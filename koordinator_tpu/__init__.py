@@ -1,0 +1,33 @@
+"""koordinator-tpu: a TPU-native QoS-based co-location scheduling framework.
+
+A from-scratch rebuild of the capabilities of Koordinator (a Kubernetes
+QoS co-location scheduling system, reference at /root/reference) designed
+TPU-first: cluster state (node allocatable/usage, pod requests, QoS /
+priority / quota / gang masks) lives as device-resident dense arrays, and
+the scheduler's Filter/Score/bin-pack inner loop, the elastic-quota
+water-filling, gang admission, and the descheduler's rebalance loop run as
+batched, sharded JAX/XLA computations over a `jax.sharding.Mesh`.
+
+Package layout (mirrors the reference's component inventory, SURVEY.md §2):
+
+- ``apis``        — the protocol: QoS classes, priority bands, resource
+                    names/units, CRD-equivalent typed objects.
+- ``state``       — the array substrate: cluster snapshots as dense arrays.
+- ``ops``         — pure jit-safe math: filter masks, scoring, bin-packing,
+                    quota water-filling, gang feasibility.
+- ``parallel``    — mesh/sharding: pjit/shard_map solver over device meshes.
+- ``models``      — end-to-end solver pipelines ("flagship models"):
+                    placement, rebalance.
+- ``scheduler``   — scheduling framework (plugin extension points) + the
+                    seven reference plugins rebuilt on the array substrate.
+- ``descheduler`` — load-aware rebalancing + migration controller.
+- ``manager``     — central controllers: node resource overcommit
+                    calculator, NodeSLO renderer, mutating webhooks.
+- ``koordlet``    — node agent: metric cache, collectors, QoS strategies,
+                    cgroup executor, prediction.
+- ``runtimeproxy``— CRI interposition skeleton.
+- ``utils``       — cpuset, sloconfig defaults, parallel helpers.
+- ``native``      — C++ perf/cgroup helpers loaded via ctypes (optional).
+"""
+
+__version__ = "0.1.0"

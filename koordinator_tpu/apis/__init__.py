@@ -1,0 +1,14 @@
+"""Typed protocol layer: QoS classes, priority bands, resources, CRD-equivalents.
+
+Mirrors the reference's ``apis/`` module (the annotation/label protocol that
+is the de-facto API of the system) as plain Python types.
+"""
+
+from koordinator_tpu.apis.extension import (  # noqa: F401
+    QoSClass,
+    PriorityClass,
+    ResourceName,
+    PRIORITY_BANDS,
+    priority_class_of,
+    qos_class_of,
+)

@@ -1,0 +1,186 @@
+"""The core protocol: QoS classes, priority bands, resource names and units.
+
+This is the TPU-native rebuild of the reference's annotation/label protocol
+(reference: apis/extension/qos.go:19-28, apis/extension/priority.go:25-58,
+apis/extension/resource.go:26-29). Because the array substrate encodes every
+pod/node attribute as integers, this module also defines the *canonical
+integer encodings* used on device:
+
+Canonical units (chosen so all score math fits int32 on TPU without x64):
+
+- CPU:    millicores (int32; 2^31 mCPU ≈ 2.1M cores — beyond any node/quota)
+- Memory: MiB        (int32; 2^31 MiB = 2 PiB per node — beyond any node)
+- Other scalar resources (batch-cpu, batch-memory, GPU shares, ...) follow
+  the same convention as their base resource.
+
+Percent math rounds via ``floor((200*used + alloc) / (2*alloc))``, which
+needs ``200*used <= 2^31`` i.e. ``used <= 10.7M`` canonical units
+(10.7k cores / 10 TiB) — safe for any single node.
+Cluster-wide aggregations (quota trees) run host-side in Python ints (exact,
+arbitrary precision, matching the reference's int64 semantics).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Mapping, Optional
+
+
+class QoSClass(enum.IntEnum):
+    """Koordinator QoS classes, integer-encoded for the array substrate.
+
+    Reference: apis/extension/qos.go:22-29. Order matters for array masks:
+    colocation logic mostly branches on "is BE" and "is latency sensitive".
+    """
+
+    NONE = 0
+    SYSTEM = 1
+    LSE = 2  # latency-sensitive exclusive (pinned cpus, no sharing)
+    LSR = 3  # latency-sensitive reserved (pinned cpus, reclaimable)
+    LS = 4   # latency-sensitive (shared pool)
+    BE = 5   # best effort (reclaimed resources)
+
+    @property
+    def is_latency_sensitive(self) -> bool:
+        return self in (QoSClass.LSE, QoSClass.LSR, QoSClass.LS)
+
+
+_QOS_BY_NAME = {
+    "LSE": QoSClass.LSE,
+    "LSR": QoSClass.LSR,
+    "LS": QoSClass.LS,
+    "BE": QoSClass.BE,
+    "SYSTEM": QoSClass.SYSTEM,
+}
+
+
+def qos_class_of(name: Optional[str]) -> QoSClass:
+    """Parse a QoS class name; unknown names map to NONE.
+
+    Reference semantics: apis/extension/qos.go:31-40 (GetPodQoSClassByName).
+    """
+    if not name:
+        return QoSClass.NONE
+    return _QOS_BY_NAME.get(name, QoSClass.NONE)
+
+
+class PriorityClass(enum.IntEnum):
+    """Koordinator priority classes (bands of k8s priority values).
+
+    Reference: apis/extension/priority.go:28-49.
+    """
+
+    NONE = 0
+    FREE = 1
+    BATCH = 2
+    MID = 3
+    PROD = 4
+
+
+#: (min, max) inclusive k8s priority value band per class
+#: (reference: apis/extension/priority.go:37-49).
+PRIORITY_BANDS: Mapping[PriorityClass, tuple] = {
+    PriorityClass.PROD: (9000, 9999),
+    PriorityClass.MID: (7000, 7999),
+    PriorityClass.BATCH: (5000, 5999),
+    PriorityClass.FREE: (3000, 3999),
+}
+
+_PRIORITY_BY_NAME = {
+    "koord-prod": PriorityClass.PROD,
+    "koord-mid": PriorityClass.MID,
+    "koord-batch": PriorityClass.BATCH,
+    "koord-free": PriorityClass.FREE,
+}
+
+
+def priority_class_of(
+    name: Optional[str] = None, value: Optional[int] = None
+) -> PriorityClass:
+    """Resolve the priority class from a class name or a numeric priority.
+
+    Name takes precedence over value, matching the reference's label-first
+    lookup (apis/extension/priority.go:71-101 GetPodPriorityClassRaw /
+    getPriorityClassByPriority).
+    """
+    if name:
+        p = _PRIORITY_BY_NAME.get(name)
+        if p is not None:
+            return p
+    if value is None:
+        return PriorityClass.NONE
+    for cls, (lo, hi) in PRIORITY_BANDS.items():
+        if lo <= value <= hi:
+            return cls
+    return PriorityClass.NONE
+
+
+class ResourceName(enum.IntEnum):
+    """Resource dimensions of the array substrate, in fixed column order.
+
+    The first two columns (CPU, MEMORY) are the native resources; the rest
+    are Koordinator extended resources (reference: apis/extension/
+    resource.go:26-29 batch-cpu/batch-memory, mid-cpu/mid-memory and
+    apis/extension/device_share.go GPU resources). Arrays of shape
+    ``[..., R]`` index this enum on the last axis.
+    """
+
+    CPU = 0          # millicores
+    MEMORY = 1       # MiB
+    BATCH_CPU = 2    # millicores, dynamically reclaimed for BE pods
+    BATCH_MEMORY = 3  # MiB, dynamically reclaimed for BE pods
+    MID_CPU = 4      # millicores, reclaimed for MID pods
+    MID_MEMORY = 5   # MiB, reclaimed for MID pods
+    GPU = 6          # GPU shares in per-cent of a device (100 == 1 GPU)
+    GPU_MEMORY = 7   # MiB of device memory
+
+
+#: Number of resource columns in substrate arrays.
+NUM_RESOURCES = len(ResourceName)
+
+#: Which resource columns are "native" (exist on every node).
+NATIVE_RESOURCES = (ResourceName.CPU, ResourceName.MEMORY)
+
+#: Batch/Mid column → the native column its quantity is denominated in.
+#: Used when translating extended resources by priority class
+#: (reference: pkg/scheduler/plugins/loadaware/load_aware.go:66
+#: TranslateResourceNameByPriorityClass).
+EXTENDED_TO_NATIVE = {
+    ResourceName.BATCH_CPU: ResourceName.CPU,
+    ResourceName.BATCH_MEMORY: ResourceName.MEMORY,
+    ResourceName.MID_CPU: ResourceName.CPU,
+    ResourceName.MID_MEMORY: ResourceName.MEMORY,
+}
+
+#: Priority class → (cpu column, memory column) a pod of that class consumes.
+PRIORITY_RESOURCES = {
+    PriorityClass.PROD: (ResourceName.CPU, ResourceName.MEMORY),
+    PriorityClass.NONE: (ResourceName.CPU, ResourceName.MEMORY),
+    PriorityClass.MID: (ResourceName.MID_CPU, ResourceName.MID_MEMORY),
+    PriorityClass.BATCH: (ResourceName.BATCH_CPU, ResourceName.BATCH_MEMORY),
+    PriorityClass.FREE: (ResourceName.CPU, ResourceName.MEMORY),
+}
+
+
+# ---------------------------------------------------------------------------
+# Well-known annotation/label keys (string protocol kept for interop with
+# tooling that speaks the reference's protocol; the array substrate is the
+# real API). Reference: apis/extension/*.go constants.
+# ---------------------------------------------------------------------------
+
+DOMAIN = "koordinator.tpu"
+
+LABEL_QOS_CLASS = f"{DOMAIN}/qosClass"
+LABEL_PRIORITY_CLASS = f"{DOMAIN}/priorityClass"
+LABEL_POD_PRIORITY = f"{DOMAIN}/priority"  # sub-priority within a band
+LABEL_GANG_NAME = f"{DOMAIN}/gang-name"
+LABEL_GANG_MIN_MEMBER = f"{DOMAIN}/gang-min-available"
+LABEL_QUOTA_NAME = f"{DOMAIN}/quota-name"
+LABEL_QUOTA_PARENT = f"{DOMAIN}/quota-parent"
+LABEL_QUOTA_IS_PARENT = f"{DOMAIN}/quota-is-parent"
+ANNOTATION_RESOURCE_SPEC = f"{DOMAIN}/resource-spec"
+ANNOTATION_RESOURCE_STATUS = f"{DOMAIN}/resource-status"
+ANNOTATION_RESERVATION_ALLOCATED = f"{DOMAIN}/reservation-allocated"
+ANNOTATION_DEVICE_ALLOCATED = f"{DOMAIN}/device-allocated"
+ANNOTATION_SOFT_EVICTION = f"{DOMAIN}/soft-eviction"
+ANNOTATION_EVICTION_COST = f"{DOMAIN}/eviction-cost"

@@ -1,0 +1,259 @@
+"""CRD-equivalent typed objects.
+
+The reference defines its API as Kubernetes CRDs plus an annotation protocol
+(reference: apis/slo/v1alpha1/nodemetric_types.go, apis/scheduling/v1alpha1/
+{reservation,pod_migration_job}_types.go, scheduler-plugins PodGroup /
+ElasticQuota). Here they are plain Python dataclasses: the control plane of
+this framework is in-process (or gRPC-fronted, see ``runtimeproxy``), and
+the hot state is immediately lowered onto the array substrate
+(``koordinator_tpu.state``).
+
+All quantities are canonical integer units (see apis/extension.py):
+CPU in millicores, memory in MiB.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import (
+    NUM_RESOURCES,
+    PriorityClass,
+    QoSClass,
+    ResourceName,
+    priority_class_of,
+)
+
+#: Sparse resource mapping in canonical units.
+Resources = Dict[ResourceName, int]
+
+
+def resources_to_vector(res: Optional[Mapping[ResourceName, int]]) -> np.ndarray:
+    """Densify a sparse resource mapping into an int64 ``[R]`` vector."""
+    vec = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    if res:
+        for name, qty in res.items():
+            vec[int(name)] = int(qty)
+    return vec
+
+
+def vector_to_resources(vec: np.ndarray) -> Resources:
+    """Sparsify an ``[R]`` vector back into a mapping (drops zeros)."""
+    return {ResourceName(i): int(v) for i, v in enumerate(vec) if v != 0}
+
+
+def add_resources(a: Resources, b: Resources) -> Resources:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+@dataclasses.dataclass
+class PodSpec:
+    """A pod as the scheduler sees it.
+
+    Combines corev1.Pod fields with the Koordinator label protocol already
+    resolved (QoS class, priority class/band value, quota, gang).
+    """
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    requests: Resources = dataclasses.field(default_factory=dict)
+    limits: Resources = dataclasses.field(default_factory=dict)
+    qos: QoSClass = QoSClass.NONE
+    priority: int = 0           # numeric k8s priority
+    sub_priority: int = 0       # koordinator.tpu/priority within the band
+    priority_class: Optional[PriorityClass] = None  # derived if None
+    quota: Optional[str] = None
+    gang: Optional[str] = None
+    node_name: Optional[str] = None   # set once assigned
+    is_daemonset: bool = False
+    preemptible: bool = True
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # wall-clock seconds when this pod was assigned (for loadaware estimation
+    # staleness rules, reference: load_aware.go:337-376)
+    assign_time: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.priority_class is None:
+            self.priority_class = priority_class_of(value=self.priority)
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    """A node: allocatable capacity plus scheduling-relevant attributes."""
+
+    name: str
+    allocatable: Resources = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    unschedulable: bool = False
+    # raw (pre-amplification) allocatable if cpu-normalization applies
+    raw_allocatable: Optional[Resources] = None
+
+
+@dataclasses.dataclass
+class NodeMetric:
+    """Reported node/pod utilization (reference: NodeMetric CRD,
+    apis/slo/v1alpha1/nodemetric_types.go).
+
+    ``update_time`` drives staleness (filter skip at 180s default,
+    degrade-to-zero in the manager's batch calculator).
+    """
+
+    node_name: str
+    node_usage: Resources = dataclasses.field(default_factory=dict)
+    # pod uid -> usage
+    pod_usages: Dict[str, Resources] = dataclasses.field(default_factory=dict)
+    # priority-class aggregated usage (prod usage mode)
+    prod_usage: Resources = dataclasses.field(default_factory=dict)
+    sys_usage: Resources = dataclasses.field(default_factory=dict)
+    # percentile -> usage, for aggregated usage mode (p50/p90/p95/p99)
+    aggregated_usage: Dict[int, Resources] = dataclasses.field(default_factory=dict)
+    update_time: float = 0.0
+    report_interval: float = 60.0
+
+
+class GangMode(enum.Enum):
+    """Gang failure handling (reference: core/gang.go ScheduleStrategy)."""
+
+    STRICT = "Strict"
+    NON_STRICT = "NonStrict"
+
+
+@dataclasses.dataclass
+class GangSpec:
+    """A gang / PodGroup: all-or-nothing co-scheduling unit.
+
+    Reference: scheduler-plugins PodGroup CRD + annotation fallback
+    (pkg/scheduler/plugins/coscheduling/core/gang.go:43-95).
+    """
+
+    name: str
+    min_member: int
+    total_member: int = 0
+    wait_time: float = 600.0
+    mode: GangMode = GangMode.STRICT
+    # gangs that must be admitted together (gang group)
+    gang_group: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class QuotaSpec:
+    """An elastic quota node in the hierarchical quota tree.
+
+    Reference: scheduler-plugins ElasticQuota CRD + koordinator extensions
+    (shared weight, allow-lent, guaranteed; pkg/scheduler/plugins/
+    elasticquota/core/quota_info.go).
+    """
+
+    name: str
+    parent: Optional[str] = None
+    min: Resources = dataclasses.field(default_factory=dict)
+    max: Resources = dataclasses.field(default_factory=dict)
+    shared_weight: Optional[Resources] = None  # defaults to max
+    is_parent: bool = False
+    allow_lent_resource: bool = True
+    guaranteed: Resources = dataclasses.field(default_factory=dict)
+    tree_id: str = ""
+
+
+class ReservationState(enum.Enum):
+    PENDING = "Pending"
+    AVAILABLE = "Available"
+    SUCCEEDED = "Succeeded"
+    EXPIRED = "Expired"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class ReservationSpec:
+    """A resource reservation (reference: apis/scheduling/v1alpha1/
+    reservation_types.go).
+
+    Reserves capacity on a node; owner pods matching ``owner_labels`` may
+    allocate from it instead of from raw node capacity.
+    """
+
+    name: str
+    requests: Resources = dataclasses.field(default_factory=dict)
+    owner_labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    node_name: Optional[str] = None        # set once the reservation is bound
+    state: ReservationState = ReservationState.PENDING
+    allocatable: Resources = dataclasses.field(default_factory=dict)
+    allocated: Resources = dataclasses.field(default_factory=dict)
+    expiration_time: Optional[float] = None
+    allocate_once: bool = True
+    owner_pod_uids: List[str] = dataclasses.field(default_factory=list)
+
+
+class MigrationPhase(enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class PodMigrationJob:
+    """Descheduler migration job (reference: apis/scheduling/v1alpha1/
+    pod_migration_job_types.go): reservation-first eviction state machine.
+    """
+
+    name: str
+    pod_uid: str
+    phase: MigrationPhase = MigrationPhase.PENDING
+    reservation_name: Optional[str] = None
+    reason: str = ""
+    ttl: float = 300.0
+    create_time: float = 0.0
+    paused: bool = False
+
+
+@dataclasses.dataclass
+class DeviceInfo:
+    """One allocatable device on a node (reference: apis/scheduling/
+    v1alpha1/device_types.go DeviceInfo)."""
+
+    minor: int                      # device index on the node
+    device_type: str = "gpu"        # gpu | rdma | fpga
+    resources: Resources = dataclasses.field(default_factory=dict)
+    numa_node: int = 0
+    pcie_id: int = 0
+    health: bool = True
+
+
+@dataclasses.dataclass
+class NodeDevice:
+    """Per-node device inventory + topology (Device CRD equivalent)."""
+
+    node_name: str
+    devices: List[DeviceInfo] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClusterSnapshot:
+    """Everything the placement solver needs for one solve.
+
+    This is the host-side, typed view; ``koordinator_tpu.state`` lowers it
+    to arrays. Components (informers in the reference) incrementally update
+    it; solves see a consistent copy.
+    """
+
+    nodes: List[NodeSpec] = dataclasses.field(default_factory=list)
+    pods: List[PodSpec] = dataclasses.field(default_factory=list)  # assigned pods
+    pending_pods: List[PodSpec] = dataclasses.field(default_factory=list)
+    node_metrics: Dict[str, NodeMetric] = dataclasses.field(default_factory=dict)
+    gangs: Dict[str, GangSpec] = dataclasses.field(default_factory=dict)
+    quotas: Dict[str, QuotaSpec] = dataclasses.field(default_factory=dict)
+    reservations: List[ReservationSpec] = dataclasses.field(default_factory=list)
+    devices: Dict[str, NodeDevice] = dataclasses.field(default_factory=dict)
+    now: float = 0.0

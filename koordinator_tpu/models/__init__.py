@@ -1,0 +1,8 @@
+"""End-to-end solver pipelines — the framework's "flagship models".
+
+A *model* here is a compiled, device-resident decision program over cluster
+state: placement (the scheduler's inner loop), rebalance (the descheduler's
+loop). Each model owns its jitted computation and the host↔device staging.
+"""
+
+from koordinator_tpu.models.placement import PlacementModel  # noqa: F401

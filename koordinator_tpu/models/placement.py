@@ -1,0 +1,127 @@
+"""PlacementModel: the flagship batched placement solver.
+
+Wraps the scan-based solver (ops/binpack.py) with host↔device staging and
+typed in/out: takes a ``ClusterSnapshot``, returns pod→node assignments
+with semantics identical to running the reference's Filter→Score→Reserve
+cycle pod-by-pod (differentially tested against the oracle).
+
+The node axis is shardable over a ``jax.sharding.Mesh`` (see
+``koordinator_tpu.parallel``): scores are computed on node shards and the
+argmax reduction rides ICI collectives inserted by GSPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.types import ClusterSnapshot
+from koordinator_tpu.ops.binpack import (
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    schedule_batch,
+)
+from koordinator_tpu.state.cluster import (
+    DEFAULT_ESTIMATED_SCALING_FACTORS,
+    DEFAULT_RESOURCE_WEIGHTS,
+    DEFAULT_USAGE_THRESHOLDS,
+    NodeArrays,
+    PendingPodArrays,
+    lower_nodes,
+    lower_pending_pods,
+)
+
+
+def _vec(mapping, dtype=np.int32) -> np.ndarray:
+    out = np.zeros(NUM_RESOURCES, dtype=dtype)
+    for k, v in mapping.items():
+        out[int(k)] = v
+    return out
+
+
+class PlacementModel:
+    """Compiled batched placement over a (possibly sharded) node axis."""
+
+    def __init__(
+        self,
+        config: SolverConfig = SolverConfig(),
+        resource_weights=None,
+        usage_thresholds=None,
+        prod_usage_thresholds=None,
+        scaling_factors=None,
+        sharding: Optional[jax.sharding.Sharding] = None,
+    ):
+        self.config = config
+        self.resource_weights = dict(resource_weights or DEFAULT_RESOURCE_WEIGHTS)
+        self.scaling_factors = dict(
+            scaling_factors or DEFAULT_ESTIMATED_SCALING_FACTORS
+        )
+        self.params = ScoreParams(
+            weights=jnp.asarray(_vec(self.resource_weights)),
+            thresholds=jnp.asarray(_vec(usage_thresholds or DEFAULT_USAGE_THRESHOLDS)),
+            prod_thresholds=jnp.asarray(_vec(prod_usage_thresholds or {})),
+        )
+        self.sharding = sharding
+        self._solve = jax.jit(schedule_batch, static_argnames=("config",))
+
+    # -- staging ------------------------------------------------------------
+
+    def stage_nodes(self, arrays: NodeArrays) -> NodeState:
+        """Stage host node arrays onto devices (sharded if configured)."""
+        put = (
+            (lambda x: jax.device_put(x, self.sharding))
+            if self.sharding is not None
+            else jnp.asarray
+        )
+        return NodeState(
+            alloc=put(arrays.alloc),
+            used_req=put(arrays.used_req),
+            usage=put(arrays.usage),
+            prod_usage=put(arrays.prod_usage),
+            est_extra=put(arrays.est_extra),
+            prod_base=put(arrays.prod_base),
+            metric_fresh=put(arrays.metric_fresh),
+            schedulable=put(arrays.schedulable),
+        )
+
+    @staticmethod
+    def stage_pods(arrays: PendingPodArrays) -> PodBatch:
+        return PodBatch(
+            req=jnp.asarray(arrays.req),
+            est=jnp.asarray(arrays.est),
+            is_prod=jnp.asarray(arrays.is_prod),
+            is_daemonset=jnp.asarray(arrays.is_daemonset),
+        )
+
+    # -- solve --------------------------------------------------------------
+
+    def solve(self, state: NodeState, pods: PodBatch):
+        """Jitted solve on staged arrays; returns (new_state, assignments)."""
+        return self._solve(state, pods, self.params, self.config)
+
+    def schedule(self, snapshot: ClusterSnapshot) -> Dict[str, Optional[str]]:
+        """Typed end-to-end: snapshot → {pod uid: node name or None}."""
+        node_arrays = lower_nodes(
+            snapshot,
+            scaling_factors=self.scaling_factors,
+            resource_weights=self.resource_weights,
+        )
+        pod_arrays = lower_pending_pods(
+            snapshot.pending_pods,
+            scaling_factors=self.scaling_factors,
+            resource_weights=self.resource_weights,
+        )
+        state = self.stage_nodes(node_arrays)
+        batch = self.stage_pods(pod_arrays)
+        _, assignments = self.solve(state, batch)
+        assignments = np.asarray(assignments)
+        return {
+            uid: (node_arrays.names[a] if a >= 0 else None)
+            for uid, a in zip(pod_arrays.uids, assignments)
+        }

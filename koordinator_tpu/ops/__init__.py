@@ -1,0 +1,7 @@
+"""Pure, jit-safe scheduling math over the array substrate.
+
+Every function here is shape-polymorphic, side-effect free, and traceable
+under ``jax.jit`` / ``pjit`` — no data-dependent Python control flow. These
+are the TPU-native equivalents of the reference's per-node Go plugin
+callbacks, batched over the node (and pod) axes.
+"""

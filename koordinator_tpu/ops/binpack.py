@@ -1,0 +1,182 @@
+"""Batched pod placement: the scheduler's hot loop as one XLA computation.
+
+The reference schedules pods one at a time: per pod it runs Filter over all
+nodes, Score over the feasible ones, picks the best, and *assumes* the pod
+into the in-memory cache so the next pod sees it (SURVEY.md §3.1). Here the
+entire pending queue is placed in a single ``lax.scan`` over pods (schedule
+order), where each step is fully vectorized over the node axis:
+
+    mask  = fit_filter & loadaware_filter & schedulable        # [N]
+    score = Σ_plugin weight · plugin_score                     # [N]
+    node  = argmax(score masked)                                # []
+    state += pod (requests into used_req, estimate into est_extra)
+
+This preserves the reference's observable semantics (same pod order, same
+per-pod view of prior placements) while compiling to one TPU program — no
+host round-trips per pod. Tie-breaking is deterministic lowest-index
+(the reference picks uniformly among max-score nodes; any member of that
+set is a legal outcome, we fix the first).
+
+Reference: pkg/scheduler/frameworkext/framework_extender.go:167-262
+(RunPreFilter/Filter/Score) and the plugin semantics in ops/fit.py,
+ops/loadaware.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.ops.fit import fit_filter, least_allocated_score
+from koordinator_tpu.ops.loadaware import loadaware_filter, loadaware_score
+
+
+class SolverConfig(NamedTuple):
+    """Static (trace-time) solver configuration."""
+
+    fit_weight: int = 1          # NodeResourcesFit LeastAllocated plugin weight
+    loadaware_weight: int = 1    # LoadAwareScheduling plugin weight
+    score_according_prod: bool = False
+
+
+class NodeState(NamedTuple):
+    """Device-resident node-side solver state (the scan carry).
+
+    All arrays int32 canonical units; bool masks.
+    """
+
+    alloc: jnp.ndarray         # [N,R]
+    used_req: jnp.ndarray      # [N,R] assigned pod requests (mutated by solve)
+    usage: jnp.ndarray         # [N,R] reported usage (static within a solve)
+    prod_usage: jnp.ndarray    # [N,R] prod Filter base (Σ prod reported usage)
+    est_extra: jnp.ndarray     # [N,R] assigned-pod estimation correction
+    prod_base: jnp.ndarray     # [N,R] prod-mode score base
+    metric_fresh: jnp.ndarray  # [N]
+    schedulable: jnp.ndarray   # [N]
+
+
+class PodBatch(NamedTuple):
+    """Pending pods in schedule order (the scan xs)."""
+
+    req: jnp.ndarray           # [P,R]
+    est: jnp.ndarray           # [P,R]
+    is_prod: jnp.ndarray       # [P]
+    is_daemonset: jnp.ndarray  # [P]
+
+
+class ScoreParams(NamedTuple):
+    """Per-solve scoring parameters (device arrays)."""
+
+    weights: jnp.ndarray          # [R] resource weights
+    thresholds: jnp.ndarray       # [R] loadaware usage thresholds (%)
+    prod_thresholds: jnp.ndarray  # [R] loadaware prod-usage thresholds (%)
+
+
+def score_one_pod(
+    state: NodeState,
+    req: jnp.ndarray,
+    est: jnp.ndarray,
+    is_prod: jnp.ndarray,
+    is_daemonset: jnp.ndarray,
+    params: ScoreParams,
+    config: SolverConfig,
+) -> tuple:
+    """(mask[N], score[N]) for one pod against the full node set."""
+    mask = (
+        state.schedulable
+        & fit_filter(req, state.alloc, state.used_req)
+        & loadaware_filter(
+            state.alloc,
+            state.usage,
+            state.prod_usage,
+            state.metric_fresh,
+            params.thresholds,
+            params.prod_thresholds,
+            is_daemonset,
+            is_prod,
+        )
+    )
+    score = config.fit_weight * least_allocated_score(
+        req, state.alloc, state.used_req, params.weights
+    ) + config.loadaware_weight * loadaware_score(
+        est,
+        state.alloc,
+        state.usage,
+        state.est_extra,
+        state.prod_base,
+        state.metric_fresh,
+        params.weights,
+        is_prod,
+        config.score_according_prod,
+    )
+    return mask, score
+
+
+def place_one_pod(
+    state: NodeState,
+    req: jnp.ndarray,
+    est: jnp.ndarray,
+    is_prod: jnp.ndarray,
+    is_daemonset: jnp.ndarray,
+    params: ScoreParams,
+    config: SolverConfig,
+    extra_mask: Optional[jnp.ndarray] = None,
+    admit: Optional[jnp.ndarray] = None,
+) -> tuple:
+    """Place a single pod; returns (new_state, chosen_node or -1).
+
+    ``extra_mask`` lets upper layers (reservation matching, node affinity,
+    NUMA admit) inject per-node feasibility; ``admit`` gates the whole pod
+    (quota / gang admission) without disturbing scan shape.
+    """
+    mask, score = score_one_pod(state, req, est, is_prod, is_daemonset, params, config)
+    if extra_mask is not None:
+        mask = mask & extra_mask
+    if admit is not None:
+        mask = mask & admit
+    masked_score = jnp.where(mask, score, -1)
+    best = jnp.argmax(masked_score)          # first max index == deterministic tie-break
+    ok = masked_score[best] >= 0
+    node = jnp.where(ok, best, -1).astype(jnp.int32)
+    add_req = jnp.where(ok, req, 0)
+    add_est = jnp.where(ok, est, 0)
+    # An assumed pod has no reported usage yet, so it is "estimated" for
+    # subsequent pods in this solve: non-prod correction always grows by
+    # its estimate; the prod score base grows only for prod pods.
+    new_state = state._replace(
+        used_req=state.used_req.at[best].add(add_req),
+        est_extra=state.est_extra.at[best].add(add_est),
+        prod_base=state.prod_base.at[best].add(jnp.where(is_prod, add_est, 0)),
+    )
+    return new_state, node
+
+
+def schedule_batch(
+    state: NodeState,
+    pods: PodBatch,
+    params: ScoreParams,
+    config: SolverConfig = SolverConfig(),
+) -> tuple:
+    """Schedule a whole pending queue; returns (final_state, assignments[P]).
+
+    ``assignments[i]`` is the node index for pod i (in the given order) or
+    -1 if unschedulable at its turn. Semantics match scheduling the pods
+    one-by-one through the reference's Filter→Score→Reserve cycle.
+    """
+    n_pods = pods.req.shape[0]
+    if state.alloc.shape[0] == 0:  # static shape: no nodes, nothing placeable
+        return state, jnp.full(n_pods, -1, dtype=jnp.int32)
+
+    def step(carry: NodeState, xs):
+        req, est, is_prod, is_ds = xs
+        new_state, node = place_one_pod(
+            carry, req, est, is_prod, is_ds, params, config
+        )
+        return new_state, node
+
+    final_state, assignments = jax.lax.scan(
+        step, state, (pods.req, pods.est, pods.is_prod, pods.is_daemonset)
+    )
+    return final_state, assignments

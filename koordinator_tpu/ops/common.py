@@ -1,0 +1,49 @@
+"""Shared integer math helpers used across scheduling ops.
+
+All score math is exact int32 arithmetic. The reference computes in Go
+int64 (occasionally via float64 with half-away-from-zero rounding); the
+identities below reproduce those results exactly for the canonical-unit
+value ranges (documented in apis/extension.py): percent math requires
+values ≤ ~10.7M canonical units (10k cores / 10 TiB per node).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: framework.MaxNodeScore in the k8s scheduler framework.
+MAX_NODE_SCORE = 100
+
+
+def percent_rounded(used: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """``round(used / total * 100)`` with half-away-from-zero rounding in
+    exact integer arithmetic: ``floor((200*used + total) / (2*total))``.
+    ``total == 0`` yields 0.
+
+    The reference (load_aware.go:215) computes this through float64, which
+    can round an exact .5 boundary down (23/40 → 57 instead of 58); this
+    framework defines the exact rational result as the semantics (see
+    oracle/scheduler.py percent_rounded for the full note).
+    """
+    total_safe = jnp.maximum(total, 1)
+    pct = (200 * used + total_safe) // (2 * total_safe)
+    return jnp.where(total > 0, pct, 0)
+
+
+def least_requested_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """``(capacity - requested) * 100 / capacity``; 0 when capacity is 0 or
+    requested exceeds capacity (reference: load_aware.go:388-397).
+    Integer (truncating) division — operands are non-negative so Go's
+    truncation equals floor division.
+    """
+    cap_safe = jnp.maximum(capacity, 1)
+    score = ((capacity - requested) * MAX_NODE_SCORE) // cap_safe
+    return jnp.where((capacity == 0) | (requested > capacity), 0, score)
+
+
+def weighted_mean_scores(scores: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    """``Σ_r score_r * w_r // Σ_r w_r`` along the last axis (the single
+    final integer division matches loadAwareSchedulingScorer,
+    load_aware.go:378-386)."""
+    weight_sum = jnp.maximum(jnp.sum(weights), 1)
+    return jnp.sum(scores * weights, axis=-1) // weight_sum

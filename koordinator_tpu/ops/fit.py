@@ -1,0 +1,43 @@
+"""NodeResourcesFit: resource-fit filter + LeastAllocated scoring, batched.
+
+The upstream k8s scheduler's NodeResourcesFit plugin (the reference relies
+on it for baseline fitting; SURVEY.md A.6) checks, per requested resource,
+``request <= allocatable - requested_on_node`` and scores nodes by the
+least-allocated formula. Here both are single vectorized expressions over
+``[N, R]`` node matrices — the whole cluster is filtered/scored in one shot.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from koordinator_tpu.ops.common import least_requested_score, weighted_mean_scores
+
+
+def fit_filter(
+    pod_req: jnp.ndarray,      # [R] int32
+    node_alloc: jnp.ndarray,   # [N,R] int32
+    node_used: jnp.ndarray,    # [N,R] int32 (sum of assigned pod requests)
+) -> jnp.ndarray:
+    """Boolean ``[N]`` mask: node has room for the pod's requests.
+
+    Resources the pod does not request (req==0) impose no constraint,
+    matching upstream Fit which iterates only requested resources.
+    """
+    fits = (pod_req == 0) | (node_used + pod_req <= node_alloc)
+    return jnp.all(fits, axis=-1)
+
+
+def least_allocated_score(
+    pod_req: jnp.ndarray,      # [R] int32
+    node_alloc: jnp.ndarray,   # [N,R] int32
+    node_used: jnp.ndarray,    # [N,R] int32
+    weights: jnp.ndarray,      # [R] int32 (0 = resource not scored)
+) -> jnp.ndarray:
+    """LeastAllocated score ``[N]`` in 0..100:
+    ``Σ_r w_r * (alloc - (used+req)) * 100 / alloc  //  Σ_r w_r``
+    (SURVEY.md A.6; same form as the reference's leastRequestedScore but
+    over requests rather than estimated usage)."""
+    requested = node_used + pod_req
+    per_resource = least_requested_score(requested, node_alloc)
+    return weighted_mean_scores(per_resource, weights)

@@ -1,0 +1,84 @@
+"""LoadAware filter + scoring, batched over the node axis.
+
+TPU-native rebuild of the reference's LoadAwareScheduling plugin
+(pkg/scheduler/plugins/loadaware/load_aware.go). Semantics (SURVEY.md
+A.1/A.2), reproduced bit-exactly given canonical-unit inputs:
+
+Filter — a node is schedulable for the pod unless a thresholded resource's
+utilization percentage meets/exceeds its threshold. Skips (passes) when the
+pod is DaemonSet-owned or the node has no fresh NodeMetric. Prod pods with
+prod thresholds configured compare the *prod pods' usage sum* instead of
+whole-node usage.
+
+Score — estimated-used = estimate(pod) + node usage + assigned-pod
+estimation correction (``est_extra``, precomputed at lowering; see
+state/cluster.py), scored with the weighted least-requested formula.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from koordinator_tpu.ops.common import (
+    least_requested_score,
+    percent_rounded,
+    weighted_mean_scores,
+)
+
+
+def loadaware_filter(
+    node_alloc: jnp.ndarray,     # [N,R] int32 (estimated node allocatable)
+    node_usage: jnp.ndarray,     # [N,R] int32 (whole-node or aggregated usage)
+    prod_usage: jnp.ndarray,     # [N,R] int32 (sum of prod pods' usage)
+    metric_fresh: jnp.ndarray,   # [N] bool
+    thresholds: jnp.ndarray,     # [R] int32, 0 = resource not thresholded
+    prod_thresholds: jnp.ndarray,  # [R] int32, all-zero = prod mode disabled
+    pod_is_daemonset: jnp.ndarray,  # [] bool
+    pod_is_prod: jnp.ndarray,       # [] bool
+) -> jnp.ndarray:
+    """Boolean ``[N]`` mask of nodes that pass the LoadAware filter.
+
+    Reference: load_aware.go:123-255. A zero threshold disables the check
+    for that resource; zero allocatable skips the resource; a node without
+    a fresh metric always passes (the plugin treats missing/expired metrics
+    as "no load information — skip").
+    """
+    usage_pct = percent_rounded(node_usage, node_alloc)       # [N,R]
+    prod_pct = percent_rounded(prod_usage, node_alloc)        # [N,R]
+
+    checkable = (node_alloc > 0)
+    over = checkable & (thresholds > 0) & (usage_pct >= thresholds)
+    over_prod = checkable & (prod_thresholds > 0) & (prod_pct >= prod_thresholds)
+
+    prod_mode = pod_is_prod & jnp.any(prod_thresholds > 0)
+    violated = jnp.where(prod_mode, jnp.any(over_prod, axis=-1), jnp.any(over, axis=-1))
+    return pod_is_daemonset | ~metric_fresh | ~violated
+
+
+def loadaware_score(
+    pod_est: jnp.ndarray,        # [R] int32 estimator output for the pod
+    node_alloc: jnp.ndarray,     # [N,R] int32
+    node_usage: jnp.ndarray,     # [N,R] int32
+    est_extra: jnp.ndarray,      # [N,R] int32 assigned-pod correction
+    prod_base: jnp.ndarray,      # [N,R] int32 prod-mode score base
+    metric_fresh: jnp.ndarray,   # [N] bool
+    weights: jnp.ndarray,        # [R] int32
+    pod_is_prod: jnp.ndarray,    # [] bool — prod-usage scoring mode
+    score_according_prod: bool = False,
+) -> jnp.ndarray:
+    """LoadAware score ``[N]`` in 0..100 (load_aware.go:269-397).
+
+    Nodes without a fresh metric score 0, matching the reference's early
+    returns. Non-prod mode: estimated-used = node usage + ``est_extra``
+    (the Σ max(estimate, reported) − covered-reported correction for pods
+    assigned since the last metric report) + the incoming pod's estimate.
+    Prod mode (ScoreAccordingProdUsage for prod pods): estimated-used =
+    ``prod_base`` + incoming estimate, where prod_base was built from prod
+    pods only (see state/cluster.py lower_nodes).
+    """
+    prod_mode = score_according_prod & pod_is_prod
+    base = jnp.where(prod_mode, prod_base, node_usage + est_extra)
+    estimated_used = base + pod_est                             # [N,R]
+    per_resource = least_requested_score(estimated_used, node_alloc)
+    score = weighted_mean_scores(per_resource, weights)
+    return jnp.where(metric_fresh, score, 0)

@@ -1,0 +1,9 @@
+"""Reference-semantics oracle: straight per-node/per-pod Python versions of
+the Go decision functions (SURVEY.md Appendix A), kept deliberately
+un-vectorized and float64-faithful (Go's ``math.Round`` paths use float64;
+Python floats are the same IEEE doubles).
+
+The JAX ops in ``koordinator_tpu.ops`` must match these bit-for-bit on
+canonical-unit inputs — golden tests in tests/ enforce it. The oracle also
+doubles as the measured "reference path" in bench comparisons.
+"""

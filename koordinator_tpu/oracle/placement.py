@@ -1,0 +1,78 @@
+"""Sequential reference-path scheduler: pod-by-pod loop over Python scalars.
+
+This mirrors the reference's scheduleOne cycle (Filter over nodes → Score →
+pick best → assume into cache) with the same plugin combination as the
+batched solver. It is the differential-test oracle for ops/binpack.py and
+the measured "reference CPU path" in benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from koordinator_tpu.oracle.scheduler import (
+    fit_filter_node,
+    least_allocated_score_node,
+    loadaware_filter_node,
+    loadaware_score_node,
+)
+
+
+def schedule_sequential(
+    alloc: np.ndarray,          # [N,R]
+    used_req: np.ndarray,       # [N,R] (copied, not mutated)
+    usage: np.ndarray,          # [N,R]
+    prod_usage: np.ndarray,     # [N,R]
+    est_extra: np.ndarray,      # [N,R] (copied)
+    prod_base: np.ndarray,      # [N,R] (copied)
+    metric_fresh: Sequence[bool],
+    schedulable: Sequence[bool],
+    pod_req: np.ndarray,        # [P,R]
+    pod_est: np.ndarray,        # [P,R]
+    pod_is_prod: Sequence[bool],
+    pod_is_daemonset: Sequence[bool],
+    weights: Sequence[int],
+    thresholds: Sequence[int],
+    prod_thresholds: Sequence[int],
+    fit_weight: int = 1,
+    loadaware_weight: int = 1,
+    score_according_prod: bool = False,
+) -> List[int]:
+    """Returns node index per pod (-1 = unschedulable), lowest-index
+    tie-break, each pod seeing all prior placements."""
+    n = alloc.shape[0]
+    used_req = used_req.copy()
+    est_extra = est_extra.copy()
+    prod_base = prod_base.copy()
+    assignments: List[int] = []
+    for p in range(pod_req.shape[0]):
+        best_node, best_score = -1, -1
+        for i in range(n):
+            if not schedulable[i]:
+                continue
+            if not fit_filter_node(pod_req[p], alloc[i], used_req[i]):
+                continue
+            if not loadaware_filter_node(
+                alloc[i], usage[i], prod_usage[i], bool(metric_fresh[i]),
+                thresholds, prod_thresholds,
+                bool(pod_is_daemonset[p]), bool(pod_is_prod[p]),
+            ):
+                continue
+            score = fit_weight * least_allocated_score_node(
+                pod_req[p], alloc[i], used_req[i], weights
+            ) + loadaware_weight * loadaware_score_node(
+                pod_est[p], alloc[i], usage[i], est_extra[i], prod_base[i],
+                bool(metric_fresh[i]), weights,
+                bool(pod_is_prod[p]), score_according_prod,
+            )
+            if score > best_score:
+                best_node, best_score = i, score
+        assignments.append(best_node)
+        if best_node >= 0:
+            used_req[best_node] += pod_req[p]
+            est_extra[best_node] += pod_est[p]
+            if pod_is_prod[p]:
+                prod_base[best_node] += pod_est[p]
+    return assignments

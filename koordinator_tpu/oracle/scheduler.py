@@ -1,0 +1,136 @@
+"""Per-node scheduler decision functions with reference (Go) semantics.
+
+Each function mirrors one decision function from SURVEY.md Appendix A,
+written as a direct scalar transliteration of the semantics (int64 Go
+arithmetic == Python ints; float64 where the reference uses float64).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+MAX_NODE_SCORE = 100
+
+
+def percent_rounded(used: int, total: int) -> int:
+    """``round(used/total*100)``, half away from zero, in exact rational
+    arithmetic: ``floor((200*used + total) / (2*total))``.
+
+    DOCUMENTED DEVIATION from the reference: load_aware.go:215 computes
+    this through float64 (``math.Round(float64(used)/float64(total)*100)``),
+    whose division rounding can land an exact .5 boundary slightly below
+    the half (e.g. used=23, total=40 → 57.4999999999999993 → 57, where the
+    exact rational 57.5 rounds to 58). This framework defines the
+    *infinitely-precise* result as the semantics — deterministic and
+    hardware-independent — so both the oracle and the device path use the
+    exact form. See percent_rounded_go_float64 for the reference quirk.
+    """
+    if total == 0:
+        return 0
+    return (200 * used + total) // (2 * total)
+
+
+def percent_rounded_go_float64(used: int, total: int) -> int:
+    """The reference's literal float64 path (load_aware.go:215), kept for
+    documenting where the exact-rational semantics deviate from it."""
+    if total == 0:
+        return 0
+    return int(math.floor(float(used) / float(total) * 100 + 0.5))
+
+
+def least_requested_score(requested: int, capacity: int) -> int:
+    """load_aware.go:388-397 (also upstream least_allocated semantics)."""
+    if capacity == 0:
+        return 0
+    if requested > capacity:
+        return 0
+    return (capacity - requested) * MAX_NODE_SCORE // capacity
+
+
+def fit_filter_node(
+    pod_req: Sequence[int], alloc: Sequence[int], used: Sequence[int]
+) -> bool:
+    """Upstream NodeResourcesFit: every requested resource must fit."""
+    for r, req in enumerate(pod_req):
+        if req == 0:
+            continue
+        if used[r] + req > alloc[r]:
+            return False
+    return True
+
+
+def least_allocated_score_node(
+    pod_req: Sequence[int],
+    alloc: Sequence[int],
+    used: Sequence[int],
+    weights: Sequence[int],
+) -> int:
+    """SURVEY.md A.6: weighted least-allocated over requests."""
+    node_score = 0
+    weight_sum = 0
+    for r, w in enumerate(weights):
+        node_score += least_requested_score(used[r] + pod_req[r], alloc[r]) * w
+        weight_sum += w
+    if weight_sum == 0:
+        return 0
+    return node_score // weight_sum
+
+
+def loadaware_filter_node(
+    alloc: Sequence[int],
+    node_usage: Sequence[int],
+    prod_usage: Sequence[int],
+    metric_fresh: bool,
+    thresholds: Sequence[int],
+    prod_thresholds: Sequence[int],
+    pod_is_daemonset: bool,
+    pod_is_prod: bool,
+) -> bool:
+    """SURVEY.md A.1 (load_aware.go:123-255). True = node passes."""
+    if pod_is_daemonset:
+        return True
+    if not metric_fresh:
+        return True
+    prod_mode = pod_is_prod and any(t > 0 for t in prod_thresholds)
+    if prod_mode:
+        usage_vec, thr_vec = prod_usage, prod_thresholds
+    else:
+        usage_vec, thr_vec = node_usage, thresholds
+    for r, threshold in enumerate(thr_vec):
+        if threshold == 0:
+            continue
+        if alloc[r] == 0:
+            continue
+        if percent_rounded(usage_vec[r], alloc[r]) >= threshold:
+            return False
+    return True
+
+
+def loadaware_score_node(
+    pod_est: Sequence[int],
+    alloc: Sequence[int],
+    node_usage: Sequence[int],
+    est_extra: Sequence[int],
+    prod_base: Sequence[int],
+    metric_fresh: bool,
+    weights: Sequence[int],
+    pod_is_prod: bool,
+    score_according_prod: bool = False,
+) -> int:
+    """SURVEY.md A.2 (load_aware.go:269-397) given the precomputed
+    assigned-pod corrections (see state/cluster.py): non-prod base is
+    node_usage + est_extra; prod base is prod_base."""
+    if not metric_fresh:
+        return 0
+    prod_mode = score_according_prod and pod_is_prod
+    node_score = 0
+    weight_sum = 0
+    for r, w in enumerate(weights):
+        base = prod_base[r] if prod_mode else node_usage[r] + est_extra[r]
+        estimated_used = base + pod_est[r]
+        node_score += least_requested_score(estimated_used, alloc[r]) * w
+        weight_sum += w
+    if weight_sum == 0:
+        return 0
+    return node_score // weight_sum

@@ -1,0 +1,18 @@
+"""Device-mesh parallelism for the placement solver.
+
+The "long axis" of this workload is the node dimension of the placement
+matrices (SURVEY.md §5.7): 5k-node × R-resource tensors are sharded across
+the chips of a pod slice; scoring is embarrassingly parallel over node
+shards and the per-pod argmax reduction rides ICI collectives inserted by
+GSPMD. This is the framework's data-parallel axis — the analogue of the
+reference's node-parallel Filter/Score fan-out
+(pkg/util/parallelize/parallelism.go).
+"""
+
+from koordinator_tpu.parallel.mesh import (  # noqa: F401
+    NODE_AXIS,
+    make_mesh,
+    node_sharding,
+    pad_node_arrays,
+    shard_solver,
+)

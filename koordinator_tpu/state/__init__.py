@@ -1,0 +1,9 @@
+"""Array substrate: cluster state lowered to dense device arrays."""
+
+from koordinator_tpu.state.cluster import (  # noqa: F401
+    NodeArrays,
+    PendingPodArrays,
+    estimate_pod_used,
+    lower_nodes,
+    lower_pending_pods,
+)

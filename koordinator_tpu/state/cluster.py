@@ -1,0 +1,334 @@
+"""Lower typed cluster snapshots onto the dense array substrate.
+
+The TPU-first design stance (SURVEY.md §7): represent cluster state as dense
+integer arrays — ``node_alloc[N,R]``, ``node_used[N,R]``, ``pod_req[P,R]``,
+QoS/priority/quota/gang id vectors — so the scheduler's Filter/Score/bin-pack
+inner loop is batched vector math instead of per-node Go callbacks.
+
+Lowering runs host-side in exact integer arithmetic (Python ints ==
+reference's int64). Everything numeric here is *canonical units*
+(cpu=millicores, memory=MiB; apis/extension.py).
+
+Reference semantics implemented here:
+- pod usage estimator: pkg/scheduler/plugins/loadaware/estimator/
+  default_estimator.go:57-110 (estimatedUsedByResource)
+- assigned-pod estimation staleness rules: pkg/scheduler/plugins/loadaware/
+  load_aware.go:337-376 (estimatedAssignedPodUsed)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import (
+    NUM_RESOURCES,
+    PriorityClass,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    resources_to_vector,
+)
+
+# Defaults matching the reference scheduler config
+# (pkg/scheduler/apis/config/v1beta2/defaults.go:33-48).
+DEFAULT_NODE_METRIC_EXPIRATION_SECONDS = 180.0
+DEFAULT_RESOURCE_WEIGHTS = {ResourceName.CPU: 1, ResourceName.MEMORY: 1}
+DEFAULT_USAGE_THRESHOLDS = {ResourceName.CPU: 65, ResourceName.MEMORY: 95}
+DEFAULT_ESTIMATED_SCALING_FACTORS = {ResourceName.CPU: 85, ResourceName.MEMORY: 70}
+# estimator zero-request defaults (default_estimator.go:36-39), canonical units
+DEFAULT_MILLI_CPU_REQUEST = 250
+DEFAULT_MEMORY_REQUEST_MIB = 200  # 200 * 1024 * 1024 bytes == 200 MiB
+
+
+def go_round(x: float) -> int:
+    """``math.Round`` semantics (half away from zero) for non-negative x."""
+    return int(math.floor(x + 0.5))
+
+
+def translate_resource_by_priority(
+    resource: ResourceName, priority_class: PriorityClass
+) -> ResourceName:
+    """Map a native resource to the extended resource a pod of the given
+    priority class actually requests (reference: apis/extension/resource.go
+    TranslateResourceNameByPriorityClass)."""
+    if priority_class == PriorityClass.BATCH:
+        if resource == ResourceName.CPU:
+            return ResourceName.BATCH_CPU
+        if resource == ResourceName.MEMORY:
+            return ResourceName.BATCH_MEMORY
+    elif priority_class == PriorityClass.MID:
+        if resource == ResourceName.CPU:
+            return ResourceName.MID_CPU
+        if resource == ResourceName.MEMORY:
+            return ResourceName.MID_MEMORY
+    return resource
+
+
+def estimate_pod_used(
+    pod: PodSpec,
+    scaling_factors: Optional[Mapping[ResourceName, int]] = None,
+    resource_weights: Optional[Mapping[ResourceName, int]] = None,
+) -> Dict[ResourceName, int]:
+    """Estimated usage of a pod, bit-exact with the reference estimator.
+
+    Reference: default_estimator.go:63-110. For each weighted resource:
+    use limit if limit > request (scaling factor forced to 100) else the
+    request; zero quantity falls back to 250 mCPU / 200 MiB; the estimate is
+    ``round(quantity * factor / 100)`` capped at the limit. Batch/Mid pods
+    read their translated extended-resource quantities.
+    """
+    scaling_factors = scaling_factors or DEFAULT_ESTIMATED_SCALING_FACTORS
+    resource_weights = resource_weights or DEFAULT_RESOURCE_WEIGHTS
+    out: Dict[ResourceName, int] = {}
+    for resource in resource_weights:
+        real = translate_resource_by_priority(resource, pod.priority_class)
+        req = int(pod.requests.get(real, 0))
+        lim = int(pod.limits.get(real, 0))
+        factor = int(scaling_factors.get(resource, 100))
+        if lim > req:
+            factor, quantity = 100, lim
+        else:
+            quantity = req
+        if quantity == 0:
+            if real in (ResourceName.CPU, ResourceName.BATCH_CPU, ResourceName.MID_CPU):
+                out[resource] = DEFAULT_MILLI_CPU_REQUEST
+            elif real in (
+                ResourceName.MEMORY,
+                ResourceName.BATCH_MEMORY,
+                ResourceName.MID_MEMORY,
+            ):
+                out[resource] = DEFAULT_MEMORY_REQUEST_MIB
+            else:
+                out[resource] = 0
+            continue
+        estimated = go_round(quantity * factor / 100)
+        if lim > 0 and estimated > lim:
+            estimated = lim
+        out[resource] = estimated
+    return out
+
+
+@dataclasses.dataclass
+class NodeArrays:
+    """Dense node-side state, host (numpy) resident until staged.
+
+    All ``[N, R]`` arrays are int32 canonical units; masks are bool ``[N]``.
+    """
+
+    names: List[str]
+    alloc: np.ndarray          # [N,R] allocatable
+    used_req: np.ndarray       # [N,R] sum of assigned pod *requests* (Fit path)
+    usage: np.ndarray          # [N,R] reported real usage (NodeMetric)
+    prod_usage: np.ndarray     # [N,R] Σ reported usage of assigned prod pods
+    est_extra: np.ndarray      # [N,R] assigned-pod estimation correction (see below)
+    prod_base: np.ndarray      # [N,R] prod-mode score base (see lower_nodes)
+    metric_fresh: np.ndarray   # [N] bool: NodeMetric exists and not expired
+    schedulable: np.ndarray    # [N] bool
+
+    @property
+    def n(self) -> int:
+        return len(self.names)
+
+    def index(self) -> Dict[str, int]:
+        return {name: i for i, name in enumerate(self.names)}
+
+
+@dataclasses.dataclass
+class PendingPodArrays:
+    """Dense pending-pod state in schedule order (priority desc, FIFO)."""
+
+    uids: List[str]
+    req: np.ndarray        # [P,R] requests
+    est: np.ndarray        # [P,R] estimator output (loadaware score path)
+    qos: np.ndarray        # [P] int8 QoSClass
+    prio_class: np.ndarray  # [P] int8 PriorityClass
+    priority: np.ndarray   # [P] int32 numeric priority
+    is_prod: np.ndarray    # [P] bool
+    is_daemonset: np.ndarray  # [P] bool
+    quota_id: np.ndarray   # [P] int32, -1 if none
+    gang_id: np.ndarray    # [P] int32, -1 if none
+
+    @property
+    def p(self) -> int:
+        return len(self.uids)
+
+
+def _clip_i32(a: np.ndarray) -> np.ndarray:
+    info = np.iinfo(np.int32)
+    return np.clip(a, info.min, info.max).astype(np.int32)
+
+
+def lower_nodes(
+    snapshot: ClusterSnapshot,
+    *,
+    metric_expiration_seconds: float = DEFAULT_NODE_METRIC_EXPIRATION_SECONDS,
+    scaling_factors: Optional[Mapping[ResourceName, int]] = None,
+    resource_weights: Optional[Mapping[ResourceName, int]] = None,
+) -> NodeArrays:
+    """Lower nodes + assigned pods + metrics to ``NodeArrays``.
+
+    ``est_extra`` encodes the loadaware assigned-pod estimation correction
+    (load_aware.go:299-327): for each node it is
+    ``Σ_p max(estimate(p), reported(p))  −  min(Σ_p reported(p), node_usage)``
+    over assigned pods p that *should be estimated* — a pod should be
+    estimated iff it has no reported usage, its assign time missed the
+    latest metric update, or it is still within the report interval.
+    The subtraction mirrors the reference's guard: the estimated pods'
+    actual usage is only subtracted from node usage when node usage covers
+    it (per resource). Non-prod score estimated-used is then
+    ``usage + est_extra + estimate(incoming_pod)``.
+
+    Prod mode (ScoreAccordingProdUsage; load_aware.go:294-307 prodPod
+    branch) never reads whole-node usage: its base is computed from prod
+    pods only, with no node-usage subtraction guard —
+    ``prod_base = Σ_{prod, estimated} max(estimate, reported)
+               + Σ_{prod, not estimated, reported} reported``
+    so prod score estimated-used is ``prod_base + estimate(incoming)``.
+
+    ``prod_usage`` is the prod Filter path's base (load_aware.go:226-255
+    filterProdUsage): Σ reported usage over assigned prod pods.
+    """
+    n = len(snapshot.nodes)
+    names = [node.name for node in snapshot.nodes]
+    index = {name: i for i, name in enumerate(names)}
+    alloc = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    used_req = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    usage = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    prod_usage = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    est_extra = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    prod_base = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    metric_fresh = np.zeros(n, dtype=bool)
+    schedulable = np.ones(n, dtype=bool)
+
+    for i, node in enumerate(snapshot.nodes):
+        alloc[i] = resources_to_vector(node.allocatable)
+        schedulable[i] = not node.unschedulable
+
+    # assigned pod requests per node
+    assigned_by_node: Dict[str, List[PodSpec]] = {}
+    for pod in snapshot.pods:
+        if pod.node_name is None or pod.node_name not in index:
+            continue
+        used_req[index[pod.node_name]] += resources_to_vector(pod.requests)
+        assigned_by_node.setdefault(pod.node_name, []).append(pod)
+
+    # metrics + estimation correction
+    for name, metric in snapshot.node_metrics.items():
+        if name not in index:
+            continue
+        i = index[name]
+        usage[i] = resources_to_vector(metric.node_usage)
+        metric_fresh[i] = (
+            snapshot.now - metric.update_time
+        ) < metric_expiration_seconds
+        est_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
+        reported_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
+        for pod in assigned_by_node.get(name, ()):
+            is_prod = pod.priority_class == PriorityClass.PROD
+            reported = metric.pod_usages.get(pod.uid)
+            rep_vec = resources_to_vector(reported) if reported else None
+            if is_prod and rep_vec is not None:
+                prod_usage[i] += rep_vec  # prod Filter base
+            should_estimate = (
+                not reported
+                or pod.assign_time >= metric.update_time
+                or (metric.update_time - pod.assign_time) < metric.report_interval
+            )
+            if not should_estimate:
+                # prod score base: non-estimated prod pods contribute their
+                # reported usage (sumPodUsages' podUsages term)
+                if is_prod and rep_vec is not None:
+                    prod_base[i] += rep_vec
+                continue
+            est_vec = resources_to_vector(
+                estimate_pod_used(pod, scaling_factors, resource_weights)
+            )
+            if rep_vec is not None:
+                est_vec = np.maximum(est_vec, rep_vec)
+                reported_sum += rep_vec
+            est_sum += est_vec
+            if is_prod:
+                prod_base[i] += est_vec
+        # subtract reported usage of estimated pods only where node usage
+        # covers it (load_aware.go:318-323 quantity.Cmp(q) >= 0 guard)
+        sub = np.where(usage[i] >= reported_sum, reported_sum, 0)
+        est_extra[i] = est_sum - sub
+
+    return NodeArrays(
+        names=names,
+        alloc=_clip_i32(alloc),
+        used_req=_clip_i32(used_req),
+        usage=_clip_i32(usage),
+        prod_usage=_clip_i32(prod_usage),
+        est_extra=_clip_i32(est_extra),
+        prod_base=_clip_i32(prod_base),
+        metric_fresh=metric_fresh,
+        schedulable=schedulable,
+    )
+
+
+def schedule_order(pods: Sequence[PodSpec]) -> List[int]:
+    """Order pending pods the way the scheduler queue would: numeric
+    priority descending, then sub-priority descending, then FIFO."""
+    return sorted(
+        range(len(pods)),
+        key=lambda i: (-pods[i].priority, -pods[i].sub_priority, i),
+    )
+
+
+def lower_pending_pods(
+    pods: Sequence[PodSpec],
+    *,
+    quota_index: Optional[Mapping[str, int]] = None,
+    gang_index: Optional[Mapping[str, int]] = None,
+    scaling_factors: Optional[Mapping[ResourceName, int]] = None,
+    resource_weights: Optional[Mapping[ResourceName, int]] = None,
+    in_schedule_order: bool = True,
+) -> PendingPodArrays:
+    """Lower pending pods to ``PendingPodArrays`` (schedule order by default)."""
+    order = schedule_order(pods) if in_schedule_order else list(range(len(pods)))
+    pods = [pods[i] for i in order]
+    p = len(pods)
+    req = np.zeros((p, NUM_RESOURCES), dtype=np.int64)
+    est = np.zeros((p, NUM_RESOURCES), dtype=np.int64)
+    qos = np.zeros(p, dtype=np.int8)
+    prio_class = np.zeros(p, dtype=np.int8)
+    priority = np.zeros(p, dtype=np.int32)
+    is_prod = np.zeros(p, dtype=bool)
+    is_daemonset = np.zeros(p, dtype=bool)
+    quota_id = np.full(p, -1, dtype=np.int32)
+    gang_id = np.full(p, -1, dtype=np.int32)
+    for i, pod in enumerate(pods):
+        req[i] = resources_to_vector(pod.requests)
+        est[i] = resources_to_vector(
+            estimate_pod_used(pod, scaling_factors, resource_weights)
+        )
+        qos[i] = int(pod.qos)
+        prio_class[i] = int(pod.priority_class)
+        priority[i] = pod.priority
+        is_prod[i] = pod.priority_class == PriorityClass.PROD
+        is_daemonset[i] = pod.is_daemonset
+        if quota_index and pod.quota is not None:
+            quota_id[i] = quota_index.get(pod.quota, -1)
+        if gang_index and pod.gang is not None:
+            gang_id[i] = gang_index.get(pod.gang, -1)
+    return PendingPodArrays(
+        uids=[pod.uid for pod in pods],
+        req=_clip_i32(req),
+        est=_clip_i32(est),
+        qos=qos,
+        prio_class=prio_class,
+        priority=priority,
+        is_prod=is_prod,
+        is_daemonset=is_daemonset,
+        quota_id=quota_id,
+        gang_id=gang_id,
+    )

@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+All sharding/multi-chip tests run on virtual CPU devices; the driver's
+dryrun validates the same path. Must run before anything imports jax.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# The ambient environment may preset JAX_PLATFORMS (e.g. a TPU tunnel);
+# tests always run on the virtual CPU mesh, so force-override it. A
+# site-level PJRT plugin may additionally have force-updated the
+# jax_platforms config at interpreter start — undo that too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

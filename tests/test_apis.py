@@ -1,0 +1,126 @@
+"""Protocol-layer tests: QoS/priority parsing, resource vectors, estimator."""
+
+from koordinator_tpu.apis.extension import (
+    NUM_RESOURCES,
+    PriorityClass,
+    QoSClass,
+    ResourceName,
+    priority_class_of,
+    qos_class_of,
+)
+from koordinator_tpu.apis.types import (
+    PodSpec,
+    resources_to_vector,
+    vector_to_resources,
+)
+from koordinator_tpu.state.cluster import (
+    DEFAULT_MEMORY_REQUEST_MIB,
+    DEFAULT_MILLI_CPU_REQUEST,
+    estimate_pod_used,
+    translate_resource_by_priority,
+)
+
+
+def test_qos_parsing():
+    # reference: apis/extension/qos.go:31-40
+    assert qos_class_of("LSE") == QoSClass.LSE
+    assert qos_class_of("LSR") == QoSClass.LSR
+    assert qos_class_of("LS") == QoSClass.LS
+    assert qos_class_of("BE") == QoSClass.BE
+    assert qos_class_of("SYSTEM") == QoSClass.SYSTEM
+    assert qos_class_of("bogus") == QoSClass.NONE
+    assert qos_class_of(None) == QoSClass.NONE
+    assert QoSClass.LS.is_latency_sensitive
+    assert not QoSClass.BE.is_latency_sensitive
+
+
+def test_priority_bands():
+    # reference: apis/extension/priority.go:37-49,84-101
+    assert priority_class_of(value=9500) == PriorityClass.PROD
+    assert priority_class_of(value=9000) == PriorityClass.PROD
+    assert priority_class_of(value=9999) == PriorityClass.PROD
+    assert priority_class_of(value=7500) == PriorityClass.MID
+    assert priority_class_of(value=5999) == PriorityClass.BATCH
+    assert priority_class_of(value=3000) == PriorityClass.FREE
+    assert priority_class_of(value=8500) == PriorityClass.NONE
+    assert priority_class_of(value=0) == PriorityClass.NONE
+    assert priority_class_of(name="koord-batch") == PriorityClass.BATCH
+    # label takes precedence over numeric value
+    assert priority_class_of(name="koord-mid", value=9500) == PriorityClass.MID
+
+
+def test_resource_vector_roundtrip():
+    res = {ResourceName.CPU: 4000, ResourceName.MEMORY: 8192}
+    vec = resources_to_vector(res)
+    assert vec.shape == (NUM_RESOURCES,)
+    assert vec[ResourceName.CPU] == 4000
+    assert vector_to_resources(vec) == res
+
+
+def test_translate_resource_by_priority():
+    assert (
+        translate_resource_by_priority(ResourceName.CPU, PriorityClass.BATCH)
+        == ResourceName.BATCH_CPU
+    )
+    assert (
+        translate_resource_by_priority(ResourceName.MEMORY, PriorityClass.MID)
+        == ResourceName.MID_MEMORY
+    )
+    assert (
+        translate_resource_by_priority(ResourceName.CPU, PriorityClass.PROD)
+        == ResourceName.CPU
+    )
+
+
+def test_estimator_request_scaling():
+    # request 1000m cpu, 1024 MiB; defaults scale cpu 85%, mem 70%
+    # (default_estimator.go:57-110; defaults.go:45-48)
+    pod = PodSpec(
+        name="a",
+        requests={ResourceName.CPU: 1000, ResourceName.MEMORY: 1024},
+        priority=9500,
+    )
+    est = estimate_pod_used(pod)
+    assert est[ResourceName.CPU] == 850       # round(1000*85/100)
+    assert est[ResourceName.MEMORY] == 717    # round(1024*70/100) = 716.8 -> 717
+
+
+def test_estimator_limit_overrides_scaling():
+    # limit > request forces factor 100 and uses the limit
+    pod = PodSpec(
+        name="a",
+        requests={ResourceName.CPU: 1000},
+        limits={ResourceName.CPU: 2000},
+    )
+    est = estimate_pod_used(pod)
+    assert est[ResourceName.CPU] == 2000
+
+
+def test_estimator_zero_request_defaults():
+    pod = PodSpec(name="a")
+    est = estimate_pod_used(pod)
+    assert est[ResourceName.CPU] == DEFAULT_MILLI_CPU_REQUEST
+    assert est[ResourceName.MEMORY] == DEFAULT_MEMORY_REQUEST_MIB
+
+
+def test_estimator_batch_pod_reads_batch_columns():
+    pod = PodSpec(
+        name="b",
+        requests={ResourceName.BATCH_CPU: 2000, ResourceName.BATCH_MEMORY: 2048},
+        priority=5500,  # koord-batch band
+    )
+    est = estimate_pod_used(pod)
+    assert est[ResourceName.CPU] == 1700      # round(2000*85/100)
+    assert est[ResourceName.MEMORY] == 1434   # round(2048*70/100) = 1433.6
+
+
+def test_estimator_cap_at_limit():
+    # estimate would round above the limit -> capped
+    pod = PodSpec(
+        name="a",
+        requests={ResourceName.CPU: 100},
+        limits={ResourceName.CPU: 84},  # limit < request: use request, factor 85
+    )
+    est = estimate_pod_used(pod)
+    # round(100*85/100)=85 capped at limit 84
+    assert est[ResourceName.CPU] == 84

@@ -1,0 +1,144 @@
+"""Differential tests: batched scan solver == sequential oracle scheduler,
+including BASELINE config #1 scale (100 pods / 20 nodes)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.ops.binpack import (
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    schedule_batch,
+)
+from koordinator_tpu.oracle.placement import schedule_sequential
+
+RNG = np.random.default_rng(42)
+
+
+def _weights():
+    w = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    w[ResourceName.CPU] = 1
+    w[ResourceName.MEMORY] = 1
+    return w
+
+
+def _thresholds():
+    t = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    t[ResourceName.CPU] = 65
+    t[ResourceName.MEMORY] = 95
+    return t
+
+
+def _cluster(n, fresh_frac=0.9):
+    alloc = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    alloc[:, ResourceName.CPU] = RNG.choice([16000, 32000, 64000, 96000], n)
+    alloc[:, ResourceName.MEMORY] = RNG.choice([32768, 65536, 131072, 262144], n)
+    used = (alloc * RNG.uniform(0, 0.6, (n, NUM_RESOURCES))).astype(np.int64)
+    usage = (alloc * RNG.uniform(0, 0.7, (n, NUM_RESOURCES))).astype(np.int64)
+    prod = (usage * RNG.uniform(0, 1.0, (n, NUM_RESOURCES))).astype(np.int64)
+    extra = RNG.integers(0, 2000, (n, NUM_RESOURCES)).astype(np.int64)
+    prod_base = (prod * RNG.uniform(0, 1.2, (n, NUM_RESOURCES))).astype(np.int64)
+    fresh = RNG.uniform(size=n) < fresh_frac
+    sched = RNG.uniform(size=n) < 0.95
+    return alloc, used, usage, prod, extra, prod_base, fresh, sched
+
+
+def _pods(p):
+    req = np.zeros((p, NUM_RESOURCES), dtype=np.int64)
+    req[:, ResourceName.CPU] = RNG.choice([500, 1000, 2000, 4000], p)
+    req[:, ResourceName.MEMORY] = RNG.choice([1024, 2048, 4096, 8192], p)
+    est = np.zeros_like(req)
+    est[:, ResourceName.CPU] = np.floor(req[:, ResourceName.CPU] * 0.85 + 0.5)
+    est[:, ResourceName.MEMORY] = np.floor(req[:, ResourceName.MEMORY] * 0.70 + 0.5)
+    is_prod = RNG.uniform(size=p) < 0.5
+    is_ds = RNG.uniform(size=p) < 0.05
+    return req, est, is_prod, is_ds
+
+
+def _run_both(n, p, config=SolverConfig()):
+    alloc, used, usage, prod, extra, prod_base, fresh, sched = _cluster(n)
+    req, est, is_prod, is_ds = _pods(p)
+    w, thr = _weights(), _thresholds()
+    prod_thr = np.zeros_like(thr)
+
+    state = NodeState(
+        alloc=jnp.asarray(alloc, jnp.int32),
+        used_req=jnp.asarray(used, jnp.int32),
+        usage=jnp.asarray(usage, jnp.int32),
+        prod_usage=jnp.asarray(prod, jnp.int32),
+        est_extra=jnp.asarray(extra, jnp.int32),
+        prod_base=jnp.asarray(prod_base, jnp.int32),
+        metric_fresh=jnp.asarray(fresh),
+        schedulable=jnp.asarray(sched),
+    )
+    pods = PodBatch(
+        req=jnp.asarray(req, jnp.int32),
+        est=jnp.asarray(est, jnp.int32),
+        is_prod=jnp.asarray(is_prod),
+        is_daemonset=jnp.asarray(is_ds),
+    )
+    params = ScoreParams(
+        weights=jnp.asarray(w, jnp.int32),
+        thresholds=jnp.asarray(thr, jnp.int32),
+        prod_thresholds=jnp.asarray(prod_thr, jnp.int32),
+    )
+    _, got = schedule_batch(state, pods, params, config)
+    want = schedule_sequential(
+        alloc, used, usage, prod, extra, prod_base, fresh, sched,
+        req, est, is_prod, is_ds, w, thr, prod_thr,
+        fit_weight=config.fit_weight,
+        loadaware_weight=config.loadaware_weight,
+        score_according_prod=config.score_according_prod,
+    )
+    return np.asarray(got), np.array(want)
+
+
+def test_batched_solver_matches_sequential_oracle_small():
+    got, want = _run_both(7, 23)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batched_solver_matches_sequential_oracle_config1():
+    # BASELINE config #1: 100 pending pods, 20 nodes
+    got, want = _run_both(20, 100)
+    np.testing.assert_array_equal(got, want)
+    assert (got >= 0).sum() > 0
+
+
+def test_batched_solver_prod_scoring_mode():
+    got, want = _run_both(11, 31, SolverConfig(score_according_prod=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_unschedulable_when_no_capacity():
+    # single tiny node, pod too big
+    alloc = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+    alloc[0, ResourceName.CPU] = 1000
+    alloc[0, ResourceName.MEMORY] = 1024
+    state = NodeState(
+        alloc=jnp.asarray(alloc, jnp.int32),
+        used_req=jnp.zeros((1, NUM_RESOURCES), jnp.int32),
+        usage=jnp.zeros((1, NUM_RESOURCES), jnp.int32),
+        prod_usage=jnp.zeros((1, NUM_RESOURCES), jnp.int32),
+        est_extra=jnp.zeros((1, NUM_RESOURCES), jnp.int32),
+        prod_base=jnp.zeros((1, NUM_RESOURCES), jnp.int32),
+        metric_fresh=jnp.asarray(np.array([True])),
+        schedulable=jnp.asarray(np.array([True])),
+    )
+    req = np.zeros((2, NUM_RESOURCES), dtype=np.int64)
+    req[:, ResourceName.CPU] = 800  # first fits, second doesn't
+    pods = PodBatch(
+        req=jnp.asarray(req, jnp.int32),
+        est=jnp.asarray(req, jnp.int32),
+        is_prod=jnp.zeros(2, bool),
+        is_daemonset=jnp.zeros(2, bool),
+    )
+    params = ScoreParams(
+        weights=jnp.asarray(_weights(), jnp.int32),
+        thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+    _, got = schedule_batch(state, pods, params)
+    assert got[0] == 0 and got[1] == -1

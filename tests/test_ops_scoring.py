@@ -1,0 +1,240 @@
+"""Golden tests: JAX batched ops == reference-semantics oracle, bit-for-bit.
+
+Randomized over realistic canonical-unit ranges plus adversarial boundary
+cases (exact-threshold percentages, zero allocatable, req > capacity).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.ops.common import percent_rounded as jax_percent
+from koordinator_tpu.ops.fit import fit_filter, least_allocated_score
+from koordinator_tpu.ops.loadaware import loadaware_filter, loadaware_score
+from koordinator_tpu.oracle import scheduler as oracle
+
+RNG = np.random.default_rng(0)
+
+
+def _rand_nodes(n):
+    alloc = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    alloc[:, ResourceName.CPU] = RNG.integers(1000, 128_000, n)       # 1..128 cores
+    alloc[:, ResourceName.MEMORY] = RNG.integers(1024, 1_048_576, n)  # 1GiB..1TiB
+    used = (alloc * RNG.uniform(0, 1.2, (n, NUM_RESOURCES))).astype(np.int64)
+    return alloc, used
+
+
+def test_percent_rounded_matches_oracle():
+    # randomized check of the device formula against the exact-rational oracle
+    used = RNG.integers(0, 10_000_000, 20_000)
+    total = RNG.integers(1, 10_000_000, 20_000)
+    got = np.asarray(jax_percent(jnp.asarray(used, jnp.int32), jnp.asarray(total, jnp.int32)))
+    want = np.array([oracle.percent_rounded(int(u), int(t)) for u, t in zip(used, total)])
+    np.testing.assert_array_equal(got, want)
+    # boundary: exactly .5 rounds away from zero
+    assert int(jax_percent(jnp.int32(1), jnp.int32(200))) == 1  # 0.5 -> 1
+    assert int(jax_percent(jnp.int32(3), jnp.int32(200))) == 2  # 1.5 -> 2
+    assert int(jax_percent(jnp.int32(0), jnp.int32(0))) == 0
+
+
+def test_percent_rounded_documented_float64_deviation():
+    # The reference computes the percentage through float64, which rounds
+    # the exact boundary 23/40 = 57.5% *down* (57.4999999999999993). This
+    # framework defines the exact rational semantics (57.5 -> 58) — a
+    # deliberate, documented deviation; everywhere off the .5 boundary the
+    # two agree.
+    assert oracle.percent_rounded(23, 40) == 58
+    assert oracle.percent_rounded_go_float64(23, 40) == 57
+    assert int(jax_percent(jnp.int32(23), jnp.int32(40))) == 58
+    mismatches = [
+        (u, t)
+        for u in range(0, 400)
+        for t in range(1, 400)
+        if oracle.percent_rounded(u, t) != oracle.percent_rounded_go_float64(u, t)
+    ]
+    # divergence only on exact .5 boundaries (a measure-zero input set)
+    for u, t in mismatches:
+        assert (200 * u) % (2 * t) == t  # exact half
+    assert len(mismatches) < 0.001 * 400 * 400
+
+
+def test_fit_filter_matches_oracle():
+    n = 257
+    alloc, used = _rand_nodes(n)
+    req = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    req[ResourceName.CPU] = 4000
+    req[ResourceName.MEMORY] = 8192
+    got = np.asarray(
+        fit_filter(jnp.asarray(req, jnp.int32), jnp.asarray(alloc, jnp.int32), jnp.asarray(used, jnp.int32))
+    )
+    want = np.array([oracle.fit_filter_node(req, alloc[i], used[i]) for i in range(n)])
+    np.testing.assert_array_equal(got, want)
+    assert got.any() and not got.all()  # exercise both branches
+
+
+def test_fit_filter_zero_request_passes_overcommitted_dim():
+    alloc = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+    used = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+    alloc[0, ResourceName.CPU] = 1000
+    # GPU dimension overcommitted but pod doesn't request it
+    used[0, ResourceName.GPU] = 500
+    req = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    req[ResourceName.CPU] = 500
+    assert bool(
+        fit_filter(
+            jnp.asarray(req, jnp.int32),
+            jnp.asarray(alloc, jnp.int32),
+            jnp.asarray(used, jnp.int32),
+        )[0]
+    )
+
+
+def test_least_allocated_matches_oracle():
+    n = 311
+    alloc, used = _rand_nodes(n)
+    weights = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    weights[ResourceName.CPU] = 1
+    weights[ResourceName.MEMORY] = 1
+    req = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    req[ResourceName.CPU] = 2500
+    req[ResourceName.MEMORY] = 4096
+    got = np.asarray(
+        least_allocated_score(
+            jnp.asarray(req, jnp.int32),
+            jnp.asarray(alloc, jnp.int32),
+            jnp.asarray(used, jnp.int32),
+            jnp.asarray(weights, jnp.int32),
+        )
+    )
+    want = np.array(
+        [oracle.least_allocated_score_node(req, alloc[i], used[i], weights) for i in range(n)]
+    )
+    np.testing.assert_array_equal(got, want)
+    assert (got > 0).any()
+
+
+def _thresholds():
+    thr = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    thr[ResourceName.CPU] = 65
+    thr[ResourceName.MEMORY] = 95
+    return thr
+
+
+def test_loadaware_filter_matches_oracle():
+    n = 409
+    alloc, _ = _rand_nodes(n)
+    usage = (alloc * RNG.uniform(0, 1.1, (n, NUM_RESOURCES))).astype(np.int64)
+    prod_usage = (usage * RNG.uniform(0, 1.0, (n, NUM_RESOURCES))).astype(np.int64)
+    fresh = RNG.uniform(size=n) < 0.8
+    thr = _thresholds()
+    for prod_thr_on in (False, True):
+        prod_thr = thr // 2 if prod_thr_on else np.zeros_like(thr)
+        for is_prod in (False, True):
+            for is_ds in (False, True):
+                got = np.asarray(
+                    loadaware_filter(
+                        jnp.asarray(alloc, jnp.int32),
+                        jnp.asarray(usage, jnp.int32),
+                        jnp.asarray(prod_usage, jnp.int32),
+                        jnp.asarray(fresh),
+                        jnp.asarray(thr, jnp.int32),
+                        jnp.asarray(prod_thr, jnp.int32),
+                        jnp.asarray(is_ds),
+                        jnp.asarray(is_prod),
+                    )
+                )
+                want = np.array(
+                    [
+                        oracle.loadaware_filter_node(
+                            alloc[i], usage[i], prod_usage[i], bool(fresh[i]),
+                            thr, prod_thr, is_ds, is_prod,
+                        )
+                        for i in range(n)
+                    ]
+                )
+                np.testing.assert_array_equal(got, want)
+
+
+def test_loadaware_filter_exact_threshold_unschedulable():
+    # usage exactly at threshold => unschedulable (>= comparison)
+    alloc = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+    usage = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+    alloc[0, ResourceName.CPU] = 1000
+    usage[0, ResourceName.CPU] = 650  # exactly 65%
+    thr = _thresholds()
+    mask = loadaware_filter(
+        jnp.asarray(alloc, jnp.int32),
+        jnp.asarray(usage, jnp.int32),
+        jnp.asarray(np.zeros_like(usage), jnp.int32),
+        jnp.asarray(np.array([True])),
+        jnp.asarray(thr, jnp.int32),
+        jnp.asarray(np.zeros_like(thr), jnp.int32),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+    assert not bool(mask[0])
+    # 64.5% rounds to 64 (wait: 645/1000 = 64.5 -> rounds away to 65 -> blocked)
+    usage[0, ResourceName.CPU] = 645
+    mask = loadaware_filter(
+        jnp.asarray(alloc, jnp.int32),
+        jnp.asarray(usage, jnp.int32),
+        jnp.asarray(np.zeros_like(usage), jnp.int32),
+        jnp.asarray(np.array([True])),
+        jnp.asarray(thr, jnp.int32),
+        jnp.asarray(np.zeros_like(thr), jnp.int32),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+    assert not bool(mask[0])
+    usage[0, ResourceName.CPU] = 644  # 64.4% -> 64 < 65 -> passes
+    mask = loadaware_filter(
+        jnp.asarray(alloc, jnp.int32),
+        jnp.asarray(usage, jnp.int32),
+        jnp.asarray(np.zeros_like(usage), jnp.int32),
+        jnp.asarray(np.array([True])),
+        jnp.asarray(thr, jnp.int32),
+        jnp.asarray(np.zeros_like(thr), jnp.int32),
+        jnp.asarray(False),
+        jnp.asarray(False),
+    )
+    assert bool(mask[0])
+
+
+def test_loadaware_score_matches_oracle():
+    n = 353
+    alloc, _ = _rand_nodes(n)
+    usage = (alloc * RNG.uniform(0, 1.0, (n, NUM_RESOURCES))).astype(np.int64)
+    prod_base = (usage * RNG.uniform(0, 1.0, (n, NUM_RESOURCES))).astype(np.int64)
+    est_extra = RNG.integers(0, 4000, (n, NUM_RESOURCES))
+    fresh = RNG.uniform(size=n) < 0.8
+    weights = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    weights[ResourceName.CPU] = 1
+    weights[ResourceName.MEMORY] = 1
+    pod_est = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    pod_est[ResourceName.CPU] = 850
+    pod_est[ResourceName.MEMORY] = 717
+    for score_prod in (False, True):
+        for is_prod in (False, True):
+            got = np.asarray(
+                loadaware_score(
+                    jnp.asarray(pod_est, jnp.int32),
+                    jnp.asarray(alloc, jnp.int32),
+                    jnp.asarray(usage, jnp.int32),
+                    jnp.asarray(est_extra, jnp.int32),
+                    jnp.asarray(prod_base, jnp.int32),
+                    jnp.asarray(fresh),
+                    jnp.asarray(weights, jnp.int32),
+                    jnp.asarray(is_prod),
+                    score_according_prod=score_prod,
+                )
+            )
+            want = np.array(
+                [
+                    oracle.loadaware_score_node(
+                        pod_est, alloc[i], usage[i], est_extra[i], prod_base[i],
+                        bool(fresh[i]), weights, is_prod, score_prod,
+                    )
+                    for i in range(n)
+                ]
+            )
+            np.testing.assert_array_equal(got, want)

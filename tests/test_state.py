@@ -1,0 +1,246 @@
+"""Array-substrate lowering tests: assigned-pod estimation correction,
+schedule ordering, metric freshness."""
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import ResourceName
+from koordinator_tpu.apis.types import ClusterSnapshot, NodeMetric, NodeSpec, PodSpec
+from koordinator_tpu.state.cluster import (
+    lower_nodes,
+    lower_pending_pods,
+    schedule_order,
+)
+
+
+def _node(name, cpu=32000, mem=65536):
+    return NodeSpec(name=name, allocatable={ResourceName.CPU: cpu, ResourceName.MEMORY: mem})
+
+
+def test_lower_nodes_basic():
+    snap = ClusterSnapshot(
+        nodes=[_node("n0"), _node("n1")],
+        pods=[
+            PodSpec(name="a", requests={ResourceName.CPU: 1000}, node_name="n0"),
+            PodSpec(name="b", requests={ResourceName.CPU: 2000}, node_name="n0"),
+            PodSpec(name="c", requests={ResourceName.CPU: 500}, node_name="n1"),
+        ],
+        node_metrics={
+            "n0": NodeMetric(
+                node_name="n0",
+                node_usage={ResourceName.CPU: 10000},
+                update_time=100.0,
+            )
+        },
+        now=150.0,
+    )
+    arrays = lower_nodes(snap)
+    assert arrays.n == 2
+    assert arrays.used_req[0, ResourceName.CPU] == 3000
+    assert arrays.used_req[1, ResourceName.CPU] == 500
+    assert arrays.usage[0, ResourceName.CPU] == 10000
+    assert arrays.metric_fresh[0] and not arrays.metric_fresh[1]
+    assert arrays.schedulable.all()
+
+
+def test_metric_expiration():
+    snap = ClusterSnapshot(
+        nodes=[_node("n0")],
+        node_metrics={
+            "n0": NodeMetric(node_name="n0", update_time=0.0),
+        },
+        now=200.0,  # > 180s default expiration
+    )
+    assert not lower_nodes(snap).metric_fresh[0]
+
+
+def test_est_extra_unreported_pod_estimated():
+    # Pod assigned after the metric update (no usage reported): its full
+    # estimate enters est_extra, nothing subtracted (load_aware.go:337-376).
+    snap = ClusterSnapshot(
+        nodes=[_node("n0")],
+        pods=[
+            PodSpec(
+                name="new",
+                requests={ResourceName.CPU: 1000, ResourceName.MEMORY: 1024},
+                node_name="n0",
+                assign_time=150.0,
+            )
+        ],
+        node_metrics={
+            "n0": NodeMetric(
+                node_name="n0",
+                node_usage={ResourceName.CPU: 5000},
+                update_time=100.0,
+                report_interval=60.0,
+            )
+        },
+        now=160.0,
+    )
+    arrays = lower_nodes(snap)
+    assert arrays.est_extra[0, ResourceName.CPU] == 850    # round(1000*0.85)
+    assert arrays.est_extra[0, ResourceName.MEMORY] == 717
+
+
+def test_est_extra_reported_pod_outside_interval_not_estimated():
+    # Pod assigned well before the metric update with reported usage: not
+    # estimated at all -> est_extra == 0.
+    snap = ClusterSnapshot(
+        nodes=[_node("n0")],
+        pods=[
+            PodSpec(
+                name="old",
+                uid="default/old",
+                requests={ResourceName.CPU: 1000},
+                node_name="n0",
+                assign_time=0.0,
+            )
+        ],
+        node_metrics={
+            "n0": NodeMetric(
+                node_name="n0",
+                node_usage={ResourceName.CPU: 5000},
+                pod_usages={"default/old": {ResourceName.CPU: 700}},
+                update_time=100.0,
+                report_interval=60.0,
+            )
+        },
+        now=160.0,
+    )
+    arrays = lower_nodes(snap)
+    assert arrays.est_extra[0, ResourceName.CPU] == 0
+
+
+def test_est_extra_max_of_estimate_and_reported_minus_covered():
+    # Pod still within the report interval with reported usage: estimated
+    # value is max(estimate, reported); its reported usage is subtracted
+    # from node usage since node usage covers it.
+    snap = ClusterSnapshot(
+        nodes=[_node("n0")],
+        pods=[
+            PodSpec(
+                name="warm",
+                uid="default/warm",
+                requests={ResourceName.CPU: 1000},
+                node_name="n0",
+                assign_time=90.0,  # update_time-assign < report_interval
+            )
+        ],
+        node_metrics={
+            "n0": NodeMetric(
+                node_name="n0",
+                node_usage={ResourceName.CPU: 5000},
+                pod_usages={"default/warm": {ResourceName.CPU: 900}},
+                update_time=100.0,
+                report_interval=60.0,
+            )
+        },
+        now=160.0,
+    )
+    arrays = lower_nodes(snap)
+    # max(850, 900) - 900 = 0 ... estimate 850 < reported 900 -> use 900,
+    # subtract the 900 reported (covered by node usage 5000) -> extra 0
+    assert arrays.est_extra[0, ResourceName.CPU] == 0
+
+    # bump the request so the estimate dominates: max(1700,900)-900 = 800
+    snap.pods[0].requests[ResourceName.CPU] = 2000
+    arrays = lower_nodes(snap)
+    assert arrays.est_extra[0, ResourceName.CPU] == 800
+
+
+def test_est_extra_subtract_guard_when_usage_does_not_cover():
+    # Node usage below the estimated pods' reported sum: no subtraction
+    # (reference guard quantity.Cmp(q) >= 0, load_aware.go:318-323).
+    snap = ClusterSnapshot(
+        nodes=[_node("n0")],
+        pods=[
+            PodSpec(
+                name="warm",
+                uid="default/warm",
+                requests={ResourceName.CPU: 1000},
+                node_name="n0",
+                assign_time=90.0,
+            )
+        ],
+        node_metrics={
+            "n0": NodeMetric(
+                node_name="n0",
+                node_usage={ResourceName.CPU: 500},  # < reported 900
+                pod_usages={"default/warm": {ResourceName.CPU: 900}},
+                update_time=100.0,
+                report_interval=60.0,
+            )
+        },
+        now=160.0,
+    )
+    arrays = lower_nodes(snap)
+    assert arrays.est_extra[0, ResourceName.CPU] == 900  # max(850,900), no sub
+
+
+def test_prod_arrays_lowering():
+    # Two assigned pods: one prod (reported, not estimated), one batch
+    # (estimated). prod_usage (filter base) and prod_base (score base) must
+    # only see the prod pod; est_extra sees both classes.
+    snap = ClusterSnapshot(
+        nodes=[_node("n0")],
+        pods=[
+            PodSpec(
+                name="prod-old",
+                uid="default/prod-old",
+                requests={ResourceName.CPU: 1000},
+                priority=9500,
+                node_name="n0",
+                assign_time=0.0,  # outside report interval -> not estimated
+            ),
+            PodSpec(
+                name="be-new",
+                requests={ResourceName.CPU: 2000},
+                priority=5500,
+                node_name="n0",
+                assign_time=150.0,  # after metric update -> estimated
+            ),
+        ],
+        node_metrics={
+            "n0": NodeMetric(
+                node_name="n0",
+                node_usage={ResourceName.CPU: 5000},
+                pod_usages={"default/prod-old": {ResourceName.CPU: 700}},
+                update_time=100.0,
+                report_interval=60.0,
+            )
+        },
+        now=160.0,
+    )
+    arrays = lower_nodes(snap)
+    # filter base: reported usage of the prod pod
+    assert arrays.prod_usage[0, ResourceName.CPU] == 700
+    # score base: non-estimated prod pod contributes reported usage only
+    assert arrays.prod_base[0, ResourceName.CPU] == 700
+    # non-prod correction: only the estimated BE pod (cpu est = 0, since a
+    # batch-priority pod requesting plain CPU reads the BATCH_CPU column ->
+    # zero quantity -> falls to the 250m default)
+    assert arrays.est_extra[0, ResourceName.CPU] == 250
+
+
+def test_schedule_order_priority_then_fifo():
+    pods = [
+        PodSpec(name="low", priority=3000),
+        PodSpec(name="hi", priority=9500),
+        PodSpec(name="hi2", priority=9500),
+        PodSpec(name="mid", priority=7000),
+    ]
+    order = schedule_order(pods)
+    assert [pods[i].name for i in order] == ["hi", "hi2", "mid", "low"]
+
+
+def test_lower_pending_pods():
+    pods = [
+        PodSpec(name="b", priority=5500, requests={ResourceName.BATCH_CPU: 2000}),
+        PodSpec(name="p", priority=9500, requests={ResourceName.CPU: 1000}, gang="g1"),
+    ]
+    arrays = lower_pending_pods(pods, gang_index={"g1": 0})
+    # schedule order puts the prod pod first
+    assert arrays.uids[0] == "default/p"
+    assert arrays.is_prod[0] and not arrays.is_prod[1]
+    assert arrays.gang_id[0] == 0 and arrays.gang_id[1] == -1
+    assert arrays.req[1, ResourceName.BATCH_CPU] == 2000
+    assert arrays.est[1, ResourceName.CPU] == 1700  # translated batch estimate
