@@ -92,11 +92,13 @@ class PlacementModel:
 
     @staticmethod
     def stage_pods(arrays: PendingPodArrays) -> PodBatch:
-        return PodBatch(
+        return PodBatch.build(
             req=jnp.asarray(arrays.req),
             est=jnp.asarray(arrays.est),
             is_prod=jnp.asarray(arrays.is_prod),
             is_daemonset=jnp.asarray(arrays.is_daemonset),
+            quota_id=jnp.asarray(arrays.quota_id),
+            non_preemptible=jnp.asarray(arrays.non_preemptible),
         )
 
     # -- solve --------------------------------------------------------------

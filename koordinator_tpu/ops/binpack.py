@@ -64,6 +64,26 @@ class PodBatch(NamedTuple):
     est: jnp.ndarray           # [P,R]
     is_prod: jnp.ndarray       # [P]
     is_daemonset: jnp.ndarray  # [P]
+    quota_id: jnp.ndarray      # [P] int32, -1 = not quota-managed
+    non_preemptible: jnp.ndarray  # [P] bool
+
+    @classmethod
+    def build(cls, req, est, is_prod, is_daemonset, quota_id=None, non_preemptible=None):
+        p = req.shape[0]
+        return cls(
+            req=req,
+            est=est,
+            is_prod=is_prod,
+            is_daemonset=is_daemonset,
+            quota_id=(
+                quota_id if quota_id is not None else jnp.full(p, -1, jnp.int32)
+            ),
+            non_preemptible=(
+                non_preemptible
+                if non_preemptible is not None
+                else jnp.zeros(p, bool)
+            ),
+        )
 
 
 class ScoreParams(NamedTuple):
@@ -158,25 +178,64 @@ def schedule_batch(
     pods: PodBatch,
     params: ScoreParams,
     config: SolverConfig = SolverConfig(),
+    quota_state=None,
 ) -> tuple:
-    """Schedule a whole pending queue; returns (final_state, assignments[P]).
+    """Schedule a whole pending queue; returns (final_state, assignments[P])
+    — or ((final_state, final_quota_state), assignments) when a
+    ``QuotaState`` is given.
 
     ``assignments[i]`` is the node index for pod i (in the given order) or
     -1 if unschedulable at its turn. Semantics match scheduling the pods
-    one-by-one through the reference's Filter→Score→Reserve cycle.
+    one-by-one through the reference's Filter→Score→Reserve cycle; with
+    ``quota_state``, each pod additionally passes the ElasticQuota
+    PreFilter gate with the runtime water-filling refreshed per pod
+    (reference plugin.go:210-255; ops/quota.py).
     """
     n_pods = pods.req.shape[0]
     if state.alloc.shape[0] == 0:  # static shape: no nodes, nothing placeable
-        return state, jnp.full(n_pods, -1, dtype=jnp.int32)
+        empty = jnp.full(n_pods, -1, dtype=jnp.int32)
+        return (state if quota_state is None else (state, quota_state)), empty
 
-    def step(carry: NodeState, xs):
-        req, est, is_prod, is_ds = xs
-        new_state, node = place_one_pod(
-            carry, req, est, is_prod, is_ds, params, config
+    if quota_state is None:
+
+        def step(carry: NodeState, xs):
+            req, est, is_prod, is_ds = xs
+            new_state, node = place_one_pod(
+                carry, req, est, is_prod, is_ds, params, config
+            )
+            return new_state, node
+
+        final_state, assignments = jax.lax.scan(
+            step, state, (pods.req, pods.est, pods.is_prod, pods.is_daemonset)
         )
-        return new_state, node
+        return final_state, assignments
 
-    final_state, assignments = jax.lax.scan(
-        step, state, (pods.req, pods.est, pods.is_prod, pods.is_daemonset)
+    from koordinator_tpu.ops.quota import quota_admit, quota_assume, quota_runtime
+
+    # Requests are static within a solve (registered at pod creation), so
+    # the water-filled runtime is computed once for the whole batch.
+    runtime = quota_runtime(quota_state)
+
+    def step_q(carry, xs):
+        node_state, qstate = carry
+        req, est, is_prod, is_ds, quota_id, non_preempt = xs
+        admit = quota_admit(qstate, runtime, quota_id, req, non_preempt)
+        new_state, node = place_one_pod(
+            node_state, req, est, is_prod, is_ds, params, config, admit=admit
+        )
+        new_qstate = quota_assume(qstate, quota_id, req, non_preempt, node >= 0)
+        return (new_state, new_qstate), node
+
+    (final_state, final_qstate), assignments = jax.lax.scan(
+        step_q,
+        (state, quota_state),
+        (
+            pods.req,
+            pods.est,
+            pods.is_prod,
+            pods.is_daemonset,
+            pods.quota_id,
+            pods.non_preemptible,
+        ),
     )
-    return final_state, assignments
+    return (final_state, final_qstate), assignments

@@ -1,0 +1,250 @@
+"""Device-path elastic quota: water-filling + admission inside the solver.
+
+The reference refreshes a quota's runtime at every pod's PreFilter
+(plugin.go:221-223). Requests register with a quota when the *pod object*
+is created (OnPodAdd → updatePodRequest), not when it is scheduled, so
+within one solve over a fixed pending queue every group's request — and
+therefore the water-filled runtime — is constant; only ``used`` moves as
+pods are placed. The solver exploits this: the fixed-point redistribution
+runs once per solve as a ``lax.while_loop`` over dense ``[Q, R]`` arrays
+(Q quota groups × R resources, all dims independent), and the per-pod gate
+is a pure ``used + req <= runtime`` mask.
+
+Exact arithmetic: the weighted share ``round(w * T / W)`` is computed as
+``w * (T // W) + round_half_up(w * (T % W) / W)`` — exact in int32 given
+host-normalized weights (per-dimension Σw ≤ 2^15-1, see
+``normalize_weights``) and values saturated at 2^30 (``SATURATE``;
+"effectively infinite" maxes keep behaving as infinite). The host oracle
+(quota/core.py water_filling with ``exact_rational=True``) matches this
+bit-for-bit; the reference's float64 delta differs only in float rounding
+artifacts (documented deviation, same spirit as ops/common.percent_rounded).
+
+Scope: single-level trees (all groups under root) run fully on device —
+the dominant production shape and BASELINE config #3. Deeper trees use
+the host GroupQuotaManager at PreFilter.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Device-path value saturation: 2^30 canonical units. Sums of two
+#: saturated values still fit int32.
+SATURATE = 1 << 30
+
+#: Per-dimension normalized weight cap (Σw per resource ≤ this).
+WEIGHT_CAP = (1 << 15) - 1
+
+
+def normalize_weights(weights: np.ndarray) -> np.ndarray:
+    """Host-side per-dimension weight normalization to Σ ≤ WEIGHT_CAP.
+
+    Proportions are preserved up to integer rounding; dimensions already
+    under the cap are untouched (bit-identical to the reference there).
+    """
+    weights = np.minimum(np.asarray(weights, dtype=np.int64), SATURATE)
+    sums = weights.sum(axis=0)  # [R]
+    scale_needed = sums > WEIGHT_CAP
+    out = weights.copy()
+    for r in np.nonzero(scale_needed)[0]:
+        out[:, r] = (weights[:, r] * WEIGHT_CAP) // sums[r]
+    return out.astype(np.int32)
+
+
+class QuotaState(NamedTuple):
+    """Device-resident quota arrays (single-level tree, [Q,R] int32).
+
+    Construct via :meth:`build`, which applies the saturation and weight
+    normalization the int32 arithmetic depends on.
+    """
+
+    min: jnp.ndarray            # [Q,R]
+    max: jnp.ndarray            # [Q,R] (saturated)
+    auto_min: jnp.ndarray       # [Q,R] max(min, guarantee)
+    weight: jnp.ndarray         # [Q,R] normalized shared weights
+    allow_lent: jnp.ndarray     # [Q] bool
+    child_request: jnp.ndarray  # [Q,R] Σ pod requests (pending + assigned)
+    used: jnp.ndarray           # [Q,R] (mutated by solve)
+    np_used: jnp.ndarray        # [Q,R] non-preemptible used
+    total: jnp.ndarray          # [R] cluster total minus system/default used
+
+    @classmethod
+    def build(
+        cls,
+        min,
+        max,
+        weight,
+        allow_lent,
+        total,
+        guarantee=None,
+        child_request=None,
+        used=None,
+        np_used=None,
+    ) -> "QuotaState":
+        """Host-side constructor enforcing the device-path preconditions:
+        values saturated at ``SATURATE`` and per-dimension weight sums
+        normalized under ``WEIGHT_CAP`` (see module docstring)."""
+        mn = np.minimum(np.asarray(min, dtype=np.int64), SATURATE)
+        mx = np.minimum(np.asarray(max, dtype=np.int64), SATURATE)
+        guar = (
+            np.minimum(np.asarray(guarantee, dtype=np.int64), SATURATE)
+            if guarantee is not None
+            else np.zeros_like(mn)
+        )
+        q = mn.shape[0]
+        zeros = np.zeros_like(mn)
+        return cls(
+            min=jnp.asarray(mn, jnp.int32),
+            max=jnp.asarray(mx, jnp.int32),
+            auto_min=jnp.asarray(np.maximum(mn, guar), jnp.int32),
+            weight=jnp.asarray(normalize_weights(np.asarray(weight))),
+            allow_lent=jnp.asarray(np.asarray(allow_lent, dtype=bool)),
+            child_request=jnp.asarray(
+                np.minimum(
+                    np.asarray(
+                        child_request if child_request is not None else zeros,
+                        dtype=np.int64,
+                    ),
+                    SATURATE,
+                ),
+                jnp.int32,
+            ),
+            used=jnp.asarray(
+                np.asarray(used if used is not None else zeros, dtype=np.int64),
+                jnp.int32,
+            ),
+            np_used=jnp.asarray(
+                np.asarray(np_used if np_used is not None else zeros, dtype=np.int64),
+                jnp.int32,
+            ),
+            total=jnp.asarray(
+                np.minimum(np.asarray(total, dtype=np.int64), SATURATE), jnp.int32
+            ),
+        )
+
+
+def limited_request(state: QuotaState) -> jnp.ndarray:
+    """[Q,R] the calculator's per-group request: child request floored at
+    min for non-lent groups, capped at max (quota_info.go:217-228)."""
+    real = jnp.where(
+        state.allow_lent[:, None],
+        state.child_request,
+        jnp.maximum(state.child_request, state.min),
+    )
+    return jnp.minimum(real, state.max)
+
+
+def _exact_share(weight: jnp.ndarray, remaining: jnp.ndarray, total_w: jnp.ndarray) -> jnp.ndarray:
+    """round_half_up(weight * remaining / total_w) exactly in int32:
+    ``w*(T//W) + (2*w*(T%W) + W) // (2*W)`` ([Q,R] × [R] → [Q,R])."""
+    w_safe = jnp.maximum(total_w, 1)              # [R]
+    t_div = remaining // w_safe                   # [R]
+    t_rem = remaining - t_div * w_safe            # [R]
+    frac = (2 * weight * t_rem[None, :] + w_safe[None, :]) // (2 * w_safe[None, :])
+    share = weight * t_div[None, :] + frac
+    return jnp.where(total_w[None, :] > 0, share, 0)
+
+
+def water_filling_device(
+    total: jnp.ndarray,      # [R]
+    request: jnp.ndarray,    # [Q,R] limited requests
+    auto_min: jnp.ndarray,   # [Q,R]
+    weight: jnp.ndarray,     # [Q,R]
+    allow_lent: jnp.ndarray,  # [Q]
+) -> jnp.ndarray:
+    """Runtime[Q,R]: the reference redistribution (SURVEY.md A.4) over all
+    resource dimensions at once."""
+    q = request.shape[0]
+    adjustable0 = request > auto_min                       # [Q,R]
+    runtime0 = jnp.where(
+        adjustable0,
+        auto_min,
+        jnp.where(allow_lent[:, None], request, auto_min),
+    )
+    remaining0 = total - jnp.sum(runtime0, axis=0)         # [R]
+    total_w0 = jnp.sum(jnp.where(adjustable0, weight, 0), axis=0)
+
+    def cond(carry):
+        runtime, adjustable, remaining, total_w = carry
+        return jnp.any((remaining > 0) & (total_w > 0) & jnp.any(adjustable, axis=0))
+
+    def body(carry):
+        runtime, adjustable, remaining, total_w = carry
+        active = (remaining > 0) & (total_w > 0)           # [R]
+        delta = jnp.where(
+            adjustable & active[None, :],
+            _exact_share(weight, jnp.maximum(remaining, 0), total_w),
+            0,
+        )
+        grown = runtime + delta
+        saturated = adjustable & (grown >= request)
+        surplus = jnp.sum(jnp.where(saturated, grown - request, 0), axis=0)
+        runtime = jnp.where(adjustable, jnp.minimum(grown, request), runtime)
+        still = adjustable & (runtime < request)
+        new_total_w = jnp.sum(jnp.where(still, weight, 0), axis=0)
+        # stop a dimension when it produced no surplus (Go stops recursing
+        # when toPartitionResource == 0) or nothing is adjustable
+        new_remaining = jnp.where(active, surplus, remaining)
+        return runtime, still, new_remaining, new_total_w
+
+    runtime, _, _, _ = jax.lax.while_loop(
+        cond, body, (runtime0, adjustable0, remaining0, total_w0)
+    )
+    return runtime
+
+
+def quota_runtime(state: QuotaState) -> jnp.ndarray:
+    """[Q,R] masked runtime: water-filling then min(runtime, max)."""
+    runtime = water_filling_device(
+        state.total,
+        limited_request(state),
+        state.auto_min,
+        state.weight,
+        state.allow_lent,
+    )
+    return jnp.minimum(runtime, state.max)
+
+
+def quota_admit(
+    state: QuotaState,
+    runtime: jnp.ndarray,        # [Q,R] precomputed masked runtime
+    quota_id: jnp.ndarray,       # [] int32, -1 = no quota
+    pod_req: jnp.ndarray,        # [R]
+    non_preemptible: jnp.ndarray,  # [] bool
+) -> jnp.ndarray:
+    """[] bool admission (SURVEY.md A.3): used + req <= runtime on the
+    requested dims; non-preemptible additionally against min. ``runtime``
+    is computed once per solve (requests are static within a solve)."""
+    q = jnp.maximum(quota_id, 0)
+    dims = pod_req > 0
+    ok = jnp.all(jnp.where(dims, state.used[q] + pod_req <= runtime[q], True))
+    np_ok = jnp.all(
+        jnp.where(
+            dims & non_preemptible,
+            state.np_used[q] + pod_req <= state.min[q],
+            True,
+        )
+    )
+    return (quota_id < 0) | (ok & np_ok)
+
+
+def quota_assume(
+    state: QuotaState,
+    quota_id: jnp.ndarray,
+    pod_req: jnp.ndarray,
+    non_preemptible: jnp.ndarray,
+    placed: jnp.ndarray,         # [] bool — only account if actually placed
+) -> QuotaState:
+    """Account a placed pod's *used* into its quota group (its request was
+    already registered at pod creation)."""
+    take = placed & (quota_id >= 0)
+    q = jnp.maximum(quota_id, 0)
+    add = jnp.where(take, pod_req, 0)
+    return state._replace(
+        used=state.used.at[q].add(add),
+        np_used=state.np_used.at[q].add(jnp.where(non_preemptible, add, 0)),
+    )

@@ -1,0 +1,20 @@
+"""Hierarchical elastic quota: fair sharing of cluster capacity.
+
+TPU-native rebuild of the reference's ElasticQuota plugin core
+(pkg/scheduler/plugins/elasticquota/core/): a tree of quota groups with
+min/max/shared-weight semantics, per-resource water-filling redistribution
+of unused capacity, and admission gating.
+
+Two implementations with one semantics:
+- ``quota.core``: the host control-plane manager (exact reference
+  semantics; Python ints == Go int64, float64 where the reference uses it).
+- ``ops.quota``: the device path used inside the batched solver — the same
+  water-filling as a fixed-point ``lax.while_loop`` over ``[Q, R]``
+  arrays with host-normalized weights (exact int32 arithmetic).
+"""
+
+from koordinator_tpu.quota.core import (  # noqa: F401
+    GroupQuotaManager,
+    QuotaInfo,
+    water_filling,
+)

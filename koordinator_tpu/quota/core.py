@@ -1,0 +1,363 @@
+"""Host control-plane quota manager with exact reference semantics.
+
+Reference: pkg/scheduler/plugins/elasticquota/core/
+  - runtime_quota_calculator.go:111-186 (redistribution + iteration)
+  - group_quota_manager.go:184-328 (request propagation, runtime refresh)
+  - plugin.go:210-255 (admission; SURVEY.md A.3/A.4)
+
+All vectors are numpy int64 ``[R]`` in canonical units; the weighted
+redistribution delta uses float64 half-up rounding exactly like the Go
+path (``int64(float64(w)*float64(T)/float64(W) + 0.5)``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES
+from koordinator_tpu.apis.types import QuotaSpec, resources_to_vector
+
+#: Well-known quota group names (reference: apis/extension/constants.go).
+ROOT_QUOTA = "root"
+SYSTEM_QUOTA = "system"
+DEFAULT_QUOTA = "default"
+
+
+def water_filling(
+    total: int,
+    request: Sequence[int],
+    min_: Sequence[int],
+    guarantee: Sequence[int],
+    weight: Sequence[int],
+    allow_lent: Sequence[bool],
+    *,
+    exact_rational: bool = False,
+) -> List[int]:
+    """One resource dimension's runtime redistribution.
+
+    Reference: runtime_quota_calculator.go:111-186. Each group first gets
+    ``min(autoScaleMin, request)`` where ``autoScaleMin = max(min,
+    guarantee)``; non-lent groups keep ``autoScaleMin`` even when their
+    request is below it; groups requesting more become "adjustable" and the
+    remaining capacity is distributed iteratively in proportion to shared
+    weight, clamping at request and re-pooling surplus until exhausted.
+
+    ``exact_rational=True`` replaces the reference's float64 delta with the
+    exact rational round-half-up — the semantics used by the device path
+    (see ops/quota.py); the two differ only on float64 rounding artifacts.
+    """
+    n = len(request)
+    runtime = [0] * n
+    adjustable = []
+    total_weight = 0
+    remaining = int(total)
+    for i in range(n):
+        auto_min = max(int(min_[i]), int(guarantee[i]))
+        if request[i] > auto_min:
+            adjustable.append(i)
+            total_weight += int(weight[i])
+            runtime[i] = auto_min
+        elif allow_lent[i]:
+            runtime[i] = int(request[i])
+        else:
+            runtime[i] = auto_min
+        remaining -= runtime[i]
+
+    while remaining > 0 and total_weight > 0 and adjustable:
+        still = []
+        still_weight = 0
+        surplus = 0
+        for i in adjustable:
+            w = int(weight[i])
+            if exact_rational:
+                delta = (2 * w * remaining + total_weight) // (2 * total_weight)
+            else:
+                delta = int(math.floor(float(w) * float(remaining) / float(total_weight) + 0.5))
+            runtime[i] += delta
+            if runtime[i] < request[i]:
+                still.append(i)
+                still_weight += w
+            else:
+                surplus += runtime[i] - int(request[i])
+                runtime[i] = int(request[i])
+        if surplus <= 0 or not still:
+            break
+        adjustable, total_weight, remaining = still, still_weight, surplus
+    return runtime
+
+
+@dataclasses.dataclass
+class QuotaInfo:
+    """One quota group's live accounting state."""
+
+    spec: QuotaSpec
+    min: np.ndarray
+    max: np.ndarray
+    auto_scale_min: np.ndarray     # max(min, guarantee)
+    shared_weight: np.ndarray      # defaults to max
+    request: np.ndarray            # own + child limited requests
+    child_request: np.ndarray
+    non_preemptible_request: np.ndarray
+    used: np.ndarray
+    non_preemptible_used: np.ndarray
+    runtime: np.ndarray
+    children: List[str]
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    @property
+    def parent(self) -> str:
+        return self.spec.parent or ROOT_QUOTA
+
+    @property
+    def limited_request(self) -> np.ndarray:
+        return np.minimum(self.request, self.max)
+
+
+def _zeros() -> np.ndarray:
+    return np.zeros(NUM_RESOURCES, dtype=np.int64)
+
+
+class GroupQuotaManager:
+    """The hierarchical quota tree: request/used accounting + runtime refresh.
+
+    Reference: group_quota_manager.go. The reference maintains per-parent
+    incremental calculators with versioning; at control-plane scale a full
+    root→leaf recomputation per refresh is equivalent and simpler — the
+    observable runtime values match.
+    """
+
+    def __init__(
+        self,
+        cluster_total: Optional[Dict] = None,
+        exact_rational: bool = False,
+    ):
+        self.quotas: Dict[str, QuotaInfo] = {}
+        self.cluster_total = resources_to_vector(cluster_total or {})
+        self.exact_rational = exact_rational
+        root = QuotaSpec(name=ROOT_QUOTA, parent=None, is_parent=True)
+        self._insert(root)
+
+    # -- tree maintenance ---------------------------------------------------
+
+    def _insert(self, spec: QuotaSpec) -> QuotaInfo:
+        mn = resources_to_vector(spec.min)
+        mx = resources_to_vector(spec.max)
+        guarantee = resources_to_vector(spec.guaranteed)
+        weight = (
+            resources_to_vector(spec.shared_weight)
+            if spec.shared_weight is not None
+            else mx.copy()
+        )
+        info = QuotaInfo(
+            spec=spec,
+            min=mn,
+            max=mx,
+            auto_scale_min=np.maximum(mn, guarantee),
+            shared_weight=weight,
+            request=_zeros(),
+            child_request=_zeros(),
+            non_preemptible_request=_zeros(),
+            used=_zeros(),
+            non_preemptible_used=_zeros(),
+            runtime=_zeros(),
+            children=[],
+        )
+        self.quotas[spec.name] = info
+        return info
+
+    def update_quota(self, spec: QuotaSpec) -> None:
+        """Add or reconfigure a quota group (UpdateQuota equivalent)."""
+        existing = self.quotas.get(spec.name)
+        if existing is not None:
+            carry = existing
+            info = self._insert(spec)
+            info.request = carry.request
+            info.child_request = carry.child_request
+            info.non_preemptible_request = carry.non_preemptible_request
+            info.used = carry.used
+            info.non_preemptible_used = carry.non_preemptible_used
+            info.children = carry.children
+        else:
+            self._insert(spec)
+        self._rebuild_children()
+
+    def _rebuild_children(self) -> None:
+        for info in self.quotas.values():
+            info.children = []
+        for name, info in self.quotas.items():
+            if name == ROOT_QUOTA:
+                continue
+            parent = self.quotas.get(info.parent)
+            if parent is not None:
+                parent.children.append(name)
+
+    def _ancestry(self, name: str) -> List[QuotaInfo]:
+        """[self, parent, ..., root] (getCurToAllParentGroupQuotaInfo)."""
+        chain = []
+        cur = self.quotas.get(name)
+        while cur is not None:
+            chain.append(cur)
+            if cur.name == ROOT_QUOTA:
+                break
+            cur = self.quotas.get(cur.parent)
+        return chain
+
+    # -- accounting ---------------------------------------------------------
+
+    def add_request(
+        self, name: str, delta: np.ndarray, non_preemptible: bool = False
+    ) -> None:
+        """Propagate a request delta up the tree
+        (recursiveUpdateGroupTreeWithDeltaRequest, group_quota_manager.go:184).
+
+        At every level: ChildRequest accumulates the delta (for the leaf,
+        pods are its "children"); Request is rewritten as ChildRequest
+        floored at min for non-lent groups; the delta handed to the parent
+        is the change in the group's max-limited request. The
+        non-preemptible delta adds unchanged at every ancestor.
+        """
+        chain = self._ancestry(name)
+        d = np.asarray(delta, dtype=np.int64)
+        npd = d if non_preemptible else np.zeros_like(d)
+        for info in chain:
+            old_limited = info.limited_request
+            info.non_preemptible_request = np.maximum(
+                info.non_preemptible_request + npd, 0
+            )
+            if info.name == ROOT_QUOTA:
+                # only the root keeps the plain accumulated request; every
+                # other level rewrites request from child_request below
+                info.request = np.maximum(info.request + d, 0)
+                return
+            info.child_request = np.maximum(info.child_request + d, 0)
+            real = info.child_request.copy()
+            if not info.spec.allow_lent_resource:
+                real = np.maximum(real, info.min)
+            info.request = real
+            d = info.limited_request - old_limited
+
+    def add_used(
+        self, name: str, delta: np.ndarray, non_preemptible: bool = False
+    ) -> None:
+        """used += delta on the group and all ancestors
+        (updateGroupDeltaUsedNoLock, group_quota_manager.go:228)."""
+        d = np.asarray(delta, dtype=np.int64)
+        for info in self._ancestry(name):
+            info.used = np.maximum(info.used + d, 0)
+            if non_preemptible:
+                info.non_preemptible_used = np.maximum(
+                    info.non_preemptible_used + d, 0
+                )
+
+    # -- runtime ------------------------------------------------------------
+
+    def _available_total(self) -> np.ndarray:
+        """Cluster total minus system/default groups' used
+        (totalResourceExceptSystemAndDefaultUsed)."""
+        total = self.cluster_total.copy()
+        for special in (SYSTEM_QUOTA, DEFAULT_QUOTA):
+            info = self.quotas.get(special)
+            if info is not None:
+                total = total - info.used
+        return total
+
+    def refresh_runtime(self, name: str) -> Optional[np.ndarray]:
+        """Runtime of ``name`` after a root→leaf refresh along its ancestry
+        (refreshRuntimeNoLock, group_quota_manager.go:266-328)."""
+        info = self.quotas.get(name)
+        if info is None:
+            return None
+        if name == ROOT_QUOTA:
+            return self._available_total()
+        if name in (SYSTEM_QUOTA, DEFAULT_QUOTA):
+            return info.max.copy()
+
+        chain = self._ancestry(name)  # [self ... root]
+        total = self._available_total()
+        for info in reversed(chain):
+            if info.name == ROOT_QUOTA:
+                continue
+            parent = self.quotas[info.parent]
+            self._redistribute_children(parent, total)
+            total = info.runtime
+        return np.minimum(self.quotas[name].runtime, self.quotas[name].max)
+
+    def _redistribute_children(self, parent: QuotaInfo, total: np.ndarray) -> None:
+        """Run the per-dimension water-filling over ``parent``'s children."""
+        children = [
+            self.quotas[c]
+            for c in parent.children
+            if c not in (SYSTEM_QUOTA, DEFAULT_QUOTA)
+        ]
+        if not children:
+            return
+        request = np.stack([c.limited_request for c in children])
+        min_ = np.stack([c.min for c in children])
+        guarantee = np.stack([c.auto_scale_min for c in children])
+        weight = np.stack([c.shared_weight for c in children])
+        allow = [c.spec.allow_lent_resource for c in children]
+        for r in range(NUM_RESOURCES):
+            runtimes = water_filling(
+                int(total[r]),
+                request[:, r],
+                min_[:, r],
+                guarantee[:, r],
+                weight[:, r],
+                allow,
+                exact_rational=self.exact_rational,
+            )
+            for c, rt in zip(children, runtimes):
+                c.runtime[r] = rt
+
+    # -- admission (SURVEY.md A.3) -----------------------------------------
+
+    def can_admit(
+        self,
+        name: str,
+        pod_request: np.ndarray,
+        non_preemptible: bool = False,
+        check_parents: bool = False,
+    ) -> bool:
+        """PreFilter admission: ``used + podReq <= runtime`` on the pod's
+        requested dimensions; non-preemptible pods additionally against min
+        (plugin.go:210-255)."""
+        info = self.quotas.get(name)
+        if info is None:
+            return True
+        req = np.asarray(pod_request, dtype=np.int64)
+        dims = req > 0
+        runtime = self.refresh_runtime(name)
+        if runtime is None:
+            return True
+        if np.any((info.used + req)[dims] > runtime[dims]):
+            return False
+        if non_preemptible and np.any(
+            (info.non_preemptible_used + req)[dims] > info.min[dims]
+        ):
+            return False
+        if check_parents and info.parent != ROOT_QUOTA and info.parent in self.quotas:
+            return self.can_admit(
+                info.parent, pod_request, non_preemptible=False, check_parents=True
+            )
+        return True
+
+    # -- convenience --------------------------------------------------------
+
+    def assume_pod(
+        self, name: str, pod_request: np.ndarray, non_preemptible: bool = False
+    ) -> None:
+        self.add_request(name, pod_request, non_preemptible)
+        self.add_used(name, pod_request, non_preemptible)
+
+    def forget_pod(
+        self, name: str, pod_request: np.ndarray, non_preemptible: bool = False
+    ) -> None:
+        self.add_request(name, -np.asarray(pod_request), non_preemptible)
+        self.add_used(name, -np.asarray(pod_request), non_preemptible)

@@ -153,6 +153,7 @@ class PendingPodArrays:
     priority: np.ndarray   # [P] int32 numeric priority
     is_prod: np.ndarray    # [P] bool
     is_daemonset: np.ndarray  # [P] bool
+    non_preemptible: np.ndarray  # [P] bool
     quota_id: np.ndarray   # [P] int32, -1 if none
     gang_id: np.ndarray    # [P] int32, -1 if none
 
@@ -304,6 +305,7 @@ def lower_pending_pods(
     priority = np.zeros(p, dtype=np.int32)
     is_prod = np.zeros(p, dtype=bool)
     is_daemonset = np.zeros(p, dtype=bool)
+    non_preemptible = np.zeros(p, dtype=bool)
     quota_id = np.full(p, -1, dtype=np.int32)
     gang_id = np.full(p, -1, dtype=np.int32)
     for i, pod in enumerate(pods):
@@ -316,6 +318,7 @@ def lower_pending_pods(
         priority[i] = pod.priority
         is_prod[i] = pod.priority_class == PriorityClass.PROD
         is_daemonset[i] = pod.is_daemonset
+        non_preemptible[i] = not pod.preemptible
         if quota_index and pod.quota is not None:
             quota_id[i] = quota_index.get(pod.quota, -1)
         if gang_index and pod.gang is not None:
@@ -329,6 +332,7 @@ def lower_pending_pods(
         priority=priority,
         is_prod=is_prod,
         is_daemonset=is_daemonset,
+        non_preemptible=non_preemptible,
         quota_id=quota_id,
         gang_id=gang_id,
     )

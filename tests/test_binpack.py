@@ -73,7 +73,7 @@ def _run_both(n, p, config=SolverConfig()):
         metric_fresh=jnp.asarray(fresh),
         schedulable=jnp.asarray(sched),
     )
-    pods = PodBatch(
+    pods = PodBatch.build(
         req=jnp.asarray(req, jnp.int32),
         est=jnp.asarray(est, jnp.int32),
         is_prod=jnp.asarray(is_prod),
@@ -129,7 +129,7 @@ def test_unschedulable_when_no_capacity():
     )
     req = np.zeros((2, NUM_RESOURCES), dtype=np.int64)
     req[:, ResourceName.CPU] = 800  # first fits, second doesn't
-    pods = PodBatch(
+    pods = PodBatch.build(
         req=jnp.asarray(req, jnp.int32),
         est=jnp.asarray(req, jnp.int32),
         is_prod=jnp.zeros(2, bool),

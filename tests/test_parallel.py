@@ -87,7 +87,7 @@ def test_sharded_solver_matches_unsharded():
     padded = pad_node_arrays(node_arrays, mesh.devices.size)
     assert padded.alloc.shape[0] % 8 == 0
 
-    pods = PodBatch(
+    pods = PodBatch.build(
         req=jnp.asarray(pod_arrays.req),
         est=jnp.asarray(pod_arrays.est),
         is_prod=jnp.asarray(pod_arrays.is_prod),
@@ -124,7 +124,7 @@ def test_padding_preserves_assignments():
         thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
         prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
     )
-    pods = PodBatch(
+    pods = PodBatch.build(
         req=jnp.asarray(pod_arrays.req),
         est=jnp.asarray(pod_arrays.est),
         is_prod=jnp.asarray(pod_arrays.is_prod),

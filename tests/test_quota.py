@@ -1,0 +1,318 @@
+"""ElasticQuota tests: water-filling golden cases, tree manager semantics,
+device == oracle differential, and quota-gated solver scheduling."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.types import QuotaSpec
+from koordinator_tpu.ops.binpack import (
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    schedule_batch,
+)
+from koordinator_tpu.ops.quota import (
+    QuotaState,
+    normalize_weights,
+    quota_admit,
+    quota_assume,
+    quota_runtime,
+    water_filling_device,
+)
+from koordinator_tpu.oracle.placement import (
+    SequentialQuota,
+    schedule_sequential_quota,
+)
+from koordinator_tpu.quota.core import GroupQuotaManager, water_filling
+
+RNG = np.random.default_rng(5)
+CPU = ResourceName.CPU
+MEM = ResourceName.MEMORY
+
+
+# ---------------------------------------------------------------------------
+# water_filling golden cases (hand-derived from runtime_quota_calculator.go)
+# ---------------------------------------------------------------------------
+
+def test_water_filling_proportional_share():
+    # both adjustable, equal weight, no clamping: remaining split evenly
+    rt = water_filling(100, [50, 100], [10, 20], [0, 0], [1, 1], [True, True])
+    assert rt == [45, 55]  # 10+35, 20+35
+
+
+def test_water_filling_clamp_and_repool():
+    # A clamps at its request; surplus re-pooled into B
+    rt = water_filling(100, [12, 100], [10, 20], [0, 0], [1, 1], [True, True])
+    assert rt == [12, 88]
+
+
+def test_water_filling_non_lent_keeps_min():
+    # non-lent group keeps autoScaleMin even with request below it
+    rt = water_filling(100, [5, 100], [30, 0], [0, 0], [1, 1], [False, True])
+    assert rt == [30, 70]
+
+
+def test_water_filling_lent_gives_request():
+    rt = water_filling(100, [5, 100], [30, 0], [0, 0], [1, 1], [True, True])
+    assert rt == [5, 95]
+
+
+def test_water_filling_guarantee_overrides_min():
+    # guarantee > min raises autoScaleMin
+    rt = water_filling(100, [50, 100], [10, 20], [40, 0], [1, 1], [True, True])
+    # A: auto 40, B: auto 20; remaining 40 -> +20 each; A clamps at its
+    # request 50, the surplus 10 re-pools into B: [50, 50]
+    assert rt == [50, 50]
+
+
+def test_water_filling_zero_weight_no_distribution():
+    rt = water_filling(100, [50, 50], [10, 10], [0, 0], [0, 0], [True, True])
+    assert rt == [10, 10]  # nothing distributed beyond autoScaleMin
+
+
+def test_water_filling_overcommitted_total():
+    # remaining <= 0: only the base allocation
+    rt = water_filling(25, [50, 100], [10, 20], [0, 0], [1, 1], [True, True])
+    assert rt == [10, 20]
+
+
+def test_water_filling_float64_vs_exact_rational():
+    # the two delta roundings agree except on float64 artifacts; randomized
+    for _ in range(200):
+        k = int(RNG.integers(2, 6))
+        total = int(RNG.integers(0, 100_000))
+        req = RNG.integers(0, 50_000, k).tolist()
+        mn = RNG.integers(0, 10_000, k).tolist()
+        w = RNG.integers(0, 100, k).tolist()
+        lent = (RNG.uniform(size=k) < 0.8).tolist()
+        a = water_filling(total, req, mn, [0] * k, w, lent, exact_rational=False)
+        b = water_filling(total, req, mn, [0] * k, w, lent, exact_rational=True)
+        assert sum(np.abs(np.array(a) - np.array(b))) <= k  # off-by-rounding only
+        # conservation: the distributed total never exceeds max(total, Σ base)
+        # plus half-up rounding slack (one unit per group per round, exactly
+        # like the reference's +0.5 per node)
+        base = [
+            mn[i] if (req[i] > mn[i] or not lent[i]) else req[i] for i in range(k)
+        ]
+        assert sum(a) <= max(total, sum(base)) + k
+        # runtime never exceeds the request for adjustable groups
+        for i in range(k):
+            if lent[i]:
+                assert a[i] <= max(req[i], mn[i])
+
+
+# ---------------------------------------------------------------------------
+# GroupQuotaManager (tree semantics)
+# ---------------------------------------------------------------------------
+
+def _vec(cpu=0, mem=0):
+    v = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    v[CPU] = cpu
+    v[MEM] = mem
+    return v
+
+
+def test_manager_flat_tree_runtime_and_admission():
+    mgr = GroupQuotaManager(cluster_total={CPU: 100_000, MEM: 200_000})
+    mgr.update_quota(QuotaSpec(name="a", min={CPU: 10_000}, max={CPU: 80_000},
+                               shared_weight={CPU: 1}))
+    mgr.update_quota(QuotaSpec(name="b", min={CPU: 20_000}, max={CPU: 100_000},
+                               shared_weight={CPU: 1}))
+    # requests exceed mins -> adjustable; remaining split by weight
+    mgr.add_request("a", _vec(cpu=50_000))
+    mgr.add_request("b", _vec(cpu=100_000))
+    rt_a = mgr.refresh_runtime("a")
+    rt_b = mgr.refresh_runtime("b")
+    assert rt_a[CPU] == 45_000   # 10k + 35k
+    assert rt_b[CPU] == 55_000   # 20k + 35k
+
+    # admission: used + req <= runtime (requests above were already
+    # registered, runtime for a is 45k)
+    mgr.add_used("a", _vec(cpu=44_000))
+    assert mgr.can_admit("a", _vec(cpu=1_000))
+    assert not mgr.can_admit("a", _vec(cpu=2_000))
+
+
+def test_manager_hierarchy_parent_runtime_caps_children():
+    mgr = GroupQuotaManager(cluster_total={CPU: 100_000})
+    mgr.update_quota(QuotaSpec(name="team", parent=None, is_parent=True,
+                               min={CPU: 0}, max={CPU: 40_000},
+                               shared_weight={CPU: 1}))
+    mgr.update_quota(QuotaSpec(name="team/x", parent="team",
+                               min={CPU: 0}, max={CPU: 100_000},
+                               shared_weight={CPU: 1}))
+    mgr.update_quota(QuotaSpec(name="team/y", parent="team",
+                               min={CPU: 0}, max={CPU: 100_000},
+                               shared_weight={CPU: 1}))
+    mgr.add_request("team/x", _vec(cpu=50_000))
+    mgr.add_request("team/y", _vec(cpu=50_000))
+    # team's limited request = min(100k, max 40k) = 40k -> team runtime 40k
+    # (whole cluster is free), split evenly between x and y
+    rt_x = mgr.refresh_runtime("team/x")
+    rt_y = mgr.refresh_runtime("team/y")
+    assert rt_x[CPU] == 20_000
+    assert rt_y[CPU] == 20_000
+    assert mgr.quotas["team"].runtime[CPU] == 40_000
+
+
+def test_manager_request_propagates_limited():
+    mgr = GroupQuotaManager(cluster_total={CPU: 100_000})
+    mgr.update_quota(QuotaSpec(name="p", is_parent=True, min={}, max={CPU: 30_000}))
+    mgr.update_quota(QuotaSpec(name="p/c", parent="p", min={}, max={CPU: 10_000}))
+    mgr.add_request("p/c", _vec(cpu=50_000))
+    # child's limited request is 10k; parent sees only 10k
+    assert mgr.quotas["p/c"].request[CPU] == 50_000
+    assert mgr.quotas["p"].child_request[CPU] == 10_000
+
+
+def test_manager_non_preemptible_against_min():
+    mgr = GroupQuotaManager(cluster_total={CPU: 100_000})
+    mgr.update_quota(QuotaSpec(name="a", min={CPU: 5_000}, max={CPU: 50_000}))
+    mgr.add_request("a", _vec(cpu=4_000), non_preemptible=True)
+    mgr.add_used("a", _vec(cpu=4_000), non_preemptible=True)
+    # incoming pods register their request at creation (OnPodAdd), then the
+    # PreFilter admission check runs
+    mgr.add_request("a", _vec(cpu=1_000), non_preemptible=True)
+    assert mgr.can_admit("a", _vec(cpu=1_000), non_preemptible=True)
+    mgr.add_request("a", _vec(cpu=1_000))  # second pod's request
+    assert not mgr.can_admit("a", _vec(cpu=2_000), non_preemptible=True)
+    # preemptible pod can exceed min (up to runtime)
+    assert mgr.can_admit("a", _vec(cpu=2_000), non_preemptible=False)
+
+
+def test_manager_system_default_reduce_total():
+    mgr = GroupQuotaManager(cluster_total={CPU: 100_000})
+    mgr.update_quota(QuotaSpec(name="system", min={}, max={CPU: 1 << 40}))
+    mgr.update_quota(QuotaSpec(name="a", min={CPU: 0}, max={CPU: 200_000},
+                               shared_weight={CPU: 1}))
+    mgr.add_used("system", _vec(cpu=30_000))
+    mgr.add_request("a", _vec(cpu=100_000))
+    rt = mgr.refresh_runtime("a")
+    assert rt[CPU] == 70_000  # total minus system used
+
+
+# ---------------------------------------------------------------------------
+# device path == oracle
+# ---------------------------------------------------------------------------
+
+def _random_quota_state(q):
+    mn = np.zeros((q, NUM_RESOURCES), dtype=np.int64)
+    mx = np.zeros((q, NUM_RESOURCES), dtype=np.int64)
+    mn[:, CPU] = RNG.integers(0, 20_000, q)
+    mn[:, MEM] = RNG.integers(0, 40_000, q)
+    mx[:, CPU] = mn[:, CPU] + RNG.integers(0, 200_000, q)
+    mx[:, MEM] = mn[:, MEM] + RNG.integers(0, 400_000, q)
+    guar = (mn * RNG.uniform(0, 1.5, mn.shape)).astype(np.int64)
+    auto_min = np.maximum(mn, guar)
+    weight = np.zeros((q, NUM_RESOURCES), dtype=np.int64)
+    weight[:, CPU] = RNG.integers(0, 1 << 20, q)  # exercises normalization
+    weight[:, MEM] = RNG.integers(0, 50, q)
+    allow = RNG.uniform(size=q) < 0.8
+    total = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    total[CPU] = RNG.integers(0, 500_000)
+    total[MEM] = RNG.integers(0, 1_000_000)
+    return mn, mx, auto_min, weight, allow, total
+
+
+def test_device_water_filling_matches_oracle():
+    for _ in range(25):
+        q = int(RNG.integers(2, 12))
+        mn, mx, auto_min, weight, allow, total = _random_quota_state(q)
+        req = np.minimum(
+            (mx * RNG.uniform(0, 1.2, mx.shape)).astype(np.int64), mx
+        )
+        weight_n = normalize_weights(weight)
+        got = np.asarray(
+            water_filling_device(
+                jnp.asarray(total, jnp.int32),
+                jnp.asarray(req, jnp.int32),
+                jnp.asarray(auto_min, jnp.int32),
+                jnp.asarray(weight_n, jnp.int32),
+                jnp.asarray(allow),
+            )
+        )
+        for r in (CPU, MEM):
+            want = water_filling(
+                int(total[r]), req[:, r], mn[:, r], auto_min[:, r],
+                weight_n[:, r].astype(np.int64), allow, exact_rational=True,
+            )
+            np.testing.assert_array_equal(got[:, r], np.asarray(want), err_msg=f"dim {r}")
+
+
+def test_quota_gated_solver_matches_oracle():
+    # BASELINE config #3 shape at test scale: pods across quota groups
+    n, p, q = 25, 120, 6
+    mn, mx, auto_min, weight, allow, total = _random_quota_state(q)
+    weight_n = normalize_weights(weight)
+
+    alloc = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    alloc[:, CPU] = RNG.choice([32000, 64000], n)
+    alloc[:, MEM] = RNG.choice([65536, 131072], n)
+    total[CPU] = alloc[:, CPU].sum()
+    total[MEM] = alloc[:, MEM].sum()
+
+    req = np.zeros((p, NUM_RESOURCES), dtype=np.int64)
+    req[:, CPU] = RNG.choice([1000, 2000, 4000], p)
+    req[:, MEM] = RNG.choice([2048, 4096], p)
+    est = (req * 85) // 100
+    quota_id = RNG.integers(-1, q, p).astype(np.int32)
+    non_pre = RNG.uniform(size=p) < 0.3
+
+    zeros2 = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
+    state = NodeState(
+        alloc=jnp.asarray(alloc, jnp.int32),
+        used_req=jnp.asarray(zeros2, jnp.int32),
+        usage=jnp.asarray(zeros2, jnp.int32),
+        prod_usage=jnp.asarray(zeros2, jnp.int32),
+        est_extra=jnp.asarray(zeros2, jnp.int32),
+        prod_base=jnp.asarray(zeros2, jnp.int32),
+        metric_fresh=jnp.ones(n, bool),
+        schedulable=jnp.ones(n, bool),
+    )
+    pods = PodBatch.build(
+        req=jnp.asarray(req, jnp.int32),
+        est=jnp.asarray(est, jnp.int32),
+        is_prod=jnp.zeros(p, bool),
+        is_daemonset=jnp.zeros(p, bool),
+        quota_id=jnp.asarray(quota_id),
+        non_preemptible=jnp.asarray(non_pre),
+    )
+    w = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    w[CPU] = w[MEM] = 1
+    params = ScoreParams(
+        weights=jnp.asarray(w, jnp.int32),
+        thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+    # every pending pod's request registers with its quota at creation
+    child_request = np.zeros((q, NUM_RESOURCES), dtype=np.int64)
+    for i in range(p):
+        if quota_id[i] >= 0:
+            child_request[quota_id[i]] += req[i]
+    qstate = QuotaState.build(
+        min=mn,
+        max=mx,
+        guarantee=auto_min,
+        weight=weight,  # raw weights: build() normalizes
+        allow_lent=allow,
+        child_request=child_request,
+        total=total,
+    )
+    (_, final_q), got = schedule_batch(state, pods, params, SolverConfig(), qstate)
+
+    oracle_q = SequentialQuota(mn, mx, auto_min, weight_n.astype(np.int64), allow, total)
+    want = schedule_sequential_quota(
+        alloc, zeros2, zeros2, zeros2, zeros2, zeros2,
+        np.ones(n, bool), np.ones(n, bool),
+        req, est, np.zeros(p, bool), np.zeros(p, bool),
+        quota_id, non_pre, oracle_q,
+        w, np.zeros(NUM_RESOURCES, np.int64), np.zeros(NUM_RESOURCES, np.int64),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.array(want))
+    # both placed and quota-rejected pods must occur for a meaningful test
+    got_np = np.asarray(got)
+    assert (got_np >= 0).any() and (got_np < 0).any()
+    # device-side accounting matches the oracle's
+    np.testing.assert_array_equal(np.asarray(final_q.used), oracle_q.used)
