@@ -1,0 +1,8 @@
+"""Gang / coscheduling: all-or-nothing group admission.
+
+Device path: ops/gang.py (segment feasibility in the batched solver).
+Host path: gang/manager.py (the incremental Permit-barrier state machine
+with Strict/NonStrict modes and schedule-cycle bookkeeping).
+"""
+
+from koordinator_tpu.gang.manager import GangManager  # noqa: F401

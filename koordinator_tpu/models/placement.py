@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
-from koordinator_tpu.apis.types import ClusterSnapshot
+from koordinator_tpu.apis.types import ClusterSnapshot, GangMode
 from koordinator_tpu.ops.binpack import (
     NodeState,
     PodBatch,
@@ -27,6 +27,8 @@ from koordinator_tpu.ops.binpack import (
     SolverConfig,
     schedule_batch,
 )
+from koordinator_tpu.ops.gang import GangState
+from koordinator_tpu.ops.quota import QuotaState
 from koordinator_tpu.state.cluster import (
     DEFAULT_ESTIMATED_SCALING_FACTORS,
     DEFAULT_RESOURCE_WEIGHTS,
@@ -43,6 +45,21 @@ def _vec(mapping, dtype=np.int32) -> np.ndarray:
     for k, v in mapping.items():
         out[int(k)] = v
     return out
+
+
+class ScheduleResult(Dict[str, Optional[str]]):
+    """Result of one batched schedule.
+
+    Behaves as the ``{pod uid: node name | None}`` mapping of *committed*
+    (bindable) placements. ``waiting`` lists placed-but-not-committed
+    NonStrict gang members: they hold their node's resources at the Permit
+    barrier and MUST NOT be bound yet (reference: waiting pods in the
+    coscheduling Permit stage).
+    """
+
+    def __init__(self, assignments, waiting=None):
+        super().__init__(assignments)
+        self.waiting: Dict[str, str] = dict(waiting or {})
 
 
 class PlacementModel:
@@ -99,6 +116,7 @@ class PlacementModel:
             is_daemonset=jnp.asarray(arrays.is_daemonset),
             quota_id=jnp.asarray(arrays.quota_id),
             non_preemptible=jnp.asarray(arrays.non_preemptible),
+            gang_id=jnp.asarray(arrays.gang_id),
         )
 
     # -- solve --------------------------------------------------------------
@@ -107,8 +125,24 @@ class PlacementModel:
         """Jitted solve on staged arrays; returns (new_state, assignments)."""
         return self._solve(state, pods, self.params, self.config)
 
-    def schedule(self, snapshot: ClusterSnapshot) -> Dict[str, Optional[str]]:
-        """Typed end-to-end: snapshot → {pod uid: node name or None}."""
+    def schedule(self, snapshot: ClusterSnapshot) -> "ScheduleResult":
+        """Typed end-to-end: snapshot → committed placements.
+
+        Returns a :class:`ScheduleResult`: a ``{pod uid: node | None}``
+        mapping of committed (bindable) placements, with
+        ``result.waiting`` carrying NonStrict gang members that hold a
+        node at the Permit barrier but must not be bound. Gangs and
+        (single-level) quotas present in the snapshot are lowered onto the
+        device solver: quota admission gates each pod, gang groups resolve
+        all-or-nothing at batch end.
+        """
+        gang_names = sorted(snapshot.gangs)
+        quota_names = sorted(
+            q for q in snapshot.quotas if snapshot.quotas[q].parent in (None, "root")
+        )
+        gang_index = {name: i for i, name in enumerate(gang_names)}
+        quota_index = {name: i for i, name in enumerate(quota_names)}
+
         node_arrays = lower_nodes(
             snapshot,
             scaling_factors=self.scaling_factors,
@@ -116,14 +150,104 @@ class PlacementModel:
         )
         pod_arrays = lower_pending_pods(
             snapshot.pending_pods,
+            quota_index=quota_index or None,
+            gang_index=gang_index or None,
             scaling_factors=self.scaling_factors,
             resource_weights=self.resource_weights,
         )
         state = self.stage_nodes(node_arrays)
         batch = self.stage_pods(pod_arrays)
-        _, assignments = self.solve(state, batch)
+
+        gang_state = None
+        if gang_names:
+            bound = {name: 0 for name in gang_names}
+            for pod in snapshot.pods:
+                if pod.gang in bound and pod.node_name is not None:
+                    bound[pod.gang] += 1
+            group_label = {}
+            for i, name in enumerate(gang_names):
+                spec = snapshot.gangs[name]
+                group_label[name] = (
+                    "/".join(sorted(spec.gang_group)) if spec.gang_group else name
+                )
+            gang_state = GangState.build(
+                min_member=[snapshot.gangs[g].min_member for g in gang_names],
+                bound_count=[bound[g] for g in gang_names],
+                strict=[
+                    snapshot.gangs[g].mode == GangMode.STRICT for g in gang_names
+                ],
+                group_id=[group_label[g] for g in gang_names],  # build densifies
+            )
+
+        quota_state = None
+        if quota_names:
+            quota_state = self._build_quota_state(
+                snapshot, quota_names, quota_index, node_arrays
+            )
+
+        result = self._solve(
+            state, batch, self.params, self.config, quota_state, gang_state
+        )
+        if gang_state is not None:
+            _, (assignments, commit, waiting) = result
+            commit = np.asarray(commit)
+            waiting = np.asarray(waiting)
+        else:
+            _, assignments = result
+            commit = np.asarray(assignments) >= 0
+            waiting = np.zeros_like(commit)
         assignments = np.asarray(assignments)
-        return {
-            uid: (node_arrays.names[a] if a >= 0 else None)
-            for uid, a in zip(pod_arrays.uids, assignments)
-        }
+        return ScheduleResult(
+            assignments={
+                uid: (node_arrays.names[a] if c else None)
+                for uid, a, c in zip(pod_arrays.uids, assignments, commit)
+            },
+            waiting={
+                uid: node_arrays.names[a]
+                for uid, a, w in zip(pod_arrays.uids, assignments, waiting)
+                if w
+            },
+        )
+
+    def _build_quota_state(self, snapshot, quota_names, quota_index, node_arrays):
+        """Lower single-level quotas to a device QuotaState: cluster total
+        from node allocatables, requests from pending + assigned pods."""
+        q = len(quota_names)
+        from koordinator_tpu.apis.types import resources_to_vector
+
+        mn = np.zeros((q, NUM_RESOURCES), np.int64)
+        mx = np.zeros((q, NUM_RESOURCES), np.int64)
+        guar = np.zeros((q, NUM_RESOURCES), np.int64)
+        weight = np.zeros((q, NUM_RESOURCES), np.int64)
+        allow = np.ones(q, bool)
+        child_request = np.zeros((q, NUM_RESOURCES), np.int64)
+        used = np.zeros((q, NUM_RESOURCES), np.int64)
+        for name, i in quota_index.items():
+            spec = snapshot.quotas[name]
+            mn[i] = resources_to_vector(spec.min)
+            mx[i] = resources_to_vector(spec.max)
+            guar[i] = resources_to_vector(spec.guaranteed)
+            weight[i] = (
+                resources_to_vector(spec.shared_weight)
+                if spec.shared_weight is not None
+                else mx[i]
+            )
+            allow[i] = spec.allow_lent_resource
+        for pod in list(snapshot.pending_pods) + list(snapshot.pods):
+            if pod.quota in quota_index:
+                i = quota_index[pod.quota]
+                vec = resources_to_vector(pod.requests)
+                child_request[i] += vec
+                if pod.node_name is not None:
+                    used[i] += vec
+        total = node_arrays.alloc.astype(np.int64).sum(axis=0)
+        return QuotaState.build(
+            min=mn,
+            max=mx,
+            guarantee=guar,
+            weight=weight,
+            allow_lent=allow,
+            child_request=child_request,
+            used=used,
+            total=total,
+        )

@@ -66,9 +66,19 @@ class PodBatch(NamedTuple):
     is_daemonset: jnp.ndarray  # [P]
     quota_id: jnp.ndarray      # [P] int32, -1 = not quota-managed
     non_preemptible: jnp.ndarray  # [P] bool
+    gang_id: jnp.ndarray       # [P] int32, -1 = not gang-managed
 
     @classmethod
-    def build(cls, req, est, is_prod, is_daemonset, quota_id=None, non_preemptible=None):
+    def build(
+        cls,
+        req,
+        est,
+        is_prod,
+        is_daemonset,
+        quota_id=None,
+        non_preemptible=None,
+        gang_id=None,
+    ):
         p = req.shape[0]
         return cls(
             req=req,
@@ -82,6 +92,9 @@ class PodBatch(NamedTuple):
                 non_preemptible
                 if non_preemptible is not None
                 else jnp.zeros(p, bool)
+            ),
+            gang_id=(
+                gang_id if gang_id is not None else jnp.full(p, -1, jnp.int32)
             ),
         )
 
@@ -179,22 +192,31 @@ def schedule_batch(
     params: ScoreParams,
     config: SolverConfig = SolverConfig(),
     quota_state=None,
+    gang_state=None,
 ) -> tuple:
-    """Schedule a whole pending queue; returns (final_state, assignments[P])
-    — or ((final_state, final_quota_state), assignments) when a
-    ``QuotaState`` is given.
+    """Schedule a whole pending queue.
+
+    Returns ``(final_state, assignments[P])``; with ``quota_state``,
+    ``final_state`` is ``(node_state, quota_state)``; with ``gang_state``,
+    assignments is replaced by ``(assignments, commit[P], waiting[P])``
+    after the gang-group feasibility pass.
 
     ``assignments[i]`` is the node index for pod i (in the given order) or
     -1 if unschedulable at its turn. Semantics match scheduling the pods
     one-by-one through the reference's Filter→Score→Reserve cycle; with
     ``quota_state``, each pod additionally passes the ElasticQuota
-    PreFilter gate with the runtime water-filling refreshed per pod
-    (reference plugin.go:210-255; ops/quota.py).
+    PreFilter gate (plugin.go:210-255; ops/quota.py); with ``gang_state``,
+    gang-group all-or-nothing admission resolves at batch end with
+    rejected Strict gangs' resources released (ops/gang.py).
     """
     n_pods = pods.req.shape[0]
     if state.alloc.shape[0] == 0:  # static shape: no nodes, nothing placeable
         empty = jnp.full(n_pods, -1, dtype=jnp.int32)
-        return (state if quota_state is None else (state, quota_state)), empty
+        out_state = state if quota_state is None else (state, quota_state)
+        if gang_state is not None:
+            falses = jnp.zeros(n_pods, bool)
+            return out_state, (empty, falses, falses)
+        return out_state, empty
 
     if quota_state is None:
 
@@ -208,34 +230,74 @@ def schedule_batch(
         final_state, assignments = jax.lax.scan(
             step, state, (pods.req, pods.est, pods.is_prod, pods.is_daemonset)
         )
-        return final_state, assignments
-
-    from koordinator_tpu.ops.quota import quota_admit, quota_assume, quota_runtime
-
-    # Requests are static within a solve (registered at pod creation), so
-    # the water-filled runtime is computed once for the whole batch.
-    runtime = quota_runtime(quota_state)
-
-    def step_q(carry, xs):
-        node_state, qstate = carry
-        req, est, is_prod, is_ds, quota_id, non_preempt = xs
-        admit = quota_admit(qstate, runtime, quota_id, req, non_preempt)
-        new_state, node = place_one_pod(
-            node_state, req, est, is_prod, is_ds, params, config, admit=admit
+        final_qstate = None
+    else:
+        from koordinator_tpu.ops.quota import (
+            quota_admit,
+            quota_assume,
+            quota_runtime,
         )
-        new_qstate = quota_assume(qstate, quota_id, req, non_preempt, node >= 0)
-        return (new_state, new_qstate), node
 
-    (final_state, final_qstate), assignments = jax.lax.scan(
-        step_q,
-        (state, quota_state),
-        (
-            pods.req,
-            pods.est,
-            pods.is_prod,
-            pods.is_daemonset,
-            pods.quota_id,
-            pods.non_preemptible,
-        ),
+        # Requests are static within a solve (registered at pod creation),
+        # so the water-filled runtime is computed once for the whole batch.
+        runtime = quota_runtime(quota_state)
+
+        def step_q(carry, xs):
+            node_state, qstate = carry
+            req, est, is_prod, is_ds, quota_id, non_preempt = xs
+            admit = quota_admit(qstate, runtime, quota_id, req, non_preempt)
+            new_state, node = place_one_pod(
+                node_state, req, est, is_prod, is_ds, params, config, admit=admit
+            )
+            new_qstate = quota_assume(qstate, quota_id, req, non_preempt, node >= 0)
+            return (new_state, new_qstate), node
+
+        (final_state, final_qstate), assignments = jax.lax.scan(
+            step_q,
+            (state, quota_state),
+            (
+                pods.req,
+                pods.est,
+                pods.is_prod,
+                pods.is_daemonset,
+                pods.quota_id,
+                pods.non_preemptible,
+            ),
+        )
+
+    if gang_state is None:
+        if final_qstate is None:
+            return final_state, assignments
+        return (final_state, final_qstate), assignments
+
+    from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
+
+    commit, waiting, rejected = gang_outcomes(assignments, pods.gang_id, gang_state)
+    used_req, est_extra, prod_base = release_rejected(
+        final_state.used_req,
+        final_state.est_extra,
+        final_state.prod_base,
+        assignments,
+        rejected,
+        pods.req,
+        pods.est,
+        pods.is_prod,
     )
-    return (final_state, final_qstate), assignments
+    final_state = final_state._replace(
+        used_req=used_req, est_extra=est_extra, prod_base=prod_base
+    )
+    out_assign = jnp.where(commit | waiting, assignments, -1).astype(jnp.int32)
+
+    if final_qstate is not None:
+        # release rejected pods' quota accounting too
+        q = final_qstate.used.shape[0]
+        qidx = jnp.where(rejected & (pods.quota_id >= 0), pods.quota_id, q)
+        rel = jnp.where((rejected & (pods.quota_id >= 0))[:, None], pods.req, 0)
+        sub = jax.ops.segment_sum(rel, qidx, num_segments=q + 1)[:q]
+        np_rel = jnp.where(pods.non_preemptible[:, None], rel, 0)
+        np_sub = jax.ops.segment_sum(np_rel, qidx, num_segments=q + 1)[:q]
+        final_qstate = final_qstate._replace(
+            used=final_qstate.used - sub, np_used=final_qstate.np_used - np_sub
+        )
+        return (final_state, final_qstate), (out_assign, commit, waiting)
+    return final_state, (out_assign, commit, waiting)

@@ -1,0 +1,125 @@
+"""Gang (coscheduling) all-or-nothing admission for the batched solver.
+
+The reference gates gangs at Permit: each placed member is "assumed" and
+waits until every gang in its gang-group has assumed+bound ≥ minMember;
+a Strict-mode member failure rejects the whole group and releases its
+assumed resources (SURVEY.md A.5; coscheduling/core/core.go:358-430).
+
+Batched formulation: the placement scan places gang members normally
+(holding resources, exactly like assumed pods waiting at Permit); after
+the scan, a segment-sum feasibility pass decides each gang-group's fate:
+
+- every gang in the group reaches its min → all its placed pods COMMIT
+  (the Permit barrier opens);
+- otherwise Strict gangs are REJECTED — their placed pods are released
+  (vectorized scatter-subtract of their requests/estimates) — while
+  NonStrict gangs stay WAITING: pods keep holding resources into the next
+  cycle, as the reference's waiting pods do until timeout.
+
+The reference's mid-cycle rejection timing depends on goroutine
+interleaving and is nondeterministic; this batched semantics — rejection
+resolved at batch end — is the deterministic equivalent and is what the
+host GangManager (gang/manager.py) models for the incremental path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GangState(NamedTuple):
+    """Device-resident gang metadata, [G] arrays (static within a solve)."""
+
+    min_member: jnp.ndarray   # [G] int32
+    bound_count: jnp.ndarray  # [G] int32 members already bound/assumed earlier
+    strict: jnp.ndarray       # [G] bool — Strict vs NonStrict mode
+    group_id: jnp.ndarray     # [G] int32 — gangs in one gang-group share an id
+
+    @classmethod
+    def build(cls, min_member, bound_count=None, strict=None, group_id=None):
+        g = len(min_member)
+        if group_id is None:
+            gid = np.arange(g, dtype=np.int32)
+        else:
+            # densify arbitrary group labels into [0, G) — segment reductions
+            # inside gang_outcomes require in-range indices
+            _, gid = np.unique(np.asarray(group_id), return_inverse=True)
+            gid = gid.astype(np.int32)
+        return cls(
+            min_member=jnp.asarray(np.asarray(min_member, np.int32)),
+            bound_count=jnp.asarray(
+                np.asarray(
+                    bound_count if bound_count is not None else np.zeros(g), np.int32
+                )
+            ),
+            strict=jnp.asarray(
+                np.asarray(strict if strict is not None else np.ones(g), bool)
+            ),
+            group_id=jnp.asarray(gid),
+        )
+
+
+def gang_outcomes(
+    assignments: jnp.ndarray,  # [P] node index or -1 (raw scan output)
+    gang_id: jnp.ndarray,      # [P] int32, -1 = not gang-managed
+    gangs: GangState,
+) -> tuple:
+    """(commit[P], waiting[P], rejected[P]) booleans.
+
+    commit: pod is bound (non-gang placed pods, or members of fully
+    satisfied gang-groups). waiting: placed NonStrict member of an
+    unsatisfied group — keeps holding its node. rejected: placed Strict
+    member of an unsatisfied group — must be released.
+    """
+    g = gangs.min_member.shape[0]
+    placed = assignments >= 0
+    gid = jnp.maximum(gang_id, 0)
+    member_placed = placed & (gang_id >= 0)
+    placed_per_gang = jax.ops.segment_sum(
+        member_placed.astype(jnp.int32), gid, num_segments=g
+    )
+    valid = (placed_per_gang + gangs.bound_count) >= gangs.min_member  # [G]
+
+    # a gang-group is satisfied iff every gang sharing its group id is valid
+    invalid = (~valid).astype(jnp.int32)
+    group_invalid = jax.ops.segment_sum(
+        invalid, gangs.group_id, num_segments=g
+    )  # indexed by group id
+    gang_ok = group_invalid[gangs.group_id] == 0                       # [G]
+
+    pod_gang_ok = gang_ok[gid]
+    commit = placed & ((gang_id < 0) | pod_gang_ok)
+    waiting = member_placed & ~pod_gang_ok & ~gangs.strict[gid]
+    rejected = member_placed & ~pod_gang_ok & gangs.strict[gid]
+    return commit, waiting, rejected
+
+
+def release_rejected(
+    node_used_req: jnp.ndarray,  # [N,R]
+    node_est_extra: jnp.ndarray,  # [N,R]
+    node_prod_base: jnp.ndarray,  # [N,R]
+    assignments: jnp.ndarray,    # [P]
+    rejected: jnp.ndarray,       # [P] bool
+    req: jnp.ndarray,            # [P,R]
+    est: jnp.ndarray,            # [P,R]
+    is_prod: jnp.ndarray,        # [P] bool
+) -> tuple:
+    """Vectorized release of rejected pods' held resources (the batched
+    Unreserve): scatter-subtract their requests/estimates per node."""
+    n = node_used_req.shape[0]
+    idx = jnp.where(rejected, assignments, n)  # out-of-range -> dropped
+    rel_req = jnp.where(rejected[:, None], req, 0)
+    rel_est = jnp.where(rejected[:, None], est, 0)
+    rel_prod = jnp.where((rejected & is_prod)[:, None], est, 0)
+    sub_req = jax.ops.segment_sum(rel_req, idx, num_segments=n + 1)[:n]
+    sub_est = jax.ops.segment_sum(rel_est, idx, num_segments=n + 1)[:n]
+    sub_prod = jax.ops.segment_sum(rel_prod, idx, num_segments=n + 1)[:n]
+    return (
+        node_used_req - sub_req,
+        node_est_extra - sub_est,
+        node_prod_base - sub_prod,
+    )

@@ -1,0 +1,260 @@
+"""Gang/coscheduling tests: segment feasibility, resource release, the
+gang-gated batched solve (BASELINE config #4 shape), and the host
+Permit-barrier state machine."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.types import GangMode, GangSpec
+from koordinator_tpu.gang.manager import GangManager, GangMatchPolicy, PermitResult
+from koordinator_tpu.ops.binpack import (
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    schedule_batch,
+)
+from koordinator_tpu.ops.gang import GangState, gang_outcomes, release_rejected
+
+CPU = ResourceName.CPU
+MEM = ResourceName.MEMORY
+RNG = np.random.default_rng(11)
+
+
+def test_gang_outcomes_basic():
+    # gang 0: 3 members all placed, min 3 -> commit
+    # gang 1 (strict): 2 of 3 placed, min 3 -> rejected
+    # gang 2 (non-strict): 1 of 2 placed, min 2 -> waiting
+    # pod 8: no gang, placed -> commit; pod 9: no gang, unplaced
+    assignments = jnp.asarray(
+        np.array([0, 1, 2, 0, 1, -1, 2, -1, 3, -1], np.int32)
+    )
+    gang_id = jnp.asarray(np.array([0, 0, 0, 1, 1, 1, 2, 2, -1, -1], np.int32))
+    gangs = GangState.build(
+        min_member=[3, 3, 2],
+        strict=[True, True, False],
+    )
+    commit, waiting, rejected = gang_outcomes(assignments, gang_id, gangs)
+    np.testing.assert_array_equal(
+        np.asarray(commit),
+        [True, True, True, False, False, False, False, False, True, False],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(waiting),
+        [False, False, False, False, False, False, True, False, False, False],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rejected),
+        [False, False, False, True, True, False, False, False, False, False],
+    )
+
+
+def test_gang_outcomes_bound_count():
+    # gang with 2 already-bound members: one new placement reaches min 3
+    assignments = jnp.asarray(np.array([5], np.int32))
+    gang_id = jnp.asarray(np.array([0], np.int32))
+    gangs = GangState.build(min_member=[3], bound_count=[2])
+    commit, waiting, rejected = gang_outcomes(assignments, gang_id, gangs)
+    assert bool(commit[0]) and not bool(waiting[0]) and not bool(rejected[0])
+
+
+def test_gang_group_coupling():
+    # two gangs in one gang-group: gang 0 satisfied, gang 1 not ->
+    # NEITHER commits (all-or-nothing across the group)
+    assignments = jnp.asarray(np.array([0, 1, 2, -1], np.int32))
+    gang_id = jnp.asarray(np.array([0, 0, 1, 1], np.int32))
+    gangs = GangState.build(
+        min_member=[2, 2], strict=[True, True], group_id=[7, 7]
+    )
+    commit, waiting, rejected = gang_outcomes(assignments, gang_id, gangs)
+    assert not np.asarray(commit).any()
+    np.testing.assert_array_equal(
+        np.asarray(rejected), [True, True, True, False]
+    )
+
+
+def test_release_rejected_restores_resources():
+    n, p = 4, 3
+    used = np.full((n, NUM_RESOURCES), 100, np.int32)
+    extra = np.full((n, NUM_RESOURCES), 50, np.int32)
+    prodb = np.full((n, NUM_RESOURCES), 30, np.int32)
+    req = np.full((p, NUM_RESOURCES), 10, np.int32)
+    est = np.full((p, NUM_RESOURCES), 7, np.int32)
+    assignments = jnp.asarray(np.array([1, 1, 2], np.int32))
+    rejected = jnp.asarray(np.array([True, True, False]))
+    is_prod = jnp.asarray(np.array([True, False, True]))
+    u, e, pb = release_rejected(
+        jnp.asarray(used), jnp.asarray(extra), jnp.asarray(prodb),
+        assignments, rejected, jnp.asarray(req), jnp.asarray(est), is_prod,
+    )
+    u, e, pb = np.asarray(u), np.asarray(e), np.asarray(pb)
+    assert (u[1] == 80).all() and (u[2] == 100).all()  # two pods off node 1
+    assert (e[1] == 36).all() and (e[0] == 50).all()
+    assert (pb[1] == 23).all()  # only the prod pod's estimate
+
+
+def _state(n, cpu=32000, mem=65536):
+    alloc = np.zeros((n, NUM_RESOURCES), np.int64)
+    alloc[:, CPU] = cpu
+    alloc[:, MEM] = mem
+    z = np.zeros((n, NUM_RESOURCES), np.int64)
+    return NodeState(
+        alloc=jnp.asarray(alloc, jnp.int32),
+        used_req=jnp.asarray(z, jnp.int32),
+        usage=jnp.asarray(z, jnp.int32),
+        prod_usage=jnp.asarray(z, jnp.int32),
+        est_extra=jnp.asarray(z, jnp.int32),
+        prod_base=jnp.asarray(z, jnp.int32),
+        metric_fresh=jnp.ones(n, bool),
+        schedulable=jnp.ones(n, bool),
+    )
+
+
+def _params():
+    w = np.zeros(NUM_RESOURCES, np.int64)
+    w[CPU] = w[MEM] = 1
+    return ScoreParams(
+        weights=jnp.asarray(w, jnp.int32),
+        thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+
+
+def test_gang_gated_solve_all_or_nothing():
+    # BASELINE config #4 shape at test scale: gangs of 4, tight capacity.
+    # 2 nodes x 32 cores; gang pods want 8 cores each -> 8 fit total.
+    # gang 0 (4 pods) fits, gang 1 (4 pods) fits, gang 2 (4 pods) does not
+    # -> strict gang 2 fully rejected, its partial placements released.
+    n_gangs, members = 3, 4
+    p = n_gangs * members
+    req = np.zeros((p, NUM_RESOURCES), np.int64)
+    req[:, CPU] = 8000
+    req[:, MEM] = 4096
+    gang_id = np.repeat(np.arange(n_gangs), members).astype(np.int32)
+    pods = PodBatch.build(
+        req=jnp.asarray(req, jnp.int32),
+        est=jnp.asarray((req * 85) // 100, jnp.int32),
+        is_prod=jnp.zeros(p, bool),
+        is_daemonset=jnp.zeros(p, bool),
+        gang_id=jnp.asarray(gang_id),
+    )
+    gangs = GangState.build(min_member=[members] * n_gangs)
+    state = _state(2)
+    final_state, (assign, commit, waiting) = schedule_batch(
+        state, pods, _params(), SolverConfig(), gang_state=gangs
+    )
+    assign = np.asarray(assign)
+    commit = np.asarray(commit)
+    # gangs 0 and 1 fully committed
+    assert commit[: 2 * members].all()
+    assert (assign[: 2 * members] >= 0).all()
+    # gang 2 fully rejected (released)
+    assert not commit[2 * members:].any()
+    assert (assign[2 * members:] == -1).all()
+    # released resources: node used_req equals exactly the committed pods
+    used = np.asarray(final_state.used_req)
+    assert used[:, CPU].sum() == 2 * members * 8000
+
+
+def test_gang_nonstrict_waits_holding_resources():
+    # NonStrict gang that can't fully place: placed members keep their nodes
+    p = 3
+    req = np.zeros((p, NUM_RESOURCES), np.int64)
+    req[:, CPU] = 16000
+    pods = PodBatch.build(
+        req=jnp.asarray(req, jnp.int32),
+        est=jnp.asarray(req, jnp.int32),
+        is_prod=jnp.zeros(p, bool),
+        is_daemonset=jnp.zeros(p, bool),
+        gang_id=jnp.asarray(np.zeros(p, np.int32)),
+    )
+    gangs = GangState.build(min_member=[3], strict=[False])
+    state = _state(1)  # one 32-core node: only 2 of 3 fit
+    final_state, (assign, commit, waiting) = schedule_batch(
+        state, pods, _params(), SolverConfig(), gang_state=gangs
+    )
+    assert not np.asarray(commit).any()
+    np.testing.assert_array_equal(np.asarray(waiting), [True, True, False])
+    np.testing.assert_array_equal(np.asarray(assign), [0, 0, -1])
+    # resources still held
+    assert np.asarray(final_state.used_req)[0, CPU] == 32000
+
+
+# ---------------------------------------------------------------------------
+# host state machine
+# ---------------------------------------------------------------------------
+
+def _mgr(min_member=2, mode=GangMode.STRICT, n_pods=3, name="g"):
+    mgr = GangManager()
+    mgr.update_gang(GangSpec(name=name, min_member=min_member, mode=mode))
+    for i in range(n_pods):
+        mgr.on_pod_add(f"{name}-p{i}", name)
+    return mgr
+
+
+def test_manager_prefilter_min_member_gate():
+    mgr = GangManager()
+    mgr.update_gang(GangSpec(name="g", min_member=3))
+    mgr.on_pod_add("g-p0", "g")
+    assert mgr.pre_filter("g-p0") is not None  # 1 < 3 children
+    mgr.on_pod_add("g-p1", "g")
+    mgr.on_pod_add("g-p2", "g")
+    assert mgr.pre_filter("g-p0") is None
+
+
+def test_manager_permit_barrier_then_allow():
+    mgr = _mgr(min_member=2)
+    assert mgr.pre_filter("g-p0") is None
+    result, wait = mgr.permit("g-p0")
+    assert result == PermitResult.WAIT and wait == 600.0
+    result, _ = mgr.permit("g-p1")
+    assert result == PermitResult.ALLOW
+    released = mgr.allow_gang_group("g")
+    assert set(released) == {"g-p0", "g-p1"}
+
+
+def test_manager_strict_rejection_releases_waiting():
+    mgr = _mgr(min_member=3)
+    mgr.permit("g-p0")
+    mgr.permit("g-p1")
+    rejected = mgr.unreserve("g-p2")  # p2 failed filter after others assumed
+    assert set(rejected) == {"g-p0", "g-p1"}
+    # cycle now invalid: strict members fail PreFilter until all attempted
+    assert mgr.pre_filter("g-p0") is not None
+
+
+def test_manager_cycle_reopens_after_all_children_attempt():
+    mgr = _mgr(min_member=3, n_pods=3)
+    # p0 and p1 attempt cycle 1, then the group is rejected
+    assert mgr.pre_filter("g-p0") is None
+    assert mgr.pre_filter("g-p1") is None
+    mgr.reject_gang_group("g")
+    # cycle invalid and not all children have attempted yet: retries fail
+    assert mgr.pre_filter("g-p0") is not None
+    assert mgr.pre_filter("g-p1") is not None
+    # p2's first attempt also fails (cycle invalid) but completes the
+    # attempt set...
+    assert mgr.pre_filter("g-p2") is not None
+    # ...so the cycle reopens and retries pass again
+    assert mgr.pre_filter("g-p0") is None
+
+
+def test_manager_once_satisfied_short_circuits():
+    mgr = _mgr(min_member=2)
+    mgr.permit("g-p0")
+    mgr.permit("g-p1")
+    mgr.allow_gang_group("g")
+    mgr.on_pod_bound("g-p0")
+    mgr.on_pod_bound("g-p1")
+    # a later member of a satisfied gang passes PreFilter unconditionally
+    # and its failure doesn't reject the gang
+    assert mgr.pre_filter("g-p2") is None
+    assert mgr.unreserve("g-p2") == []
+
+
+def test_manager_nonstrict_failure_keeps_waiting():
+    mgr = _mgr(min_member=3, mode=GangMode.NON_STRICT)
+    mgr.permit("g-p0")
+    mgr.permit("g-p1")
+    assert mgr.unreserve("g-p2") == []  # non-strict: no group rejection
