@@ -153,6 +153,11 @@ class QuotaSpec:
     Reference: scheduler-plugins ElasticQuota CRD + koordinator extensions
     (shared weight, allow-lent, guaranteed; pkg/scheduler/plugins/
     elasticquota/core/quota_info.go).
+
+    NOTE: a resource dimension absent from ``max`` admits nothing on that
+    dimension — pods requesting it are rejected, matching the reference's
+    quota ``LessThanOrEqual`` semantics (missing key in the bound = not
+    satisfiable). Define ``max`` for every resource your pods request.
     """
 
     name: str

@@ -118,6 +118,11 @@ class GangManager:
             record.children.discard(pod_uid)
             record.waiting.discard(pod_uid)
             record.bound.discard(pod_uid)
+        # drop the pod's schedule-cycle attempt record, otherwise stale
+        # entries wedge (or prematurely reopen) the group's cycle
+        group = self._group_of(gang_name)
+        if group is not None:
+            group.child_cycle.pop(pod_uid, None)
 
     # -- PreFilter (core.go:232-291) ---------------------------------------
 

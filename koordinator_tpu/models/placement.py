@@ -137,9 +137,7 @@ class PlacementModel:
         all-or-nothing at batch end.
         """
         gang_names = sorted(snapshot.gangs)
-        quota_names = sorted(
-            q for q in snapshot.quotas if snapshot.quotas[q].parent in (None, "root")
-        )
+        quota_names = sorted(snapshot.quotas)
         gang_index = {name: i for i, name in enumerate(gang_names)}
         quota_index = {name: i for i, name in enumerate(quota_names)}
 
@@ -157,6 +155,21 @@ class PlacementModel:
         )
         state = self.stage_nodes(node_arrays)
         batch = self.stage_pods(pod_arrays)
+
+        # a gang pod whose GangSpec hasn't been observed yet must not bind
+        # solo (the incremental path rejects it at PreFilter; the batched
+        # path hard-blocks it)
+        uid_to_pod = {pod.uid: pod for pod in snapshot.pending_pods}
+        blocked = np.array(
+            [
+                uid_to_pod[uid].gang is not None
+                and uid_to_pod[uid].gang not in gang_index
+                for uid in pod_arrays.uids
+            ],
+            dtype=bool,
+        )
+        if blocked.any():
+            batch = batch._replace(blocked=jnp.asarray(blocked))
 
         gang_state = None
         if gang_names:
@@ -210,11 +223,19 @@ class PlacementModel:
         )
 
     def _build_quota_state(self, snapshot, quota_names, quota_index, node_arrays):
-        """Lower single-level quotas to a device QuotaState: cluster total
-        from node allocatables, requests from pending + assigned pods."""
-        q = len(quota_names)
-        from koordinator_tpu.apis.types import resources_to_vector
+        """Lower the (possibly hierarchical) quota tree to a device
+        QuotaState.
 
+        Requests are static within a solve, so the exact tree runtime —
+        multi-level water-filling included — is computed once on the host
+        through GroupQuotaManager (exact-rational mode, matching the
+        device arithmetic) and shipped as the precomputed ``runtime``.
+        The device then only tracks per-quota ``used`` as pods place.
+        """
+        from koordinator_tpu.apis.types import resources_to_vector
+        from koordinator_tpu.quota.core import GroupQuotaManager
+
+        q = len(quota_names)
         mn = np.zeros((q, NUM_RESOURCES), np.int64)
         mx = np.zeros((q, NUM_RESOURCES), np.int64)
         guar = np.zeros((q, NUM_RESOURCES), np.int64)
@@ -240,7 +261,20 @@ class PlacementModel:
                 child_request[i] += vec
                 if pod.node_name is not None:
                     used[i] += vec
+
         total = node_arrays.alloc.astype(np.int64).sum(axis=0)
+        mgr = GroupQuotaManager(exact_rational=True)
+        mgr.cluster_total = total.copy()
+        for name in quota_names:
+            mgr.update_quota(snapshot.quotas[name])
+        for name, i in quota_index.items():
+            if child_request[i].any():
+                mgr.add_request(name, child_request[i])
+        runtime = np.zeros((q, NUM_RESOURCES), np.int64)
+        for name, i in quota_index.items():
+            rt = mgr.refresh_runtime(name)
+            runtime[i] = rt if rt is not None else 0
+
         return QuotaState.build(
             min=mn,
             max=mx,
@@ -250,4 +284,5 @@ class PlacementModel:
             child_request=child_request,
             used=used,
             total=total,
+            runtime=runtime,
         )

@@ -67,6 +67,8 @@ class PodBatch(NamedTuple):
     quota_id: jnp.ndarray      # [P] int32, -1 = not quota-managed
     non_preemptible: jnp.ndarray  # [P] bool
     gang_id: jnp.ndarray       # [P] int32, -1 = not gang-managed
+    blocked: jnp.ndarray       # [P] bool — host-side hard reject (e.g. a
+    #                            gang pod whose GangSpec is not yet known)
 
     @classmethod
     def build(
@@ -78,6 +80,7 @@ class PodBatch(NamedTuple):
         quota_id=None,
         non_preemptible=None,
         gang_id=None,
+        blocked=None,
     ):
         p = req.shape[0]
         return cls(
@@ -96,6 +99,7 @@ class PodBatch(NamedTuple):
             gang_id=(
                 gang_id if gang_id is not None else jnp.full(p, -1, jnp.int32)
             ),
+            blocked=(blocked if blocked is not None else jnp.zeros(p, bool)),
         )
 
 
@@ -221,14 +225,16 @@ def schedule_batch(
     if quota_state is None:
 
         def step(carry: NodeState, xs):
-            req, est, is_prod, is_ds = xs
+            req, est, is_prod, is_ds, blocked = xs
             new_state, node = place_one_pod(
-                carry, req, est, is_prod, is_ds, params, config
+                carry, req, est, is_prod, is_ds, params, config, admit=~blocked
             )
             return new_state, node
 
         final_state, assignments = jax.lax.scan(
-            step, state, (pods.req, pods.est, pods.is_prod, pods.is_daemonset)
+            step,
+            state,
+            (pods.req, pods.est, pods.is_prod, pods.is_daemonset, pods.blocked),
         )
         final_qstate = None
     else:
@@ -244,8 +250,8 @@ def schedule_batch(
 
         def step_q(carry, xs):
             node_state, qstate = carry
-            req, est, is_prod, is_ds, quota_id, non_preempt = xs
-            admit = quota_admit(qstate, runtime, quota_id, req, non_preempt)
+            req, est, is_prod, is_ds, quota_id, non_preempt, blocked = xs
+            admit = ~blocked & quota_admit(qstate, runtime, quota_id, req, non_preempt)
             new_state, node = place_one_pod(
                 node_state, req, est, is_prod, is_ds, params, config, admit=admit
             )
@@ -262,6 +268,7 @@ def schedule_batch(
                 pods.is_daemonset,
                 pods.quota_id,
                 pods.non_preemptible,
+                pods.blocked,
             ),
         )
 

@@ -26,7 +26,7 @@ the host GroupQuotaManager at PreFilter.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -71,6 +71,12 @@ class QuotaState(NamedTuple):
     used: jnp.ndarray           # [Q,R] (mutated by solve)
     np_used: jnp.ndarray        # [Q,R] non-preemptible used
     total: jnp.ndarray          # [R] cluster total minus system/default used
+    #: Optional precomputed masked runtime [Q,R]. When set (trace-time
+    #: check), the solver uses it directly instead of running the on-device
+    #: single-level water-filling — this is how hierarchical (multi-level)
+    #: quota trees are supported: the host computes the exact tree runtime
+    #: once per solve (requests are static within a solve) and ships it.
+    runtime: Optional[jnp.ndarray] = None
 
     @classmethod
     def build(
@@ -84,6 +90,7 @@ class QuotaState(NamedTuple):
         child_request=None,
         used=None,
         np_used=None,
+        runtime=None,
     ) -> "QuotaState":
         """Host-side constructor enforcing the device-path preconditions:
         values saturated at ``SATURATE`` and per-dimension weight sums
@@ -123,6 +130,14 @@ class QuotaState(NamedTuple):
             ),
             total=jnp.asarray(
                 np.minimum(np.asarray(total, dtype=np.int64), SATURATE), jnp.int32
+            ),
+            runtime=(
+                None
+                if runtime is None
+                else jnp.asarray(
+                    np.minimum(np.asarray(runtime, dtype=np.int64), SATURATE),
+                    jnp.int32,
+                )
             ),
         )
 
@@ -198,7 +213,10 @@ def water_filling_device(
 
 
 def quota_runtime(state: QuotaState) -> jnp.ndarray:
-    """[Q,R] masked runtime: water-filling then min(runtime, max)."""
+    """[Q,R] masked runtime: the precomputed tree runtime when provided,
+    else the on-device single-level water-filling + min(runtime, max)."""
+    if state.runtime is not None:
+        return state.runtime
     runtime = water_filling_device(
         state.total,
         limited_request(state),

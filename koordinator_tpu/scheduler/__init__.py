@@ -1,0 +1,19 @@
+"""The scheduling framework: extension points, cache, plugins, solver glue.
+
+TPU-native rebuild of the reference's scheduler layer (pkg/scheduler/):
+the *framework extension* architecture is preserved — plugins implement
+PreFilter/Filter/Score/Reserve/Permit/PreBind extension points behind a
+stable interface (reference: pkg/scheduler/frameworkext/interface.go) —
+but the hot math lives on the array substrate: every built-in plugin also
+exposes its batched formulation, and ``Scheduler.schedule_pending`` runs
+the whole queue through the device solver (models/placement.py) while the
+per-pod incremental path exists for parity, debugging and tiny clusters.
+"""
+
+from koordinator_tpu.scheduler.framework import (  # noqa: F401
+    CycleState,
+    Plugin,
+    SchedulingFramework,
+)
+from koordinator_tpu.scheduler.cache import SchedulerCache  # noqa: F401
+from koordinator_tpu.scheduler.scheduler import Scheduler  # noqa: F401

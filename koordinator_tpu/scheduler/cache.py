@@ -1,0 +1,118 @@
+"""Scheduler cache: the live cluster model with assume/forget semantics.
+
+Mirrors the reference's scheduler cache + loadaware podAssignCache
+(pkg/scheduler/plugins/loadaware/pod_assign_cache.go): assumed pods count
+against node resources immediately (before the API server confirms the
+bind), with their assign timestamps driving the loadaware estimation
+staleness rules. ``snapshot()`` produces the consistent typed view each
+scheduling cycle (and each batched solve) runs against.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    GangSpec,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+    ReservationSpec,
+)
+
+
+class SchedulerCache:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.nodes: Dict[str, NodeSpec] = {}
+        self.pods: Dict[str, PodSpec] = {}          # assigned (incl. assumed)
+        self.pending: Dict[str, PodSpec] = {}
+        self.assumed: Dict[str, float] = {}         # uid -> assume time
+        self.node_metrics: Dict[str, NodeMetric] = {}
+        self.gangs: Dict[str, GangSpec] = {}
+        self.quotas: Dict[str, QuotaSpec] = {}
+        self.reservations: Dict[str, ReservationSpec] = {}
+
+    # -- informer-style updates --------------------------------------------
+
+    def add_node(self, node: NodeSpec) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            self.nodes.pop(name, None)
+
+    def add_pod(self, pod: PodSpec) -> None:
+        """A pod object appeared: pending if unassigned, else running."""
+        with self._lock:
+            if pod.node_name:
+                self.pods[pod.uid] = pod
+            else:
+                self.pending[pod.uid] = pod
+
+    def remove_pod(self, uid: str) -> None:
+        with self._lock:
+            self.pods.pop(uid, None)
+            self.pending.pop(uid, None)
+            self.assumed.pop(uid, None)
+
+    def update_node_metric(self, metric: NodeMetric) -> None:
+        with self._lock:
+            self.node_metrics[metric.node_name] = metric
+
+    def update_gang(self, spec: GangSpec) -> None:
+        with self._lock:
+            self.gangs[spec.name] = spec
+
+    def update_quota(self, spec: QuotaSpec) -> None:
+        with self._lock:
+            self.quotas[spec.name] = spec
+
+    def update_reservation(self, spec: ReservationSpec) -> None:
+        with self._lock:
+            self.reservations[spec.name] = spec
+
+    # -- assume / forget (reference: scheduler cache AssumePod) -------------
+
+    def assume_pod(self, uid: str, node_name: str, now: Optional[float] = None) -> None:
+        with self._lock:
+            pod = self.pending.pop(uid, None)
+            if pod is None:
+                return
+            pod.node_name = node_name
+            pod.assign_time = now if now is not None else time.time()
+            self.pods[uid] = pod
+            self.assumed[uid] = pod.assign_time
+
+    def forget_pod(self, uid: str) -> None:
+        """Bind failed / gang rejected: back to pending."""
+        with self._lock:
+            pod = self.pods.pop(uid, None)
+            self.assumed.pop(uid, None)
+            if pod is not None:
+                pod.node_name = None
+                self.pending[pod.uid] = pod
+
+    def finish_binding(self, uid: str) -> None:
+        with self._lock:
+            self.assumed.pop(uid, None)
+
+    # -- snapshot -----------------------------------------------------------
+
+    def snapshot(self, now: Optional[float] = None) -> ClusterSnapshot:
+        with self._lock:
+            return ClusterSnapshot(
+                nodes=list(self.nodes.values()),
+                pods=list(self.pods.values()),
+                pending_pods=list(self.pending.values()),
+                node_metrics=dict(self.node_metrics),
+                gangs=dict(self.gangs),
+                quotas=dict(self.quotas),
+                reservations=list(self.reservations.values()),
+                now=now if now is not None else time.time(),
+            )

@@ -1,0 +1,210 @@
+"""Extension-point protocol and the incremental scheduling cycle.
+
+Mirrors the reference's framework-extender architecture
+(pkg/scheduler/frameworkext/framework_extender.go:167-262 overrides of
+RunPreFilterPlugins / RunFilterPluginsWithNominatedPods / RunScorePlugins /
+RunPreBindPlugins, and the transformer extension points in interface.go:
+78-97): plugins see typed snapshots and may rewrite the pod/node view
+before each phase. The per-pod cycle here is the semantics oracle for the
+batched solver and the path for one-off scheduling (tiny clusters, tests,
+debug dumps); bulk scheduling goes through models/placement.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
+
+MAX_NODE_SCORE = 100
+
+
+class CycleState(dict):
+    """Per-scheduling-cycle scratch space shared between plugins
+    (reference: framework.CycleState)."""
+
+
+class Status:
+    """Plugin status: success (None reason) or failure with a reason."""
+
+    def __init__(self, reason: Optional[str] = None, unschedulable: bool = False):
+        self.reason = reason
+        self.unschedulable = unschedulable
+
+    @property
+    def ok(self) -> bool:
+        return self.reason is None
+
+    @classmethod
+    def success(cls) -> "Status":
+        return cls()
+
+    @classmethod
+    def unschedulable_(cls, reason: str) -> "Status":
+        return cls(reason=reason, unschedulable=True)
+
+    def __repr__(self) -> str:
+        return f"Status(ok={self.ok}, reason={self.reason!r})"
+
+
+class Plugin:
+    """Base plugin. Override any subset of the extension points.
+
+    Extension points (in cycle order), mirroring the k8s framework plus
+    the koordinator transformers:
+
+    - before_pre_filter(snapshot, pod) -> bool: may mutate the cycle's
+      view (reservation restore etc.); True if anything changed
+    - pre_filter(state, snapshot, pod) -> Status: admission gates
+    - filter(state, snapshot, pod, node) -> Status: per-node feasibility
+    - score(state, snapshot, pod, node) -> int: 0..100
+    - reserve(state, snapshot, pod, node) -> Status / unreserve(...)
+    - permit(state, snapshot, pod, node) -> ("allow"|"wait"|"reject", t)
+    - pre_bind(state, snapshot, pod, node) -> Status: final mutations
+    """
+
+    name = "Plugin"
+
+    def before_pre_filter(self, state: CycleState, snapshot, pod) -> bool:
+        return False
+
+    def pre_filter(self, state: CycleState, snapshot, pod) -> Status:
+        return Status.success()
+
+    def filter(self, state: CycleState, snapshot, pod, node) -> Status:
+        return Status.success()
+
+    def score(self, state: CycleState, snapshot, pod, node) -> int:
+        return 0
+
+    def score_weight(self) -> int:
+        return 1
+
+    def reserve(self, state: CycleState, snapshot, pod, node) -> Status:
+        return Status.success()
+
+    def unreserve(self, state: CycleState, snapshot, pod, node) -> None:
+        pass
+
+    def permit(self, state: CycleState, snapshot, pod, node) -> Tuple[str, float]:
+        return ("allow", 0.0)
+
+    def pre_bind(self, state: CycleState, snapshot, pod, node) -> Status:
+        return Status.success()
+
+    def post_filter(self, state: CycleState, snapshot, pod) -> None:
+        """Called when every node was filtered out (failure fan-out)."""
+
+
+@dataclasses.dataclass
+class ScheduleOutcome:
+    pod_uid: str
+    node: Optional[str]
+    status: str                  # bound | waiting | unschedulable | error
+    reason: str = ""
+    scores: Optional[Dict[str, int]] = None  # populated when debug enabled
+
+
+class SchedulingFramework:
+    """Runs one pod through the full plugin chain (SURVEY.md §3.1)."""
+
+    def __init__(self, plugins: Sequence[Plugin], monitor=None, debug=None):
+        self.plugins = list(plugins)
+        self.monitor = monitor
+        self.debug = debug
+
+    def schedule_one(
+        self, snapshot: ClusterSnapshot, pod: PodSpec
+    ) -> ScheduleOutcome:
+        started = time.monotonic()
+        if self.monitor is not None:
+            self.monitor.cycle_started(pod.uid, started)
+        try:
+            return self._schedule_one(snapshot, pod)
+        finally:
+            if self.monitor is not None:
+                self.monitor.cycle_finished(pod.uid, time.monotonic() - started)
+
+    def _schedule_one(self, snapshot, pod) -> ScheduleOutcome:
+        state = CycleState()
+
+        for plugin in self.plugins:
+            plugin.before_pre_filter(state, snapshot, pod)
+        for plugin in self.plugins:
+            status = plugin.pre_filter(state, snapshot, pod)
+            if not status.ok:
+                return ScheduleOutcome(
+                    pod.uid, None, "unschedulable", f"{plugin.name}: {status.reason}"
+                )
+
+        feasible: List[NodeSpec] = []
+        for node in snapshot.nodes:
+            if node.unschedulable:
+                continue
+            ok = True
+            for plugin in self.plugins:
+                status = plugin.filter(state, snapshot, pod, node)
+                if not status.ok:
+                    if self.debug is not None:
+                        self.debug.record_filter(pod.uid, node.name, plugin.name, status)
+                    ok = False
+                    break
+            if ok:
+                feasible.append(node)
+        if not feasible:
+            for plugin in self.plugins:
+                plugin.post_filter(state, snapshot, pod)
+            return ScheduleOutcome(pod.uid, None, "unschedulable", "no feasible node")
+
+        best_node, best_score = None, -1
+        all_scores: Dict[str, int] = {}
+        for node in feasible:
+            total = 0
+            for plugin in self.plugins:
+                total += plugin.score_weight() * plugin.score(state, snapshot, pod, node)
+            all_scores[node.name] = total
+            if total > best_score:
+                best_node, best_score = node, total
+        if self.debug is not None:
+            self.debug.record_scores(pod.uid, all_scores)
+
+        for i, plugin in enumerate(self.plugins):
+            status = plugin.reserve(state, snapshot, pod, best_node)
+            if not status.ok:
+                # unreserve ALL plugins including the failing one (the k8s
+                # framework contract: a failing Reserve may have partially
+                # mutated state)
+                for done in self.plugins[: i + 1]:
+                    done.unreserve(state, snapshot, pod, best_node)
+                return ScheduleOutcome(
+                    pod.uid, None, "unschedulable", f"{plugin.name}: {status.reason}"
+                )
+
+        for plugin in self.plugins:
+            verdict, _wait = plugin.permit(state, snapshot, pod, best_node)
+            if verdict == "wait":
+                return ScheduleOutcome(pod.uid, best_node.name, "waiting")
+            if verdict == "reject":
+                for done in self.plugins:
+                    done.unreserve(state, snapshot, pod, best_node)
+                return ScheduleOutcome(
+                    pod.uid, None, "unschedulable", f"{plugin.name}: permit rejected"
+                )
+
+        for plugin in self.plugins:
+            status = plugin.pre_bind(state, snapshot, pod, best_node)
+            if not status.ok:
+                for done in self.plugins:
+                    done.unreserve(state, snapshot, pod, best_node)
+                return ScheduleOutcome(
+                    pod.uid, None, "error", f"{plugin.name}: {status.reason}"
+                )
+
+        return ScheduleOutcome(
+            pod.uid,
+            best_node.name,
+            "bound",
+            scores=all_scores if self.debug is not None else None,
+        )

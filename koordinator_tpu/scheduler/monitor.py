@@ -1,0 +1,87 @@
+"""Scheduler monitor + debug services.
+
+- SchedulerMonitor: flags slow/stuck scheduling cycles (reference:
+  pkg/scheduler/frameworkext/scheduler_monitor.go:44-103).
+- DebugRecorder: runtime-togglable score/filter dumps (reference:
+  pkg/scheduler/frameworkext/debug.go and the /debug/flags HTTP toggles).
+- DebugServices: per-plugin debug endpoints as plain dict payloads
+  (reference: frameworkext/services/services.go — there gin HTTP, here an
+  in-process registry any HTTP layer can front).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class SchedulerMonitor:
+    def __init__(self, timeout_seconds: float = 10.0, log=print):
+        self.timeout = timeout_seconds
+        self.log = log
+        self._lock = threading.Lock()
+        self._active: Dict[str, float] = {}
+        self.slow_cycles: List[Dict] = []
+
+    def cycle_started(self, pod_uid: str, at: Optional[float] = None) -> None:
+        with self._lock:
+            self._active[pod_uid] = at if at is not None else time.monotonic()
+
+    def cycle_finished(self, pod_uid: str, duration: float) -> None:
+        with self._lock:
+            self._active.pop(pod_uid, None)
+            if duration > self.timeout:
+                record = {"pod": pod_uid, "duration_s": duration}
+                self.slow_cycles.append(record)
+                self.log(f"scheduler monitor: slow cycle {record}")
+
+    def check_stuck(self) -> List[str]:
+        """Pods whose cycle has been running past the timeout right now."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                uid for uid, t0 in self._active.items() if now - t0 > self.timeout
+            ]
+
+
+class DebugRecorder:
+    """Score/filter dump collection, toggled at runtime."""
+
+    def __init__(self) -> None:
+        self.dump_scores = False
+        self.dump_filters = False
+        self.scores: List[Dict] = []
+        self.filters: List[Dict] = []
+
+    def record_scores(self, pod_uid: str, scores: Dict[str, int]) -> None:
+        if self.dump_scores:
+            self.scores.append({"pod": pod_uid, "scores": dict(scores)})
+
+    def record_filter(self, pod_uid: str, node: str, plugin: str, status) -> None:
+        if self.dump_filters:
+            self.filters.append(
+                {
+                    "pod": pod_uid,
+                    "node": node,
+                    "plugin": plugin,
+                    "reason": status.reason,
+                }
+            )
+
+
+class DebugServices:
+    """Named debug endpoints: plugins register callables returning dicts."""
+
+    def __init__(self) -> None:
+        self._services: Dict[str, Callable[[], Dict]] = {}
+
+    def register(self, plugin_name: str, fn: Callable[[], Dict]) -> None:
+        self._services[plugin_name] = fn
+
+    def query(self, plugin_name: str) -> Optional[Dict]:
+        fn = self._services.get(plugin_name)
+        return fn() if fn is not None else None
+
+    def names(self) -> List[str]:
+        return sorted(self._services)

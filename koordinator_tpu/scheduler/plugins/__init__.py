@@ -1,0 +1,13 @@
+"""Built-in scheduler plugins (the reference's seven, rebuilt).
+
+Each plugin implements the host extension points (framework.py) for the
+incremental path; the hot math delegates to the same canonical-unit
+functions the batched solver uses, so the two paths can't drift.
+"""
+
+from koordinator_tpu.scheduler.plugins.fit import NodeResourcesFit  # noqa: F401
+from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareScheduling  # noqa: F401
+from koordinator_tpu.scheduler.plugins.elasticquota import ElasticQuotaPlugin  # noqa: F401
+from koordinator_tpu.scheduler.plugins.coscheduling import CoschedulingPlugin  # noqa: F401
+from koordinator_tpu.scheduler.plugins.reservation import ReservationPlugin  # noqa: F401
+from koordinator_tpu.scheduler.plugins.defaultprebind import DefaultPreBind  # noqa: F401

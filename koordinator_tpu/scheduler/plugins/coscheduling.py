@@ -1,0 +1,47 @@
+"""Coscheduling plugin (incremental path): wraps the GangManager state
+machine (gang/manager.py; SURVEY.md A.5)."""
+
+from __future__ import annotations
+
+from koordinator_tpu.gang.manager import GangManager, PermitResult
+from koordinator_tpu.scheduler.framework import CycleState, Plugin, Status
+
+
+class CoschedulingPlugin(Plugin):
+    name = "Coscheduling"
+
+    def __init__(self, manager: GangManager, on_release=None):
+        self.manager = manager
+        self.on_release = on_release
+
+    def score_weight(self) -> int:
+        return 0
+
+    def pre_filter(self, state: CycleState, snapshot, pod) -> Status:
+        reason = self.manager.pre_filter(pod.uid)
+        if reason is None:
+            return Status.success()
+        return Status.unschedulable_(reason)
+
+    def permit(self, state: CycleState, snapshot, pod, node):
+        result, wait = self.manager.permit(pod.uid)
+        if result == PermitResult.ALLOW:
+            released = self.manager.allow_gang_group(
+                self.manager.pod_gang.get(pod.uid, "")
+            )
+            if self.on_release is not None:
+                # siblings that were waiting at the barrier are bindable now
+                self.on_release([u for u in released if u != pod.uid])
+            return ("allow", 0.0)
+        if result == PermitResult.WAIT:
+            return ("wait", wait)
+        return ("allow", 0.0)
+
+    def unreserve(self, state: CycleState, snapshot, pod, node) -> None:
+        self.manager.unreserve(pod.uid)
+
+    def post_filter(self, state: CycleState, snapshot, pod) -> None:
+        # a member failed filtering entirely: strict gangs reject the group
+        gang = self.manager.pod_gang.get(pod.uid)
+        if gang is not None:
+            self.manager.unreserve(pod.uid)

@@ -1,0 +1,33 @@
+"""DefaultPreBind: applies accumulated object patches once at PreBind.
+
+Reference: pkg/scheduler/plugins/defaultprebind/plugin.go — plugins queue
+mutations during the cycle; this plugin materializes them in one place
+(annotations on the pod, allocation onto the reservation object).
+"""
+
+from __future__ import annotations
+
+import json
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_RESERVATION_ALLOCATED,
+    ANNOTATION_RESOURCE_STATUS,
+)
+from koordinator_tpu.scheduler.framework import CycleState, Plugin, Status
+
+
+class DefaultPreBind(Plugin):
+    name = "DefaultPreBind"
+
+    def score_weight(self) -> int:
+        return 0
+
+    def pre_bind(self, state: CycleState, snapshot, pod, node) -> Status:
+        if state.get("reservation_allocated"):
+            pod.annotations[ANNOTATION_RESERVATION_ALLOCATED] = state[
+                "reservation_allocated"
+            ]
+        status = state.get("resource_status")
+        if status:
+            pod.annotations[ANNOTATION_RESOURCE_STATUS] = json.dumps(status)
+        return Status.success()

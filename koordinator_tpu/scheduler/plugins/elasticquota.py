@@ -1,0 +1,78 @@
+"""ElasticQuota plugin (incremental path): PreFilter admission + accounting.
+
+Wraps the host GroupQuotaManager (quota/core.py; SURVEY.md A.3). Pod
+requests register at pod creation via ``on_pod_add``; Reserve moves used.
+"""
+
+from __future__ import annotations
+
+from koordinator_tpu.apis.types import resources_to_vector
+from koordinator_tpu.quota.core import GroupQuotaManager
+from koordinator_tpu.scheduler.framework import CycleState, Plugin, Status
+
+
+class ElasticQuotaPlugin(Plugin):
+    name = "ElasticQuota"
+
+    def __init__(
+        self,
+        manager: GroupQuotaManager,
+        enable_runtime_quota: bool = True,
+        enable_check_parent: bool = False,
+    ):
+        self.manager = manager
+        self.enable_runtime_quota = enable_runtime_quota
+        self.enable_check_parent = enable_check_parent
+
+    def score_weight(self) -> int:
+        return 0
+
+    # informer events ------------------------------------------------------
+
+    def on_pod_add(self, pod) -> None:
+        if pod.quota:
+            self.manager.add_request(
+                pod.quota,
+                resources_to_vector(pod.requests),
+                non_preemptible=not pod.preemptible,
+            )
+
+    def on_pod_delete(self, pod) -> None:
+        if pod.quota:
+            self.manager.add_request(
+                pod.quota,
+                -resources_to_vector(pod.requests),
+                non_preemptible=not pod.preemptible,
+            )
+
+    # cycle ----------------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, snapshot, pod) -> Status:
+        if not pod.quota:
+            return Status.success()
+        ok = self.manager.can_admit(
+            pod.quota,
+            resources_to_vector(pod.requests),
+            non_preemptible=not pod.preemptible,
+            check_parents=self.enable_check_parent,
+        )
+        if ok:
+            return Status.success()
+        return Status.unschedulable_(f"insufficient quota {pod.quota}")
+
+    def reserve(self, state: CycleState, snapshot, pod, node) -> Status:
+        if pod.quota:
+            self.manager.add_used(
+                pod.quota,
+                resources_to_vector(pod.requests),
+                non_preemptible=not pod.preemptible,
+            )
+        return Status.success()
+
+    def unreserve(self, state: CycleState, snapshot, pod, node) -> None:
+        if pod.quota:
+            self.manager.add_used(
+                pod.quota,
+                -resources_to_vector(pod.requests),
+                non_preemptible=not pod.preemptible,
+            )

@@ -1,0 +1,46 @@
+"""NodeResourcesFit: resource fit + LeastAllocated scoring (incremental path).
+
+Host counterpart of ops/fit.py (SURVEY.md A.6). Node requested totals are
+computed once per snapshot through the same lowering as the batched path.
+"""
+
+from __future__ import annotations
+
+from koordinator_tpu.apis.types import resources_to_vector
+from koordinator_tpu.oracle.scheduler import (
+    fit_filter_node,
+    least_allocated_score_node,
+)
+from koordinator_tpu.scheduler.framework import CycleState, Plugin, Status
+from koordinator_tpu.scheduler.plugins.lowering import node_view
+
+
+class NodeResourcesFit(Plugin):
+    name = "NodeResourcesFit"
+
+    def __init__(self, weights=None, weight: int = 1):
+        from koordinator_tpu.state.cluster import DEFAULT_RESOURCE_WEIGHTS
+
+        self.weights = resources_to_vector(weights or DEFAULT_RESOURCE_WEIGHTS)
+        self.weight = weight
+
+    def score_weight(self) -> int:
+        return self.weight
+
+    def filter(self, state: CycleState, snapshot, pod, node) -> Status:
+        view = node_view(state, snapshot)
+        i = view.index[node.name]
+        req = resources_to_vector(pod.requests)
+        used = view.arrays.used_req[i] + view.extra_used.get(node.name, 0)
+        if fit_filter_node(req, view.arrays.alloc[i], used):
+            return Status.success()
+        return Status.unschedulable_("insufficient resources")
+
+    def score(self, state: CycleState, snapshot, pod, node) -> int:
+        view = node_view(state, snapshot)
+        i = view.index[node.name]
+        req = resources_to_vector(pod.requests)
+        used = view.arrays.used_req[i] + view.extra_used.get(node.name, 0)
+        return least_allocated_score_node(
+            req, view.arrays.alloc[i], used, self.weights
+        )

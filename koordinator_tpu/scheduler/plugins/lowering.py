@@ -1,0 +1,36 @@
+"""Shared per-cycle lowering cache for the incremental path.
+
+Plugins need the same canonical arrays the batched solver uses
+(allocatable, requested, usage, estimation corrections). They are lowered
+once per snapshot and cached in the CycleState; reservation restore and
+in-cycle reserves adjust a per-node ``extra_used`` delta on top.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+from koordinator_tpu.state.cluster import NodeArrays, lower_nodes
+
+_VIEW_KEY = "__node_view__"
+
+
+@dataclasses.dataclass
+class NodeView:
+    arrays: NodeArrays
+    index: Dict[str, int]
+    #: per-node adjustment applied by reservation restore / in-cycle
+    #: reserves, added to arrays.used_req (numpy [R] vectors)
+    extra_used: Dict[str, np.ndarray]
+
+
+def node_view(state, snapshot) -> NodeView:
+    view = state.get(_VIEW_KEY)
+    if view is None or view.arrays.n != len(snapshot.nodes):
+        arrays = lower_nodes(snapshot)
+        view = NodeView(arrays=arrays, index=arrays.index(), extra_used={})
+        state[_VIEW_KEY] = view
+    return view

@@ -1,0 +1,127 @@
+"""Reservation plugin (incremental path): restore, match, allocate.
+
+Reference semantics (pkg/scheduler/plugins/reservation/transformer.go:
+restoreMatchedReservation / restoreUnmatchedReservations): an Available
+reservation holds its unallocated remainder ``(allocatable - allocated)+``
+on its node; pods consuming it are accounted individually. This substrate
+encodes exactly that net view at lowering time (state/cluster.py adds the
+remainder hold into ``used_req``), so:
+
+- unmatched pods see the remainder as occupied — nothing to do;
+- matched pods get the remainder *credited back* for Filter/Score
+  (the reservation's free capacity is available to them);
+- Reserve allocates the pod onto the matched reservation with the most
+  free capacity on the chosen node (deterministic lowest-index
+  tie-break; the reference nominates by reservation score — documented
+  deviation: the choice among matched reservations on one node differs
+  only in which reservation is consumed first).
+
+Owner matching is by label subset (``owner_labels ⊆ pod.labels``), the
+typed analogue of the reference's owner selectors.
+
+Coverage note: the remainder *hold* is encoded in the lowering and thus
+seen by both the incremental and the batched solver; the per-pod matched
+*credit* currently applies on the incremental path only — the device
+scan's per-pod credit (match matrix + reservation carry) is a planned
+extension of ops/binpack.py. Until then, batched solves treat reserved
+capacity as occupied for everyone (safe: never over-commits, may
+under-place owner pods that need reserved capacity).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from koordinator_tpu.apis.types import (
+    PodSpec,
+    ReservationSpec,
+    ReservationState,
+    resources_to_vector,
+    vector_to_resources,
+)
+from koordinator_tpu.scheduler.framework import CycleState, Plugin, Status
+from koordinator_tpu.scheduler.plugins.lowering import node_view
+
+_MATCH_KEY = "__resv_matched__"
+
+
+def reservation_matches_pod(resv: ReservationSpec, pod: PodSpec) -> bool:
+    """Owner match: every owner label must be present on the pod."""
+    if resv.state != ReservationState.AVAILABLE or resv.node_name is None:
+        return False
+    if not resv.owner_labels:
+        return False
+    return all(pod.labels.get(k) == v for k, v in resv.owner_labels.items())
+
+
+def reservation_free(resv: ReservationSpec) -> np.ndarray:
+    alloc = resources_to_vector(resv.allocatable or resv.requests)
+    used = resources_to_vector(resv.allocated)
+    return np.maximum(alloc - used, 0)
+
+
+class ReservationPlugin(Plugin):
+    name = "Reservation"
+
+    def before_pre_filter(self, state: CycleState, snapshot, pod) -> bool:
+        """Credit matched reservations' free remainder back to their nodes
+        for this pod's cycle (the BeforePreFilter restore)."""
+        view = node_view(state, snapshot)
+        matched: Dict[str, List[ReservationSpec]] = {}
+        changed = False
+        for resv in snapshot.reservations:
+            if not reservation_matches_pod(resv, pod):
+                continue
+            free = reservation_free(resv)
+            if not free.any():
+                continue
+            matched.setdefault(resv.node_name, []).append(resv)
+            extra = view.extra_used.setdefault(
+                resv.node_name, np.zeros_like(free)
+            )
+            view.extra_used[resv.node_name] = extra - free
+            changed = True
+        state[_MATCH_KEY] = matched
+        return changed
+
+    def reserve(self, state: CycleState, snapshot, pod, node) -> Status:
+        matched = state.get(_MATCH_KEY, {}).get(node.name, [])
+        if not matched:
+            return Status.success()
+        # most free capacity wins; ties -> first in snapshot order
+        best = max(matched, key=lambda r: int(reservation_free(r).sum()))
+        req = resources_to_vector(pod.requests)
+        alloc_vec = resources_to_vector(best.allocatable or best.requests)
+        old_allocated = resources_to_vector(best.allocated)
+        new_allocated = np.minimum(old_allocated + req, alloc_vec)
+        best.allocated = vector_to_resources(new_allocated)
+        best.owner_pod_uids.append(pod.uid)
+        if best.allocate_once:
+            best.state = ReservationState.SUCCEEDED
+        state["reservation_allocated"] = best.name
+        # remember the clamped delta actually added — unreserve must subtract
+        # exactly this, not the raw request
+        state["reservation_allocated_delta"] = new_allocated - old_allocated
+        return Status.success()
+
+    def unreserve(self, state: CycleState, snapshot, pod, node) -> None:
+        name = state.get("reservation_allocated")
+        if not name:
+            return
+        delta = state.get("reservation_allocated_delta")
+        for resv in snapshot.reservations:
+            if resv.name == name:
+                sub = (
+                    delta
+                    if delta is not None
+                    else resources_to_vector(pod.requests)
+                )
+                cur = resources_to_vector(resv.allocated)
+                resv.allocated = vector_to_resources(np.maximum(cur - sub, 0))
+                if pod.uid in resv.owner_pod_uids:
+                    resv.owner_pod_uids.remove(pod.uid)
+                if resv.state == ReservationState.SUCCEEDED and resv.allocate_once:
+                    resv.state = ReservationState.AVAILABLE
+                break

@@ -1,0 +1,238 @@
+"""The scheduler: cache + plugin framework + the TPU placement backend.
+
+The reference wires koordinator plugins into the k8s scheduling framework
+and schedules pod-at-a-time (cmd/koord-scheduler/app/server.go). Here the
+same plugin architecture exists, but the default backend is the batched
+device solver — the ``--placement-backend=jax-tpu`` north star: every
+scheduling round takes a consistent snapshot, solves the entire pending
+queue on device, and commits the results through assume/forget.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from koordinator_tpu.apis.types import (
+    GangSpec,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+    ReservationSpec,
+)
+from koordinator_tpu.gang.manager import GangManager
+from koordinator_tpu.models.placement import PlacementModel, ScheduleResult
+from koordinator_tpu.quota.core import GroupQuotaManager
+from koordinator_tpu.scheduler.cache import SchedulerCache
+from koordinator_tpu.scheduler.framework import (
+    ScheduleOutcome,
+    SchedulingFramework,
+)
+from koordinator_tpu.scheduler.monitor import (
+    DebugRecorder,
+    DebugServices,
+    SchedulerMonitor,
+)
+from koordinator_tpu.scheduler.plugins import (
+    CoschedulingPlugin,
+    DefaultPreBind,
+    ElasticQuotaPlugin,
+    LoadAwareScheduling,
+    NodeResourcesFit,
+    ReservationPlugin,
+)
+
+
+class Scheduler:
+    """Top-level scheduler with both backends.
+
+    - ``schedule_pending()``: the batched device path (default) — one
+      solve over the whole queue, assignments assumed into the cache.
+    - ``schedule_one(uid)``: the incremental plugin-chain path (parity,
+      debugging, one-off placements).
+    """
+
+    def __init__(
+        self,
+        model: Optional[PlacementModel] = None,
+        cluster_total=None,
+    ):
+        self.cache = SchedulerCache()
+        self.quota_manager = GroupQuotaManager(cluster_total=cluster_total or {})
+        self.gang_manager = GangManager()
+        self.model = model or PlacementModel()
+        self.monitor = SchedulerMonitor()
+        self.debug = DebugRecorder()
+        self.services = DebugServices()
+        #: pods placed at the Permit barrier: uid -> held node. They hold
+        #: resources (assumed) but are not bound until their gang group
+        #: completes.
+        self._waiting: Dict[str, str] = {}
+
+        self._quota_plugin = ElasticQuotaPlugin(self.quota_manager)
+        self._coscheduling = CoschedulingPlugin(
+            self.gang_manager, on_release=self._on_gang_release
+        )
+        self.framework = SchedulingFramework(
+            plugins=[
+                ReservationPlugin(),
+                self._coscheduling,
+                self._quota_plugin,
+                NodeResourcesFit(),
+                LoadAwareScheduling(),
+                DefaultPreBind(),
+            ],
+            monitor=self.monitor,
+            debug=self.debug,
+        )
+        self.services.register(
+            "Coscheduling",
+            lambda: {
+                name: {
+                    "min_member": rec.spec.min_member,
+                    "waiting": sorted(rec.waiting),
+                    "bound": sorted(rec.bound),
+                    "once_satisfied": rec.once_satisfied,
+                }
+                for name, rec in self.gang_manager.gangs.items()
+            },
+        )
+        self.services.register(
+            "ElasticQuota",
+            lambda: {
+                name: {
+                    "request": info.request.tolist(),
+                    "used": info.used.tolist(),
+                    "runtime": info.runtime.tolist(),
+                }
+                for name, info in self.quota_manager.quotas.items()
+            },
+        )
+
+    # -- informer-style event intake ---------------------------------------
+
+    def add_node(self, node: NodeSpec) -> None:
+        self.cache.add_node(node)
+
+    def update_node_metric(self, metric: NodeMetric) -> None:
+        self.cache.update_node_metric(metric)
+
+    def update_gang(self, spec: GangSpec) -> None:
+        self.cache.update_gang(spec)
+        self.gang_manager.update_gang(spec)
+
+    def update_quota(self, spec: QuotaSpec) -> None:
+        self.cache.update_quota(spec)
+        self.quota_manager.update_quota(spec)
+
+    def update_reservation(self, spec: ReservationSpec) -> None:
+        self.cache.update_reservation(spec)
+
+    def add_pod(self, pod: PodSpec) -> None:
+        self.cache.add_pod(pod)
+        if pod.gang:
+            self.gang_manager.on_pod_add(pod.uid, pod.gang)
+        self._quota_plugin.on_pod_add(pod)
+
+    def remove_pod(self, pod: PodSpec) -> None:
+        cached = self.cache.pods.get(pod.uid)
+        was_assigned = cached is not None and cached.node_name is not None
+        self.cache.remove_pod(pod.uid)
+        self.gang_manager.on_pod_delete(pod.uid)
+        self._quota_plugin.on_pod_delete(pod)
+        if was_assigned and cached.quota:
+            # an assigned pod's quota 'used' was accounted at bind time and
+            # must be released with it
+            from koordinator_tpu.apis.types import resources_to_vector
+
+            self.quota_manager.add_used(
+                cached.quota,
+                -resources_to_vector(cached.requests),
+                non_preemptible=not cached.preemptible,
+            )
+        self._waiting.pop(pod.uid, None)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def schedule_pending(self, now: Optional[float] = None) -> ScheduleResult:
+        """One batched round: solve the whole pending queue on device and
+        assume committed placements (and waiting holds) into the cache."""
+        snapshot = self.cache.snapshot(now=now)
+        pending = {pod.uid: pod for pod in snapshot.pending_pods}
+        result = self.model.schedule(snapshot)
+        at = now if now is not None else time.time()
+        for uid, node in result.items():
+            if node is not None:
+                self.cache.assume_pod(uid, node, now=at)
+                self.gang_manager.on_pod_bound(uid)
+                pod = pending.get(uid)
+                if pod is not None and pod.quota:
+                    # keep the host quota manager's used in sync with the
+                    # device solve (the solve derives used from the
+                    # snapshot; observers read the manager)
+                    from koordinator_tpu.apis.types import resources_to_vector
+
+                    self.quota_manager.add_used(
+                        pod.quota,
+                        resources_to_vector(pod.requests),
+                        non_preemptible=not pod.preemptible,
+                    )
+        for uid, node in result.waiting.items():
+            # waiting gang members hold their node but are not bound
+            self.cache.assume_pod(uid, node, now=at)
+            self._waiting[uid] = node
+        self._resolve_waiting(result)
+        return result
+
+    def _resolve_waiting(self, result: ScheduleResult) -> None:
+        """Open the Permit barrier for previously-waiting pods whose gang
+        group is now satisfied: report them as committed placements."""
+        if not self._waiting:
+            return
+        assigned_count: Dict[str, int] = {}
+        for pod in self.cache.pods.values():
+            if pod.gang and pod.node_name is not None:
+                assigned_count[pod.gang] = assigned_count.get(pod.gang, 0) + 1
+
+        def group_of(gang_name: str) -> List[str]:
+            spec = self.cache.gangs.get(gang_name)
+            if spec is None or not spec.gang_group:
+                return [gang_name]
+            return list(spec.gang_group)
+
+        for uid, node in list(self._waiting.items()):
+            pod = self.cache.pods.get(uid)
+            if pod is None or pod.gang is None:
+                self._waiting.pop(uid, None)
+                continue
+            satisfied = all(
+                assigned_count.get(g, 0)
+                >= (self.cache.gangs[g].min_member if g in self.cache.gangs else 1)
+                for g in group_of(pod.gang)
+            )
+            if satisfied:
+                self._waiting.pop(uid)
+                result.waiting.pop(uid, None)
+                result[uid] = node
+                self.cache.finish_binding(uid)
+                self.gang_manager.on_pod_bound(uid)
+
+    def _on_gang_release(self, uids: List[str]) -> None:
+        """Incremental path: the Permit barrier opened — waiting siblings
+        become bindable."""
+        for uid in uids:
+            self.cache.finish_binding(uid)
+            self._waiting.pop(uid, None)
+
+    def schedule_one(self, pod_uid: str, now: Optional[float] = None) -> ScheduleOutcome:
+        snapshot = self.cache.snapshot(now=now)
+        pod = self.cache.pending.get(pod_uid)
+        if pod is None:
+            return ScheduleOutcome(pod_uid, None, "error", "pod not pending")
+        outcome = self.framework.schedule_one(snapshot, pod)
+        if outcome.status in ("bound", "waiting") and outcome.node:
+            self.cache.assume_pod(pod_uid, outcome.node, now=now)
+            if outcome.status == "bound":
+                self.gang_manager.on_pod_bound(pod_uid)
+        return outcome

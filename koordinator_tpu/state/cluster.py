@@ -221,6 +221,19 @@ def lower_nodes(
         used_req[index[pod.node_name]] += resources_to_vector(pod.requests)
         assigned_by_node.setdefault(pod.node_name, []).append(pod)
 
+    # Available reservations hold their unallocated remainder on the node
+    # (the net view of the reference's fake reserve pod + restore chain;
+    # see scheduler/plugins/reservation.py). Matched pods get this credited
+    # back per cycle / per scan step.
+    for resv in snapshot.reservations:
+        if (
+            getattr(resv.state, "value", resv.state) == "Available"
+            and resv.node_name in index
+        ):
+            alloc_vec = resources_to_vector(resv.allocatable or resv.requests)
+            used_vec = resources_to_vector(resv.allocated)
+            used_req[index[resv.node_name]] += np.maximum(alloc_vec - used_vec, 0)
+
     # metrics + estimation correction
     for name, metric in snapshot.node_metrics.items():
         if name not in index:

@@ -1,0 +1,153 @@
+"""Scheduler framework tests: batch + incremental paths, reservations,
+monitor/debug services."""
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import (
+    GangSpec,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+    ReservationSpec,
+    ReservationState,
+)
+from koordinator_tpu.scheduler import Scheduler
+
+
+def _mk_scheduler(n_nodes=3, cpu=16000, mem=32768):
+    s = Scheduler(cluster_total={R.CPU: n_nodes * cpu, R.MEMORY: n_nodes * mem})
+    for i in range(n_nodes):
+        s.add_node(
+            NodeSpec(name=f"n{i}", allocatable={R.CPU: cpu, R.MEMORY: mem})
+        )
+        s.update_node_metric(
+            NodeMetric(
+                node_name=f"n{i}", node_usage={R.CPU: 500}, update_time=99.0
+            )
+        )
+    return s
+
+
+def test_batched_round_commits_and_next_round_sees_state():
+    s = _mk_scheduler(2)
+    for i in range(2):
+        s.add_pod(PodSpec(name=f"p{i}", requests={R.CPU: 6000, R.MEMORY: 4096}))
+    out = s.schedule_pending(now=100.0)
+    assert all(v is not None for v in out.values())
+    # spreading: least-allocated puts them on different nodes
+    assert len(set(out.values())) == 2
+
+    # second round: a big pod that only fits because it sees prior commits
+    s.add_pod(PodSpec(name="big", requests={R.CPU: 10000}))
+    out2 = s.schedule_pending(now=101.0)
+    assert out2["default/big"] is not None
+    # third round: nothing pending
+    assert s.schedule_pending(now=102.0) == {}
+
+
+def test_incremental_path_binds():
+    s = _mk_scheduler(3)
+    s.add_pod(PodSpec(name="a", requests={R.CPU: 2000}))
+    outcome = s.schedule_one("default/a", now=100.0)
+    assert outcome.status == "bound" and outcome.node is not None
+    assert "default/a" in s.cache.pods
+
+
+def test_incremental_gang_waits_then_allows():
+    s = _mk_scheduler(3)
+    s.update_gang(GangSpec(name="g", min_member=2))
+    s.add_pod(PodSpec(name="g0", gang="g", requests={R.CPU: 1000}))
+    s.add_pod(PodSpec(name="g1", gang="g", requests={R.CPU: 1000}))
+    o0 = s.schedule_one("default/g0", now=100.0)
+    assert o0.status == "waiting"  # permit barrier
+    o1 = s.schedule_one("default/g1", now=100.0)
+    assert o1.status == "bound"
+
+
+def test_reservation_held_for_owner():
+    s = _mk_scheduler(1, cpu=10000)
+    # reservation holds 8 cores for team=ml pods on the single node
+    s.update_reservation(
+        ReservationSpec(
+            name="resv",
+            requests={R.CPU: 8000},
+            allocatable={R.CPU: 8000},
+            owner_labels={"team": "ml"},
+            node_name="n0",
+            state=ReservationState.AVAILABLE,
+        )
+    )
+    # a non-owner pod asking 4 cores: only 2 cores unreserved -> unschedulable
+    s.add_pod(PodSpec(name="other", requests={R.CPU: 4000}))
+    out = s.schedule_pending(now=100.0)
+    assert out["default/other"] is None
+
+    # an owner pod asking 4 cores gets the reserved capacity
+    s.add_pod(PodSpec(name="mlpod", requests={R.CPU: 4000}, labels={"team": "ml"}))
+    outcome = s.schedule_one("default/mlpod", now=100.0)
+    assert outcome.status == "bound" and outcome.node == "n0"
+    # allocation recorded on the reservation
+    resv = s.cache.reservations["resv"]
+    assert resv.allocated.get(R.CPU) == 4000
+    import koordinator_tpu.apis.extension as ext
+
+    pod = s.cache.pods["default/mlpod"]
+    assert pod.annotations.get(ext.ANNOTATION_RESERVATION_ALLOCATED) == "resv"
+
+
+def test_quota_gates_incremental_path():
+    s = _mk_scheduler(2)
+    s.update_quota(QuotaSpec(name="t", min={R.CPU: 1000}, max={R.CPU: 3000}))
+    s.add_pod(PodSpec(name="a", quota="t", requests={R.CPU: 3000}))
+    s.add_pod(PodSpec(name="b", quota="t", requests={R.CPU: 1000}))
+    assert s.schedule_one("default/a", now=100.0).status == "bound"
+    out_b = s.schedule_one("default/b", now=100.0)
+    assert out_b.status == "unschedulable"
+    assert "quota" in out_b.reason
+
+
+def test_monitor_and_debug_services():
+    s = _mk_scheduler(1)
+    s.debug.dump_scores = True
+    s.add_pod(PodSpec(name="a", requests={R.CPU: 1000}))
+    s.schedule_one("default/a", now=100.0)
+    assert s.debug.scores and "n0" in s.debug.scores[0]["scores"]
+    assert "Coscheduling" in s.services.names()
+    # only the implicit root exists before any quota is registered
+    assert list(s.services.query("ElasticQuota")) == ["root"]
+    s.monitor.cycle_finished("x", duration=99.0)
+    assert s.monitor.slow_cycles[0]["pod"] == "x"
+
+
+def test_batch_and_incremental_agree():
+    def build():
+        s = _mk_scheduler(4)
+        rng = np.random.default_rng(3)
+        for i in range(12):
+            s.add_pod(
+                PodSpec(
+                    name=f"p{i}",
+                    priority=int(rng.choice([9500, 5500])),
+                    requests={
+                        R.CPU: int(rng.choice([1000, 2000, 4000])),
+                        R.MEMORY: int(rng.choice([1024, 4096])),
+                    },
+                )
+            )
+        return s
+
+    s_batch = build()
+    batch_out = dict(s_batch.schedule_pending(now=100.0))
+
+    s_inc = build()
+    from koordinator_tpu.state.cluster import schedule_order
+
+    pending = list(s_inc.cache.pending.values())
+    inc_out = {}
+    for i in schedule_order(pending):
+        uid = pending[i].uid
+        outcome = s_inc.schedule_one(uid, now=100.0)
+        inc_out[uid] = outcome.node
+    assert batch_out == inc_out

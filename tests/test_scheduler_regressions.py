@@ -1,0 +1,149 @@
+"""Regression tests for review findings: waiting-pod resolution, child
+quota enforcement on the batch path, unknown-gang blocking, gang
+scale-down cycle hygiene, reservation unreserve delta, quota used release."""
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import (
+    GangMode,
+    GangSpec,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+    ReservationSpec,
+    ReservationState,
+    resources_to_vector,
+)
+from koordinator_tpu.gang.manager import GangManager
+from koordinator_tpu.scheduler import Scheduler
+
+
+def _mk(n_nodes=4, cpu=16000, mem=32768):
+    s = Scheduler(cluster_total={R.CPU: n_nodes * cpu, R.MEMORY: n_nodes * mem})
+    for i in range(n_nodes):
+        s.add_node(NodeSpec(name=f"n{i}", allocatable={R.CPU: cpu, R.MEMORY: mem}))
+        s.update_node_metric(
+            NodeMetric(node_name=f"n{i}", node_usage={R.CPU: 500}, update_time=99.0)
+        )
+    return s
+
+
+def test_waiting_pods_commit_when_gang_completes_next_round():
+    s = _mk()
+    s.update_gang(GangSpec(name="g", min_member=4, mode=GangMode.NON_STRICT))
+    for i in range(2):
+        s.add_pod(PodSpec(name=f"g{i}", gang="g", requests={R.CPU: 1000}))
+    out1 = s.schedule_pending(now=100.0)
+    assert set(out1.waiting) == {"default/g0", "default/g1"}
+    assert out1["default/g0"] is None
+
+    # the rest of the gang arrives; everyone must now be committed
+    for i in range(2, 4):
+        s.add_pod(PodSpec(name=f"g{i}", gang="g", requests={R.CPU: 1000}))
+    out2 = s.schedule_pending(now=101.0)
+    assert out2["default/g2"] is not None and out2["default/g3"] is not None
+    # previously-waiting members are re-reported as committed with their held node
+    assert out2["default/g0"] is not None and out2["default/g1"] is not None
+    assert not out2.waiting
+    assert s._waiting == {}
+
+
+def test_child_quota_enforced_on_batch_path():
+    s = _mk()
+    s.update_quota(
+        QuotaSpec(
+            name="team",
+            is_parent=True,
+            min={R.CPU: 0, R.MEMORY: 0},
+            max={R.CPU: 64000, R.MEMORY: 131072},
+        )
+    )
+    s.update_quota(
+        QuotaSpec(
+            name="team/child",
+            parent="team",
+            min={R.CPU: 0, R.MEMORY: 0},
+            max={R.CPU: 2000, R.MEMORY: 131072},  # tight child cap
+        )
+    )
+    s.add_pod(PodSpec(name="a", quota="team/child", requests={R.CPU: 2000}))
+    s.add_pod(PodSpec(name="b", quota="team/child", requests={R.CPU: 2000}))
+    out = s.schedule_pending(now=100.0)
+    placed = [uid for uid, n in out.items() if n is not None]
+    assert len(placed) == 1  # child max 2000 admits exactly one
+
+
+def test_unknown_gang_pod_blocked_on_batch_path():
+    s = _mk()
+    # pod references a gang whose spec was never registered
+    s.add_pod(PodSpec(name="orphan", gang="ghost", requests={R.CPU: 1000}))
+    out = s.schedule_pending(now=100.0)
+    assert out["default/orphan"] is None
+
+
+def test_gang_scale_down_does_not_wedge_cycle():
+    mgr = GangManager()
+    mgr.update_gang(GangSpec(name="g", min_member=1))
+    for i in range(3):
+        mgr.on_pod_add(f"p{i}", "g")
+    for i in range(3):
+        assert mgr.pre_filter(f"p{i}") is None
+    mgr.reject_gang_group("g")
+    # gang scales down to one pod
+    mgr.on_pod_delete("p1")
+    mgr.on_pod_delete("p2")
+    # p0 retries: the attempt set reflects the remaining child only (p0
+    # already attempted), so the cycle reopens immediately instead of
+    # wedging forever on the deleted pods' stale attempts
+    assert mgr.pre_filter("p0") is None
+
+
+def test_reservation_unreserve_subtracts_clamped_delta():
+    s = _mk(1, cpu=10000)
+    s.update_reservation(
+        ReservationSpec(
+            name="resv",
+            requests={R.CPU: 10000},
+            allocatable={R.CPU: 10000},
+            allocated={R.CPU: 8000},  # prior owners hold 8 cores
+            owner_labels={"team": "ml"},
+            node_name="n0",
+            state=ReservationState.AVAILABLE,
+            allocate_once=False,
+        )
+    )
+    from koordinator_tpu.scheduler.framework import CycleState
+    from koordinator_tpu.scheduler.plugins.reservation import ReservationPlugin
+
+    plugin = ReservationPlugin()
+    snap = s.cache.snapshot(now=100.0)
+    pod = PodSpec(name="p", requests={R.CPU: 5000}, labels={"team": "ml"})
+    state = CycleState()
+    plugin.before_pre_filter(state, snap, pod)
+    node = snap.nodes[0]
+    plugin.reserve(state, snap, pod, node)
+    resv = snap.reservations[0]
+    assert resv.allocated[R.CPU] == 10000  # clamped at allocatable
+    plugin.unreserve(state, snap, pod, node)
+    # only the 2000 actually added may be subtracted
+    assert resv.allocated[R.CPU] == 8000
+
+
+def test_quota_used_released_when_pod_removed():
+    s = _mk(2)
+    s.update_quota(
+        QuotaSpec(name="t", min={R.CPU: 0, R.MEMORY: 0},
+                  max={R.CPU: 4000, R.MEMORY: 131072})
+    )
+    pod = PodSpec(name="a", quota="t", requests={R.CPU: 4000})
+    s.add_pod(pod)
+    assert s.schedule_one("default/a", now=100.0).status == "bound"
+    assert s.quota_manager.quotas["t"].used[R.CPU] == 4000
+    s.remove_pod(pod)
+    assert s.quota_manager.quotas["t"].used[R.CPU] == 0
+    assert s.quota_manager.quotas["t"].request[R.CPU] == 0
+    # quota capacity is usable again
+    s.add_pod(PodSpec(name="b", quota="t", requests={R.CPU: 4000}))
+    assert s.schedule_one("default/b", now=101.0).status == "bound"
