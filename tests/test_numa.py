@@ -1,0 +1,418 @@
+"""Tests for the NUMA stack: topology, accumulator, hints, manager, plugin.
+
+Scenarios mirror the reference's table-driven tests
+(pkg/scheduler/plugins/nodenumaresource/cpu_accumulator_test.go,
+pkg/scheduler/frameworkext/topologymanager/policy_test.go).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_RESOURCE_SPEC,
+    ANNOTATION_RESOURCE_STATUS,
+    QoSClass,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
+from koordinator_tpu.numa.accumulator import (
+    CPUAllocationError,
+    take_cpus,
+    take_preferred_cpus,
+)
+from koordinator_tpu.numa.hints import (
+    NUMATopologyHint,
+    NUMATopologyPolicy,
+    mask_bits,
+    mask_of,
+    merge_hints,
+)
+from koordinator_tpu.numa.manager import (
+    PodAllocation,
+    ResourceManager,
+    ResourceOptions,
+    TopologyOptions,
+    generate_resource_hints,
+)
+from koordinator_tpu.numa.topology import (
+    AllocatedCPUs,
+    CPUBindPolicy,
+    CPUExclusivePolicy,
+    CPUTopology,
+    NUMAAllocateStrategy,
+)
+from koordinator_tpu.scheduler.framework import SchedulingFramework
+from koordinator_tpu.scheduler.plugins.nodenumaresource import (
+    NodeNUMAResourcePlugin,
+)
+
+
+def two_socket_topo():
+    # 2 sockets x 1 NUMA node x 4 cores x 2 threads = 16 cpus
+    return CPUTopology.build(
+        sockets=2, nodes_per_socket=1, cores_per_node=4, threads_per_core=2
+    )
+
+
+def all_available(topo):
+    return np.ones(topo.num_cpus, dtype=bool)
+
+
+class TestTopology:
+    def test_build_shape(self):
+        topo = two_socket_topo()
+        assert topo.num_cpus == 16
+        assert topo.num_cores == 8
+        assert topo.num_nodes == 2
+        assert topo.num_sockets == 2
+        assert topo.cpus_per_core == 2
+        assert topo.cpus_per_node == 8
+        assert topo.cpus_per_socket == 8
+
+
+class TestAccumulator:
+    def test_full_pcpus_takes_whole_cores(self):
+        topo = two_socket_topo()
+        got = take_cpus(
+            topo, 1, all_available(topo), AllocatedCPUs.empty(topo), 4,
+            CPUBindPolicy.FULL_PCPUS,
+        )
+        assert len(got) == 4
+        # whole physical cores: every taken core contributes both threads
+        cores = topo.core_id[got]
+        assert all((cores == c).sum() == 2 for c in set(cores))
+        # single NUMA node
+        assert len(set(topo.node_id[got])) == 1
+
+    def test_spread_takes_one_per_core(self):
+        topo = two_socket_topo()
+        got = take_cpus(
+            topo, 1, all_available(topo), AllocatedCPUs.empty(topo), 4,
+            CPUBindPolicy.SPREAD_BY_PCPUS,
+        )
+        assert len(got) == 4
+        assert len(set(topo.core_id[got])) == 4
+
+    def test_insufficient_raises(self):
+        topo = two_socket_topo()
+        with pytest.raises(CPUAllocationError):
+            take_cpus(
+                topo, 1, all_available(topo), AllocatedCPUs.empty(topo), 17,
+                CPUBindPolicy.FULL_PCPUS,
+            )
+
+    def test_most_allocated_packs_partial_node(self):
+        topo = two_socket_topo()
+        avail = all_available(topo)
+        # node 0 partially consumed: core 0 (cpus 0,1) taken
+        avail[0] = avail[1] = False
+        got = take_cpus(
+            topo, 1, avail, AllocatedCPUs.empty(topo), 2,
+            CPUBindPolicy.FULL_PCPUS,
+            strategy=NUMAAllocateStrategy.MOST_ALLOCATED,
+        )
+        # most-allocated packs onto the busier node 0
+        assert set(topo.node_id[got]) == {0}
+
+    def test_least_allocated_spreads_to_free_node(self):
+        topo = two_socket_topo()
+        avail = all_available(topo)
+        avail[0] = avail[1] = False
+        got = take_cpus(
+            topo, 1, avail, AllocatedCPUs.empty(topo), 2,
+            CPUBindPolicy.FULL_PCPUS,
+            strategy=NUMAAllocateStrategy.LEAST_ALLOCATED,
+        )
+        assert set(topo.node_id[got]) == {1}
+
+    def test_pcpu_exclusive_avoids_claimed_cores(self):
+        topo = two_socket_topo()
+        allocated = AllocatedCPUs.empty(topo)
+        allocated.exclusive_in_cores.add(0)  # core 0 claimed PCPU-exclusive
+        avail = all_available(topo)
+        avail[0] = False  # cpu 0 allocated, sibling cpu 1 still free
+        got = take_cpus(
+            topo, 1, avail, allocated, 4, CPUBindPolicy.SPREAD_BY_PCPUS,
+            exclusive_policy=CPUExclusivePolicy.PCPU_LEVEL,
+        )
+        assert 1 not in got  # sibling of exclusive core avoided
+
+    def test_ref_count_sharing(self):
+        topo = two_socket_topo()
+        allocated = AllocatedCPUs.empty(topo)
+        allocated.ref_count[:8] = 1  # node 0 cpus shared once already
+        avail = all_available(topo)  # max_ref_count=2: all still available
+        got = take_cpus(
+            topo, 2, avail, allocated, 2, CPUBindPolicy.SPREAD_BY_PCPUS,
+        )
+        assert len(got) == 2
+
+    def test_preferred_cpus_first(self):
+        topo = two_socket_topo()
+        preferred = np.zeros(topo.num_cpus, dtype=bool)
+        preferred[[8, 9]] = True  # reservation-held cpus on node 1
+        got = take_preferred_cpus(
+            topo, 1, all_available(topo), preferred,
+            AllocatedCPUs.empty(topo), 4, CPUBindPolicy.FULL_PCPUS,
+        )
+        assert {8, 9} <= set(int(c) for c in got)
+
+    def test_needs_more_than_one_socket(self):
+        topo = two_socket_topo()
+        got = take_cpus(
+            topo, 1, all_available(topo), AllocatedCPUs.empty(topo), 12,
+            CPUBindPolicy.FULL_PCPUS,
+        )
+        assert len(got) == 12
+
+
+class TestHintMerge:
+    def test_none_policy_always_admits(self):
+        hint, admit = merge_hints(NUMATopologyPolicy.NONE, [0, 1], [])
+        assert admit and hint.affinity is None
+
+    def test_best_effort_picks_narrowest_preferred(self):
+        providers = [
+            {
+                "cpu": [
+                    NUMATopologyHint(mask_of([0]), True),
+                    NUMATopologyHint(mask_of([0, 1]), False),
+                ]
+            }
+        ]
+        hint, admit = merge_hints(NUMATopologyPolicy.BEST_EFFORT, [0, 1], providers)
+        assert admit and hint.affinity == mask_of([0]) and hint.preferred
+
+    def test_best_effort_admits_unpreferred(self):
+        providers = [{"cpu": [NUMATopologyHint(mask_of([0, 1]), False)]}]
+        hint, admit = merge_hints(NUMATopologyPolicy.BEST_EFFORT, [0, 1], providers)
+        assert admit and not hint.preferred
+
+    def test_restricted_rejects_unpreferred(self):
+        providers = [{"cpu": [NUMATopologyHint(mask_of([0, 1]), False)]}]
+        _, admit = merge_hints(NUMATopologyPolicy.RESTRICTED, [0, 1], providers)
+        assert not admit
+
+    def test_single_numa_rejects_multi_node(self):
+        providers = [{"cpu": [NUMATopologyHint(mask_of([0, 1]), True)]}]
+        _, admit = merge_hints(
+            NUMATopologyPolicy.SINGLE_NUMA_NODE, [0, 1], providers
+        )
+        assert not admit
+
+    def test_single_numa_admits_single_node(self):
+        providers = [{"cpu": [NUMATopologyHint(mask_of([1]), True)]}]
+        hint, admit = merge_hints(
+            NUMATopologyPolicy.SINGLE_NUMA_NODE, [0, 1], providers
+        )
+        assert admit and hint.affinity == mask_of([1])
+
+    def test_cross_provider_and(self):
+        providers = [
+            {"cpu": [NUMATopologyHint(mask_of([0, 1]), True)]},
+            {"gpu": [NUMATopologyHint(mask_of([1]), True)]},
+        ]
+        hint, admit = merge_hints(NUMATopologyPolicy.BEST_EFFORT, [0, 1], providers)
+        assert hint.affinity == mask_of([1])
+
+    def test_empty_resource_hints_means_unsatisfiable(self):
+        providers = [{"cpu": []}]
+        hint, admit = merge_hints(NUMATopologyPolicy.RESTRICTED, [0, 1], providers)
+        assert not admit
+
+
+class TestResourceHints:
+    def test_min_affinity_preferred(self):
+        numa_res = {
+            0: {ResourceName.CPU: 8000, ResourceName.MEMORY: 1024},
+            1: {ResourceName.CPU: 8000, ResourceName.MEMORY: 1024},
+        }
+        avail = {n: dict(r) for n, r in numa_res.items()}
+        hints = generate_resource_hints(
+            numa_res, {ResourceName.CPU: 4000, ResourceName.MEMORY: 512}, avail
+        )
+        cpu_hints = hints[ResourceName.CPU]
+        # single-node masks feasible → preferred; two-node mask not preferred
+        by_mask = {h.affinity: h for h in cpu_hints}
+        assert by_mask[mask_of([0])].preferred
+        assert by_mask[mask_of([1])].preferred
+        assert not by_mask[mask_of([0, 1])].preferred
+
+    def test_free_gate_drops_hint_but_keeps_min_size(self):
+        numa_res = {
+            0: {ResourceName.CPU: 8000},
+            1: {ResourceName.CPU: 8000},
+        }
+        # node 0 busy: only 1000 free
+        avail = {0: {ResourceName.CPU: 1000}, 1: {ResourceName.CPU: 8000}}
+        hints = generate_resource_hints(
+            numa_res, {ResourceName.CPU: 4000}, avail
+        )
+        masks = {h.affinity for h in hints[ResourceName.CPU]}
+        assert mask_of([0]) not in masks
+        assert mask_of([1]) in masks
+        # min affinity size is still 1 (capacity-feasible), so [1] preferred
+        by_mask = {h.affinity: h for h in hints[ResourceName.CPU]}
+        assert by_mask[mask_of([1])].preferred
+
+    def test_lack_resource_node_excluded(self):
+        numa_res = {
+            0: {ResourceName.CPU: 8000, ResourceName.GPU: 200},
+            1: {ResourceName.CPU: 8000},
+        }
+        avail = {n: dict(r) for n, r in numa_res.items()}
+        hints = generate_resource_hints(numa_res, {ResourceName.GPU: 100}, avail)
+        masks = {h.affinity for h in hints[ResourceName.GPU]}
+        assert masks == {mask_of([0])}
+
+
+class TestResourceManager:
+    def make_manager(self):
+        topo = two_socket_topo()
+        mgr = ResourceManager()
+        mgr.update_topology(
+            "node-a",
+            TopologyOptions(
+                cpu_topology=topo,
+                policy=NUMATopologyPolicy.BEST_EFFORT,
+                numa_node_resources={
+                    0: {ResourceName.CPU: 8000, ResourceName.MEMORY: 1024},
+                    1: {ResourceName.CPU: 8000, ResourceName.MEMORY: 1024},
+                },
+            ),
+        )
+        return mgr
+
+    def test_allocate_cpuset_and_release(self):
+        mgr = self.make_manager()
+        options = ResourceOptions(
+            requests={ResourceName.CPU: 4000},
+            num_cpus_needed=4,
+            request_cpu_bind=True,
+            cpu_bind_policy=CPUBindPolicy.FULL_PCPUS,
+        )
+        alloc = mgr.allocate("node-a", "pod-1", options)
+        assert len(alloc.cpuset) == 4
+        mgr.update("node-a", PodAllocation(
+            pod_uid="pod-1", cpuset=alloc.cpuset,
+        ))
+        avail, _ = mgr.available_cpus("node-a")
+        assert int(avail.sum()) == 12
+        mgr.release("node-a", "pod-1")
+        avail, _ = mgr.available_cpus("node-a")
+        assert int(avail.sum()) == 16
+
+    def test_allocate_by_hint_distributes_evenly(self):
+        mgr = self.make_manager()
+        options = ResourceOptions(
+            requests={ResourceName.CPU: 8000, ResourceName.MEMORY: 1024},
+            hint=NUMATopologyHint(mask_of([0, 1]), True),
+        )
+        alloc = mgr.allocate("node-a", "pod-1", options)
+        assert set(alloc.numa_resources) == {0, 1}
+        assert alloc.numa_resources[0][ResourceName.CPU] == 4000
+        assert alloc.numa_resources[1][ResourceName.CPU] == 4000
+
+    def test_allocate_insufficient_numa_raises(self):
+        mgr = self.make_manager()
+        options = ResourceOptions(
+            requests={ResourceName.CPU: 20000},
+            hint=NUMATopologyHint(mask_of([0, 1]), True),
+        )
+        with pytest.raises(CPUAllocationError):
+            mgr.allocate("node-a", "pod-1", options)
+
+
+class TestPlugin:
+    def build(self, policy=NUMATopologyPolicy.NONE):
+        topo = two_socket_topo()
+        mgr = ResourceManager()
+        mgr.update_topology(
+            "node-a",
+            TopologyOptions(
+                cpu_topology=topo,
+                policy=policy,
+                numa_node_resources={
+                    0: {ResourceName.CPU: 8000, ResourceName.MEMORY: 1024},
+                    1: {ResourceName.CPU: 8000, ResourceName.MEMORY: 1024},
+                },
+            ),
+        )
+        plugin = NodeNUMAResourcePlugin(mgr)
+        snapshot = ClusterSnapshot(
+            nodes=[NodeSpec(
+                name="node-a",
+                allocatable={ResourceName.CPU: 16000, ResourceName.MEMORY: 2048},
+            )]
+        )
+        return plugin, mgr, snapshot
+
+    def test_lsr_pod_gets_cpuset(self):
+        plugin, mgr, snapshot = self.build()
+        fw = SchedulingFramework([plugin])
+        pod = PodSpec(
+            name="p1", qos=QoSClass.LSR,
+            requests={ResourceName.CPU: 4000, ResourceName.MEMORY: 512},
+        )
+        outcome = fw.schedule_one(snapshot, pod)
+        assert outcome.status == "bound"
+        status = json.loads(pod.annotations[ANNOTATION_RESOURCE_STATUS])
+        assert len(status["cpuset"]) == 4
+
+    def test_non_integer_cpuset_rejected(self):
+        plugin, mgr, snapshot = self.build()
+        fw = SchedulingFramework([plugin])
+        pod = PodSpec(
+            name="p1", qos=QoSClass.LSR, requests={ResourceName.CPU: 2500}
+        )
+        outcome = fw.schedule_one(snapshot, pod)
+        assert outcome.status == "unschedulable"
+        assert "integer" in outcome.reason
+
+    def test_single_numa_policy_constrains(self):
+        plugin, mgr, snapshot = self.build(NUMATopologyPolicy.SINGLE_NUMA_NODE)
+        fw = SchedulingFramework([plugin])
+        # fits on one NUMA node → admitted
+        pod = PodSpec(
+            name="p1", qos=QoSClass.LS,
+            requests={ResourceName.CPU: 6000, ResourceName.MEMORY: 512},
+        )
+        assert fw.schedule_one(snapshot, pod).status == "bound"
+        # cannot fit any single NUMA node → rejected
+        pod2 = PodSpec(
+            name="p2", qos=QoSClass.LS,
+            requests={ResourceName.CPU: 12000, ResourceName.MEMORY: 512},
+        )
+        outcome = fw.schedule_one(snapshot, pod2)
+        assert outcome.status == "unschedulable"
+
+    def test_exclusive_annotation_honored(self):
+        plugin, mgr, snapshot = self.build()
+        fw = SchedulingFramework([plugin])
+        pod = PodSpec(
+            name="p1", qos=QoSClass.LSE,
+            requests={ResourceName.CPU: 2000},
+            annotations={
+                ANNOTATION_RESOURCE_SPEC: json.dumps(
+                    {"cpuBindPolicy": "FullPCPUs", "cpuExclusivePolicy": "PCPULevel"}
+                )
+            },
+        )
+        outcome = fw.schedule_one(snapshot, pod)
+        assert outcome.status == "bound"
+        cpus = json.loads(pod.annotations[ANNOTATION_RESOURCE_STATUS])["cpuset"]
+        topo = mgr.get_topology("node-a").cpu_topology
+        assert len({int(topo.core_id[c]) for c in cpus}) == 1  # one full core
+
+    def test_reserve_commits_and_unreserve_rolls_back(self):
+        plugin, mgr, snapshot = self.build()
+        fw = SchedulingFramework([plugin])
+        pod = PodSpec(
+            name="p1", qos=QoSClass.LSR, requests={ResourceName.CPU: 8000}
+        )
+        assert fw.schedule_one(snapshot, pod).status == "bound"
+        avail, _ = mgr.available_cpus("node-a")
+        assert int(avail.sum()) == 8
+        assert mgr.get_allocated_cpuset("node-a", pod.uid) is not None
