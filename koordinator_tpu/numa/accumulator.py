@@ -106,7 +106,7 @@ class _Accumulator:
             return free_score
         return -free_score
 
-    def _sorted_core_cpus(self, cpus: np.ndarray, cores: List[int],
+    def _sorted_core_cpus(self, cores: List[int],
                           cpus_in_cores: Dict[int, np.ndarray]) -> List[int]:
         """Core order within a node/socket: cpu count desc, core ref count
         asc (shared mode), core id asc (reference sortCores :345-368);
@@ -168,7 +168,7 @@ class _Accumulator:
             cores_in_nodes.setdefault(int(self.topo.node_id[cpus[0]]), []).append(core)
 
         cpus_in_nodes = {
-            node: self._sorted_core_cpus(cpu_ids, cores, cpus_in_cores)
+            node: self._sorted_core_cpus(cores, cpus_in_cores)
             for node, cores in cores_in_nodes.items()
         }
 
@@ -199,7 +199,7 @@ class _Accumulator:
         for core, cpus in cpus_in_cores.items():
             cores_in_sockets.setdefault(int(self.topo.socket_id[cpus[0]]), []).append(core)
         cpus_in_sockets = {
-            s: self._sorted_core_cpus(cpu_ids, cores, cpus_in_cores)
+            s: self._sorted_core_cpus(cores, cpus_in_cores)
             for s, cores in cores_in_sockets.items()
         }
 

@@ -105,7 +105,7 @@ class NodeAllocation:
         self.node_name = node_name
         self.pods: Dict[str, PodAllocation] = {}
 
-    def add(self, allocation: PodAllocation, topology: Optional[CPUTopology]) -> None:
+    def add(self, allocation: PodAllocation) -> None:
         if allocation.pod_uid in self.pods:
             return
         self.pods[allocation.pod_uid] = allocation
@@ -182,7 +182,8 @@ def generate_resource_hints(
     """Hints per resource over all NUMA-node subsets (reference:
     resource_manager.go:459 generateResourceHints): a mask yields a hint
     for a resource iff the mask's total capacity and free amount both cover
-    the request and the mask avoids nodes lacking the resource entirely;
+    the request and the mask avoids nodes with zero *available* amount of
+    it (the reference builds the lack set from available, not capacity);
     preferred = the minimal feasible-by-capacity mask size. Memory-like
     resources are gated together, others independently."""
     numa_nodes = sorted(numa_node_resources)
@@ -391,6 +392,10 @@ class ResourceManager:
             for i, node in enumerate(order):
                 split = _split_quantity(r, quantity, len(numa_nodes) - i, options, opts)
                 allocated = min(total_available.get(node, {}).get(r, 0), split)
+                if r == ResourceName.CPU and options.request_cpu_bind:
+                    # cpuset pods take whole logical cpus: floor so the
+                    # recorded NUMA amount always matches the cpuset taken
+                    allocated = allocated // 1000 * 1000
                 if allocated > 0:
                     result.setdefault(node, {})[r] = allocated
                     quantity -= allocated
@@ -457,7 +462,7 @@ class ResourceManager:
         opts = self.get_topology(node_name)
         if opts.cpu_topology is None or not opts.cpu_topology.is_valid():
             return
-        self._node_allocation(node_name).add(allocation, opts.cpu_topology)
+        self._node_allocation(node_name).add(allocation)
 
     def release(self, node_name: str, pod_uid: str) -> None:
         self._node_allocation(node_name).release(pod_uid)

@@ -81,7 +81,10 @@ class NodeNUMAResourcePlugin(Plugin):
 
     # -- PreFilter (reference: plugin.go:219) ------------------------------
     def pre_filter(self, state: CycleState, snapshot, pod) -> Status:
-        pf = _PreFilterState(pod)
+        try:
+            pf = _PreFilterState(pod)
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            return Status.unschedulable_(f"invalid resource spec annotation: {e}")
         if pf.invalid_integer:
             return Status.unschedulable_("the requested CPUs must be integer")
         state[_STATE_KEY] = pf
