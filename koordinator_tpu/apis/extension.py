@@ -182,5 +182,7 @@ ANNOTATION_RESOURCE_SPEC = f"{DOMAIN}/resource-spec"
 ANNOTATION_RESOURCE_STATUS = f"{DOMAIN}/resource-status"
 ANNOTATION_RESERVATION_ALLOCATED = f"{DOMAIN}/reservation-allocated"
 ANNOTATION_DEVICE_ALLOCATED = f"{DOMAIN}/device-allocated"
+ANNOTATION_DEVICE_ALLOCATE_HINTS = f"{DOMAIN}/device-allocate-hints"
+ANNOTATION_DEVICE_JOINT_ALLOCATE = f"{DOMAIN}/device-joint-allocate"
 ANNOTATION_SOFT_EVICTION = f"{DOMAIN}/soft-eviction"
 ANNOTATION_EVICTION_COST = f"{DOMAIN}/eviction-cost"

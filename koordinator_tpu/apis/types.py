@@ -73,6 +73,9 @@ class PodSpec:
     quota: Optional[str] = None
     gang: Optional[str] = None
     node_name: Optional[str] = None   # set once assigned
+    # device resource requests keyed by raw device resource name
+    # (reference: extended resources like nvidia.com/gpu in pod spec)
+    device_requests: Dict[str, int] = dataclasses.field(default_factory=dict)
     is_daemonset: bool = False
     preemptible: bool = True
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,365 @@
+"""Tests for device allocation (mirrors reference deviceshare tests:
+device_allocator_test.go, devicehandler_gpu_test.go, utils_test.go)."""
+
+import json
+
+import pytest
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_DEVICE_ALLOCATED,
+    ANNOTATION_DEVICE_ALLOCATE_HINTS,
+    ANNOTATION_DEVICE_JOINT_ALLOCATE,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
+from koordinator_tpu.device.allocator import (
+    AutopilotAllocator,
+    DeviceHint,
+    DeviceUnschedulable,
+    JointAllocate,
+    normalize_device_requests,
+)
+from koordinator_tpu.device.cache import (
+    DeviceEntry,
+    DeviceResourceName as DR,
+    DeviceType,
+    NodeDevice,
+    NodeDeviceCache,
+    VirtualFunction,
+)
+from koordinator_tpu.scheduler.framework import SchedulingFramework
+from koordinator_tpu.scheduler.plugins.deviceshare import DeviceSharePlugin
+
+GPU_FULL = {DR.GPU_CORE: 100, DR.GPU_MEMORY: 16384, DR.GPU_MEMORY_RATIO: 100}
+
+
+def gpu_node(n_gpus=4, with_rdma=False, numa_split=True):
+    entries = []
+    for i in range(n_gpus):
+        entries.append(
+            DeviceEntry(
+                minor=i,
+                device_type=DeviceType.GPU,
+                resources=dict(GPU_FULL),
+                numa_node=i // 2 if numa_split else 0,
+                pcie_id=str(i // 2),
+            )
+        )
+    if with_rdma:
+        for i in range(2):
+            entries.append(
+                DeviceEntry(
+                    minor=i,
+                    device_type=DeviceType.RDMA,
+                    resources={DR.RDMA: 100},
+                    numa_node=i,
+                    pcie_id=str(i),
+                    vfs=[
+                        VirtualFunction(bus_id=f"0000:{i}0:00.{v}")
+                        for v in range(4)
+                    ],
+                )
+            )
+    return NodeDevice("node-a", entries)
+
+
+class TestNormalize:
+    def test_nvidia_gpu_expands(self):
+        out = normalize_device_requests({DR.NVIDIA_GPU: 2})
+        assert out[DeviceType.GPU] == {DR.GPU_CORE: 200, DR.GPU_MEMORY_RATIO: 200}
+
+    def test_koord_gpu_percent(self):
+        out = normalize_device_requests({DR.KOORD_GPU: 50})
+        assert out[DeviceType.GPU] == {DR.GPU_CORE: 50, DR.GPU_MEMORY_RATIO: 50}
+
+    def test_core_plus_memory(self):
+        out = normalize_device_requests({DR.GPU_CORE: 50, DR.GPU_MEMORY: 8192})
+        assert out[DeviceType.GPU] == {DR.GPU_CORE: 50, DR.GPU_MEMORY: 8192}
+
+    def test_invalid_combination(self):
+        with pytest.raises(DeviceUnschedulable):
+            normalize_device_requests({DR.NVIDIA_GPU: 1, DR.GPU_CORE: 50})
+
+    def test_invalid_percentage(self):
+        with pytest.raises(DeviceUnschedulable):
+            normalize_device_requests({DR.KOORD_GPU: 150})
+
+    def test_rdma_fpga(self):
+        out = normalize_device_requests({DR.RDMA: 100, DR.FPGA: 100})
+        assert out[DeviceType.RDMA] == {DR.RDMA: 100}
+        assert out[DeviceType.FPGA] == {DR.FPGA: 100}
+
+
+class TestAllocator:
+    def test_partial_gpu_share(self):
+        nd = gpu_node(1)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.KOORD_GPU: 50})
+        )
+        allocs = allocator.allocate()[DeviceType.GPU]
+        assert len(allocs) == 1
+        # memory filled from total: 50% of 16 GiB
+        assert allocs[0].resources[DR.GPU_MEMORY] == 8192
+
+    def test_multi_gpu(self):
+        nd = gpu_node(4)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.NVIDIA_GPU: 2})
+        )
+        allocs = allocator.allocate()[DeviceType.GPU]
+        assert len(allocs) == 2
+        assert all(a.resources[DR.GPU_CORE] == 100 for a in allocs)
+
+    def test_insufficient_devices(self):
+        nd = gpu_node(1)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.NVIDIA_GPU: 2})
+        )
+        with pytest.raises(DeviceUnschedulable):
+            allocator.allocate()
+
+    def test_two_half_gpus_share_device(self):
+        nd = gpu_node(1)
+        a1 = AutopilotAllocator(nd, normalize_device_requests({DR.KOORD_GPU: 50}))
+        from koordinator_tpu.device.allocator import DeviceAllocation  # noqa
+        nd.apply("pod-1", a1.allocate())
+        a2 = AutopilotAllocator(nd, normalize_device_requests({DR.KOORD_GPU: 50}))
+        allocs = a2.allocate()[DeviceType.GPU]
+        assert allocs[0].minor == 0
+        nd.apply("pod-2", {DeviceType.GPU: allocs})
+        a3 = AutopilotAllocator(nd, normalize_device_requests({DR.KOORD_GPU: 10}))
+        with pytest.raises(DeviceUnschedulable):
+            a3.allocate()
+
+    def test_least_allocated_prefers_free_device(self):
+        nd = gpu_node(2, numa_split=False)
+        a1 = AutopilotAllocator(nd, normalize_device_requests({DR.KOORD_GPU: 50}))
+        nd.apply("pod-1", a1.allocate())
+        a2 = AutopilotAllocator(nd, normalize_device_requests({DR.KOORD_GPU: 50}))
+        allocs = a2.allocate()[DeviceType.GPU]
+        assert allocs[0].minor == 1  # least-allocated picks the idle gpu
+
+    def test_most_allocated_packs(self):
+        nd = gpu_node(2, numa_split=False)
+        a1 = AutopilotAllocator(
+            nd, normalize_device_requests({DR.KOORD_GPU: 50}),
+            scorer="MostAllocated",
+        )
+        nd.apply("pod-1", a1.allocate())
+        a2 = AutopilotAllocator(
+            nd, normalize_device_requests({DR.KOORD_GPU: 40}),
+            scorer="MostAllocated",
+        )
+        assert a2.allocate()[DeviceType.GPU][0].minor == 0
+
+    def test_numa_affinity_filters(self):
+        nd = gpu_node(4)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.NVIDIA_GPU: 1}),
+            numa_affinity=1 << 1,  # NUMA node 1 only → minors 2,3
+        )
+        allocs = allocator.allocate()[DeviceType.GPU]
+        assert allocs[0].minor in (2, 3)
+
+    def test_vf_allocation(self):
+        nd = gpu_node(2, with_rdma=True)
+        hints = {DeviceType.RDMA: DeviceHint(vf_selector={})}
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.RDMA: 100}), hints=hints
+        )
+        allocs = allocator.allocate()[DeviceType.RDMA]
+        assert allocs[0].vf_bus_ids == ["0000:00:00.0"]
+        nd.apply("pod-1", {DeviceType.RDMA: allocs})
+        # next VF is the following bus id on the scored-best device
+        a2 = AutopilotAllocator(
+            nd, normalize_device_requests({DR.RDMA: 100}), hints=hints
+        )
+        # device 0 is fully used now → device 1
+        assert a2.allocate()[DeviceType.RDMA][0].minor == 1
+
+    def test_joint_allocate_same_pcie(self):
+        nd = gpu_node(4, with_rdma=True)
+        joint = JointAllocate(
+            device_types=[DeviceType.GPU, DeviceType.RDMA],
+            required_scope="SamePCIe",
+        )
+        allocator = AutopilotAllocator(
+            nd,
+            normalize_device_requests({DR.NVIDIA_GPU: 2, DR.RDMA: 100}),
+            joint_allocate=joint,
+        )
+        allocs = allocator.allocate()
+        gpu_pcies = {nd.entry(DeviceType.GPU, a.minor).pcie_id
+                     for a in allocs[DeviceType.GPU]}
+        rdma_pcies = {nd.entry(DeviceType.RDMA, a.minor).pcie_id
+                      for a in allocs[DeviceType.RDMA]}
+        assert gpu_pcies == rdma_pcies
+
+    def test_apply_for_all_strategy(self):
+        nd = gpu_node(2, with_rdma=True)
+        hints = {DeviceType.RDMA: DeviceHint(allocate_strategy="ApplyForAll")}
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.RDMA: 1}), hints=hints
+        )
+        allocs = allocator.allocate()[DeviceType.RDMA]
+        assert len(allocs) == 2  # all rdma devices
+
+    def test_unhealthy_device_skipped(self):
+        entries = [
+            DeviceEntry(minor=0, device_type=DeviceType.GPU,
+                        resources=dict(GPU_FULL), health=False),
+            DeviceEntry(minor=1, device_type=DeviceType.GPU,
+                        resources=dict(GPU_FULL)),
+        ]
+        nd = NodeDevice("node-a", entries)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.NVIDIA_GPU: 1})
+        )
+        assert allocator.allocate()[DeviceType.GPU][0].minor == 1
+
+
+class TestReviewRegressions:
+    """Scenarios from the adversarial review of the first device cut."""
+
+    def test_joint_allocate_never_overallocates_primary(self):
+        # 3 PCIes with 1 free GPU each, pod wants 2 via joint-allocate:
+        # must get exactly 2, not one per preferred PCIe
+        entries = [
+            DeviceEntry(minor=i, device_type=DeviceType.GPU,
+                        resources=dict(GPU_FULL), numa_node=0, pcie_id=str(i))
+            for i in range(3)
+        ]
+        entries.append(DeviceEntry(
+            minor=0, device_type=DeviceType.RDMA,
+            resources={DR.RDMA: 100}, numa_node=0, pcie_id="0"))
+        nd = NodeDevice("node-a", entries)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.NVIDIA_GPU: 2}),
+            joint_allocate=JointAllocate(device_types=[DeviceType.GPU,
+                                                       DeviceType.RDMA]),
+        )
+        allocs = allocator.allocate()
+        assert len(allocs[DeviceType.GPU]) == 2
+
+    def test_same_pcie_secondary_spreads_across_pcies(self):
+        # RDMA minors 0,1 on p0 and 2 on p1: SamePCIe needs one per
+        # primary PCIe, not the two best-scored on one switch
+        entries = [
+            DeviceEntry(minor=0, device_type=DeviceType.GPU,
+                        resources=dict(GPU_FULL), pcie_id="p0"),
+            DeviceEntry(minor=1, device_type=DeviceType.GPU,
+                        resources=dict(GPU_FULL), pcie_id="p1"),
+            DeviceEntry(minor=0, device_type=DeviceType.RDMA,
+                        resources={DR.RDMA: 100}, pcie_id="p0"),
+            DeviceEntry(minor=1, device_type=DeviceType.RDMA,
+                        resources={DR.RDMA: 100}, pcie_id="p0"),
+            DeviceEntry(minor=2, device_type=DeviceType.RDMA,
+                        resources={DR.RDMA: 100}, pcie_id="p1"),
+        ]
+        nd = NodeDevice("node-a", entries)
+        allocator = AutopilotAllocator(
+            nd,
+            normalize_device_requests({DR.NVIDIA_GPU: 2, DR.RDMA: 100}),
+            joint_allocate=JointAllocate(
+                device_types=[DeviceType.GPU, DeviceType.RDMA],
+                required_scope="SamePCIe",
+            ),
+        )
+        allocs = allocator.allocate()
+        rdma_pcies = {nd.entry(DeviceType.RDMA, a.minor).pcie_id
+                      for a in allocs[DeviceType.RDMA]}
+        assert rdma_pcies == {"p0", "p1"}
+
+    def test_joint_allocate_skips_unrequested_types(self):
+        nd = gpu_node(2, with_rdma=True)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.NVIDIA_GPU: 1}),
+            joint_allocate=JointAllocate(
+                device_types=[DeviceType.GPU, DeviceType.RDMA]
+            ),
+        )
+        allocs = allocator.allocate()
+        assert DeviceType.RDMA not in allocs
+
+    def test_apply_for_all_ignores_unhealthy(self):
+        entries = [
+            DeviceEntry(minor=0, device_type=DeviceType.RDMA,
+                        resources={DR.RDMA: 100}),
+            DeviceEntry(minor=1, device_type=DeviceType.RDMA,
+                        resources={DR.RDMA: 100}, health=False),
+        ]
+        nd = NodeDevice("node-a", entries)
+        allocator = AutopilotAllocator(
+            nd, normalize_device_requests({DR.RDMA: 1}),
+            hints={DeviceType.RDMA: DeviceHint(allocate_strategy="ApplyForAll")},
+        )
+        allocs = allocator.allocate()[DeviceType.RDMA]
+        assert [a.minor for a in allocs] == [0]
+
+    def test_unknown_extended_resource_ignored(self):
+        cache = NodeDeviceCache()
+        cache.nodes["node-a"] = gpu_node(1)
+        fw = SchedulingFramework([DeviceSharePlugin(cache)])
+        snapshot = ClusterSnapshot(
+            nodes=[NodeSpec(name="node-a",
+                            allocatable={ResourceName.CPU: 16000})]
+        )
+        pod = PodSpec(name="p1", device_requests={"example.com/foo": 1})
+        assert fw.schedule_one(snapshot, pod).status == "bound"
+
+
+class TestPlugin:
+    def build(self, n_gpus=2):
+        cache = NodeDeviceCache()
+        nd = gpu_node(n_gpus, with_rdma=True)
+        cache.nodes["node-a"] = nd
+        plugin = DeviceSharePlugin(cache)
+        snapshot = ClusterSnapshot(
+            nodes=[NodeSpec(name="node-a",
+                            allocatable={ResourceName.CPU: 16000})]
+        )
+        return plugin, cache, snapshot
+
+    def test_gpu_pod_bound_and_annotated(self):
+        plugin, cache, snapshot = self.build()
+        fw = SchedulingFramework([plugin])
+        pod = PodSpec(name="p1", device_requests={"nvidia.com/gpu": 1})
+        out = fw.schedule_one(snapshot, pod)
+        assert out.status == "bound"
+        allocated = json.loads(pod.annotations[ANNOTATION_DEVICE_ALLOCATED])
+        assert len(allocated["gpu"]) == 1
+
+    def test_non_device_pod_skips(self):
+        plugin, cache, snapshot = self.build()
+        fw = SchedulingFramework([plugin])
+        pod = PodSpec(name="p1", requests={ResourceName.CPU: 1000})
+        assert fw.schedule_one(snapshot, pod).status == "bound"
+
+    def test_exhaustion_unschedulable(self):
+        plugin, cache, snapshot = self.build(n_gpus=1)
+        fw = SchedulingFramework([plugin])
+        p1 = PodSpec(name="p1", device_requests={"nvidia.com/gpu": 1})
+        assert fw.schedule_one(snapshot, p1).status == "bound"
+        p2 = PodSpec(name="p2", device_requests={"nvidia.com/gpu": 1})
+        out = fw.schedule_one(snapshot, p2)
+        assert out.status == "unschedulable"
+
+    def test_joint_allocate_annotation(self):
+        plugin, cache, snapshot = self.build(n_gpus=4)
+        fw = SchedulingFramework([plugin])
+        pod = PodSpec(
+            name="p1",
+            device_requests={"nvidia.com/gpu": 2, "rdma": 100},
+            annotations={
+                ANNOTATION_DEVICE_JOINT_ALLOCATE: json.dumps(
+                    {"deviceTypes": ["gpu", "rdma"], "requiredScope": "SamePCIe"}
+                ),
+                ANNOTATION_DEVICE_ALLOCATE_HINTS: json.dumps(
+                    {"rdma": {"vfSelector": {}}}
+                ),
+            },
+        )
+        out = fw.schedule_one(snapshot, pod)
+        assert out.status == "bound"
+        allocated = json.loads(pod.annotations[ANNOTATION_DEVICE_ALLOCATED])
+        assert allocated["rdma"][0]["vfs"]
