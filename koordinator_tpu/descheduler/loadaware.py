@@ -1,0 +1,285 @@
+"""LowNodeLoad: balance actual utilization across the pool.
+
+Semantics oracle: pkg/descheduler/framework/plugins/loadaware/
+{low_node_load.go, utilization_util.go} (see SURVEY.md A.7): classify
+nodes by *real* utilization (NodeMetric) against low/high thresholds —
+underutilized iff below all lows, overutilized iff above any high —
+debounce with the anomaly detector, then evict the heaviest pods from
+overutilized nodes while the destination pool has headroom. The
+classification runs as one vectorized pass over the (nodes × resources)
+matrix (``ops.rebalance.classify_nodes``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
+from koordinator_tpu.apis.types import resources_to_vector
+from koordinator_tpu.descheduler.anomaly import BasicDetector, State
+from koordinator_tpu.descheduler.framework import BalancePlugin, Evictor
+from koordinator_tpu.ops.rebalance import classify_nodes
+
+
+@dataclasses.dataclass
+class NodePool:
+    """One node pool's thresholds (reference: LowNodeLoadNodePool)."""
+
+    name: str = "default"
+    # resource -> percent; missing resource = never triggers
+    low_thresholds: Dict[ResourceName, int] = dataclasses.field(
+        default_factory=lambda: {ResourceName.CPU: 45, ResourceName.MEMORY: 60}
+    )
+    high_thresholds: Dict[ResourceName, int] = dataclasses.field(
+        default_factory=lambda: {ResourceName.CPU: 65, ResourceName.MEMORY: 80}
+    )
+    use_deviation_thresholds: bool = False
+    node_selector: Optional[Dict[str, str]] = None
+    resource_weights: Dict[ResourceName, int] = dataclasses.field(
+        default_factory=lambda: {ResourceName.CPU: 1, ResourceName.MEMORY: 1}
+    )
+    # anomaly debounce (reference: LoadAnomalyCondition)
+    consecutive_abnormalities: int = 1
+
+
+@dataclasses.dataclass
+class LowNodeLoadArgs:
+    """Plugin args (reference: apis/config LowNodeLoadArgs)."""
+
+    node_pools: Sequence[NodePool] = dataclasses.field(
+        default_factory=lambda: [NodePool()]
+    )
+    paused: bool = False
+    dry_run: bool = False
+    node_fit: bool = True
+    number_of_nodes: int = 0
+    node_metric_expiration_seconds: Optional[float] = 180.0
+    # pod filter: which pods are candidates for eviction at all
+    pod_filter: Optional[Callable[[PodSpec], bool]] = None
+
+
+def _percent_vec(thresholds: Dict[ResourceName, int]) -> np.ndarray:
+    vec = np.full(NUM_RESOURCES, -1, dtype=np.int64)
+    for r, p in thresholds.items():
+        vec[int(r)] = p
+    return vec
+
+
+class LowNodeLoad(BalancePlugin):
+    name = "LowNodeLoad"
+
+    def __init__(self, args: Optional[LowNodeLoadArgs] = None):
+        self.args = args or LowNodeLoadArgs()
+        self.detectors: Dict[str, BasicDetector] = {}
+
+    # -- usage gathering (reference: utilization_util.go getNodeUsage) -----
+    def _gather(self, pool: NodePool, snapshot: ClusterSnapshot,
+                processed: set):
+        nodes: List[NodeSpec] = []
+        for node in snapshot.nodes:
+            if node.name in processed:
+                continue
+            if pool.node_selector and not all(
+                node.labels.get(k) == v for k, v in pool.node_selector.items()
+            ):
+                continue
+            nodes.append(node)
+        usage = np.zeros((len(nodes), NUM_RESOURCES), dtype=np.int64)
+        alloc = np.zeros((len(nodes), NUM_RESOURCES), dtype=np.int64)
+        fresh = np.zeros(len(nodes), dtype=bool)
+        schedulable = np.zeros(len(nodes), dtype=bool)
+        expiry = self.args.node_metric_expiration_seconds
+        for i, node in enumerate(nodes):
+            alloc[i] = resources_to_vector(node.allocatable)
+            schedulable[i] = not node.unschedulable
+            metric = snapshot.node_metrics.get(node.name)
+            if metric is None:
+                continue
+            if expiry is not None and snapshot.now - metric.update_time > expiry:
+                continue
+            fresh[i] = True
+            usage[i] = resources_to_vector(metric.node_usage)
+        return nodes, usage, alloc, fresh, schedulable
+
+    # -- the Balance extension point (reference: low_node_load.go:134) -----
+    def balance(self, snapshot: ClusterSnapshot, evictor: Evictor) -> None:
+        if self.args.paused:
+            return
+        processed: set = set()
+        for pool in self.args.node_pools:
+            self._process_pool(pool, snapshot, evictor, processed)
+
+    def _process_pool(self, pool: NodePool, snapshot: ClusterSnapshot,
+                      evictor: Evictor, processed: set) -> None:
+        nodes, usage, alloc, fresh, schedulable = self._gather(
+            pool, snapshot, processed
+        )
+        if not nodes:
+            return
+        verdict = classify_nodes(
+            jnp.asarray(usage),
+            jnp.asarray(alloc),
+            jnp.asarray(_percent_vec(pool.low_thresholds)),
+            jnp.asarray(_percent_vec(pool.high_thresholds)),
+            jnp.asarray(fresh),
+            jnp.asarray(schedulable),
+            use_deviation=pool.use_deviation_thresholds,
+        )
+        low = np.asarray(verdict.low)
+        high = np.asarray(verdict.high)
+        over_res = np.asarray(verdict.over_resource)
+        high_q = np.asarray(verdict.high_quantity)
+
+        source_idx = [i for i in np.flatnonzero(high)]
+        for i in source_idx:
+            processed.add(nodes[i].name)
+        # a normal observation breaks mid-load nodes' abnormal streaks so
+        # non-consecutive spikes don't accumulate (the reference expires
+        # streaks via the detector cache timeout; an explicit normal mark
+        # is the equivalent debounce)
+        high_names = {nodes[i].name for i in source_idx}
+        for i in range(len(nodes)):
+            if fresh[i] and nodes[i].name not in high_names:
+                det = self.detectors.get(nodes[i].name)
+                if det is not None:
+                    det.mark(True)
+        if not source_idx:
+            return
+
+        # anomaly debounce (reference: :258 filterRealAbnormalNodes)
+        abnormal_idx = []
+        for i in source_idx:
+            det = self.detectors.get(nodes[i].name)
+            if det is None:
+                det = self.detectors[nodes[i].name] = BasicDetector(
+                    nodes[i].name,
+                    consecutive_abnormalities=pool.consecutive_abnormalities,
+                )
+            if (
+                pool.consecutive_abnormalities <= 1
+                or det.mark(False) == State.ANOMALY
+            ):
+                abnormal_idx.append(i)
+        if not abnormal_idx:
+            return
+
+        low_idx = list(np.flatnonzero(low))
+        for i in low_idx:
+            det = self.detectors.get(nodes[i].name)
+            if det is not None:
+                det.reset()
+        if not low_idx:
+            return
+        if len(low_idx) <= self.args.number_of_nodes:
+            return
+        if len(low_idx) == len(nodes):
+            return
+
+        # destination headroom: Σ over low nodes of (high threshold − usage),
+        # tracked only on thresholded resources (the reference's
+        # resourceNames set — union of low and high threshold names,
+        # utilization_util.go newThresholds)
+        thresholded = (
+            (_percent_vec(pool.low_thresholds) >= 0)
+            | (_percent_vec(pool.high_thresholds) >= 0)
+        )
+        available = np.zeros(NUM_RESOURCES, dtype=np.int64)
+        for i in low_idx:
+            available += high_q[i] - usage[i]
+
+        weights = np.zeros(NUM_RESOURCES, dtype=np.int64)
+        for r, w in pool.resource_weights.items():
+            weights[int(r)] = w
+
+        # heaviest source nodes first (reference: sortNodesByUsage desc)
+        def node_score(i):
+            cap = np.maximum(alloc[i], 1)
+            pct = usage[i] * 100 // cap
+            wsum = max(int(weights.sum()), 1)
+            return int((pct * weights).sum() // wsum)
+
+        abnormal_idx.sort(key=node_score, reverse=True)
+        # one pass over the pod list, not one per source node
+        pods_by_node: Dict[str, List[PodSpec]] = {}
+        for pod in snapshot.pods:
+            if pod.node_name:
+                pods_by_node.setdefault(pod.node_name, []).append(pod)
+        low_arr = np.asarray(low_idx, dtype=np.int64)
+        for i in abnormal_idx:
+            self._evict_from_node(
+                pool, snapshot, evictor, nodes[i],
+                pods_by_node.get(nodes[i].name, []), usage[i], high_q[i],
+                over_res[i], available, thresholded, weights,
+                alloc, usage, low_arr,
+            )
+
+    def _pod_usage(self, snapshot, pod) -> np.ndarray:
+        metric = snapshot.node_metrics.get(pod.node_name or "")
+        if metric is not None and pod.uid in metric.pod_usages:
+            return resources_to_vector(metric.pod_usages[pod.uid])
+        return resources_to_vector(pod.requests)
+
+    def _evict_from_node(
+        self, pool, snapshot, evictor, node, node_pods, node_usage,
+        node_high_q, node_over, available, thresholded, weights, alloc,
+        usage, low_arr,
+    ) -> None:
+        removable = []
+        for pod in node_pods:
+            if pod.is_daemonset:
+                continue
+            if self.args.pod_filter is not None and not self.args.pod_filter(pod):
+                continue
+            if not evictor.filter(pod):
+                continue
+            if self.args.node_fit and not self._fits_any(
+                pod, alloc, usage, low_arr
+            ):
+                continue
+            removable.append(pod)
+        if not removable:
+            return
+
+        # evict biggest consumers of the *overused* resources first
+        # (reference: sortPodsOnOneOverloadedNode — weights zeroed for
+        # resources the node is not overusing)
+        over_weights = np.where(node_over, weights, 0)
+
+        def pod_score(pod):
+            u = self._pod_usage(snapshot, pod)
+            cap = np.maximum(resources_to_vector(node.allocatable), 1)
+            wsum = max(int(over_weights.sum()), 1)
+            return int((u * 100 // cap * over_weights).sum() // wsum)
+
+        removable.sort(key=pod_score, reverse=True)
+        for pod in removable:
+            # stop once the node is back under every high threshold or the
+            # destination headroom is gone (reference: continueEvictionCond)
+            if not ((node_usage > node_high_q).any()):
+                det = self.detectors.get(node.name)
+                if det is not None:
+                    det.reset()
+                return
+            if (available[thresholded] <= 0).any():
+                return
+            if not evictor.evict(snapshot, pod, reason=(
+                f"node {node.name} over-utilized"
+            )):
+                continue
+            u = self._pod_usage(snapshot, pod)
+            available -= u
+            node_usage -= u
+
+    def _fits_any(self, pod, alloc, usage, low_arr) -> bool:
+        """nodeFit gate (reference: nodeutil.PodFitsAnyNode): some
+        underutilized node has headroom for the pod's request."""
+        if low_arr.size == 0:
+            return False
+        req = resources_to_vector(pod.requests)
+        fits = (usage[low_arr] + req[None, :]) <= alloc[low_arr]
+        return bool(fits.all(axis=1).any())

@@ -1,0 +1,75 @@
+"""Vectorized node-utilization classification for the descheduler.
+
+Semantics oracle: pkg/descheduler/framework/plugins/loadaware/
+{low_node_load.go:286-326, utilization_util.go getNodeThresholds /
+isNodeOverutilized / isNodeUnderutilized / calcAverageResourceUsagePercent}.
+The reference classifies nodes one by one; here the whole (nodes ×
+resources) matrix classifies in one fused XLA computation so a 5k-node
+pool (BASELINE config #5) is a single device pass.
+
+Threshold quantities follow the reference exactly:
+``q = int(percent * 0.01 * capacity)`` (truncation), a node is
+*underutilized* iff usage <= low_q on every thresholded resource, and
+*overutilized* iff usage > high_q on any. A percent of -1 marks an unset
+threshold: the resource never triggers (its threshold becomes capacity).
+Deviation mode offsets thresholds by the pool's average utilization
+percent.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RebalanceVerdict(NamedTuple):
+    low: jax.Array          # [N] bool: underutilized
+    high: jax.Array         # [N] bool: overutilized
+    over_resource: jax.Array  # [N, R] bool: which resources are over
+    low_quantity: jax.Array   # [N, R] i32 resolved low threshold quantities
+    high_quantity: jax.Array  # [N, R] i32 resolved high threshold quantities
+
+
+def classify_nodes(
+    usage: jax.Array,        # [N, R] int
+    alloc: jax.Array,        # [N, R] int capacity/allocatable
+    low_percent: jax.Array,  # [R] int, -1 = unset
+    high_percent: jax.Array,  # [R] int, -1 = unset
+    active: jax.Array,       # [N] bool: nodes participating (pool + fresh
+                             # metric, reference low_node_load.go:153)
+    schedulable: jax.Array,  # [N] bool: unschedulable nodes can't be "low"
+    use_deviation: bool = False,
+) -> RebalanceVerdict:
+    usage = usage.astype(jnp.int32)
+    alloc = alloc.astype(jnp.int32)
+    thresholded = low_percent >= 0
+
+    low_p = jnp.where(thresholded, low_percent, 100).astype(jnp.int32)
+    high_p = jnp.where(high_percent >= 0, high_percent, 100).astype(jnp.int32)
+
+    if use_deviation:
+        # pool-average utilization percent per resource (reference:
+        # calcAverageResourceUsagePercent — mean over active nodes of
+        # usage*100/capacity, integer division per node)
+        node_pct = jnp.where(
+            alloc > 0, usage * 100 // jnp.maximum(alloc, 1), 0
+        )
+        n_active = jnp.maximum(active.sum(), 1)
+        avg = (node_pct * active[:, None]).sum(axis=0) // n_active
+        low_p = jnp.clip(avg - low_p, 0, 100)
+        high_p = jnp.clip(avg + high_p, 0, 100)
+        low_p = jnp.where(thresholded, low_p, 100)
+        high_p = jnp.where(high_percent >= 0, high_p, 100)
+
+    # q = trunc(percent * 0.01 * capacity), exact in integer math
+    low_q = low_p[None, :] * alloc // 100
+    high_q = high_p[None, :] * alloc // 100
+
+    under_each = usage <= low_q
+    over_each = (usage > high_q) & (high_percent >= 0)[None, :]
+
+    low = under_each.all(axis=1) & active & schedulable
+    high = over_each.any(axis=1) & active
+    return RebalanceVerdict(low, high, over_each, low_q, high_q)

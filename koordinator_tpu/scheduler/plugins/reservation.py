@@ -48,9 +48,13 @@ _MATCH_KEY = "__resv_matched__"
 
 
 def reservation_matches_pod(resv: ReservationSpec, pod: PodSpec) -> bool:
-    """Owner match: every owner label must be present on the pod."""
+    """Owner match: explicit pod-uid owners (migration reservations,
+    reference: reservation_types.go ReservationOwner.Object) or label
+    owners (every owner label present on the pod)."""
     if resv.state != ReservationState.AVAILABLE or resv.node_name is None:
         return False
+    if resv.owner_pod_uids:
+        return pod.uid in resv.owner_pod_uids
     if not resv.owner_labels:
         return False
     return all(pod.labels.get(k) == v for k, v in resv.owner_labels.items())

@@ -1,0 +1,343 @@
+"""Descheduler tests: classification op, LowNodeLoad, anomaly debounce,
+migration controller + arbitrator (mirrors reference
+low_node_load_test.go / controller_test.go / arbitrator_test.go)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    MigrationPhase,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+)
+from koordinator_tpu.descheduler import (
+    Arbitrator,
+    BasicDetector,
+    Descheduler,
+    DirectEvictor,
+    EvictionLimiter,
+    LowNodeLoad,
+    LowNodeLoadArgs,
+    MigrationController,
+    MigrationEvictor,
+    NodePool,
+    Profile,
+)
+from koordinator_tpu.descheduler.anomaly import State
+from koordinator_tpu.ops.rebalance import classify_nodes
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+
+
+def pvec(d):
+    v = np.full(NUM_RESOURCES, -1, dtype=np.int64)
+    for k, val in d.items():
+        v[int(k)] = val
+    return v
+
+
+class TestClassifyOp:
+    def test_basic_classification(self):
+        alloc = np.tile(np.array([[0] * NUM_RESOURCES]), (3, 1))
+        alloc[:, CPU] = 10000
+        usage = np.zeros_like(alloc)
+        usage[0, CPU] = 1000   # 10% → low
+        usage[1, CPU] = 5000   # 50% → neither
+        usage[2, CPU] = 9000   # 90% → high
+        v = classify_nodes(
+            jnp.asarray(usage), jnp.asarray(alloc),
+            jnp.asarray(pvec({CPU: 30})), jnp.asarray(pvec({CPU: 70})),
+            jnp.ones(3, bool), jnp.ones(3, bool),
+        )
+        assert list(np.asarray(v.low)) == [True, False, False]
+        assert list(np.asarray(v.high)) == [False, False, True]
+
+    def test_under_requires_all_over_requires_any(self):
+        alloc = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+        alloc[0, CPU] = 10000
+        alloc[0, MEM] = 1000
+        usage = np.zeros_like(alloc)
+        usage[0, CPU] = 1000   # under cpu low
+        usage[0, MEM] = 900    # over mem high
+        v = classify_nodes(
+            jnp.asarray(usage), jnp.asarray(alloc),
+            jnp.asarray(pvec({CPU: 30, MEM: 30})),
+            jnp.asarray(pvec({CPU: 70, MEM: 70})),
+            jnp.ones(1, bool), jnp.ones(1, bool),
+        )
+        assert not bool(np.asarray(v.low)[0])
+        assert bool(np.asarray(v.high)[0])
+
+    def test_deviation_mode(self):
+        alloc = np.zeros((2, NUM_RESOURCES), dtype=np.int64)
+        alloc[:, CPU] = 10000
+        usage = np.zeros_like(alloc)
+        usage[0, CPU] = 2000  # 20%
+        usage[1, CPU] = 8000  # 80%  avg=50
+        v = classify_nodes(
+            jnp.asarray(usage), jnp.asarray(alloc),
+            jnp.asarray(pvec({CPU: 10})), jnp.asarray(pvec({CPU: 10})),
+            jnp.ones(2, bool), jnp.ones(2, bool), use_deviation=True,
+        )
+        # thresholds become low=40%, high=60%
+        assert list(np.asarray(v.low)) == [True, False]
+        assert list(np.asarray(v.high)) == [False, True]
+
+    def test_stale_node_inactive(self):
+        alloc = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+        alloc[0, CPU] = 10000
+        usage = np.zeros_like(alloc)
+        usage[0, CPU] = 9900
+        v = classify_nodes(
+            jnp.asarray(usage), jnp.asarray(alloc),
+            jnp.asarray(pvec({CPU: 30})), jnp.asarray(pvec({CPU: 70})),
+            jnp.zeros(1, bool), jnp.ones(1, bool),
+        )
+        assert not bool(np.asarray(v.high)[0])
+
+
+class TestAnomalyDetector:
+    def test_debounce(self):
+        det = BasicDetector("n", consecutive_abnormalities=2)
+        assert det.mark(False) == State.OK
+        assert det.mark(False) == State.OK
+        assert det.mark(False) == State.ANOMALY
+
+    def test_normal_resets_streak(self):
+        det = BasicDetector("n", consecutive_abnormalities=2)
+        det.mark(False)
+        det.mark(False)
+        det.mark(True)
+        assert det.mark(False) == State.OK
+
+
+def make_cluster(n_nodes=4, overloaded=(0,), underloaded=(2, 3)):
+    nodes, pods, metrics = [], [], {}
+    for i in range(n_nodes):
+        name = f"node-{i}"
+        nodes.append(NodeSpec(name=name, allocatable={CPU: 10000, MEM: 10000}))
+        if i in overloaded:
+            usage = {CPU: 9000, MEM: 5000}
+            for j in range(3):
+                pods.append(PodSpec(
+                    name=f"app-{i}-{j}", node_name=name,
+                    requests={CPU: 2000, MEM: 1000},
+                ))
+            metrics[name] = NodeMetric(
+                node_name=name, node_usage=usage, update_time=100.0,
+                pod_usages={
+                    f"default/app-{i}-{j}": {CPU: 2500, MEM: 1200}
+                    for j in range(3)
+                },
+            )
+        else:
+            usage = {CPU: 5000 if i in underloaded else 6000, MEM: 2000}
+            if i in underloaded:
+                usage = {CPU: 2000, MEM: 1000}
+            metrics[name] = NodeMetric(
+                node_name=name, node_usage=usage, update_time=100.0
+            )
+    return ClusterSnapshot(nodes=nodes, pods=pods, node_metrics=metrics,
+                          now=120.0)
+
+
+class TestLowNodeLoad:
+    def test_evicts_from_overloaded(self):
+        snapshot = make_cluster()
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 70, MEM: 70},
+        )]))
+        evictor = DirectEvictor()
+        desch = Descheduler([Profile("p", balance_plugins=[plugin])], evictor)
+        evicted = desch.run_once(snapshot)
+        assert evicted  # pods moved off node-0
+        assert all(p.node_name is None for p in evicted)
+
+    def test_stops_when_under_threshold(self):
+        snapshot = make_cluster()
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 70, MEM: 70},
+        )]))
+        evictor = DirectEvictor()
+        plugin.balance(snapshot, evictor)
+        # 9000 usage, threshold 7000: one pod (2500) → 6500 under
+        assert len(evictor.evicted) == 1
+
+    def test_no_low_nodes_no_eviction(self):
+        snapshot = make_cluster(underloaded=())
+        # make every other node mid-loaded (not under 30%)
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 70, MEM: 70},
+        )]))
+        evictor = DirectEvictor()
+        plugin.balance(snapshot, evictor)
+        assert evictor.evicted == []
+
+    def test_anomaly_debounce_delays_eviction(self):
+        snapshot = make_cluster()
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 70, MEM: 70},
+            consecutive_abnormalities=2,
+        )]))
+        evictor = DirectEvictor()
+        plugin.balance(snapshot, evictor)
+        assert evictor.evicted == []  # first observation: debounced
+        plugin.balance(snapshot, evictor)
+        assert evictor.evicted == []  # streak=2, needs > 2
+        plugin.balance(snapshot, evictor)
+        assert evictor.evicted       # third consecutive → anomaly
+
+    def test_max_per_node_enforced(self):
+        snapshot = make_cluster()
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 20, MEM: 90},  # wants to evict a lot
+        )]))
+        evictor = DirectEvictor(EvictionLimiter(max_per_node=1))
+        plugin.balance(snapshot, evictor)
+        assert len(evictor.evicted) <= 1
+
+    def test_high_only_threshold_detects_overload(self):
+        alloc = np.zeros((2, NUM_RESOURCES), dtype=np.int64)
+        alloc[:, CPU] = 10000
+        usage = np.zeros_like(alloc)
+        usage[0, CPU] = 9500
+        v = classify_nodes(
+            jnp.asarray(usage), jnp.asarray(alloc),
+            jnp.asarray(pvec({MEM: 60})),       # low only on memory
+            jnp.asarray(pvec({CPU: 70})),       # high only on cpu
+            jnp.ones(2, bool), jnp.ones(2, bool),
+        )
+        assert bool(np.asarray(v.high)[0])
+
+    def test_flapping_node_not_anomalous(self):
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 70, MEM: 70},
+            consecutive_abnormalities=2,
+        )]))
+        evictor = DirectEvictor()
+        for spike in (True, False, True, False, True):
+            snapshot = make_cluster()
+            if not spike:
+                snapshot.node_metrics["node-0"].node_usage = {
+                    CPU: 5000, MEM: 5000
+                }
+            plugin.balance(snapshot, evictor)
+        # spikes were never consecutive → debounce holds
+        assert evictor.evicted == []
+
+    def test_stale_metric_skips_node(self):
+        snapshot = make_cluster()
+        snapshot.node_metrics["node-0"].update_time = -1000.0
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 70, MEM: 70},
+        )]))
+        evictor = DirectEvictor()
+        plugin.balance(snapshot, evictor)
+        assert evictor.evicted == []
+
+    def test_eviction_limit_respected(self):
+        snapshot = make_cluster()
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            # very low high threshold → wants to evict everything
+            high_thresholds={CPU: 10, MEM: 90},
+        )]))
+        evictor = DirectEvictor(EvictionLimiter(max_per_cycle=1))
+        plugin.balance(snapshot, evictor)
+        assert len(evictor.evicted) <= 1
+
+
+class TestMigration:
+    def place(self, snapshot, reservation):
+        # trivially place on the emptiest node
+        return "node-3"
+
+    def test_reservation_first_migration(self):
+        snapshot = make_cluster()
+        plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 70, MEM: 70},
+        )]))
+        evictor = MigrationEvictor()
+        plugin.balance(snapshot, evictor)
+        assert evictor.jobs
+        controller = MigrationController(self.place)
+        controller.reconcile(snapshot, evictor.jobs)
+        done = [j for j in evictor.jobs if j.phase == MigrationPhase.SUCCEEDED]
+        assert done
+        assert snapshot.reservations  # capacity reserved before eviction
+        assert snapshot.reservations[0].node_name == "node-3"
+        # evicted pod requeued as pending
+        assert any(p.uid == done[0].pod_uid for p in snapshot.pending_pods)
+
+    def test_unplaceable_reservation_stays_pending(self):
+        snapshot = make_cluster()
+        evictor = MigrationEvictor()
+        pod = snapshot.pods[0]
+        evictor.evict(snapshot, pod, reason="test")
+        controller = MigrationController(lambda s, r: None)
+        controller.reconcile(snapshot, evictor.jobs)
+        assert evictor.jobs[0].phase == MigrationPhase.PENDING
+        assert pod.node_name is not None  # NOT evicted without capacity
+
+    def test_job_ttl_fails(self):
+        snapshot = make_cluster()
+        evictor = MigrationEvictor()
+        evictor.evict(snapshot, snapshot.pods[0], reason="test")
+        evictor.jobs[0].create_time = snapshot.now - 1000
+        controller = MigrationController(self.place)
+        controller.reconcile(snapshot, evictor.jobs)
+        assert evictor.jobs[0].phase == MigrationPhase.FAILED
+
+    def test_duplicate_job_suppressed(self):
+        snapshot = make_cluster()
+        evictor = MigrationEvictor()
+        assert evictor.evict(snapshot, snapshot.pods[0], reason="a")
+        assert not evictor.evict(snapshot, snapshot.pods[0], reason="b")
+
+    def test_arbitrator_workload_limit(self):
+        snapshot = make_cluster()
+        evictor = MigrationEvictor()
+        for pod in snapshot.pods[:3]:  # same workload app-0-*
+            pod.labels["workload"] = "app"
+            evictor.evict(snapshot, pod, reason="t")
+        arb = Arbitrator(max_migrating_per_workload=1)
+        admitted = arb.arbitrate(evictor.jobs, snapshot, [])
+        assert len(admitted) == 1
+
+    def test_migrated_pod_can_consume_reservation(self):
+        from koordinator_tpu.scheduler.plugins.reservation import (
+            reservation_matches_pod,
+        )
+        snapshot = make_cluster()
+        evictor = MigrationEvictor()
+        pod = snapshot.pods[0]
+        evictor.evict(snapshot, pod, reason="t")
+        controller = MigrationController(self.place)
+        controller.reconcile(snapshot, evictor.jobs)
+        resv = snapshot.reservations[0]
+        assert reservation_matches_pod(resv, pod)
+        other = PodSpec(name="other")
+        assert not reservation_matches_pod(resv, other)
+        assert resv.expiration_time is not None
+
+    def test_arbitrator_sorts_by_creation_time(self):
+        snapshot = make_cluster()
+        evictor = MigrationEvictor()
+        for pod in snapshot.pods[:2]:
+            evictor.evict(snapshot, pod, reason="t")
+        evictor.jobs[0].create_time = 50.0
+        evictor.jobs[1].create_time = 10.0
+        admitted = Arbitrator().arbitrate(evictor.jobs, snapshot, [])
+        assert admitted[0] is evictor.jobs[1]
