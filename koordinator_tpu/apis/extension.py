@@ -186,3 +186,11 @@ ANNOTATION_DEVICE_ALLOCATE_HINTS = f"{DOMAIN}/device-allocate-hints"
 ANNOTATION_DEVICE_JOINT_ALLOCATE = f"{DOMAIN}/device-joint-allocate"
 ANNOTATION_SOFT_EVICTION = f"{DOMAIN}/soft-eviction"
 ANNOTATION_EVICTION_COST = f"{DOMAIN}/eviction-cost"
+# node-level colocation protocol (reference: apis/extension/node.go,
+# node_colocation.go): reserved resources, cpu normalization/amplification
+ANNOTATION_NODE_RESERVATION = f"{DOMAIN}/node-reservation"
+ANNOTATION_CPU_NORMALIZATION_RATIO = f"{DOMAIN}/cpu-normalization-ratio"
+ANNOTATION_RESOURCE_AMPLIFICATION_RATIO = (
+    f"{DOMAIN}/node-resource-amplification-ratio"
+)
+ANNOTATION_NODE_RAW_ALLOCATABLE = f"{DOMAIN}/node-raw-allocatable"

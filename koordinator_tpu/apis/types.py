@@ -46,6 +46,16 @@ def vector_to_resources(vec: np.ndarray) -> Resources:
     return {ResourceName(i): int(v) for i, v in enumerate(vec) if v != 0}
 
 
+def selector_matches(
+    selector: Optional[Mapping[str, str]], labels: Mapping[str, str]
+) -> bool:
+    """k8s equality-based label selector: every selector key/value must
+    appear in ``labels``. Empty/None selector matches everything."""
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
 def add_resources(a: Resources, b: Resources) -> Resources:
     out = dict(a)
     for k, v in b.items():
@@ -98,6 +108,7 @@ class NodeSpec:
     name: str
     allocatable: Resources = dataclasses.field(default_factory=dict)
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
     unschedulable: bool = False
     # raw (pre-amplification) allocatable if cpu-normalization applies
     raw_allocatable: Optional[Resources] = None
@@ -119,6 +130,14 @@ class NodeMetric:
     # priority-class aggregated usage (prod usage mode)
     prod_usage: Resources = dataclasses.field(default_factory=dict)
     sys_usage: Resources = dataclasses.field(default_factory=dict)
+    # predictor output: reclaimable prod resources (feeds mid-tier calc;
+    # reference: NodeMetric.Status.ProdReclaimableMetric)
+    prod_reclaimable: Resources = dataclasses.field(default_factory=dict)
+    # pod uid -> priority class recorded with the metric (used for pods
+    # reported in the metric but absent from the pod list)
+    pod_priority_class: Dict[str, PriorityClass] = dataclasses.field(
+        default_factory=dict
+    )
     # percentile -> usage, for aggregated usage mode (p50/p90/p95/p99)
     aggregated_usage: Dict[int, Resources] = dataclasses.field(default_factory=dict)
     update_time: float = 0.0

@@ -20,7 +20,7 @@ import numpy as np
 
 from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
 from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
-from koordinator_tpu.apis.types import resources_to_vector
+from koordinator_tpu.apis.types import resources_to_vector, selector_matches
 from koordinator_tpu.descheduler.anomaly import BasicDetector, State
 from koordinator_tpu.descheduler.framework import BalancePlugin, Evictor
 from koordinator_tpu.ops.rebalance import classify_nodes
@@ -84,9 +84,7 @@ class LowNodeLoad(BalancePlugin):
         for node in snapshot.nodes:
             if node.name in processed:
                 continue
-            if pool.node_selector and not all(
-                node.labels.get(k) == v for k, v in pool.node_selector.items()
-            ):
+            if not selector_matches(pool.node_selector, node.labels):
                 continue
             nodes.append(node)
         usage = np.zeros((len(nodes), NUM_RESOURCES), dtype=np.int64)
@@ -249,11 +247,11 @@ class LowNodeLoad(BalancePlugin):
         # (reference: sortPodsOnOneOverloadedNode — weights zeroed for
         # resources the node is not overusing)
         over_weights = np.where(node_over, weights, 0)
+        cap = np.maximum(resources_to_vector(node.allocatable), 1)
+        wsum = max(int(over_weights.sum()), 1)
 
         def pod_score(pod):
             u = self._pod_usage(snapshot, pod)
-            cap = np.maximum(resources_to_vector(node.allocatable), 1)
-            wsum = max(int(over_weights.sum()), 1)
             return int((u * 100 // cap * over_weights).sum() // wsum)
 
         removable.sort(key=pod_score, reverse=True)

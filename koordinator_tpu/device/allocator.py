@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from koordinator_tpu.apis.types import selector_matches as _matches
 from koordinator_tpu.device.cache import (
     DeviceResourceName,
     DeviceResources,
@@ -145,12 +146,6 @@ class DeviceAllocation:
     minor: int
     resources: DeviceResources
     vf_bus_ids: List[str] = dataclasses.field(default_factory=list)
-
-
-def _matches(selector: Optional[Dict[str, str]], labels: Dict[str, str]) -> bool:
-    if not selector:
-        return True
-    return all(labels.get(k) == v for k, v in selector.items())
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,28 @@
+"""koord-manager: central controllers (noderesource overcommit, nodemetric
+collect policy, nodeslo strategy rendering) + admission webhooks.
+
+Reference layout: cmd/koord-manager + pkg/slo-controller (§2.3 of
+SURVEY.md). The reconcile loops here are batched: instead of one
+controller-runtime Reconcile per node, the noderesource controller lowers
+the whole cluster onto the array substrate and computes every node's
+batch/mid allocatable in one fused XLA program
+(koordinator_tpu.ops.overcommit).
+"""
+
+from koordinator_tpu.manager.sloconfig import (
+    ColocationStrategy,
+    NodeSLOSpec,
+    default_node_slo_spec,
+)
+from koordinator_tpu.manager.noderesource import NodeResourceController
+from koordinator_tpu.manager.nodemetric import node_metric_collect_policy
+from koordinator_tpu.manager.nodeslo import NodeSLOController
+
+__all__ = [
+    "ColocationStrategy",
+    "NodeSLOSpec",
+    "default_node_slo_spec",
+    "NodeResourceController",
+    "node_metric_collect_policy",
+    "NodeSLOController",
+]

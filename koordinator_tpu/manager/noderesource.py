@@ -1,0 +1,387 @@
+"""noderesource controller: the colocation overcommit reconciler.
+
+Reference: pkg/slo-controller/noderesource/ (noderesource_controller.go,
+resource_calculator.go, plugins_profile.go) — watches NodeMetric + Node +
+pods and writes dynamically-reclaimable batch/mid extended resources into
+``Node.status.allocatable``.
+
+TPU-native design: the reference reconciles node-by-node through a plugin
+pipeline (Setup/PreUpdate/NeedSync/Prepare/Calculate). Here ONE
+``reconcile_all`` lowers the whole cluster to arrays and computes every
+node's batch+mid allocatable in a single jitted XLA program
+(ops/overcommit.py); host-side plugins then run only the annotation-type
+preparations (cpu-normalization -> amplification) that are inherently
+string-typed. NeedSync's diff-threshold gate is part of the same fused
+program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_CPU_NORMALIZATION_RATIO,
+    ANNOTATION_NODE_RAW_ALLOCATABLE,
+    ANNOTATION_NODE_RESERVATION,
+    ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
+    NUM_RESOURCES,
+    PriorityClass,
+    QoSClass,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    resources_to_vector,
+)
+from koordinator_tpu.manager.sloconfig import ColocationConfig, ColocationStrategy
+from koordinator_tpu.ops.overcommit import (
+    CalculatePolicy,
+    NodeOvercommitInputs,
+    OvercommitParams,
+    PodOvercommitInputs,
+    needs_sync,
+    overcommit_allocatable,
+)
+
+_POLICY_BY_NAME = {
+    "usage": CalculatePolicy.USAGE,
+    "request": CalculatePolicy.REQUEST,
+    "maxUsageRequest": CalculatePolicy.MAX_USAGE_REQUEST,
+}
+
+#: Extended resource columns owned by this controller.
+OVERCOMMIT_COLUMNS = (
+    ResourceName.BATCH_CPU,
+    ResourceName.BATCH_MEMORY,
+    ResourceName.MID_CPU,
+    ResourceName.MID_MEMORY,
+)
+
+
+@dataclasses.dataclass
+class NodeResourceUpdate:
+    """One node's reconcile outcome."""
+
+    node_name: str
+    #: new values for the overcommit columns (canonical units)
+    allocatable: Dict[ResourceName, int]
+    #: whether the diff threshold requires writing back
+    synced: bool
+    #: degraded to zero because the NodeMetric was stale/missing
+    degraded: bool
+    #: annotations to set on the node (amplification etc.)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _is_metric_fresh(
+    metric: Optional[NodeMetric], strategy: ColocationStrategy, now: float
+) -> bool:
+    """Degrade gate (reference: batchresource/plugin.go:480-499
+    isDegradeNeeded): no metric or update older than DegradeTimeMinutes."""
+    if metric is None or metric.update_time <= 0:
+        return False
+    return now - metric.update_time <= strategy.degrade_time_minutes * 60
+
+
+class HostPlugin:
+    """Annotation-type noderesource plugin (host-side).
+
+    Mirrors the reference's plugin Prepare/NeedSyncMeta surface for
+    plugins whose output is node metadata rather than array math
+    (reference: plugins/{cpunormalization,resourceamplification}/).
+    """
+
+    name = "hostplugin"
+
+    def prepare(self, node: NodeSpec, update: NodeResourceUpdate) -> None:
+        raise NotImplementedError
+
+
+#: Amplification ratios beyond this are treated as malformed: real cpu
+#: normalization ratios are ~1-2x, and huge values would overflow the
+#: int32 capacity columns.
+_MAX_NORMALIZATION_RATIO = 100.0
+
+
+def _cpu_normalization_ratio(node: NodeSpec) -> Optional[float]:
+    """Parsed cpu-normalization ratio, or None when absent/malformed/not
+    amplifying (reference: extension.GetCPUNormalizationRatio: ratio <= 1
+    means no amplification)."""
+    raw = node.annotations.get(ANNOTATION_CPU_NORMALIZATION_RATIO)
+    if raw is None:
+        return None
+    try:
+        ratio = float(raw)
+    except ValueError:
+        return None
+    # rejects NaN, inf, and int32-overflowing values
+    if not 1 < ratio <= _MAX_NORMALIZATION_RATIO:
+        return None
+    return ratio
+
+
+class ResourceAmplificationPlugin(HostPlugin):
+    """Sets the node resource-amplification ratio annotation from the cpu
+    normalization ratio (reference:
+    plugins/resourceamplification/plugin.go:82-115 Calculate: ratio <= 1
+    -> no annotation; else {"cpu": ratio})."""
+
+    name = "ResourceAmplification"
+
+    def prepare(self, node: NodeSpec, update: NodeResourceUpdate) -> None:
+        ratio = _cpu_normalization_ratio(node)
+        if ratio is None:
+            return
+        update.annotations[ANNOTATION_RESOURCE_AMPLIFICATION_RATIO] = (
+            json.dumps({"cpu": ratio})
+        )
+
+
+class CPUNormalizationPlugin(HostPlugin):
+    """Amplifies node CPU allocatable by the normalization ratio, keeping
+    the raw value in an annotation (reference:
+    plugins/cpunormalization/plugin.go Prepare + extension
+    GetCPUNormalizationRatio). Amplification applies to the native CPU
+    column the scheduler sees; when the ratio is removed or drops to <= 1
+    the raw allocatable is restored."""
+
+    name = "CPUNormalization"
+
+    def prepare(self, node: NodeSpec, update: NodeResourceUpdate) -> None:
+        ratio = _cpu_normalization_ratio(node)
+        if ratio is None:
+            if node.raw_allocatable is not None:
+                node.allocatable[ResourceName.CPU] = node.raw_allocatable.get(
+                    ResourceName.CPU, node.allocatable.get(ResourceName.CPU, 0)
+                )
+                node.raw_allocatable = None
+            return
+        base_cpu = node.allocatable.get(ResourceName.CPU, 0)
+        if node.raw_allocatable is None:
+            node.raw_allocatable = dict(node.allocatable)
+        else:
+            base_cpu = node.raw_allocatable.get(ResourceName.CPU, base_cpu)
+        node.allocatable[ResourceName.CPU] = int(base_cpu * ratio)
+        update.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
+            {"cpu": base_cpu}
+        )
+
+
+@partial(jax.jit, static_argnames=())
+def _overcommit_step(nodes, pods, params, old_alloc, diff_threshold_percent,
+                     enabled):
+    new_alloc = overcommit_allocatable(nodes, pods, params)
+    # strategy disabled -> batch/mid resources are withdrawn (the
+    # reference resets the extended resources when colocation turns off),
+    # and needs_sync then fires iff the old values were nonzero
+    new_alloc = jnp.where(enabled[:, None], new_alloc, 0)
+    sync = needs_sync(old_alloc, new_alloc, diff_threshold_percent)
+    return new_alloc, sync
+
+
+class NodeResourceController:
+    """Batched equivalent of the noderesource reconciler."""
+
+    def __init__(self, config: Optional[ColocationConfig] = None,
+                 plugins: Optional[Sequence[HostPlugin]] = None):
+        self.config = config or ColocationConfig(
+            cluster_strategy=ColocationStrategy(enable=True)
+        )
+        self.plugins: List[HostPlugin] = list(
+            plugins
+            if plugins is not None
+            else (CPUNormalizationPlugin(), ResourceAmplificationPlugin())
+        )
+
+    # -- lowering -----------------------------------------------------------
+
+    def _lower_nodes(
+        self, snapshot: ClusterSnapshot, strategies: List[ColocationStrategy]
+    ) -> NodeOvercommitInputs:
+        n = len(snapshot.nodes)
+        capacity = np.zeros((n, NUM_RESOURCES), np.int32)
+        system_used = np.zeros((n, NUM_RESOURCES), np.int32)
+        reserved = np.zeros((n, NUM_RESOURCES), np.int32)
+        prod_reclaimable = np.zeros((n, NUM_RESOURCES), np.int32)
+        fresh = np.zeros(n, bool)
+        for i, node in enumerate(snapshot.nodes):
+            capacity[i] = resources_to_vector(node.allocatable)
+            metric = snapshot.node_metrics.get(node.name)
+            fresh[i] = _is_metric_fresh(metric, strategies[i], snapshot.now)
+            if metric is not None:
+                system_used[i] = resources_to_vector(metric.sys_usage)
+                prod_reclaimable[i] = resources_to_vector(
+                    metric.prod_reclaimable
+                )
+            anno = node.annotations.get(ANNOTATION_NODE_RESERVATION)
+            if anno:
+                # malformed annotations on one node must not abort the
+                # cluster-wide reconcile
+                try:
+                    spec = json.loads(anno)
+                    if isinstance(spec, dict):
+                        reserved[i, ResourceName.CPU] = int(spec.get("cpu", 0))
+                        reserved[i, ResourceName.MEMORY] = int(
+                            spec.get("memory", 0)
+                        )
+                except (ValueError, TypeError):
+                    reserved[i] = 0
+        return NodeOvercommitInputs(
+            capacity=jnp.asarray(capacity),
+            system_used=jnp.asarray(system_used),
+            reserved=jnp.asarray(reserved),
+            prod_reclaimable=jnp.asarray(prod_reclaimable),
+            metric_fresh=jnp.asarray(fresh),
+        )
+
+    def _lower_pods(
+        self, snapshot: ClusterSnapshot, node_index: Dict[str, int]
+    ) -> PodOvercommitInputs:
+        rows = []  # (node_idx, req, usage, has_metric, is_hp, is_lse)
+        seen_uids = set()
+        for pod in snapshot.pods:
+            idx = node_index.get(pod.node_name or "", -1)
+            metric = snapshot.node_metrics.get(pod.node_name or "")
+            usage = None
+            if metric is not None and pod.uid in metric.pod_usages:
+                usage = resources_to_vector(metric.pod_usages[pod.uid])
+                seen_uids.add(pod.uid)
+            is_hp = pod.priority_class not in (
+                PriorityClass.BATCH,
+                PriorityClass.FREE,
+            )
+            rows.append((
+                idx,
+                resources_to_vector(pod.requests),
+                usage if usage is not None else np.zeros(NUM_RESOURCES, np.int64),
+                usage is not None,
+                is_hp,
+                pod.qos is QoSClass.LSE,
+            ))
+        # dangling: reported in NodeMetric but absent from the pod list
+        # (reference: plugin.go:295-303). Modeled as req=0 rows; priority
+        # from the metric's recorded class, defaulting to HP.
+        for node_name, metric in snapshot.node_metrics.items():
+            idx = node_index.get(node_name, -1)
+            for uid, usage in metric.pod_usages.items():
+                if uid in seen_uids:
+                    continue
+                cls = metric.pod_priority_class.get(uid, PriorityClass.PROD)
+                if cls in (PriorityClass.BATCH, PriorityClass.FREE):
+                    continue
+                rows.append((
+                    idx,
+                    np.zeros(NUM_RESOURCES, np.int64),
+                    resources_to_vector(usage),
+                    True,
+                    True,
+                    False,
+                ))
+        if not rows:
+            rows.append((
+                -1,
+                np.zeros(NUM_RESOURCES, np.int64),
+                np.zeros(NUM_RESOURCES, np.int64),
+                False,
+                False,
+                False,
+            ))
+        idxs, reqs, usages, has_metric, is_hp, is_lse = zip(*rows)
+        return PodOvercommitInputs(
+            node_idx=jnp.asarray(np.array(idxs, np.int32)),
+            req=jnp.asarray(np.stack(reqs).astype(np.int32)),
+            usage=jnp.asarray(np.stack(usages).astype(np.int32)),
+            has_metric=jnp.asarray(np.array(has_metric, bool)),
+            is_hp=jnp.asarray(np.array(is_hp, bool)),
+            is_lse=jnp.asarray(np.array(is_lse, bool)),
+            active=jnp.ones(len(rows), dtype=bool),
+        )
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile_all(self, snapshot: ClusterSnapshot) -> List[NodeResourceUpdate]:
+        """Compute every node's batch/mid allocatable; returns one update
+        per node with the NeedSync decision already applied. Mutates the
+        snapshot's NodeSpec.allocatable for synced nodes (the reference
+        PATCHes Node.status.allocatable)."""
+        if not snapshot.nodes:
+            return []
+        strategies = [
+            self.config.strategy_for_node(n.labels) for n in snapshot.nodes
+        ]
+        updates: List[NodeResourceUpdate] = []
+
+        # host plugins first: they may rewrite native allocatable
+        # (cpu normalization) which feeds the array pass
+        pre = [
+            NodeResourceUpdate(n.name, {}, synced=False, degraded=False)
+            for n in snapshot.nodes
+        ]
+        for plugin in self.plugins:
+            for node, upd in zip(snapshot.nodes, pre):
+                plugin.prepare(node, upd)
+
+        node_index = {n.name: i for i, n in enumerate(snapshot.nodes)}
+        nodes_in = self._lower_nodes(snapshot, strategies)
+        pods_in = self._lower_pods(snapshot, node_index)
+
+        # per-node strategy knobs as [N,...] arrays: node-selector
+        # overrides cost nothing extra — still ONE fused dispatch
+        n = len(snapshot.nodes)
+        old_alloc = np.zeros((n, NUM_RESOURCES), np.int32)
+        reclaim = np.zeros((n, NUM_RESOURCES), np.int32)
+        mid_thr = np.zeros((n, NUM_RESOURCES), np.int32)
+        cpu_pol = np.zeros(n, np.int32)
+        mem_pol = np.zeros(n, np.int32)
+        diff_thr = np.zeros(n, np.int32)
+        enabled = np.zeros(n, bool)
+        for i, (node, s) in enumerate(zip(snapshot.nodes, strategies)):
+            for col in OVERCOMMIT_COLUMNS:
+                old_alloc[i, col] = node.allocatable.get(col, 0)
+            reclaim[i, ResourceName.CPU] = s.cpu_reclaim_threshold_percent
+            reclaim[i, ResourceName.MEMORY] = s.memory_reclaim_threshold_percent
+            mid_thr[i, ResourceName.CPU] = s.mid_cpu_threshold_percent
+            mid_thr[i, ResourceName.MEMORY] = s.mid_memory_threshold_percent
+            cpu_pol[i] = _POLICY_BY_NAME.get(
+                s.cpu_calculate_policy, CalculatePolicy.USAGE
+            )
+            mem_pol[i] = _POLICY_BY_NAME.get(
+                s.memory_calculate_policy, CalculatePolicy.USAGE
+            )
+            diff_thr[i] = int(round(s.resource_diff_threshold * 100))
+            enabled[i] = s.enable
+
+        params = OvercommitParams(
+            reclaim_percent=jnp.asarray(reclaim),
+            mid_threshold_percent=jnp.asarray(mid_thr),
+            cpu_policy=jnp.asarray(cpu_pol),
+            memory_policy=jnp.asarray(mem_pol),
+        )
+        alloc, sync = _overcommit_step(
+            nodes_in, pods_in, params, jnp.asarray(old_alloc),
+            jnp.asarray(diff_thr), jnp.asarray(enabled),
+        )
+        new_alloc = np.asarray(alloc)
+        sync_mask = np.asarray(sync)
+
+        fresh_np = np.asarray(nodes_in.metric_fresh)
+        for i, node in enumerate(snapshot.nodes):
+            upd = pre[i]
+            upd.allocatable = {
+                col: int(new_alloc[i, col]) for col in OVERCOMMIT_COLUMNS
+            }
+            upd.synced = bool(sync_mask[i])
+            upd.degraded = bool(enabled[i]) and not bool(fresh_np[i])
+            if upd.synced:
+                node.allocatable.update(upd.allocatable)
+            updates.append(upd)
+        return updates

@@ -1,0 +1,65 @@
+"""nodeslo controller: renders per-node NodeSLO specs from cluster config.
+
+Reference: pkg/slo-controller/nodeslo/{nodeslo_controller.go,
+resource_strategy.go, extender_plugin.go} — merges the cluster strategy
+ConfigMaps (threshold, QoS, CPU burst, system) with node-selector
+overrides into one NodeSLO CR per node, extensible via extender plugins.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from koordinator_tpu.apis.types import selector_matches
+from koordinator_tpu.manager.sloconfig import (
+    NodeSLOSpec,
+    default_node_slo_spec,
+    merge_overrides,
+)
+
+#: Extender plugin: (node_name, node_labels, spec) -> None, may mutate
+#: spec.extensions (reference: nodeslo/extender_plugin.go
+#: NodeSLOExtender interface).
+NodeSLOExtender = Callable[[str, Dict[str, str], NodeSLOSpec], None]
+
+
+@dataclasses.dataclass
+class NodeSLOOverride:
+    """A node-selector-scoped strategy override (reference:
+    configuration.NodeStrategy in the nodeSLO ConfigMaps). ``overrides``
+    holds only the fields the override sets, nested dicts mirroring the
+    NodeSLOSpec structure (JSON-merge-patch semantics)."""
+
+    match_labels: Dict[str, str]
+    overrides: Dict = dataclasses.field(default_factory=dict)
+
+
+class NodeSLOController:
+    """Renders NodeSLO specs: cluster default -> matching overrides ->
+    extender plugins."""
+
+    def __init__(
+        self,
+        cluster_spec: Optional[NodeSLOSpec] = None,
+        overrides: Optional[List[NodeSLOOverride]] = None,
+        extenders: Optional[List[NodeSLOExtender]] = None,
+    ):
+        self.cluster_spec = cluster_spec or default_node_slo_spec()
+        self.overrides = overrides or []
+        self.extenders = extenders or []
+
+    def render(self, node_name: str, node_labels: Dict[str, str]) -> NodeSLOSpec:
+        spec = copy.deepcopy(self.cluster_spec)
+        for ov in self.overrides:
+            if selector_matches(ov.match_labels, node_labels):
+                spec = merge_overrides(spec, ov.overrides)
+        for ext in self.extenders:
+            ext(node_name, node_labels, spec)
+        return spec
+
+    def reconcile_all(
+        self, nodes: List[Tuple[str, Dict[str, str]]]
+    ) -> Dict[str, NodeSLOSpec]:
+        return {name: self.render(name, labels) for name, labels in nodes}

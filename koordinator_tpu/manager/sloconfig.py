@@ -1,0 +1,266 @@
+"""Cluster SLO configuration: colocation strategy + NodeSLO strategies.
+
+Reference: apis/configuration/slo_controller_config.go (schema) and
+pkg/util/sloconfig/{colocation_config.go,nodeslo_config.go} (defaults).
+The reference stores these in `koordinator-system` ConfigMaps; here they
+are plain dataclasses parsed from dicts (the ConfigMap JSON payloads),
+with the same default values and the same per-node override merge
+(cluster strategy -> node-selector strategies -> node annotation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.apis.types import selector_matches
+
+
+def merge_overrides(base, overrides: Dict):
+    """Recursive JSON-merge-patch overlay: only keys present in
+    ``overrides`` change; nested dicts recurse into nested dataclasses
+    (reference: the sloconfig ConfigMap node-strategy merge, which
+    strategic-merges only the fields the override JSON sets). Returns a
+    new dataclass; ``base`` is not mutated."""
+    import copy
+
+    out = copy.deepcopy(base)
+    for key, value in overrides.items():
+        if not hasattr(out, key):
+            continue
+        current = getattr(out, key)
+        if isinstance(value, dict) and dataclasses.is_dataclass(current):
+            setattr(out, key, merge_overrides(current, value))
+        else:
+            setattr(out, key, value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Colocation strategy (drives noderesource + nodemetric)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ColocationStrategy:
+    """Reference: configuration.ColocationStrategy with defaults from
+    pkg/util/sloconfig/colocation_config.go:50-75."""
+
+    enable: bool = False
+    metric_aggregate_duration_seconds: int = 300
+    metric_report_interval_seconds: int = 60
+    cpu_reclaim_threshold_percent: int = 60
+    memory_reclaim_threshold_percent: int = 65
+    degrade_time_minutes: int = 15
+    update_time_threshold_seconds: int = 300
+    resource_diff_threshold: float = 0.1
+    mid_cpu_threshold_percent: int = 100
+    mid_memory_threshold_percent: int = 100
+    # CalculatePolicy names: "usage" | "request" | "maxUsageRequest"
+    cpu_calculate_policy: str = "usage"
+    memory_calculate_policy: str = "usage"
+
+    def is_valid(self) -> bool:
+        """Reference: sloconfig.IsColocationStrategyValid
+        (colocation_config.go:77-85)."""
+        return (
+            self.metric_aggregate_duration_seconds > 0
+            and self.metric_report_interval_seconds > 0
+            and self.cpu_reclaim_threshold_percent > 0
+            and self.memory_reclaim_threshold_percent > 0
+            and self.degrade_time_minutes > 0
+            and self.update_time_threshold_seconds > 0
+            and self.resource_diff_threshold > 0
+        )
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ColocationStrategy":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class NodeStrategySelector:
+    """A node-scoped strategy override selected by labels (reference:
+    configuration.NodeColocationCfg / NodeStrategy). ``overrides`` holds
+    only the fields the override sets (JSON-merge-patch semantics)."""
+
+    match_labels: Dict[str, str]
+    overrides: Dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ColocationConfig:
+    """Cluster config + node overrides (reference:
+    configuration.ColocationCfg)."""
+
+    cluster_strategy: ColocationStrategy = dataclasses.field(
+        default_factory=ColocationStrategy
+    )
+    node_strategies: List[NodeStrategySelector] = dataclasses.field(
+        default_factory=list
+    )
+
+    def strategy_for_node(self, node_labels: Dict[str, str]) -> ColocationStrategy:
+        """Cluster strategy overlaid with the first matching node strategy
+        (reference: config_cache.go GetStrategyCopy + merge)."""
+        out = self.cluster_strategy
+        for sel in self.node_strategies:
+            if selector_matches(sel.match_labels, node_labels):
+                out = merge_overrides(out, sel.overrides)
+                break
+        return out
+
+
+# ---------------------------------------------------------------------------
+# NodeSLO strategies (rendered into per-node NodeSLO by the nodeslo
+# controller; consumed by koordlet's qosmanager)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResourceThresholdStrategy:
+    """Reference: slov1alpha1.ResourceThresholdStrategy, defaults
+    nodeslo_config.go:53-61."""
+
+    enable: bool = False
+    cpu_suppress_threshold_percent: int = 65
+    cpu_suppress_policy: str = "cpuset"  # cpuset | cfsQuota
+    memory_evict_threshold_percent: int = 70
+    memory_evict_lower_percent: Optional[int] = None  # default threshold-2
+    cpu_evict_policy: str = "evictByRealLimit"
+    cpu_evict_be_usage_threshold_percent: int = 90
+    cpu_evict_be_satisfaction_lower_percent: Optional[int] = None
+    cpu_evict_be_satisfaction_upper_percent: Optional[int] = None
+    cpu_evict_time_window_seconds: int = 60
+
+
+@dataclasses.dataclass
+class CPUQOS:
+    """Per-QoS cpu knobs (reference: slov1alpha1.CPUQOS, defaults
+    nodeslo_config.go:64-97): bvt group identity, SCHED_IDLE, core
+    expeller."""
+
+    group_identity: int = 0
+    sched_idle: int = 0
+    core_expeller: bool = False
+
+
+@dataclasses.dataclass
+class MemoryQOS:
+    """Reference: slov1alpha1.MemoryQOS (memcg qos), defaults all-off
+    (nodeslo_config.go:136-190)."""
+
+    min_limit_percent: int = 0
+    low_limit_percent: int = 0
+    throttling_percent: int = 0
+    wmark_ratio: int = 95
+    wmark_scale_permill: int = 20
+    wmark_min_adj: int = 0
+    oom_kill_group: int = 0
+    priority_enable: int = 0
+    priority: int = 0
+
+
+@dataclasses.dataclass
+class ResctrlQOS:
+    """Reference: slov1alpha1.ResctrlQOS, defaults nodeslo_config.go:
+    100-130: BE gets 0-30% of LLC ways, others full; MBA 100%."""
+
+    cat_range_start_percent: int = 0
+    cat_range_end_percent: int = 100
+    mba_percent: int = 100
+
+
+@dataclasses.dataclass
+class QoSConfig:
+    enable: bool = False
+    cpu: CPUQOS = dataclasses.field(default_factory=CPUQOS)
+    memory: MemoryQOS = dataclasses.field(default_factory=MemoryQOS)
+    resctrl: ResctrlQOS = dataclasses.field(default_factory=ResctrlQOS)
+
+
+def default_qos_config(qos: QoSClass) -> QoSConfig:
+    """Per-class defaults (reference: DefaultResourceQOSStrategy,
+    nodeslo_config.go:64-130): LSR/LS bvt=2 + core expeller, BE bvt=-1 and
+    LLC capped to 30%."""
+    cfg = QoSConfig()
+    if qos in (QoSClass.LSR, QoSClass.LS):
+        cfg.cpu = CPUQOS(group_identity=2, core_expeller=True)
+    elif qos is QoSClass.BE:
+        cfg.cpu = CPUQOS(group_identity=-1)
+        cfg.resctrl = ResctrlQOS(cat_range_end_percent=30)
+    return cfg
+
+
+@dataclasses.dataclass
+class ResourceQOSStrategy:
+    lsr: QoSConfig = dataclasses.field(
+        default_factory=lambda: default_qos_config(QoSClass.LSR)
+    )
+    ls: QoSConfig = dataclasses.field(
+        default_factory=lambda: default_qos_config(QoSClass.LS)
+    )
+    be: QoSConfig = dataclasses.field(
+        default_factory=lambda: default_qos_config(QoSClass.BE)
+    )
+    system: QoSConfig = dataclasses.field(
+        default_factory=lambda: default_qos_config(QoSClass.SYSTEM)
+    )
+
+    def for_qos(self, qos: QoSClass) -> QoSConfig:
+        return {
+            QoSClass.LSE: self.lsr,  # LSE shares LSR's knobs
+            QoSClass.LSR: self.lsr,
+            QoSClass.LS: self.ls,
+            QoSClass.BE: self.be,
+            QoSClass.SYSTEM: self.system,
+        }.get(qos, self.ls)
+
+
+@dataclasses.dataclass
+class CPUBurstStrategy:
+    """Reference: slov1alpha1.CPUBurstStrategy, defaults
+    nodeslo_config.go:360-374."""
+
+    policy: str = "none"  # none | cpuBurstOnly | cfsQuotaBurstOnly | auto
+    cpu_burst_percent: int = 1000
+    cfs_quota_burst_percent: int = 300
+    cfs_quota_burst_period_seconds: int = -1  # -1: always allowed
+    share_pool_threshold_percent: int = 50
+
+
+@dataclasses.dataclass
+class SystemStrategy:
+    """Reference: slov1alpha1.SystemStrategy, defaults
+    nodeslo_config.go:376-382."""
+
+    min_free_kbytes_factor: int = 100   # 1/10000 of total memory
+    watermark_scale_factor: int = 150   # 1/10000
+    memcg_reap_background: int = 0
+
+
+@dataclasses.dataclass
+class NodeSLOSpec:
+    """The rendered per-node SLO (reference: slov1alpha1.NodeSLOSpec)."""
+
+    resource_used_threshold_with_be: ResourceThresholdStrategy = (
+        dataclasses.field(default_factory=ResourceThresholdStrategy)
+    )
+    resource_qos_strategy: ResourceQOSStrategy = dataclasses.field(
+        default_factory=ResourceQOSStrategy
+    )
+    cpu_burst_strategy: CPUBurstStrategy = dataclasses.field(
+        default_factory=CPUBurstStrategy
+    )
+    system_strategy: SystemStrategy = dataclasses.field(
+        default_factory=SystemStrategy
+    )
+    extensions: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+def default_node_slo_spec() -> NodeSLOSpec:
+    """Reference: sloconfig.DefaultNodeSLOSpecConfig
+    (nodeslo_config.go:43-51)."""
+    return NodeSLOSpec()

@@ -35,6 +35,7 @@ from typing import Dict, List
 import numpy as np
 
 from koordinator_tpu.apis.types import (
+    selector_matches,
     PodSpec,
     ReservationSpec,
     ReservationState,
@@ -57,7 +58,7 @@ def reservation_matches_pod(resv: ReservationSpec, pod: PodSpec) -> bool:
         return pod.uid in resv.owner_pod_uids
     if not resv.owner_labels:
         return False
-    return all(pod.labels.get(k) == v for k, v in resv.owner_labels.items())
+    return selector_matches(resv.owner_labels, pod.labels)
 
 
 def reservation_free(resv: ReservationSpec) -> np.ndarray:

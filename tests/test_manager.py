@@ -1,0 +1,479 @@
+"""koord-manager tests: overcommit math, degrade, diff-threshold sync,
+collect policy, NodeSLO rendering.
+
+Semantics oracle: pkg/slo-controller/noderesource/plugins/batchresource
+(calculateBatchResourceByPolicy util.go:38-91, calculateOnNode
+plugin.go:226), midresource/plugin.go:128, nodemetric/collect_policy.go.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_CPU_NORMALIZATION_RATIO,
+    ANNOTATION_NODE_RESERVATION,
+    NUM_RESOURCES,
+    PriorityClass,
+    QoSClass,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+)
+from koordinator_tpu.manager.nodemetric import node_metric_collect_policy
+from koordinator_tpu.manager.nodeslo import NodeSLOController, NodeSLOOverride
+from koordinator_tpu.manager.noderesource import NodeResourceController
+from koordinator_tpu.manager.sloconfig import (
+    ColocationConfig,
+    ColocationStrategy,
+    NodeSLOSpec,
+    ResourceThresholdStrategy,
+    default_node_slo_spec,
+)
+from koordinator_tpu.ops.overcommit import (
+    CalculatePolicy,
+    NodeOvercommitInputs,
+    OvercommitParams,
+    PodOvercommitInputs,
+    batch_allocatable,
+    mid_allocatable,
+    needs_sync,
+)
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+BCPU, BMEM = ResourceName.BATCH_CPU, ResourceName.BATCH_MEMORY
+
+
+def _params(cpu_pct=60, mem_pct=65, cpu_policy=CalculatePolicy.USAGE,
+            mem_policy=CalculatePolicy.USAGE, mid_pct=100):
+    reclaim = np.zeros(NUM_RESOURCES, np.int32)
+    reclaim[CPU], reclaim[MEM] = cpu_pct, mem_pct
+    mid = np.zeros(NUM_RESOURCES, np.int32)
+    mid[CPU] = mid[MEM] = mid_pct
+    return OvercommitParams(
+        reclaim_percent=jnp.asarray(reclaim),
+        mid_threshold_percent=jnp.asarray(mid),
+        cpu_policy=jnp.asarray(cpu_policy, jnp.int32),
+        memory_policy=jnp.asarray(mem_policy, jnp.int32),
+    )
+
+
+def _nodes(capacity, system=None, reserved=None, reclaimable=None, fresh=None):
+    capacity = np.asarray(capacity, np.int32)
+    n = capacity.shape[0]
+    z = np.zeros_like(capacity)
+    return NodeOvercommitInputs(
+        capacity=jnp.asarray(capacity),
+        system_used=jnp.asarray(system if system is not None else z),
+        reserved=jnp.asarray(reserved if reserved is not None else z),
+        prod_reclaimable=jnp.asarray(
+            reclaimable if reclaimable is not None else z
+        ),
+        metric_fresh=jnp.asarray(
+            fresh if fresh is not None else np.ones(n, bool)
+        ),
+    )
+
+
+def _pods(node_idx, req, usage, has_metric, is_hp=None, is_lse=None):
+    p = len(node_idx)
+    return PodOvercommitInputs(
+        node_idx=jnp.asarray(np.array(node_idx, np.int32)),
+        req=jnp.asarray(np.array(req, np.int32)),
+        usage=jnp.asarray(np.array(usage, np.int32)),
+        has_metric=jnp.asarray(np.array(has_metric, bool)),
+        is_hp=jnp.asarray(
+            np.array(is_hp if is_hp is not None else [True] * p, bool)
+        ),
+        is_lse=jnp.asarray(
+            np.array(is_lse if is_lse is not None else [False] * p, bool)
+        ),
+        active=jnp.ones(p, bool),
+    )
+
+
+def _vec(cpu=0, mem=0):
+    v = np.zeros(NUM_RESOURCES, np.int64)
+    v[CPU], v[MEM] = cpu, mem
+    return v
+
+
+class TestBatchAllocatable:
+    def test_usage_policy_formula(self):
+        # cap 10000m/10000Mi, reclaim 60%/65% -> margin 4000/3500
+        # sys 1000/500, hp used 2000/1000
+        nodes = _nodes([_vec(10000, 10000)], system=[_vec(1000, 500)])
+        pods = _pods([0], [_vec(3000, 2000)], [_vec(2000, 1000)], [True])
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 10000 - 4000 - 1000 - 2000
+        assert out[0, BMEM] == 10000 - 3500 - 500 - 1000
+
+    def test_system_used_maxed_with_reserved(self):
+        # reference util.go:42: systemUsed = max(systemUsed, nodeReserved)
+        nodes = _nodes(
+            [_vec(10000, 10000)],
+            system=[_vec(500, 200)],
+            reserved=[_vec(1500, 800)],
+        )
+        pods = _pods([0], [_vec(0, 0)], [_vec(0, 0)], [True], is_hp=[False])
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 10000 - 4000 - 1500
+        assert out[0, BMEM] == 10000 - 3500 - 800
+
+    def test_no_metric_pod_counts_request(self):
+        # plugin.go:270-272: !hasMetric -> used += request
+        nodes = _nodes([_vec(10000, 10000)])
+        pods = _pods([0], [_vec(4000, 3000)], [_vec(0, 0)], [False])
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 10000 - 4000 - 4000
+        assert out[0, BMEM] == 10000 - 3500 - 3000
+
+    def test_lse_pod_mixes_cpu_request_memory_usage(self):
+        # plugin.go:273-277: LSE pods don't reclaim CPU: used gets
+        # (req.cpu, usage.mem)
+        nodes = _nodes([_vec(10000, 10000)])
+        pods = _pods(
+            [0], [_vec(4000, 3000)], [_vec(1000, 1000)], [True],
+            is_lse=[True],
+        )
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 10000 - 4000 - 4000  # req cpu
+        assert out[0, BMEM] == 10000 - 3500 - 1000  # usage mem
+
+    def test_lp_pods_ignored(self):
+        nodes = _nodes([_vec(10000, 10000)])
+        pods = _pods(
+            [0], [_vec(9000, 9000)], [_vec(9000, 9000)], [True],
+            is_hp=[False],
+        )
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 6000 and out[0, BMEM] == 6500
+
+    def test_clamped_at_zero(self):
+        nodes = _nodes([_vec(1000, 1000)], system=[_vec(900, 900)])
+        pods = _pods([0], [_vec(500, 500)], [_vec(500, 500)], [True])
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 0 and out[0, BMEM] == 0
+
+    def test_max_usage_request_policy(self):
+        # util.go:51-53: by_max subtracts max(req, usage)
+        nodes = _nodes([_vec(10000, 10000)])
+        pods = _pods([0], [_vec(3000, 1000)], [_vec(2000, 2000)], [True])
+        params = _params(
+            cpu_policy=CalculatePolicy.MAX_USAGE_REQUEST,
+            mem_policy=CalculatePolicy.MAX_USAGE_REQUEST,
+        )
+        out = np.asarray(batch_allocatable(nodes, pods, params))
+        assert out[0, BCPU] == 10000 - 4000 - 3000
+        assert out[0, BMEM] == 10000 - 3500 - 2000
+
+    def test_request_policy_memory(self):
+        # util.go:46-49: by_request subtracts reserved + hp requests
+        nodes = _nodes(
+            [_vec(10000, 10000)],
+            system=[_vec(2000, 2000)],
+            reserved=[_vec(100, 100)],
+        )
+        pods = _pods([0], [_vec(3000, 1000)], [_vec(100, 100)], [True])
+        params = _params(mem_policy=CalculatePolicy.REQUEST)
+        out = np.asarray(batch_allocatable(nodes, pods, params))
+        assert out[0, BMEM] == 10000 - 3500 - 100 - 1000
+        assert out[0, BCPU] == 10000 - 4000 - 2000 - 100  # usage policy
+
+    def test_degrade_zeroes_stale_nodes(self):
+        nodes = _nodes(
+            [_vec(10000, 10000), _vec(10000, 10000)],
+            fresh=[True, False],
+        )
+        pods = _pods([0], [_vec(0, 0)], [_vec(0, 0)], [True], is_hp=[False])
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 6000
+        assert out[1, BCPU] == 0 and out[1, BMEM] == 0
+
+    def test_multi_node_segment_sum(self):
+        nodes = _nodes([_vec(10000, 10000)] * 3)
+        pods = _pods(
+            [0, 0, 2, -1],
+            [_vec(1000, 500)] * 4,
+            [_vec(800, 400)] * 4,
+            [True] * 4,
+        )
+        out = np.asarray(batch_allocatable(nodes, pods, _params()))
+        assert out[0, BCPU] == 6000 - 1600
+        assert out[1, BCPU] == 6000
+        assert out[2, BCPU] == 6000 - 800
+
+
+class TestMidAllocatable:
+    def test_min_of_reclaimable_and_threshold(self):
+        # midresource/plugin.go:128-162
+        nodes = _nodes(
+            [_vec(10000, 10000)], reclaimable=[_vec(3000, 9000)]
+        )
+        out = np.asarray(mid_allocatable(nodes, _params(mid_pct=50)))
+        assert out[0, ResourceName.MID_CPU] == 3000      # reclaimable
+        assert out[0, ResourceName.MID_MEMORY] == 5000   # capped at 50%
+
+    def test_degraded_zero(self):
+        nodes = _nodes(
+            [_vec(10000, 10000)],
+            reclaimable=[_vec(3000, 3000)],
+            fresh=[False],
+        )
+        out = np.asarray(mid_allocatable(nodes, _params()))
+        assert out[0, ResourceName.MID_CPU] == 0
+
+
+class TestNeedsSync:
+    def test_threshold_gate(self):
+        # util/resource.go:121-126: |new-old| > old*thr
+        old = np.zeros((3, NUM_RESOURCES), np.int32)
+        new = np.zeros((3, NUM_RESOURCES), np.int32)
+        old[0, BCPU], new[0, BCPU] = 1000, 1099   # 9.9% < 10% -> no sync
+        old[1, BCPU], new[1, BCPU] = 1000, 1101   # 10.1% -> sync
+        old[2, BCPU], new[2, BCPU] = 0, 1         # zero-old nonzero-new
+        out = np.asarray(
+            needs_sync(jnp.asarray(old), jnp.asarray(new), jnp.asarray(10))
+        )
+        assert list(out) == [False, True, True]
+
+
+class TestNodeResourceController:
+    def _snapshot(self, now=1000.0):
+        node = NodeSpec(
+            "n0", allocatable={CPU: 10000, MEM: 10000},
+        )
+        pod = PodSpec(
+            "p0", requests={CPU: 3000, MEM: 2000}, priority=9500,
+            node_name="n0", qos=QoSClass.LS,
+        )
+        metric = NodeMetric(
+            "n0",
+            sys_usage={CPU: 1000, MEM: 500},
+            pod_usages={pod.uid: {CPU: 2000, MEM: 1000}},
+            update_time=now - 60,
+        )
+        return ClusterSnapshot(
+            nodes=[node], pods=[pod], node_metrics={"n0": metric}, now=now
+        )
+
+    def test_reconcile_end_to_end(self):
+        snap = self._snapshot()
+        ctrl = NodeResourceController()
+        updates = ctrl.reconcile_all(snap)
+        assert len(updates) == 1
+        upd = updates[0]
+        assert upd.allocatable[BCPU] == 10000 - 4000 - 1000 - 2000
+        assert upd.allocatable[BMEM] == 10000 - 3500 - 500 - 1000
+        assert upd.synced and not upd.degraded
+        # written back into the node for the scheduler to see
+        assert snap.nodes[0].allocatable[BCPU] == upd.allocatable[BCPU]
+
+    def test_degrade_on_stale_metric(self):
+        snap = self._snapshot()
+        snap.node_metrics["n0"].update_time = snap.now - 16 * 60
+        ctrl = NodeResourceController()
+        upd = ctrl.reconcile_all(snap)[0]
+        assert upd.degraded and upd.allocatable[BCPU] == 0
+
+    def test_dangling_pod_metric_subtracted(self):
+        # pod reported in NodeMetric but gone from pod list: its usage
+        # still subtracts (plugin.go:295-303)
+        snap = self._snapshot()
+        snap.node_metrics["n0"].pod_usages["ghost"] = {CPU: 500, MEM: 250}
+        ctrl = NodeResourceController()
+        upd = ctrl.reconcile_all(snap)[0]
+        assert upd.allocatable[BCPU] == 10000 - 4000 - 1000 - 2000 - 500
+
+    def test_dangling_lp_pod_ignored(self):
+        snap = self._snapshot()
+        snap.node_metrics["n0"].pod_usages["ghost"] = {CPU: 500}
+        snap.node_metrics["n0"].pod_priority_class["ghost"] = (
+            PriorityClass.BATCH
+        )
+        ctrl = NodeResourceController()
+        upd = ctrl.reconcile_all(snap)[0]
+        assert upd.allocatable[BCPU] == 10000 - 4000 - 1000 - 2000
+
+    def test_node_reservation_annotation(self):
+        snap = self._snapshot()
+        snap.nodes[0].annotations[ANNOTATION_NODE_RESERVATION] = (
+            '{"cpu": 1500, "memory": 800}'
+        )
+        ctrl = NodeResourceController()
+        upd = ctrl.reconcile_all(snap)[0]
+        # max(sys=1000, reserved=1500) = 1500
+        assert upd.allocatable[BCPU] == 10000 - 4000 - 1500 - 2000
+
+    def test_no_sync_when_diff_small(self):
+        snap = self._snapshot()
+        ctrl = NodeResourceController()
+        first = ctrl.reconcile_all(snap)[0]
+        assert first.synced
+        # tiny usage wiggle below the 10% diff threshold
+        snap.node_metrics["n0"].sys_usage[CPU] = 1010
+        second = ctrl.reconcile_all(snap)[0]
+        assert not second.synced
+
+    def test_disabled_strategy_no_sync(self):
+        snap = self._snapshot()
+        ctrl = NodeResourceController(
+            ColocationConfig(cluster_strategy=ColocationStrategy(enable=False))
+        )
+        upd = ctrl.reconcile_all(snap)[0]
+        assert not upd.synced
+
+    def test_disabling_withdraws_batch_resources(self):
+        # once colocation turns off, previously synced batch/mid values
+        # must be reset to zero, not left stale
+        snap = self._snapshot()
+        NodeResourceController().reconcile_all(snap)
+        assert snap.nodes[0].allocatable[BCPU] > 0
+        off = NodeResourceController(
+            ColocationConfig(cluster_strategy=ColocationStrategy(enable=False))
+        )
+        upd = off.reconcile_all(snap)[0]
+        assert upd.synced and upd.allocatable[BCPU] == 0
+        assert snap.nodes[0].allocatable[BCPU] == 0
+
+    def test_nonfinite_normalization_ratio_ignored(self):
+        for bad in ("inf", "1e400", "nan", "1e15"):
+            snap = self._snapshot()
+            snap.nodes[0].annotations[ANNOTATION_CPU_NORMALIZATION_RATIO] = bad
+            NodeResourceController().reconcile_all(snap)
+            assert snap.nodes[0].allocatable[CPU] == 10000
+
+    def test_cpu_normalization_amplifies(self):
+        snap = self._snapshot()
+        snap.nodes[0].annotations[ANNOTATION_CPU_NORMALIZATION_RATIO] = "1.5"
+        ctrl = NodeResourceController()
+        ctrl.reconcile_all(snap)
+        assert snap.nodes[0].allocatable[CPU] == 15000
+        assert snap.nodes[0].raw_allocatable[CPU] == 10000
+        # idempotent: re-reconcile doesn't compound
+        ctrl.reconcile_all(snap)
+        assert snap.nodes[0].allocatable[CPU] == 15000
+        # removing the ratio reverts to the raw allocatable
+        del snap.nodes[0].annotations[ANNOTATION_CPU_NORMALIZATION_RATIO]
+        ctrl.reconcile_all(snap)
+        assert snap.nodes[0].allocatable[CPU] == 10000
+        assert snap.nodes[0].raw_allocatable is None
+
+    def test_malformed_reservation_annotation_ignored(self):
+        # one bad annotation must not abort the cluster-wide reconcile
+        for bad in ('{"cpu": "1500m"}', "[]", "not-json"):
+            snap = self._snapshot()
+            snap.nodes[0].annotations[ANNOTATION_NODE_RESERVATION] = bad
+            upd = NodeResourceController().reconcile_all(snap)[0]
+            assert upd.allocatable[BCPU] == 10000 - 4000 - 1000 - 2000
+
+    def test_per_node_strategy_override(self):
+        from koordinator_tpu.manager.sloconfig import NodeStrategySelector
+
+        snap = self._snapshot()
+        snap.nodes.append(
+            NodeSpec("n1", allocatable={CPU: 10000, MEM: 10000},
+                     labels={"pool": "aggressive"})
+        )
+        snap.node_metrics["n1"] = NodeMetric(
+            "n1", sys_usage={CPU: 1000, MEM: 500}, update_time=snap.now - 60
+        )
+        cfg = ColocationConfig(
+            cluster_strategy=ColocationStrategy(enable=True),
+            node_strategies=[
+                NodeStrategySelector(
+                    match_labels={"pool": "aggressive"},
+                    overrides={"cpu_reclaim_threshold_percent": 80},
+                )
+            ],
+        )
+        upds = NodeResourceController(cfg).reconcile_all(snap)
+        assert upds[0].allocatable[BCPU] == 10000 - 4000 - 1000 - 2000
+        assert upds[1].allocatable[BCPU] == 10000 - 2000 - 1000
+
+
+class TestCollectPolicy:
+    def test_policy_from_strategy(self):
+        s = ColocationStrategy(enable=True)
+        p = node_metric_collect_policy(s)
+        assert p.aggregate_duration_seconds == 300
+        assert p.report_interval_seconds == 60
+
+    def test_disabled_returns_none(self):
+        assert node_metric_collect_policy(ColocationStrategy()) is None
+
+    def test_invalid_returns_none(self):
+        s = ColocationStrategy(enable=True, degrade_time_minutes=0)
+        assert node_metric_collect_policy(s) is None
+
+
+class TestNodeSLO:
+    def test_defaults(self):
+        spec = default_node_slo_spec()
+        t = spec.resource_used_threshold_with_be
+        assert t.cpu_suppress_threshold_percent == 65
+        assert t.memory_evict_threshold_percent == 70
+        assert spec.resource_qos_strategy.be.cpu.group_identity == -1
+        assert spec.resource_qos_strategy.ls.cpu.group_identity == 2
+        assert spec.resource_qos_strategy.be.resctrl.cat_range_end_percent == 30
+        assert spec.cpu_burst_strategy.cpu_burst_percent == 1000
+        assert spec.system_strategy.min_free_kbytes_factor == 100
+
+    def test_override_merge(self):
+        # tuned cluster spec: override must only touch the keys it sets
+        cluster = default_node_slo_spec()
+        cluster.resource_used_threshold_with_be.memory_evict_threshold_percent = 80
+        ctrl = NodeSLOController(
+            cluster_spec=cluster,
+            overrides=[
+                NodeSLOOverride(
+                    match_labels={"pool": "be"},
+                    overrides={
+                        "resource_used_threshold_with_be": {
+                            "enable": True,
+                            "cpu_suppress_threshold_percent": 50,
+                        }
+                    },
+                )
+            ],
+        )
+        hit = ctrl.render("n0", {"pool": "be"})
+        miss = ctrl.render("n1", {"pool": "ls"})
+        t = hit.resource_used_threshold_with_be
+        assert t.cpu_suppress_threshold_percent == 50 and t.enable
+        # partial override preserves the tuned cluster value
+        assert t.memory_evict_threshold_percent == 80
+        assert miss.resource_used_threshold_with_be.cpu_suppress_threshold_percent == 65
+
+    def test_extender(self):
+        def ext(name, labels, spec):
+            spec.extensions["x"] = name
+
+        ctrl = NodeSLOController(extenders=[ext])
+        n0 = ctrl.render("n0", {})
+        n1 = ctrl.render("n1", {})
+        # rendered specs are independent copies, not shared state
+        assert n0.extensions["x"] == "n0" and n1.extensions["x"] == "n1"
+        assert ctrl.cluster_spec.extensions == {}
+
+    def test_partial_colocation_override_preserves_cluster_strategy(self):
+        from koordinator_tpu.manager.sloconfig import NodeStrategySelector
+
+        cfg = ColocationConfig(
+            cluster_strategy=ColocationStrategy(
+                enable=True, cpu_reclaim_threshold_percent=70
+            ),
+            node_strategies=[
+                NodeStrategySelector(
+                    match_labels={"pool": "x"},
+                    overrides={"memory_reclaim_threshold_percent": 50},
+                )
+            ],
+        )
+        s = cfg.strategy_for_node({"pool": "x"})
+        assert s.enable and s.cpu_reclaim_threshold_percent == 70
+        assert s.memory_reclaim_threshold_percent == 50
