@@ -1,0 +1,9 @@
+"""koordlet: the node agent.
+
+Reference layout: pkg/koordlet/ (SURVEY.md §2.4) — seven subsystems wired
+together: statesinformer, metriccache, metricsadvisor, qosmanager,
+runtimehooks, resourceexecutor, prediction (+ pleg, audit). This package
+rebuilds them host-side (cgroup actuation is inherently a node/OS
+concern); the math-heavy parts (metric aggregation, peak prediction,
+suppress-target computation) lower onto the array substrate.
+"""

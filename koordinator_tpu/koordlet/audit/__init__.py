@@ -1,0 +1,3 @@
+from koordinator_tpu.koordlet.audit.auditor import AuditEvent, Auditor
+
+__all__ = ["AuditEvent", "Auditor"]

@@ -1,0 +1,15 @@
+from koordinator_tpu.koordlet.resourceexecutor.executor import (
+    CgroupUpdater,
+    ResourceUpdateExecutor,
+    merge_if_cfs_quota_larger,
+    merge_if_cpuset_looser,
+    merge_if_value_larger,
+)
+
+__all__ = [
+    "CgroupUpdater",
+    "ResourceUpdateExecutor",
+    "merge_if_cfs_quota_larger",
+    "merge_if_cpuset_looser",
+    "merge_if_value_larger",
+]

@@ -80,6 +80,10 @@ class NodeResourceUpdate:
     degraded: bool
     #: annotations to set on the node (amplification etc.)
     annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: node metadata (annotations / native allocatable) changed and must
+    #: be written back even when the overcommit diff is below threshold
+    #: (reference: the plugins' NeedSyncMeta surface)
+    meta_synced: bool = False
 
 
 def _is_metric_fresh(
@@ -141,9 +145,11 @@ class ResourceAmplificationPlugin(HostPlugin):
         ratio = _cpu_normalization_ratio(node)
         if ratio is None:
             return
-        update.annotations[ANNOTATION_RESOURCE_AMPLIFICATION_RATIO] = (
-            json.dumps({"cpu": ratio})
-        )
+        value = json.dumps({"cpu": ratio})
+        update.annotations[ANNOTATION_RESOURCE_AMPLIFICATION_RATIO] = value
+        if node.annotations.get(ANNOTATION_RESOURCE_AMPLIFICATION_RATIO) != value:
+            node.annotations[ANNOTATION_RESOURCE_AMPLIFICATION_RATIO] = value
+            update.meta_synced = True
 
 
 class CPUNormalizationPlugin(HostPlugin):
@@ -158,19 +164,24 @@ class CPUNormalizationPlugin(HostPlugin):
 
     def prepare(self, node: NodeSpec, update: NodeResourceUpdate) -> None:
         ratio = _cpu_normalization_ratio(node)
+        old_cpu = node.allocatable.get(ResourceName.CPU, 0)
         if ratio is None:
             if node.raw_allocatable is not None:
                 node.allocatable[ResourceName.CPU] = node.raw_allocatable.get(
-                    ResourceName.CPU, node.allocatable.get(ResourceName.CPU, 0)
+                    ResourceName.CPU, old_cpu
                 )
                 node.raw_allocatable = None
+                update.meta_synced = True
             return
-        base_cpu = node.allocatable.get(ResourceName.CPU, 0)
+        base_cpu = old_cpu
         if node.raw_allocatable is None:
             node.raw_allocatable = dict(node.allocatable)
         else:
             base_cpu = node.raw_allocatable.get(ResourceName.CPU, base_cpu)
-        node.allocatable[ResourceName.CPU] = int(base_cpu * ratio)
+        amplified = int(base_cpu * ratio)
+        if amplified != old_cpu:
+            update.meta_synced = True
+        node.allocatable[ResourceName.CPU] = amplified
         update.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
             {"cpu": base_cpu}
         )

@@ -9,12 +9,12 @@ overrides into one NodeSLO CR per node, extensible via extender plugins.
 from __future__ import annotations
 
 import copy
-import dataclasses
 from typing import Callable, Dict, List, Optional, Tuple
 
 from koordinator_tpu.apis.types import selector_matches
 from koordinator_tpu.manager.sloconfig import (
     NodeSLOSpec,
+    NodeStrategySelector,
     default_node_slo_spec,
     merge_overrides,
 )
@@ -25,15 +25,10 @@ from koordinator_tpu.manager.sloconfig import (
 NodeSLOExtender = Callable[[str, Dict[str, str], NodeSLOSpec], None]
 
 
-@dataclasses.dataclass
-class NodeSLOOverride:
-    """A node-selector-scoped strategy override (reference:
-    configuration.NodeStrategy in the nodeSLO ConfigMaps). ``overrides``
-    holds only the fields the override sets, nested dicts mirroring the
-    NodeSLOSpec structure (JSON-merge-patch semantics)."""
-
-    match_labels: Dict[str, str]
-    overrides: Dict = dataclasses.field(default_factory=dict)
+#: A node-selector-scoped NodeSLO override — same selector + JSON-merge-
+#: patch shape as the colocation node strategy (reference:
+#: configuration.NodeStrategy in the nodeSLO ConfigMaps).
+NodeSLOOverride = NodeStrategySelector
 
 
 class NodeSLOController:

@@ -30,6 +30,23 @@ def percent_rounded(used: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(total > 0, pct, 0)
 
 
+def mul_percent_floor(x: jnp.ndarray, pct: jnp.ndarray) -> jnp.ndarray:
+    """``floor(x * pct / 100)`` without the ``x * pct`` intermediate, via
+    the exact identity ``(x//100)*pct + ((x%100)*pct)//100`` — safe in
+    int32 for any non-negative x and pct <= ~100 (a plain ``x * pct``
+    wraps for memory columns above ~21.4M MiB)."""
+    return (x // 100) * pct + ((x % 100) * pct) // 100
+
+
+def percent_exceeds(diff: jnp.ndarray, base: jnp.ndarray,
+                    pct: jnp.ndarray) -> jnp.ndarray:
+    """Exact ``100*diff > base*pct`` for non-negative int32 operands
+    without overflowing either product: with integer diff,
+    ``diff > floor(base*pct/100)`` is equivalent (a strict integer bound
+    clears any fractional remainder)."""
+    return diff > mul_percent_floor(base, pct)
+
+
 def least_requested_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
     """``(capacity - requested) * 100 / capacity``; 0 when capacity is 0 or
     requested exceeds capacity (reference: load_aware.go:388-397).

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.ops.common import mul_percent_floor, percent_exceeds
 
 
 class CalculatePolicy:
@@ -157,7 +158,7 @@ def batch_allocatable(
     hp_req, hp_used, hp_max = hp_pod_contributions(pods, num_nodes)
 
     cap = nodes.capacity
-    margin = cap * (100 - params.reclaim_percent) // 100
+    margin = mul_percent_floor(cap, 100 - params.reclaim_percent)
     sys_or_reserved = jnp.maximum(nodes.system_used, nodes.reserved)
 
     base = cap - margin
@@ -195,7 +196,7 @@ def mid_allocatable(
     (reference: midresource/plugin.go:128-162), degraded with the metric
     mask like batch. [N, R] with MID_CPU / MID_MEMORY populated."""
     num_nodes = nodes.capacity.shape[0]
-    ceiling = nodes.capacity * params.mid_threshold_percent // 100
+    ceiling = mul_percent_floor(nodes.capacity, params.mid_threshold_percent)
     mid = jnp.clip(jnp.minimum(nodes.prod_reclaimable, ceiling), 0)
 
     out = jnp.zeros((num_nodes, NUM_RESOURCES), dtype=nodes.capacity.dtype)
@@ -236,5 +237,5 @@ def needs_sync(
     if thr.ndim == old_alloc.ndim - 1:
         thr = thr[..., None]
     diff = jnp.abs(new_alloc - old_alloc)
-    per_res = 100 * diff > old_alloc * thr
+    per_res = percent_exceeds(diff, old_alloc, thr)
     return jnp.any(per_res, axis=-1)

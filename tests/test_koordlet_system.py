@@ -1,0 +1,244 @@
+"""koordlet substrate tests: cgroup registry path/encoding, executor
+cache + merge conditions + leveled batch, audit log.
+
+Fake-cgroupfs pattern per the reference's testutil: a temp dir stands in
+for /sys/fs/cgroup (reference: pkg/koordlet/util/system tests +
+NewTestResourceExecutor).
+"""
+
+import os
+
+import pytest
+
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.resourceexecutor import (
+    CgroupUpdater,
+    ResourceUpdateExecutor,
+    merge_if_cfs_quota_larger,
+    merge_if_cpuset_looser,
+    merge_if_value_larger,
+)
+from koordinator_tpu.koordlet.resourceexecutor.executor import ensure_cgroup_dir
+from koordinator_tpu.koordlet.system import (
+    SystemConfig,
+    convert_cpu_shares_to_weight,
+    convert_cpu_weight_to_shares,
+    get_resource,
+)
+
+
+@pytest.fixture
+def v1(tmp_path):
+    cfg = SystemConfig(cgroup_root=str(tmp_path), use_cgroup_v2=False)
+    ensure_cgroup_dir("kubepods/pod1", cfg)
+    return cfg
+
+
+@pytest.fixture
+def v2(tmp_path):
+    cfg = SystemConfig(cgroup_root=str(tmp_path), use_cgroup_v2=True)
+    ensure_cgroup_dir("kubepods/pod1", cfg)
+    return cfg
+
+
+class TestRegistry:
+    def test_v1_path_nests_under_subsystem(self, v1):
+        r = get_resource("cpu.cfs_quota_us")
+        assert r.path("kubepods/pod1", v1).endswith(
+            "/cpu/kubepods/pod1/cpu.cfs_quota_us"
+        )
+
+    def test_v2_path_unified(self, v2):
+        r = get_resource("cpu.cfs_quota_us")
+        assert r.path("kubepods/pod1", v2).endswith("/kubepods/pod1/cpu.max")
+
+    def test_shares_weight_conversion_roundtrip(self):
+        # KEP-2254 mapping (reference: cgroup2.go:283-315)
+        assert convert_cpu_shares_to_weight(2) == 1
+        assert convert_cpu_shares_to_weight(262144) == 10000
+        assert convert_cpu_weight_to_shares(1) == 2
+        assert convert_cpu_weight_to_shares(10000) == 262144
+        assert convert_cpu_weight_to_shares(39) == 998  # kubelet example
+
+    def test_bvt_validator(self):
+        r = get_resource("cpu.bvt_warp_ns")
+        assert r.validate("2") and r.validate("-1")
+        assert not r.validate("3") and not r.validate("x")
+
+    def test_cpuset_validator(self):
+        r = get_resource("cpuset.cpus")
+        assert r.validate("0-3,8,10-11") and r.validate("")
+        assert not r.validate("3-1") and not r.validate("a-b")
+
+
+class TestExecutorV1:
+    def test_write_and_cache(self, v1):
+        ex = ResourceUpdateExecutor(v1)
+        u = CgroupUpdater("cpu.cfs_quota_us", "kubepods/pod1", "100000")
+        assert ex.update(True, u)
+        path = u.resource().path("kubepods/pod1", v1)
+        assert open(path).read() == "100000"
+        # same value again: cache short-circuits
+        assert not ex.update(True, u)
+        # different value writes
+        u2 = CgroupUpdater("cpu.cfs_quota_us", "kubepods/pod1", "50000")
+        assert ex.update(True, u2)
+
+    def test_cache_expiry_rewrites(self, v1):
+        now = [0.0]
+        ex = ResourceUpdateExecutor(v1, cache_ttl=10.0, clock=lambda: now[0])
+        u = CgroupUpdater("cpu.cfs_quota_us", "kubepods/pod1", "100000")
+        assert ex.update(True, u)
+        now[0] = 5.0
+        assert not ex.update(True, u)
+        now[0] = 11.0  # expired: external drift gets corrected
+        assert ex.update(True, u)
+
+    def test_invalid_value_rejected_and_audited(self, v1):
+        ex = ResourceUpdateExecutor(v1)
+        u = CgroupUpdater("cpu.bvt_warp_ns", "kubepods/pod1", "7")
+        assert not ex.update(False, u)
+        assert ex.auditor.query(operation="reject")
+
+    def test_audit_records_write(self, v1):
+        ex = ResourceUpdateExecutor(v1)
+        ex.update(False, CgroupUpdater("cpu.shares", "kubepods/pod1", "1024"))
+        events = ex.auditor.query(operation="update")
+        assert len(events) == 1 and "1024" in events[0].detail
+
+    def test_max_literal_translated_on_v1(self, v1):
+        ex = ResourceUpdateExecutor(v1)
+        ex.update(False, CgroupUpdater(
+            "cpu.cfs_quota_us", "kubepods/pod1", "max"))
+        assert get_resource("cpu.cfs_quota_us").read(
+            "kubepods/pod1", v1) == "-1"
+
+    def test_missing_dir_fails_gracefully(self, v1):
+        ex = ResourceUpdateExecutor(v1)
+        u = CgroupUpdater("cpu.shares", "kubepods/ghost", "1024")
+        assert not ex.update(False, u)
+        assert ex.auditor.query(operation="error")
+
+
+class TestExecutorV2:
+    def test_cfs_quota_packs_cpu_max(self, v2):
+        ex = ResourceUpdateExecutor(v2)
+        r = get_resource("cpu.cfs_quota_us")
+        r.write("kubepods/pod1", "max 100000", v2)
+        ex.update(False, CgroupUpdater(
+            "cpu.cfs_quota_us", "kubepods/pod1", "50000"))
+        assert r.read("kubepods/pod1", v2) == "50000 100000"
+        # -1 -> "max", period preserved
+        ex.update(False, CgroupUpdater(
+            "cpu.cfs_quota_us", "kubepods/pod1", "-1"))
+        assert r.read("kubepods/pod1", v2) == "max 100000"
+
+    def test_period_preserves_quota(self, v2):
+        ex = ResourceUpdateExecutor(v2)
+        r = get_resource("cpu.cfs_period_us")
+        r.write("kubepods/pod1", "50000 100000", v2)
+        ex.update(False, CgroupUpdater(
+            "cpu.cfs_period_us", "kubepods/pod1", "200000"))
+        assert r.read("kubepods/pod1", v2) == "50000 200000"
+
+    def test_shares_written_as_weight(self, v2):
+        ex = ResourceUpdateExecutor(v2)
+        ex.update(False, CgroupUpdater("cpu.shares", "kubepods/pod1", "2"))
+        assert get_resource("cpu.shares").read("kubepods/pod1", v2) == "1"
+
+    def test_memory_limit_negative_is_max(self, v2):
+        ex = ResourceUpdateExecutor(v2)
+        ex.update(False, CgroupUpdater(
+            "memory.limit_in_bytes", "kubepods/pod1", "-1"))
+        assert get_resource("memory.limit_in_bytes").read(
+            "kubepods/pod1", v2) == "max"
+
+    def test_max_literal_encodes_without_crash(self, v2):
+        ex = ResourceUpdateExecutor(v2)
+        assert ex.update(False, CgroupUpdater(
+            "cpu.cfs_quota_us", "kubepods/pod1", "max"))
+        assert get_resource("cpu.cfs_quota_us").read(
+            "kubepods/pod1", v2).startswith("max")
+        assert ex.update(False, CgroupUpdater(
+            "memory.limit_in_bytes", "kubepods/pod1", "max"))
+        # period rejects "max" (no unlimited period exists)
+        assert not ex.update(False, CgroupUpdater(
+            "cpu.cfs_period_us", "kubepods/pod1", "max"))
+
+    def test_packed_file_cache_no_collision(self, v2):
+        # cpu.cfs_quota_us and cpu.cfs_period_us share cpu.max: caching by
+        # path alone would skip a quota write after an equal period write
+        ex = ResourceUpdateExecutor(v2)
+        r = get_resource("cpu.cfs_quota_us")
+        r.write("kubepods/pod1", "max 100000", v2)
+        assert ex.update(True, CgroupUpdater(
+            "cpu.cfs_quota_us", "kubepods/pod1", "50000"))
+        assert ex.update(True, CgroupUpdater(
+            "cpu.cfs_period_us", "kubepods/pod1", "200000"))
+        assert ex.update(True, CgroupUpdater(
+            "cpu.cfs_quota_us", "kubepods/pod1", "200000"))
+        assert r.read("kubepods/pod1", v2) == "200000 200000"
+
+
+class TestMergeConditions:
+    def test_value_larger(self):
+        assert merge_if_value_larger("100", "200") == ("200", True)
+        assert merge_if_value_larger("200", "100") == ("100", False)
+
+    def test_cfs_quota_unlimited_is_largest(self):
+        # reference: MergeConditionIfCFSQuotaIsLarger
+        assert merge_if_cfs_quota_larger("-1", "100000")[1] is False
+        assert merge_if_cfs_quota_larger("100000", "-1")[1] is True
+        assert merge_if_cfs_quota_larger("100000", "200000")[1] is True
+        assert merge_if_cfs_quota_larger("max 100000", "50000")[1] is False
+
+    def test_cpuset_looser_unions(self):
+        merged, need = merge_if_cpuset_looser("0-3", "2-5")
+        assert need and merged == "0,1,2,3,4,5"
+        _, need = merge_if_cpuset_looser("0-5", "1-2")
+        assert not need
+
+
+class TestLeveledBatch:
+    def test_shrink_applies_children_first(self, v1):
+        """Shrinking quota: merge pass must not shrink the parent while
+        children still hold larger quotas (reference: executor.go:114)."""
+        ensure_cgroup_dir("kubepods/pod1/c1", v1)
+        ex = ResourceUpdateExecutor(v1)
+        quota = get_resource("cpu.cfs_quota_us")
+        quota.write("kubepods/pod1", "400000", v1)
+        quota.write("kubepods/pod1/c1", "400000", v1)
+
+        parent = CgroupUpdater("cpu.cfs_quota_us", "kubepods/pod1",
+                               "100000", merge_if_cfs_quota_larger)
+        child = CgroupUpdater("cpu.cfs_quota_us", "kubepods/pod1/c1",
+                              "100000", merge_if_cfs_quota_larger)
+        ex.leveled_update_batch([[parent], [child]])
+        assert quota.read("kubepods/pod1", v1) == "100000"
+        assert quota.read("kubepods/pod1/c1", v1) == "100000"
+
+    def test_grow_applies_parent_first_via_merge(self, v1):
+        ensure_cgroup_dir("kubepods/pod1/c1", v1)
+        ex = ResourceUpdateExecutor(v1)
+        cpuset = get_resource("cpuset.cpus")
+        cpuset.write("kubepods/pod1", "0-1", v1)
+        cpuset.write("kubepods/pod1/c1", "0-1", v1)
+
+        parent = CgroupUpdater("cpuset.cpus", "kubepods/pod1", "0-3",
+                               merge_if_cpuset_looser)
+        child = CgroupUpdater("cpuset.cpus", "kubepods/pod1/c1", "2-3",
+                              merge_if_cpuset_looser)
+        ex.leveled_update_batch([[parent], [child]])
+        assert cpuset.read("kubepods/pod1", v1) == "0-3"
+        assert cpuset.read("kubepods/pod1/c1", v1) == "2-3"
+
+
+class TestAuditor:
+    def test_ring_bound_and_query(self):
+        a = Auditor(capacity=3, clock=lambda: 1.0)
+        for i in range(5):
+            a.log("g", f"s{i}", "op")
+        assert len(a) == 3
+        assert [e.subject for e in a.query()] == ["s4", "s3", "s2"]
+        assert a.query(subject="s3", limit=1)[0].subject == "s3"
+        assert a.query(group="other") == []

@@ -340,6 +340,34 @@ class TestNodeResourceController:
         assert upd.synced and upd.allocatable[BCPU] == 0
         assert snap.nodes[0].allocatable[BCPU] == 0
 
+    def test_annotation_only_change_sets_meta_synced(self):
+        # amplification with batch diff below threshold must still flag a
+        # node write-back (reference: NeedSyncMeta)
+        snap = self._snapshot()
+        ctrl = NodeResourceController()
+        ctrl.reconcile_all(snap)
+        snap.nodes[0].annotations[ANNOTATION_CPU_NORMALIZATION_RATIO] = "1.5"
+        upd = ctrl.reconcile_all(snap)[0]
+        assert upd.meta_synced
+        # steady state: no further meta churn
+        upd = ctrl.reconcile_all(snap)[0]
+        assert not upd.meta_synced
+
+    def test_huge_memory_node_no_overflow(self):
+        # 64 TiB node: capacity * percent would wrap int32
+        big = 64 * 1024 * 1024  # MiB
+        snap = ClusterSnapshot(
+            nodes=[NodeSpec("n0", allocatable={CPU: 10000, MEM: big})],
+            pods=[],
+            node_metrics={"n0": NodeMetric(
+                "n0", prod_reclaimable={MEM: big // 2},
+                update_time=940.0)},
+            now=1000.0,
+        )
+        upd = NodeResourceController().reconcile_all(snap)[0]
+        assert upd.allocatable[BMEM] == big - (big * 35) // 100
+        assert upd.allocatable[ResourceName.MID_MEMORY] == big // 2
+
     def test_nonfinite_normalization_ratio_ignored(self):
         for bad in ("inf", "1e400", "nan", "1e15"):
             snap = self._snapshot()
