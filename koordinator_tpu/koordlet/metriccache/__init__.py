@@ -1,0 +1,7 @@
+from koordinator_tpu.koordlet.metriccache.metric_cache import (
+    AggregationType,
+    MetricCache,
+    MetricKind,
+)
+
+__all__ = ["AggregationType", "MetricCache", "MetricKind"]

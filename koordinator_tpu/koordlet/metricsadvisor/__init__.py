@@ -1,0 +1,8 @@
+from koordinator_tpu.koordlet.metricsadvisor.framework import (
+    Collector,
+    CollectorContext,
+    MetricsAdvisor,
+    PodMeta,
+)
+
+__all__ = ["Collector", "CollectorContext", "MetricsAdvisor", "PodMeta"]

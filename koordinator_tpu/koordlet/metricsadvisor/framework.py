@@ -1,0 +1,102 @@
+"""Collector plugin framework for the metrics advisor.
+
+Reference: pkg/koordlet/metricsadvisor/framework/{plugin.go,context.go} —
+a registry of collectors, each on its own timer, appending samples to the
+metric cache; SharedState passes cross-collector values (e.g. pod usage
+for the system-resource collector).
+
+Here collectors are driven by explicit ``collect()`` ticks (the agent
+main loop or tests call them; no goroutines), and the shared state is a
+typed ``CollectorContext``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Mapping, Optional, Protocol, Sequence
+
+from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+
+
+@dataclasses.dataclass
+class PodMeta:
+    """What collectors need to know about a running pod (reference:
+    statesinformer.PodMeta: pod + cgroup parent dir)."""
+
+    uid: str
+    cgroup_dir: str            # e.g. "kubepods/pod<uid>"
+    qos: QoSClass = QoSClass.NONE
+    containers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # container name -> cgroup dir
+
+
+class PodProvider(Protocol):
+    """Source of the current pod list (the statesinformer)."""
+
+    def running_pods(self) -> Sequence[PodMeta]: ...
+
+
+@dataclasses.dataclass
+class CollectorContext:
+    """Shared collector state (reference: framework/context.go:63
+    SharedState): latest per-source usages for cross-collector math."""
+
+    metric_cache: MetricCache
+    system_config: SystemConfig
+    pod_provider: Optional[PodProvider] = None
+    #: latest node usage sample {"cpu": mCPU, "memory": MiB}
+    latest_node_usage: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+    #: latest per-pod usage {uid: {"cpu": mCPU, "memory": MiB}}
+    latest_pod_usage: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class Collector(Protocol):
+    name: str
+
+    def setup(self, ctx: CollectorContext) -> None: ...
+
+    def collect(self, now: float) -> None: ...
+
+    def enabled(self) -> bool: ...
+
+
+class MetricsAdvisor:
+    """Runs registered collectors (reference: metrics_advisor.go).
+
+    ``tick`` invokes each enabled collector whose interval elapsed;
+    ``collect_all`` forces one round (tests, initial sync).
+    """
+
+    def __init__(self, ctx: CollectorContext,
+                 collectors: Sequence[Collector],
+                 interval_seconds: float = 1.0):
+        self.ctx = ctx
+        self.collectors: List[Collector] = []
+        self.interval_seconds = interval_seconds
+        self._last_run: Dict[str, float] = {}
+        for c in collectors:
+            c.setup(ctx)
+            self.collectors.append(c)
+
+    def collect_all(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for c in self.collectors:
+            if c.enabled():
+                c.collect(now)
+                self._last_run[c.name] = now
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for c in self.collectors:
+            if not c.enabled():
+                continue
+            if now - self._last_run.get(c.name, -1e18) >= self.interval_seconds:
+                c.collect(now)
+                self._last_run[c.name] = now

@@ -31,6 +31,7 @@ from koordinator_tpu.koordlet.system.cgroup import (
     CONFIG,
     CgroupResource,
     SystemConfig,
+    V1_SUBSYSTEMS,
     get_resource,
 )
 
@@ -166,23 +167,30 @@ class ResourceUpdateExecutor:
         key = updater.key(self.config)
         value = updater.value
 
-        # read the current content at most once, and only when needed:
-        # for a merge condition or a packed-v2-file encoder
-        needs_current = (merge and updater.merge_condition is not None) or (
-            self.config.use_cgroup_v2 and resource.v2_encode is not None
-        )
-        current = ""
-        if needs_current:
+        current = None
+        if merge and updater.merge_condition is not None:
+            # the merge condition needs the live content, and the merged
+            # value is what the cache must compare against
             try:
                 current = resource.read(updater.parent_dir, self.config)
             except OSError:
                 current = ""
-        if merge and updater.merge_condition is not None:
             value, need = updater.merge_condition(current, value)
             if not need:
                 return False
         if cacheable and self._cached(key) == value:
+            # cache hit short-circuits BEFORE any read: steady-state
+            # reconcile ticks cost zero cgroupfs I/O
             return False
+        if current is None:
+            # packed v2 files (cpu.max) need the live content to encode
+            if self.config.use_cgroup_v2 and resource.v2_encode is not None:
+                try:
+                    current = resource.read(updater.parent_dir, self.config)
+                except OSError:
+                    current = ""
+            else:
+                current = ""
 
         try:
             content = resource.encode(value, current, self.config)
@@ -231,8 +239,7 @@ class ResourceUpdateExecutor:
 
 
 def ensure_cgroup_dir(parent_dir: str, cfg: Optional[SystemConfig] = None,
-                      subfs: Sequence[str] = ("cpu", "cpuset", "memory",
-                                              "blkio")) -> None:
+                      subfs: Sequence[str] = V1_SUBSYSTEMS) -> None:
     """Create the fake-cgroupfs directories for tests (reference:
     testutil NewFileTestUtil.MkDirAll)."""
     cfg = cfg or CONFIG

@@ -25,6 +25,10 @@ class CgroupVersion(enum.Enum):
     V2 = 2
 
 
+#: v1 hierarchies this framework manages.
+V1_SUBSYSTEMS = ("cpu", "cpuacct", "cpuset", "memory", "blkio")
+
+
 @dataclasses.dataclass
 class SystemConfig:
     """Host paths + cgroup driver config (reference:
@@ -138,6 +142,10 @@ class CgroupResource:
     v2_encode: Optional[Callable[[str, str], str]] = None
     #: normalize a value for the v1 file (e.g. "max" -> "-1")
     v1_encode: Optional[Callable[[str], str]] = None
+    #: on v1 this file exists independently in EVERY hierarchy and a
+    #: write must hit all of them (cgroup.procs: moving a task in only
+    #: the cpu hierarchy leaves it in the old cpuset/memory cgroups)
+    v1_all_subfs: bool = False
 
     def supported(self, version: CgroupVersion) -> bool:
         return version is CgroupVersion.V1 or self.v2_file is not None
@@ -156,6 +164,17 @@ class CgroupResource:
         return os.path.join(
             cfg.cgroup_root, self.v1_subfs, parent_dir, self.v1_file
         )
+
+    def paths(self, parent_dir: str,
+              cfg: Optional[SystemConfig] = None) -> List[str]:
+        """All file paths a write must reach (one, except v1_all_subfs)."""
+        cfg = cfg or CONFIG
+        if cfg.use_cgroup_v2 or not self.v1_all_subfs:
+            return [self.path(parent_dir, cfg)]
+        return [
+            os.path.join(cfg.cgroup_root, fs, parent_dir, self.v1_file)
+            for fs in V1_SUBSYSTEMS
+        ]
 
     def validate(self, value: str, cfg: Optional[SystemConfig] = None) -> bool:
         cfg = cfg or CONFIG
@@ -185,8 +204,9 @@ class CgroupResource:
 
     def write(self, parent_dir: str, content: str,
               cfg: Optional[SystemConfig] = None) -> None:
-        with open(self.path(parent_dir, cfg), "w") as f:
-            f.write(content)
+        for p in self.paths(parent_dir, cfg):
+            with open(p, "w") as f:
+                f.write(content)
 
 
 # -- v2 packed-file encoders -------------------------------------------------
@@ -251,7 +271,7 @@ CPU_SET = CgroupResource(
 )
 CPU_PROCS = CgroupResource(
     "cgroup.procs", "cpu", "cgroup.procs", "cgroup.procs",
-    validator=_natural_int64,
+    validator=_natural_int64, v1_all_subfs=True,
 )
 MEMORY_LIMIT = CgroupResource(
     "memory.limit_in_bytes", "memory", "memory.limit_in_bytes", "memory.max",
@@ -291,6 +311,11 @@ MEMORY_USAGE = CgroupResource(
     "memory.usage_in_bytes", "memory", "memory.usage_in_bytes",
     "memory.current",
 )
+#: cumulative cpu time: v1 cpuacct.usage is nanoseconds; v2 cpu.stat has
+#: a "usage_usec N" line (callers parse per version)
+CPU_ACCT_USAGE = CgroupResource(
+    "cpuacct.usage", "cpuacct", "cpuacct.usage", "cpu.stat",
+)
 BLKIO_IO_WEIGHT = CgroupResource(
     "blkio.cost.weight", "blkio", "blkio.cost.weight", "io.cost.weight",
     validator=_range_validator(1, 100),
@@ -301,6 +326,7 @@ _KNOWN: List[CgroupResource] = [
     CPU_IDLE, CPU_SET, CPU_PROCS, MEMORY_LIMIT, MEMORY_MIN, MEMORY_LOW,
     MEMORY_HIGH, MEMORY_WMARK_RATIO, MEMORY_WMARK_SCALE_FACTOR,
     MEMORY_PRIORITY, MEMORY_OOM_GROUP, MEMORY_USAGE, BLKIO_IO_WEIGHT,
+    CPU_ACCT_USAGE,
 ]
 _BY_TYPE: Dict[str, CgroupResource] = {r.resource_type: r for r in _KNOWN}
 

@@ -355,13 +355,23 @@ class NodeResourceController:
         mem_pol = np.zeros(n, np.int32)
         diff_thr = np.zeros(n, np.int32)
         enabled = np.zeros(n, bool)
+        clamp_pct = lambda p: min(max(int(p), 0), 100)
         for i, (node, s) in enumerate(zip(snapshot.nodes, strategies)):
             for col in OVERCOMMIT_COLUMNS:
                 old_alloc[i, col] = node.allocatable.get(col, 0)
-            reclaim[i, ResourceName.CPU] = s.cpu_reclaim_threshold_percent
-            reclaim[i, ResourceName.MEMORY] = s.memory_reclaim_threshold_percent
-            mid_thr[i, ResourceName.CPU] = s.mid_cpu_threshold_percent
-            mid_thr[i, ResourceName.MEMORY] = s.mid_memory_threshold_percent
+            # clamp to [0, 100]: a malformed override must not produce
+            # batch allocatable beyond node capacity (and the exact
+            # percent identities assume pct <= 100)
+            reclaim[i, ResourceName.CPU] = clamp_pct(
+                s.cpu_reclaim_threshold_percent
+            )
+            reclaim[i, ResourceName.MEMORY] = clamp_pct(
+                s.memory_reclaim_threshold_percent
+            )
+            mid_thr[i, ResourceName.CPU] = clamp_pct(s.mid_cpu_threshold_percent)
+            mid_thr[i, ResourceName.MEMORY] = clamp_pct(
+                s.mid_memory_threshold_percent
+            )
             cpu_pol[i] = _POLICY_BY_NAME.get(
                 s.cpu_calculate_policy, CalculatePolicy.USAGE
             )

@@ -67,8 +67,8 @@ class ColocationStrategy:
         return (
             self.metric_aggregate_duration_seconds > 0
             and self.metric_report_interval_seconds > 0
-            and self.cpu_reclaim_threshold_percent > 0
-            and self.memory_reclaim_threshold_percent > 0
+            and 0 < self.cpu_reclaim_threshold_percent <= 100
+            and 0 < self.memory_reclaim_threshold_percent <= 100
             and self.degrade_time_minutes > 0
             and self.update_time_threshold_seconds > 0
             and self.resource_diff_threshold > 0

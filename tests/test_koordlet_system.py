@@ -106,6 +106,16 @@ class TestExecutorV1:
         events = ex.auditor.query(operation="update")
         assert len(events) == 1 and "1024" in events[0].detail
 
+    def test_procs_written_to_all_v1_hierarchies(self, v1):
+        # cgroup.procs must move the task in EVERY hierarchy, not just cpu
+        ex = ResourceUpdateExecutor(v1)
+        ex.update(False, CgroupUpdater("cgroup.procs", "kubepods/pod1", "42"))
+        import os
+        for fs in ("cpu", "cpuset", "memory"):
+            p = os.path.join(v1.cgroup_root, fs, "kubepods/pod1",
+                             "cgroup.procs")
+            assert open(p).read() == "42", fs
+
     def test_max_literal_translated_on_v1(self, v1):
         ex = ResourceUpdateExecutor(v1)
         ex.update(False, CgroupUpdater(

@@ -399,6 +399,23 @@ class TestNodeResourceController:
             upd = NodeResourceController().reconcile_all(snap)[0]
             assert upd.allocatable[BCPU] == 10000 - 4000 - 1000 - 2000
 
+    def test_overrange_reclaim_percent_clamped(self):
+        # malformed override (150%) must not mint capacity beyond the node
+        from koordinator_tpu.manager.sloconfig import NodeStrategySelector
+
+        snap = self._snapshot()
+        cfg = ColocationConfig(
+            cluster_strategy=ColocationStrategy(enable=True),
+            node_strategies=[NodeStrategySelector(
+                match_labels={},  # matches every node
+                overrides={"cpu_reclaim_threshold_percent": 150},
+            )],
+        )
+        upd = NodeResourceController(cfg).reconcile_all(snap)[0]
+        # clamped to 100%: margin 0
+        assert upd.allocatable[BCPU] == 10000 - 0 - 1000 - 2000
+        assert upd.allocatable[BCPU] <= 10000
+
     def test_per_node_strategy_override(self):
         from koordinator_tpu.manager.sloconfig import NodeStrategySelector
 

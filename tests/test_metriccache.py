@@ -1,0 +1,264 @@
+"""metriccache + metricsadvisor tests.
+
+Aggregation oracle: pkg/koordlet/metriccache/util.go:55-100 (percentile =
+ascending sort, idx = max(int(n*p)-1, 0)). Collector fixtures build a
+fake /proc + cgroupfs tree (reference's testutil pattern).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.koordlet.metriccache import (
+    AggregationType,
+    MetricCache,
+    MetricKind,
+)
+from koordinator_tpu.koordlet.metricsadvisor.collectors import (
+    BEResourceCollector,
+    NodeResourceCollector,
+    PodResourceCollector,
+    PSICollector,
+    SysResourceCollector,
+    read_psi_avg10,
+)
+from koordinator_tpu.koordlet.metricsadvisor.framework import (
+    CollectorContext,
+    MetricsAdvisor,
+    PodMeta,
+)
+from koordinator_tpu.koordlet.resourceexecutor.executor import ensure_cgroup_dir
+from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+
+A = AggregationType
+
+
+class TestMetricCache:
+    def test_append_query_window(self):
+        mc = MetricCache()
+        for t in range(10):
+            mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), t * 100.0)
+        ts, vals = mc.query(MetricKind.NODE_CPU_USAGE, start=3.0, end=7.0)
+        assert list(ts) == [3.0, 4.0, 5.0, 6.0, 7.0]
+        assert vals[0] == 300.0
+
+    def test_ring_overwrites_oldest(self):
+        mc = MetricCache(capacity_per_series=4)
+        for t in range(6):
+            mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), float(t))
+        ts, _ = mc.query(MetricKind.NODE_CPU_USAGE)
+        assert list(ts) == [2.0, 3.0, 4.0, 5.0]
+
+    def test_aggregations_match_reference(self):
+        # percentile: sort asc, idx = int(n*p)-1 clamped 0 (util.go:91-95)
+        mc = MetricCache()
+        vals = [5.0, 1.0, 3.0, 2.0, 4.0]  # sorted: 1 2 3 4 5
+        for i, v in enumerate(vals):
+            mc.append(MetricKind.NODE_CPU_USAGE, None, float(i), v)
+        agg = lambda a: mc.aggregate(MetricKind.NODE_CPU_USAGE, agg=a)
+        assert agg(A.AVG) == 3.0
+        assert agg(A.P50) == 2.0   # idx int(5*.5)-1 = 1
+        assert agg(A.P90) == 4.0   # idx int(4.5)-1 = 3
+        assert agg(A.P99) == 4.0   # idx int(4.95)-1 = 3
+        assert agg(A.LAST) == 4.0  # last appended
+        assert agg(A.COUNT) == 5.0
+        assert mc.aggregate(MetricKind.POD_CPU_USAGE, {"pod": "x"}) is None
+
+    def test_labels_separate_series(self):
+        mc = MetricCache()
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "a"}, 1.0, 100.0)
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "b"}, 1.0, 200.0)
+        assert mc.aggregate(
+            MetricKind.POD_CPU_USAGE, {"pod": "a"}, agg=A.LAST) == 100.0
+
+    def test_aggregate_batch_matches_scalar(self):
+        mc = MetricCache()
+        rng = np.random.default_rng(0)
+        pods = [f"p{i}" for i in range(5)]
+        for p in pods:
+            for t in range(rng.integers(1, 20)):
+                mc.append(MetricKind.POD_CPU_USAGE, {"pod": p},
+                          float(t), float(rng.uniform(0, 1000)))
+        reqs = [(MetricKind.POD_CPU_USAGE, {"pod": p}) for p in pods]
+        batch = mc.aggregate_batch(reqs, 0.0, 100.0,
+                                   [A.AVG, A.P50, A.P90, A.LAST, A.COUNT])
+        for (kind, labels), res in zip(reqs, batch):
+            for a in (A.AVG, A.P50, A.P90, A.LAST, A.COUNT):
+                expect = mc.aggregate(kind, labels, 0.0, 100.0, a)
+                assert res[a] == pytest.approx(expect), (labels, a)
+
+    def test_batch_empty_series(self):
+        mc = MetricCache()
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "a"}, 1.0, 1.0)
+        batch = mc.aggregate_batch(
+            [(MetricKind.POD_CPU_USAGE, {"pod": "a"}),
+             (MetricKind.POD_CPU_USAGE, {"pod": "ghost"})],
+            0.0, 10.0, [A.AVG],
+        )
+        assert batch[0][A.AVG] == 1.0 and batch[1][A.AVG] is None
+
+    def test_kv_storage(self):
+        mc = MetricCache()
+        mc.set("node_cpu_info", {"cores": 8})
+        assert mc.get("node_cpu_info")["cores"] == 8
+        assert mc.get("missing") is None
+
+    def test_gc_drops_stale_series(self):
+        mc = MetricCache(retention_seconds=60)
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "old"}, 10.0, 1.0)
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "new"}, 100.0, 1.0)
+        assert mc.gc(now=120.0) == 1
+        assert mc.aggregate(
+            MetricKind.POD_CPU_USAGE, {"pod": "new"}, agg=A.LAST) == 1.0
+        assert mc.aggregate(
+            MetricKind.POD_CPU_USAGE, {"pod": "old"}, agg=A.LAST) is None
+
+
+# -- collectors fixtures -----------------------------------------------------
+
+
+def write_proc_stat(proc, busy, idle=1000):
+    # user nice system idle iowait irq softirq steal
+    os.makedirs(proc, exist_ok=True)
+    with open(os.path.join(proc, "stat"), "w") as f:
+        f.write(f"cpu  {busy} 0 0 {idle} 0 0 0 0 0 0\n")
+        f.write("cpu0 0 0 0 0 0 0 0 0 0 0\n")
+
+
+def write_meminfo(proc, total_kb, avail_kb):
+    with open(os.path.join(proc, "meminfo"), "w") as f:
+        f.write(f"MemTotal: {total_kb} kB\nMemFree: 0 kB\n"
+                f"MemAvailable: {avail_kb} kB\n")
+
+
+def write_pod_cgroup(cfg, pod_dir, cpu_ns, mem_bytes):
+    ensure_cgroup_dir(pod_dir, cfg)
+    from koordinator_tpu.koordlet.system.cgroup import (
+        CPU_ACCT_USAGE,
+        MEMORY_USAGE,
+    )
+    CPU_ACCT_USAGE.write(pod_dir, str(cpu_ns), cfg)
+    MEMORY_USAGE.write(pod_dir, str(mem_bytes), cfg)
+
+
+class StaticPods:
+    def __init__(self, pods):
+        self.pods = pods
+
+    def running_pods(self):
+        return self.pods
+
+
+@pytest.fixture
+def env(tmp_path):
+    cfg = SystemConfig(
+        cgroup_root=str(tmp_path / "cgroup"),
+        proc_root=str(tmp_path / "proc"),
+    )
+    write_proc_stat(cfg.proc_root, busy=0)
+    write_meminfo(cfg.proc_root, total_kb=16 * 1024 * 1024,
+                  avail_kb=8 * 1024 * 1024)
+    mc = MetricCache()
+    return cfg, mc
+
+
+class TestCollectors:
+    def test_node_cpu_rate_and_memory(self, env):
+        cfg, mc = env
+        ctx = CollectorContext(metric_cache=mc, system_config=cfg)
+        c = NodeResourceCollector()
+        c.setup(ctx)
+        c.collect(0.0)   # first tick primes the counter
+        assert mc.aggregate(MetricKind.NODE_CPU_USAGE) is None
+        # +200 busy jiffies over 1s at USER_HZ=100 -> 2 cores -> 2000 mCPU
+        write_proc_stat(cfg.proc_root, busy=200)
+        c.collect(1.0)
+        assert mc.aggregate(
+            MetricKind.NODE_CPU_USAGE, agg=A.LAST) == pytest.approx(2000.0)
+        # memory: 16GiB total - 8GiB avail = 8192 MiB
+        assert mc.aggregate(
+            MetricKind.NODE_MEMORY_USAGE, agg=A.LAST
+        ) == pytest.approx(8192.0)
+
+    def test_pod_usage_and_sys_residual(self, env):
+        cfg, mc = env
+        pods = [
+            PodMeta("be-1", "kubepods/besteffort/be-1", QoSClass.BE),
+            PodMeta("ls-1", "kubepods/burstable/ls-1", QoSClass.LS),
+        ]
+        write_pod_cgroup(cfg, pods[0].cgroup_dir, 0, 512 * 1024 * 1024)
+        write_pod_cgroup(cfg, pods[1].cgroup_dir, 0, 1024 * 1024 * 1024)
+        ctx = CollectorContext(
+            metric_cache=mc, system_config=cfg, pod_provider=StaticPods(pods)
+        )
+        adv = MetricsAdvisor(
+            ctx,
+            [NodeResourceCollector(), PodResourceCollector(),
+             BEResourceCollector(), SysResourceCollector()],
+        )
+        adv.collect_all(0.0)
+        # advance counters: node 3 cores, be pod 0.5 core, ls pod 1 core
+        write_proc_stat(cfg.proc_root, busy=300)
+        write_pod_cgroup(cfg, pods[0].cgroup_dir, int(0.5e9),
+                         512 * 1024 * 1024)
+        write_pod_cgroup(cfg, pods[1].cgroup_dir, int(1.0e9),
+                         1024 * 1024 * 1024)
+        adv.collect_all(1.0)
+
+        last = lambda k, l=None: mc.aggregate(k, l, agg=A.LAST)
+        assert last(MetricKind.POD_CPU_USAGE, {"pod": "be-1"}) == pytest.approx(500.0)
+        assert last(MetricKind.POD_MEMORY_USAGE, {"pod": "ls-1"}) == pytest.approx(1024.0)
+        assert last(MetricKind.BE_CPU_USAGE) == pytest.approx(500.0)
+        # system residual: 3000 - 1500 = 1500 mCPU
+        assert last(MetricKind.SYS_CPU_USAGE) == pytest.approx(1500.0)
+
+    def test_pod_restart_counter_reset_clamped(self, env):
+        cfg, mc = env
+        pod = PodMeta("p1", "kubepods/p1", QoSClass.LS)
+        write_pod_cgroup(cfg, pod.cgroup_dir, int(5e9), 1)
+        ctx = CollectorContext(
+            metric_cache=mc, system_config=cfg,
+            pod_provider=StaticPods([pod]),
+        )
+        c = PodResourceCollector()
+        c.setup(ctx)
+        c.collect(0.0)
+        # counter went backwards (container restart): rate clamps to 0
+        write_pod_cgroup(cfg, pod.cgroup_dir, int(1e9), 1)
+        c.collect(1.0)
+        assert mc.aggregate(
+            MetricKind.POD_CPU_USAGE, {"pod": "p1"}, agg=A.LAST) == 0.0
+
+    def test_psi(self, env):
+        cfg, mc = env
+        pdir = os.path.join(cfg.proc_root, "pressure")
+        os.makedirs(pdir)
+        with open(os.path.join(pdir, "cpu"), "w") as f:
+            f.write("some avg10=1.50 avg60=0.80 avg300=0.30 total=100\n")
+        with open(os.path.join(pdir, "memory"), "w") as f:
+            f.write("some avg10=2.00 avg60=0 avg300=0 total=0\n"
+                    "full avg10=0.75 avg60=0 avg300=0 total=0\n")
+        with open(os.path.join(pdir, "io"), "w") as f:
+            f.write("some avg10=0.10 avg60=0 avg300=0 total=0\n")
+        c = PSICollector()
+        c.setup(CollectorContext(metric_cache=mc, system_config=cfg))
+        assert c.enabled()
+        c.collect(1.0)
+        assert mc.aggregate(
+            MetricKind.PSI_CPU_SOME_AVG10, agg=A.LAST) == 1.50
+        assert mc.aggregate(
+            MetricKind.PSI_MEM_FULL_AVG10, agg=A.LAST) == 0.75
+
+    def test_advisor_tick_respects_interval(self, env):
+        cfg, mc = env
+        ctx = CollectorContext(metric_cache=mc, system_config=cfg)
+        c = NodeResourceCollector()
+        adv = MetricsAdvisor(ctx, [c], interval_seconds=10.0)
+        adv.tick(0.0)
+        write_proc_stat(cfg.proc_root, busy=100)
+        adv.tick(5.0)   # too soon: no collection
+        adv.tick(10.0)  # 1 core over 10s
+        assert mc.aggregate(
+            MetricKind.NODE_CPU_USAGE, agg=A.LAST) == pytest.approx(100.0)
