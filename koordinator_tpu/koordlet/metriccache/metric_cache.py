@@ -200,7 +200,11 @@ class MetricCache:
         for i, w in enumerate(windows):
             mat[i, : len(w)] = w
         counts = np.sum(~np.isnan(mat), axis=1)
-        sorted_mat = np.sort(mat, axis=1)  # NaNs sort to the end
+        # O(S*T log T) sort only when a percentile was actually requested
+        sorted_mat = (
+            np.sort(mat, axis=1)  # NaNs sort to the end
+            if any(a in _PERCENTILE for a in aggs) else None
+        )
         for a in aggs:
             if a is AggregationType.COUNT:
                 vals = counts.astype(float)

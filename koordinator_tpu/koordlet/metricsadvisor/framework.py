@@ -23,14 +23,19 @@ from koordinator_tpu.koordlet.system.cgroup import SystemConfig
 
 @dataclasses.dataclass
 class PodMeta:
-    """What collectors need to know about a running pod (reference:
-    statesinformer.PodMeta: pod + cgroup parent dir)."""
+    """What node-local subsystems need to know about a running pod
+    (reference: statesinformer.PodMeta: pod + cgroup parent dir)."""
 
     uid: str
     cgroup_dir: str            # e.g. "kubepods/pod<uid>"
     qos: QoSClass = QoSClass.NONE
     containers: Dict[str, str] = dataclasses.field(default_factory=dict)
     # container name -> cgroup dir
+    name: str = ""
+    priority: int = 0          # k8s numeric priority (eviction order)
+    cpu_request_mcpu: int = 0
+    cpu_limit_mcpu: int = 0    # 0 = no limit
+    memory_limit_mib: int = 0  # 0 = no limit
 
 
 class PodProvider(Protocol):

@@ -1,0 +1,18 @@
+from koordinator_tpu.koordlet.qosmanager.framework import (
+    CPUInfo,
+    QoSContext,
+    QoSManager,
+)
+from koordinator_tpu.koordlet.qosmanager.cpusuppress import CPUSuppress
+from koordinator_tpu.koordlet.qosmanager.evictors import CPUEvictor, MemoryEvictor
+from koordinator_tpu.koordlet.qosmanager.cpuburst import CPUBurst
+
+__all__ = [
+    "CPUInfo",
+    "QoSContext",
+    "QoSManager",
+    "CPUSuppress",
+    "CPUEvictor",
+    "MemoryEvictor",
+    "CPUBurst",
+]

@@ -1,0 +1,69 @@
+"""CPU burst: let latency-sensitive containers briefly exceed their cfs
+quota to absorb spikes.
+
+Reference: pkg/koordlet/qosmanager/plugins/cpuburst/cpu_burst.go — for
+each non-BE container with a cpu limit, when the burst policy allows:
+
+  cpu.cfs_burst_us = limit_cores * period * CPUBurstPercent / 100
+
+(burst buffer the kernel may carry over between periods). The cfs-quota-
+burst half (scaling quota up under throttling, bounded by
+CFSQuotaBurstPercent and the node share-pool threshold) degrades back
+when node utilization crosses SharePoolThresholdPercent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.koordlet.metriccache import AggregationType, MetricKind
+from koordinator_tpu.koordlet.qosmanager.framework import QoSContext
+from koordinator_tpu.koordlet.resourceexecutor import CgroupUpdater
+from koordinator_tpu.koordlet.system.cgroup import CFS_PERIOD_US
+
+
+class CPUBurst:
+    name = "cpuburst"
+    interval_seconds = 1.0
+
+    def enabled(self, ctx: QoSContext) -> bool:
+        return ctx.node_slo.cpu_burst_strategy.policy != "none"
+
+    def _node_share_pool_overloaded(self, ctx: QoSContext,
+                                    now: float) -> bool:
+        """Degrade bursts when node cpu usage crosses the share-pool
+        threshold (cpu_burst.go shared-pool check)."""
+        strategy = ctx.node_slo.cpu_burst_strategy
+        if ctx.node_capacity_mcpu <= 0:
+            return False
+        usage = ctx.metric_cache.aggregate(
+            MetricKind.NODE_CPU_USAGE,
+            start=now - ctx.metric_collect_interval, end=now,
+            agg=AggregationType.LAST,
+        )
+        if usage is None:
+            return False
+        pct = usage / ctx.node_capacity_mcpu * 100.0
+        return pct >= strategy.share_pool_threshold_percent
+
+    def execute(self, ctx: QoSContext, now: float) -> None:
+        strategy = ctx.node_slo.cpu_burst_strategy
+        burst_allowed = strategy.policy in ("auto", "cpuBurstOnly") and (
+            not self._node_share_pool_overloaded(ctx, now)
+        )
+        for pod in ctx.pod_provider.running_pods():
+            if pod.qos is QoSClass.BE or pod.cpu_limit_mcpu <= 0:
+                continue
+            if burst_allowed:
+                burst_us = (
+                    pod.cpu_limit_mcpu * CFS_PERIOD_US
+                    * strategy.cpu_burst_percent // 100 // 1000
+                )
+            else:
+                burst_us = 0
+            ctx.executor.update(True, CgroupUpdater(
+                "cpu.cfs_burst_us", pod.cgroup_dir, str(burst_us)))
+            for cdir in pod.containers.values():
+                ctx.executor.update(True, CgroupUpdater(
+                    "cpu.cfs_burst_us", cdir, str(burst_us)))

@@ -1,0 +1,98 @@
+"""QoS strategy framework: timed plugins that actuate node QoS.
+
+Reference: pkg/koordlet/qosmanager/{qosmanager.go,framework/strategy.go,
+framework/context.go} — each strategy runs on its own interval with
+access to the states informer, metric cache, and resource executor; the
+helpers' eviction path is shared.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Protocol, Sequence
+
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta, PodProvider
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.manager.sloconfig import NodeSLOSpec
+from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUInfo:
+    """One logical processor (reference: koordletutil.ProcessorInfo)."""
+
+    cpu_id: int
+    core_id: int
+    socket_id: int
+    node_id: int  # NUMA node
+
+
+#: Eviction callback: (pods, reason) -> uids actually evicted. The node
+#: agent wires this to the apiserver eviction API (reference:
+#: framework.Evictor.EvictPodsIfNotEvicted).
+EvictFn = Callable[[List[PodMeta], str], List[str]]
+
+
+@dataclasses.dataclass
+class QoSContext:
+    """Shared strategy dependencies (reference: framework/context.go)."""
+
+    metric_cache: MetricCache
+    executor: ResourceUpdateExecutor
+    pod_provider: PodProvider
+    system_config: SystemConfig
+    node_slo: NodeSLOSpec = dataclasses.field(default_factory=NodeSLOSpec)
+    node_capacity_mcpu: int = 0
+    node_capacity_mem_mib: int = 0
+    node_reserved_mcpu: int = 0
+    cpu_infos: List[CPUInfo] = dataclasses.field(default_factory=list)
+    evict: Optional[EvictFn] = None
+    auditor: Optional[Auditor] = None
+    #: cgroup parent of the best-effort QoS tier (reference:
+    #: koordletutil.GetPodQoSRelativePath(PodQOSBestEffort))
+    be_cgroup_dir: str = "kubepods/besteffort"
+    #: how far back "latest" metric queries look
+    metric_collect_interval: float = 60.0
+
+    def log(self, group: str, subject: str, op: str, detail: str = "") -> None:
+        if self.auditor is not None:
+            self.auditor.log(group, subject, op, detail)
+
+
+class QoSStrategy(Protocol):
+    name: str
+    interval_seconds: float
+
+    def enabled(self, ctx: QoSContext) -> bool: ...
+
+    def execute(self, ctx: QoSContext, now: float) -> None: ...
+
+
+class QoSManager:
+    """Runs strategies on their intervals (reference: qosmanager.go:42-51
+    registers cpusuppress, cpuevict, memoryevict, cpuburst, ...)."""
+
+    def __init__(self, ctx: QoSContext, strategies: Sequence[QoSStrategy]):
+        self.ctx = ctx
+        self.strategies = list(strategies)
+        self._last_run: Dict[str, float] = {}
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for s in self.strategies:
+            last = self._last_run.get(s.name, -1e18)
+            if now - last < s.interval_seconds:
+                continue
+            if s.enabled(self.ctx):
+                s.execute(self.ctx, now)
+            self._last_run[s.name] = now
+
+    def run_all(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for s in self.strategies:
+            if s.enabled(self.ctx):
+                s.execute(self.ctx, now)
+            self._last_run[s.name] = now

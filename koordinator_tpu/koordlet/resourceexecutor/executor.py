@@ -49,13 +49,24 @@ def merge_if_value_larger(old: str, new: str) -> Tuple[str, bool]:
     return new, n > o
 
 
+def parse_cfs_quota(raw: str) -> Optional[int]:
+    """Quota microseconds from a v1 cpu.cfs_quota_us or v2 cpu.max
+    content; "max" and -1 both mean unlimited (-1). None if unparsable."""
+    try:
+        return int(raw.split()[0].replace("max", "-1"))
+    except (ValueError, IndexError):
+        return None
+
+
 def merge_if_cfs_quota_larger(old: str, new: str) -> Tuple[str, bool]:
     """cfs_quota: -1 (unlimited) is the largest value (reference:
     updater.go MergeConditionIfCFSQuotaIsLarger)."""
+    o = parse_cfs_quota(old)
     try:
-        o = int(old.split()[0].replace("max", "-1"))
         n = int(new)
-    except (ValueError, IndexError):
+    except ValueError:
+        n = None
+    if o is None or n is None:
         return new, True
     if o == -1:
         return new, False

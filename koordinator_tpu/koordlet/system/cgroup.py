@@ -28,6 +28,10 @@ class CgroupVersion(enum.Enum):
 #: v1 hierarchies this framework manages.
 V1_SUBSYSTEMS = ("cpu", "cpuacct", "cpuset", "memory", "blkio")
 
+#: kernel cfs period in microseconds; quota and burst math in the
+#: suppress/evict/burst strategies must all use the same value.
+CFS_PERIOD_US = 100000
+
 
 @dataclasses.dataclass
 class SystemConfig:
@@ -204,9 +208,26 @@ class CgroupResource:
 
     def write(self, parent_dir: str, content: str,
               cfg: Optional[SystemConfig] = None) -> None:
-        for p in self.paths(parent_dir, cfg):
-            with open(p, "w") as f:
+        paths = self.paths(parent_dir, cfg)
+        if len(paths) == 1:
+            with open(paths[0], "w") as f:
                 f.write(content)
+            return
+        # multi-hierarchy (cgroup.procs): a hierarchy that is not mounted
+        # or lacks this cgroup dir is skipped — raising midway would leave
+        # the task split across old/new cgroups with no way to converge
+        first_err: Optional[OSError] = None
+        wrote = False
+        for p in paths:
+            try:
+                with open(p, "w") as f:
+                    f.write(content)
+                wrote = True
+            except OSError as e:
+                if first_err is None:
+                    first_err = e
+        if not wrote and first_err is not None:
+            raise first_err
 
 
 # -- v2 packed-file encoders -------------------------------------------------

@@ -1,0 +1,361 @@
+"""qosmanager tests: suppress budget math, cpuset selection, cfs quota
+policy, evictors, cpu burst.
+
+Oracles: cpu_suppress.go:137-163 (budget), :653 (cpuset policy), :589
+(cfs quota); memory_evict.go:101-160; cpu_evict.go:246-360.
+"""
+
+import pytest
+
+from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
+from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+from koordinator_tpu.koordlet.qosmanager import (
+    CPUBurst,
+    CPUEvictor,
+    CPUInfo,
+    CPUSuppress,
+    MemoryEvictor,
+    QoSContext,
+    QoSManager,
+)
+from koordinator_tpu.koordlet.qosmanager.cpusuppress import (
+    calculate_be_suppress_mcpu,
+    select_suppress_cpus,
+)
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.resourceexecutor.executor import ensure_cgroup_dir
+from koordinator_tpu.koordlet.system.cgroup import (
+    CPU_BURST,
+    CPU_CFS_QUOTA,
+    CPU_SET,
+    SystemConfig,
+)
+from koordinator_tpu.manager.sloconfig import (
+    NodeSLOSpec,
+    ResourceThresholdStrategy,
+)
+
+
+def topo_2numa_8cpu():
+    """2 NUMA nodes x 2 cores x 2 HT = 8 cpus; siblings adjacent ids."""
+    infos = []
+    for node in range(2):
+        for core in range(2):
+            for ht in range(2):
+                cpu_id = node * 4 + core * 2 + ht
+                infos.append(CPUInfo(
+                    cpu_id=cpu_id, core_id=node * 2 + core,
+                    socket_id=0, node_id=node,
+                ))
+    return infos
+
+
+class StaticPods:
+    def __init__(self, pods):
+        self.pods = pods
+
+    def running_pods(self):
+        return self.pods
+
+
+def make_ctx(tmp_path, pods, slo=None, cap_mcpu=8000, cap_mem=16384,
+             evict=None):
+    cfg = SystemConfig(cgroup_root=str(tmp_path / "cg"),
+                       proc_root=str(tmp_path / "proc"))
+    ensure_cgroup_dir("kubepods/besteffort", cfg)
+    for p in pods:
+        ensure_cgroup_dir(p.cgroup_dir, cfg)
+        for c in p.containers.values():
+            ensure_cgroup_dir(c, cfg)
+    mc = MetricCache()
+    return QoSContext(
+        metric_cache=mc,
+        executor=ResourceUpdateExecutor(cfg, auditor=Auditor()),
+        pod_provider=StaticPods(pods),
+        system_config=cfg,
+        node_slo=slo or NodeSLOSpec(
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True
+            )
+        ),
+        node_capacity_mcpu=cap_mcpu,
+        node_capacity_mem_mib=cap_mem,
+        cpu_infos=topo_2numa_8cpu(),
+        evict=evict,
+        auditor=Auditor(),
+    )
+
+
+class TestSuppressBudget:
+    def test_formula(self):
+        # cap 8000, threshold 65 -> 5200; LS used 2000; sys = 3000-2500=500
+        got = calculate_be_suppress_mcpu(
+            capacity_mcpu=8000, threshold_percent=65,
+            node_used_mcpu=3000.0,
+            pod_used_mcpu={"ls": 2000.0, "be": 500.0},
+            non_be_uids={"ls"}, reserved_mcpu=0,
+        )
+        assert got == 5200 - 2000 - 500
+
+    def test_reserved_wins_over_system(self):
+        got = calculate_be_suppress_mcpu(
+            8000, 65, 2000.0, {"ls": 2000.0}, {"ls"}, reserved_mcpu=700
+        )
+        # system = max(2000-2000, 0) = 0; max(0, 700) = 700
+        assert got == 5200 - 2000 - 700
+
+
+class TestSelectCPUs:
+    def test_ht_pairs_scattered_across_numa(self):
+        cpus = select_suppress_cpus(4, topo_2numa_8cpu(), old_count=0)
+        assert len(cpus) == 4
+        # scattered: 2 from each NUMA node, HT-paired
+        numa0 = [c for c in cpus if c < 4]
+        numa1 = [c for c in cpus if c >= 4]
+        assert len(numa0) == 2 and len(numa1) == 2
+        assert numa0[1] == numa0[0] + 1  # sibling pair
+
+    def test_minimum_two(self):
+        assert len(select_suppress_cpus(0, topo_2numa_8cpu(), 0)) == 2
+
+    def test_growth_rate_limited(self):
+        # 8 cpus -> max increase ceil(0.8)=1 per round
+        cpus = select_suppress_cpus(8, topo_2numa_8cpu(), old_count=2)
+        assert len(cpus) == 3
+
+    def test_capped_at_available(self):
+        assert len(select_suppress_cpus(64, topo_2numa_8cpu(), 0)) == 8
+
+
+class TestCPUSuppressStrategy:
+    def _prime(self, ctx, node_mcpu, be_mcpu, ls_mcpu):
+        mc = ctx.metric_cache
+        mc.append(MetricKind.NODE_CPU_USAGE, None, 100.0, node_mcpu)
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "ls"}, 100.0, ls_mcpu)
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "be"}, 100.0, be_mcpu)
+
+    def _pods(self):
+        return [
+            PodMeta("ls", "kubepods/burstable/ls", QoSClass.LS),
+            PodMeta("be", "kubepods/besteffort/be", QoSClass.BE,
+                    containers={"c": "kubepods/besteffort/be/c"}),
+        ]
+
+    def test_cpuset_policy_writes_be_dirs(self, tmp_path):
+        ctx = make_ctx(tmp_path, self._pods())
+        self._prime(ctx, 3000, 500, 2000)
+        CPUSuppress().execute(ctx, now=100.0)
+        # budget (8000*65% - 2000 - 500)/1000 = 2.7 -> 2 cpus
+        got = CPU_SET.read("kubepods/besteffort", ctx.system_config)
+        assert got == "0,1"
+        assert CPU_SET.read("kubepods/besteffort/be/c",
+                            ctx.system_config) == "0,1"
+
+    def test_cfs_quota_policy(self, tmp_path):
+        slo = NodeSLOSpec(
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True, cpu_suppress_policy="cfsQuota",
+            )
+        )
+        ctx = make_ctx(tmp_path, self._pods(), slo=slo)
+        CPU_CFS_QUOTA.write("kubepods/besteffort", "-1", ctx.system_config)
+        self._prime(ctx, 3000, 500, 2000)
+        CPUSuppress().execute(ctx, now=100.0)
+        got = int(CPU_CFS_QUOTA.read("kubepods/besteffort",
+                                     ctx.system_config))
+        assert got == 2700 * 100000 // 1000
+
+    def test_disabled_recovers(self, tmp_path):
+        ctx = make_ctx(tmp_path, self._pods())
+        self._prime(ctx, 3000, 500, 2000)
+        s = CPUSuppress()
+        s.execute(ctx, now=100.0)
+        assert CPU_SET.read("kubepods/besteffort",
+                            ctx.system_config) == "0,1"
+        ctx.node_slo.resource_used_threshold_with_be.enable = False
+        s.execute(ctx, now=101.0)
+        got = CPU_SET.read("kubepods/besteffort", ctx.system_config)
+        assert got == "0,1,2,3,4,5,6,7"
+
+    def test_kernel_range_cpuset_counted_correctly(self, tmp_path):
+        # kernel normalizes cpuset to "0-7": growth limit must see 8 old
+        # cpus, not 2, and not clamp the new set below the budget
+        ctx = make_ctx(tmp_path, self._pods())
+        CPU_SET.write("kubepods/besteffort", "0-7", ctx.system_config)
+        self._prime(ctx, 3000, 500, 2000)  # budget -> 2 cpus
+        CPUSuppress().execute(ctx, now=100.0)
+        assert CPU_SET.read("kubepods/besteffort",
+                            ctx.system_config) == "0,1"
+
+    def test_quota_small_delta_bypassed(self, tmp_path):
+        slo = NodeSLOSpec(
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True, cpu_suppress_policy="cfsQuota",
+            )
+        )
+        ctx = make_ctx(tmp_path, self._pods(), slo=slo)
+        self._prime(ctx, 3000, 500, 2000)
+        s = CPUSuppress()
+        s.execute(ctx, now=100.0)
+        first = CPU_CFS_QUOTA.read("kubepods/besteffort", ctx.system_config)
+        # tiny usage change: delta below 1% of capacity*period -> bypass
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_USAGE, {"pod": "ls"}, 101.0, 2010.0)
+        s.execute(ctx, now=101.0)
+        assert CPU_CFS_QUOTA.read(
+            "kubepods/besteffort", ctx.system_config) == first
+
+
+class TestMemoryEvictor:
+    def test_evicts_largest_lowest_priority_until_released(self, tmp_path):
+        evicted = []
+        pods = [
+            PodMeta("be1", "kubepods/besteffort/be1", QoSClass.BE,
+                    name="be1", priority=5500),
+            PodMeta("be2", "kubepods/besteffort/be2", QoSClass.BE,
+                    name="be2", priority=5000),
+            PodMeta("be3", "kubepods/besteffort/be3", QoSClass.BE,
+                    name="be3", priority=5000),
+        ]
+        ctx = make_ctx(
+            tmp_path, pods,
+            evict=lambda ps, r: evicted.extend(p.uid for p in ps) or [],
+        )
+        mc = ctx.metric_cache
+        # node at 80% of 16384 MiB (threshold 70) -> release to 68%
+        mc.append(MetricKind.NODE_MEMORY_USAGE, None, 100.0, 0.80 * 16384)
+        mc.append(MetricKind.POD_MEMORY_USAGE, {"pod": "be1"}, 100.0, 512.0)
+        mc.append(MetricKind.POD_MEMORY_USAGE, {"pod": "be2"}, 100.0, 1024.0)
+        mc.append(MetricKind.POD_MEMORY_USAGE, {"pod": "be3"}, 100.0, 2048.0)
+        MemoryEvictor().execute(ctx, now=100.0)
+        # need (80-68)% * 16384 = 1966 MiB: be3 (prio 5000, 2048) suffices
+        assert evicted == ["be3"]
+
+    def test_below_threshold_no_evict(self, tmp_path):
+        evicted = []
+        pods = [PodMeta("be1", "kubepods/besteffort/be1", QoSClass.BE)]
+        ctx = make_ctx(tmp_path, pods,
+                       evict=lambda ps, r: evicted.extend(ps) or [])
+        ctx.metric_cache.append(
+            MetricKind.NODE_MEMORY_USAGE, None, 100.0, 0.5 * 16384)
+        MemoryEvictor().execute(ctx, now=100.0)
+        assert evicted == []
+
+    def test_cooldown(self, tmp_path):
+        evicted = []
+        pods = [PodMeta("be1", "kubepods/besteffort/be1", QoSClass.BE)]
+        ctx = make_ctx(tmp_path, pods,
+                       evict=lambda ps, r: evicted.extend(ps) or [])
+        ctx.metric_cache.append(
+            MetricKind.NODE_MEMORY_USAGE, None, 100.0, 0.9 * 16384)
+        m = MemoryEvictor()
+        m.execute(ctx, now=100.0)
+        ctx.metric_cache.append(
+            MetricKind.NODE_MEMORY_USAGE, None, 130.0, 0.9 * 16384)
+        m.execute(ctx, now=130.0)  # within 60s cooldown
+        assert len(evicted) == 1
+
+
+class TestCPUEvictor:
+    def _slo(self):
+        return NodeSLOSpec(
+            resource_used_threshold_with_be=ResourceThresholdStrategy(
+                enable=True,
+                cpu_evict_be_satisfaction_lower_percent=60,
+                cpu_evict_be_satisfaction_upper_percent=80,
+            )
+        )
+
+    def test_evicts_when_starved(self, tmp_path):
+        evicted = []
+        pods = [
+            PodMeta("be1", "kubepods/besteffort/be1", QoSClass.BE,
+                    priority=5000, cpu_request_mcpu=2000),
+            PodMeta("be2", "kubepods/besteffort/be2", QoSClass.BE,
+                    priority=5500, cpu_request_mcpu=2000),
+        ]
+        ctx = make_ctx(tmp_path, pods, slo=self._slo(),
+                       evict=lambda ps, r: evicted.extend(
+                           p.uid for p in ps) or [])
+        # BE tier quota 2 cores against 4 cores requested -> 50% < 60%
+        CPU_CFS_QUOTA.write("kubepods/besteffort", "200000",
+                            ctx.system_config)
+        mc = ctx.metric_cache
+        mc.append(MetricKind.BE_CPU_USAGE, None, 100.0, 1900.0)  # 95% of limit
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "be1"}, 100.0, 900.0)
+        mc.append(MetricKind.POD_CPU_USAGE, {"pod": "be2"}, 100.0, 1000.0)
+        CPUEvictor().execute(ctx, now=100.0)
+        # release (0.8-0.5)*4000 = 1200 mCPU: be1 (lowest priority) first
+        assert evicted == ["be1"]
+
+    def test_not_starved_no_evict(self, tmp_path):
+        evicted = []
+        pods = [PodMeta("be1", "kubepods/besteffort/be1", QoSClass.BE,
+                        cpu_request_mcpu=2000)]
+        ctx = make_ctx(tmp_path, pods, slo=self._slo(),
+                       evict=lambda ps, r: evicted.extend(ps) or [])
+        CPU_CFS_QUOTA.write("kubepods/besteffort", "200000",
+                            ctx.system_config)
+        # usage far below limit: not starved
+        ctx.metric_cache.append(MetricKind.BE_CPU_USAGE, None, 100.0, 500.0)
+        CPUEvictor().execute(ctx, now=100.0)
+        assert evicted == []
+
+
+class TestCPUBurst:
+    def test_burst_applied_to_ls_with_limit(self, tmp_path):
+        pods = [
+            PodMeta("ls", "kubepods/burstable/ls", QoSClass.LS,
+                    cpu_limit_mcpu=2000,
+                    containers={"c": "kubepods/burstable/ls/c"}),
+            PodMeta("be", "kubepods/besteffort/be", QoSClass.BE,
+                    cpu_limit_mcpu=2000),
+        ]
+        slo = NodeSLOSpec()
+        slo.cpu_burst_strategy.policy = "auto"
+        ctx = make_ctx(tmp_path, pods, slo=slo)
+        CPUBurst().execute(ctx, now=100.0)
+        # 2000 mCPU * 100000us * 1000% / 100 / 1000 = 2_000_000 us
+        assert CPU_BURST.read("kubepods/burstable/ls",
+                              ctx.system_config) == "2000000"
+        assert CPU_BURST.read("kubepods/burstable/ls/c",
+                              ctx.system_config) == "2000000"
+        with pytest.raises(OSError):
+            CPU_BURST.read("kubepods/besteffort/be", ctx.system_config)
+
+    def test_burst_degrades_when_share_pool_hot(self, tmp_path):
+        pods = [PodMeta("ls", "kubepods/burstable/ls", QoSClass.LS,
+                        cpu_limit_mcpu=2000)]
+        slo = NodeSLOSpec()
+        slo.cpu_burst_strategy.policy = "auto"
+        ctx = make_ctx(tmp_path, pods, slo=slo)
+        # node at 60% > 50% share pool threshold
+        ctx.metric_cache.append(
+            MetricKind.NODE_CPU_USAGE, None, 100.0, 4800.0)
+        CPUBurst().execute(ctx, now=100.0)
+        assert CPU_BURST.read("kubepods/burstable/ls",
+                              ctx.system_config) == "0"
+
+
+class TestQoSManager:
+    def test_tick_intervals(self, tmp_path):
+        runs = []
+
+        class Fake:
+            name = "fake"
+            interval_seconds = 10.0
+
+            def enabled(self, ctx):
+                return True
+
+            def execute(self, ctx, now):
+                runs.append(now)
+
+        ctx = make_ctx(tmp_path, [])
+        mgr = QoSManager(ctx, [Fake()])
+        mgr.tick(0.0)
+        mgr.tick(5.0)
+        mgr.tick(10.0)
+        assert runs == [0.0, 10.0]
