@@ -1,0 +1,3 @@
+from koordinator_tpu.koordlet.pleg.pleg import PLEG, PodLifecycleEvent
+
+__all__ = ["PLEG", "PodLifecycleEvent"]

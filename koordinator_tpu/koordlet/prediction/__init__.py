@@ -1,0 +1,15 @@
+from koordinator_tpu.koordlet.prediction.histogram import HistogramBank
+from koordinator_tpu.koordlet.prediction.predict_server import (
+    PeakPredictServer,
+    PredictionConfig,
+)
+from koordinator_tpu.koordlet.prediction.predictor import (
+    prod_reclaimable,
+)
+
+__all__ = [
+    "HistogramBank",
+    "PeakPredictServer",
+    "PredictionConfig",
+    "prod_reclaimable",
+]

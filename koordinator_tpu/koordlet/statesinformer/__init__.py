@@ -1,0 +1,8 @@
+from koordinator_tpu.koordlet.statesinformer.states_informer import (
+    StatesInformer,
+)
+from koordinator_tpu.koordlet.statesinformer.nodemetric_reporter import (
+    NodeMetricReporter,
+)
+
+__all__ = ["StatesInformer", "NodeMetricReporter"]

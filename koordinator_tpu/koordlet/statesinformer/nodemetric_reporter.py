@@ -1,0 +1,150 @@
+"""NodeMetric reporter: aggregates the metric cache into a NodeMetric.
+
+Reference: pkg/koordlet/statesinformer/impl/states_nodemetric.go —
+``collectMetric`` (:332) queries the TSDB for the collect-policy window,
+aggregates node + per-pod usage (avg), percentile stats for aggregated-
+usage mode, the system residual, prod-tier usage, and the predictor's
+prod-reclaimable, then updates the NodeMetric CR status (:244 sync).
+
+Here the produced object is the scheduler-facing
+``apis.types.NodeMetric``, so the report loop closes the colocation
+cycle in-process: koordlet reports -> manager computes batch resources ->
+scheduler places BE pods. Pod aggregation uses the cache's batched
+matrix path — all pods reduce in one pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from koordinator_tpu.apis.extension import (
+    PriorityClass,
+    QoSClass,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import NodeMetric
+from koordinator_tpu.koordlet.metriccache import (
+    AggregationType,
+    MetricCache,
+    MetricKind,
+)
+from koordinator_tpu.koordlet.prediction import (
+    PeakPredictServer,
+    prod_reclaimable,
+)
+from koordinator_tpu.koordlet.statesinformer.states_informer import (
+    StatesInformer,
+)
+
+#: percentile -> aggregation type for aggregated-usage mode
+_PCTS = {
+    50: AggregationType.P50,
+    90: AggregationType.P90,
+    95: AggregationType.P95,
+    99: AggregationType.P99,
+}
+
+
+class NodeMetricReporter:
+    def __init__(self, metric_cache: MetricCache, informer: StatesInformer,
+                 predict_server: Optional[PeakPredictServer] = None):
+        self.metric_cache = metric_cache
+        self.informer = informer
+        self.predict_server = predict_server
+        self.last_report: Optional[NodeMetric] = None
+
+    def _window(self, now: float) -> float:
+        policy = self.informer.get_collect_policy()
+        dur = policy.aggregate_duration_seconds if policy else 300
+        return now - dur
+
+    def report(self, now: float) -> Optional[NodeMetric]:
+        node = self.informer.get_node()
+        if node is None:
+            return None
+        mc = self.metric_cache
+        start = self._window(now)
+        A = AggregationType
+
+        metric = NodeMetric(node_name=node.name, update_time=now)
+        policy = self.informer.get_collect_policy()
+        if policy is not None:
+            metric.report_interval = float(policy.report_interval_seconds)
+
+        # node usage (avg over the window) + aggregated percentiles
+        node_aggs = mc.aggregate_batch(
+            [(MetricKind.NODE_CPU_USAGE, None),
+             (MetricKind.NODE_MEMORY_USAGE, None)],
+            start, now, [A.AVG] + list(_PCTS.values()),
+        )
+        cpu_row, mem_row = node_aggs
+        if cpu_row[A.AVG] is not None:
+            metric.node_usage[ResourceName.CPU] = int(cpu_row[A.AVG])
+        if mem_row[A.AVG] is not None:
+            metric.node_usage[ResourceName.MEMORY] = int(mem_row[A.AVG])
+        for pct, agg in _PCTS.items():
+            usage = {}
+            if cpu_row[agg] is not None:
+                usage[ResourceName.CPU] = int(cpu_row[agg])
+            if mem_row[agg] is not None:
+                usage[ResourceName.MEMORY] = int(mem_row[agg])
+            if usage:
+                metric.aggregated_usage[pct] = usage
+
+        # per-pod usage: ONE batched matrix reduction for all pods
+        pods = self.informer.running_pods()
+        reqs = []
+        for pod in pods:
+            reqs.append((MetricKind.POD_CPU_USAGE, {"pod": pod.uid}))
+            reqs.append((MetricKind.POD_MEMORY_USAGE, {"pod": pod.uid}))
+        pod_aggs = mc.aggregate_batch(reqs, start, now, [A.AVG])
+        prod_cpu = prod_mem = 0
+        for i, pod in enumerate(pods):
+            cpu = pod_aggs[2 * i][A.AVG]
+            mem = pod_aggs[2 * i + 1][A.AVG]
+            usage = {}
+            if cpu is not None:
+                usage[ResourceName.CPU] = int(cpu)
+            if mem is not None:
+                usage[ResourceName.MEMORY] = int(mem)
+            if usage:
+                metric.pod_usages[pod.uid] = usage
+                is_prod = pod.qos in (
+                    QoSClass.LSE, QoSClass.LSR, QoSClass.LS
+                ) or pod.priority >= 9000
+                metric.pod_priority_class[pod.uid] = (
+                    PriorityClass.PROD if is_prod else PriorityClass.BATCH
+                )
+                if is_prod:
+                    prod_cpu += usage.get(ResourceName.CPU, 0)
+                    prod_mem += usage.get(ResourceName.MEMORY, 0)
+        metric.prod_usage = {
+            ResourceName.CPU: prod_cpu, ResourceName.MEMORY: prod_mem
+        }
+
+        # system residual
+        sys_aggs = mc.aggregate_batch(
+            [(MetricKind.SYS_CPU_USAGE, None),
+             (MetricKind.SYS_MEMORY_USAGE, None)],
+            start, now, [A.AVG],
+        )
+        if sys_aggs[0][A.AVG] is not None:
+            metric.sys_usage[ResourceName.CPU] = int(sys_aggs[0][A.AVG])
+        if sys_aggs[1][A.AVG] is not None:
+            metric.sys_usage[ResourceName.MEMORY] = int(sys_aggs[1][A.AVG])
+
+        # predictor: prod reclaimable (feeds MID resources)
+        if self.predict_server is not None:
+            rec = prod_reclaimable(
+                self.predict_server,
+                [(p.uid, p.cpu_request_mcpu, 0) for p in pods
+                 if p.qos in (QoSClass.LS, QoSClass.LSR, QoSClass.LSE)],
+                now,
+            )
+            metric.prod_reclaimable = {
+                ResourceName.CPU: rec["cpu"],
+                ResourceName.MEMORY: rec["memory"],
+            }
+
+        self.last_report = metric
+        return metric

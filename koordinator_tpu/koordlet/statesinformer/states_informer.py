@@ -1,0 +1,88 @@
+"""States informer: the node agent's view of node / pods / NodeSLO.
+
+Reference: pkg/koordlet/statesinformer/impl/{states_informer.go,
+registry.go, callback_runner.go} — a registry of informer plugins keeps
+node, pod list, NodeSLO, NodeMetric policy in sync and fans callbacks out
+to subscribers (qosmanager strategies re-arm on NodeSLO changes, the
+metric reporter on collect-policy changes).
+
+In this framework the control plane is in-process: setters stand in for
+the apiserver watch; the callback fan-out and the typed getters keep the
+same surface the subsystems program against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Dict, List, Optional, Sequence
+
+from koordinator_tpu.apis.types import NodeSpec
+from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+from koordinator_tpu.manager.nodemetric import NodeMetricCollectPolicy
+from koordinator_tpu.manager.sloconfig import NodeSLOSpec
+
+
+class StateKind(enum.Enum):
+    NODE = "node"
+    PODS = "pods"
+    NODE_SLO = "nodeslo"
+    COLLECT_POLICY = "collect_policy"
+
+
+Callback = Callable[[StateKind, object], None]
+
+
+class StatesInformer:
+    """Typed state + callback fan-out (reference: callback_runner.go)."""
+
+    def __init__(self):
+        self._node: Optional[NodeSpec] = None
+        self._pods: List[PodMeta] = []
+        self._node_slo: NodeSLOSpec = NodeSLOSpec()
+        self._collect_policy: Optional[NodeMetricCollectPolicy] = None
+        self._callbacks: Dict[StateKind, List[Callback]] = {
+            k: [] for k in StateKind
+        }
+
+    # -- subscribe ----------------------------------------------------------
+
+    def register_callback(self, kind: StateKind, cb: Callback) -> None:
+        self._callbacks[kind].append(cb)
+
+    def _fire(self, kind: StateKind, value: object) -> None:
+        for cb in self._callbacks[kind]:
+            cb(kind, value)
+
+    # -- setters (the "watch" side) -----------------------------------------
+
+    def set_node(self, node: NodeSpec) -> None:
+        self._node = node
+        self._fire(StateKind.NODE, node)
+
+    def set_pods(self, pods: Sequence[PodMeta]) -> None:
+        self._pods = list(pods)
+        self._fire(StateKind.PODS, self._pods)
+
+    def set_node_slo(self, slo: NodeSLOSpec) -> None:
+        self._node_slo = slo
+        self._fire(StateKind.NODE_SLO, slo)
+
+    def set_collect_policy(self, policy: NodeMetricCollectPolicy) -> None:
+        self._collect_policy = policy
+        self._fire(StateKind.COLLECT_POLICY, policy)
+
+    # -- getters (what subsystems consume) ----------------------------------
+
+    def get_node(self) -> Optional[NodeSpec]:
+        return self._node
+
+    def running_pods(self) -> List[PodMeta]:
+        """PodProvider protocol for the advisor/qosmanager."""
+        return self._pods
+
+    def get_node_slo(self) -> NodeSLOSpec:
+        return self._node_slo
+
+    def get_collect_policy(self) -> Optional[NodeMetricCollectPolicy]:
+        return self._collect_policy

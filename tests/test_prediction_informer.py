@@ -1,0 +1,248 @@
+"""prediction + statesinformer + pleg tests.
+
+Oracles: prediction/peak_predictor.go (p95 cpu / p98 mem x safety margin,
+cold start, min of pod/priority views), statesinformer/impl/
+states_nodemetric.go (NodeMetric assembly), pleg/watcher.go.
+"""
+
+import os
+
+import pytest
+
+from koordinator_tpu.apis.extension import QoSClass, ResourceName
+from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
+from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+from koordinator_tpu.koordlet.pleg import PLEG
+from koordinator_tpu.koordlet.pleg.pleg import EventType
+from koordinator_tpu.koordlet.prediction import (
+    HistogramBank,
+    PeakPredictServer,
+    PredictionConfig,
+    prod_reclaimable,
+)
+from koordinator_tpu.koordlet.prediction.predict_server import (
+    SYS_KEY,
+    pod_key,
+    priority_key,
+)
+from koordinator_tpu.koordlet.resourceexecutor.executor import ensure_cgroup_dir
+from koordinator_tpu.koordlet.statesinformer import (
+    NodeMetricReporter,
+    StatesInformer,
+)
+from koordinator_tpu.koordlet.statesinformer.states_informer import StateKind
+from koordinator_tpu.apis.types import NodeSpec
+from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+from koordinator_tpu.manager.nodemetric import NodeMetricCollectPolicy
+
+
+class TestHistogramBank:
+    def test_percentile_of_constant_stream(self):
+        h = HistogramBank(first_bucket=25.0)
+        for t in range(100):
+            h.add("a", 500.0, float(t))
+        p95 = h.percentile("a", 0.95)
+        # bucket containing 500 has bounds within 5% growth
+        assert 500 <= p95 <= 500 * 1.1
+
+    def test_percentile_orders(self):
+        h = HistogramBank(first_bucket=25.0)
+        for t in range(90):
+            h.add("a", 100.0, float(t))
+        for t in range(90, 100):
+            h.add("a", 2000.0, float(t))
+        p50 = h.percentile("a", 0.5)
+        p99 = h.percentile("a", 0.99)
+        assert p50 < 200 and p99 >= 2000
+
+    def test_decay_forgets_old_peaks(self):
+        h = HistogramBank(first_bucket=25.0, half_life_seconds=3600)
+        h.add("a", 4000.0, 0.0)
+        # 20 half-lives later, many low samples dominate
+        for i in range(100):
+            h.add("a", 100.0, 72000.0 + i)
+        assert h.percentile("a", 0.95) < 200
+
+    def test_unknown_key_none(self):
+        h = HistogramBank(first_bucket=25.0)
+        assert h.percentile("ghost", 0.95) is None
+
+    def test_batch_matches_scalar(self):
+        h = HistogramBank(first_bucket=25.0)
+        import random
+        rng = random.Random(0)
+        for key in ("a", "b", "c"):
+            for t in range(50):
+                h.add(key, rng.uniform(10, 5000), float(t))
+        batch = h.percentiles_batch(["a", "b", "ghost", "c"], [0.5, 0.95])
+        for i, key in enumerate(["a", "b", "ghost", "c"]):
+            for j, p in enumerate([0.5, 0.95]):
+                assert batch[i][j] == h.percentile(key, p)
+
+    def test_forget_and_state_roundtrip(self):
+        h = HistogramBank(first_bucket=25.0)
+        h.add("a", 100.0, 0.0)
+        h.add("b", 200.0, 0.0)
+        h.forget(["b"])
+        assert h.percentile("a", 0.5) is None
+        assert h.percentile("b", 0.5) is not None
+        h2 = HistogramBank(first_bucket=25.0)
+        h2.load_state(h.state())
+        assert h2.percentile("b", 0.5) == h.percentile("b", 0.5)
+
+
+class TestPeakPredictServer:
+    def test_peak_applies_safety_margin(self):
+        s = PeakPredictServer(PredictionConfig(safety_margin_percent=10))
+        for t in range(100):
+            s.update(pod_key("p"), 1000.0, 512.0, float(t))
+        peak = s.peak(pod_key("p"))
+        assert peak["cpu"] == pytest.approx(
+            s.cpu.percentile(pod_key("p"), 0.95) * 1.1
+        )
+
+    def test_cold_start(self):
+        s = PeakPredictServer(PredictionConfig(cold_start_seconds=900))
+        s.update(pod_key("p"), 100.0, 10.0, 1000.0)
+        assert s.in_cold_start(pod_key("p"), 1100.0)
+        assert not s.in_cold_start(pod_key("p"), 2000.0)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        path = str(tmp_path / "ckpt.json")
+        s = PeakPredictServer(PredictionConfig(checkpoint_path=path))
+        for t in range(50):
+            s.update(pod_key("p"), 700.0, 300.0, float(t))
+        s.save_checkpoint()
+        s2 = PeakPredictServer(PredictionConfig(checkpoint_path=path))
+        assert s2.load_checkpoint()
+        assert s2.peak(pod_key("p"))["cpu"] == s.peak(pod_key("p"))["cpu"]
+
+
+class TestProdReclaimable:
+    def _server(self):
+        s = PeakPredictServer(PredictionConfig(
+            safety_margin_percent=0, cold_start_seconds=0))
+        # pod p uses ~500 mCPU of a 2000 mCPU request
+        for t in range(1000):
+            s.update(pod_key("p"), 500.0, 256.0, float(t))
+            s.update(priority_key("prod"), 500.0, 256.0, float(t))
+            s.update(SYS_KEY, 100.0, 50.0, float(t))
+        return s
+
+    def test_min_of_pod_and_priority_views(self):
+        s = self._server()
+        rec = prod_reclaimable(s, [("p", 2000, 1024)], now=1000.0)
+        pod_view = 2000 - s.peak(pod_key("p"))["cpu"]
+        pri_view = (2000 - s.peak(priority_key("prod"))["cpu"]
+                    - s.peak(SYS_KEY)["cpu"])
+        assert rec["cpu"] == int(min(pod_view, pri_view))
+        assert rec["cpu"] > 0
+
+    def test_cold_start_pod_contributes_zero(self):
+        s = PeakPredictServer(PredictionConfig(cold_start_seconds=1e6))
+        s.update(pod_key("p"), 100.0, 10.0, 0.0)
+        rec = prod_reclaimable(s, [("p", 2000, 1024)], now=100.0)
+        assert rec["cpu"] == 0
+
+
+class TestNodeMetricReporter:
+    def test_report_assembles_nodemetric(self):
+        mc = MetricCache()
+        informer = StatesInformer()
+        informer.set_node(NodeSpec("n0", allocatable={
+            ResourceName.CPU: 8000, ResourceName.MEMORY: 16384}))
+        pods = [
+            PodMeta("ls", "kubepods/burstable/ls", QoSClass.LS,
+                    cpu_request_mcpu=2000),
+            PodMeta("be", "kubepods/besteffort/be", QoSClass.BE),
+        ]
+        informer.set_pods(pods)
+        informer.set_collect_policy(NodeMetricCollectPolicy(300, 60))
+        for t in range(10):
+            mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), 3000.0)
+            mc.append(MetricKind.NODE_MEMORY_USAGE, None, float(t), 8000.0)
+            mc.append(MetricKind.POD_CPU_USAGE, {"pod": "ls"}, float(t), 2000.0)
+            mc.append(MetricKind.POD_CPU_USAGE, {"pod": "be"}, float(t), 400.0)
+            mc.append(MetricKind.SYS_CPU_USAGE, None, float(t), 600.0)
+        reporter = NodeMetricReporter(mc, informer)
+        m = reporter.report(now=10.0)
+        assert m.node_usage[ResourceName.CPU] == 3000
+        assert m.pod_usages["ls"][ResourceName.CPU] == 2000
+        assert m.prod_usage[ResourceName.CPU] == 2000  # only the LS pod
+        assert m.sys_usage[ResourceName.CPU] == 600
+        assert m.aggregated_usage[95][ResourceName.CPU] == 3000
+        assert m.report_interval == 60.0
+        assert m.update_time == 10.0
+
+    def test_report_feeds_manager(self):
+        """The full colocation loop: reporter output drives the batch
+        overcommit calculator."""
+        from koordinator_tpu.apis.types import ClusterSnapshot, PodSpec
+        from koordinator_tpu.manager import NodeResourceController
+
+        mc = MetricCache()
+        informer = StatesInformer()
+        node = NodeSpec("n0", allocatable={
+            ResourceName.CPU: 10000, ResourceName.MEMORY: 10000})
+        informer.set_node(node)
+        informer.set_pods([PodMeta(
+            "default/prod0", "kubepods/p", QoSClass.LS,
+            cpu_request_mcpu=3000)])
+        for t in range(5):
+            mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), 3000.0)
+            mc.append(MetricKind.POD_CPU_USAGE,
+                      {"pod": "default/prod0"}, float(t), 2000.0)
+            mc.append(MetricKind.SYS_CPU_USAGE, None, float(t), 1000.0)
+        m = NodeMetricReporter(mc, informer).report(now=5.0)
+
+        pod = PodSpec("prod0", requests={ResourceName.CPU: 3000},
+                      priority=9500, node_name="n0", qos=QoSClass.LS)
+        snap = ClusterSnapshot(nodes=[node], pods=[pod],
+                               node_metrics={"n0": m}, now=10.0)
+        upd = NodeResourceController().reconcile_all(snap)[0]
+        # batch cpu = 10000 - 4000(margin) - 1000(sys) - 2000(pod) = 3000
+        assert upd.allocatable[ResourceName.BATCH_CPU] == 3000
+
+    def test_callbacks_fire(self):
+        informer = StatesInformer()
+        seen = []
+        informer.register_callback(
+            StateKind.NODE_SLO, lambda k, v: seen.append(k))
+        from koordinator_tpu.manager.sloconfig import NodeSLOSpec
+        informer.set_node_slo(NodeSLOSpec())
+        assert seen == [StateKind.NODE_SLO]
+
+
+class TestPLEG:
+    def test_poll_diff_events(self, tmp_path):
+        cfg = SystemConfig(cgroup_root=str(tmp_path))
+        ensure_cgroup_dir("kubepods/besteffort", cfg)
+        pleg = PLEG(cfg)
+        assert pleg.poll() == []  # primer
+
+        ensure_cgroup_dir("kubepods/besteffort/pod1", cfg)
+        events = pleg.poll()
+        assert [e.event for e in events] == [EventType.POD_ADDED]
+        assert events[0].cgroup_dir == "kubepods/besteffort/pod1"
+
+        ensure_cgroup_dir("kubepods/besteffort/pod1/c1", cfg)
+        events = pleg.poll()
+        assert [e.event for e in events] == [EventType.CONTAINER_ADDED]
+
+        import shutil
+        shutil.rmtree(os.path.join(str(tmp_path), "cpu",
+                                   "kubepods/besteffort/pod1"))
+        events = pleg.poll()
+        kinds = {e.event for e in events}
+        assert EventType.POD_DELETED in kinds
+
+    def test_handlers_invoked(self, tmp_path):
+        cfg = SystemConfig(cgroup_root=str(tmp_path))
+        ensure_cgroup_dir("kubepods", cfg)
+        pleg = PLEG(cfg)
+        got = []
+        pleg.register(got.append)
+        pleg.poll()
+        ensure_cgroup_dir("kubepods/podX", cfg)
+        pleg.poll()
+        assert len(got) == 1 and got[0].event == EventType.POD_ADDED
