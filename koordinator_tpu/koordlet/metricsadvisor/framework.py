@@ -35,6 +35,7 @@ class PodMeta:
     priority: int = 0          # k8s numeric priority (eviction order)
     cpu_request_mcpu: int = 0
     cpu_limit_mcpu: int = 0    # 0 = no limit
+    memory_request_mib: int = 0
     memory_limit_mib: int = 0  # 0 = no limit
 
 

@@ -9,7 +9,9 @@ sample ingest is a row scatter-add, and percentiles for ALL subjects are
 one cumulative-sum pass — the whole node's predictor state updates in a
 few fused array ops instead of N object updates.
 
-Bucket b spans ``[first*growth^b, first*growth^(b+1))``; growth 1.05
+VPA bucket semantics: bucket 0 spans ``[0, first)``; bucket b >= 1 spans
+``[first*growth^(b-1), first*growth^b)``, and percentile queries return
+the crossing bucket's *start* (vpa histogram.Percentile). Growth 1.05
 (DefaultHistogramBucketSizeGrowth 0.05), first bucket 25 mCPU for CPU /
 5 MiB for memory (predict_server.go:208,217 scaled to canonical units).
 Decay halves a sample's weight every half-life (cpu 12h, mem 24h,
@@ -33,8 +35,11 @@ class HistogramBank:
         self.growth = growth
         self.num_buckets = num_buckets
         self.half_life = half_life_seconds
-        #: upper bound of each bucket
-        self.bounds = first_bucket * growth ** np.arange(1, num_buckets + 1)
+        #: start of each bucket (VPA GetBucketStart): 0 for bucket 0,
+        #: first*growth^(b-1) for b >= 1
+        self.bounds = np.concatenate(
+            [[0.0], first_bucket * growth ** np.arange(num_buckets - 1)]
+        )
         self._rows: Dict[str, int] = {}
         self._weights = np.zeros((0, num_buckets), np.float64)
         self._last_decay = np.zeros(0, np.float64)
@@ -63,9 +68,12 @@ class HistogramBank:
         return self._first_seen.get(key)
 
     def _bucket(self, value: float) -> int:
-        if value <= self.first_bucket:
+        if value < self.first_bucket:
             return 0
-        b = int(math.log(value / self.first_bucket) / math.log(self.growth))
+        b = (
+            int(math.log(value / self.first_bucket) / math.log(self.growth))
+            + 1
+        )
         return min(b, self.num_buckets - 1)
 
     def _decay_row(self, idx: int, now: float) -> None:
@@ -142,10 +150,15 @@ class HistogramBank:
 
     # -- checkpoint ---------------------------------------------------------
 
+    #: checkpoint format version; bumped when bucket semantics change so
+    #: stale checkpoints are discarded instead of silently reinterpreted
+    STATE_VERSION = 2
+
     def state(self) -> dict:
         keys = list(self._rows)
         idxs = [self._rows[k] for k in keys]
         return {
+            "version": self.STATE_VERSION,
             "keys": keys,
             "weights": self._weights[idxs].tolist(),
             "last_decay": self._last_decay[idxs].tolist(),
@@ -153,6 +166,8 @@ class HistogramBank:
         }
 
     def load_state(self, state: dict) -> None:
+        if state.get("version") != self.STATE_VERSION:
+            return  # stale format: cold-start rather than misread buckets
         keys = state["keys"]
         n = len(keys)
         self._rows = {k: i for i, k in enumerate(keys)}

@@ -210,7 +210,9 @@ class CPUSuppress:
             old_count = len(_parse_cpuset(old))
         except ValueError:
             old_count = 0
-        want = budget_mcpu // 1000
+        # reference rounds the BE cpuset size UP (cpu_suppress.go:388
+        # math.Ceil), so a non-integral budget still grants the extra CPU
+        want = -(-budget_mcpu // 1000)
         cpus = select_suppress_cpus(want, ctx.cpu_infos, old_count)
         if not cpus:
             return

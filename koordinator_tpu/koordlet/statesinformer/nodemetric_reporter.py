@@ -21,6 +21,7 @@ from koordinator_tpu.apis.extension import (
     PriorityClass,
     QoSClass,
     ResourceName,
+    priority_class_of,
 )
 from koordinator_tpu.apis.types import NodeMetric
 from koordinator_tpu.koordlet.metriccache import (
@@ -109,12 +110,19 @@ class NodeMetricReporter:
                 usage[ResourceName.MEMORY] = int(mem)
             if usage:
                 metric.pod_usages[pod.uid] = usage
-                is_prod = pod.qos in (
-                    QoSClass.LSE, QoSClass.LSR, QoSClass.LS
-                ) or pod.priority >= 9000
-                metric.pod_priority_class[pod.uid] = (
-                    PriorityClass.PROD if is_prod else PriorityClass.BATCH
-                )
+                # Reference GetPodPriorityClassWithDefault (slo-controller
+                # plugin.go:297): resolve from the priority band, default
+                # unlabeled/priority-0 pods to PROD (BE qos -> BATCH) so
+                # ordinary k8s pods' usage stays in the HP sums.
+                cls = priority_class_of(value=pod.priority or None)
+                if cls == PriorityClass.NONE:
+                    cls = (
+                        PriorityClass.BATCH
+                        if pod.qos == QoSClass.BE
+                        else PriorityClass.PROD
+                    )
+                metric.pod_priority_class[pod.uid] = cls
+                is_prod = cls == PriorityClass.PROD
                 if is_prod:
                     prod_cpu += usage.get(ResourceName.CPU, 0)
                     prod_mem += usage.get(ResourceName.MEMORY, 0)
@@ -137,7 +145,8 @@ class NodeMetricReporter:
         if self.predict_server is not None:
             rec = prod_reclaimable(
                 self.predict_server,
-                [(p.uid, p.cpu_request_mcpu, 0) for p in pods
+                [(p.uid, p.cpu_request_mcpu, p.memory_request_mib)
+                 for p in pods
                  if p.qos in (QoSClass.LS, QoSClass.LSR, QoSClass.LSE)],
                 now,
             )

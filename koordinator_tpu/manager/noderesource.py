@@ -212,6 +212,9 @@ class NodeResourceController:
             if plugins is not None
             else (CPUNormalizationPlugin(), ResourceAmplificationPlugin())
         )
+        #: node name -> time of the last synced write-back, for the
+        #: periodic force-update gate (update_time_threshold_seconds)
+        self._last_sync: Dict[str, float] = {}
 
     # -- lowering -----------------------------------------------------------
 
@@ -401,8 +404,25 @@ class NodeResourceController:
                 col: int(new_alloc[i, col]) for col in OVERCOMMIT_COLUMNS
             }
             upd.synced = bool(sync_mask[i])
+            # Periodic force-update: even below the resource-diff
+            # threshold, re-sync once update_time_threshold_seconds has
+            # elapsed since the last write-back (reference:
+            # batchresource NeedSync time gate, plugin.go isResourceDiff
+            # || time since update > UpdateTimeThresholdSeconds).
+            if not upd.synced and bool(enabled[i]):
+                thr = strategies[i].update_time_threshold_seconds
+                # first sighting baselines at now (no restart storm; the
+                # diff gate covers genuinely unsynced nodes)
+                last = self._last_sync.setdefault(node.name, snapshot.now)
+                if thr > 0 and snapshot.now - last >= thr:
+                    upd.synced = True
             upd.degraded = bool(enabled[i]) and not bool(fresh_np[i])
             if upd.synced:
                 node.allocatable.update(upd.allocatable)
+                self._last_sync[node.name] = snapshot.now
             updates.append(upd)
+        # prune departed nodes so the map doesn't grow with cluster churn
+        live = {n.name for n in snapshot.nodes}
+        for name in [k for k in self._last_sync if k not in live]:
+            del self._last_sync[name]
         return updates

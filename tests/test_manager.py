@@ -319,6 +319,19 @@ class TestNodeResourceController:
         second = ctrl.reconcile_all(snap)[0]
         assert not second.synced
 
+    def test_periodic_force_sync_after_time_threshold(self):
+        # a node whose values drift below the diff threshold still
+        # re-syncs once update_time_threshold_seconds elapses (ADVICE r1:
+        # the reference's periodic force-update)
+        snap = self._snapshot()
+        ctrl = NodeResourceController()
+        assert ctrl.reconcile_all(snap)[0].synced
+        snap.node_metrics["n0"].sys_usage[CPU] = 1010  # < 10% diff
+        assert not ctrl.reconcile_all(snap)[0].synced
+        snap.now += 301  # default update_time_threshold_seconds = 300
+        snap.node_metrics["n0"].update_time = snap.now - 60
+        assert ctrl.reconcile_all(snap)[0].synced
+
     def test_disabled_strategy_no_sync(self):
         snap = self._snapshot()
         ctrl = NodeResourceController(

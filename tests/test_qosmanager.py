@@ -147,11 +147,12 @@ class TestCPUSuppressStrategy:
         ctx = make_ctx(tmp_path, self._pods())
         self._prime(ctx, 3000, 500, 2000)
         CPUSuppress().execute(ctx, now=100.0)
-        # budget (8000*65% - 2000 - 500)/1000 = 2.7 -> 2 cpus
+        # budget (8000*65% - 2000 - 500)/1000 = 2.7 -> ceil -> 3 cpus
+        # (reference cpu_suppress.go:388 rounds the BE cpuset size up)
         got = CPU_SET.read("kubepods/besteffort", ctx.system_config)
-        assert got == "0,1"
+        assert got == "0,1,2"
         assert CPU_SET.read("kubepods/besteffort/be/c",
-                            ctx.system_config) == "0,1"
+                            ctx.system_config) == "0,1,2"
 
     def test_cfs_quota_policy(self, tmp_path):
         slo = NodeSLOSpec(
@@ -173,7 +174,7 @@ class TestCPUSuppressStrategy:
         s = CPUSuppress()
         s.execute(ctx, now=100.0)
         assert CPU_SET.read("kubepods/besteffort",
-                            ctx.system_config) == "0,1"
+                            ctx.system_config) == "0,1,2"
         ctx.node_slo.resource_used_threshold_with_be.enable = False
         s.execute(ctx, now=101.0)
         got = CPU_SET.read("kubepods/besteffort", ctx.system_config)
@@ -184,10 +185,10 @@ class TestCPUSuppressStrategy:
         # cpus, not 2, and not clamp the new set below the budget
         ctx = make_ctx(tmp_path, self._pods())
         CPU_SET.write("kubepods/besteffort", "0-7", ctx.system_config)
-        self._prime(ctx, 3000, 500, 2000)  # budget -> 2 cpus
+        self._prime(ctx, 3000, 500, 2000)  # budget 2.7 -> ceil -> 3 cpus
         CPUSuppress().execute(ctx, now=100.0)
         assert CPU_SET.read("kubepods/besteffort",
-                            ctx.system_config) == "0,1"
+                            ctx.system_config) == "0,1,2"
 
     def test_quota_small_delta_bypassed(self, tmp_path):
         slo = NodeSLOSpec(
