@@ -22,6 +22,18 @@ from koordinator_tpu.koordlet.system.cgroup import SystemConfig
 
 
 @dataclasses.dataclass
+class ContainerBatchResources:
+    """One container's koordinator extended (batch) resources, in
+    canonical units (reference: util.GetBatchMilliCPUFromResourceList /
+    GetBatchMemoryFromResourceList over container requests/limits).
+    ``None`` limit = unlimited."""
+
+    request_mcpu: int = 0
+    limit_mcpu: Optional[int] = None
+    memory_limit_bytes: Optional[int] = None
+
+
+@dataclasses.dataclass
 class PodMeta:
     """What node-local subsystems need to know about a running pod
     (reference: statesinformer.PodMeta: pod + cgroup parent dir)."""
@@ -37,6 +49,13 @@ class PodMeta:
     cpu_limit_mcpu: int = 0    # 0 = no limit
     memory_request_mib: int = 0
     memory_limit_mib: int = 0  # 0 = no limit
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: container name -> extended (batch) resources; populated for BE
+    #: pods running on reclaimed batch-cpu/batch-memory
+    batch_resources: Dict[str, "ContainerBatchResources"] = (
+        dataclasses.field(default_factory=dict)
+    )
 
 
 class PodProvider(Protocol):

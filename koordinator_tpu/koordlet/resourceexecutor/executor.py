@@ -181,12 +181,16 @@ class ResourceUpdateExecutor:
         current = None
         if merge and updater.merge_condition is not None:
             # the merge condition needs the live content, and the merged
-            # value is what the cache must compare against
+            # value is what the cache must compare against; v2 content is
+            # decoded into v1 conventions first (cpu.weight -> shares,
+            # "max" -> -1) so the comparison happens in one value space
             try:
                 current = resource.read(updater.parent_dir, self.config)
             except OSError:
                 current = ""
-            value, need = updater.merge_condition(current, value)
+            value, need = updater.merge_condition(
+                resource.decode(current, self.config), value
+            )
             if not need:
                 return False
         if cacheable and self._cached(key) == value:

@@ -1,0 +1,148 @@
+"""runtimehooks: pod-lifecycle QoS actuation (the decision->cgroup path).
+
+Reference: pkg/koordlet/runtimehooks/ — the koordlet subsystem that turns
+scheduler decisions (QoS class labels, cpuset annotations, batch
+resources) into cgroup state at pod/container lifecycle events, via
+three delivery modes: NRI server, runtime-proxy gRPC, and a reconciler.
+
+Here: an instance-based hook registry (hooks.py), typed protocol
+contexts (protocol.py), the three core hook plugins (groupidentity bvt,
+cpuset pinning, batchresource limits), a reconciler that heals cgroup
+drift from informer state, and an in-process server seam for the CRI
+interposer. ``RuntimeHooks`` wires them against a states informer +
+executor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from koordinator_tpu.koordlet.metricsadvisor.framework import (
+    ContainerBatchResources,
+    PodMeta,
+)
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks.batchresource import (
+    BatchResourcePlugin,
+)
+from koordinator_tpu.koordlet.runtimehooks.cpuset import (
+    CpusetPlugin,
+    NodeTopoInfo,
+)
+from koordinator_tpu.koordlet.runtimehooks.groupidentity import (
+    BvtPlugin,
+    BvtRule,
+    parse_rule,
+)
+from koordinator_tpu.koordlet.runtimehooks.hooks import (
+    DEFAULT_REGISTRY,
+    FailurePolicy,
+    Hook,
+    HookRegistry,
+    Stage,
+)
+from koordinator_tpu.koordlet.runtimehooks.protocol import (
+    ContainerContext,
+    HooksProtocol,
+    KUBE_QOS_DIR,
+    KubeQOS,
+    KubeQOSContext,
+    PodContext,
+    Resources,
+    kube_qos_by_cgroup_parent,
+    milli_cpu_to_quota,
+    milli_cpu_to_shares,
+)
+from koordinator_tpu.koordlet.runtimehooks.reconciler import Reconciler
+from koordinator_tpu.koordlet.runtimehooks.server import RuntimeHookServer
+from koordinator_tpu.koordlet.statesinformer.states_informer import (
+    StateKind,
+    StatesInformer,
+)
+from koordinator_tpu.manager.sloconfig import NodeSLOSpec
+
+__all__ = [
+    "BatchResourcePlugin",
+    "BvtPlugin",
+    "BvtRule",
+    "ContainerBatchResources",
+    "ContainerContext",
+    "CpusetPlugin",
+    "DEFAULT_REGISTRY",
+    "FailurePolicy",
+    "Hook",
+    "HookRegistry",
+    "HooksProtocol",
+    "KUBE_QOS_DIR",
+    "KubeQOS",
+    "KubeQOSContext",
+    "NodeTopoInfo",
+    "PodContext",
+    "Reconciler",
+    "Resources",
+    "RuntimeHookServer",
+    "RuntimeHooks",
+    "Stage",
+    "kube_qos_by_cgroup_parent",
+    "milli_cpu_to_quota",
+    "milli_cpu_to_shares",
+    "parse_rule",
+]
+
+
+class RuntimeHooks:
+    """Top-level wiring (reference: runtimehooks.go NewRuntimeHook):
+    registers the standard plugins on a fresh registry, subscribes to
+    informer NodeSLO/pod changes, exposes the server + reconciler."""
+
+    def __init__(
+        self,
+        informer: StatesInformer,
+        executor: ResourceUpdateExecutor,
+        registry: Optional[HookRegistry] = None,
+    ):
+        self.registry = registry or HookRegistry()
+        self.executor = executor
+        self.informer = informer
+
+        self.groupidentity = BvtPlugin()
+        self.cpuset = CpusetPlugin()
+        self.batchresource = BatchResourcePlugin()
+        self.groupidentity.register(self.registry)
+        self.cpuset.register(self.registry)
+        self.batchresource.register(self.registry)
+
+        self.reconciler = Reconciler(
+            self.registry, executor, bvt_plugin=self.groupidentity
+        )
+        self.server = RuntimeHookServer(self.registry, executor)
+
+        informer.register_callback(StateKind.NODE_SLO, self._on_node_slo)
+        informer.register_callback(StateKind.PODS, self._on_pods)
+        # arm the rule from whatever the informer already holds
+        self.groupidentity.update_rule(informer.get_node_slo())
+
+    # -- informer callbacks --------------------------------------------------
+
+    def _on_node_slo(self, kind: StateKind, slo: NodeSLOSpec) -> None:
+        if self.groupidentity.update_rule(slo):
+            # rule changed: re-actuate every kube-QoS dir + pod
+            # (rule.go:148 ruleUpdateCb)
+            self.groupidentity.rule_update(
+                self.informer.running_pods(), self.executor
+            )
+
+    def _on_pods(self, kind: StateKind, pods: Sequence[PodMeta]) -> None:
+        self.reconcile()
+
+    # -- public surface ------------------------------------------------------
+
+    def set_node_topo(self, topo: NodeTopoInfo) -> None:
+        """Feed share pools / kubelet policy (reference: cpuset rule from
+        the NodeResourceTopology CR). A changed rule re-actuates every
+        pod immediately (cpuset/rule.go:205 ruleUpdateCb)."""
+        if self.cpuset.update_rule(topo):
+            self.reconcile()
+
+    def reconcile(self) -> int:
+        return self.reconciler.reconcile(self.informer.running_pods())

@@ -146,6 +146,10 @@ class CgroupResource:
     v2_encode: Optional[Callable[[str, str], str]] = None
     #: normalize a value for the v1 file (e.g. "max" -> "-1")
     v1_encode: Optional[Callable[[str], str]] = None
+    #: decode raw v2 file content back into the v1-convention value space
+    #: (cpu.weight -> shares, "max" -> "-1"); merge conditions compare in
+    #: v1 conventions
+    v2_decode: Optional[Callable[[str], str]] = None
     #: on v1 this file exists independently in EVERY hierarchy and a
     #: write must hit all of them (cgroup.procs: moving a task in only
     #: the cpu hierarchy leaves it in the old cpuset/memory cgroups)
@@ -201,6 +205,18 @@ class CgroupResource:
         if self.v1_encode is not None:
             return self.v1_encode(value)
         return value
+
+    def decode(self, content: str,
+               cfg: Optional[SystemConfig] = None) -> str:
+        """v1-convention value from raw file content (inverse of encode;
+        identity on v1 and for files whose formats match)."""
+        cfg = cfg or CONFIG
+        if cfg.use_cgroup_v2 and self.v2_decode is not None:
+            try:
+                return self.v2_decode(content)
+            except (ValueError, IndexError):
+                return content
+        return content
 
     def read(self, parent_dir: str, cfg: Optional[SystemConfig] = None) -> str:
         with open(self.path(parent_dir, cfg)) as f:
@@ -263,11 +279,13 @@ CPU_SHARES = CgroupResource(
     validator=_range_validator(CPU_SHARES_MIN, CPU_SHARES_MAX),
     v2_validator=_range_validator(CPU_SHARES_MIN, CPU_SHARES_MAX),
     v2_encode=_encode_cpu_shares,
+    v2_decode=lambda c: str(convert_cpu_weight_to_shares(int(c))),
 )
 CPU_CFS_QUOTA = CgroupResource(
     "cpu.cfs_quota_us", "cpu", "cpu.cfs_quota_us", "cpu.max",
     validator=_any_int, v2_encode=_encode_cfs_quota,
     v1_encode=lambda v: "-1" if v == "max" else v,
+    v2_decode=lambda c: c.split()[0].replace("max", "-1"),
 )
 CPU_CFS_PERIOD = CgroupResource(
     "cpu.cfs_period_us", "cpu", "cpu.cfs_period_us", "cpu.max",
@@ -299,6 +317,7 @@ MEMORY_LIMIT = CgroupResource(
     validator=_any_int,
     v2_encode=lambda v, cur: "max" if v == "max" or int(v) < 0 else v,
     v1_encode=lambda v: "-1" if v == "max" else v,
+    v2_decode=lambda c: "-1" if c == "max" else c,
 )
 MEMORY_MIN = CgroupResource(
     "memory.min", "memory", "memory.min", "memory.min",
