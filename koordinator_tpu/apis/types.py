@@ -219,7 +219,12 @@ class ReservationSpec:
     allocated: Resources = dataclasses.field(default_factory=dict)
     expiration_time: Optional[float] = None
     allocate_once: bool = True
+    #: explicit pod owners (migration reservations; reference:
+    #: ReservationOwner.Object) — when set, only these pods match
     owner_pod_uids: List[str] = dataclasses.field(default_factory=list)
+    #: pods currently allocated from this reservation (reference:
+    #: Reservation.Status current owners) — bookkeeping, not matching
+    allocated_pod_uids: List[str] = dataclasses.field(default_factory=list)
 
 
 class MigrationPhase(enum.Enum):

@@ -34,6 +34,7 @@ from koordinator_tpu.koordlet.runtimehooks.protocol import (
     KUBE_QOS_DIR,
     KubeQOS,
     PodContext,
+    kube_qos_by_cgroup_parent,
 )
 from koordinator_tpu.manager.sloconfig import NodeSLOSpec
 
@@ -160,11 +161,7 @@ class BvtPlugin:
         pod_level = []
         container_level = []
         for pod in pods:
-            kube_qos = (
-                KubeQOS.BESTEFFORT if "besteffort" in pod.cgroup_dir
-                else KubeQOS.BURSTABLE if "burstable" in pod.cgroup_dir
-                else KubeQOS.GUARANTEED
-            )
+            kube_qos = kube_qos_by_cgroup_parent(pod.cgroup_dir)
             bvt = r.pod_bvt(pod.qos, kube_qos)
             pod_level.append(
                 CgroupUpdater("cpu.bvt_warp_ns", pod.cgroup_dir, str(bvt))

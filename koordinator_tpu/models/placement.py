@@ -20,12 +20,16 @@ import numpy as np
 
 from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
 from koordinator_tpu.apis.types import ClusterSnapshot, GangMode
+from koordinator_tpu.models.finegrained import FineGrained
 from koordinator_tpu.ops.binpack import (
+    Extras,
     NodeState,
+    NumaAux,
     PodBatch,
+    ResvArrays,
     ScoreParams,
     SolverConfig,
-    schedule_batch,
+    solve_batch,
 )
 from koordinator_tpu.ops.gang import GangState
 from koordinator_tpu.ops.quota import QuotaState
@@ -57,13 +61,21 @@ class ScheduleResult(Dict[str, Optional[str]]):
     coscheduling Permit stage).
     """
 
-    def __init__(self, assignments, waiting=None):
+    def __init__(self, assignments, waiting=None, fine_states=None):
         super().__init__(assignments)
         self.waiting: Dict[str, str] = dict(waiting or {})
+        #: uid -> (node name, CycleState) for fine-grained (NUMA/device)
+        #: allocations applied but not yet PreBind-annotated (waiting gang
+        #: members); the scheduler annotates them when the barrier opens.
+        self.fine_states: Dict[str, tuple] = dict(fine_states or {})
 
 
 class PlacementModel:
     """Compiled batched placement over a (possibly sharded) node axis."""
+
+    #: score-consistency refinement rounds before freezing extra scores
+    #: (feasibility is still enforced afterwards, so the loop terminates)
+    MAX_SCORE_ITERS = 8
 
     def __init__(
         self,
@@ -73,6 +85,7 @@ class PlacementModel:
         prod_usage_thresholds=None,
         scaling_factors=None,
         sharding: Optional[jax.sharding.Sharding] = None,
+        fine: Optional[FineGrained] = None,
     ):
         self.config = config
         self.resource_weights = dict(resource_weights or DEFAULT_RESOURCE_WEIGHTS)
@@ -85,11 +98,14 @@ class PlacementModel:
             prod_thresholds=jnp.asarray(_vec(prod_usage_thresholds or {})),
         )
         self.sharding = sharding
-        self._solve = jax.jit(schedule_batch, static_argnames=("config",))
+        self.fine = fine
+        self._solve = jax.jit(solve_batch, static_argnames=("config",))
 
     # -- staging ------------------------------------------------------------
 
-    def stage_nodes(self, arrays: NodeArrays) -> NodeState:
+    def stage_nodes(
+        self, arrays: NodeArrays, numa_cap=None, numa_free=None
+    ) -> NodeState:
         """Stage host node arrays onto devices (sharded if configured)."""
         put = (
             (lambda x: jax.device_put(x, self.sharding))
@@ -105,6 +121,8 @@ class PlacementModel:
             prod_base=put(arrays.prod_base),
             metric_fresh=put(arrays.metric_fresh),
             schedulable=put(arrays.schedulable),
+            numa_cap=put(numa_cap) if numa_cap is not None else None,
+            numa_free=put(numa_free) if numa_free is not None else None,
         )
 
     @staticmethod
@@ -123,7 +141,8 @@ class PlacementModel:
 
     def solve(self, state: NodeState, pods: PodBatch):
         """Jitted solve on staged arrays; returns (new_state, assignments)."""
-        return self._solve(state, pods, self.params, self.config)
+        r = self._solve(state, pods, self.params, self.config)
+        return r.node_state, r.assign
 
     def schedule(self, snapshot: ClusterSnapshot) -> "ScheduleResult":
         """Typed end-to-end: snapshot → committed placements.
@@ -131,10 +150,12 @@ class PlacementModel:
         Returns a :class:`ScheduleResult`: a ``{pod uid: node | None}``
         mapping of committed (bindable) placements, with
         ``result.waiting`` carrying NonStrict gang members that hold a
-        node at the Permit barrier but must not be bound. Gangs and
-        (single-level) quotas present in the snapshot are lowered onto the
-        device solver: quota admission gates each pod, gang groups resolve
-        all-or-nothing at batch end.
+        node at the Permit barrier but must not be bound. Gangs, quotas,
+        reservations, NUMA topology, and devices present in the snapshot /
+        managers are all lowered onto the device solver; fine-grained
+        (cpuset/device) placements are validated against the host
+        allocators and the batch re-solved on conflict (propose →
+        validate → refine, models/finegrained.py).
         """
         gang_names = sorted(snapshot.gangs)
         quota_names = sorted(snapshot.quotas)
@@ -153,18 +174,45 @@ class PlacementModel:
             scaling_factors=self.scaling_factors,
             resource_weights=self.resource_weights,
         )
-        state = self.stage_nodes(node_arrays)
+        uid_to_pod = {pod.uid: pod for pod in snapshot.pending_pods}
+        pods_in_order = [uid_to_pod[uid] for uid in pod_arrays.uids]
+        node_by_name = {node.name: node for node in snapshot.nodes}
+
+        # -- fine-grained pod classification + NUMA lowering ---------------
+        # one annotation parse per pod yields both the special set (host
+        # rows needed) and the pod-level NUMA-policy flags (in-scan
+        # consumption)
+        numa_aux = None
+        numa_cap = numa_free = None
+        has_numa_policy_arr = None
+        fine = self.fine
+        specials: List[int] = []
+        use_numa = fine is not None and fine.has_topology(node_arrays.names)
+        node_policy_present = use_numa and fine.any_node_policy(node_arrays.names)
+        if fine is not None:
+            pod_policy = np.zeros(len(pods_in_order), bool)
+            for i, pod in enumerate(pods_in_order):
+                special, has_policy = fine.pod_flags(pod, node_policy_present)
+                if special:
+                    specials.append(i)
+                pod_policy[i] = has_policy
+        if use_numa:
+            numa_cap, numa_free, node_policy = fine.numa_arrays(node_arrays.names)
+            has_numa_policy_arr = jnp.asarray(pod_policy)
+            numa_aux = NumaAux(node_policy=jnp.asarray(node_policy))
+
+        state = self.stage_nodes(node_arrays, numa_cap, numa_free)
         batch = self.stage_pods(pod_arrays)
+        if has_numa_policy_arr is not None:
+            batch = batch._replace(has_numa_policy=has_numa_policy_arr)
 
         # a gang pod whose GangSpec hasn't been observed yet must not bind
         # solo (the incremental path rejects it at PreFilter; the batched
         # path hard-blocks it)
-        uid_to_pod = {pod.uid: pod for pod in snapshot.pending_pods}
         blocked = np.array(
             [
-                uid_to_pod[uid].gang is not None
-                and uid_to_pod[uid].gang not in gang_index
-                for uid in pod_arrays.uids
+                pod.gang is not None and pod.gang not in gang_index
+                for pod in pods_in_order
             ],
             dtype=bool,
         )
@@ -198,18 +246,100 @@ class PlacementModel:
                 snapshot, quota_names, quota_index, node_arrays
             )
 
-        result = self._solve(
-            state, batch, self.params, self.config, quota_state, gang_state
+        resv_arrays, resv_specs = self._build_resv(
+            snapshot, node_arrays, pods_in_order
         )
-        if gang_state is not None:
-            _, (assignments, commit, waiting) = result
-            commit = np.asarray(commit)
-            waiting = np.asarray(waiting)
-        else:
-            _, assignments = result
-            commit = np.asarray(assignments) >= 0
-            waiting = np.zeros_like(commit)
-        assignments = np.asarray(assignments)
+
+        # -- special pods: host Extras rows --------------------------------
+        extras = None
+        mask_np = score_np = None
+        if specials:
+            p, n = len(pods_in_order), node_arrays.n
+            mask_np = np.ones((p, n), bool)
+            score_np = np.zeros((p, n), np.int32)
+            for i in specials:
+                mask_np[i], score_np[i] = fine.rows(
+                    snapshot, pods_in_order[i], snapshot.nodes
+                )
+            extras = Extras(mask=jnp.asarray(mask_np), score=jnp.asarray(score_np))
+
+        # -- propose → validate → refine loop ------------------------------
+        applied: List[tuple] = []  # (idx, node_name, CycleState)
+        iteration = 0
+        while True:
+            result = self._solve(
+                state,
+                batch,
+                self.params,
+                self.config,
+                quota_state,
+                gang_state,
+                extras,
+                resv_arrays,
+                numa_aux,
+            )
+            if not specials:
+                break
+            raw = np.asarray(result.raw_assign)
+            frozen = iteration >= self.MAX_SCORE_ITERS
+            dirty = False
+            for i in specials:
+                a = int(raw[i])
+                if a < 0:
+                    continue
+                pod = pods_in_order[i]
+                node = node_by_name[node_arrays.names[a]]
+                if not frozen:
+                    m_row, s_row = fine.rows(snapshot, pod, snapshot.nodes)
+                    if not np.array_equal(m_row, mask_np[i]) or not np.array_equal(
+                        s_row, score_np[i]
+                    ):
+                        mask_np[i] = m_row
+                        score_np[i] = s_row
+                        dirty = True
+                        break
+                ok, cstate = fine.apply(snapshot, pod, node)
+                if not ok:
+                    mask_np[i, a] = False
+                    dirty = True
+                    break
+                applied.append((i, node.name, cstate))
+            if not dirty:
+                break
+            for i, node_name, cstate in reversed(applied):
+                fine.rollback(
+                    snapshot, pods_in_order[i], node_by_name[node_name], cstate
+                )
+            applied = []
+            extras = Extras(mask=jnp.asarray(mask_np), score=jnp.asarray(score_np))
+            iteration += 1
+
+        assignments = np.asarray(result.assign)
+        commit = np.asarray(result.commit)
+        waiting = np.asarray(result.waiting)
+        rejected = np.asarray(result.rejected)
+
+        # fine-grained epilogue: release gang-rejected holds, annotate
+        # committed pods (PreBind), keep waiting pods' holds for the
+        # scheduler to annotate when the Permit barrier opens
+        fine_states: Dict[str, tuple] = {}
+        for i, node_name, cstate in applied:
+            pod = pods_in_order[i]
+            node = node_by_name[node_name]
+            if rejected[i]:
+                fine.rollback(snapshot, pod, node, cstate)
+            elif commit[i]:
+                fine.pre_bind(snapshot, pod, node, cstate)
+            else:  # waiting at the Permit barrier
+                fine_states[pod.uid] = (node_name, cstate)
+
+        # reservation consumption bookkeeping (the incremental Reserve's
+        # mutation of the matched ReservationSpec)
+        if resv_arrays is not None:
+            self._apply_reservations(
+                snapshot, resv_specs, result, pods_in_order, commit, waiting
+            )
+
         return ScheduleResult(
             assignments={
                 uid: (node_arrays.names[a] if c else None)
@@ -220,7 +350,69 @@ class PlacementModel:
                 for uid, a, w in zip(pod_arrays.uids, assignments, waiting)
                 if w
             },
+            fine_states=fine_states,
         )
+
+    def _build_resv(self, snapshot, node_arrays, pods_in_order):
+        """Lower Available reservations with free remainder to
+        :class:`ResvArrays` (+ the spec list, indexed by v)."""
+        from koordinator_tpu.scheduler.plugins.reservation import (
+            reservation_free,
+            reservation_matches_pod,
+        )
+
+        index = node_arrays.index()
+        specs, nodes, frees, once = [], [], [], []
+        for resv in snapshot.reservations:
+            if getattr(resv.state, "value", resv.state) != "Available":
+                continue
+            if resv.node_name not in index:
+                continue
+            free = reservation_free(resv)
+            if not free.any():
+                continue
+            specs.append(resv)
+            nodes.append(index[resv.node_name])
+            frees.append(free)
+            once.append(resv.allocate_once)
+        if not specs:
+            return None, []
+        match = np.zeros((len(pods_in_order), len(specs)), bool)
+        for i, pod in enumerate(pods_in_order):
+            for v, resv in enumerate(specs):
+                match[i, v] = reservation_matches_pod(resv, pod)
+        return (
+            ResvArrays(
+                node=jnp.asarray(np.asarray(nodes, np.int32)),
+                free=jnp.asarray(np.stack(frees).astype(np.int32)),
+                allocate_once=jnp.asarray(np.asarray(once, bool)),
+                match=jnp.asarray(match),
+            ),
+            specs,
+        )
+
+    def _apply_reservations(
+        self, snapshot, resv_specs, result, pods_in_order, commit, waiting
+    ):
+        from koordinator_tpu.apis.types import (
+            ReservationState,
+            resources_to_vector,
+            vector_to_resources,
+        )
+
+        vstar = np.asarray(result.resv_vstar)
+        delta = np.asarray(result.resv_delta)
+        keep = commit | waiting
+        for i, pod in enumerate(pods_in_order):
+            v = int(vstar[i])
+            if v < 0 or not keep[i]:
+                continue
+            spec = resv_specs[v]
+            cur = resources_to_vector(spec.allocated)
+            spec.allocated = vector_to_resources(cur + delta[i])
+            spec.allocated_pod_uids.append(pod.uid)
+            if spec.allocate_once:
+                spec.state = ReservationState.SUCCEEDED
 
     def _build_quota_state(self, snapshot, quota_names, quota_index, node_arrays):
         """Lower the (possibly hierarchical) quota tree to a device

@@ -17,6 +17,22 @@ host round-trips per pod. Tie-breaking is deterministic lowest-index
 (the reference picks uniformly among max-score nodes; any member of that
 set is a legal outcome, we fix the first).
 
+Fine-grained plugins integrate three ways (reference parity map):
+
+- **Reservation matched credit** (transformer.go restoreMatchedReservation):
+  carried ``resv.free [V,R]`` remainders are credited back per scan step to
+  pods matching each reservation, and consumed (best-free-first) when a
+  matching pod places on the reservation's node.
+- **NUMA score + aggregate consumption** (nodenumaresource/scoring.go): the
+  per-node least/most-allocated score over aggregated NUMA resources is
+  computed in-scan from ``NodeState.numa_cap/numa_free``; pods subject to a
+  NUMA topology policy subtract their request on placement.
+- **Host-computed extras** (``Extras.mask/score [P,N]``): per-pod×node
+  feasibility and score injections for the inherently sequential greedy
+  sub-algorithms (cpuset take, device joint-allocate, hint merge) computed
+  by the host against manager state, validated post-solve and re-solved on
+  conflict (models/placement.py).
+
 Reference: pkg/scheduler/frameworkext/framework_extender.go:167-262
 (RunPreFilter/Filter/Score) and the plugin semantics in ops/fit.py,
 ops/loadaware.py.
@@ -39,12 +55,15 @@ class SolverConfig(NamedTuple):
     fit_weight: int = 1          # NodeResourcesFit LeastAllocated plugin weight
     loadaware_weight: int = 1    # LoadAwareScheduling plugin weight
     score_according_prod: bool = False
+    numa_most_allocated: bool = False  # NUMA scorer: MostAllocated vs Least
 
 
 class NodeState(NamedTuple):
     """Device-resident node-side solver state (the scan carry).
 
-    All arrays int32 canonical units; bool masks.
+    All arrays int32 canonical units; bool masks. ``numa_cap``/``numa_free``
+    are the aggregated per-node NUMA inventories ([N,R], None when no node
+    reports topology) feeding the in-scan NUMA score.
     """
 
     alloc: jnp.ndarray         # [N,R]
@@ -55,6 +74,8 @@ class NodeState(NamedTuple):
     prod_base: jnp.ndarray     # [N,R] prod-mode score base
     metric_fresh: jnp.ndarray  # [N]
     schedulable: jnp.ndarray   # [N]
+    numa_cap: Optional[jnp.ndarray] = None   # [N,R] Σ NUMA-node allocatable
+    numa_free: Optional[jnp.ndarray] = None  # [N,R] Σ NUMA-node free
 
 
 class PodBatch(NamedTuple):
@@ -69,6 +90,9 @@ class PodBatch(NamedTuple):
     gang_id: jnp.ndarray       # [P] int32, -1 = not gang-managed
     blocked: jnp.ndarray       # [P] bool — host-side hard reject (e.g. a
     #                            gang pod whose GangSpec is not yet known)
+    # [P] bool — pod declares its own NUMA topology policy (annotation
+    # override); with NumaAux it marks the pod as consuming numa_free
+    has_numa_policy: Optional[jnp.ndarray] = None
 
     @classmethod
     def build(
@@ -81,6 +105,7 @@ class PodBatch(NamedTuple):
         non_preemptible=None,
         gang_id=None,
         blocked=None,
+        has_numa_policy=None,
     ):
         p = req.shape[0]
         return cls(
@@ -100,6 +125,7 @@ class PodBatch(NamedTuple):
                 gang_id if gang_id is not None else jnp.full(p, -1, jnp.int32)
             ),
             blocked=(blocked if blocked is not None else jnp.zeros(p, bool)),
+            has_numa_policy=has_numa_policy,
         )
 
 
@@ -109,6 +135,54 @@ class ScoreParams(NamedTuple):
     weights: jnp.ndarray          # [R] resource weights
     thresholds: jnp.ndarray       # [R] loadaware usage thresholds (%)
     prod_thresholds: jnp.ndarray  # [R] loadaware prod-usage thresholds (%)
+
+
+class Extras(NamedTuple):
+    """Host-injected per-pod×node feasibility and score (fine-grained
+    plugins: NUMA hint-merge/cpuset feasibility, DeviceShare)."""
+
+    mask: jnp.ndarray   # [P,N] bool
+    score: jnp.ndarray  # [P,N] int32 added to feasible nodes' scores
+
+
+class ResvArrays(NamedTuple):
+    """Reservation matched-credit arrays (reference: reservation
+    transformer.go restore + plugin Reserve allocation)."""
+
+    node: jnp.ndarray           # [V] int32 node index of each reservation
+    free: jnp.ndarray           # [V,R] int32 initial free remainder
+    allocate_once: jnp.ndarray  # [V] bool
+    match: jnp.ndarray          # [P,V] bool pod↔reservation owner match
+
+
+class NumaAux(NamedTuple):
+    """Enables in-scan NUMA scoring/consumption (requires
+    ``NodeState.numa_cap/numa_free`` and ``PodBatch.has_numa_policy``)."""
+
+    node_policy: jnp.ndarray  # [N] bool — node declares a topology policy
+
+
+class SolveResult(NamedTuple):
+    """Everything one batched solve produces.
+
+    ``assign`` is the post-gang committed/waiting node per pod (-1 else);
+    ``raw_assign`` is the scan's placement before gang resolution (what the
+    host validation loop replays). Reservation consumption comes back as
+    per-pod ``resv_vstar``/``resv_delta`` so the host can mutate the
+    matching ReservationSpec exactly as the incremental Reserve does.
+    """
+
+    node_state: NodeState
+    quota_state: Optional[object]        # QuotaState when quotas present
+    resv_free: Optional[jnp.ndarray]     # [V,R] final free remainders
+    assign: jnp.ndarray                  # [P] int32
+    commit: jnp.ndarray                  # [P] bool
+    waiting: jnp.ndarray                 # [P] bool
+    rejected: jnp.ndarray                # [P] bool
+    raw_assign: jnp.ndarray              # [P] int32
+    resv_vstar: Optional[jnp.ndarray]    # [P] int32 consumed reservation, -1
+    resv_delta: Optional[jnp.ndarray]    # [P,R] consumed amount
+    numa_consumed: Optional[jnp.ndarray]  # [P] bool
 
 
 def score_one_pod(
@@ -151,6 +225,31 @@ def score_one_pod(
     return mask, score
 
 
+def numa_node_score(
+    cap: jnp.ndarray,   # [N,R]
+    free: jnp.ndarray,  # [N,R]
+    req: jnp.ndarray,   # [R]
+    config: SolverConfig,
+) -> jnp.ndarray:
+    """[N] NUMA least/most-allocated score, the in-scan counterpart of
+    scheduler/plugins/nodenumaresource.py ``score`` (reference:
+    nodenumaresource/scoring.go): per requested resource,
+    ``requested = cap - free + req``; least = ``(cap-requested)*100//cap``,
+    0 when cap==0 or requested>cap; mean over requested resources."""
+    member = req > 0                      # [R]
+    requested = cap - free + req          # [N,R]
+    capq = jnp.maximum(cap, 1)
+    least = ((cap - requested) * 100) // capq
+    most = (requested * 100) // capq
+    per = jnp.where(
+        member & (cap > 0) & (requested <= cap),
+        most if config.numa_most_allocated else least,
+        0,
+    )
+    w = member.sum()
+    return jnp.where(w > 0, per.sum(axis=-1) // jnp.maximum(w, 1), 0)
+
+
 def place_one_pod(
     state: NodeState,
     req: jnp.ndarray,
@@ -164,9 +263,10 @@ def place_one_pod(
 ) -> tuple:
     """Place a single pod; returns (new_state, chosen_node or -1).
 
-    ``extra_mask`` lets upper layers (reservation matching, node affinity,
-    NUMA admit) inject per-node feasibility; ``admit`` gates the whole pod
-    (quota / gang admission) without disturbing scan shape.
+    ``extra_mask`` lets upper layers inject per-node feasibility;
+    ``admit`` gates the whole pod (quota / gang admission) without
+    disturbing scan shape. (Thin single-pod wrapper kept for tests and
+    the incremental path's cross-checks.)
     """
     mask, score = score_one_pod(state, req, est, is_prod, is_daemonset, params, config)
     if extra_mask is not None:
@@ -179,9 +279,6 @@ def place_one_pod(
     node = jnp.where(ok, best, -1).astype(jnp.int32)
     add_req = jnp.where(ok, req, 0)
     add_est = jnp.where(ok, est, 0)
-    # An assumed pod has no reported usage yet, so it is "estimated" for
-    # subsequent pods in this solve: non-prod correction always grows by
-    # its estimate; the prod score base grows only for prod pods.
     new_state = state._replace(
         used_req=state.used_req.at[best].add(add_req),
         est_extra=state.est_extra.at[best].add(add_est),
@@ -190,54 +287,53 @@ def place_one_pod(
     return new_state, node
 
 
-def schedule_batch(
+def solve_batch(
     state: NodeState,
     pods: PodBatch,
     params: ScoreParams,
     config: SolverConfig = SolverConfig(),
     quota_state=None,
     gang_state=None,
-) -> tuple:
-    """Schedule a whole pending queue.
+    extras: Optional[Extras] = None,
+    resv: Optional[ResvArrays] = None,
+    numa: Optional[NumaAux] = None,
+) -> SolveResult:
+    """Schedule a whole pending queue with every enabled subsystem fused
+    into one scan. Optional features add structure only when present, so
+    the plain fast path compiles to the same program as before.
 
-    Returns ``(final_state, assignments[P])``; with ``quota_state``,
-    ``final_state`` is ``(node_state, quota_state)``; with ``gang_state``,
-    assignments is replaced by ``(assignments, commit[P], waiting[P])``
-    after the gang-group feasibility pass.
-
-    ``assignments[i]`` is the node index for pod i (in the given order) or
-    -1 if unschedulable at its turn. Semantics match scheduling the pods
-    one-by-one through the reference's Filter→Score→Reserve cycle; with
-    ``quota_state``, each pod additionally passes the ElasticQuota
-    PreFilter gate (plugin.go:210-255; ops/quota.py); with ``gang_state``,
-    gang-group all-or-nothing admission resolves at batch end with
-    rejected Strict gangs' resources released (ops/gang.py).
+    Semantics match scheduling the pods one-by-one through the reference's
+    Filter→Score→Reserve cycle: quota admission gates each pod
+    (plugin.go:210-255), reservation credit/consumption follows the
+    restore/Reserve chain, NUMA scoring/consumption follows scoring.go,
+    and gang-group all-or-nothing admission resolves at batch end with
+    rejected Strict gangs' resources (including reservation consumption
+    and NUMA holds) released.
     """
     n_pods = pods.req.shape[0]
+    use_q = quota_state is not None
+    use_x = extras is not None
+    use_r = resv is not None
+    use_n = numa is not None
+
     if state.alloc.shape[0] == 0:  # static shape: no nodes, nothing placeable
         empty = jnp.full(n_pods, -1, dtype=jnp.int32)
-        out_state = state if quota_state is None else (state, quota_state)
-        if gang_state is not None:
-            falses = jnp.zeros(n_pods, bool)
-            return out_state, (empty, falses, falses)
-        return out_state, empty
-
-    if quota_state is None:
-
-        def step(carry: NodeState, xs):
-            req, est, is_prod, is_ds, blocked = xs
-            new_state, node = place_one_pod(
-                carry, req, est, is_prod, is_ds, params, config, admit=~blocked
-            )
-            return new_state, node
-
-        final_state, assignments = jax.lax.scan(
-            step,
-            state,
-            (pods.req, pods.est, pods.is_prod, pods.is_daemonset, pods.blocked),
+        falses = jnp.zeros(n_pods, bool)
+        return SolveResult(
+            node_state=state,
+            quota_state=quota_state,
+            resv_free=resv.free if use_r else None,
+            assign=empty,
+            commit=falses,
+            waiting=falses,
+            rejected=falses,
+            raw_assign=empty,
+            resv_vstar=jnp.full(n_pods, -1, jnp.int32) if use_r else None,
+            resv_delta=jnp.zeros_like(pods.req) if use_r else None,
+            numa_consumed=falses if use_n else None,
         )
-        final_qstate = None
-    else:
+
+    if use_q:
         from koordinator_tpu.ops.quota import (
             quota_admit,
             quota_assume,
@@ -248,51 +344,191 @@ def schedule_batch(
         # so the water-filled runtime is computed once for the whole batch.
         runtime = quota_runtime(quota_state)
 
-        def step_q(carry, xs):
-            node_state, qstate = carry
-            req, est, is_prod, is_ds, quota_id, non_preempt, blocked = xs
-            admit = ~blocked & quota_admit(qstate, runtime, quota_id, req, non_preempt)
-            new_state, node = place_one_pod(
-                node_state, req, est, is_prod, is_ds, params, config, admit=admit
-            )
-            new_qstate = quota_assume(qstate, quota_id, req, non_preempt, node >= 0)
-            return (new_state, new_qstate), node
+    xs = [pods.req, pods.est, pods.is_prod, pods.is_daemonset, pods.blocked]
+    if use_q:
+        xs += [pods.quota_id, pods.non_preemptible]
+    if use_x:
+        xs += [extras.mask, extras.score]
+    if use_r:
+        xs += [resv.match]
+    if use_n:
+        assert pods.has_numa_policy is not None
+        assert state.numa_cap is not None and state.numa_free is not None
+        xs += [pods.has_numa_policy]
 
-        (final_state, final_qstate), assignments = jax.lax.scan(
-            step_q,
-            (state, quota_state),
-            (
-                pods.req,
-                pods.est,
-                pods.is_prod,
-                pods.is_daemonset,
-                pods.quota_id,
-                pods.non_preemptible,
-                pods.blocked,
-            ),
+    init = [state]
+    if use_q:
+        init.append(quota_state)
+    if use_r:
+        init.append(resv.free)
+
+    def step(carry, x):
+        ci = iter(carry)
+        ns = next(ci)
+        qs = next(ci) if use_q else None
+        rfree = next(ci) if use_r else None
+        xi = iter(x)
+        req = next(xi)
+        est = next(xi)
+        is_prod = next(xi)
+        is_ds = next(xi)
+        blocked = next(xi)
+        if use_q:
+            quota_id = next(xi)
+            non_pre = next(xi)
+        if use_x:
+            emask = next(xi)
+            escore = next(xi)
+        if use_r:
+            match = next(xi)
+        if use_n:
+            pod_numa = next(xi)
+
+        eff = ns
+        if use_r:
+            # matched reservations' free remainder credited back on their
+            # nodes for this pod's Filter/Score (fit path only — the
+            # incremental restore adjusts requested, not usage)
+            credit = jnp.zeros_like(ns.used_req).at[resv.node].add(
+                jnp.where(match[:, None], rfree, 0)
+            )
+            eff = ns._replace(used_req=ns.used_req - credit)
+        mask, score = score_one_pod(eff, req, est, is_prod, is_ds, params, config)
+        if use_n:
+            score = score + numa_node_score(ns.numa_cap, ns.numa_free, req, config)
+        if use_x:
+            mask = mask & emask
+            score = score + escore
+        admit = ~blocked
+        if use_q:
+            admit = admit & quota_admit(qs, runtime, quota_id, req, non_pre)
+        mask = mask & admit
+
+        masked = jnp.where(mask, score, -1)
+        best = jnp.argmax(masked)   # first max index == deterministic tie-break
+        ok = masked[best] >= 0
+        node = jnp.where(ok, best, -1).astype(jnp.int32)
+        add_req = jnp.where(ok, req, 0)
+        add_est = jnp.where(ok, est, 0)
+        net_req = add_req
+        outs = [node]
+
+        if use_r:
+            # consume the matched reservation with the most free capacity
+            # on the chosen node (reservation.py Reserve); allocate_once
+            # reservations become SUCCEEDED: remaining hold released, no
+            # further matches (zero free ⇒ zero credit/consumption).
+            on_node = match & (resv.node == best) & ok
+            fsum = jnp.where(on_node, rfree.sum(axis=-1), -1)
+            v_raw = jnp.argmax(fsum)
+            has = fsum[v_raw] > 0
+            delta = jnp.where(has, jnp.minimum(rfree[v_raw], req), 0)
+            once = has & resv.allocate_once[v_raw]
+            rem = jnp.where(once, rfree[v_raw] - delta, 0)
+            rfree = rfree.at[v_raw].set(
+                jnp.where(has, jnp.where(once, 0, rfree[v_raw] - delta), rfree[v_raw])
+            )
+            vstar = jnp.where(has, v_raw, -1).astype(jnp.int32)
+            # the pod's request lands on the node minus what the
+            # reservation hold already accounted (delta) and minus the
+            # released remainder of an allocate_once reservation (rem)
+            net_req = net_req - delta - rem
+            outs += [vstar, delta, rem]
+
+        new_ns = ns._replace(
+            used_req=ns.used_req.at[best].add(net_req),
+            est_extra=ns.est_extra.at[best].add(add_est),
+            prod_base=ns.prod_base.at[best].add(jnp.where(is_prod, add_est, 0)),
         )
+        if use_n:
+            consume = ok & (pod_numa | numa.node_policy[best])
+            new_ns = new_ns._replace(
+                numa_free=new_ns.numa_free.at[best].add(
+                    -jnp.where(consume, req, 0)
+                )
+            )
+            outs.append(consume)
+        if use_q:
+            qs = quota_assume(qs, quota_id, req, non_pre, node >= 0)
+
+        out_carry = [new_ns]
+        if use_q:
+            out_carry.append(qs)
+        if use_r:
+            out_carry.append(rfree)
+        return tuple(out_carry), tuple(outs)
+
+    final_carry, ys = jax.lax.scan(step, tuple(init), tuple(xs))
+    fi = iter(final_carry)
+    final_state = next(fi)
+    final_qstate = next(fi) if use_q else None
+    final_rfree = next(fi) if use_r else None
+    yi = iter(ys)
+    assignments = next(yi)
+    if use_r:
+        resv_vstar = next(yi)
+        resv_delta = next(yi)
+        resv_rem = next(yi)
+    else:
+        resv_vstar = resv_delta = resv_rem = None
+    numa_consumed = next(yi) if use_n else None
 
     if gang_state is None:
-        if final_qstate is None:
-            return final_state, assignments
-        return (final_state, final_qstate), assignments
+        placed = assignments >= 0
+        return SolveResult(
+            node_state=final_state,
+            quota_state=final_qstate,
+            resv_free=final_rfree,
+            assign=assignments,
+            commit=placed,
+            waiting=jnp.zeros(n_pods, bool),
+            rejected=jnp.zeros(n_pods, bool),
+            raw_assign=assignments,
+            resv_vstar=resv_vstar,
+            resv_delta=resv_delta,
+            numa_consumed=numa_consumed,
+        )
 
     from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
 
     commit, waiting, rejected = gang_outcomes(assignments, pods.gang_id, gang_state)
+    # a rejected pod held only its net request (reservation delta+rem were
+    # absorbed by the hold shrink) — release exactly that
+    rel_req = pods.req
+    if use_r:
+        rel_req = pods.req - resv_delta - resv_rem
     used_req, est_extra, prod_base = release_rejected(
         final_state.used_req,
         final_state.est_extra,
         final_state.prod_base,
         assignments,
         rejected,
-        pods.req,
+        rel_req,
         pods.est,
         pods.is_prod,
     )
     final_state = final_state._replace(
         used_req=used_req, est_extra=est_extra, prod_base=prod_base
     )
+    if use_r:
+        # restore rejected pods' reservation consumption (+ the released
+        # allocate_once remainder): the incremental Unreserve equivalent
+        v = resv.free.shape[0]
+        take = rejected & (resv_vstar >= 0)
+        vidx = jnp.where(take, resv_vstar, v)
+        back = jnp.where(take[:, None], resv_delta + resv_rem, 0)
+        final_rfree = final_rfree + jax.ops.segment_sum(
+            back, vidx, num_segments=v + 1
+        )[:v]
+    if use_n:
+        n = final_state.used_req.shape[0]
+        take = rejected & numa_consumed
+        nidx = jnp.where(take, assignments, n)
+        back = jnp.where(take[:, None], pods.req, 0)
+        final_state = final_state._replace(
+            numa_free=final_state.numa_free
+            + jax.ops.segment_sum(back, nidx, num_segments=n + 1)[:n]
+        )
     out_assign = jnp.where(commit | waiting, assignments, -1).astype(jnp.int32)
 
     if final_qstate is not None:
@@ -306,5 +542,39 @@ def schedule_batch(
         final_qstate = final_qstate._replace(
             used=final_qstate.used - sub, np_used=final_qstate.np_used - np_sub
         )
-        return (final_state, final_qstate), (out_assign, commit, waiting)
-    return final_state, (out_assign, commit, waiting)
+
+    return SolveResult(
+        node_state=final_state,
+        quota_state=final_qstate,
+        resv_free=final_rfree,
+        assign=out_assign,
+        commit=commit,
+        waiting=waiting,
+        rejected=rejected,
+        raw_assign=assignments,
+        resv_vstar=resv_vstar,
+        resv_delta=resv_delta,
+        numa_consumed=numa_consumed,
+    )
+
+
+def schedule_batch(
+    state: NodeState,
+    pods: PodBatch,
+    params: ScoreParams,
+    config: SolverConfig = SolverConfig(),
+    quota_state=None,
+    gang_state=None,
+) -> tuple:
+    """Legacy-shaped wrapper over :func:`solve_batch`.
+
+    Returns ``(final_state, assignments[P])``; with ``quota_state``,
+    ``final_state`` is ``(node_state, quota_state)``; with ``gang_state``,
+    assignments is replaced by ``(assignments, commit[P], waiting[P])``
+    after the gang-group feasibility pass.
+    """
+    r = solve_batch(state, pods, params, config, quota_state, gang_state)
+    out_state = r.node_state if quota_state is None else (r.node_state, r.quota_state)
+    if gang_state is None:
+        return out_state, r.assign
+    return out_state, (r.assign, r.commit, r.waiting)

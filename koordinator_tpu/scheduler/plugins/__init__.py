@@ -10,4 +10,8 @@ from koordinator_tpu.scheduler.plugins.loadaware import LoadAwareScheduling  # n
 from koordinator_tpu.scheduler.plugins.elasticquota import ElasticQuotaPlugin  # noqa: F401
 from koordinator_tpu.scheduler.plugins.coscheduling import CoschedulingPlugin  # noqa: F401
 from koordinator_tpu.scheduler.plugins.reservation import ReservationPlugin  # noqa: F401
+from koordinator_tpu.scheduler.plugins.nodenumaresource import (  # noqa: F401
+    NodeNUMAResourcePlugin,
+)
+from koordinator_tpu.scheduler.plugins.deviceshare import DeviceSharePlugin  # noqa: F401
 from koordinator_tpu.scheduler.plugins.defaultprebind import DefaultPreBind  # noqa: F401

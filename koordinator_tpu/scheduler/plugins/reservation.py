@@ -19,13 +19,13 @@ remainder hold into ``used_req``), so:
 Owner matching is by label subset (``owner_labels ⊆ pod.labels``), the
 typed analogue of the reference's owner selectors.
 
-Coverage note: the remainder *hold* is encoded in the lowering and thus
-seen by both the incremental and the batched solver; the per-pod matched
-*credit* currently applies on the incremental path only — the device
-scan's per-pod credit (match matrix + reservation carry) is a planned
-extension of ops/binpack.py. Until then, batched solves treat reserved
-capacity as occupied for everyone (safe: never over-commits, may
-under-place owner pods that need reserved capacity).
+Both paths implement the full chain: the remainder *hold* is encoded in
+the lowering (state/cluster.py); the per-pod matched *credit* and
+consumption run here for the incremental path and in the device scan for
+the batched path (ops/binpack.py ``ResvArrays``: match matrix +
+reservation-free carry, best-free consumption, allocate_once hold
+release), with host bookkeeping in models/placement.py
+``_apply_reservations``.
 """
 
 from __future__ import annotations
@@ -102,7 +102,7 @@ class ReservationPlugin(Plugin):
         old_allocated = resources_to_vector(best.allocated)
         new_allocated = np.minimum(old_allocated + req, alloc_vec)
         best.allocated = vector_to_resources(new_allocated)
-        best.owner_pod_uids.append(pod.uid)
+        best.allocated_pod_uids.append(pod.uid)
         if best.allocate_once:
             best.state = ReservationState.SUCCEEDED
         state["reservation_allocated"] = best.name
@@ -125,8 +125,8 @@ class ReservationPlugin(Plugin):
                 )
                 cur = resources_to_vector(resv.allocated)
                 resv.allocated = vector_to_resources(np.maximum(cur - sub, 0))
-                if pod.uid in resv.owner_pod_uids:
-                    resv.owner_pod_uids.remove(pod.uid)
+                if pod.uid in resv.allocated_pod_uids:
+                    resv.allocated_pod_uids.remove(pod.uid)
                 if resv.state == ReservationState.SUCCEEDED and resv.allocate_once:
                     resv.state = ReservationState.AVAILABLE
                 break

@@ -21,8 +21,11 @@ from koordinator_tpu.apis.types import (
     QuotaSpec,
     ReservationSpec,
 )
+from koordinator_tpu.device.cache import NodeDeviceCache
 from koordinator_tpu.gang.manager import GangManager
+from koordinator_tpu.models.finegrained import FineGrained
 from koordinator_tpu.models.placement import PlacementModel, ScheduleResult
+from koordinator_tpu.numa.manager import ResourceManager, TopologyOptions
 from koordinator_tpu.quota.core import GroupQuotaManager
 from koordinator_tpu.scheduler.cache import SchedulerCache
 from koordinator_tpu.scheduler.framework import (
@@ -37,8 +40,10 @@ from koordinator_tpu.scheduler.monitor import (
 from koordinator_tpu.scheduler.plugins import (
     CoschedulingPlugin,
     DefaultPreBind,
+    DeviceSharePlugin,
     ElasticQuotaPlugin,
     LoadAwareScheduling,
+    NodeNUMAResourcePlugin,
     NodeResourcesFit,
     ReservationPlugin,
 )
@@ -61,7 +66,8 @@ class Scheduler:
         self.cache = SchedulerCache()
         self.quota_manager = GroupQuotaManager(cluster_total=cluster_total or {})
         self.gang_manager = GangManager()
-        self.model = model or PlacementModel()
+        self.numa_manager = ResourceManager()
+        self.device_cache = NodeDeviceCache()
         self.monitor = SchedulerMonitor()
         self.debug = DebugRecorder()
         self.services = DebugServices()
@@ -69,16 +75,32 @@ class Scheduler:
         #: resources (assumed) but are not bound until their gang group
         #: completes.
         self._waiting: Dict[str, str] = {}
+        #: waiting pods' fine-grained allocation state, annotated at the
+        #: barrier (uid -> (node name, CycleState))
+        self._fine_waiting: Dict[str, tuple] = {}
 
         self._quota_plugin = ElasticQuotaPlugin(self.quota_manager)
         self._coscheduling = CoschedulingPlugin(
             self.gang_manager, on_release=self._on_gang_release
         )
+        self._numa_plugin = NodeNUMAResourcePlugin(self.numa_manager)
+        self._device_plugin = DeviceSharePlugin(self.device_cache)
+        fine = FineGrained(
+            numa_plugin=self._numa_plugin, device_plugin=self._device_plugin
+        )
+        if model is None:
+            model = PlacementModel()
+        # the model binds to THIS scheduler's managers — a model reused
+        # across schedulers would otherwise apply holds to the old one's
+        model.fine = fine
+        self.model = model
         self.framework = SchedulingFramework(
             plugins=[
                 ReservationPlugin(),
                 self._coscheduling,
                 self._quota_plugin,
+                self._numa_plugin,
+                self._device_plugin,
                 NodeResourcesFit(),
                 LoadAwareScheduling(),
                 DefaultPreBind(),
@@ -129,6 +151,15 @@ class Scheduler:
     def update_reservation(self, spec: ReservationSpec) -> None:
         self.cache.update_reservation(spec)
 
+    def update_node_topology(self, node_name: str, options: TopologyOptions) -> None:
+        """NodeResourceTopology CRD intake (reference:
+        nodenumaresource/topology_options.go sync)."""
+        self.numa_manager.update_topology(node_name, options)
+
+    def update_node_devices(self, node_name: str, entries) -> None:
+        """Device CRD intake (reference: deviceshare/device_cache.go)."""
+        self.device_cache.update_node(node_name, entries)
+
     def add_pod(self, pod: PodSpec) -> None:
         self.cache.add_pod(pod)
         if pod.gang:
@@ -138,19 +169,20 @@ class Scheduler:
     def remove_pod(self, pod: PodSpec) -> None:
         cached = self.cache.pods.get(pod.uid)
         was_assigned = cached is not None and cached.node_name is not None
+        if was_assigned:
+            # release any fine-grained holds (cpuset/NUMA + devices)
+            self.numa_manager.release(cached.node_name, pod.uid)
+            node_device = self.device_cache.get(cached.node_name)
+            if node_device is not None:
+                node_device.release(pod.uid)
         self.cache.remove_pod(pod.uid)
         self.gang_manager.on_pod_delete(pod.uid)
         self._quota_plugin.on_pod_delete(pod)
-        if was_assigned and cached.quota:
-            # an assigned pod's quota 'used' was accounted at bind time and
-            # must be released with it
-            from koordinator_tpu.apis.types import resources_to_vector
-
-            self.quota_manager.add_used(
-                cached.quota,
-                -resources_to_vector(cached.requests),
-                non_preemptible=not cached.preemptible,
-            )
+        self._fine_waiting.pop(pod.uid, None)
+        if was_assigned:
+            # an assigned pod's quota 'used' was accounted at assume time
+            # (bind or Permit hold) and must be released with it
+            self._account_quota(cached, release=True)
         self._waiting.pop(pod.uid, None)
 
     # -- scheduling ---------------------------------------------------------
@@ -166,24 +198,31 @@ class Scheduler:
             if node is not None:
                 self.cache.assume_pod(uid, node, now=at)
                 self.gang_manager.on_pod_bound(uid)
-                pod = pending.get(uid)
-                if pod is not None and pod.quota:
-                    # keep the host quota manager's used in sync with the
-                    # device solve (the solve derives used from the
-                    # snapshot; observers read the manager)
-                    from koordinator_tpu.apis.types import resources_to_vector
-
-                    self.quota_manager.add_used(
-                        pod.quota,
-                        resources_to_vector(pod.requests),
-                        non_preemptible=not pod.preemptible,
-                    )
+                # keep the host quota manager's used in sync with the
+                # device solve (the solve derives used from the snapshot;
+                # observers read the manager)
+                self._account_quota(pending.get(uid))
         for uid, node in result.waiting.items():
-            # waiting gang members hold their node but are not bound
+            # waiting gang members hold their node (and their quota, as
+            # the incremental Reserve does) but are not bound
             self.cache.assume_pod(uid, node, now=at)
+            self._account_quota(pending.get(uid))
             self._waiting[uid] = node
+        self._fine_waiting.update(result.fine_states)
         self._resolve_waiting(result)
         return result
+
+    def _account_quota(self, pod: Optional[PodSpec], release: bool = False) -> None:
+        if pod is None or not pod.quota:
+            return
+        from koordinator_tpu.apis.types import resources_to_vector
+
+        vec = resources_to_vector(pod.requests)
+        self.quota_manager.add_used(
+            pod.quota,
+            -vec if release else vec,
+            non_preemptible=not pod.preemptible,
+        )
 
     def _resolve_waiting(self, result: ScheduleResult) -> None:
         """Open the Permit barrier for previously-waiting pods whose gang
@@ -217,6 +256,21 @@ class Scheduler:
                 result[uid] = node
                 self.cache.finish_binding(uid)
                 self.gang_manager.on_pod_bound(uid)
+                self._fine_pre_bind(uid)
+
+    def _fine_pre_bind(self, uid: str) -> None:
+        """Annotate a newly-committed pod's fine-grained allocation (its
+        deferred PreBind) once the Permit barrier opens."""
+        held = self._fine_waiting.pop(uid, None)
+        if held is None or self.model.fine is None:
+            return
+        node_name, cstate = held
+        pod = self.cache.pods.get(uid)
+        node = self.cache.nodes.get(node_name)
+        if pod is not None and node is not None:
+            # pre_bind only annotates from the CycleState — no snapshot
+            # needed (avoids an O(cluster) copy per released gang member)
+            self.model.fine.pre_bind(None, pod, node, cstate)
 
     def _on_gang_release(self, uids: List[str]) -> None:
         """Incremental path: the Permit barrier opened — waiting siblings
@@ -224,6 +278,7 @@ class Scheduler:
         for uid in uids:
             self.cache.finish_binding(uid)
             self._waiting.pop(uid, None)
+            self._fine_pre_bind(uid)
 
     def schedule_one(self, pod_uid: str, now: Optional[float] = None) -> ScheduleOutcome:
         snapshot = self.cache.snapshot(now=now)
