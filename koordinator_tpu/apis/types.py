@@ -217,7 +217,11 @@ class ReservationSpec:
     state: ReservationState = ReservationState.PENDING
     allocatable: Resources = dataclasses.field(default_factory=dict)
     allocated: Resources = dataclasses.field(default_factory=dict)
+    #: absolute expiry (spec.expires); checked before ttl
     expiration_time: Optional[float] = None
+    #: relative expiry from create_time (spec.TTL); 0 disables expiration
+    ttl: Optional[float] = None
+    create_time: float = 0.0
     allocate_once: bool = True
     #: explicit pod owners (migration reservations; reference:
     #: ReservationOwner.Object) — when set, only these pods match

@@ -243,6 +243,14 @@ class GangManager:
             group.cycle_valid = False
         return rejected
 
+    def on_pod_waiting(self, pod_uid: str) -> None:
+        """A batched-path pod entered the Permit barrier (the incremental
+        path records this inside :meth:`permit`)."""
+        gang_name = self.pod_gang.get(pod_uid)
+        record = self.gangs.get(gang_name) if gang_name else None
+        if record is not None:
+            record.waiting.add(pod_uid)
+
     def on_pod_bound(self, pod_uid: str) -> None:
         gang_name = self.pod_gang.get(pod_uid)
         record = self.gangs.get(gang_name) if gang_name else None

@@ -61,13 +61,17 @@ class ScheduleResult(Dict[str, Optional[str]]):
     coscheduling Permit stage).
     """
 
-    def __init__(self, assignments, waiting=None, fine_states=None):
+    def __init__(self, assignments, waiting=None, fine_states=None,
+                 resv_allocs=None):
         super().__init__(assignments)
         self.waiting: Dict[str, str] = dict(waiting or {})
         #: uid -> (node name, CycleState) for fine-grained (NUMA/device)
         #: allocations applied but not yet PreBind-annotated (waiting gang
         #: members); the scheduler annotates them when the barrier opens.
         self.fine_states: Dict[str, tuple] = dict(fine_states or {})
+        #: uid -> (reservation name, delta vector) for *waiting* pods'
+        #: reservation consumption — rolled back if the wait expires.
+        self.resv_allocs: Dict[str, tuple] = dict(resv_allocs or {})
 
 
 class PlacementModel:
@@ -335,8 +339,9 @@ class PlacementModel:
 
         # reservation consumption bookkeeping (the incremental Reserve's
         # mutation of the matched ReservationSpec)
+        resv_allocs: Dict[str, tuple] = {}
         if resv_arrays is not None:
-            self._apply_reservations(
+            resv_allocs = self._apply_reservations(
                 snapshot, resv_specs, result, pods_in_order, commit, waiting
             )
 
@@ -351,6 +356,7 @@ class PlacementModel:
                 if w
             },
             fine_states=fine_states,
+            resv_allocs=resv_allocs,
         )
 
     def _build_resv(self, snapshot, node_arrays, pods_in_order):
@@ -403,6 +409,7 @@ class PlacementModel:
         vstar = np.asarray(result.resv_vstar)
         delta = np.asarray(result.resv_delta)
         keep = commit | waiting
+        out: Dict[str, tuple] = {}
         for i, pod in enumerate(pods_in_order):
             v = int(vstar[i])
             if v < 0 or not keep[i]:
@@ -413,6 +420,9 @@ class PlacementModel:
             spec.allocated_pod_uids.append(pod.uid)
             if spec.allocate_once:
                 spec.state = ReservationState.SUCCEEDED
+            if waiting[i]:
+                out[pod.uid] = (spec.name, delta[i].copy())
+        return out
 
     def _build_quota_state(self, snapshot, quota_names, quota_index, node_arrays):
         """Lower the (possibly hierarchical) quota tree to a device

@@ -75,6 +75,10 @@ class SchedulerCache:
 
     def update_reservation(self, spec: ReservationSpec) -> None:
         with self._lock:
+            # stamp creation for TTL expiry (the CRD's creationTimestamp);
+            # an unset create_time with a live TTL would expire immediately
+            if spec.ttl and not spec.create_time:
+                spec.create_time = time.time()
             self.reservations[spec.name] = spec
 
     # -- assume / forget (reference: scheduler cache AssumePod) -------------

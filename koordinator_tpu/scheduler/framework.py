@@ -105,6 +105,9 @@ class ScheduleOutcome:
     status: str                  # bound | waiting | unschedulable | error
     reason: str = ""
     scores: Optional[Dict[str, int]] = None  # populated when debug enabled
+    #: the cycle's state, returned for *waiting* outcomes so the caller
+    #: can roll back Reserve-time holds if the Permit wait later expires
+    cycle_state: Optional["CycleState"] = None
 
 
 class SchedulingFramework:
@@ -185,7 +188,9 @@ class SchedulingFramework:
         for plugin in self.plugins:
             verdict, _wait = plugin.permit(state, snapshot, pod, best_node)
             if verdict == "wait":
-                return ScheduleOutcome(pod.uid, best_node.name, "waiting")
+                return ScheduleOutcome(
+                    pod.uid, best_node.name, "waiting", cycle_state=state
+                )
             if verdict == "reject":
                 for done in self.plugins:
                     done.unreserve(state, snapshot, pod, best_node)

@@ -32,6 +32,9 @@ from koordinator_tpu.scheduler.framework import (
     ScheduleOutcome,
     SchedulingFramework,
 )
+from koordinator_tpu.scheduler.reservation_controller import (
+    ReservationController,
+)
 from koordinator_tpu.scheduler.monitor import (
     DebugRecorder,
     DebugServices,
@@ -75,9 +78,15 @@ class Scheduler:
         #: resources (assumed) but are not bound until their gang group
         #: completes.
         self._waiting: Dict[str, str] = {}
+        #: when each waiting pod entered the Permit barrier (WaitTime expiry)
+        self._waiting_since: Dict[str, float] = {}
         #: waiting pods' fine-grained allocation state, annotated at the
         #: barrier (uid -> (node name, CycleState))
         self._fine_waiting: Dict[str, tuple] = {}
+        #: waiting pods' reservation consumption (uid -> (resv name,
+        #: delta vector)) — rolled back if the wait expires
+        self._resv_waiting: Dict[str, tuple] = {}
+        self.reservation_controller = ReservationController(self.cache)
 
         self._quota_plugin = ElasticQuotaPlugin(self.quota_manager)
         self._coscheduling = CoschedulingPlugin(
@@ -179,21 +188,28 @@ class Scheduler:
         self.gang_manager.on_pod_delete(pod.uid)
         self._quota_plugin.on_pod_delete(pod)
         self._fine_waiting.pop(pod.uid, None)
+        # a deleted waiting pod never ran: undo its reservation consumption
+        self._rollback_reservation(pod.uid)
         if was_assigned:
             # an assigned pod's quota 'used' was accounted at assume time
             # (bind or Permit hold) and must be released with it
             self._account_quota(cached, release=True)
         self._waiting.pop(pod.uid, None)
+        self._waiting_since.pop(pod.uid, None)
 
     # -- scheduling ---------------------------------------------------------
 
     def schedule_pending(self, now: Optional[float] = None) -> ScheduleResult:
-        """One batched round: solve the whole pending queue on device and
-        assume committed placements (and waiting holds) into the cache."""
+        """One batched round: expire stale state (gang WaitTime,
+        reservations), solve the whole pending queue on device, and assume
+        committed placements (and waiting holds) into the cache."""
+        at0 = now if now is not None else time.time()
+        self.expire_waiting(at0)
+        self.reservation_controller.sync(at0)
         snapshot = self.cache.snapshot(now=now)
         pending = {pod.uid: pod for pod in snapshot.pending_pods}
         result = self.model.schedule(snapshot)
-        at = now if now is not None else time.time()
+        at = at0
         for uid, node in result.items():
             if node is not None:
                 self.cache.assume_pod(uid, node, now=at)
@@ -208,9 +224,80 @@ class Scheduler:
             self.cache.assume_pod(uid, node, now=at)
             self._account_quota(pending.get(uid))
             self._waiting[uid] = node
+            self._waiting_since.setdefault(uid, at)
+            self.gang_manager.on_pod_waiting(uid)
+            if uid in result.resv_allocs:
+                self._resv_waiting[uid] = result.resv_allocs[uid]
         self._fine_waiting.update(result.fine_states)
         self._resolve_waiting(result)
         return result
+
+    def expire_waiting(self, now: float) -> List[str]:
+        """Reject waiting pods whose gang WaitTime has elapsed (reference:
+        Permit wait timeout → unreserve → Strict group rejection,
+        core/gang.go:43-95 WaitTime, core/core.go:390-408). Returns the
+        released pod uids; their held node/quota/fine-grained resources go
+        back and the pods return to the pending queue."""
+        released: List[str] = []
+        for uid, since in list(self._waiting_since.items()):
+            if uid not in self._waiting:
+                self._waiting_since.pop(uid, None)
+                continue
+            pod = self.cache.pods.get(uid)
+            if pod is None:
+                self._waiting_since.pop(uid, None)
+                self._waiting.pop(uid, None)
+                continue
+            spec = self.cache.gangs.get(pod.gang) if pod.gang else None
+            wait_time = spec.wait_time if spec is not None else 600.0
+            if not wait_time or (now - since) < wait_time:
+                continue
+            # the timed-out pod plus (Strict mode) its whole gang group
+            siblings = self.gang_manager.unreserve(uid)
+            for r in {uid, *siblings}:
+                if r in self._waiting:
+                    self._release_waiting(r)
+                    released.append(r)
+        return released
+
+    def _release_waiting(self, uid: str) -> None:
+        """Release one waiting pod's holds (node, quota, fine-grained,
+        reservation) and return it to pending."""
+        self._waiting.pop(uid, None)
+        self._waiting_since.pop(uid, None)
+        pod = self.cache.pods.get(uid)
+        self._account_quota(pod, release=True)
+        held = self._fine_waiting.pop(uid, None)
+        if held is not None and self.model.fine is not None:
+            node = self.cache.nodes.get(held[0])
+            if pod is not None and node is not None:
+                self.model.fine.rollback(None, pod, node, held[1])
+        self._rollback_reservation(uid)
+        self.cache.forget_pod(uid)
+
+    def _rollback_reservation(self, uid: str) -> None:
+        """Undo a waiting pod's reservation consumption (the incremental
+        Unreserve's reservation restore, plugins/reservation.py:114-132)."""
+        info = self._resv_waiting.pop(uid, None)
+        if info is None:
+            return
+        from koordinator_tpu.apis.types import (
+            ReservationState,
+            resources_to_vector,
+            vector_to_resources,
+        )
+        import numpy as np
+
+        name, delta = info
+        resv = self.cache.reservations.get(name)
+        if resv is None:
+            return
+        cur = resources_to_vector(resv.allocated)
+        resv.allocated = vector_to_resources(np.maximum(cur - delta, 0))
+        if uid in resv.allocated_pod_uids:
+            resv.allocated_pod_uids.remove(uid)
+        if resv.allocate_once and resv.state == ReservationState.SUCCEEDED:
+            resv.state = ReservationState.AVAILABLE
 
     def _account_quota(self, pod: Optional[PodSpec], release: bool = False) -> None:
         if pod is None or not pod.quota:
@@ -252,6 +339,8 @@ class Scheduler:
             )
             if satisfied:
                 self._waiting.pop(uid)
+                self._waiting_since.pop(uid, None)
+                self._resv_waiting.pop(uid, None)  # consumption is final
                 result.waiting.pop(uid, None)
                 result[uid] = node
                 self.cache.finish_binding(uid)
@@ -278,6 +367,8 @@ class Scheduler:
         for uid in uids:
             self.cache.finish_binding(uid)
             self._waiting.pop(uid, None)
+            self._waiting_since.pop(uid, None)
+            self._resv_waiting.pop(uid, None)  # consumption is final
             self._fine_pre_bind(uid)
 
     def schedule_one(self, pod_uid: str, now: Optional[float] = None) -> ScheduleOutcome:
@@ -290,4 +381,18 @@ class Scheduler:
             self.cache.assume_pod(pod_uid, outcome.node, now=now)
             if outcome.status == "bound":
                 self.gang_manager.on_pod_bound(pod_uid)
+            else:
+                at = now if now is not None else time.time()
+                self._waiting[pod_uid] = outcome.node
+                self._waiting_since.setdefault(pod_uid, at)
+                state = outcome.cycle_state
+                if state is not None:
+                    # keep the cycle state for fine-grained rollback /
+                    # deferred PreBind, and the reservation delta for
+                    # rollback on WaitTime expiry
+                    self._fine_waiting[pod_uid] = (outcome.node, state)
+                    resv_name = state.get("reservation_allocated")
+                    delta = state.get("reservation_allocated_delta")
+                    if resv_name and delta is not None:
+                        self._resv_waiting[pod_uid] = (resv_name, delta)
         return outcome

@@ -1,0 +1,263 @@
+"""Gang WaitTime expiry + Reservation expiration/GC (VERDICT item 7).
+
+Reference: coscheduling WaitTime timeout → reject + release
+(pkg/scheduler/plugins/coscheduling/core/gang.go:43-95, core/core.go:
+390-408); reservation controller expiration/GC
+(pkg/scheduler/plugins/reservation/controller/controller.go:186-266,
+garbage_collection.go:35-82).
+"""
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import (
+    GangMode,
+    GangSpec,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+    ReservationSpec,
+    ReservationState,
+)
+from koordinator_tpu.scheduler import Scheduler
+from koordinator_tpu.scheduler.reservation_controller import (
+    ReservationController,
+)
+
+
+def _one_node_scheduler(cpu=16000):
+    s = Scheduler()
+    s.add_node(NodeSpec(name="n0", allocatable={R.CPU: cpu, R.MEMORY: 32768}))
+    s.update_node_metric(
+        NodeMetric(node_name="n0", node_usage={}, update_time=99.0)
+    )
+    return s
+
+
+class TestGangWaitTimeExpiry:
+    def test_batched_waiting_pod_expires_and_releases(self):
+        s = _one_node_scheduler()
+        s.update_quota(QuotaSpec(name="t", min={R.CPU: 1000}, max={R.CPU: 8000}))
+        s.update_gang(
+            GangSpec(name="g", min_member=2, wait_time=30.0, mode=GangMode.NON_STRICT)
+        )
+        pod = PodSpec(name="w1", gang="g", quota="t", requests={R.CPU: 2000})
+        s.add_pod(pod)
+        out = s.schedule_pending(now=100.0)
+        assert out.waiting.get("default/w1") == "n0"
+        assert s.quota_manager.quotas["t"].used[int(R.CPU)] == 2000
+
+        # before the timeout nothing changes
+        s.schedule_pending(now=120.0)
+        assert "default/w1" in s._waiting
+
+        # past WaitTime: rejected, resources released, pod back to pending
+        released = s.expire_waiting(now=131.0)
+        assert released == ["default/w1"]
+        assert "default/w1" in s.cache.pending
+        assert s.quota_manager.quotas["t"].used[int(R.CPU)] == 0
+        # next round it waits again (still only 1 member)
+        out = s.schedule_pending(now=140.0)
+        assert out.waiting.get("default/w1") == "n0"
+
+    def test_strict_group_rejected_together(self):
+        s = _one_node_scheduler()
+        s.update_gang(GangSpec(name="g", min_member=3, wait_time=30.0))
+        for i in range(3):
+            s.add_pod(PodSpec(name=f"g{i}", gang="g", requests={R.CPU: 1000}))
+        # incremental path: two members scheduled, both wait at Permit
+        assert s.schedule_one("default/g0", now=100.0).status == "waiting"
+        assert s.schedule_one("default/g1", now=105.0).status == "waiting"
+        assert set(s._waiting) == {"default/g0", "default/g1"}
+
+        # g0 times out at 130; Strict mode rejects the whole group
+        released = s.expire_waiting(now=131.0)
+        assert set(released) == {"default/g0", "default/g1"}
+        assert "default/g0" in s.cache.pending
+        assert "default/g1" in s.cache.pending
+        assert not s._waiting
+
+        # ... and the very next batched round re-places the whole gang
+        # (all three members are pending together now)
+        out = s.schedule_pending(now=132.0)
+        assert all(out.get(f"default/g{i}") == "n0" for i in range(3))
+
+    def test_gang_completion_stops_the_clock(self):
+        s = _one_node_scheduler()
+        s.update_gang(GangSpec(name="g", min_member=2, wait_time=30.0))
+        s.add_pod(PodSpec(name="g0", gang="g", requests={R.CPU: 1000}))
+        s.add_pod(PodSpec(name="g1", gang="g", requests={R.CPU: 1000}))
+        assert s.schedule_one("default/g0", now=100.0).status == "waiting"
+        assert s.schedule_one("default/g1", now=110.0).status == "bound"
+        # barrier opened: g0 committed, no expiry later
+        assert not s._waiting
+        assert s.expire_waiting(now=500.0) == []
+        assert s.cache.pods["default/g0"].node_name == "n0"
+
+
+class TestReservationController:
+    def test_expiration_releases_hold(self):
+        s = _one_node_scheduler(cpu=10000)
+        s.update_reservation(
+            ReservationSpec(
+                name="resv",
+                requests={R.CPU: 8000},
+                allocatable={R.CPU: 8000},
+                owner_labels={"team": "ml"},
+                node_name="n0",
+                state=ReservationState.AVAILABLE,
+                expiration_time=150.0,
+            )
+        )
+        s.add_pod(PodSpec(name="big", requests={R.CPU: 6000}))
+        out = s.schedule_pending(now=100.0)
+        assert out["default/big"] is None  # blocked by the hold
+
+        # controller expires the reservation at 150; hold released
+        out = s.schedule_pending(now=151.0)
+        assert out["default/big"] == "n0"
+        assert s.cache.reservations["resv"].state == ReservationState.EXPIRED
+
+    def test_ttl_and_zero_ttl(self):
+        cache_s = _one_node_scheduler()
+        c = cache_s.reservation_controller
+        cache_s.update_reservation(
+            ReservationSpec(
+                name="ttl0", requests={R.CPU: 100}, node_name="n0",
+                state=ReservationState.AVAILABLE, ttl=0, create_time=0.0,
+            )
+        )
+        cache_s.update_reservation(
+            ReservationSpec(
+                name="ttl60", requests={R.CPU: 100}, node_name="n0",
+                state=ReservationState.AVAILABLE, ttl=60.0, create_time=100.0,
+            )
+        )
+        c.sync(now=1000.0)
+        assert cache_s.cache.reservations["ttl0"].state == ReservationState.AVAILABLE
+        assert cache_s.cache.reservations["ttl60"].state == ReservationState.EXPIRED
+
+    def test_missing_node_expires(self):
+        s = _one_node_scheduler()
+        s.update_reservation(
+            ReservationSpec(
+                name="ghost", requests={R.CPU: 100}, node_name="gone",
+                state=ReservationState.AVAILABLE,
+            )
+        )
+        s.reservation_controller.sync(now=100.0)
+        assert s.cache.reservations["ghost"].state == ReservationState.EXPIRED
+
+    def test_gc_removes_after_grace(self):
+        s = _one_node_scheduler()
+        c = ReservationController(s.cache, gc_seconds=100.0)
+        s.update_reservation(
+            ReservationSpec(
+                name="old", requests={R.CPU: 100}, node_name="n0",
+                state=ReservationState.SUCCEEDED,
+            )
+        )
+        c.sync(now=0.0)
+        assert "old" in s.cache.reservations
+        c.sync(now=99.0)
+        assert "old" in s.cache.reservations
+        c.sync(now=101.0)
+        assert "old" not in s.cache.reservations
+
+    def test_status_sync_releases_dead_pod_allocation(self):
+        s = _one_node_scheduler()
+        resv = ReservationSpec(
+            name="multi",
+            requests={R.CPU: 8000},
+            allocatable={R.CPU: 8000},
+            owner_labels={"team": "ml"},
+            node_name="n0",
+            state=ReservationState.AVAILABLE,
+            allocate_once=False,
+        )
+        s.update_reservation(resv)
+        pod = PodSpec(name="mlpod", requests={R.CPU: 4000}, labels={"team": "ml"})
+        s.add_pod(pod)
+        out = s.schedule_pending(now=100.0)
+        assert out["default/mlpod"] == "n0"
+        assert resv.allocated.get(R.CPU) == 4000
+
+        # the consuming pod dies; status sync returns the capacity
+        s.remove_pod(pod)
+        s.reservation_controller.sync(now=110.0)
+        assert not resv.allocated.get(R.CPU)
+        assert resv.allocated_pod_uids == []
+
+
+def test_waiting_pod_reservation_rolled_back_on_expiry():
+    """An allocate_once reservation consumed by a *waiting* gang member is
+    restored (AVAILABLE, allocation removed) when the wait expires —
+    review fix: the capacity must not be lost to a pod that never ran."""
+    s = _one_node_scheduler()
+    s.update_gang(
+        GangSpec(name="g", min_member=2, wait_time=30.0, mode=GangMode.NON_STRICT)
+    )
+    resv = ReservationSpec(
+        name="resv",
+        requests={R.CPU: 4000},
+        allocatable={R.CPU: 4000},
+        owner_labels={"team": "ml"},
+        node_name="n0",
+        state=ReservationState.AVAILABLE,
+        allocate_once=True,
+    )
+    s.update_reservation(resv)
+    s.add_pod(
+        PodSpec(name="w1", gang="g", requests={R.CPU: 2000}, labels={"team": "ml"})
+    )
+    out = s.schedule_pending(now=100.0)
+    assert out.waiting.get("default/w1") == "n0"
+    assert resv.state == ReservationState.SUCCEEDED
+    assert resv.allocated.get(R.CPU) == 2000
+
+    released = s.expire_waiting(now=131.0)
+    assert released == ["default/w1"]
+    assert resv.state == ReservationState.AVAILABLE
+    assert not resv.allocated.get(R.CPU)
+    assert resv.allocated_pod_uids == []
+
+
+def test_incremental_waiting_cpuset_released_on_expiry():
+    """Incremental-path waiting pod with a cpuset hold: expiry releases
+    the pinned cpus (review fix: schedule_one now stashes its cycle
+    state for rollback)."""
+    import json as _json
+
+    from koordinator_tpu.apis.extension import QoSClass
+    from koordinator_tpu.numa.hints import NUMATopologyPolicy
+    from koordinator_tpu.numa.manager import TopologyOptions
+    from koordinator_tpu.numa.topology import CPUTopology
+
+    s = _one_node_scheduler()
+    topo = CPUTopology.build(
+        sockets=2, nodes_per_socket=1, cores_per_node=4, threads_per_core=2
+    )
+    s.update_node_topology(
+        "n0",
+        TopologyOptions(
+            cpu_topology=topo,
+            policy=NUMATopologyPolicy.NONE,
+            numa_node_resources={
+                0: {R.CPU: 8000, R.MEMORY: 16384},
+                1: {R.CPU: 8000, R.MEMORY: 16384},
+            },
+        ),
+    )
+    s.update_gang(GangSpec(name="g", min_member=2, wait_time=30.0))
+    s.add_pod(
+        PodSpec(name="c1", gang="g", qos=QoSClass.LSR, requests={R.CPU: 4000})
+    )
+    s.add_pod(PodSpec(name="c2", gang="g", requests={R.CPU: 99000}))  # never fits
+    out = s.schedule_one("default/c1", now=100.0)
+    assert out.status == "waiting"
+    assert s.numa_manager.get_allocated_cpuset("n0", "default/c1") is not None
+
+    released = s.expire_waiting(now=131.0)
+    assert "default/c1" in released
+    assert s.numa_manager.get_allocated_cpuset("n0", "default/c1") is None
