@@ -191,6 +191,13 @@ class QuotaSpec:
     allow_lent_resource: bool = True
     guaranteed: Resources = dataclasses.field(default_factory=dict)
     tree_id: str = ""
+    #: opt into proportional min scaling when sibling mins oversubscribe
+    #: the parent total (reference: enable-scale-min-quota annotation,
+    #: core/scale_minquota_when_over_root_res.go)
+    enable_min_quota_scale: bool = False
+    #: tree roots: the node-pool total backing this tree (reference:
+    #: AnnotationTotalResource set by the quota-profile controller)
+    total_resource: Optional[Resources] = None
 
 
 class ReservationState(enum.Enum):

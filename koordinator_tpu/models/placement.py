@@ -72,6 +72,9 @@ class ScheduleResult(Dict[str, Optional[str]]):
         #: uid -> (reservation name, delta vector) for *waiting* pods'
         #: reservation consumption — rolled back if the wait expires.
         self.resv_allocs: Dict[str, tuple] = dict(resv_allocs or {})
+        #: uid -> nominated node for pods that triggered preemption this
+        #: round (victims evicted; the pod binds in a later round)
+        self.nominations: Dict[str, str] = {}
 
 
 class PlacementModel:
@@ -464,18 +467,33 @@ class PlacementModel:
                 if pod.node_name is not None:
                     used[i] += vec
 
-        total = node_arrays.alloc.astype(np.int64).sum(axis=0)
-        mgr = GroupQuotaManager(exact_rational=True)
-        mgr.cluster_total = total.copy()
+        # one host manager per quota tree (quota_handler.go multi-tree):
+        # each tree water-fills against its own total — the root quota's
+        # total_resource (profile-created node pools) or the cluster total
+        node_total = node_arrays.alloc.astype(np.int64).sum(axis=0)
+        by_tree: Dict[str, list] = {}
         for name in quota_names:
-            mgr.update_quota(snapshot.quotas[name])
-        for name, i in quota_index.items():
-            if child_request[i].any():
-                mgr.add_request(name, child_request[i])
+            by_tree.setdefault(snapshot.quotas[name].tree_id, []).append(name)
         runtime = np.zeros((q, NUM_RESOURCES), np.int64)
-        for name, i in quota_index.items():
-            rt = mgr.refresh_runtime(name)
-            runtime[i] = rt if rt is not None else 0
+        for tree_names in by_tree.values():
+            mgr = GroupQuotaManager(exact_rational=True)
+            mgr.cluster_total = node_total.copy()
+            for name in tree_names:
+                spec = snapshot.quotas[name]
+                # only tree ROOTS carry the pool total (profile controller)
+                if spec.total_resource is not None and (
+                    spec.parent is None or spec.parent == "root"
+                ):
+                    mgr.cluster_total = resources_to_vector(spec.total_resource)
+                mgr.update_quota(spec)
+            for name in tree_names:
+                i = quota_index[name]
+                if child_request[i].any():
+                    mgr.add_request(name, child_request[i])
+            for name in tree_names:
+                i = quota_index[name]
+                rt = mgr.refresh_runtime(name)
+                runtime[i] = rt if rt is not None else 0
 
         return QuotaState.build(
             min=mn,
@@ -485,6 +503,6 @@ class PlacementModel:
             allow_lent=allow,
             child_request=child_request,
             used=used,
-            total=total,
+            total=node_total,
             runtime=runtime,
         )

@@ -97,7 +97,7 @@ class QuotaInfo:
     spec: QuotaSpec
     min: np.ndarray
     max: np.ndarray
-    auto_scale_min: np.ndarray     # max(min, guarantee)
+    guaranteed: np.ndarray         # spec.guaranteed as a vector
     shared_weight: np.ndarray      # defaults to max
     request: np.ndarray            # own + child limited requests
     child_request: np.ndarray
@@ -159,7 +159,7 @@ class GroupQuotaManager:
             spec=spec,
             min=mn,
             max=mx,
-            auto_scale_min=np.maximum(mn, guarantee),
+            guaranteed=guarantee,
             shared_weight=weight,
             request=_zeros(),
             child_request=_zeros(),
@@ -289,6 +289,42 @@ class GroupQuotaManager:
             total = info.runtime
         return np.minimum(self.quotas[name].runtime, self.quotas[name].max)
 
+    def _scaled_mins(
+        self, children: List[QuotaInfo], total: np.ndarray
+    ) -> np.ndarray:
+        """[C,R] per-child min after proportional scaling (reference:
+        scale_minquota_when_over_root_res.go:99-160 getScaledMinQuota).
+
+        On dimensions where Σ sibling mins exceeds ``total``, scaling-
+        enabled children share the remainder after non-scaling children's
+        mins are guaranteed first, proportionally to their original min:
+        ``scaled = (total - disable_sum)+ * min / enable_sum``.
+        """
+        mins = np.stack([c.min for c in children])
+        enable = np.array(
+            [c.spec.enable_min_quota_scale for c in children], dtype=bool
+        )
+        if not enable.any():
+            return mins
+        enable_sum = mins[enable].sum(axis=0)
+        disable_sum = mins[~enable].sum(axis=0) if (~enable).any() else np.zeros_like(total)
+        over = (enable_sum + disable_sum) > total  # [R] dims needing scale
+        if not over.any():
+            return mins
+        scaled = mins.copy()
+        avail = np.maximum(total - disable_sum, 0)
+        for i, c in enumerate(children):
+            if not enable[i]:
+                continue
+            for r in np.nonzero(over)[0]:
+                if avail[r] <= 0:
+                    scaled[i, r] = 0
+                elif enable_sum[r] > 0:
+                    scaled[i, r] = int(
+                        float(avail[r]) * float(mins[i, r]) / float(enable_sum[r])
+                    )
+        return scaled
+
     def _redistribute_children(self, parent: QuotaInfo, total: np.ndarray) -> None:
         """Run the per-dimension water-filling over ``parent``'s children."""
         children = [
@@ -299,8 +335,8 @@ class GroupQuotaManager:
         if not children:
             return
         request = np.stack([c.limited_request for c in children])
-        min_ = np.stack([c.min for c in children])
-        guarantee = np.stack([c.auto_scale_min for c in children])
+        min_ = self._scaled_mins(children, total)  # scaled when oversubscribed
+        guarantee = np.stack([c.guaranteed for c in children])
         weight = np.stack([c.shared_weight for c in children])
         allow = [c.spec.allow_lent_resource for c in children]
         for r in range(NUM_RESOURCES):

@@ -108,6 +108,9 @@ class ScheduleOutcome:
     #: the cycle's state, returned for *waiting* outcomes so the caller
     #: can roll back Reserve-time holds if the Permit wait later expires
     cycle_state: Optional["CycleState"] = None
+    #: *nominated* outcomes: uids of pods that must be evicted before the
+    #: nominated node has room (PostFilter preemption)
+    victims: Optional[List[str]] = None
 
 
 class SchedulingFramework:
@@ -130,6 +133,26 @@ class SchedulingFramework:
             if self.monitor is not None:
                 self.monitor.cycle_finished(pod.uid, time.monotonic() - started)
 
+    def _run_post_filter(self, state, snapshot, pod) -> Optional[ScheduleOutcome]:
+        """PostFilter: side effects (gang rejection fan-out) run for every
+        plugin; the first preemption nomination wins (reference: framework
+        RunPostFilterPlugins)."""
+        nomination = None
+        for plugin in self.plugins:
+            result = plugin.post_filter(state, snapshot, pod)
+            if result is not None and nomination is None:
+                nomination = result
+        if nomination is None:
+            return None
+        node_name, victims = nomination
+        return ScheduleOutcome(
+            pod.uid,
+            node_name,
+            "nominated",
+            reason=f"preemption: {len(victims)} victim(s)",
+            victims=[v.uid for v in victims],
+        )
+
     def _schedule_one(self, snapshot, pod) -> ScheduleOutcome:
         state = CycleState()
 
@@ -138,6 +161,13 @@ class SchedulingFramework:
         for plugin in self.plugins:
             status = plugin.pre_filter(state, snapshot, pod)
             if not status.ok:
+                # an unschedulable PreFilter verdict (e.g. quota admission)
+                # still reaches PostFilter, exactly as the k8s framework's
+                # scheduleOne error path does — this is how ElasticQuota
+                # preemption triggers on quota rejection
+                nominated = self._run_post_filter(state, snapshot, pod)
+                if nominated is not None:
+                    return nominated
                 return ScheduleOutcome(
                     pod.uid, None, "unschedulable", f"{plugin.name}: {status.reason}"
                 )
@@ -157,8 +187,9 @@ class SchedulingFramework:
             if ok:
                 feasible.append(node)
         if not feasible:
-            for plugin in self.plugins:
-                plugin.post_filter(state, snapshot, pod)
+            nominated = self._run_post_filter(state, snapshot, pod)
+            if nominated is not None:
+                return nominated
             return ScheduleOutcome(pod.uid, None, "unschedulable", "no feasible node")
 
         best_node, best_score = None, -1

@@ -10,9 +10,16 @@ from koordinator_tpu.scheduler.framework import CycleState, Plugin, Status
 class CoschedulingPlugin(Plugin):
     name = "Coscheduling"
 
-    def __init__(self, manager: GangManager, on_release=None):
+    def __init__(self, manager: GangManager, on_release=None, on_reject=None):
         self.manager = manager
         self.on_release = on_release
+        #: called with the waiting sibling uids released by a Strict
+        #: gang-group rejection — their held resources must be returned
+        self.on_reject = on_reject
+
+    def _rejected(self, uids) -> None:
+        if uids and self.on_reject is not None:
+            self.on_reject(list(uids))
 
     def score_weight(self) -> int:
         return 0
@@ -38,10 +45,12 @@ class CoschedulingPlugin(Plugin):
         return ("allow", 0.0)
 
     def unreserve(self, state: CycleState, snapshot, pod, node) -> None:
-        self.manager.unreserve(pod.uid)
+        self._rejected(self.manager.unreserve(pod.uid))
 
     def post_filter(self, state: CycleState, snapshot, pod) -> None:
         # a member failed filtering entirely: strict gangs reject the group
+        # (core.go:318 rejectGangGroupById); the released waiting siblings
+        # are surfaced so the scheduler returns their holds
         gang = self.manager.pod_gang.get(pod.uid)
         if gang is not None:
-            self.manager.unreserve(pod.uid)
+            self._rejected(self.manager.unreserve(pod.uid))

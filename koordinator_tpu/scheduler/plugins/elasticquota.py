@@ -1,13 +1,19 @@
-"""ElasticQuota plugin (incremental path): PreFilter admission + accounting.
+"""ElasticQuota plugin (incremental path): PreFilter admission,
+accounting, multi-tree routing, and PostFilter preemption.
 
-Wraps the host GroupQuotaManager (quota/core.py; SURVEY.md A.3). Pod
-requests register at pod creation via ``on_pod_add``; Reserve moves used.
+Wraps per-tree host GroupQuotaManagers (quota/core.py + quota/trees.py;
+SURVEY.md A.3). Pod requests register at pod creation via ``on_pod_add``;
+Reserve moves used; PostFilter selects same-quota lower-priority victims
+(reference: plugin.go:210-321, preempt.go).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 from koordinator_tpu.apis.types import resources_to_vector
 from koordinator_tpu.quota.core import GroupQuotaManager
+from koordinator_tpu.quota.trees import QuotaTreeRegistry
 from koordinator_tpu.scheduler.framework import CycleState, Plugin, Status
 
 
@@ -16,13 +22,30 @@ class ElasticQuotaPlugin(Plugin):
 
     def __init__(
         self,
-        manager: GroupQuotaManager,
+        manager,
         enable_runtime_quota: bool = True,
         enable_check_parent: bool = False,
+        enable_preemption: bool = True,
     ):
-        self.manager = manager
+        # accept a bare GroupQuotaManager (single default tree) or a
+        # QuotaTreeRegistry (multi-tree, quota_handler.go)
+        if isinstance(manager, GroupQuotaManager):
+            registry = QuotaTreeRegistry()
+            registry.default = manager
+            registry.trees[""] = manager
+            manager = registry
+        self.registry: QuotaTreeRegistry = manager
         self.enable_runtime_quota = enable_runtime_quota
         self.enable_check_parent = enable_check_parent
+        self.enable_preemption = enable_preemption
+
+    @property
+    def manager(self) -> GroupQuotaManager:
+        """The default tree's manager (single-tree compatibility)."""
+        return self.registry.default
+
+    def _mgr(self, quota_name) -> GroupQuotaManager:
+        return self.registry.manager_for_quota(quota_name)
 
     def score_weight(self) -> int:
         return 0
@@ -31,7 +54,7 @@ class ElasticQuotaPlugin(Plugin):
 
     def on_pod_add(self, pod) -> None:
         if pod.quota:
-            self.manager.add_request(
+            self._mgr(pod.quota).add_request(
                 pod.quota,
                 resources_to_vector(pod.requests),
                 non_preemptible=not pod.preemptible,
@@ -39,7 +62,7 @@ class ElasticQuotaPlugin(Plugin):
 
     def on_pod_delete(self, pod) -> None:
         if pod.quota:
-            self.manager.add_request(
+            self._mgr(pod.quota).add_request(
                 pod.quota,
                 -resources_to_vector(pod.requests),
                 non_preemptible=not pod.preemptible,
@@ -50,7 +73,7 @@ class ElasticQuotaPlugin(Plugin):
     def pre_filter(self, state: CycleState, snapshot, pod) -> Status:
         if not pod.quota:
             return Status.success()
-        ok = self.manager.can_admit(
+        ok = self._mgr(pod.quota).can_admit(
             pod.quota,
             resources_to_vector(pod.requests),
             non_preemptible=not pod.preemptible,
@@ -62,7 +85,7 @@ class ElasticQuotaPlugin(Plugin):
 
     def reserve(self, state: CycleState, snapshot, pod, node) -> Status:
         if pod.quota:
-            self.manager.add_used(
+            self._mgr(pod.quota).add_used(
                 pod.quota,
                 resources_to_vector(pod.requests),
                 non_preemptible=not pod.preemptible,
@@ -71,8 +94,37 @@ class ElasticQuotaPlugin(Plugin):
 
     def unreserve(self, state: CycleState, snapshot, pod, node) -> None:
         if pod.quota:
-            self.manager.add_used(
+            self._mgr(pod.quota).add_used(
                 pod.quota,
                 -resources_to_vector(pod.requests),
                 non_preemptible=not pod.preemptible,
             )
+
+    # PostFilter preemption (plugin.go:302, preempt.go) --------------------
+
+    def post_filter(self, state: CycleState, snapshot, pod):
+        """Try preempting same-quota lower-priority pods; returns
+        ``(node name, [victim PodSpec])`` or None."""
+        if not self.enable_preemption:
+            return None
+        from koordinator_tpu.scheduler.preemption import (
+            ARRAYS_STATE_KEY,
+            find_preemption,
+        )
+
+        quota_used = used_limit = None
+        if pod.quota:
+            mgr = self._mgr(pod.quota)
+            info = mgr.quotas.get(pod.quota)
+            if info is not None:
+                quota_used = info.used
+                used_limit = (
+                    mgr.refresh_runtime(pod.quota)
+                    if self.enable_runtime_quota
+                    else info.max
+                )
+        arrays = state.get(ARRAYS_STATE_KEY) if state is not None else None
+        return find_preemption(
+            snapshot, pod, quota_used=quota_used, used_limit=used_limit,
+            arrays=arrays,
+        )

@@ -1,0 +1,168 @@
+"""ElasticQuota PostFilter preemption: evict lower-priority same-quota
+pods to make room (reference: pkg/scheduler/plugins/elasticquota/
+preempt.go:103-294).
+
+Semantics reproduced from ``SelectVictimsOnNode``:
+
+- a pod can preempt a victim iff the victim is preemptible, has lower
+  priority, and belongs to the SAME quota group (``canPreempt``,
+  preempt.go:276-294);
+- per node: remove every candidate victim; if the pod still doesn't fit
+  the node, the node is unsuitable; otherwise *reprieve* victims from
+  most-important down (priority desc, then earlier assignment —
+  util.MoreImportantPod), re-adding each unless (a) the pod no longer
+  fits with it back, or (b) the quota's ``used + podReq`` exceeds its
+  ``usedLimit`` (runtime) — the reference checks (b) against the
+  PostFilter-snapshot used, so when the quota is over its runtime no
+  victim is reprieved (preempt.go:176-201);
+- PodDisruptionBudget grouping (preempt.go:219-267) has no counterpart
+  here (no PDB objects in the typed model).
+
+Node fitness uses the same canonical filters as the solver (fit +
+loadaware; usage does not change on eviction, matching the reference
+where NodeMetric lags eviction).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    PodSpec,
+    resources_to_vector,
+)
+from koordinator_tpu.oracle.scheduler import (
+    fit_filter_node,
+    loadaware_filter_node,
+)
+from koordinator_tpu.state.cluster import (
+    DEFAULT_USAGE_THRESHOLDS,
+    lower_nodes,
+)
+from koordinator_tpu.apis.extension import PriorityClass
+
+#: CycleState key: callers batch-preempting many pods stash the lowered
+#: node arrays here so each PostFilter doesn't re-lower the cluster
+ARRAYS_STATE_KEY = "__preempt_node_arrays__"
+
+
+def can_preempt(pod: PodSpec, victim: PodSpec) -> bool:
+    """preempt.go:276-294 canPreempt: preemptible victim, strictly lower
+    priority, same quota group."""
+    if not victim.preemptible:
+        return False
+    if pod.priority <= victim.priority:
+        return False
+    return (pod.quota or "") == (victim.quota or "")
+
+
+def _more_important(p: PodSpec) -> tuple:
+    """Sort key for util.MoreImportantPod: higher priority first, then
+    earlier assignment."""
+    return (-p.priority, p.assign_time)
+
+
+def select_victims_on_node(
+    pod: PodSpec,
+    node_index: int,
+    candidates: Sequence[PodSpec],
+    arrays,
+    quota_used: Optional[np.ndarray],
+    used_limit: Optional[np.ndarray],
+    thresholds: np.ndarray,
+    prod_thresholds: np.ndarray,
+) -> Optional[List[PodSpec]]:
+    """Victims on one node, or None if preemption there can't help."""
+    victims = [v for v in candidates if can_preempt(pod, v)]
+    if not victims:
+        return None
+    req = resources_to_vector(pod.requests)
+    alloc = arrays.alloc[node_index].astype(np.int64)
+    base_used = arrays.used_req[node_index].astype(np.int64)
+    removed = sum(
+        (resources_to_vector(v.requests) for v in victims),
+        np.zeros_like(req),
+    )
+    is_ds = pod.is_daemonset
+    is_prod = pod.priority_class == PriorityClass.PROD
+    if not loadaware_filter_node(
+        arrays.alloc[node_index],
+        arrays.usage[node_index],
+        arrays.prod_usage[node_index],
+        bool(arrays.metric_fresh[node_index]),
+        thresholds,
+        prod_thresholds,
+        is_ds,
+        is_prod,
+    ):
+        return None  # eviction can't fix a usage-threshold failure
+    if not fit_filter_node(req, alloc, base_used - removed):
+        return None  # doesn't fit even with every victim gone
+
+    # quota gate is constant across the reprieve loop (preempt.go:191-199
+    # checks the PostFilter-snapshot used): over-runtime quota means no
+    # reprieve at all
+    quota_blocks = False
+    if quota_used is not None and used_limit is not None:
+        dims = req > 0
+        quota_blocks = bool(np.any((quota_used + req)[dims] > used_limit[dims]))
+
+    final: List[PodSpec] = []
+    kept = base_used - removed
+    for v in sorted(victims, key=_more_important):
+        if quota_blocks:
+            final.append(v)
+            continue
+        v_req = resources_to_vector(v.requests)
+        if fit_filter_node(req, alloc, kept + v_req):
+            kept = kept + v_req  # reprieved
+        else:
+            final.append(v)
+    return final if final else None
+
+
+def find_preemption(
+    snapshot: ClusterSnapshot,
+    pod: PodSpec,
+    quota_used: Optional[np.ndarray] = None,
+    used_limit: Optional[np.ndarray] = None,
+    arrays=None,
+    thresholds: Optional[np.ndarray] = None,
+    prod_thresholds: Optional[np.ndarray] = None,
+) -> Optional[Tuple[str, List[PodSpec]]]:
+    """(node name, victims) for the cheapest viable preemption, or None.
+
+    Candidate nodes are ranked by fewest victims then lowest top victim
+    priority (the spirit of the reference's pickOneNodeForPreemption).
+    """
+    if thresholds is None:
+        thresholds = resources_to_vector(DEFAULT_USAGE_THRESHOLDS)
+    if prod_thresholds is None:
+        prod_thresholds = resources_to_vector({})
+    if arrays is None:
+        arrays = lower_nodes(snapshot)
+    by_node: Dict[str, List[PodSpec]] = {}
+    for p in snapshot.pods:
+        if p.node_name is not None:
+            by_node.setdefault(p.node_name, []).append(p)
+    index = arrays.index()
+
+    best: Optional[Tuple[str, List[PodSpec]]] = None
+    best_key = None
+    for node_name, candidates in by_node.items():
+        i = index.get(node_name)
+        if i is None or not arrays.schedulable[i]:
+            continue
+        victims = select_victims_on_node(
+            pod, i, candidates, arrays, quota_used, used_limit,
+            thresholds, prod_thresholds,
+        )
+        if victims is None:
+            continue
+        key = (len(victims), max(v.priority for v in victims))
+        if best_key is None or key < best_key:
+            best, best_key = (node_name, victims), key
+    return best

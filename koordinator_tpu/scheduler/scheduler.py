@@ -27,8 +27,10 @@ from koordinator_tpu.models.finegrained import FineGrained
 from koordinator_tpu.models.placement import PlacementModel, ScheduleResult
 from koordinator_tpu.numa.manager import ResourceManager, TopologyOptions
 from koordinator_tpu.quota.core import GroupQuotaManager
+from koordinator_tpu.quota.trees import QuotaTreeRegistry
 from koordinator_tpu.scheduler.cache import SchedulerCache
 from koordinator_tpu.scheduler.framework import (
+    CycleState,
     ScheduleOutcome,
     SchedulingFramework,
 )
@@ -67,7 +69,8 @@ class Scheduler:
         cluster_total=None,
     ):
         self.cache = SchedulerCache()
-        self.quota_manager = GroupQuotaManager(cluster_total=cluster_total or {})
+        self.quota_registry = QuotaTreeRegistry(cluster_total=cluster_total or {})
+        self.quota_manager = self.quota_registry.default
         self.gang_manager = GangManager()
         self.numa_manager = ResourceManager()
         self.device_cache = NodeDeviceCache()
@@ -88,9 +91,11 @@ class Scheduler:
         self._resv_waiting: Dict[str, tuple] = {}
         self.reservation_controller = ReservationController(self.cache)
 
-        self._quota_plugin = ElasticQuotaPlugin(self.quota_manager)
+        self._quota_plugin = ElasticQuotaPlugin(self.quota_registry)
         self._coscheduling = CoschedulingPlugin(
-            self.gang_manager, on_release=self._on_gang_release
+            self.gang_manager,
+            on_release=self._on_gang_release,
+            on_reject=self._on_gang_reject,
         )
         self._numa_plugin = NodeNUMAResourcePlugin(self.numa_manager)
         self._device_plugin = DeviceSharePlugin(self.device_cache)
@@ -155,7 +160,7 @@ class Scheduler:
 
     def update_quota(self, spec: QuotaSpec) -> None:
         self.cache.update_quota(spec)
-        self.quota_manager.update_quota(spec)
+        self.quota_registry.update_quota(spec)
 
     def update_reservation(self, spec: ReservationSpec) -> None:
         self.cache.update_reservation(spec)
@@ -230,7 +235,64 @@ class Scheduler:
                 self._resv_waiting[uid] = result.resv_allocs[uid]
         self._fine_waiting.update(result.fine_states)
         self._resolve_waiting(result)
+        self._preempt_unplaced(result, pending, at)
         return result
+
+    #: at most this many preemption scans per batched round
+    MAX_PREEMPTIONS_PER_ROUND = 32
+
+    def _preempt_unplaced(self, result: ScheduleResult, pending, now) -> None:
+        """Batched PostFilter: for pods the solve could not place, try
+        same-quota lower-priority preemption (preempt.go). Victims are
+        evicted now; the preemptor binds in a later round once capacity
+        frees — the reference's nominate-then-wait timing."""
+        unplaced = [
+            uid
+            for uid, node in result.items()
+            if node is None and uid not in result.waiting
+        ]
+        if not unplaced:
+            return
+        snapshot = self.cache.snapshot(now=now)
+        assigned = [p for p in snapshot.pods if p.preemptible]
+        if not assigned:
+            return
+        from koordinator_tpu.scheduler.preemption import ARRAYS_STATE_KEY
+        from koordinator_tpu.state.cluster import lower_nodes
+
+        min_priority = min(p.priority for p in assigned)
+        arrays = None
+        attempts = 0
+        result.nominations = {}
+        for uid in unplaced:
+            if attempts >= self.MAX_PREEMPTIONS_PER_ROUND:
+                break
+            pod = pending.get(uid)
+            if pod is None or pod.priority <= min_priority:
+                continue  # no strictly-lower-priority victim can exist
+            attempts += 1
+            if arrays is None:
+                arrays = lower_nodes(snapshot)
+            state = CycleState()
+            state[ARRAYS_STATE_KEY] = arrays
+            nomination = self._quota_plugin.post_filter(state, snapshot, pod)
+            if nomination is None:
+                continue
+            node_name, victims = nomination
+            victim_uids = {v.uid for v in victims}
+            self._evict_victims(sorted(victim_uids))
+            # later preemptors must see the eviction, not the stale view
+            snapshot.pods = [
+                p for p in snapshot.pods if p.uid not in victim_uids
+            ]
+            arrays = lower_nodes(snapshot)
+            result.nominations[uid] = node_name
+
+    def _evict_victims(self, uids: List[str]) -> None:
+        for uid in uids:
+            victim = self.cache.pods.get(uid)
+            if victim is not None:
+                self.remove_pod(victim)
 
     def expire_waiting(self, now: float) -> List[str]:
         """Reject waiting pods whose gang WaitTime has elapsed (reference:
@@ -305,7 +367,7 @@ class Scheduler:
         from koordinator_tpu.apis.types import resources_to_vector
 
         vec = resources_to_vector(pod.requests)
-        self.quota_manager.add_used(
+        self.quota_registry.manager_for_quota(pod.quota).add_used(
             pod.quota,
             -vec if release else vec,
             non_preemptible=not pod.preemptible,
@@ -371,12 +433,25 @@ class Scheduler:
             self._resv_waiting.pop(uid, None)  # consumption is final
             self._fine_pre_bind(uid)
 
+    def _on_gang_reject(self, uids: List[str]) -> None:
+        """A Strict gang-group rejection released these waiting siblings:
+        return their node/quota/fine-grained/reservation holds."""
+        for uid in uids:
+            if uid in self._waiting:
+                self._release_waiting(uid)
+
     def schedule_one(self, pod_uid: str, now: Optional[float] = None) -> ScheduleOutcome:
         snapshot = self.cache.snapshot(now=now)
         pod = self.cache.pending.get(pod_uid)
         if pod is None:
             return ScheduleOutcome(pod_uid, None, "error", "pod not pending")
         outcome = self.framework.schedule_one(snapshot, pod)
+        if outcome.status == "nominated" and outcome.victims:
+            # evict the victims (the reference deletes them via the API
+            # server and records nominatedNodeName); the preemptor stays
+            # pending and binds once the capacity frees
+            self._evict_victims(outcome.victims)
+            return outcome
         if outcome.status in ("bound", "waiting") and outcome.node:
             self.cache.assume_pod(pod_uid, outcome.node, now=now)
             if outcome.status == "bound":

@@ -1,0 +1,261 @@
+"""ElasticQuota preemption + multi-tree + min-scaling + profile controller
+(VERDICT round-1 item 4).
+
+Reference: pkg/scheduler/plugins/elasticquota/preempt.go (canPreempt,
+SelectVictimsOnNode), quota_handler.go (per-tree managers),
+core/scale_minquota_when_over_root_res.go (proportional min scaling),
+pkg/quota-controller/profile/profile_controller.go (profiles → trees).
+"""
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import (
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+)
+from koordinator_tpu.quota.core import GroupQuotaManager
+from koordinator_tpu.quota.profile import QuotaProfile, QuotaProfileController
+from koordinator_tpu.quota.trees import QuotaTreeRegistry
+from koordinator_tpu.scheduler import Scheduler
+from koordinator_tpu.scheduler.preemption import can_preempt, find_preemption
+
+
+def _mk(n_nodes=1, cpu=10000, mem=32768):
+    s = Scheduler(
+        cluster_total={R.CPU: max(n_nodes, 1) * cpu, R.MEMORY: max(n_nodes, 1) * mem}
+    )
+    for i in range(n_nodes):
+        s.add_node(
+            NodeSpec(name=f"n{i}", allocatable={R.CPU: cpu, R.MEMORY: mem})
+        )
+        s.update_node_metric(
+            NodeMetric(node_name=f"n{i}", node_usage={}, update_time=99.0)
+        )
+    return s
+
+
+class TestCanPreempt:
+    def test_same_quota_lower_priority_only(self):
+        pod = PodSpec(name="p", quota="a", priority=100)
+        assert can_preempt(pod, PodSpec(name="v1", quota="a", priority=50))
+        # different quota group: never (preempt.go:293)
+        assert not can_preempt(pod, PodSpec(name="v2", quota="b", priority=50))
+        # equal or higher priority: never
+        assert not can_preempt(pod, PodSpec(name="v3", quota="a", priority=100))
+        # non-preemptible victim: never (preempt.go:277)
+        assert not can_preempt(
+            pod, PodSpec(name="v4", quota="a", priority=50, preemptible=False)
+        )
+
+
+class TestIncrementalPreemption:
+    def test_nominates_and_evicts_lower_priority_same_quota(self):
+        s = _mk(cpu=10000)
+        s.update_quota(QuotaSpec(name="a", min={R.CPU: 10000}, max={R.CPU: 10000}))
+        victim = PodSpec(name="low", quota="a", priority=10, requests={R.CPU: 8000})
+        s.add_pod(victim)
+        assert s.schedule_one("default/low", now=100.0).status == "bound"
+
+        high = PodSpec(name="high", quota="a", priority=100, requests={R.CPU: 8000})
+        s.add_pod(high)
+        out = s.schedule_one("default/high", now=101.0)
+        assert out.status == "nominated"
+        assert out.node == "n0"
+        assert out.victims == ["default/low"]
+        # the victim was evicted; the preemptor binds next attempt
+        assert "default/low" not in s.cache.pods
+        assert s.schedule_one("default/high", now=102.0).status == "bound"
+
+    def test_no_preemption_across_quotas(self):
+        s = _mk(cpu=10000)
+        s.update_quota(QuotaSpec(name="a", min={R.CPU: 5000}, max={R.CPU: 10000}))
+        s.update_quota(QuotaSpec(name="b", min={R.CPU: 5000}, max={R.CPU: 10000}))
+        s.add_pod(PodSpec(name="other", quota="b", priority=10, requests={R.CPU: 8000}))
+        assert s.schedule_one("default/other", now=100.0).status == "bound"
+        s.add_pod(PodSpec(name="high", quota="a", priority=100, requests={R.CPU: 8000}))
+        out = s.schedule_one("default/high", now=101.0)
+        assert out.status == "unschedulable"
+        assert "default/other" in s.cache.pods
+
+    def test_reprieve_keeps_unneeded_victims(self):
+        """Quota has headroom but the node is full: only as many victims
+        as needed are evicted; the most important candidates are reprieved
+        first (preempt.go:166-215)."""
+        s = _mk(n_nodes=2, cpu=10000)
+        s.update_quota(QuotaSpec(name="a", min={R.CPU: 20000}, max={R.CPU: 20000}))
+        for i, prio in enumerate((30, 20)):
+            pod = PodSpec(name=f"v{i}", quota="a", priority=prio,
+                          requests={R.CPU: 4000}, node_name="n0")
+            s.add_pod(pod)
+            s._quota_plugin.reserve(None, None, pod, None)
+        # n0 has 2000 free; the preemptor needs 4000 there: ONE victim
+        # suffices. Fill n1 so it isn't a free alternative.
+        filler = PodSpec(name="filler", priority=1000, preemptible=False,
+                         requests={R.CPU: 9000}, node_name="n1")
+        s.add_pod(filler)
+        s.add_pod(PodSpec(name="high", quota="a", priority=100,
+                          requests={R.CPU: 4000}))
+        out = s.schedule_one("default/high", now=101.0)
+        assert out.status == "nominated"
+        assert out.node == "n0"
+        # the higher-priority candidate (v0, prio 30) is reprieved; the
+        # least important (v1, prio 20) is the victim
+        assert out.victims == ["default/v1"]
+        assert "default/v0" in s.cache.pods
+
+    def test_over_runtime_quota_evicts_all_candidates(self):
+        """When the quota is over its runtime even the fit-reprievable
+        candidates stay victims — the reference checks the static
+        PostFilter-snapshot used (preempt.go:191-199)."""
+        s = _mk(cpu=10000)
+        s.update_quota(QuotaSpec(name="a", min={R.CPU: 10000}, max={R.CPU: 10000}))
+        for i, prio in enumerate((30, 20)):
+            s.add_pod(
+                PodSpec(name=f"v{i}", quota="a", priority=prio,
+                        requests={R.CPU: 4000})
+            )
+            s.schedule_one(f"default/v{i}", now=100.0)
+        s.add_pod(PodSpec(name="high", quota="a", priority=100,
+                          requests={R.CPU: 4000}))
+        out = s.schedule_one("default/high", now=101.0)
+        assert out.status == "nominated"
+        assert set(out.victims) == {"default/v0", "default/v1"}
+
+    def test_batched_round_preempts_unplaced(self):
+        s = _mk(cpu=10000)
+        s.update_quota(QuotaSpec(name="a", min={R.CPU: 10000}, max={R.CPU: 10000}))
+        s.add_pod(PodSpec(name="low", quota="a", priority=10, requests={R.CPU: 8000}))
+        s.schedule_pending(now=100.0)
+        assert s.cache.pods["default/low"].node_name == "n0"
+
+        s.add_pod(PodSpec(name="high", quota="a", priority=100, requests={R.CPU: 8000}))
+        out = s.schedule_pending(now=101.0)
+        assert out["default/high"] is None
+        assert out.nominations == {"default/high": "n0"}
+        assert "default/low" not in s.cache.pods
+        # next round the preemptor binds
+        out2 = s.schedule_pending(now=102.0)
+        assert out2["default/high"] == "n0"
+
+
+class TestMultiTree:
+    def test_trees_water_fill_independently(self):
+        reg = QuotaTreeRegistry(cluster_total={R.CPU: 100000})
+        reg.update_quota(
+            QuotaSpec(name="root-a", tree_id="ta", is_parent=True,
+                      min={R.CPU: 0}, max={R.CPU: 10**9},
+                      total_resource={R.CPU: 10000})
+        )
+        reg.update_quota(
+            QuotaSpec(name="a1", parent="root-a", tree_id="ta",
+                      min={R.CPU: 2000}, max={R.CPU: 10000})
+        )
+        reg.update_quota(
+            QuotaSpec(name="b1", tree_id="",
+                      min={R.CPU: 2000}, max={R.CPU: 100000})
+        )
+        mgr_a = reg.manager_for_quota("a1")
+        mgr_b = reg.manager_for_quota("b1")
+        assert mgr_a is not mgr_b
+        # tree A's water-filling is bounded by its pool total (10000),
+        # not the cluster total
+        mgr_a.add_request("a1", resources_to_vec({R.CPU: 50000}))
+        rt = mgr_a.refresh_runtime("a1")
+        assert rt[int(R.CPU)] <= 10000
+        mgr_b.add_request("b1", resources_to_vec({R.CPU: 50000}))
+        rt_b = mgr_b.refresh_runtime("b1")
+        assert rt_b[int(R.CPU)] == 50000  # cluster tree has room
+
+    def test_batched_path_uses_tree_totals(self):
+        s = _mk(n_nodes=2, cpu=10000)
+        # tree-a pool total is only 6000 despite 20000 of cluster capacity
+        s.update_quota(
+            QuotaSpec(name="pool", tree_id="ta", is_parent=True,
+                      min={R.CPU: 6000}, max={R.CPU: 10**9},
+                      total_resource={R.CPU: 6000, R.MEMORY: 65536})
+        )
+        s.update_quota(
+            QuotaSpec(name="team", parent="pool", tree_id="ta",
+                      min={R.CPU: 0}, max={R.CPU: 10**9})
+        )
+        for i in range(3):
+            s.add_pod(PodSpec(name=f"p{i}", quota="team", requests={R.CPU: 3000}))
+        out = s.schedule_pending(now=100.0)
+        placed = [u for u, n in out.items() if n is not None]
+        # runtime = tree total 6000 -> exactly two 3000 pods admitted
+        assert len(placed) == 2
+
+
+def resources_to_vec(res):
+    from koordinator_tpu.apis.types import resources_to_vector
+
+    return resources_to_vector(res)
+
+
+class TestMinScaling:
+    def test_scaled_proportionally_when_oversubscribed(self):
+        """scale_minquota_when_over_root_res.go: enable-scale children
+        share what remains after disable-scale children's mins."""
+        mgr = GroupQuotaManager(cluster_total={R.CPU: 10000})
+        mgr.update_quota(
+            QuotaSpec(name="fixed", min={R.CPU: 4000}, max={R.CPU: 10000},
+                      allow_lent_resource=False)
+        )
+        mgr.update_quota(
+            QuotaSpec(name="s1", min={R.CPU: 6000}, max={R.CPU: 10000},
+                      allow_lent_resource=False, enable_min_quota_scale=True)
+        )
+        mgr.update_quota(
+            QuotaSpec(name="s2", min={R.CPU: 3000}, max={R.CPU: 10000},
+                      allow_lent_resource=False, enable_min_quota_scale=True)
+        )
+        # sum of mins 13000 > total 10000; disable-scale 'fixed' keeps
+        # 4000; s1/s2 share 6000 proportionally to 6000:3000 -> 4000/2000
+        assert mgr.refresh_runtime("fixed")[int(R.CPU)] == 4000
+        assert mgr.refresh_runtime("s1")[int(R.CPU)] == 4000
+        assert mgr.refresh_runtime("s2")[int(R.CPU)] == 2000
+
+    def test_no_scaling_when_total_sufficient(self):
+        mgr = GroupQuotaManager(cluster_total={R.CPU: 20000})
+        mgr.update_quota(
+            QuotaSpec(name="s1", min={R.CPU: 6000}, max={R.CPU: 20000},
+                      allow_lent_resource=False, enable_min_quota_scale=True)
+        )
+        mgr.update_quota(
+            QuotaSpec(name="fixed", min={R.CPU: 4000}, max={R.CPU: 20000},
+                      allow_lent_resource=False)
+        )
+        assert mgr.refresh_runtime("s1")[int(R.CPU)] == 6000
+
+
+class TestProfileController:
+    def test_profile_materialises_tree_root(self):
+        s = _mk(n_nodes=0)
+        s.add_node(NodeSpec(name="gpu-0", allocatable={R.CPU: 8000},
+                            labels={"pool": "gpu"}))
+        s.add_node(NodeSpec(name="gpu-1", allocatable={R.CPU: 8000},
+                            labels={"pool": "gpu"}))
+        s.add_node(NodeSpec(name="cpu-0", allocatable={R.CPU: 64000},
+                            labels={"pool": "cpu"}))
+        c = QuotaProfileController(s)
+        c.update_profile(
+            QuotaProfile(name="gpu-profile", quota_name="gpu-pool",
+                         node_selector={"pool": "gpu"})
+        )
+        c.sync()
+        spec = s.cache.quotas["gpu-pool"]
+        assert spec.min[R.CPU] == 16000          # Σ selected allocatable
+        assert spec.total_resource[R.CPU] == 16000
+        assert spec.tree_id != ""
+        # the tree's manager got the pool total
+        mgr = s.quota_registry.manager_for_quota("gpu-pool")
+        assert mgr.cluster_total[int(R.CPU)] == 16000
+
+        # node pool grows -> resync updates the root min/total
+        s.add_node(NodeSpec(name="gpu-2", allocatable={R.CPU: 8000},
+                            labels={"pool": "gpu"}))
+        c.sync()
+        assert s.cache.quotas["gpu-pool"].min[R.CPU] == 24000
