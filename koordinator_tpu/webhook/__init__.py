@@ -1,0 +1,20 @@
+"""Admission webhooks: the typed ingress for pods and quotas.
+
+Rebuild of /root/reference/pkg/webhook/: pod mutation
+(ClusterColocationProfile injection + batch/mid resource translation,
+pod/mutating/cluster_colocation_profile.go), pod validation
+(pod/validating/cluster_colocation_profile.go), and the ElasticQuota
+topology guard (elasticquota/quota_topology.go).
+"""
+
+from koordinator_tpu.webhook.mutating import (  # noqa: F401
+    ClusterColocationProfile,
+    PodMutatingWebhook,
+)
+from koordinator_tpu.webhook.validating import (  # noqa: F401
+    PodValidatingWebhook,
+)
+from koordinator_tpu.webhook.quota_topology import (  # noqa: F401
+    QuotaTopologyGuard,
+    QuotaTopologyError,
+)

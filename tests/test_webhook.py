@@ -1,0 +1,246 @@
+"""Webhook admission tests (VERDICT round-1 item 6).
+
+Reference: pkg/webhook/pod/mutating/cluster_colocation_profile.go,
+pod/validating/cluster_colocation_profile.go,
+elasticquota/quota_topology.go.
+"""
+
+import pytest
+
+from koordinator_tpu.apis.extension import (
+    PriorityClass,
+    QoSClass,
+    ResourceName as R,
+)
+from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec, QuotaSpec
+from koordinator_tpu.webhook import (
+    ClusterColocationProfile,
+    PodMutatingWebhook,
+    PodValidatingWebhook,
+    QuotaTopologyError,
+    QuotaTopologyGuard,
+)
+
+
+class TestMutating:
+    def _webhook(self):
+        wh = PodMutatingWebhook()
+        wh.update_profile(
+            ClusterColocationProfile(
+                name="colocation-batch",
+                namespace_selector={"colocation": "enabled"},
+                labels={"injected": "yes"},
+                qos_class=QoSClass.BE,
+                priority=5500,  # batch band
+                koordinator_priority=333,
+            )
+        )
+        wh.set_namespace_labels("batch-ns", {"colocation": "enabled"})
+        wh.set_namespace_labels("prod-ns", {})
+        return wh
+
+    def test_unlabeled_pod_gains_qos_priority_and_batch_resources(self):
+        wh = self._webhook()
+        pod = PodSpec(
+            name="job", namespace="batch-ns",
+            requests={R.CPU: 4000, R.MEMORY: 2048},
+            limits={R.CPU: 8000},
+        )
+        wh.mutate(pod)
+        assert pod.qos == QoSClass.BE
+        assert pod.priority == 5500
+        assert pod.priority_class == PriorityClass.BATCH
+        assert pod.sub_priority == 333
+        assert pod.labels["injected"] == "yes"
+        # native resources translated to batch extended resources
+        assert pod.requests == {R.BATCH_CPU: 4000, R.BATCH_MEMORY: 2048}
+        assert pod.limits == {R.BATCH_CPU: 8000}
+
+    def test_non_matching_namespace_untouched(self):
+        wh = self._webhook()
+        pod = PodSpec(name="svc", namespace="prod-ns",
+                      requests={R.CPU: 1000})
+        wh.mutate(pod)
+        assert pod.qos == QoSClass.NONE
+        assert pod.requests == {R.CPU: 1000}
+
+    def test_object_selector_and_key_mapping(self):
+        wh = PodMutatingWebhook()
+        wh.update_profile(
+            ClusterColocationProfile(
+                name="map",
+                selector={"app": "ml"},
+                label_keys_mapping={"quota-name": "team"},
+            )
+        )
+        pod = PodSpec(name="a", labels={"app": "ml", "team": "vision"})
+        wh.mutate(pod)
+        assert pod.labels["quota-name"] == "vision"
+        other = PodSpec(name="b", labels={"team": "vision"})
+        wh.mutate(other)
+        assert "quota-name" not in other.labels
+
+    def test_mid_translation_and_limit_only_request(self):
+        # translation only runs for profile-managed pods (reference
+        # :66-69) — use a match-all profile
+        wh = PodMutatingWebhook([ClusterColocationProfile(name="all")])
+        pod = PodSpec(name="m", priority=7500,  # mid band
+                      limits={R.CPU: 2000})
+        wh.mutate(pod)
+        # limit-only extended resource gains a matching request
+        # (restrictResourceRequestAndLimit)
+        assert pod.limits == {R.MID_CPU: 2000}
+        assert pod.requests == {R.MID_CPU: 2000}
+
+    def test_prod_pod_resources_untouched(self):
+        wh = PodMutatingWebhook([ClusterColocationProfile(name="all")])
+        pod = PodSpec(name="p", priority=9500, requests={R.CPU: 1000})
+        wh.mutate(pod)
+        assert pod.requests == {R.CPU: 1000}
+
+    def test_unmanaged_batch_pod_not_translated(self):
+        """No profile matched: the reference skips mutatePodResourceSpec
+        entirely — a directly-created batch-band pod keeps native cpu."""
+        wh = PodMutatingWebhook()
+        pod = PodSpec(name="raw", priority=5500, requests={R.CPU: 4000})
+        wh.mutate(pod)
+        assert pod.requests == {R.CPU: 4000}
+
+    def test_end_to_end_mutated_pod_schedules_on_batch_resources(self):
+        """The ingress story: an unlabeled pod passes the webhook, gains
+        BE/batch identity, and the scheduler places it against the node's
+        batch allocatable."""
+        from koordinator_tpu.scheduler import Scheduler
+
+        wh = self._webhook()
+        s = Scheduler()
+        s.add_node(
+            NodeSpec(name="n0", allocatable={
+                R.CPU: 16000, R.MEMORY: 32768,
+                R.BATCH_CPU: 6000, R.BATCH_MEMORY: 8192,
+            })
+        )
+        s.update_node_metric(
+            NodeMetric(node_name="n0", node_usage={}, update_time=99.0)
+        )
+        pod = PodSpec(name="job", namespace="batch-ns",
+                      requests={R.CPU: 4000, R.MEMORY: 2048})
+        s.add_pod(wh.mutate(pod))
+        out = s.schedule_pending(now=100.0)
+        assert out["batch-ns/job"] == "n0"
+        # a second batch pod exceeding batch-cpu is rejected even though
+        # native cpu would fit
+        pod2 = PodSpec(name="job2", namespace="batch-ns",
+                       requests={R.CPU: 4000})
+        s.add_pod(wh.mutate(pod2))
+        out2 = s.schedule_pending(now=101.0)
+        assert out2["batch-ns/job2"] is None
+
+
+class TestValidating:
+    def test_batch_resources_require_be(self):
+        v = PodValidatingWebhook()
+        pod = PodSpec(name="x", qos=QoSClass.LS,
+                      requests={R.BATCH_CPU: 1000})
+        assert any("QoS BE" in e for e in v.validate(pod))
+        ok = PodSpec(name="y", qos=QoSClass.BE, priority=5500,
+                     requests={R.BATCH_CPU: 1000})
+        assert v.validate(ok) == []
+
+    def test_forbidden_combinations(self):
+        v = PodValidatingWebhook()
+        # BE + prod priority: forbidden
+        pod = PodSpec(name="x", qos=QoSClass.BE, priority=9500)
+        assert any("combination" in e for e in v.validate(pod))
+        # LSR + batch priority: forbidden
+        pod = PodSpec(name="y", qos=QoSClass.LSR, priority=5500,
+                      requests={R.CPU: 2000})
+        assert any("combination" in e for e in v.validate(pod))
+        # LSR + prod: fine
+        pod = PodSpec(name="z", qos=QoSClass.LSR, priority=9500,
+                      requests={R.CPU: 2000})
+        assert v.validate(pod) == []
+
+    def test_lsr_integer_cpu(self):
+        v = PodValidatingWebhook()
+        pod = PodSpec(name="x", qos=QoSClass.LSR, priority=9500,
+                      requests={R.CPU: 1500})
+        assert any("integer" in e for e in v.validate(pod))
+        pod = PodSpec(name="y", qos=QoSClass.LSE, priority=9500)
+        assert any("must declare" in e for e in v.validate(pod))
+
+    def test_immutable_on_update(self):
+        v = PodValidatingWebhook()
+        old = PodSpec(name="x", qos=QoSClass.LS, priority=9500)
+        new = PodSpec(name="x", qos=QoSClass.BE, priority=5500)
+        errs = v.validate(new, old_pod=old)
+        assert any("qosClass" in e for e in errs)
+        assert any("spec.priority" in e for e in errs)
+
+
+class TestQuotaTopologyGuard:
+    def _guard(self):
+        g = QuotaTopologyGuard()
+        g.validate_add(
+            QuotaSpec(name="parent", is_parent=True,
+                      min={R.CPU: 10000}, max={R.CPU: 20000})
+        )
+        return g
+
+    def test_negative_and_min_over_max_rejected(self):
+        g = QuotaTopologyGuard()
+        with pytest.raises(QuotaTopologyError, match="< 0"):
+            g.validate_add(QuotaSpec(name="neg", min={R.CPU: -1},
+                                     max={R.CPU: 100}))
+        with pytest.raises(QuotaTopologyError, match="min > max"):
+            g.validate_add(QuotaSpec(name="inv", min={R.CPU: 200},
+                                     max={R.CPU: 100}))
+
+    def test_parent_checks(self):
+        g = self._guard()
+        with pytest.raises(QuotaTopologyError, match="not found"):
+            g.validate_add(QuotaSpec(name="orphan", parent="ghost",
+                                     min={R.CPU: 1}, max={R.CPU: 1},
+                                     is_parent=True))
+        g.validate_add(QuotaSpec(name="leaf", parent="parent",
+                                 min={R.CPU: 1000}, max={R.CPU: 20000}))
+        with pytest.raises(QuotaTopologyError, match="not a parent"):
+            g.validate_add(QuotaSpec(name="under-leaf", parent="leaf",
+                                     min={R.CPU: 1}, max={R.CPU: 20000},
+                                     is_parent=True))
+
+    def test_sibling_min_sum_capped_by_parent(self):
+        g = self._guard()
+        g.validate_add(QuotaSpec(name="a", parent="parent",
+                                 min={R.CPU: 6000}, max={R.CPU: 20000}))
+        with pytest.raises(QuotaTopologyError, match="brothers"):
+            g.validate_add(QuotaSpec(name="b", parent="parent",
+                                     min={R.CPU: 6000}, max={R.CPU: 20000}))
+        g.validate_add(QuotaSpec(name="b", parent="parent",
+                                 min={R.CPU: 4000}, max={R.CPU: 20000}))
+
+    def test_max_keys_must_match_parent(self):
+        g = self._guard()
+        with pytest.raises(QuotaTopologyError, match="max keys"):
+            g.validate_add(
+                QuotaSpec(name="c", parent="parent",
+                          min={R.CPU: 100},
+                          max={R.CPU: 20000, R.MEMORY: 1024})
+            )
+
+    def test_delete_with_children_forbidden(self):
+        g = self._guard()
+        g.validate_add(QuotaSpec(name="kid", parent="parent",
+                                 min={R.CPU: 100}, max={R.CPU: 20000}))
+        with pytest.raises(QuotaTopologyError, match="children"):
+            g.validate_delete("parent")
+        g.validate_delete("kid")
+        g.validate_delete("parent")
+
+    def test_tree_id_immutable_on_update(self):
+        g = self._guard()
+        with pytest.raises(QuotaTopologyError, match="immutable"):
+            g.validate_update(
+                QuotaSpec(name="parent", is_parent=True, tree_id="other",
+                          min={R.CPU: 10000}, max={R.CPU: 20000})
+            )
