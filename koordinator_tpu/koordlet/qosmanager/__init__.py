@@ -6,6 +6,11 @@ from koordinator_tpu.koordlet.qosmanager.framework import (
 from koordinator_tpu.koordlet.qosmanager.cpusuppress import CPUSuppress
 from koordinator_tpu.koordlet.qosmanager.evictors import CPUEvictor, MemoryEvictor
 from koordinator_tpu.koordlet.qosmanager.cpuburst import CPUBurst
+from koordinator_tpu.koordlet.qosmanager.resctrl import ResctrlReconcile
+from koordinator_tpu.koordlet.qosmanager.cgreconcile import (
+    CgroupResourcesReconcile,
+)
+from koordinator_tpu.koordlet.qosmanager.blkio import BlkIOReconcile
 
 __all__ = [
     "CPUInfo",
@@ -15,4 +20,7 @@ __all__ = [
     "CPUEvictor",
     "MemoryEvictor",
     "CPUBurst",
+    "ResctrlReconcile",
+    "CgroupResourcesReconcile",
+    "BlkIOReconcile",
 ]

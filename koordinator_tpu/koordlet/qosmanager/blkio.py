@@ -1,0 +1,77 @@
+"""BlkIOReconcile: block-device throttling per QoS tier and pod.
+
+Reference: pkg/koordlet/qosmanager/plugins/blkio/blkio_reconcile.go — the
+NodeSLO's per-QoS BlkIOQOS block configs become
+``blkio.throttle.{read,write}_{bps,iops}_device`` writes on the QoS tier
+cgroup dir and every member pod's dir (:106-243, updateBlkIOConfig;
+getBlkIOUpdaterFromBlockCfg :311-373). The reference resolves volume
+groups/pod volumes to disk numbers on the host; the typed model addresses
+devices by MAJ:MIN directly. A zero limit removes the throttle (writes
+``MAJ:MIN 0`` → kernel clears, matching getBlkIORemoverFromDiskNumber).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.koordlet.qosmanager.framework import QoSContext
+from koordinator_tpu.koordlet.resourceexecutor.executor import CgroupUpdater
+from koordinator_tpu.manager.sloconfig import BlockCfg
+
+_QOS_DIR = {
+    QoSClass.BE: "kubepods/besteffort",
+    QoSClass.LS: "kubepods/burstable",
+}
+
+_FILES = (
+    ("blkio.throttle.read_bps_device", "read_bps"),
+    ("blkio.throttle.write_bps_device", "write_bps"),
+    ("blkio.throttle.read_iops_device", "read_iops"),
+    ("blkio.throttle.write_iops_device", "write_iops"),
+)
+
+
+def block_updaters(parent_dir: str, block: BlockCfg) -> List[CgroupUpdater]:
+    """The four throttle writes for one device on one cgroup dir."""
+    out = []
+    for resource_type, field_name in _FILES:
+        value = getattr(block, field_name)
+        out.append(
+            CgroupUpdater(
+                resource_type,
+                parent_dir,
+                f"{block.device} {value}",
+                key_extra=block.device,  # one cache entry per device
+            )
+        )
+    return out
+
+
+class BlkIOReconcile:
+    name = "blkio"
+    interval_seconds = 10.0
+
+    def enabled(self, ctx: QoSContext) -> bool:
+        strategy = ctx.node_slo.resource_qos_strategy
+        return any(
+            strategy.for_qos(q).blkio for q in (QoSClass.LS, QoSClass.BE)
+        )
+
+    def execute(self, ctx: QoSContext, now: float) -> None:
+        strategy = ctx.node_slo.resource_qos_strategy
+        updates: List[CgroupUpdater] = []
+        for qos, tier_dir in _QOS_DIR.items():
+            blocks = strategy.for_qos(qos).blkio
+            for block in blocks:
+                updates += block_updaters(tier_dir, block)
+            if not blocks:
+                continue
+            for pod in ctx.pod_provider.running_pods():
+                if pod.qos != qos:
+                    continue
+                for block in blocks:
+                    updates += block_updaters(pod.cgroup_dir, block)
+        for up in updates:
+            ctx.executor.update(True, up)
+            ctx.log("blkio", up.parent_dir, up.resource_type, up.value)

@@ -112,6 +112,9 @@ class CgroupUpdater:
     parent_dir: str
     value: str
     merge_condition: Optional[MergeCondition] = None
+    #: extra cache-key component for files holding multiple independent
+    #: entries (device-keyed blkio throttles: one key per device)
+    key_extra: str = ""
 
     def resource(self) -> CgroupResource:
         return get_resource(self.resource_type)
@@ -120,7 +123,8 @@ class CgroupUpdater:
         # keyed by resource type AND path: distinct resources can share a
         # packed v2 file (cpu.cfs_quota_us and cpu.cfs_period_us both map
         # to cpu.max) and must not collide in the cache
-        return f"{self.resource_type}:{self.resource().path(self.parent_dir, cfg)}"
+        base = f"{self.resource_type}:{self.resource().path(self.parent_dir, cfg)}"
+        return f"{base}:{self.key_extra}" if self.key_extra else base
 
 
 class ResourceUpdateExecutor:

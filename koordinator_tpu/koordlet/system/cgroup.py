@@ -329,7 +329,7 @@ MEMORY_LOW = CgroupResource(
 )
 MEMORY_HIGH = CgroupResource(
     "memory.high", "memory", "memory.high", "memory.high",
-    validator=_natural_int64,
+    validator=lambda v: v == "max" or _natural_int64(v),
 )
 MEMORY_WMARK_RATIO = CgroupResource(
     "memory.wmark_ratio", "memory", "memory.wmark_ratio",
@@ -361,11 +361,68 @@ BLKIO_IO_WEIGHT = CgroupResource(
     validator=_range_validator(1, 100),
 )
 
+
+def _device_value(value: str) -> bool:
+    """"MAJ:MIN N" (or "MAJ:MIN max") device throttle entries."""
+    parts = value.split()
+    if len(parts) != 2 or ":" not in parts[0]:
+        return False
+    return parts[1] == "max" or parts[1].isdigit()
+
+
+def _io_max_encode(key: str):
+    """Pack a v1-style "MAJ:MIN N" throttle into the v2 ``io.max`` file,
+    merging with the other keys already present for the device."""
+
+    def enc(value: str, current: str) -> str:
+        dev, val = value.split()
+        entries: Dict[str, Dict[str, str]] = {}
+        for line in current.splitlines():
+            parts = line.split()
+            if not parts:
+                continue
+            entries[parts[0]] = dict(
+                kv.split("=", 1) for kv in parts[1:] if "=" in kv
+            )
+        entry = entries.setdefault(dev, {})
+        entry[key] = "max" if val in ("max", "-1", "0") else val
+        return "\n".join(
+            f"{d} " + " ".join(f"{k}={v}" for k, v in sorted(e.items()))
+            for d, e in sorted(entries.items())
+        )
+
+    return enc
+
+
+#: blkio throttling (reference: blkio_reconcile.go throttle files;
+#: cgroup v2 packs all four into io.max)
+BLKIO_READ_BPS = CgroupResource(
+    "blkio.throttle.read_bps_device", "blkio",
+    "blkio.throttle.read_bps_device", "io.max",
+    validator=_device_value, v2_encode=_io_max_encode("rbps"),
+)
+BLKIO_WRITE_BPS = CgroupResource(
+    "blkio.throttle.write_bps_device", "blkio",
+    "blkio.throttle.write_bps_device", "io.max",
+    validator=_device_value, v2_encode=_io_max_encode("wbps"),
+)
+BLKIO_READ_IOPS = CgroupResource(
+    "blkio.throttle.read_iops_device", "blkio",
+    "blkio.throttle.read_iops_device", "io.max",
+    validator=_device_value, v2_encode=_io_max_encode("riops"),
+)
+BLKIO_WRITE_IOPS = CgroupResource(
+    "blkio.throttle.write_iops_device", "blkio",
+    "blkio.throttle.write_iops_device", "io.max",
+    validator=_device_value, v2_encode=_io_max_encode("wiops"),
+)
+
 _KNOWN: List[CgroupResource] = [
     CPU_SHARES, CPU_CFS_QUOTA, CPU_CFS_PERIOD, CPU_BURST, CPU_BVT_WARP_NS,
     CPU_IDLE, CPU_SET, CPU_PROCS, MEMORY_LIMIT, MEMORY_MIN, MEMORY_LOW,
     MEMORY_HIGH, MEMORY_WMARK_RATIO, MEMORY_WMARK_SCALE_FACTOR,
     MEMORY_PRIORITY, MEMORY_OOM_GROUP, MEMORY_USAGE, BLKIO_IO_WEIGHT,
+    BLKIO_READ_BPS, BLKIO_WRITE_BPS, BLKIO_READ_IOPS, BLKIO_WRITE_IOPS,
     CPU_ACCT_USAGE,
 ]
 _BY_TYPE: Dict[str, CgroupResource] = {r.resource_type: r for r in _KNOWN}

@@ -174,11 +174,25 @@ class ResctrlQOS:
 
 
 @dataclasses.dataclass
+class BlockCfg:
+    """One throttled block device (reference: slov1alpha1.BlockCfg/
+    BlkIOQOS, blkio_reconcile.go:311-373 getBlkIOUpdaterFromBlockCfg).
+    Devices are addressed by their MAJ:MIN number; 0 = unlimited."""
+
+    device: str                 # "MAJ:MIN"
+    read_bps: int = 0
+    write_bps: int = 0
+    read_iops: int = 0
+    write_iops: int = 0
+
+
+@dataclasses.dataclass
 class QoSConfig:
     enable: bool = False
     cpu: CPUQOS = dataclasses.field(default_factory=CPUQOS)
     memory: MemoryQOS = dataclasses.field(default_factory=MemoryQOS)
     resctrl: ResctrlQOS = dataclasses.field(default_factory=ResctrlQOS)
+    blkio: List[BlockCfg] = dataclasses.field(default_factory=list)
 
 
 def default_qos_config(qos: QoSClass) -> QoSConfig:
