@@ -15,19 +15,27 @@ Package layout (mirrors the reference's component inventory, SURVEY.md §2):
 - ``state``       — the array substrate: cluster snapshots as dense arrays.
 - ``ops``         — pure jit-safe math: filter masks, scoring, bin-packing,
                     quota water-filling, gang feasibility.
-- ``parallel``    — mesh/sharding: pjit/shard_map solver over device meshes.
-- ``models``      — end-to-end solver pipelines ("flagship models"):
-                    placement, rebalance.
-- ``scheduler``   — scheduling framework (plugin extension points) + the
-                    seven reference plugins rebuilt on the array substrate.
+- ``parallel``    — mesh/sharding: the solver sharded over device meshes.
+- ``models``      — end-to-end solver pipelines: batched placement with
+                    the fine-grained propose/validate/refine loop.
+- ``scheduler``   — scheduling framework (plugin extension points), the
+                    seven reference plugins, preemption, reservation
+                    lifecycle, cache/monitor.
 - ``descheduler`` — load-aware rebalancing + migration controller.
 - ``manager``     — central controllers: node resource overcommit
-                    calculator, NodeSLO renderer, mutating webhooks.
-- ``koordlet``    — node agent: metric cache, collectors, QoS strategies,
-                    cgroup executor, prediction.
-- ``runtimeproxy``— CRI interposition skeleton.
-- ``utils``       — cpuset, sloconfig defaults, parallel helpers.
-- ``native``      — C++ perf/cgroup helpers loaded via ctypes (optional).
+                    calculator, NodeSLO renderer, collect policy.
+- ``webhook``     — admission: ClusterColocationProfile mutation, pod
+                    validation, quota topology guard.
+- ``quota``       — hierarchical quota core, multi-tree registry, profile
+                    controller.
+- ``numa``/``device``/``gang`` — fine-grained allocators + gang states.
+- ``koordlet``    — node agent: metric cache, collectors (incl. native
+                    CPI), QoS strategies, cgroup executor, runtimehooks,
+                    prediction, pleg, audit.
+- ``native``      — C++ perf-group reader bound via ctypes.
+- ``features``    — the three feature-gate registries.
+- ``cmd``         — component entry points (config objects + CLIs).
+- ``oracle``      — host-side reference-semantics oracles for testing.
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Component entry points: each binary-equivalent is constructible from a
+typed config object, with feature gates toggling subsystems.
+
+Reference: cmd/{koord-scheduler,koord-descheduler,koord-manager,koordlet}
+— cobra commands with component configs and --feature-gates. Here each
+module exposes ``*Config`` + ``build_*(config)`` (the Setup function) and
+a ``main(argv)`` flag parser; run as
+``python -m koordinator_tpu.cmd.<component> --help``.
+"""
+
+from koordinator_tpu.cmd.scheduler import SchedulerConfig, build_scheduler  # noqa: F401
+from koordinator_tpu.cmd.koordlet import KoordletConfig, build_koordlet  # noqa: F401
+from koordinator_tpu.cmd.manager import ManagerConfig, build_manager  # noqa: F401
+from koordinator_tpu.cmd.descheduler import (  # noqa: F401
+    DeschedulerConfig,
+    build_descheduler,
+)

@@ -1,0 +1,100 @@
+"""koord-descheduler entry point.
+
+Reference: cmd/koord-descheduler + pkg/descheduler/descheduler.go:46 —
+profiles of Deschedule/Balance plugins run on the descheduling interval;
+the LowNodeLoad balance plugin and the migration-evictor mode are the
+component config's knobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from koordinator_tpu.features import FeatureGate
+
+#: descheduler gates (the reference reuses the scheduler registry; the
+#: meaningful toggles here are the eviction mode and anomaly debounce)
+DESCHEDULER_GATES = FeatureGate({
+    "MigrationController": True,
+    "AnomalyDetection": True,
+})
+
+
+@dataclasses.dataclass
+class DeschedulerConfig:
+    feature_gates: str = ""
+    descheduling_interval_seconds: float = 120.0
+    #: LowNodeLoad thresholds (percent)
+    low_cpu_percent: int = 45
+    high_cpu_percent: int = 65
+    #: consecutive abnormal observations before eviction
+    anomaly_condition_count: int = 3
+    max_migrating_per_node: int = 2
+
+
+def build_descheduler(
+    config: DeschedulerConfig, gates: Optional[FeatureGate] = None
+):
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.descheduler.framework import (
+        Descheduler,
+        DirectEvictor,
+        MigrationEvictor,
+        Profile,
+    )
+    from koordinator_tpu.descheduler.loadaware import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+    )
+
+    from koordinator_tpu.descheduler.framework import EvictionLimiter
+    from koordinator_tpu.descheduler.loadaware import NodePool
+
+    gates = gates or DESCHEDULER_GATES
+    gates.set_from_spec(config.feature_gates)
+    pool = NodePool(
+        low_thresholds={ResourceName.CPU: config.low_cpu_percent},
+        high_thresholds={ResourceName.CPU: config.high_cpu_percent},
+        consecutive_abnormalities=(
+            config.anomaly_condition_count
+            if gates.enabled("AnomalyDetection")
+            else 1
+        ),
+    )
+    plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[pool]))
+    limiter = EvictionLimiter(max_per_node=config.max_migrating_per_node)
+    evictor = (
+        MigrationEvictor(limiter)
+        if gates.enabled("MigrationController")
+        else DirectEvictor(limiter)
+    )
+    return Descheduler(
+        profiles=[Profile(name="default", balance_plugins=[plugin])],
+        evictor=evictor,
+        descheduling_interval=config.descheduling_interval_seconds,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("koord-descheduler")
+    parser.add_argument("--feature-gates", default="")
+    parser.add_argument("--descheduling-interval", type=float, default=120.0)
+    args = parser.parse_args(argv)
+    descheduler = build_descheduler(
+        DeschedulerConfig(
+            feature_gates=args.feature_gates,
+            descheduling_interval_seconds=args.descheduling_interval,
+        )
+    )
+    print(
+        "koord-descheduler: profiles="
+        f"{[p.name for p in descheduler.profiles]}, "
+        f"interval={descheduler.descheduling_interval}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,202 @@
+"""koordlet entry point: the node agent daemon assembly.
+
+Reference: cmd/koordlet/main.go + pkg/koordlet/koordlet.go:70-126
+(NewDaemon wiring: executor → metriccache → statesinformer →
+metricsadvisor → predictServer → qosManager → runtimeHooks) with the
+koordlet feature gates (pkg/features/koordlet_features.go) toggling each
+collector/strategy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import List, Optional
+
+from koordinator_tpu.features import KOORDLET_GATES, FeatureGate
+
+
+@dataclasses.dataclass
+class KoordletConfig:
+    feature_gates: str = ""
+    cgroup_root: str = "/sys/fs/cgroup"
+    proc_root: str = "/proc"
+    use_cgroup_v2: bool = False
+    collect_interval_seconds: float = 1.0
+    reconcile_interval_seconds: float = 10.0
+    node_capacity_mcpu: int = 0
+    node_capacity_mem_mib: int = 0
+
+
+@dataclasses.dataclass
+class KoordletDaemon:
+    """The wired node agent (koordlet.go Daemon)."""
+
+    states_informer: object
+    metric_cache: object
+    metrics_advisor: object
+    qos_manager: object
+    predict_server: object
+    auditor: object
+    executor: object
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One daemon step: collect → predict → actuate."""
+        now = time.time() if now is None else now
+        self.metrics_advisor.tick(now)
+        self.qos_manager.tick(now)
+
+
+def build_koordlet(
+    config: KoordletConfig, gates: Optional[FeatureGate] = None
+) -> KoordletDaemon:
+    """NewDaemon (koordlet.go:70): every subsystem built, gates deciding
+    which collectors/strategies register."""
+    from koordinator_tpu.koordlet.audit import Auditor
+    from koordinator_tpu.koordlet.metriccache import MetricCache
+    from koordinator_tpu.koordlet.metricsadvisor.collectors import (
+        BEResourceCollector,
+        NodeResourceCollector,
+        PodResourceCollector,
+        PSICollector,
+        SysResourceCollector,
+    )
+    from koordinator_tpu.koordlet.metricsadvisor.framework import (
+        CollectorContext,
+        MetricsAdvisor,
+    )
+    from koordinator_tpu.koordlet.metricsadvisor.performance import (
+        PerformanceCollector,
+    )
+    from koordinator_tpu.koordlet.prediction import (
+        PeakPredictServer,
+        PredictionConfig,
+    )
+    from koordinator_tpu.koordlet.qosmanager import (
+        BlkIOReconcile,
+        CgroupResourcesReconcile,
+        CPUBurst,
+        CPUEvictor,
+        CPUSuppress,
+        MemoryEvictor,
+        QoSContext,
+        QoSManager,
+        ResctrlReconcile,
+    )
+    from koordinator_tpu.koordlet.resourceexecutor import (
+        ResourceUpdateExecutor,
+    )
+    from koordinator_tpu.koordlet.statesinformer import StatesInformer
+    from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+
+    gates = gates or KOORDLET_GATES
+    gates.set_from_spec(config.feature_gates)
+
+    system_config = SystemConfig(
+        cgroup_root=config.cgroup_root,
+        proc_root=config.proc_root,
+        use_cgroup_v2=config.use_cgroup_v2,
+    )
+    auditor = Auditor() if gates.enabled("AuditEvents") else None
+    executor = ResourceUpdateExecutor(system_config, auditor=auditor)
+    metric_cache = MetricCache()
+    # the informer IS the PodProvider (running_pods) for every subsystem
+    states_informer = StatesInformer()
+    pod_provider = states_informer
+    collector_ctx = CollectorContext(
+        metric_cache=metric_cache,
+        system_config=system_config,
+        pod_provider=pod_provider,
+    )
+    collectors: List[object] = [
+        NodeResourceCollector(),
+        PodResourceCollector(),
+        BEResourceCollector(),
+        SysResourceCollector(),
+    ]
+    if gates.enabled("PSICollector"):
+        collectors.append(PSICollector())
+    if gates.enabled("CPICollector"):
+        collectors.append(PerformanceCollector())
+    metrics_advisor = MetricsAdvisor(
+        collector_ctx, collectors,
+        interval_seconds=config.collect_interval_seconds,
+    )
+
+    predict_server = PeakPredictServer(PredictionConfig())
+
+    qos_ctx = QoSContext(
+        metric_cache=metric_cache,
+        executor=executor,
+        pod_provider=pod_provider,
+        system_config=system_config,
+        auditor=auditor,
+        node_capacity_mcpu=config.node_capacity_mcpu,
+        node_capacity_mem_mib=config.node_capacity_mem_mib,
+    )
+    strategies: List[object] = []
+    if gates.enabled("BECPUSuppress"):
+        strategies.append(CPUSuppress())
+    if gates.enabled("BECPUEvict"):
+        strategies.append(CPUEvictor())
+    if gates.enabled("BEMemoryEvict"):
+        strategies.append(MemoryEvictor())
+    if gates.enabled("CPUBurst"):
+        strategies.append(CPUBurst())
+    if gates.enabled("RdtResctrl"):
+        strategies.append(ResctrlReconcile())
+    if gates.enabled("CgroupReconcile"):
+        strategies.append(CgroupResourcesReconcile())
+    if gates.enabled("BlkIOReconcile"):
+        strategies.append(BlkIOReconcile())
+    qos_manager = QoSManager(qos_ctx, strategies)
+
+    # NodeSLO changes flow from the informer into the QoS strategies
+    from koordinator_tpu.koordlet.statesinformer.states_informer import (
+        StateKind,
+    )
+
+    states_informer.register_callback(
+        StateKind.NODE_SLO,
+        lambda kind, slo: setattr(qos_ctx, "node_slo", slo),
+    )
+
+    return KoordletDaemon(
+        states_informer=states_informer,
+        metric_cache=metric_cache,
+        metrics_advisor=metrics_advisor,
+        qos_manager=qos_manager,
+        predict_server=predict_server,
+        auditor=auditor,
+        executor=executor,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("koordlet")
+    parser.add_argument("--feature-gates", default="")
+    parser.add_argument("--cgroup-root", default="/sys/fs/cgroup")
+    parser.add_argument("--proc-root", default="/proc")
+    parser.add_argument("--cgroup-v2", action="store_true")
+    parser.add_argument("--collect-interval", type=float, default=1.0)
+    parser.add_argument("--once", action="store_true")
+    args = parser.parse_args(argv)
+    daemon = build_koordlet(
+        KoordletConfig(
+            feature_gates=args.feature_gates,
+            cgroup_root=args.cgroup_root,
+            proc_root=args.proc_root,
+            use_cgroup_v2=args.cgroup_v2,
+            collect_interval_seconds=args.collect_interval,
+        )
+    )
+    while True:
+        daemon.tick()
+        if args.once:
+            return 0
+        time.sleep(args.collect_interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,106 @@
+"""Feature gates: three registries toggling optional subsystems.
+
+Reference: pkg/features/ — manager/webhook gates (features.go:28-90),
+scheduler gates (scheduler_features.go:32-59), koordlet gates
+(koordlet_features.go:33-143 with defaults :154-173). Gates parse the
+k8s-style ``--feature-gates=Name=true,Other=false`` spec and components
+consult them at construction time.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping, Optional
+
+
+class FeatureGate:
+    """A mutable gate registry (componentbase featuregate.FeatureGate)."""
+
+    def __init__(self, defaults: Mapping[str, bool]):
+        self._defaults: Dict[str, bool] = dict(defaults)
+        self._overrides: Dict[str, bool] = {}
+
+    def known(self) -> Iterable[str]:
+        return sorted(self._defaults)
+
+    def enabled(self, feature: str) -> bool:
+        if feature not in self._defaults:
+            raise KeyError(f"unknown feature gate {feature!r}")
+        return self._overrides.get(feature, self._defaults[feature])
+
+    def set(self, feature: str, value: bool) -> None:
+        if feature not in self._defaults:
+            raise KeyError(f"unknown feature gate {feature!r}")
+        self._overrides[feature] = bool(value)
+
+    def set_from_spec(self, spec: str) -> None:
+        """Parse "A=true,B=false" (the --feature-gates flag format)."""
+        if not spec:
+            return
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"invalid feature gate spec {part!r}")
+            name, raw = part.split("=", 1)
+            raw = raw.strip().lower()
+            if raw not in ("true", "false"):
+                raise ValueError(f"invalid feature gate value {part!r}")
+            self.set(name.strip(), raw == "true")
+
+    def as_dict(self) -> Dict[str, bool]:
+        return {name: self.enabled(name) for name in self._defaults}
+
+
+#: koordlet gates (koordlet_features.go:154-173 defaults)
+KOORDLET_GATES = FeatureGate({
+    "AuditEvents": False,
+    "AuditEventsHTTPHandler": False,
+    "BECPUSuppress": True,
+    "BECPUManager": False,
+    "BECPUEvict": False,
+    "BEMemoryEvict": False,
+    "CPUBurst": True,
+    "SystemConfig": False,
+    "RdtResctrl": True,
+    "CgroupReconcile": False,
+    "NodeTopologyReport": True,
+    "Accelerators": False,
+    "CPICollector": False,
+    "Libpfm4": False,
+    "PSICollector": False,
+    "BlkIOReconcile": False,
+    "ColdPageCollector": False,
+    "HugePageReport": False,
+})
+
+#: manager/webhook gates (features.go:28-90)
+MANAGER_GATES = FeatureGate({
+    "PodMutatingWebhook": True,
+    "PodValidatingWebhook": True,
+    "ElasticMutatingWebhook": True,
+    "ElasticValidatingWebhook": True,
+    "NodeMutatingWebhook": False,
+    "NodeValidatingWebhook": False,
+    "ConfigMapValidatingWebhook": False,
+    "ColocationProfileSkipMutatingResources": False,
+    "WebhookFramework": True,
+    "MultiQuotaTree": False,
+    "ElasticQuotaIgnorePodOverhead": False,
+    "ElasticQuotaGuaranteeUsage": False,
+    "DisableDefaultQuota": False,
+    "SupportParentQuotaSubmitPod": False,
+    "DisablePVCReservation": False,
+})
+
+#: scheduler gates (scheduler_features.go:32-59)
+SCHEDULER_GATES = FeatureGate({
+    "CompatibleCSIStorageCapacity": False,
+    "DisableCSIStorageCapacityInformer": False,
+    "CompatiblePodDisruptionBudget": False,
+    "DisablePodDisruptionBudgetInformer": False,
+    "ResizePod": False,
+    #: TPU-native gates: the batched device solver vs incremental-only
+    "BatchedPlacement": True,
+    "ElasticQuotaPreemption": True,
+})

@@ -95,6 +95,11 @@ class PerformanceCollector:
                 try:
                     cycles, instr = source.read()
                 except PerfUnavailable:
+                    # dead fds (cgroup torn down & recreated): drop the
+                    # source so the next tick reopens it fresh
+                    self._sources.pop(key, None)
+                    self._last.pop(key, None)
+                    source.close()
                     continue
                 prev = self._last.get(key)
                 self._last[key] = (cycles, instr)

@@ -52,26 +52,45 @@ class BlkIOReconcile:
     name = "blkio"
     interval_seconds = 10.0
 
+    def __init__(self):
+        #: cgroup dir -> devices throttled by a previous pass; a device
+        #: that disappears from the config gets an explicit "dev 0"
+        #: remover write (reference: getBlkIORemoverFromDiskNumber)
+        self._applied: dict = {}
+
     def enabled(self, ctx: QoSContext) -> bool:
         strategy = ctx.node_slo.resource_qos_strategy
-        return any(
+        return bool(self._applied) or any(
             strategy.for_qos(q).blkio for q in (QoSClass.LS, QoSClass.BE)
         )
 
     def execute(self, ctx: QoSContext, now: float) -> None:
         strategy = ctx.node_slo.resource_qos_strategy
         updates: List[CgroupUpdater] = []
+        live: dict = {}
+
+        def throttle(parent_dir: str, blocks) -> None:
+            for block in blocks:
+                updates.extend(block_updaters(parent_dir, block))
+                live.setdefault(parent_dir, set()).add(block.device)
+
         for qos, tier_dir in _QOS_DIR.items():
             blocks = strategy.for_qos(qos).blkio
-            for block in blocks:
-                updates += block_updaters(tier_dir, block)
             if not blocks:
                 continue
+            throttle(tier_dir, blocks)
             for pod in ctx.pod_provider.running_pods():
-                if pod.qos != qos:
-                    continue
-                for block in blocks:
-                    updates += block_updaters(pod.cgroup_dir, block)
+                if pod.qos == qos:
+                    throttle(pod.cgroup_dir, blocks)
+
+        # stale devices: explicitly clear the kernel throttle
+        for parent_dir, devices in self._applied.items():
+            for device in devices - live.get(parent_dir, set()):
+                updates.extend(
+                    block_updaters(parent_dir, BlockCfg(device=device))
+                )
+        self._applied = live
+
         for up in updates:
             ctx.executor.update(True, up)
             ctx.log("blkio", up.parent_dir, up.resource_type, up.value)

@@ -63,7 +63,7 @@ class CgroupResourcesReconcile:
         updates: List[CgroupUpdater] = []
         for pod in ctx.pod_provider.running_pods():
             cfg = strategy.for_qos(pod.qos)
-            if not cfg.enable:
+            if not cfg.enable or cfg.memory is None:
                 continue
             mem = cfg.memory
             # PodMeta carries pod-level requests (the reference iterates

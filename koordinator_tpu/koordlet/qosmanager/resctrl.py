@@ -24,6 +24,7 @@ from koordinator_tpu.koordlet.system.resctrl import (
     ResctrlFS,
     calculate_cat_l3_mask,
     calculate_mba,
+    detect_vendor,
 )
 
 _QOS_TO_GROUP = {
@@ -49,9 +50,18 @@ class ResctrlReconcile:
     name = "resctrl"
     interval_seconds = 10.0
 
-    def __init__(self, fs: Optional[ResctrlFS] = None, vendor: str = "intel"):
+    def __init__(self, fs: Optional[ResctrlFS] = None,
+                 vendor: Optional[str] = None):
         self._fs = fs
+        #: None = detect from /proc/cpuinfo at first execute (AMD's MBA
+        #: takes absolute MBps, Intel's takes percent — writing the wrong
+        #: convention throttles drastically)
         self.vendor = vendor
+
+    def _vendor_for(self, ctx: QoSContext) -> str:
+        if self.vendor is None:
+            self.vendor = detect_vendor(ctx.system_config.proc_root)
+        return self.vendor
 
     def _fs_for(self, ctx: QoSContext) -> ResctrlFS:
         # bind to the context's SystemConfig unless explicitly injected,
@@ -108,7 +118,7 @@ class ResctrlReconcile:
             ctx.log("resctrl", group, "schemata", line)
 
     def _apply_mb(self, ctx, group, cache_ids, resctrl) -> None:
-        value = calculate_mba(resctrl.mba_percent, self.vendor)
+        value = calculate_mba(resctrl.mba_percent, self._vendor_for(ctx))
         line = "MB:" + ";".join(f"{i}={value}" for i in cache_ids)
         if self.fs.write_schemata_line(group, line):
             ctx.log("resctrl", group, "schemata", line)
@@ -132,17 +142,29 @@ class ResctrlReconcile:
                     continue
 
     def _pod_task_ids(self, ctx: QoSContext, pod) -> List[int]:
+        """Thread-level task ids: the resctrl tasks file moves exactly the
+        written TID, so worker threads must be moved individually — read
+        the cgroup's thread-level files first (v1 ``tasks``, v2
+        ``cgroup.threads``), falling back to ``cgroup.procs`` (leaders
+        only) when absent."""
         tids: List[int] = []
         dirs = [pod.cgroup_dir] + list(pod.containers.values())
         root = ctx.system_config.cgroup_root
-        sub = "" if ctx.system_config.use_cgroup_v2 else "cpu"
+        if ctx.system_config.use_cgroup_v2:
+            sub, names = "", ("cgroup.threads", "cgroup.procs")
+        else:
+            sub, names = "cpu", ("tasks", "cgroup.procs")
         for d in dirs:
-            path = os.path.join(root, sub, d, "cgroup.procs")
-            if not os.path.exists(path):
-                continue
-            try:
-                with open(path) as f:
-                    tids.extend(int(x) for x in f.read().split() if x.strip())
-            except (OSError, ValueError):
-                continue
+            for name in names:
+                path = os.path.join(root, sub, d, name)
+                if not os.path.exists(path):
+                    continue
+                try:
+                    with open(path) as f:
+                        tids.extend(
+                            int(x) for x in f.read().split() if x.strip()
+                        )
+                except (OSError, ValueError):
+                    pass
+                break  # thread-level file found: don't double-read procs
         return sorted(set(tids))

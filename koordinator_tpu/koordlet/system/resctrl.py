@@ -28,6 +28,19 @@ AMD_CCD_MAX_MB_GBPS = 25 * 1024
 AMD_CCD_UNLIMITED_MB = "2048000"
 
 
+def detect_vendor(proc_root: str = "/proc") -> str:
+    """"amd" | "intel" from /proc/cpuinfo vendor_id (GenuineIntel /
+    AuthenticAMD); unknown vendors use Intel percent semantics."""
+    try:
+        with open(os.path.join(proc_root, "cpuinfo")) as f:
+            for line in f:
+                if line.startswith("vendor_id"):
+                    return "amd" if "AuthenticAMD" in line else "intel"
+    except OSError:
+        pass
+    return "intel"
+
+
 def resctrl_root(cfg: Optional[SystemConfig] = None) -> str:
     cfg = cfg or CONFIG
     # tests place a fake resctrl tree next to the fake cgroup root

@@ -1,5 +1,6 @@
 """koord-manager: central controllers (noderesource overcommit, nodemetric
-collect policy, nodeslo strategy rendering) + admission webhooks.
+collect policy, nodeslo strategy rendering); the admission webhooks it
+serves live in ``koordinator_tpu.webhook`` (wired via ``cmd.manager``).
 
 Reference layout: cmd/koord-manager + pkg/slo-controller (§2.3 of
 SURVEY.md). The reconcile loops here are batched: instead of one

@@ -30,10 +30,21 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from typing import TYPE_CHECKING
+
 from koordinator_tpu.apis.extension import NUM_RESOURCES
 from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
 from koordinator_tpu.numa.hints import NUMATopologyPolicy
-from koordinator_tpu.scheduler.framework import CycleState
+
+if TYPE_CHECKING:  # pragma: no cover
+    from koordinator_tpu.scheduler.framework import CycleState
+
+
+def _cycle_state():
+    # imported lazily: scheduler <-> models would otherwise be a cycle
+    from koordinator_tpu.scheduler.framework import CycleState
+
+    return CycleState()
 
 
 class FineGrained:
@@ -148,7 +159,7 @@ class FineGrained:
         n = len(nodes)
         mask = np.ones(n, bool)
         score = np.zeros(n, np.int32)
-        state = CycleState()
+        state = _cycle_state()
         for plugin in self._plugins():
             if not plugin.pre_filter(state, snapshot, pod).ok:
                 return np.zeros(n, bool), score
@@ -173,7 +184,7 @@ class FineGrained:
         """Reserve the pod's fine-grained allocation on the real managers
         (the incremental Reserve). Returns (ok, cycle_state); on failure
         everything is rolled back."""
-        state = CycleState()
+        state = _cycle_state()
         plugins = self._plugins()
         for plugin in plugins:
             if not plugin.pre_filter(state, snapshot, pod).ok:
@@ -190,14 +201,14 @@ class FineGrained:
 
     def rollback(
         self, snapshot: ClusterSnapshot, pod: PodSpec, node: NodeSpec,
-        state: CycleState,
+        state: "CycleState",
     ) -> None:
         for plugin in reversed(self._plugins()):
             plugin.unreserve(state, snapshot, pod, node)
 
     def pre_bind(
         self, snapshot: ClusterSnapshot, pod: PodSpec, node: NodeSpec,
-        state: CycleState,
+        state: "CycleState",
     ) -> None:
         """Write the allocation annotations onto the pod (the incremental
         PreBind: resource-status cpuset + device allocation JSON)."""

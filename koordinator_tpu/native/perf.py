@@ -45,7 +45,13 @@ def _load() -> ctypes.CDLL:
     global _lib
     with _lock:
         if _lib is None:
-            lib = ctypes.CDLL(ensure_built())
+            try:
+                path = ensure_built()
+            except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+                # no compiler / compile error: perf is host-unavailable,
+                # not a transient per-container condition
+                raise PerfUnavailable(f"native perf build failed: {e}") from e
+            lib = ctypes.CDLL(path)
             lib.kp_open.restype = ctypes.c_void_p
             lib.kp_open.argtypes = [
                 ctypes.c_int, ctypes.c_int, ctypes.c_ulong,

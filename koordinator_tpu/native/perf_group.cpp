@@ -54,7 +54,9 @@ static int kp_perf_open(unsigned int config, int pid, int cpu, int group_fd,
     attr.size = sizeof(attr);
     attr.config = config;
     attr.disabled = (group_fd == -1) ? 1 : 0;  // group starts disabled
-    attr.inherit = 1;
+    // NOTE: inherit must stay 0 — the kernel rejects inherit with
+    // PERF_FORMAT_GROUP (EINVAL since 4.13); cgroup-scoped per-cpu
+    // events don't need it anyway
     attr.read_format = PERF_FORMAT_GROUP | PERF_FORMAT_ID;
     attr.exclude_kernel = 1;  // unprivileged-friendly
     attr.exclude_hv = 1;

@@ -83,6 +83,9 @@ class Scheduler:
         self._waiting: Dict[str, str] = {}
         #: when each waiting pod entered the Permit barrier (WaitTime expiry)
         self._waiting_since: Dict[str, float] = {}
+        #: BatchedPlacement feature gate: False falls back to per-pod
+        #: incremental cycles in schedule_pending
+        self.batched_placement = True
         #: waiting pods' fine-grained allocation state, annotated at the
         #: barrier (uid -> (node name, CycleState))
         self._fine_waiting: Dict[str, tuple] = {}
@@ -211,6 +214,8 @@ class Scheduler:
         at0 = now if now is not None else time.time()
         self.expire_waiting(at0)
         self.reservation_controller.sync(at0)
+        if not self.batched_placement:
+            return self._schedule_pending_incremental(now)
         snapshot = self.cache.snapshot(now=now)
         pending = {pod.uid: pod for pod in snapshot.pending_pods}
         result = self.model.schedule(snapshot)
@@ -237,6 +242,26 @@ class Scheduler:
         self._resolve_waiting(result)
         self._preempt_unplaced(result, pending, at)
         return result
+
+    def _schedule_pending_incremental(self, now: Optional[float]) -> ScheduleResult:
+        """BatchedPlacement=false fallback: one incremental cycle per
+        pending pod in schedule order (the reference's only mode)."""
+        from koordinator_tpu.state.cluster import schedule_order
+
+        pending = list(self.cache.pending.values())
+        order = schedule_order(pending)
+        assignments: Dict[str, Optional[str]] = {}
+        waiting: Dict[str, str] = {}
+        for idx in order:
+            pod = pending[idx]
+            outcome = self.schedule_one(pod.uid, now=now)
+            if outcome.status == "bound":
+                assignments[pod.uid] = outcome.node
+            elif outcome.status == "waiting":
+                waiting[pod.uid] = outcome.node
+            else:
+                assignments[pod.uid] = None
+        return ScheduleResult(assignments, waiting=waiting)
 
     #: at most this many preemption scans per batched round
     MAX_PREEMPTIONS_PER_ROUND = 32

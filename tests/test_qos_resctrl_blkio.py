@@ -297,3 +297,37 @@ class TestNativePerf:
         transient.setup(ctx)
         transient.collect(now=1.0)
         assert transient.enabled()  # retried next tick
+
+
+class TestBlkIOStaleRemoval:
+    def test_deleted_block_cfg_clears_throttle(self, tmp_path):
+        slo = NodeSLOSpec(
+            resource_qos_strategy=ResourceQOSStrategy(
+                be=QoSConfig(enable=True,
+                             blkio=[BlockCfg(device="253:0", read_bps=1000)])
+            )
+        )
+        ctx = make_ctx(tmp_path, [], slo=slo)
+        strategy = BlkIOReconcile()
+        strategy.execute(ctx, now=1.0)
+        root = ctx.system_config.cgroup_root
+        path = os.path.join(root, "blkio", "kubepods/besteffort",
+                            "blkio.throttle.read_bps_device")
+        assert open(path).read() == "253:0 1000"
+
+        # config removed: the next pass writes the remover and the
+        # strategy stays enabled for that pass
+        ctx.node_slo.resource_qos_strategy.be.blkio = []
+        assert strategy.enabled(ctx)
+        strategy.execute(ctx, now=2.0)
+        assert open(path).read() == "253:0 0"
+
+
+def test_vendor_detection(tmp_path):
+    from koordinator_tpu.koordlet.system.resctrl import detect_vendor
+
+    (tmp_path / "cpuinfo").write_text("vendor_id\t: AuthenticAMD\n")
+    assert detect_vendor(str(tmp_path)) == "amd"
+    (tmp_path / "cpuinfo").write_text("vendor_id\t: GenuineIntel\n")
+    assert detect_vendor(str(tmp_path)) == "intel"
+    assert detect_vendor(str(tmp_path / "missing")) == "intel"
