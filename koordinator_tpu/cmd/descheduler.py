@@ -52,7 +52,7 @@ def build_descheduler(
     from koordinator_tpu.descheduler.framework import EvictionLimiter
     from koordinator_tpu.descheduler.loadaware import NodePool
 
-    gates = gates or DESCHEDULER_GATES
+    gates = gates or DESCHEDULER_GATES.copy()
     gates.set_from_spec(config.feature_gates)
     pool = NodePool(
         low_thresholds={ResourceName.CPU: config.low_cpu_percent},

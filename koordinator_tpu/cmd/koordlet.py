@@ -40,12 +40,40 @@ class KoordletDaemon:
     predict_server: object
     auditor: object
     executor: object
+    collector_ctx: object = None
 
     def tick(self, now: Optional[float] = None) -> None:
         """One daemon step: collect → predict → actuate."""
         now = time.time() if now is None else now
         self.metrics_advisor.tick(now)
+        self._feed_predictor(now)
         self.qos_manager.tick(now)
+
+    def _feed_predictor(self, now: float) -> None:
+        """Stream the latest usage samples into the peak predictor
+        (predict_server.go's informer subscription)."""
+        ctx = self.collector_ctx
+        if ctx is None:
+            return
+        from koordinator_tpu.koordlet.prediction.predict_server import (
+            NODE_KEY,
+        )
+
+        node_usage = ctx.latest_node_usage
+        if node_usage:
+            self.predict_server.update(
+                NODE_KEY,
+                node_usage.get("cpu", 0.0),
+                node_usage.get("memory", 0.0),
+                now,
+            )
+        for uid, usage in ctx.latest_pod_usage.items():
+            self.predict_server.update(
+                f"pod/{uid}",
+                usage.get("cpu", 0.0),
+                usage.get("memory", 0.0),
+                now,
+            )
 
 
 def build_koordlet(
@@ -90,7 +118,7 @@ def build_koordlet(
     from koordinator_tpu.koordlet.statesinformer import StatesInformer
     from koordinator_tpu.koordlet.system.cgroup import SystemConfig
 
-    gates = gates or KOORDLET_GATES
+    gates = gates or KOORDLET_GATES.copy()
     gates.set_from_spec(config.feature_gates)
 
     system_config = SystemConfig(
@@ -150,6 +178,9 @@ def build_koordlet(
         strategies.append(CgroupResourcesReconcile())
     if gates.enabled("BlkIOReconcile"):
         strategies.append(BlkIOReconcile())
+    for strategy in strategies:
+        if strategy.name in ("resctrl", "cgreconcile", "blkio"):
+            strategy.interval_seconds = config.reconcile_interval_seconds
     qos_manager = QoSManager(qos_ctx, strategies)
 
     # NodeSLO changes flow from the informer into the QoS strategies
@@ -170,6 +201,7 @@ def build_koordlet(
         predict_server=predict_server,
         auditor=auditor,
         executor=executor,
+        collector_ctx=collector_ctx,
     )
 
 

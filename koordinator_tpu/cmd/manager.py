@@ -54,7 +54,7 @@ def build_manager(config: ManagerConfig, gates: Optional[FeatureGate] = None) ->
         QuotaTopologyGuard,
     )
 
-    gates = gates or MANAGER_GATES
+    gates = gates or MANAGER_GATES.copy()
     gates.set_from_spec(config.feature_gates)
     return Manager(
         noderesource=NodeResourceController(),

@@ -34,7 +34,7 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
     from koordinator_tpu.ops.binpack import SolverConfig
     from koordinator_tpu.scheduler import Scheduler
 
-    gates = gates or SCHEDULER_GATES
+    gates = gates or SCHEDULER_GATES.copy()
     gates.set_from_spec(config.feature_gates)
     model = PlacementModel(
         config=SolverConfig(
@@ -43,9 +43,10 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
             score_according_prod=config.score_according_prod,
         )
     )
-    scheduler = Scheduler(model=model, cluster_total=config.cluster_total)
-    scheduler._quota_plugin.enable_preemption = gates.enabled(
-        "ElasticQuotaPreemption"
+    scheduler = Scheduler(
+        model=model,
+        cluster_total=config.cluster_total,
+        enable_preemption=gates.enabled("ElasticQuotaPreemption"),
     )
     #: gate off the batched device path: schedule_pending falls back to
     #: per-pod incremental cycles

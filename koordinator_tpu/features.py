@@ -33,9 +33,11 @@ class FeatureGate:
         self._overrides[feature] = bool(value)
 
     def set_from_spec(self, spec: str) -> None:
-        """Parse "A=true,B=false" (the --feature-gates flag format)."""
+        """Parse "A=true,B=false" (the --feature-gates flag format).
+        Atomic: an invalid spec leaves the registry untouched."""
         if not spec:
             return
+        parsed: Dict[str, bool] = {}
         for part in spec.split(","):
             part = part.strip()
             if not part:
@@ -43,10 +45,20 @@ class FeatureGate:
             if "=" not in part:
                 raise ValueError(f"invalid feature gate spec {part!r}")
             name, raw = part.split("=", 1)
+            name = name.strip()
             raw = raw.strip().lower()
             if raw not in ("true", "false"):
                 raise ValueError(f"invalid feature gate value {part!r}")
-            self.set(name.strip(), raw == "true")
+            if name not in self._defaults:
+                raise KeyError(f"unknown feature gate {name!r}")
+            parsed[name] = raw == "true"
+        self._overrides.update(parsed)
+
+    def copy(self) -> "FeatureGate":
+        """A fresh gate with this registry's effective values as defaults
+        (builders copy the module registry so per-build --feature-gates
+        overrides never leak across builds)."""
+        return FeatureGate(self.as_dict())
 
     def as_dict(self) -> Dict[str, bool]:
         return {name: self.enabled(name) for name in self._defaults}

@@ -1,8 +1,21 @@
 from koordinator_tpu.koordlet.statesinformer.states_informer import (
+    StateKind,
     StatesInformer,
 )
 from koordinator_tpu.koordlet.statesinformer.nodemetric_reporter import (
     NodeMetricReporter,
 )
+from koordinator_tpu.koordlet.statesinformer.reporters import (
+    DeviceReporter,
+    NodeTopologyReporter,
+    PodsInformer,
+)
 
-__all__ = ["StatesInformer", "NodeMetricReporter"]
+__all__ = [
+    "StateKind",
+    "StatesInformer",
+    "NodeMetricReporter",
+    "DeviceReporter",
+    "NodeTopologyReporter",
+    "PodsInformer",
+]

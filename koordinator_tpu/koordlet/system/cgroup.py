@@ -40,6 +40,7 @@ class SystemConfig:
 
     cgroup_root: str = "/sys/fs/cgroup"
     proc_root: str = "/proc"
+    sysfs_root: str = "/sys"
     use_cgroup_v2: bool = False
     #: cgroup path prefix for the kubepods hierarchy
     kubepods_dir: str = "kubepods"

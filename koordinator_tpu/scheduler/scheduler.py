@@ -67,6 +67,7 @@ class Scheduler:
         self,
         model: Optional[PlacementModel] = None,
         cluster_total=None,
+        enable_preemption: bool = True,
     ):
         self.cache = SchedulerCache()
         self.quota_registry = QuotaTreeRegistry(cluster_total=cluster_total or {})
@@ -94,7 +95,9 @@ class Scheduler:
         self._resv_waiting: Dict[str, tuple] = {}
         self.reservation_controller = ReservationController(self.cache)
 
-        self._quota_plugin = ElasticQuotaPlugin(self.quota_registry)
+        self._quota_plugin = ElasticQuotaPlugin(
+            self.quota_registry, enable_preemption=enable_preemption
+        )
         self._coscheduling = CoschedulingPlugin(
             self.gang_manager,
             on_release=self._on_gang_release,

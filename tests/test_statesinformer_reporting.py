@@ -1,0 +1,170 @@
+"""statesinformer reporting pipeline (VERDICT weak item 5): kubelet-style
+pod source, NodeResourceTopology + Device reporting feeding the
+scheduler's NUMA/DeviceShare plugins end-to-end.
+
+Reference: pkg/koordlet/statesinformer/impl/{kubelet_stub.go,
+states_noderesourcetopology.go,states_device_linux.go}.
+"""
+
+import json
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_RESOURCE_STATUS,
+    QoSClass,
+    ResourceName as R,
+)
+from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+from koordinator_tpu.device.cache import DeviceEntry, DeviceType
+from koordinator_tpu.device.cache import DeviceResourceName as DR
+from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+from koordinator_tpu.koordlet.statesinformer import (
+    DeviceReporter,
+    NodeTopologyReporter,
+    PodsInformer,
+    StatesInformer,
+)
+from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+from koordinator_tpu.koordlet.system.cpuinfo import (
+    parse_cpulist,
+    read_cpu_infos,
+)
+from koordinator_tpu.numa.hints import NUMATopologyPolicy
+from koordinator_tpu.scheduler import Scheduler
+
+
+def fake_proc_sys(tmp_path, sockets=1, cores=4, threads=2, numa_nodes=2):
+    """Fake /proc/cpuinfo + /sys NUMA cpulists."""
+    proc = tmp_path / "proc"
+    proc.mkdir(exist_ok=True)
+    n = sockets * cores * threads
+    blocks = []
+    for cpu in range(n):
+        core = cpu // threads
+        blocks.append(
+            f"processor\t: {cpu}\n"
+            f"physical id\t: {core // (cores // sockets) if sockets > 1 else 0}\n"
+            f"core id\t: {core}\n"
+        )
+    (proc / "cpuinfo").write_text("\n".join(blocks) + "\n")
+    per_node = n // numa_nodes
+    for node in range(numa_nodes):
+        d = tmp_path / "sys" / "devices" / "system" / "node" / f"node{node}"
+        d.mkdir(parents=True, exist_ok=True)
+        lo, hi = node * per_node, (node + 1) * per_node - 1
+        (d / "cpulist").write_text(f"{lo}-{hi}\n")
+    return SystemConfig(
+        proc_root=str(proc), sysfs_root=str(tmp_path / "sys"),
+        cgroup_root=str(tmp_path / "cg"),
+    )
+
+
+def test_parse_cpulist():
+    assert parse_cpulist("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+    assert parse_cpulist("") == []
+
+
+def test_read_cpu_infos(tmp_path):
+    cfg = fake_proc_sys(tmp_path)
+    infos = read_cpu_infos(cfg)
+    assert len(infos) == 8
+    assert infos[0].node_id == 0 and infos[7].node_id == 1
+    assert infos[0].core_id == infos[1].core_id  # hyperthreads share cores
+
+
+def test_pods_informer_publishes():
+    informer = StatesInformer()
+
+    class Stub:
+        def get_all_pods(self):
+            return [PodMeta(uid="p1", cgroup_dir="kubepods/podp1",
+                            qos=QoSClass.LS)]
+
+    pods = PodsInformer(Stub(), informer).sync()
+    assert [p.uid for p in informer.running_pods()] == ["p1"]
+    assert pods[0].uid == "p1"
+
+
+def test_topology_and_device_reporting_feed_scheduler(tmp_path):
+    """The full pipeline: koordlet discovers topology + devices and
+    reports; the scheduler then pins a cpuset pod and allocates a GPU on
+    that node — topology no longer appears 'by fiat'."""
+    cfg = fake_proc_sys(tmp_path)
+    s = Scheduler()
+    s.add_node(NodeSpec(name="node-a", allocatable={R.CPU: 8000, R.MEMORY: 32768}))
+    s.update_node_metric(
+        NodeMetric(node_name="node-a", node_usage={}, update_time=99.0)
+    )
+
+    nrt = NodeTopologyReporter(
+        node_name="node-a",
+        system_config=cfg,
+        report=s.update_node_topology,
+        policy=NUMATopologyPolicy.NONE,
+        numa_memory_mib={0: 16384, 1: 16384},
+    )
+    report = nrt.sync()
+    assert report is not None
+    opts = s.numa_manager.get_topology("node-a")
+    assert opts.cpu_topology is not None and opts.cpu_topology.num_cpus == 8
+    assert opts.numa_node_resources[0][R.CPU] == 4000
+    assert opts.numa_node_resources[1][R.MEMORY] == 16384
+
+    class GPUSource:
+        def list_devices(self):
+            return [
+                DeviceEntry(
+                    minor=i, device_type=DeviceType.GPU,
+                    resources={DR.GPU_CORE: 100, DR.GPU_MEMORY: 16384,
+                               DR.GPU_MEMORY_RATIO: 100},
+                    numa_node=0, pcie_id="0",
+                )
+                for i in range(2)
+            ]
+
+    DeviceReporter("node-a", GPUSource(), s.update_node_devices).sync()
+    assert s.device_cache.get("node-a") is not None
+
+    # a cpuset LSR pod pins onto the reported topology
+    s.add_pod(PodSpec(name="pin", qos=QoSClass.LSR, requests={R.CPU: 2000}))
+    # a GPU pod allocates from the reported inventory
+    s.add_pod(PodSpec(name="gpu", requests={R.CPU: 1000},
+                      device_requests={"nvidia.com/gpu": 1}))
+    out = s.schedule_pending(now=100.0)
+    assert out["default/pin"] == "node-a"
+    assert out["default/gpu"] == "node-a"
+    pin = s.cache.pods["default/pin"]
+    status = json.loads(pin.annotations[ANNOTATION_RESOURCE_STATUS])
+    assert len(status["cpuset"]) == 2
+    gpu_alloc = s.device_cache.get("node-a").allocations
+    assert "default/gpu" in gpu_alloc
+
+
+def test_offline_cpus_reserved_not_counted(tmp_path):
+    """Sparse cpu ids (offline cpus) must be reserved out, not reported
+    as phantom capacity (round-2 review fix)."""
+    from koordinator_tpu.koordlet.system.cpuinfo import ProcessorInfo
+
+    cfg = SystemConfig(proc_root=str(tmp_path), sysfs_root=str(tmp_path))
+    infos = [
+        ProcessorInfo(cpu_id=0, core_id=0, socket_id=0, node_id=0),
+        ProcessorInfo(cpu_id=1, core_id=0, socket_id=0, node_id=0),
+        ProcessorInfo(cpu_id=3, core_id=1, socket_id=0, node_id=0),  # cpu 2 offline
+    ]
+    reports = {}
+    nrt = NodeTopologyReporter(
+        "n", cfg, report=lambda name, opts: reports.update({name: opts}),
+        cpu_infos=infos,
+    )
+    nrt.sync()
+    opts = reports["n"]
+    assert opts.numa_node_resources[0][R.CPU] == 3000  # 3 real cpus
+    assert tuple(opts.reserved_cpus) == (2,)
+
+
+def test_gate_overrides_do_not_leak_between_builds():
+    from koordinator_tpu.cmd import SchedulerConfig, build_scheduler
+
+    s1 = build_scheduler(SchedulerConfig(feature_gates="BatchedPlacement=false"))
+    s2 = build_scheduler(SchedulerConfig())
+    assert not s1.batched_placement
+    assert s2.batched_placement  # default build unaffected (review fix)
