@@ -57,6 +57,7 @@ class KoordletDaemon:
             return
         from koordinator_tpu.koordlet.prediction.predict_server import (
             NODE_KEY,
+            pod_key,
         )
 
         node_usage = ctx.latest_node_usage
@@ -67,13 +68,18 @@ class KoordletDaemon:
                 node_usage.get("memory", 0.0),
                 now,
             )
+        live_keys = []
         for uid, usage in ctx.latest_pod_usage.items():
+            key = pod_key(uid)
+            live_keys.append(key)
             self.predict_server.update(
-                f"pod/{uid}",
+                key,
                 usage.get("cpu", 0.0),
                 usage.get("memory", 0.0),
                 now,
             )
+        # forget churned pods so predictor state stays bounded
+        self.predict_server.gc(live_keys)
 
 
 def build_koordlet(

@@ -100,6 +100,9 @@ class Evictor:
             return False
         self.limiter.note(node, namespace)
         self.evicted.append(pod)
+        from koordinator_tpu.metrics.components import PODS_EVICTED
+
+        PODS_EVICTED.inc({"strategy": reason or "unknown", "node": node})
         return True
 
     def _do_evict(self, snapshot, pod, reason) -> bool:
@@ -176,6 +179,9 @@ class Descheduler:
         """One descheduling cycle: every profile's Deschedule plugins,
         then its Balance plugins (reference: framework/runtime/
         framework.go RunDeschedulePlugins/RunBalancePlugins order)."""
+        from koordinator_tpu.metrics.components import DESCHEDULE_LOOP_DURATION
+
+        started = time.monotonic()
         self.evictor.limiter.reset_cycle()
         before = len(self.evictor.evicted)
         for profile in self.profiles:
@@ -183,6 +189,7 @@ class Descheduler:
                 plugin.deschedule(snapshot, self.evictor)
             for plugin in profile.balance_plugins:
                 plugin.balance(snapshot, self.evictor)
+        DESCHEDULE_LOOP_DURATION.observe(time.monotonic() - started)
         return self.evictor.evicted[before:]
 
     def maybe_run(self, snapshot: ClusterSnapshot, now: Optional[float] = None):

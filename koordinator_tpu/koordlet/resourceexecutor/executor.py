@@ -230,6 +230,9 @@ class ResourceUpdateExecutor:
         self.auditor.log(
             "resourceexecutor", path, "update", f"-> {content!r}"
         )
+        from koordinator_tpu.metrics.components import CGROUP_WRITES
+
+        CGROUP_WRITES.inc({"resource": updater.resource_type})
         return True
 
     def update_batch(self, cacheable: bool,

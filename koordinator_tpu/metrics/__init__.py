@@ -1,0 +1,20 @@
+"""Prometheus-style metrics: registries per component + merged gather.
+
+Reference: pkg/scheduler/metrics/, pkg/koordlet/metrics/ (internal +
+external registries merged by pkg/util/metrics/merged_gather.go),
+pkg/descheduler/metrics/.
+"""
+
+from koordinator_tpu.metrics.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MergedGatherer,
+    Registry,
+)
+from koordinator_tpu.metrics.components import (  # noqa: F401
+    DESCHEDULER_METRICS,
+    KOORDLET_EXTERNAL_METRICS,
+    KOORDLET_INTERNAL_METRICS,
+    SCHEDULER_METRICS,
+)

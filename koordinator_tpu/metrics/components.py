@@ -1,0 +1,95 @@
+"""Per-component metric sets.
+
+Reference metric names: pkg/scheduler/metrics/metrics.go,
+pkg/koordlet/metrics/{metrics,common,resource_summary,cpu_suppress,...}.go
+(internal vs external registries), pkg/descheduler/metrics/metrics.go.
+"""
+
+from __future__ import annotations
+
+from koordinator_tpu.metrics.registry import Registry
+
+# -- koord-scheduler (pkg/scheduler/metrics) --------------------------------
+
+SCHEDULER_METRICS = Registry("koord-scheduler")
+SCHEDULING_ATTEMPTS = SCHEDULER_METRICS.counter(
+    "scheduler_schedule_attempts_total",
+    "Scheduling attempts by result",
+    label_names=("result",),  # scheduled | unschedulable | error | nominated
+)
+E2E_SCHEDULING_DURATION = SCHEDULER_METRICS.histogram(
+    "scheduler_e2e_scheduling_duration_seconds",
+    "End-to-end scheduling latency per pod/batch",
+)
+PENDING_PODS = SCHEDULER_METRICS.gauge(
+    "scheduler_pending_pods", "Pods waiting to be scheduled",
+)
+BATCH_SOLVE_DURATION = SCHEDULER_METRICS.histogram(
+    "scheduler_batched_solve_duration_seconds",
+    "Device solve wall-clock per batched round (the jax-tpu backend)",
+)
+PREEMPTION_ATTEMPTS = SCHEDULER_METRICS.counter(
+    "scheduler_preemption_attempts_total",
+    "PostFilter preemption attempts",
+)
+GANG_REJECTIONS = SCHEDULER_METRICS.counter(
+    "scheduler_gang_rejections_total",
+    "Gang-group rejections (strict failures + WaitTime expiry)",
+)
+
+# -- koordlet (pkg/koordlet/metrics: internal + external sets) --------------
+
+KOORDLET_INTERNAL_METRICS = Registry("koordlet-internal")
+CGROUP_WRITES = KOORDLET_INTERNAL_METRICS.counter(
+    "koordlet_resource_executor_writes_total",
+    "Cgroup writes issued by the resource executor",
+    label_names=("resource",),
+)
+COLLECT_DURATION = KOORDLET_INTERNAL_METRICS.histogram(
+    "koordlet_collect_duration_seconds",
+    "Metrics-advisor collection pass latency",
+    label_names=("collector",),
+)
+PREDICT_DURATION = KOORDLET_INTERNAL_METRICS.histogram(
+    "koordlet_predict_duration_seconds",
+    "Peak predictor update latency",
+)
+
+KOORDLET_EXTERNAL_METRICS = Registry("koordlet-external")
+BE_SUPPRESS_CPU_CORES = KOORDLET_EXTERNAL_METRICS.gauge(
+    "koordlet_be_suppress_cpu_cores",
+    "Current BE CPU suppress target in cores",
+)
+POD_EVICTIONS = KOORDLET_EXTERNAL_METRICS.counter(
+    "koordlet_pod_evictions_total",
+    "Pods evicted by QoS strategies",
+    label_names=("reason",),
+)
+NODE_RESOURCE_ALLOCATABLE = KOORDLET_EXTERNAL_METRICS.gauge(
+    "koordlet_node_resource_allocatable",
+    "Reported node allocatable per resource",
+    label_names=("resource",),
+)
+CONTAINER_CPI_METRIC = KOORDLET_EXTERNAL_METRICS.gauge(
+    "koordlet_container_cpi",
+    "Latest cycles-per-instruction per container",
+    label_names=("pod", "container"),
+)
+
+# -- koord-descheduler (pkg/descheduler/metrics) ----------------------------
+
+DESCHEDULER_METRICS = Registry("koord-descheduler")
+PODS_EVICTED = DESCHEDULER_METRICS.counter(
+    "descheduler_pods_evicted_total",
+    "Pods evicted/migrated by descheduling",
+    label_names=("strategy", "node"),
+)
+DESCHEDULE_LOOP_DURATION = DESCHEDULER_METRICS.histogram(
+    "descheduler_loop_duration_seconds",
+    "One descheduling cycle's latency",
+)
+MIGRATION_JOBS = DESCHEDULER_METRICS.counter(
+    "descheduler_migration_jobs_total",
+    "PodMigrationJobs by phase transition",
+    label_names=("phase",),
+)

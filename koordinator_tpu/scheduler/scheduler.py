@@ -214,6 +214,12 @@ class Scheduler:
         """One batched round: expire stale state (gang WaitTime,
         reservations), solve the whole pending queue on device, and assume
         committed placements (and waiting holds) into the cache."""
+        from koordinator_tpu.metrics.components import (
+            BATCH_SOLVE_DURATION,
+            PENDING_PODS,
+            SCHEDULING_ATTEMPTS,
+        )
+
         at0 = now if now is not None else time.time()
         self.expire_waiting(at0)
         self.reservation_controller.sync(at0)
@@ -221,7 +227,14 @@ class Scheduler:
             return self._schedule_pending_incremental(now)
         snapshot = self.cache.snapshot(now=now)
         pending = {pod.uid: pod for pod in snapshot.pending_pods}
+        PENDING_PODS.set(len(pending))
+        solve_started = time.monotonic()
         result = self.model.schedule(snapshot)
+        BATCH_SOLVE_DURATION.observe(time.monotonic() - solve_started)
+        for uid, node in result.items():
+            SCHEDULING_ATTEMPTS.inc(
+                {"result": "scheduled" if node is not None else "unschedulable"}
+            )
         at = at0
         for uid, node in result.items():
             if node is not None:
@@ -285,6 +298,7 @@ class Scheduler:
         assigned = [p for p in snapshot.pods if p.preemptible]
         if not assigned:
             return
+        from koordinator_tpu.metrics.components import PREEMPTION_ATTEMPTS
         from koordinator_tpu.scheduler.preemption import ARRAYS_STATE_KEY
         from koordinator_tpu.state.cluster import lower_nodes
 
@@ -299,6 +313,7 @@ class Scheduler:
             if pod is None or pod.priority <= min_priority:
                 continue  # no strictly-lower-priority victim can exist
             attempts += 1
+            PREEMPTION_ATTEMPTS.inc()
             if arrays is None:
                 arrays = lower_nodes(snapshot)
             state = CycleState()
@@ -328,6 +343,8 @@ class Scheduler:
         core/gang.go:43-95 WaitTime, core/core.go:390-408). Returns the
         released pod uids; their held node/quota/fine-grained resources go
         back and the pods return to the pending queue."""
+        from koordinator_tpu.metrics.components import GANG_REJECTIONS
+
         released: List[str] = []
         for uid, since in list(self._waiting_since.items()):
             if uid not in self._waiting:
@@ -342,6 +359,7 @@ class Scheduler:
             wait_time = spec.wait_time if spec is not None else 600.0
             if not wait_time or (now - since) < wait_time:
                 continue
+            GANG_REJECTIONS.inc()
             # the timed-out pod plus (Strict mode) its whole gang group
             siblings = self.gang_manager.unreserve(uid)
             for r in {uid, *siblings}:
