@@ -52,54 +52,60 @@ class RuntimeHookServer:
 
     # -- pod events ----------------------------------------------------------
 
-    def run_pod_sandbox(self, pod: PodMeta, apply: bool = True) -> Resources:
+    def run_pod_sandbox(self, pod: PodMeta, apply: bool = True,
+                        policy: Optional[FailurePolicy] = None) -> Resources:
         ctx = PodContext.from_meta(pod)
         self.registry.run_hooks(
-            Stage.PRE_RUN_POD_SANDBOX, ctx, self.fail_policy
+            Stage.PRE_RUN_POD_SANDBOX, ctx, policy or self.fail_policy
         )
         return self._finish(ctx, apply)
 
-    def stop_pod_sandbox(self, pod: PodMeta, apply: bool = True) -> Resources:
+    def stop_pod_sandbox(self, pod: PodMeta, apply: bool = True,
+                         policy: Optional[FailurePolicy] = None) -> Resources:
         ctx = PodContext.from_meta(pod)
         self.registry.run_hooks(
-            Stage.POST_STOP_POD_SANDBOX, ctx, self.fail_policy
+            Stage.POST_STOP_POD_SANDBOX, ctx, policy or self.fail_policy
         )
         return self._finish(ctx, apply)
 
     # -- container events ----------------------------------------------------
 
     def create_container(
-        self, pod: PodMeta, container: str, apply: bool = True
+        self, pod: PodMeta, container: str, apply: bool = True,
+        policy: Optional[FailurePolicy] = None,
     ) -> Resources:
         ctx = ContainerContext.from_meta(pod, container)
         self.registry.run_hooks(
-            Stage.PRE_CREATE_CONTAINER, ctx, self.fail_policy
+            Stage.PRE_CREATE_CONTAINER, ctx, policy or self.fail_policy
         )
         return self._finish(ctx, apply)
 
     def start_container(
-        self, pod: PodMeta, container: str, apply: bool = True
+        self, pod: PodMeta, container: str, apply: bool = True,
+        policy: Optional[FailurePolicy] = None,
     ) -> Resources:
         ctx = ContainerContext.from_meta(pod, container)
         self.registry.run_hooks(
-            Stage.PRE_START_CONTAINER, ctx, self.fail_policy
+            Stage.PRE_START_CONTAINER, ctx, policy or self.fail_policy
         )
         return self._finish(ctx, apply)
 
     def update_container_resources(
-        self, pod: PodMeta, container: str, apply: bool = True
+        self, pod: PodMeta, container: str, apply: bool = True,
+        policy: Optional[FailurePolicy] = None,
     ) -> Resources:
         ctx = ContainerContext.from_meta(pod, container)
         self.registry.run_hooks(
-            Stage.PRE_UPDATE_CONTAINER_RESOURCES, ctx, self.fail_policy
+            Stage.PRE_UPDATE_CONTAINER_RESOURCES, ctx, policy or self.fail_policy
         )
         return self._finish(ctx, apply)
 
     def stop_container(
-        self, pod: PodMeta, container: str, apply: bool = True
+        self, pod: PodMeta, container: str, apply: bool = True,
+        policy: Optional[FailurePolicy] = None,
     ) -> Resources:
         ctx = ContainerContext.from_meta(pod, container)
         self.registry.run_hooks(
-            Stage.POST_STOP_CONTAINER, ctx, self.fail_policy
+            Stage.POST_STOP_CONTAINER, ctx, policy or self.fail_policy
         )
         return self._finish(ctx, apply)

@@ -1,0 +1,200 @@
+"""koord-runtime-proxy: CRI interposition (VERDICT missing item 8).
+
+Reference: pkg/runtimeproxy/server/cri/criserver.go (intercept + transparent
+pass-through + failOver), config.go failure policy, store/.
+"""
+
+import pytest
+
+from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+from koordinator_tpu.koordlet.runtimehooks.hooks import (
+    FailurePolicy,
+    HookRegistry,
+    Stage,
+)
+from koordinator_tpu.koordlet.runtimehooks.server import RuntimeHookServer
+from koordinator_tpu.runtimeproxy import (
+    CRIRequest,
+    RuntimeManagerCriServer,
+)
+
+
+class RecordingBackend:
+    """Fake containerd: records forwarded requests."""
+
+    def __init__(self, pods=()):
+        self.requests = []
+        self._pods = list(pods)
+
+    def handle(self, request):
+        self.requests.append(request)
+        return {"ok": True, "method": request.method}
+
+    def list_pods(self):
+        return self._pods
+
+
+def be_pod(uid="be1"):
+    return PodMeta(
+        uid=uid, cgroup_dir=f"kubepods/besteffort/pod{uid}",
+        qos=QoSClass.BE,
+        containers={"c0": f"kubepods/besteffort/pod{uid}/c0"},
+    )
+
+
+def hook_server(registry=None, policy=FailurePolicy.IGNORE):
+    return RuntimeHookServer(registry or HookRegistry(), fail_policy=policy)
+
+
+class TestIntercept:
+    def test_hooked_method_runs_hooks_and_forwards(self):
+        registry = HookRegistry()
+        seen = []
+
+        def set_shares(ctx):
+            seen.append(ctx.request.pod_meta.uid)
+            ctx.response.cpu_shares = 2
+
+        registry.register(Stage.PRE_CREATE_CONTAINER, "set-shares", "", set_shares)
+        backend = RecordingBackend()
+        proxy = RuntimeManagerCriServer(hook_server(registry), backend)
+        req = CRIRequest(method="CreateContainer", pod=be_pod(), container="c0")
+        out = proxy.intercept(req)
+        assert seen == ["be1"]
+        # hook response merged into the forwarded request
+        assert backend.requests[0].resources.cpu_shares == 2
+        assert out.backend_response["ok"]
+
+    def test_unknown_method_transparent(self):
+        backend = RecordingBackend()
+        proxy = RuntimeManagerCriServer(hook_server(), backend)
+        req = CRIRequest(method="ListImages")
+        out = proxy.intercept(req)
+        assert backend.requests == [req]
+        assert out.hook_response is None
+
+    def test_store_tracks_sandboxes(self):
+        backend = RecordingBackend()
+        proxy = RuntimeManagerCriServer(hook_server(), backend)
+        pod = be_pod()
+        proxy.intercept(CRIRequest(method="RunPodSandbox", pod=pod))
+        assert proxy.store.pod("be1") is pod
+        # a later call can resolve the pod from the store by uid
+        req = CRIRequest(method="UpdateContainerResources",
+                         container="c0", payload={"pod_uid": "be1"})
+        proxy.intercept(req)
+        assert backend.requests[-1] is req
+        proxy.intercept(CRIRequest(method="StopPodSandbox", pod=pod))
+        assert proxy.store.pod("be1") is None
+
+    def test_failure_policy_ignore_forwards_unmodified(self):
+        registry = HookRegistry()
+
+        def boom(ctx):
+            raise RuntimeError("hook down")
+
+        registry.register(Stage.PRE_CREATE_CONTAINER, "boom", "", boom)
+        backend = RecordingBackend()
+        proxy = RuntimeManagerCriServer(
+            hook_server(registry),
+            backend,
+            failure_policy=FailurePolicy.IGNORE,
+        )
+        req = CRIRequest(method="CreateContainer", pod=be_pod(), container="c0")
+        out = proxy.intercept(req)
+        assert out.backend_response["ok"]          # still forwarded
+        assert out.hook_response is None
+        assert backend.requests[0].resources.cpu_shares is None
+
+    def test_failure_policy_fail_raises(self):
+        """The PROXY's Fail policy governs even when the hook server was
+        built with its default Ignore policy (review fix)."""
+        registry = HookRegistry()
+
+        def boom(ctx):
+            raise RuntimeError("hook down")
+
+        registry.register(Stage.PRE_CREATE_CONTAINER, "boom", "", boom)
+        backend = RecordingBackend()
+        proxy = RuntimeManagerCriServer(
+            hook_server(registry),  # default IGNORE server
+            backend,
+            failure_policy=FailurePolicy.FAIL,
+        )
+        with pytest.raises(RuntimeError):
+            proxy.intercept(
+                CRIRequest(method="CreateContainer", pod=be_pod(),
+                           container="c0")
+            )
+        assert backend.requests == []  # the CRI call failed, not forwarded
+
+    def test_post_stop_hooks_run_after_forward_and_never_block(self):
+        """Stop calls forward FIRST; a failing post-stop hook can't keep
+        the sandbox alive (review fix)."""
+        registry = HookRegistry()
+        order = []
+
+        def post_stop(ctx):
+            order.append("hook")
+            raise RuntimeError("post-stop hook down")
+
+        registry.register(Stage.POST_STOP_POD_SANDBOX, "ps", "", post_stop)
+        backend = RecordingBackend()
+        real_handle = backend.handle
+
+        def handle(req):
+            order.append("backend")
+            return real_handle(req)
+
+        backend.handle = handle
+        proxy = RuntimeManagerCriServer(
+            hook_server(registry), backend,
+            failure_policy=FailurePolicy.FAIL,
+        )
+        pod = be_pod()
+        proxy.store.record_pod(pod)
+        out = proxy.intercept(CRIRequest(method="StopPodSandbox", pod=pod))
+        assert order == ["backend", "hook"]
+        assert out.backend_response["ok"]
+        assert proxy.store.pod(pod.uid) is None
+
+    def test_fail_over_rebuilds_store(self):
+        pods = [be_pod("a"), be_pod("b")]
+        backend = RecordingBackend(pods=pods)
+        proxy = RuntimeManagerCriServer(hook_server(), backend)
+        assert proxy.fail_over() == 2
+        assert proxy.store.pod("a") is pods[0]
+        assert proxy.store.pod("b") is pods[1]
+
+
+def test_end_to_end_groupidentity_through_proxy(tmp_path):
+    """The §3.5 flow: kubelet → proxy → hooks (bvt from NodeSLO) → merge
+    into the CRI request."""
+    from koordinator_tpu.koordlet.runtimehooks.groupidentity import (
+        BvtPlugin as GroupIdentityPlugin,
+    )
+    from koordinator_tpu.manager.sloconfig import (
+        CPUQOS,
+        NodeSLOSpec,
+        QoSConfig,
+        ResourceQOSStrategy,
+    )
+
+    registry = HookRegistry()
+    plugin = GroupIdentityPlugin()
+    plugin.register(registry)
+    plugin.update_rule(
+        NodeSLOSpec(
+            resource_qos_strategy=ResourceQOSStrategy(
+                be=QoSConfig(enable=True, cpu=CPUQOS(group_identity=-1))
+            )
+        )
+    )
+    backend = RecordingBackend()
+    proxy = RuntimeManagerCriServer(hook_server(registry), backend)
+    req = CRIRequest(method="RunPodSandbox", pod=be_pod())
+    out = proxy.intercept(req)
+    assert out.hook_response is not None
+    assert out.hook_response.cpu_bvt == -1  # BE group identity
+    assert backend.requests[0].resources.cpu_bvt == -1
