@@ -100,6 +100,16 @@ class RuntimeHookServer:
         )
         return self._finish(ctx, apply)
 
+    def post_start_container(
+        self, pod: PodMeta, container: str, apply: bool = True,
+        policy: Optional[FailurePolicy] = None,
+    ) -> Resources:
+        ctx = ContainerContext.from_meta(pod, container)
+        self.registry.run_hooks(
+            Stage.POST_START_CONTAINER, ctx, policy or self.fail_policy
+        )
+        return self._finish(ctx, apply)
+
     def stop_container(
         self, pod: PodMeta, container: str, apply: bool = True,
         policy: Optional[FailurePolicy] = None,

@@ -70,14 +70,15 @@ class RuntimeProxyStore:
         self.pods.pop(uid, None)
 
 
-#: method -> (pre hook runner, post hook runner) names on RuntimeHookServer
+#: method -> (pre-forward runner, post-forward runner) names on
+#: RuntimeHookServer; stop/post hooks run AFTER the runtime acted
 _HOOKED = {
-    "RunPodSandbox": "run_pod_sandbox",
-    "StopPodSandbox": "stop_pod_sandbox",
-    "CreateContainer": "create_container",
-    "StartContainer": "start_container",
-    "UpdateContainerResources": "update_container_resources",
-    "StopContainer": "stop_container",
+    "RunPodSandbox": ("run_pod_sandbox", None),
+    "StopPodSandbox": (None, "stop_pod_sandbox"),
+    "CreateContainer": ("create_container", None),
+    "StartContainer": ("start_container", "post_start_container"),
+    "UpdateContainerResources": ("update_container_resources", None),
+    "StopContainer": (None, "stop_container"),
 }
 
 _POD_METHODS = {"RunPodSandbox", "StopPodSandbox"}
@@ -114,8 +115,8 @@ class RuntimeManagerCriServer:
     def intercept(self, request: CRIRequest) -> CRIResponse:
         """The gRPC unary interceptor equivalent
         (InterceptRuntimeRequest :125)."""
-        runner_name = _HOOKED.get(request.method)
-        if runner_name is None:
+        runners = _HOOKED.get(request.method)
+        if runners is None:
             # TransparentHandler: forward untouched (:89-94)
             return CRIResponse(
                 request=request, backend_response=self.backend.handle(request)
@@ -129,14 +130,14 @@ class RuntimeManagerCriServer:
                 request=request, backend_response=self.backend.handle(request)
             )
 
-        is_stop = request.method in ("StopPodSandbox", "StopContainer")
+        pre_name, post_name = runners
         hook_response: Optional[Resources] = None
 
-        def run_hook() -> Optional[Resources]:
+        def run_hook(name: str) -> Optional[Resources]:
             # the PROXY's failure policy governs, regardless of the hook
             # server's own default (hooks must surface errors to us)
             try:
-                runner = getattr(self.hook_server, runner_name)
+                runner = getattr(self.hook_server, name)
                 if request.method in _POD_METHODS:
                     return runner(pod, apply=False, policy=FailurePolicy.FAIL)
                 return runner(
@@ -148,9 +149,9 @@ class RuntimeManagerCriServer:
                     raise
                 return None  # Ignore: forward unmodified
 
-        if not is_stop:
+        if pre_name is not None:
             # pre-hooks mutate the request before the runtime sees it
-            hook_response = run_hook()
+            hook_response = run_hook(pre_name)
             if hook_response is not None:
                 self._merge(request, hook_response)
 
@@ -162,14 +163,13 @@ class RuntimeManagerCriServer:
         elif request.method == "StopPodSandbox":
             self.store.delete_pod(pod.uid)
 
-        if is_stop:
-            # POST_STOP hooks run after the runtime actually stopped it
-            # (the reference's post-hook side of the dispatch); a failing
-            # post-stop hook never blocks the stop itself
+        if post_name is not None:
+            # post hooks run after the runtime acted; they never block
+            # the already-completed call
             try:
-                hook_response = run_hook()
+                hook_response = run_hook(post_name) or hook_response
             except Exception:
-                hook_response = None
+                pass
 
         return CRIResponse(
             request=request,

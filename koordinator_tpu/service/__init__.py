@@ -1,0 +1,22 @@
+"""The control-plane ↔ solver service boundary.
+
+SURVEY.md §5.8 / §7 north star: the Go control plane talks to the JAX
+solver sidecar over an ordinary RPC carrying *batched* request/response
+payloads that mirror the Score/Reserve plugin API — node/pod arrays in,
+assignments out. Here the boundary is a length-prefixed binary protocol
+(npz-packed arrays, language-neutral framing a C++/Go client can speak)
+over a unix or TCP socket.
+"""
+
+from koordinator_tpu.service.codec import (  # noqa: F401
+    SolveRequest,
+    SolveResponse,
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+    read_frame,
+    write_frame,
+)
+from koordinator_tpu.service.server import PlacementService  # noqa: F401
+from koordinator_tpu.service.client import PlacementClient  # noqa: F401

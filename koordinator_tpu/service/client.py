@@ -1,0 +1,63 @@
+"""Control-plane client for the solver sidecar.
+
+Speaks the framed npz protocol; ``solve_arrays`` takes the same host
+arrays the in-process path lowers (state/cluster.py), so a control plane
+swaps between in-process and sidecar solving without changing its
+lowering.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, Optional
+
+import numpy as np
+
+from koordinator_tpu.service.codec import (
+    SolveRequest,
+    SolveResponse,
+    decode_response,
+    encode_request,
+    read_frame,
+    write_frame,
+)
+
+
+class PlacementClient:
+    def __init__(self, address, timeout: float = 60.0):
+        if isinstance(address, str):
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(address)
+        self._stream = self._sock.makefile("rwb")
+
+    def solve(self, request: SolveRequest) -> SolveResponse:
+        write_frame(self._stream, encode_request(request))
+        self._stream.flush()
+        payload = read_frame(self._stream)
+        if payload is None:
+            raise ConnectionError("solver closed the connection")
+        response = decode_response(payload)
+        if response.error:
+            raise RuntimeError(f"solver error: {response.error}")
+        return response
+
+    def solve_arrays(
+        self,
+        node: Dict[str, np.ndarray],
+        pods: Dict[str, np.ndarray],
+        params: Dict[str, np.ndarray],
+    ) -> SolveResponse:
+        return self.solve(SolveRequest(node=node, pods=pods, params=params))
+
+    def close(self) -> None:
+        self._stream.close()
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,110 @@
+"""Wire format for the solver boundary.
+
+Framing: 4-byte big-endian payload length, then the payload. Payloads are
+npz archives (zip of npy buffers) — a stable, language-neutral container
+(C++ can read npy headers with ~50 lines; Go has cnpy-style readers), so
+the control plane doesn't need Python to speak to the solver. The
+request carries exactly the batched Score/Reserve inputs
+(NodeState/PodBatch/ScoreParams columns); the response carries the
+assignments plus the mutated node accounting columns so the caller's
+cache can assume without re-deriving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import struct
+from typing import BinaryIO, Dict, Optional
+
+import numpy as np
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 30
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One batched solve: the scan's inputs as host arrays."""
+
+    node: Dict[str, np.ndarray]    # alloc/used_req/usage/... [N,R]+masks
+    pods: Dict[str, np.ndarray]    # req/est/is_prod/... [P,...]
+    params: Dict[str, np.ndarray]  # weights/thresholds/prod_thresholds [R]
+
+
+@dataclasses.dataclass
+class SolveResponse:
+    assignments: np.ndarray              # [P] int32 node index or -1
+    node_used_req: Optional[np.ndarray] = None  # [N,R] post-solve
+    error: str = ""
+
+
+def write_frame(stream: BinaryIO, payload: bytes) -> None:
+    stream.write(_LEN.pack(len(payload)))
+    stream.write(payload)
+
+
+def read_frame(stream: BinaryIO) -> Optional[bytes]:
+    header = stream.read(_LEN.size)
+    if len(header) < _LEN.size:
+        return None  # peer closed
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    chunks = []
+    remaining = length
+    while remaining:
+        chunk = stream.read(remaining)
+        if not chunk:
+            raise EOFError("truncated frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def _pack(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _unpack(payload: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def encode_request(req: SolveRequest) -> bytes:
+    arrays: Dict[str, np.ndarray] = {}
+    for prefix, group in (("n.", req.node), ("p.", req.pods), ("s.", req.params)):
+        for key, value in group.items():
+            arrays[prefix + key] = np.asarray(value)
+    return _pack(arrays)
+
+
+def decode_request(payload: bytes) -> SolveRequest:
+    node: Dict[str, np.ndarray] = {}
+    pods: Dict[str, np.ndarray] = {}
+    params: Dict[str, np.ndarray] = {}
+    for key, value in _unpack(payload).items():
+        prefix, name = key[:2], key[2:]
+        {"n.": node, "p.": pods, "s.": params}[prefix][name] = value
+    return SolveRequest(node=node, pods=pods, params=params)
+
+
+def encode_response(resp: SolveResponse) -> bytes:
+    arrays = {
+        "assignments": np.asarray(resp.assignments, dtype=np.int32),
+        "error": np.frombuffer(resp.error.encode(), dtype=np.uint8),
+    }
+    if resp.node_used_req is not None:
+        arrays["node_used_req"] = np.asarray(resp.node_used_req)
+    return _pack(arrays)
+
+
+def decode_response(payload: bytes) -> SolveResponse:
+    arrays = _unpack(payload)
+    return SolveResponse(
+        assignments=arrays["assignments"],
+        node_used_req=arrays.get("node_used_req"),
+        error=bytes(arrays["error"]).decode() if "error" in arrays else "",
+    )

@@ -1,0 +1,130 @@
+"""The solver sidecar: hosts the batched placement solve behind the wire
+boundary.
+
+One thread per connection, one solve per request frame. The solver keeps
+its jit cache across requests (the first solve pays compilation; repeat
+shapes are cached), which is the point of the sidecar: the control plane
+restarts freely while the compiled solver stays warm.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops.binpack import (
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    solve_batch,
+)
+from koordinator_tpu.service.codec import (
+    SolveRequest,
+    SolveResponse,
+    decode_request,
+    encode_response,
+    read_frame,
+    write_frame,
+)
+
+NODE_FIELDS = (
+    "alloc", "used_req", "usage", "prod_usage", "est_extra", "prod_base",
+    "metric_fresh", "schedulable",
+)
+POD_FIELDS = (
+    "req", "est", "is_prod", "is_daemonset", "quota_id", "non_preemptible",
+    "gang_id", "blocked",
+)
+
+
+def solve_from_request(req: SolveRequest,
+                       config: SolverConfig = SolverConfig()) -> SolveResponse:
+    """Run one batched solve from wire arrays (the RPC handler body)."""
+    try:
+        state = NodeState(
+            **{f: jnp.asarray(req.node[f]) for f in NODE_FIELDS}
+        )
+        pods = PodBatch.build(
+            **{f: jnp.asarray(req.pods[f])
+               for f in POD_FIELDS if f in req.pods}
+        )
+        params = ScoreParams(
+            weights=jnp.asarray(req.params["weights"]),
+            thresholds=jnp.asarray(req.params["thresholds"]),
+            prod_thresholds=jnp.asarray(req.params["prod_thresholds"]),
+        )
+        result = solve_batch(state, pods, params, config)
+        return SolveResponse(
+            assignments=np.asarray(result.assign),
+            node_used_req=np.asarray(result.node_state.used_req),
+        )
+    except Exception as e:  # the boundary returns errors, never crashes
+        return SolveResponse(
+            assignments=np.empty(0, np.int32), error=f"{type(e).__name__}: {e}"
+        )
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        stream = self.request.makefile("rwb")
+        try:
+            while True:
+                payload = read_frame(stream)
+                if payload is None:
+                    return
+                try:
+                    request = decode_request(payload)
+                except Exception as e:
+                    # malformed payload: report, keep the connection
+                    response = SolveResponse(
+                        assignments=np.empty(0, np.int32),
+                        error=f"decode failed: {type(e).__name__}: {e}",
+                    )
+                else:
+                    response = solve_from_request(
+                        request, self.server.solver_config
+                    )
+                write_frame(stream, encode_response(response))
+                stream.flush()
+        finally:
+            stream.close()
+
+
+class PlacementService:
+    """The sidecar server (UDS by default; TCP for cross-host)."""
+
+    def __init__(self, address, config: SolverConfig = SolverConfig()):
+        self.address = address
+        if isinstance(address, str):
+            server_cls = type(
+                "_UnixServer",
+                (socketserver.ThreadingUnixStreamServer,),
+                {"daemon_threads": True},
+            )
+        else:
+            server_cls = type(
+                "_TCPServer",
+                (socketserver.ThreadingTCPServer,),
+                {"daemon_threads": True, "allow_reuse_address": True},
+            )
+        self._server = server_cls(address, _Handler)
+        self._server.solver_config = config
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

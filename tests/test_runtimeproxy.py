@@ -198,3 +198,21 @@ def test_end_to_end_groupidentity_through_proxy(tmp_path):
     assert out.hook_response is not None
     assert out.hook_response.cpu_bvt == -1  # BE group identity
     assert backend.requests[0].resources.cpu_bvt == -1
+
+
+def test_post_start_hooks_dispatch_after_forward():
+    """POST_START_CONTAINER hooks run after StartContainer forwards
+    (review fix: the post side of the dispatch table)."""
+    registry = HookRegistry()
+    order = []
+    registry.register(Stage.PRE_START_CONTAINER, "pre", "",
+                      lambda ctx: order.append("pre"))
+    registry.register(Stage.POST_START_CONTAINER, "post", "",
+                      lambda ctx: order.append("post"))
+    backend = RecordingBackend()
+    real_handle = backend.handle
+    backend.handle = lambda req: (order.append("backend"), real_handle(req))[1]
+    proxy = RuntimeManagerCriServer(hook_server(registry), backend)
+    proxy.intercept(CRIRequest(method="StartContainer", pod=be_pod(),
+                               container="c0"))
+    assert order == ["pre", "backend", "post"]
