@@ -1,18 +1,25 @@
-"""North-star benchmark: 10k-pending-pod / 5k-node churn burst.
+"""Benchmark: the BASELINE.json matrix on the batched TPU solver.
 
-Measures the batched placement solver (the TPU-native rebuild of the
-scheduler's Filter→Score→Reserve inner loop) on the BASELINE.json target:
-schedule a 10k-pod churn against 5k nodes; the target is < 1 s wall-clock,
-i.e. >= 10k pods scheduled/sec. Prints exactly one JSON line:
+Flagship (the driver metric): 10k-pending-pod / 5k-node churn burst —
+target < 1 s wall-clock (>= 10k pods/s). Prints exactly ONE JSON line:
 ``{"metric": ..., "value": pods_per_sec, "unit": "pods/s",
-"vs_baseline": pods_per_sec / 10000}``.
+"vs_baseline": pods_per_sec / 10000, "matrix": {...}}`` where ``matrix``
+carries the BASELINE comparison configs #1-#5:
 
-State is device-resident: node arrays are staged once and stay on device
-across churn batches (the steady-state regime of a real cluster); the
-timed section is solve + assignments readback, which is what a scheduling
-round costs.
+1. NodeResourcesFit LeastAllocated, 100 pods / 20 nodes (+ host-oracle
+   python reference on the same config -> speedup);
+2. LoadAware mixed LS/BE, 2k pods / 500 nodes (usage + thresholds live);
+3. ElasticQuota, 5k pods / 50 groups / 1k nodes (water-filled runtime +
+   admission fused into the solve);
+4. Coscheduling, 200 gangs x 32 pods, all-or-nothing at batch end;
+5. Descheduler LoadAware rebalance sweep, 5k nodes / 30k pods.
 
-Env knobs: KTPU_BENCH_NODES, KTPU_BENCH_PODS, KTPU_BENCH_REPEATS.
+State is device-resident; the timed section is solve + assignments
+readback (what a scheduling round costs). Pod-shape bucketing
+(models/placement.py pod_bucket) amortizes compiles across queue sizes.
+
+Env knobs: KTPU_BENCH_NODES, KTPU_BENCH_PODS, KTPU_BENCH_REPEATS,
+KTPU_BENCH_MATRIX=0 to skip the matrix (flagship only).
 """
 
 import json
@@ -23,11 +30,29 @@ import time
 import numpy as np
 
 
-def main():
-    n_nodes = int(os.environ.get("KTPU_BENCH_NODES", 5000))
-    n_pods = int(os.environ.get("KTPU_BENCH_PODS", 10000))
-    repeats = max(1, int(os.environ.get("KTPU_BENCH_REPEATS", 3)))
+def _timed(fn, repeats, *args):
+    """(best seconds, warmup seconds, last output) with readback forced
+    each run; the first (compile) call is timed separately as warmup."""
+    t0 = time.time()
+    out = fn(*args)
+    _ = np.asarray(out[1] if isinstance(out, tuple) else out)
+    warmup = time.time() - t0
+    times = []
+    for _i in range(repeats):
+        t0 = time.time()
+        out = fn(*args)
+        _ = np.asarray(out[1] if isinstance(out, tuple) else out)
+        times.append(time.time() - t0)
+    return min(times), warmup, out
 
+
+def _problem(n_nodes, n_pods, seed=1):
+    from __graft_entry__ import _example_problem
+
+    return _example_problem(n_nodes, n_pods, seed=seed)
+
+
+def bench_flagship(repeats):
     import jax
 
     from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
@@ -36,9 +61,10 @@ def main():
         shard_node_state,
         shard_solver,
     )
-    from __graft_entry__ import _example_problem
 
-    state, pods, params = _example_problem(n_nodes, n_pods, seed=1)
+    n_nodes = int(os.environ.get("KTPU_BENCH_NODES", 5000))
+    n_pods = int(os.environ.get("KTPU_BENCH_PODS", 10000))
+    state, pods, params = _problem(n_nodes, n_pods)
 
     devices = jax.devices()
     if len(devices) > 1:
@@ -50,31 +76,206 @@ def main():
             lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig())
         )
 
-    # warm-up: compile + first run
+    best, warmup, out = _timed(solve, repeats, state, pods, params)
+    assignments = np.asarray(out[1])
+    scheduled = int((assignments >= 0).sum())
+    return {
+        "pods_per_sec": n_pods / best,
+        "wall_s": best,
+        "scheduled": scheduled,
+        "n_nodes": n_nodes,
+        "n_pods": n_pods,
+        "warmup_s": warmup,
+        "devices": f"{len(devices)}x{devices[0].platform}",
+    }
+
+
+def bench_fit_with_oracle(repeats, n_nodes=20, n_pods=100):
+    """Config #1 on device AND through the pure-python host oracle — the
+    measured host-oracle speedup + bit-identity check. At the 100x20
+    scale a single host<->device round trip dominates; the 500x200
+    variant shows the crossover."""
+    import jax
+
+    from koordinator_tpu.oracle.placement import schedule_sequential
+    from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+
+    state, pods, params = _problem(n_nodes, n_pods)
+    solve = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))
+    best, _warm, out = _timed(solve, repeats, state, pods, params)
+
+    args = (
+        np.asarray(state.alloc), np.asarray(state.used_req),
+        np.asarray(state.usage), np.asarray(state.prod_usage),
+        np.asarray(state.est_extra), np.asarray(state.prod_base),
+        np.asarray(state.metric_fresh), np.asarray(state.schedulable),
+        np.asarray(pods.req), np.asarray(pods.est),
+        np.asarray(pods.is_prod), np.asarray(pods.is_daemonset),
+        np.asarray(params.weights), np.asarray(params.thresholds),
+        np.asarray(params.prod_thresholds),
+    )
     t0 = time.time()
-    new_state, assignments = solve(state, pods, params)
-    jax.block_until_ready((new_state, assignments))
-    warmup = time.time() - t0
+    oracle = schedule_sequential(*args)
+    oracle_s = time.time() - t0
+    identical = bool((np.asarray(out[1]) == np.asarray(oracle)).all())
+    return {
+        "pods_per_sec": n_pods / best,
+        "oracle_pods_per_sec": n_pods / oracle_s,
+        "speedup_vs_host_oracle": oracle_s / best,
+        "identical_to_oracle": identical,
+    }
 
-    times = []
-    for _ in range(repeats):
-        t0 = time.time()
-        new_state, assignments = solve(state, pods, params)
-        out = np.asarray(assignments)  # include readback: it's part of a round
-        times.append(time.time() - t0)
-    elapsed = min(times)
 
-    scheduled = int((out >= 0).sum())
-    pods_per_sec = n_pods / elapsed
+def bench_loadaware(repeats):
+    import jax
+
+    from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+
+    state, pods, params = _problem(500, 2000, seed=2)
+    solve = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))
+    best, _warm, _out = _timed(solve, repeats, state, pods, params)
+    return {"pods_per_sec": 2000 / best, "wall_s": best}
+
+
+def bench_quota(repeats):
+    import jax
+    import jax.numpy as jnp
+
+    from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+    from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+    from koordinator_tpu.ops.quota import QuotaState
+
+    n_nodes, n_pods, n_quota = 1000, 5000, 50
+    state, pods, params = _problem(n_nodes, n_pods, seed=3)
+    rng = np.random.default_rng(3)
+    quota_id = rng.integers(0, n_quota, n_pods).astype(np.int32)
+    pods = pods._replace(quota_id=jnp.asarray(quota_id))
+    total = np.asarray(state.alloc).astype(np.int64).sum(axis=0)
+    mn = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mx = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mn[:, ResourceName.CPU] = total[ResourceName.CPU] // (2 * n_quota)
+    mn[:, ResourceName.MEMORY] = total[ResourceName.MEMORY] // (2 * n_quota)
+    mx[:, ResourceName.CPU] = total[ResourceName.CPU] // 10
+    mx[:, ResourceName.MEMORY] = total[ResourceName.MEMORY] // 10
+    req = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    pods_req = np.asarray(pods.req).astype(np.int64)
+    for q in range(n_quota):
+        req[q] = pods_req[quota_id == q].sum(axis=0)
+    qstate = QuotaState.build(
+        min=mn, max=mx, weight=mx, allow_lent=np.ones(n_quota, bool),
+        total=total, child_request=req,
+    )
+    solve = jax.jit(
+        lambda s, p, pr, q: schedule_batch(s, p, pr, SolverConfig(), q)[1]
+    )
+    best, _warm, out = _timed(lambda *a: solve(*a), repeats,
+                              state, pods, params, qstate)
+    placed = int((np.asarray(out) >= 0).sum())
+    return {"pods_per_sec": n_pods / best, "wall_s": best, "placed": placed}
+
+
+def bench_gang(repeats):
+    import jax
+    import jax.numpy as jnp
+
+    from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+    from koordinator_tpu.ops.gang import GangState
+
+    n_gangs, size = 200, 32
+    n_pods = n_gangs * size
+    n_nodes = 1600
+    state, pods, params = _problem(n_nodes, n_pods, seed=4)
+    gang_id = np.repeat(np.arange(n_gangs, dtype=np.int32), size)
+    pods = pods._replace(gang_id=jnp.asarray(gang_id))
+    gstate = GangState.build(min_member=[size] * n_gangs)
+    solve = jax.jit(
+        lambda s, p, pr, g: schedule_batch(s, p, pr, SolverConfig(), None, g)[1]
+    )
+    best, _warm, out = _timed(lambda *a: solve(*a), repeats,
+                              state, pods, params, gstate)
+    committed = int(np.asarray(out[1]).sum())
+    return {
+        "pods_per_sec": n_pods / best,
+        "wall_s": best,
+        "committed": committed,
+        "gangs": n_gangs,
+    }
+
+
+def bench_rebalance(repeats):
+    import jax
+    import jax.numpy as jnp
+
+    from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+    from koordinator_tpu.ops.rebalance import classify_nodes
+
+    n_nodes, n_pods = 5000, 30000
+    rng = np.random.default_rng(5)
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+    alloc[:, ResourceName.CPU] = 64000
+    alloc[:, ResourceName.MEMORY] = 131072
+    # 30k pods' usage folded onto nodes, skewed (squared uniform) so a
+    # tail of nodes actually crosses the high threshold
+    pod_node = (rng.random(n_pods) ** 2 * n_nodes).astype(np.int64)
+    pod_cpu = rng.integers(200, 4000, n_pods)
+    usage = np.zeros((n_nodes, NUM_RESOURCES), np.int64)
+    np.add.at(usage[:, ResourceName.CPU], pod_node, pod_cpu)
+    usage = np.minimum(usage, alloc).astype(np.int32)
+    low = np.full(NUM_RESOURCES, -1, np.int32)
+    high = np.full(NUM_RESOURCES, -1, np.int32)
+    low[ResourceName.CPU] = 45
+    high[ResourceName.CPU] = 65
+    active = jnp.asarray(np.ones(n_nodes, bool))
+    fn = jax.jit(
+        lambda u, a: classify_nodes(
+            u, a, jnp.asarray(low), jnp.asarray(high), active, active
+        ).high
+    )
+    best, _warm, out = _timed(lambda *a: fn(*a), repeats,
+                              jnp.asarray(usage), jnp.asarray(alloc))
+    return {
+        "sweeps_per_sec": 1.0 / best,
+        "wall_ms": best * 1000,
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "overloaded": int(np.asarray(out).sum()),
+    }
+
+
+def main():
+    repeats = max(1, int(os.environ.get("KTPU_BENCH_REPEATS", 3)))
+    flagship = bench_flagship(repeats)
+
+    matrix = {}
+    if os.environ.get("KTPU_BENCH_MATRIX", "1") != "0":
+        matrix["1_fit_100x20"] = bench_fit_with_oracle(repeats)
+        matrix["1b_fit_500x200"] = bench_fit_with_oracle(
+            repeats, n_nodes=200, n_pods=500
+        )
+        matrix["2_loadaware_2kx500"] = bench_loadaware(repeats)
+        matrix["3_quota_5k_50q_1k"] = bench_quota(repeats)
+        matrix["4_gang_200x32"] = bench_gang(repeats)
+        matrix["5_rebalance_5kx30k"] = bench_rebalance(repeats)
+
+    def _round(obj):
+        if isinstance(obj, dict):
+            return {k: _round(v) for k, v in obj.items()}
+        if isinstance(obj, float):
+            return round(obj, 3)
+        return obj
+
+    pods_per_sec = flagship["pods_per_sec"]
     result = {
         "metric": (
-            f"batched placement churn ({n_pods} pods / {n_nodes} nodes, "
-            f"{scheduled} placed, {len(devices)}x{devices[0].platform}, "
-            f"warmup {warmup:.1f}s)"
+            f"batched placement churn ({flagship['n_pods']} pods / "
+            f"{flagship['n_nodes']} nodes, {flagship['scheduled']} placed, "
+            f"{flagship['devices']}, warmup {flagship['warmup_s']:.1f}s)"
+            + (" + BASELINE matrix configs 1-5" if matrix else "")
         ),
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_sec / 10000.0, 3),
+        "matrix": _round(matrix),
     }
     print(json.dumps(result))
 

@@ -84,6 +84,18 @@ class PlacementModel:
     #: (feasibility is still enforced afterwards, so the loop terminates)
     MAX_SCORE_ITERS = 8
 
+    @staticmethod
+    def pod_bucket(p: int) -> int:
+        """Round the pod-batch length up to a shape bucket (quarter steps
+        between powers of two, floor 64) so churn batches of nearby sizes
+        reuse one compiled program instead of recompiling per queue
+        length. Padding pods are hard-blocked, so results are identical."""
+        if p <= 64:
+            return 64
+        power = 1 << (p - 1).bit_length()      # next power of two
+        step = power // 8                      # quarter steps of power/2
+        return ((p + step - 1) // step) * step
+
     def __init__(
         self,
         config: SolverConfig = SolverConfig(),
@@ -93,6 +105,7 @@ class PlacementModel:
         scaling_factors=None,
         sharding: Optional[jax.sharding.Sharding] = None,
         fine: Optional[FineGrained] = None,
+        pod_bucketing: bool = True,
     ):
         self.config = config
         self.resource_weights = dict(resource_weights or DEFAULT_RESOURCE_WEIGHTS)
@@ -106,6 +119,7 @@ class PlacementModel:
         )
         self.sharding = sharding
         self.fine = fine
+        self.pod_bucketing = pod_bucketing
         self._solve = jax.jit(solve_batch, static_argnames=("config",))
 
     # -- staging ------------------------------------------------------------
@@ -270,6 +284,29 @@ class PlacementModel:
                 )
             extras = Extras(mask=jnp.asarray(mask_np), score=jnp.asarray(score_np))
 
+        # -- pod-shape bucketing (compile amortization) ---------------------
+        n_real = len(pods_in_order)
+        if self.pod_bucketing:
+            batch, extras, resv_arrays = self._pad_pods(
+                batch, extras, resv_arrays, n_real
+            )
+        padded_p = int(batch.req.shape[0])
+
+        def _extras_device():
+            """Extras from the (unpadded) host rows, padded to the batch
+            length — the refine loop rebuilds through this so re-solves
+            keep matching scan dims."""
+            pad = padded_p - mask_np.shape[0]
+            if pad:
+                mask = np.pad(mask_np, ((0, pad), (0, 0)))
+                score = np.pad(score_np, ((0, pad), (0, 0)))
+            else:
+                mask, score = mask_np, score_np
+            return Extras(mask=jnp.asarray(mask), score=jnp.asarray(score))
+
+        if extras is not None:
+            extras = _extras_device()
+
         # -- propose → validate → refine loop ------------------------------
         applied: List[tuple] = []  # (idx, node_name, CycleState)
         iteration = 0
@@ -318,13 +355,13 @@ class PlacementModel:
                     snapshot, pods_in_order[i], node_by_name[node_name], cstate
                 )
             applied = []
-            extras = Extras(mask=jnp.asarray(mask_np), score=jnp.asarray(score_np))
+            extras = _extras_device()
             iteration += 1
 
-        assignments = np.asarray(result.assign)
-        commit = np.asarray(result.commit)
-        waiting = np.asarray(result.waiting)
-        rejected = np.asarray(result.rejected)
+        assignments = np.asarray(result.assign)[:n_real]
+        commit = np.asarray(result.commit)[:n_real]
+        waiting = np.asarray(result.waiting)[:n_real]
+        rejected = np.asarray(result.rejected)[:n_real]
 
         # fine-grained epilogue: release gang-rejected holds, annotate
         # committed pods (PreBind), keep waiting pods' holds for the
@@ -361,6 +398,42 @@ class PlacementModel:
             fine_states=fine_states,
             resv_allocs=resv_allocs,
         )
+
+    def _pad_pods(self, batch, extras, resv, n_real):
+        """Pad the pod axis up to the shape bucket with hard-blocked
+        dummies (assignment -1, no accounting) — identical semantics, one
+        compiled program per bucket."""
+        target = self.pod_bucket(n_real)
+        if target == n_real:
+            return batch, extras, resv
+        pad = target - n_real
+
+        def padp(a, fill):
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.pad(a, widths, constant_values=fill)
+
+        batch = batch._replace(
+            req=padp(batch.req, 0),
+            est=padp(batch.est, 0),
+            is_prod=padp(batch.is_prod, False),
+            is_daemonset=padp(batch.is_daemonset, False),
+            quota_id=padp(batch.quota_id, -1),
+            non_preemptible=padp(batch.non_preemptible, False),
+            gang_id=padp(batch.gang_id, -1),
+            blocked=padp(batch.blocked, True),
+            has_numa_policy=(
+                padp(batch.has_numa_policy, False)
+                if batch.has_numa_policy is not None
+                else None
+            ),
+        )
+        if extras is not None:
+            extras = Extras(
+                mask=padp(extras.mask, False), score=padp(extras.score, 0)
+            )
+        if resv is not None:
+            resv = resv._replace(match=padp(resv.match, False))
+        return batch, extras, resv
 
     def _build_resv(self, snapshot, node_arrays, pods_in_order):
         """Lower Available reservations with free remainder to

@@ -45,6 +45,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from koordinator_tpu.ops.common import reciprocal_for
 from koordinator_tpu.ops.fit import fit_filter, least_allocated_score
 from koordinator_tpu.ops.loadaware import loadaware_filter, loadaware_score
 
@@ -56,6 +57,9 @@ class SolverConfig(NamedTuple):
     loadaware_weight: int = 1    # LoadAwareScheduling plugin weight
     score_according_prod: bool = False
     numa_most_allocated: bool = False  # NUMA scorer: MostAllocated vs Least
+    #: scan unroll factor: amortizes per-step loop overhead (~1.4x
+    #: throughput at 5k nodes); results are identical at any value
+    unroll: int = 8
 
 
 class NodeState(NamedTuple):
@@ -193,8 +197,15 @@ def score_one_pod(
     is_daemonset: jnp.ndarray,
     params: ScoreParams,
     config: SolverConfig,
+    alloc_recip: Optional[jnp.ndarray] = None,
 ) -> tuple:
-    """(mask[N], score[N]) for one pod against the full node set."""
+    """(mask[N], score[N]) for one pod against the full node set.
+
+    ``alloc_recip`` (``reciprocal_for(state.alloc)``, computed once per
+    solve) replaces the two per-step int32 divisions with the exact
+    reciprocal-multiply path — identical results, ~4x the throughput on
+    TPU (int32 division lowers to a long scalar expansion).
+    """
     mask = (
         state.schedulable
         & fit_filter(req, state.alloc, state.used_req)
@@ -210,7 +221,7 @@ def score_one_pod(
         )
     )
     score = config.fit_weight * least_allocated_score(
-        req, state.alloc, state.used_req, params.weights
+        req, state.alloc, state.used_req, params.weights, alloc_recip
     ) + config.loadaware_weight * loadaware_score(
         est,
         state.alloc,
@@ -221,6 +232,7 @@ def score_one_pod(
         params.weights,
         is_prod,
         config.score_according_prod,
+        alloc_recip,
     )
     return mask, score
 
@@ -344,6 +356,10 @@ def solve_batch(
         # so the water-filled runtime is computed once for the whole batch.
         runtime = quota_runtime(quota_state)
 
+    # allocatable is static within a solve: precompute the reciprocal once
+    # so every scan step scores without int32 division
+    alloc_recip = reciprocal_for(state.alloc)
+
     xs = [pods.req, pods.est, pods.is_prod, pods.is_daemonset, pods.blocked]
     if use_q:
         xs += [pods.quota_id, pods.non_preemptible]
@@ -393,7 +409,9 @@ def solve_batch(
                 jnp.where(match[:, None], rfree, 0)
             )
             eff = ns._replace(used_req=ns.used_req - credit)
-        mask, score = score_one_pod(eff, req, est, is_prod, is_ds, params, config)
+        mask, score = score_one_pod(
+            eff, req, est, is_prod, is_ds, params, config, alloc_recip
+        )
         if use_n:
             score = score + numa_node_score(ns.numa_cap, ns.numa_free, req, config)
         if use_x:
@@ -458,7 +476,9 @@ def solve_batch(
             out_carry.append(rfree)
         return tuple(out_carry), tuple(outs)
 
-    final_carry, ys = jax.lax.scan(step, tuple(init), tuple(xs))
+    final_carry, ys = jax.lax.scan(
+        step, tuple(init), tuple(xs), unroll=config.unroll
+    )
     fi = iter(final_carry)
     final_state = next(fi)
     final_qstate = next(fi) if use_q else None

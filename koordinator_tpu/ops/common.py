@@ -47,14 +47,54 @@ def percent_exceeds(diff: jnp.ndarray, base: jnp.ndarray,
     return diff > mul_percent_floor(base, pct)
 
 
-def least_requested_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+def reciprocal_for(divisor: jnp.ndarray) -> jnp.ndarray:
+    """f32 ``1/max(divisor,1)`` — precompute ONCE for a static divisor and
+    feed :func:`floor_div_exact`. TPU int32 division lowers to a long
+    scalar expansion (~10x the cost of the whole score body); a float
+    reciprocal multiply plus a one-step integer correction computes the
+    same exact floor quotient."""
+    return 1.0 / jnp.maximum(divisor, 1).astype(jnp.float32)
+
+
+def floor_div_exact(y: jnp.ndarray, divisor: jnp.ndarray,
+                    recip: jnp.ndarray) -> jnp.ndarray:
+    """Exact ``floor(y / max(divisor,1))`` for non-negative int32 ``y``.
+
+    ``q0 = floor(f32(y) * recip)`` carries relative error < 3·2⁻²⁴, so its
+    absolute error is < 1 whenever the true quotient is < ~2²². The two
+    one-step corrections then pin the exact floor.
+
+    Domain (int32 correction products must not wrap): quotient < 2²² AND
+    ``y + divisor < 2³¹``. Score math satisfies both with wide headroom:
+    quotients are ≤ 100 and ``y ≤ 100·capacity`` with capacity bounded at
+    ~10.7M canonical units (apis/extension.py), so ``y + divisor ≤
+    101·10.7M ≈ 2³⁰``.
+    """
+    y = jnp.maximum(y, 0)
+    div_safe = jnp.maximum(divisor, 1)
+    q0 = jnp.floor(y.astype(jnp.float32) * recip).astype(jnp.int32)
+    return q0 - (q0 * div_safe > y) + ((q0 + 1) * div_safe <= y)
+
+
+def least_requested_score(
+    requested: jnp.ndarray,
+    capacity: jnp.ndarray,
+    recip: jnp.ndarray = None,
+) -> jnp.ndarray:
     """``(capacity - requested) * 100 / capacity``; 0 when capacity is 0 or
     requested exceeds capacity (reference: load_aware.go:388-397).
     Integer (truncating) division — operands are non-negative so Go's
-    truncation equals floor division.
+    truncation equals floor division. Pass ``recip``
+    (:func:`reciprocal_for` of the static capacity) on hot paths: the
+    result is identical, computed without the slow int32 divide.
     """
-    cap_safe = jnp.maximum(capacity, 1)
-    score = ((capacity - requested) * MAX_NODE_SCORE) // cap_safe
+    if recip is not None:
+        score = floor_div_exact(
+            (capacity - requested) * MAX_NODE_SCORE, capacity, recip
+        )
+    else:
+        cap_safe = jnp.maximum(capacity, 1)
+        score = ((capacity - requested) * MAX_NODE_SCORE) // cap_safe
     return jnp.where((capacity == 0) | (requested > capacity), 0, score)
 
 

@@ -33,11 +33,12 @@ def least_allocated_score(
     node_alloc: jnp.ndarray,   # [N,R] int32
     node_used: jnp.ndarray,    # [N,R] int32
     weights: jnp.ndarray,      # [R] int32 (0 = resource not scored)
+    alloc_recip: jnp.ndarray = None,  # reciprocal_for(node_alloc), hot path
 ) -> jnp.ndarray:
     """LeastAllocated score ``[N]`` in 0..100:
     ``Σ_r w_r * (alloc - (used+req)) * 100 / alloc  //  Σ_r w_r``
     (SURVEY.md A.6; same form as the reference's leastRequestedScore but
     over requests rather than estimated usage)."""
     requested = node_used + pod_req
-    per_resource = least_requested_score(requested, node_alloc)
+    per_resource = least_requested_score(requested, node_alloc, alloc_recip)
     return weighted_mean_scores(per_resource, weights)

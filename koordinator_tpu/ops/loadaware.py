@@ -65,6 +65,7 @@ def loadaware_score(
     weights: jnp.ndarray,        # [R] int32
     pod_is_prod: jnp.ndarray,    # [] bool — prod-usage scoring mode
     score_according_prod: bool = False,
+    alloc_recip: jnp.ndarray = None,  # reciprocal_for(node_alloc), hot path
 ) -> jnp.ndarray:
     """LoadAware score ``[N]`` in 0..100 (load_aware.go:269-397).
 
@@ -79,6 +80,6 @@ def loadaware_score(
     prod_mode = score_according_prod & pod_is_prod
     base = jnp.where(prod_mode, prod_base, node_usage + est_extra)
     estimated_used = base + pod_est                             # [N,R]
-    per_resource = least_requested_score(estimated_used, node_alloc)
+    per_resource = least_requested_score(estimated_used, node_alloc, alloc_recip)
     score = weighted_mean_scores(per_resource, weights)
     return jnp.where(metric_fresh, score, 0)

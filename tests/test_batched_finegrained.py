@@ -345,3 +345,27 @@ def test_waiting_gang_pod_quota_accounted_and_released():
     s.remove_pod(pod)
     used = s.quota_manager.quotas["t"].used
     assert used[int(R.CPU)] == 0
+
+
+def test_refine_loop_with_bucketing_device_conflict():
+    """The dirty/re-solve path under pod bucketing: two GPU pods compete
+    for the only device node; the refine re-solve must keep padded scan
+    dims consistent (round-2 review fix)."""
+    s = Scheduler()
+    for name in ("n0", "n1"):
+        s.add_node(NodeSpec(name=name, allocatable={R.CPU: 16000, R.MEMORY: 32768}))
+        s.update_node_metric(
+            NodeMetric(node_name=name, node_usage={}, update_time=99.0)
+        )
+    s.update_node_devices("n0", _gpu_entries(4))
+    # each wants 3 of the 4 GPUs: only one can be satisfied
+    g1 = PodSpec(name="g1", requests={R.CPU: 1000},
+                 device_requests={"nvidia.com/gpu": 3})
+    g2 = PodSpec(name="g2", requests={R.CPU: 1000},
+                 device_requests={"nvidia.com/gpu": 3})
+    s.add_pod(g1)
+    s.add_pod(g2)
+    out = s.schedule_pending(now=100.0)
+    placed = sorted(u for u, n in out.items() if n is not None)
+    assert placed == ["default/g1"]
+    assert out["default/g2"] is None

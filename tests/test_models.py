@@ -116,3 +116,52 @@ def test_quota_caps_through_model():
     out = PlacementModel().schedule(snap)
     assert out["default/a"] is not None
     assert out["default/b"] is None  # 9000 + 1000 > max 9000
+
+
+class TestPodBucketing:
+    def test_bucket_sizes(self):
+        from koordinator_tpu.models.placement import PlacementModel
+
+        b = PlacementModel.pod_bucket
+        assert b(1) == 64 and b(64) == 64
+        assert b(65) == 80          # steps of 16 below 128
+        assert b(100) == 112
+        assert b(1000) == 1024
+        assert b(1025) == 1280      # steps of 256 below 2048
+        for p in (1, 7, 65, 100, 999, 4097, 10000):
+            assert b(p) >= p
+            assert b(p) <= max(64, int(p * 1.25) + 1)
+
+    def test_bucketed_schedule_identical(self):
+        from koordinator_tpu.apis.extension import ResourceName as R
+        from koordinator_tpu.apis.types import (
+            ClusterSnapshot,
+            NodeMetric,
+            NodeSpec,
+            PodSpec,
+        )
+        from koordinator_tpu.models.placement import PlacementModel
+
+        def snap():
+            return ClusterSnapshot(
+                nodes=[
+                    NodeSpec(name=f"n{i}",
+                             allocatable={R.CPU: 16000, R.MEMORY: 32768})
+                    for i in range(3)
+                ],
+                pending_pods=[
+                    PodSpec(name=f"p{i}", requests={R.CPU: 1000 + 100 * i})
+                    for i in range(7)
+                ],
+                node_metrics={
+                    f"n{i}": NodeMetric(node_name=f"n{i}", node_usage={},
+                                        update_time=99.0)
+                    for i in range(3)
+                },
+                now=100.0,
+            )
+
+        bucketed = PlacementModel(pod_bucketing=True).schedule(snap())
+        plain = PlacementModel(pod_bucketing=False).schedule(snap())
+        assert dict(bucketed) == dict(plain)
+        assert len(bucketed) == 7  # padding never leaks into results

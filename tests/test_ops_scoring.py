@@ -238,3 +238,45 @@ def test_loadaware_score_matches_oracle():
                 ]
             )
             np.testing.assert_array_equal(got, want)
+
+
+class TestFloorDivExact:
+    def test_matches_integer_division_exhaustively(self):
+        """The reciprocal-multiply fast path is bit-identical to // for
+        the score value ranges (divisor static, quotient <= ~100R)."""
+        import numpy as np
+
+        from koordinator_tpu.ops.common import floor_div_exact, reciprocal_for
+
+        rng = np.random.default_rng(7)
+        cap = rng.choice(
+            [1, 3, 7, 100, 999, 16000, 65536, 10_700_000], size=4096
+        ).astype(np.int32)
+        y = (rng.integers(0, 101, 4096).astype(np.int64) * cap).astype(np.int32)
+        # perturb off exact multiples + boundary cases
+        y = np.concatenate([y, np.maximum(y - 1, 0), y + 1, np.zeros_like(y)])
+        cap4 = np.concatenate([cap] * 4)
+        recip = reciprocal_for(jnp.asarray(cap4))
+        got = np.asarray(
+            floor_div_exact(jnp.asarray(y), jnp.asarray(cap4), recip)
+        )
+        want = y.astype(np.int64) // np.maximum(cap4, 1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_score_identity(self):
+        import numpy as np
+
+        from koordinator_tpu.ops.common import (
+            least_requested_score,
+            reciprocal_for,
+        )
+
+        rng = np.random.default_rng(8)
+        cap = rng.choice([0, 1000, 16000, 32768, 10_700_000], size=(512, 6))
+        cap = jnp.asarray(cap.astype(np.int32))
+        requested = jnp.asarray(
+            rng.integers(0, 11_000_000, (512, 6)).astype(np.int32)
+        )
+        slow = least_requested_score(requested, cap)
+        fast = least_requested_score(requested, cap, reciprocal_for(cap))
+        np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
