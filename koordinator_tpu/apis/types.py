@@ -93,6 +93,15 @@ class PodSpec:
     # wall-clock seconds when this pod was assigned (for loadaware estimation
     # staleness rules, reference: load_aware.go:337-376)
     assign_time: float = 0.0
+    #: controller owner reference, "Kind/namespace/name" (metav1
+    #: OwnerReference with controller=true) — workload grouping for the
+    #: descheduler arbitrator and duplicate detection
+    owner: Optional[str] = None
+    #: required node selector (spec.nodeSelector) — the node-affinity
+    #: slice the compat descheduler plugin enforces
+    node_selector: Optional[Dict[str, str]] = None
+    #: Σ container restart counts (status) — TooManyRestarts input
+    restart_count: int = 0
 
     def __post_init__(self) -> None:
         if self.priority_class is None:

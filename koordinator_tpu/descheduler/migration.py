@@ -24,8 +24,10 @@ from koordinator_tpu.apis.types import (
 
 def _workload_of(pod: PodSpec) -> str:
     """Group key for per-workload limits (reference: arbitrator sort.go
-    getJobControllerOfPod — owner reference; here the trailing ordinal of
-    the pod name stands in for the replica-set owner)."""
+    getJobControllerOfPod — the controller owner reference). Pods without
+    an owner fall back to a label or the pod-name-stem heuristic."""
+    if pod.owner:
+        return pod.owner
     if "workload" in pod.labels:
         return pod.labels["workload"]
     base = pod.name.rsplit("-", 1)[0] if "-" in pod.name else pod.name

@@ -271,10 +271,17 @@ class PlacementModel:
             snapshot, node_arrays, pods_in_order
         )
 
-        # -- special pods: host Extras rows --------------------------------
+        # -- special pods + required node selectors: host Extras rows ------
+        # node selectors (the NodeAffinity slice the incremental fit
+        # plugin enforces) become per-pod row masks, AND-ed back after any
+        # refine-loop row refresh
         extras = None
         mask_np = score_np = None
-        if specials:
+        affinity_rows: Dict[int, np.ndarray] = {}
+        selector_pods = [
+            i for i, pod in enumerate(pods_in_order) if pod.node_selector
+        ]
+        if specials or selector_pods:
             p, n = len(pods_in_order), node_arrays.n
             mask_np = np.ones((p, n), bool)
             score_np = np.zeros((p, n), np.int32)
@@ -282,6 +289,21 @@ class PlacementModel:
                 mask_np[i], score_np[i] = fine.rows(
                     snapshot, pods_in_order[i], snapshot.nodes
                 )
+            if selector_pods:
+                from koordinator_tpu.apis.types import selector_matches
+
+                for i in selector_pods:
+                    selector = pods_in_order[i].node_selector
+                    row = np.fromiter(
+                        (
+                            selector_matches(selector, node.labels)
+                            for node in snapshot.nodes
+                        ),
+                        dtype=bool,
+                        count=n,
+                    )
+                    affinity_rows[i] = row
+                    mask_np[i] &= row
             extras = Extras(mask=jnp.asarray(mask_np), score=jnp.asarray(score_np))
 
         # -- pod-shape bucketing (compile amortization) ---------------------
@@ -335,6 +357,8 @@ class PlacementModel:
                 node = node_by_name[node_arrays.names[a]]
                 if not frozen:
                     m_row, s_row = fine.rows(snapshot, pod, snapshot.nodes)
+                    if i in affinity_rows:  # node selector always applies
+                        m_row = m_row & affinity_rows[i]
                     if not np.array_equal(m_row, mask_np[i]) or not np.array_equal(
                         s_row, score_np[i]
                     ):

@@ -70,11 +70,29 @@ class Plugin:
     def before_pre_filter(self, state: CycleState, snapshot, pod) -> bool:
         return False
 
+    def after_pre_filter(self, state: CycleState, snapshot, pod) -> None:
+        """Correct per-plugin cycle state after every PreFilter ran
+        (reference: PreFilterTransformer.AfterPreFilter,
+        interface.go:83-85)."""
+
     def pre_filter(self, state: CycleState, snapshot, pod) -> Status:
         return Status.success()
 
+    def before_filter(self, state: CycleState, snapshot, pod, node):
+        """May substitute the (pod, node) the Filter phase sees for this
+        node (reference: FilterTransformer.BeforeFilter,
+        interface.go:88-92). Return None to leave them unchanged, or a
+        ``(pod, node)`` pair."""
+        return None
+
     def filter(self, state: CycleState, snapshot, pod, node) -> Status:
         return Status.success()
+
+    def before_score(self, state: CycleState, snapshot, pod, nodes):
+        """May substitute the (pod, feasible nodes) the Score phase sees
+        (reference: ScoreTransformer.BeforeScore, interface.go:95-97).
+        Return None to leave them unchanged, or a ``(pod, nodes)`` pair."""
+        return None
 
     def score(self, state: CycleState, snapshot, pod, node) -> int:
         return 0
@@ -158,9 +176,21 @@ class SchedulingFramework:
 
         for plugin in self.plugins:
             plugin.before_pre_filter(state, snapshot, pod)
+        after_pre_filter_ran = False
+
+        def run_after_pre_filter():
+            # AfterPreFilter runs once, whatever ends the PreFilter phase
+            # (framework_extender.go:167-199 runs it on both outcomes)
+            nonlocal after_pre_filter_ran
+            if not after_pre_filter_ran:
+                after_pre_filter_ran = True
+                for plugin in self.plugins:
+                    plugin.after_pre_filter(state, snapshot, pod)
+
         for plugin in self.plugins:
             status = plugin.pre_filter(state, snapshot, pod)
             if not status.ok:
+                run_after_pre_filter()
                 # an unschedulable PreFilter verdict (e.g. quota admission)
                 # still reaches PostFilter, exactly as the k8s framework's
                 # scheduleOne error path does — this is how ElasticQuota
@@ -172,13 +202,23 @@ class SchedulingFramework:
                     pod.uid, None, "unschedulable", f"{plugin.name}: {status.reason}"
                 )
 
+        run_after_pre_filter()
+
         feasible: List[NodeSpec] = []
         for node in snapshot.nodes:
             if node.unschedulable:
                 continue
+            # BeforeFilter transformers may substitute the pod/node view
+            filter_pod, filter_node = pod, node
+            for plugin in self.plugins:
+                replaced = plugin.before_filter(
+                    state, snapshot, filter_pod, filter_node
+                )
+                if replaced is not None:
+                    filter_pod, filter_node = replaced
             ok = True
             for plugin in self.plugins:
-                status = plugin.filter(state, snapshot, pod, node)
+                status = plugin.filter(state, snapshot, filter_pod, filter_node)
                 if not status.ok:
                     if self.debug is not None:
                         self.debug.record_filter(pod.uid, node.name, plugin.name, status)
@@ -192,12 +232,29 @@ class SchedulingFramework:
                 return nominated
             return ScheduleOutcome(pod.uid, None, "unschedulable", "no feasible node")
 
+        # BeforeScore transformers may substitute the pod / feasible set
+        score_pod = pod
+        for plugin in self.plugins:
+            replaced = plugin.before_score(state, snapshot, score_pod, feasible)
+            if replaced is not None:
+                score_pod, feasible = replaced
+        if not feasible:
+            # a transformer filtered every candidate away
+            nominated = self._run_post_filter(state, snapshot, pod)
+            if nominated is not None:
+                return nominated
+            return ScheduleOutcome(
+                pod.uid, None, "unschedulable", "no feasible node after transformers"
+            )
+
         best_node, best_score = None, -1
         all_scores: Dict[str, int] = {}
         for node in feasible:
             total = 0
             for plugin in self.plugins:
-                total += plugin.score_weight() * plugin.score(state, snapshot, pod, node)
+                total += plugin.score_weight() * plugin.score(
+                    state, snapshot, score_pod, node
+                )
             all_scores[node.name] = total
             if total > best_score:
                 best_node, best_score = node, total

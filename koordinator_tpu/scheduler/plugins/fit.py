@@ -28,6 +28,15 @@ class NodeResourcesFit(Plugin):
         return self.weight
 
     def filter(self, state: CycleState, snapshot, pod, node) -> Status:
+        # required node selector (spec.nodeSelector — the slice of node
+        # affinity the upstream NodeAffinity filter enforces)
+        if pod.node_selector:
+            from koordinator_tpu.apis.types import selector_matches
+
+            if not selector_matches(pod.node_selector, node.labels):
+                return Status.unschedulable_(
+                    "node(s) didn't match Pod's node selector"
+                )
         view = node_view(state, snapshot)
         i = view.index[node.name]
         req = resources_to_vector(pod.requests)

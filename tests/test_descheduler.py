@@ -341,3 +341,77 @@ class TestMigration:
         evictor.jobs[1].create_time = 10.0
         admitted = Arbitrator().arbitrate(evictor.jobs, snapshot, [])
         assert admitted[0] is evictor.jobs[1]
+
+
+class TestK8sCompatPlugins:
+    """Reference: pkg/descheduler/framework/plugins/kubernetes/ adaptors."""
+
+    def _snapshot(self):
+        from koordinator_tpu.apis.extension import ResourceName as R
+        from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
+
+        return ClusterSnapshot(
+            nodes=[
+                NodeSpec(name="n0", allocatable={R.CPU: 16000},
+                         labels={"zone": "a"}),
+                NodeSpec(name="n1", allocatable={R.CPU: 16000},
+                         labels={"zone": "b"}),
+            ],
+            pods=[
+                PodSpec(name="aff-ok", node_name="n0",
+                        node_selector={"zone": "a"}),
+                PodSpec(name="aff-bad", node_name="n1",
+                        node_selector={"zone": "a"}),
+                PodSpec(name="restarty", node_name="n0", restart_count=150),
+                PodSpec(name="dup-1", node_name="n0",
+                        owner="ReplicaSet/default/web"),
+                PodSpec(name="dup-2", node_name="n0",
+                        owner="ReplicaSet/default/web"),
+                PodSpec(name="dup-3", node_name="n1",
+                        owner="ReplicaSet/default/web"),
+            ],
+        )
+
+    def test_node_affinity_violation_evicted(self):
+        from koordinator_tpu.descheduler.framework import DirectEvictor
+        from koordinator_tpu.descheduler.kubernetes import (
+            RemovePodsViolatingNodeAffinity,
+        )
+
+        snap = self._snapshot()
+        evictor = DirectEvictor()
+        RemovePodsViolatingNodeAffinity().deschedule(snap, evictor)
+        assert [p.name for p in evictor.evicted] == ["aff-bad"]
+
+    def test_too_many_restarts(self):
+        from koordinator_tpu.descheduler.framework import DirectEvictor
+        from koordinator_tpu.descheduler.kubernetes import (
+            RemovePodsHavingTooManyRestarts,
+        )
+
+        snap = self._snapshot()
+        evictor = DirectEvictor()
+        RemovePodsHavingTooManyRestarts(pod_restart_threshold=100).deschedule(
+            snap, evictor
+        )
+        assert [p.name for p in evictor.evicted] == ["restarty"]
+
+    def test_remove_duplicates_keeps_one_per_node(self):
+        from koordinator_tpu.descheduler.framework import DirectEvictor
+        from koordinator_tpu.descheduler.kubernetes import RemoveDuplicates
+
+        snap = self._snapshot()
+        evictor = DirectEvictor()
+        RemoveDuplicates().deschedule(snap, evictor)
+        # dup-1/dup-2 share (owner, n0): one evicted; dup-3 alone on n1 stays
+        assert [p.name for p in evictor.evicted] == ["dup-2"]
+
+
+def test_workload_of_prefers_owner_reference():
+    from koordinator_tpu.apis.types import PodSpec
+    from koordinator_tpu.descheduler.migration import _workload_of
+
+    pod = PodSpec(name="web-abc12", owner="ReplicaSet/default/web")
+    assert _workload_of(pod) == "ReplicaSet/default/web"
+    # fallback heuristics unchanged for owner-less pods
+    assert _workload_of(PodSpec(name="solo")) == "default/solo"

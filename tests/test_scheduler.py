@@ -151,3 +151,127 @@ def test_batch_and_incremental_agree():
         outcome = s_inc.schedule_one(uid, now=100.0)
         inc_out[uid] = outcome.node
     assert batch_out == inc_out
+
+class TestTransformerExtensionPoints:
+    """Reference: frameworkext/interface.go:78-97 — AfterPreFilter,
+    BeforeFilter, BeforeScore granularity (round-2 coverage item 4)."""
+
+    def test_full_transformer_chain(self):
+        import dataclasses
+
+        from koordinator_tpu.apis.types import ClusterSnapshot
+        from koordinator_tpu.scheduler.framework import (
+            Plugin,
+            SchedulingFramework,
+            Status,
+        )
+
+        calls = []
+
+        class Transformer(Plugin):
+            name = "T"
+
+            def before_pre_filter(self, state, snapshot, pod):
+                calls.append("before_pre_filter")
+                return False
+
+            def after_pre_filter(self, state, snapshot, pod):
+                calls.append("after_pre_filter")
+
+            def before_filter(self, state, snapshot, pod, node):
+                calls.append(f"before_filter:{node.name}")
+                # substitute a pod view with a bigger request
+                bigger = dataclasses.replace(
+                    pod, requests={R.CPU: pod.requests[R.CPU] * 10}
+                )
+                return bigger, node
+
+            def before_score(self, state, snapshot, pod, nodes):
+                calls.append("before_score")
+                # restrict scoring to n1
+                return pod, [n for n in nodes if n.name == "n1"]
+
+        class Fit(Plugin):
+            name = "Fit"
+
+            def filter(self, state, snapshot, pod, node):
+                # sees the transformed 10x request: only big nodes pass
+                if pod.requests[R.CPU] <= node.allocatable[R.CPU]:
+                    return Status.success()
+                return Status.unschedulable_("too big")
+
+        snapshot = ClusterSnapshot(
+            nodes=[
+                NodeSpec(name="n0", allocatable={R.CPU: 20000}),
+                NodeSpec(name="n1", allocatable={R.CPU: 20000}),
+                NodeSpec(name="small", allocatable={R.CPU: 1000}),
+            ],
+        )
+        fw = SchedulingFramework([Transformer(), Fit()])
+        pod = PodSpec(name="p", requests={R.CPU: 2000})
+        out = fw.schedule_one(snapshot, pod)
+        # transformed request (20000) fits n0/n1 but not small;
+        # before_score then restricts to n1
+        assert out.status == "bound" and out.node == "n1"
+        assert calls[0] == "before_pre_filter"
+        assert "after_pre_filter" in calls
+        assert any(c.startswith("before_filter:") for c in calls)
+        assert "before_score" in calls
+        # after_pre_filter ran before any filter
+        assert calls.index("after_pre_filter") < calls.index("before_filter:n0")
+
+    def test_after_pre_filter_runs_on_rejection(self):
+        from koordinator_tpu.apis.types import ClusterSnapshot
+        from koordinator_tpu.scheduler.framework import (
+            Plugin,
+            SchedulingFramework,
+            Status,
+        )
+
+        seen = []
+
+        class Gate(Plugin):
+            name = "Gate"
+
+            def pre_filter(self, state, snapshot, pod):
+                return Status.unschedulable_("nope")
+
+            def after_pre_filter(self, state, snapshot, pod):
+                seen.append("after")
+
+        fw = SchedulingFramework([Gate()])
+        out = fw.schedule_one(
+            ClusterSnapshot(nodes=[NodeSpec(name="n0")]),
+            PodSpec(name="p"),
+        )
+        assert out.status == "unschedulable"
+        assert seen == ["after"]
+
+
+def test_node_selector_enforced_on_both_paths():
+    """Required node selectors gate both the incremental fit Filter and
+    the batched solver (round-2 review fix: eviction/reschedule loop)."""
+    def mk():
+        s = Scheduler()
+        for name, zone in (("n0", "a"), ("n1", "b")):
+            s.add_node(NodeSpec(name=name,
+                                allocatable={R.CPU: 16000, R.MEMORY: 32768},
+                                labels={"zone": zone}))
+            s.update_node_metric(
+                NodeMetric(node_name=name, node_usage={}, update_time=99.0)
+            )
+        return s
+
+    sb = mk()
+    sb.add_pod(PodSpec(name="pin-b", requests={R.CPU: 1000},
+                       node_selector={"zone": "b"}))
+    sb.add_pod(PodSpec(name="pin-c", requests={R.CPU: 1000},
+                       node_selector={"zone": "c"}))
+    out = sb.schedule_pending(now=100.0)
+    assert out["default/pin-b"] == "n1"
+    assert out["default/pin-c"] is None
+
+    si = mk()
+    si.add_pod(PodSpec(name="pin-b", requests={R.CPU: 1000},
+                       node_selector={"zone": "b"}))
+    assert si.schedule_one("default/pin-b", now=100.0).node == "n1"
