@@ -91,7 +91,9 @@ def build_koordlet(
     from koordinator_tpu.koordlet.metriccache import MetricCache
     from koordinator_tpu.koordlet.metricsadvisor.collectors import (
         BEResourceCollector,
+        ColdMemoryCollector,
         NodeResourceCollector,
+        PageCacheCollector,
         PodResourceCollector,
         PSICollector,
         SysResourceCollector,
@@ -117,6 +119,7 @@ def build_koordlet(
         QoSContext,
         QoSManager,
         ResctrlReconcile,
+        SystemConfigReconcile,
     )
     from koordinator_tpu.koordlet.resourceexecutor import (
         ResourceUpdateExecutor,
@@ -153,6 +156,9 @@ def build_koordlet(
         collectors.append(PSICollector())
     if gates.enabled("CPICollector"):
         collectors.append(PerformanceCollector())
+    if gates.enabled("ColdPageCollector"):
+        collectors.append(ColdMemoryCollector())
+        collectors.append(PageCacheCollector())
     metrics_advisor = MetricsAdvisor(
         collector_ctx, collectors,
         interval_seconds=config.collect_interval_seconds,
@@ -184,8 +190,10 @@ def build_koordlet(
         strategies.append(CgroupResourcesReconcile())
     if gates.enabled("BlkIOReconcile"):
         strategies.append(BlkIOReconcile())
+    if gates.enabled("SystemConfig"):
+        strategies.append(SystemConfigReconcile())
     for strategy in strategies:
-        if strategy.name in ("resctrl", "cgreconcile", "blkio"):
+        if strategy.name in ("resctrl", "cgreconcile", "blkio", "sysreconcile"):
             strategy.interval_seconds = config.reconcile_interval_seconds
     qos_manager = QoSManager(qos_ctx, strategies)
 

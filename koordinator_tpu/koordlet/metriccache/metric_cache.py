@@ -49,6 +49,8 @@ class MetricKind(str, enum.Enum):
     CONTAINER_CPI = "container_cpi"              # cycles/instruction
     HOST_APP_CPU_USAGE = "host_app_cpu_usage"    # mCPU, label app=
     HOST_APP_MEMORY_USAGE = "host_app_memory_usage"
+    NODE_COLD_PAGE_BYTES = "node_cold_page_bytes"    # kidled cold file pages
+    NODE_PAGE_CACHE_MIB = "node_page_cache_mib"      # meminfo Cached
 
 
 class AggregationType(str, enum.Enum):

@@ -11,6 +11,9 @@ from koordinator_tpu.koordlet.qosmanager.cgreconcile import (
     CgroupResourcesReconcile,
 )
 from koordinator_tpu.koordlet.qosmanager.blkio import BlkIOReconcile
+from koordinator_tpu.koordlet.qosmanager.sysreconcile import (
+    SystemConfigReconcile,
+)
 
 __all__ = [
     "CPUInfo",
@@ -23,4 +26,5 @@ __all__ = [
     "ResctrlReconcile",
     "CgroupResourcesReconcile",
     "BlkIOReconcile",
+    "SystemConfigReconcile",
 ]

@@ -49,6 +49,10 @@ class BvtRule:
     pod_qos_params: Dict[QoSClass, int]
     kube_qos_dir_params: Dict[KubeQOS, int]
     kube_qos_pod_params: Dict[KubeQOS, int]
+    #: QoS classes whose pods get a shared core-scheduling cookie so SMT
+    #: siblings never co-run others' tasks (CPUQOS.core_expeller;
+    #: reference: the coresched hook driven by the same rule)
+    core_expeller_qos: frozenset = frozenset()
 
     def pod_bvt(self, qos: QoSClass, kube_qos: KubeQOS) -> int:
         """interceptor.go getPodBvtValue: koord QoS first, kube QoS
@@ -98,6 +102,15 @@ def parse_rule(slo: NodeSLOSpec) -> BvtRule:
             KubeQOS.BURSTABLE: ls_value,
             KubeQOS.BESTEFFORT: be_value,
         },
+        core_expeller_qos=frozenset(
+            qos
+            for qos, cfg in (
+                (QoSClass.LSE, strategy.lsr),
+                (QoSClass.LSR, strategy.lsr),
+                (QoSClass.LS, strategy.ls),
+            )
+            if cfg.enable and cfg.cpu.core_expeller
+        ),
     )
 
 
@@ -106,8 +119,10 @@ class BvtPlugin:
 
     name = NAME
 
-    def __init__(self):
+    def __init__(self, core_sched=None):
         self._rule: Optional[BvtRule] = None
+        #: optional CoreSched (system/core_sched.py) for the expeller
+        self.core_sched = core_sched
 
     # -- rule lifecycle ------------------------------------------------------
 
@@ -138,6 +153,30 @@ class BvtPlugin:
             Stage.PRE_RUN_POD_SANDBOX, self.name,
             "set bvt value for pod cgroup", self.set_pod_bvt,
         )
+
+    def apply_core_expeller(self, pods: List[PodMeta], pids_of) -> int:
+        """Tag each expeller-class pod's tasks with one shared
+        core-scheduling cookie (reference: the coresched hook applying the
+        CPUQOS core-expeller over PR_SCHED_CORE). ``pids_of(pod)`` reads
+        the pod's live pids; returns how many pods were tagged."""
+        r = self._rule
+        if (
+            r is None
+            or not r.core_expeller_qos
+            or self.core_sched is None
+            or not self.core_sched.supported()
+        ):
+            return 0
+        tagged = 0
+        for pod in pods:
+            if pod.qos not in r.core_expeller_qos:
+                continue
+            pids = list(pids_of(pod))
+            if not pids:
+                continue
+            self.core_sched.assign_group_cookie(pids[0], pids)
+            tagged += 1
+        return tagged
 
     # -- rule-update actuation (rule.go:148-222) -----------------------------
 

@@ -1,0 +1,196 @@
+"""sysreconcile, core scheduling, kidled cold pages, pagecache
+(remaining SURVEY §2.9 / coverage items 27-28/35).
+
+Reference: pkg/koordlet/qosmanager/plugins/sysreconcile/system_config.go,
+util/system/core_sched_linux.go, util/system/kidled_util.go,
+metricsadvisor/collectors/{coldmemoryresource,pagecache}.
+"""
+
+import os
+
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
+from koordinator_tpu.koordlet.metricsadvisor.collectors import (
+    ColdMemoryCollector,
+    PageCacheCollector,
+)
+from koordinator_tpu.koordlet.metricsadvisor.framework import CollectorContext
+from koordinator_tpu.koordlet.qosmanager import QoSContext, SystemConfigReconcile
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+from koordinator_tpu.koordlet.system.core_sched import (
+    CoreSched,
+    FakeKernel,
+    PIDTYPE_PID,
+)
+from koordinator_tpu.koordlet.system.kidled import (
+    Kidled,
+    parse_idle_page_stats,
+)
+from koordinator_tpu.manager.sloconfig import NodeSLOSpec, SystemStrategy
+
+
+class NoPods:
+    def running_pods(self):
+        return []
+
+
+def make_ctx(tmp_path, strategy, cap_mem=16384):
+    cfg = SystemConfig(cgroup_root=str(tmp_path / "cg"),
+                       proc_root=str(tmp_path / "proc"))
+    return QoSContext(
+        metric_cache=MetricCache(),
+        executor=ResourceUpdateExecutor(cfg, auditor=Auditor()),
+        pod_provider=NoPods(),
+        system_config=cfg,
+        node_slo=NodeSLOSpec(system_strategy=strategy),
+        node_capacity_mem_mib=cap_mem,
+    )
+
+
+class TestSysReconcile:
+    def test_writes_vm_knobs(self, tmp_path):
+        # 16 GiB node, factor 100/10000 -> min_free = 16*1024*1024*100/10000
+        ctx = make_ctx(tmp_path, SystemStrategy(
+            min_free_kbytes_factor=100, watermark_scale_factor=150,
+        ), cap_mem=16384)
+        s = SystemConfigReconcile()
+        assert s.enabled(ctx)
+        s.execute(ctx, now=1.0)
+        vm = tmp_path / "proc" / "sys" / "vm"
+        assert (vm / "min_free_kbytes").read_text() == str(
+            16384 * 1024 * 100 // 10000
+        )
+        assert (vm / "watermark_scale_factor").read_text() == "150"
+
+    def test_out_of_range_skipped(self, tmp_path):
+        ctx = make_ctx(tmp_path, SystemStrategy(
+            min_free_kbytes_factor=1, watermark_scale_factor=5000,
+        ), cap_mem=64)  # 64 MiB * 1/10000 = 6 kbytes < floor
+        SystemConfigReconcile().execute(ctx, now=1.0)
+        vm = tmp_path / "proc" / "sys" / "vm"
+        assert not (vm / "min_free_kbytes").exists()
+        assert not (vm / "watermark_scale_factor").exists()
+
+
+class TestCoreSched:
+    def test_cookie_lifecycle_on_fake_kernel(self):
+        kernel = FakeKernel()
+        cs = CoreSched(prctl=kernel.prctl)
+        assert cs.supported()
+        assert cs.get(100) == 0
+        assert cs.create(100, PIDTYPE_PID)
+        cookie = cs.get(100)
+        assert cookie and cookie > 0
+        assert cs.assign_group_cookie(100, [101, 102]) == 2
+        assert kernel.cookies[101] == cookie
+        assert kernel.cookies[102] == cookie
+
+    def test_unsupported_kernel(self):
+        cs = CoreSched(prctl=FakeKernel(supported=False).prctl)
+        assert not cs.supported()
+        assert cs.get(1) is None
+
+
+IDLE_STATS = """\
+# version: 1.0
+# scan_period_in_seconds: 120
+# use_hierarchy: 1
+# buckets: 1,2,5,15,30,60,120,240
+cfei 0 0 100 200 300 0 0 0
+dfei 0 0 0 50 0 0 0 0
+cfui 0 0 0 0 25 0 0 0
+dfui 0 0 0 0 0 0 0 0
+csei 999 0 0 0 0 0 0 0
+"""
+
+
+class TestKidled:
+    def test_parse_and_cold_bytes(self):
+        stats = parse_idle_page_stats(IDLE_STATS)
+        assert stats.scan_period_seconds == 120
+        assert stats.use_hierarchy == 1
+        assert stats.buckets == [1, 2, 5, 15, 30, 60, 120, 240]
+        # boundary 3: buckets [15,+inf) -> cfei 200+300, dfei 50, cfui 25
+        assert stats.cold_page_bytes(boundary=3) == 575
+        # csei is not a cold-page class
+        assert stats.cold_page_bytes(boundary=0) == 675
+
+    def test_collector(self, tmp_path):
+        cfg = SystemConfig(cgroup_root=str(tmp_path / "cg"),
+                           proc_root=str(tmp_path / "proc"))
+        cfg.sysfs_root = str(tmp_path / "sys")
+        kid_dir = tmp_path / "sys" / "kernel" / "mm" / "kidled"
+        kid_dir.mkdir(parents=True)
+        (kid_dir / "scan_period_in_seconds").write_text("120\n")
+        mem_root = tmp_path / "cg" / "memory"
+        mem_root.mkdir(parents=True)
+        (mem_root / "memory.idle_page_stats").write_text(IDLE_STATS)
+
+        mc = MetricCache()
+        ctx = CollectorContext(metric_cache=mc, system_config=cfg)
+        c = ColdMemoryCollector(cold_boundary=3)
+        c.setup(ctx)
+        assert c.enabled()
+        c.collect(now=1.0)
+        ts, vs = mc.query(MetricKind.NODE_COLD_PAGE_BYTES, None)
+        assert list(vs) == [575.0]
+
+        kidled = Kidled(cfg)
+        kidled.set_scan_period(60)
+        assert (kid_dir / "scan_period_in_seconds").read_text() == "60"
+
+
+def test_pagecache_collector(tmp_path):
+    proc = tmp_path / "proc"
+    proc.mkdir()
+    (proc / "meminfo").write_text(
+        "MemTotal: 16384000 kB\nCached: 2048000 kB\n"
+    )
+    cfg = SystemConfig(cgroup_root=str(tmp_path / "cg"), proc_root=str(proc))
+    mc = MetricCache()
+    ctx = CollectorContext(metric_cache=mc, system_config=cfg)
+    c = PageCacheCollector()
+    c.setup(ctx)
+    assert c.enabled()
+    c.collect(now=1.0)
+    ts, vs = mc.query(MetricKind.NODE_PAGE_CACHE_MIB, None)
+    assert list(vs) == [2048000 / 1024.0]
+
+
+def test_core_expeller_through_bvt_plugin():
+    """The core-expeller path: BvtPlugin tags expeller-class pods' task
+    groups with shared cookies via CoreSched (round-2 review wiring)."""
+    from koordinator_tpu.apis.extension import QoSClass
+    from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+    from koordinator_tpu.koordlet.runtimehooks.groupidentity import BvtPlugin
+    from koordinator_tpu.manager.sloconfig import (
+        CPUQOS,
+        QoSConfig,
+        ResourceQOSStrategy,
+    )
+
+    kernel = FakeKernel()
+    plugin = BvtPlugin(core_sched=CoreSched(prctl=kernel.prctl))
+    plugin.update_rule(NodeSLOSpec(
+        resource_qos_strategy=ResourceQOSStrategy(
+            lsr=QoSConfig(enable=True,
+                          cpu=CPUQOS(group_identity=2, core_expeller=True)),
+            ls=QoSConfig(enable=True, cpu=CPUQOS(group_identity=2)),
+        )
+    ))
+    assert QoSClass.LSR in plugin.rule.core_expeller_qos
+    assert QoSClass.LS not in plugin.rule.core_expeller_qos
+
+    pods = [
+        PodMeta(uid="lsr1", cgroup_dir="kubepods/podlsr1", qos=QoSClass.LSR),
+        PodMeta(uid="ls1", cgroup_dir="kubepods/burstable/podls1",
+                qos=QoSClass.LS),
+    ]
+    pids = {"lsr1": [10, 11, 12], "ls1": [20]}
+    tagged = plugin.apply_core_expeller(pods, lambda p: pids[p.uid])
+    assert tagged == 1
+    cookie = kernel.cookies[10]
+    assert cookie > 0
+    assert kernel.cookies[11] == cookie and kernel.cookies[12] == cookie
+    assert 20 not in kernel.cookies  # LS has no expeller
