@@ -1,0 +1,17 @@
+"""The in-process API-server bus: typed objects, watch/list, component
+wiring.
+
+Reference: SURVEY.md §1 — "the API server is the only cross-process bus":
+the five components never talk to each other directly; they watch and
+patch CRDs (pkg/client generated clientsets/informers/listers). Here the
+bus is a typed object store with synchronous watch fan-out
+(:class:`APIServer`) plus the informer-style adapters that subscribe each
+component (scheduler, manager, koordlet reporter) to the kinds it
+consumes and publish what it produces.
+"""
+
+from koordinator_tpu.client.bus import APIServer, Kind  # noqa: F401
+from koordinator_tpu.client.wiring import (  # noqa: F401
+    wire_manager,
+    wire_scheduler,
+)

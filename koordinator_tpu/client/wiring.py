@@ -1,0 +1,112 @@
+"""Informer adapters: subscribe components to the bus.
+
+Each wire_* mirrors the reference component's informer registrations
+(cmd/*/main.go + eventhandlers): the scheduler consumes
+Node/Pod/NodeMetric/Quota/PodGroup/Reservation/Device/NRT — with a
+DeleteFunc for every kind — and the manager consumes NodeMetric (+ pods
+via the snapshot) and PATCHes Node allocatable back onto the bus.
+"""
+
+from __future__ import annotations
+
+from koordinator_tpu.client.bus import APIServer, EventType, Kind
+
+
+def wire_scheduler(bus: APIServer, scheduler) -> None:
+    """Subscribe a Scheduler to every kind it consumes (the reference's
+    informer factory in cmd/koord-scheduler/app/server.go + frameworkext
+    eventhandlers)."""
+
+    def on_node(event, name, node):
+        if event is EventType.DELETED:
+            scheduler.remove_node(name)
+        else:
+            scheduler.add_node(node)
+
+    def on_pod(event, name, pod):
+        if event is EventType.DELETED:
+            scheduler.remove_pod(pod)
+        else:
+            # update_pod handles both first-sight and refresh without
+            # re-running quota/gang registration for status-only changes
+            scheduler.update_pod(pod)
+
+    def updater(update_fn, delete_fn):
+        def on_event(event, name, obj):
+            if event is EventType.DELETED:
+                delete_fn(name)
+            else:
+                update_fn(obj)
+
+        return on_event
+
+    bus.watch(Kind.NODE, on_node)
+    bus.watch(Kind.POD, on_pod)
+    bus.watch(
+        Kind.NODE_METRIC,
+        updater(scheduler.update_node_metric, scheduler.remove_node_metric),
+    )
+    bus.watch(
+        Kind.QUOTA, updater(scheduler.update_quota, scheduler.remove_quota)
+    )
+    bus.watch(Kind.GANG, updater(scheduler.update_gang, scheduler.remove_gang))
+    bus.watch(
+        Kind.RESERVATION,
+        updater(scheduler.update_reservation, scheduler.remove_reservation),
+    )
+
+    def on_nrt(event, name, topology):
+        if event is EventType.DELETED:
+            from koordinator_tpu.numa.manager import TopologyOptions
+
+            scheduler.update_node_topology(name, TopologyOptions())
+        else:
+            scheduler.update_node_topology(name, topology)
+
+    def on_device(event, name, entries):
+        scheduler.update_node_devices(
+            name, [] if event is EventType.DELETED else entries
+        )
+
+    bus.watch(Kind.NODE_RESOURCE_TOPOLOGY, on_nrt)
+    bus.watch(Kind.DEVICE, on_device)
+
+
+class ManagerLoop:
+    """The slo-controller noderesource reconcile loop over the bus
+    (SURVEY.md §3.3): NodeMetric + pods in, Node allocatable PATCH out."""
+
+    def __init__(self, bus: APIServer, controller):
+        self.bus = bus
+        self.controller = controller
+
+    def reconcile(self, now: float) -> int:
+        """One pass; returns how many nodes were synced back to the bus."""
+        from koordinator_tpu.apis.types import ClusterSnapshot
+
+        nodes = list(self.bus.list(Kind.NODE).values())
+        pods = [
+            p for p in self.bus.list(Kind.POD).values()
+            if getattr(p, "node_name", None) is not None
+        ]
+        snapshot = ClusterSnapshot(
+            nodes=nodes,
+            pods=pods,
+            node_metrics=self.bus.list(Kind.NODE_METRIC),
+            now=now,
+        )
+        updates = self.controller.reconcile_all(snapshot)
+        synced = 0
+        for update, node in zip(updates, snapshot.nodes):
+            if update.synced:
+                # the reference PATCHes Node.status.allocatable; here the
+                # mutated NodeSpec is re-applied, fanning out to watchers
+                self.bus.apply(Kind.NODE, node.name, node)
+                synced += 1
+        return synced
+
+
+def wire_manager(bus: APIServer, controller=None) -> ManagerLoop:
+    from koordinator_tpu.manager.noderesource import NodeResourceController
+
+    return ManagerLoop(bus, controller or NodeResourceController())

@@ -157,6 +157,69 @@ class Scheduler:
     def add_node(self, node: NodeSpec) -> None:
         self.cache.add_node(node)
 
+    def remove_node(self, name: str) -> None:
+        """Node deleted: drop the node and every per-node auxiliary state
+        (metric, NUMA topology, devices)."""
+        self.cache.remove_node(name)
+        self.cache.node_metrics.pop(name, None)
+        self.numa_manager.update_topology(name, TopologyOptions())
+        self.device_cache.update_node(name, [])
+
+    def remove_quota(self, name: str) -> None:
+        self.cache.quotas.pop(name, None)
+        tree_id = self.quota_registry.quota_tree.pop(name, "")
+        mgr = self.quota_registry.trees.get(tree_id)
+        if mgr is not None:
+            mgr.quotas.pop(name, None)
+            mgr._rebuild_children()
+
+    def remove_gang(self, name: str) -> None:
+        self.cache.gangs.pop(name, None)
+        record = self.gang_manager.gangs.pop(name, None)
+        if record is not None:
+            for uid in list(record.children):
+                self.gang_manager.pod_gang.pop(uid, None)
+        key = self.gang_manager.gang_group_key.pop(name, None)
+        group = self.gang_manager.groups.get(key) if key else None
+        if group is not None:
+            group.gangs.discard(name)
+            if not group.gangs:
+                self.gang_manager.groups.pop(key, None)
+
+    def remove_reservation(self, name: str) -> None:
+        self.cache.reservations.pop(name, None)
+
+    def remove_node_metric(self, name: str) -> None:
+        self.cache.node_metrics.pop(name, None)
+
+    def update_pod(self, pod: PodSpec) -> None:
+        """Pod object changed (the informer MODIFIED path). Accounting
+        side effects (quota/gang registration) only re-run when the
+        accounted fields actually changed — a status update must not
+        double-register requests."""
+        old = self.cache.pods.get(pod.uid) or self.cache.pending.get(pod.uid)
+        if old is None:
+            self.add_pod(pod)
+            return
+        if old is pod:
+            return
+        if (
+            old.quota != pod.quota
+            or old.requests != pod.requests
+            or old.gang != pod.gang
+            or old.preemptible != pod.preemptible
+        ):
+            self.remove_pod(old)
+            self.add_pod(pod)
+            return
+        # in-place object refresh preserving placement state
+        pod.node_name = old.node_name
+        pod.assign_time = old.assign_time
+        if pod.uid in self.cache.pods:
+            self.cache.pods[pod.uid] = pod
+        else:
+            self.cache.pending[pod.uid] = pod
+
     def update_node_metric(self, metric: NodeMetric) -> None:
         self.cache.update_node_metric(metric)
 

@@ -1,0 +1,166 @@
+"""The in-process API-server bus (coverage item 2): components coordinate
+only through watched objects, closing the reference's §3.2/§3.3 loop —
+koordlet reports NodeMetric → manager computes batch overcommit and
+patches Node → scheduler places a BE pod on the batch resources.
+"""
+
+
+
+from koordinator_tpu.apis.extension import QoSClass, ResourceName as R
+from koordinator_tpu.apis.types import (
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+)
+from koordinator_tpu.client import APIServer, Kind, wire_manager, wire_scheduler
+from koordinator_tpu.client.bus import EventType
+from koordinator_tpu.scheduler import Scheduler
+
+
+class TestBus:
+    def test_watch_replays_then_streams(self):
+        bus = APIServer()
+        bus.apply(Kind.NODE, "n0", NodeSpec(name="n0"))
+        events = []
+        bus.watch(Kind.NODE, lambda e, n, o: events.append((e, n)))
+        assert events == [(EventType.ADDED, "n0")]
+        bus.apply(Kind.NODE, "n0", NodeSpec(name="n0"))
+        bus.apply(Kind.NODE, "n1", NodeSpec(name="n1"))
+        bus.delete(Kind.NODE, "n0")
+        assert events == [
+            (EventType.ADDED, "n0"),
+            (EventType.MODIFIED, "n0"),
+            (EventType.ADDED, "n1"),
+            (EventType.DELETED, "n0"),
+        ]
+
+    def test_get_list(self):
+        bus = APIServer()
+        bus.apply(Kind.QUOTA, "t", QuotaSpec(name="t"))
+        assert bus.get(Kind.QUOTA, "t").name == "t"
+        assert list(bus.list(Kind.QUOTA)) == ["t"]
+        assert bus.get(Kind.QUOTA, "missing") is None
+
+
+class TestWiredScheduler:
+    def test_scheduler_follows_bus(self):
+        bus = APIServer()
+        s = Scheduler()
+        wire_scheduler(bus, s)
+        bus.apply(Kind.NODE, "n0", NodeSpec(
+            name="n0", allocatable={R.CPU: 16000, R.MEMORY: 32768}))
+        bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+            node_name="n0", node_usage={}, update_time=99.0))
+        pod = PodSpec(name="p", requests={R.CPU: 1000})
+        bus.apply(Kind.POD, "default/p", pod)
+        out = s.schedule_pending(now=100.0)
+        assert out["default/p"] == "n0"
+        bus.delete(Kind.POD, "default/p")
+        assert "default/p" not in s.cache.pods
+
+
+def test_full_colocation_loop_over_bus():
+    """§3.2 + §3.3 + §3.1 end-to-end: NodeMetric report → manager batch
+    overcommit PATCH → scheduler places a BE pod against batch-cpu."""
+    bus = APIServer()
+    scheduler = Scheduler()
+    wire_scheduler(bus, scheduler)
+    manager = wire_manager(bus)
+
+    # the node joins with native resources only (no batch columns yet)
+    node = NodeSpec(name="n0", allocatable={R.CPU: 32000, R.MEMORY: 65536})
+    bus.apply(Kind.NODE, "n0", node)
+
+    # a BE pod requesting batch-cpu cannot schedule yet
+    be_pod = PodSpec(name="be", qos=QoSClass.BE, priority=5500,
+                     requests={R.BATCH_CPU: 4000})
+    bus.apply(Kind.POD, "default/be", be_pod)
+    out = scheduler.schedule_pending(now=100.0)
+    assert out["default/be"] is None
+
+    # koordlet-side report lands on the bus: low prod usage -> large
+    # reclaimable batch capacity
+    bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+        node_name="n0",
+        node_usage={R.CPU: 6000, R.MEMORY: 8192},
+        sys_usage={R.CPU: 1000},
+        update_time=100.0,
+    ))
+
+    # manager reconcile: computes kubernetes.io/batch-* and PATCHes the
+    # node; the scheduler sees the new allocatable through its watch
+    synced = manager.reconcile(now=110.0)
+    assert synced == 1
+    patched = bus.get(Kind.NODE, "n0")
+    assert patched.allocatable.get(R.BATCH_CPU, 0) > 4000
+
+    out = scheduler.schedule_pending(now=120.0)
+    assert out["default/be"] == "n0"
+
+
+def test_modified_pod_does_not_double_count_quota():
+    """Informer MODIFIED events must not re-register quota requests
+    (round-2 review fix)."""
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.QUOTA, "t", QuotaSpec(name="t", min={R.CPU: 1000},
+                                         max={R.CPU: 8000}))
+    pod = PodSpec(name="p", quota="t", requests={R.CPU: 2000})
+    bus.apply(Kind.POD, "default/p", pod)
+    bus.apply(Kind.POD, "default/p", pod)      # status-ish refresh
+    import dataclasses
+
+    refreshed = dataclasses.replace(pod, labels={"x": "y"})
+    bus.apply(Kind.POD, "default/p", refreshed)
+    info = s.quota_manager.quotas["t"]
+    assert info.request[int(R.CPU)] == 2000    # not 4000/6000
+    bus.delete(Kind.POD, "default/p")
+    assert s.quota_manager.quotas["t"].request[int(R.CPU)] == 0
+
+
+def test_deletes_propagate_for_every_kind(tmp_path):
+    from koordinator_tpu.device.cache import DeviceEntry, DeviceType
+    from koordinator_tpu.device.cache import DeviceResourceName as DR
+    from koordinator_tpu.apis.types import (
+        GangSpec,
+        ReservationSpec,
+        ReservationState,
+    )
+    from koordinator_tpu.numa.hints import NUMATopologyPolicy
+    from koordinator_tpu.numa.manager import TopologyOptions
+    from koordinator_tpu.numa.topology import CPUTopology
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.NODE, "n0", NodeSpec(name="n0", allocatable={R.CPU: 16000}))
+    bus.apply(Kind.NODE_METRIC, "n0",
+              NodeMetric(node_name="n0", update_time=1.0))
+    bus.apply(Kind.QUOTA, "t", QuotaSpec(name="t", max={R.CPU: 100}))
+    bus.apply(Kind.GANG, "g", GangSpec(name="g", min_member=2))
+    bus.apply(Kind.RESERVATION, "r", ReservationSpec(
+        name="r", node_name="n0", state=ReservationState.AVAILABLE))
+    topo = CPUTopology.build(sockets=1, nodes_per_socket=1,
+                             cores_per_node=2, threads_per_core=2)
+    bus.apply(Kind.NODE_RESOURCE_TOPOLOGY, "n0", TopologyOptions(
+        cpu_topology=topo, policy=NUMATopologyPolicy.NONE,
+        numa_node_resources={0: {R.CPU: 4000}}))
+    bus.apply(Kind.DEVICE, "n0", [DeviceEntry(
+        minor=0, device_type=DeviceType.GPU, resources={DR.GPU_CORE: 100})])
+
+    for kind, name in ((Kind.QUOTA, "t"), (Kind.GANG, "g"),
+                       (Kind.RESERVATION, "r"), (Kind.NODE_METRIC, "n0"),
+                       (Kind.NODE_RESOURCE_TOPOLOGY, "n0"),
+                       (Kind.DEVICE, "n0")):
+        bus.delete(kind, name)
+    assert "t" not in s.cache.quotas and "t" not in s.quota_manager.quotas
+    assert "g" not in s.cache.gangs and "g" not in s.gang_manager.gangs
+    assert "r" not in s.cache.reservations
+    assert "n0" not in s.cache.node_metrics
+    assert not s.numa_manager.get_topology("n0").numa_node_resources
+    assert not s.device_cache.get("n0").device_infos
+
+    bus.delete(Kind.NODE, "n0")
+    assert "n0" not in s.cache.nodes
