@@ -90,5 +90,28 @@ class QuotaTreeRegistry:
                 anc.non_preemptible_used + sign * np_used, 0
             )
 
+    def remove_quota(self, name: str) -> None:
+        """Quota deleted: withdraw its propagated request/used from the
+        old ancestors (the tree-move withdraw), then drop the node."""
+        tree_id = self.quota_tree.pop(name, "")
+        mgr = self.trees.get(tree_id)
+        if mgr is None:
+            return
+        info = mgr.quotas.get(name)
+        if info is not None:
+            self._shift_accounting(
+                mgr,
+                name,
+                (
+                    info.child_request.copy(),
+                    info.non_preemptible_request.copy(),
+                    info.used.copy(),
+                    info.non_preemptible_used.copy(),
+                ),
+                sign=-1,
+            )
+            mgr.quotas.pop(name, None)
+            mgr._rebuild_children()
+
     def items(self) -> Iterable[Tuple[str, GroupQuotaManager]]:
         return self.trees.items()

@@ -167,20 +167,22 @@ class Scheduler:
 
     def remove_quota(self, name: str) -> None:
         self.cache.quotas.pop(name, None)
-        tree_id = self.quota_registry.quota_tree.pop(name, "")
-        mgr = self.quota_registry.trees.get(tree_id)
-        if mgr is not None:
-            mgr.quotas.pop(name, None)
-            mgr._rebuild_children()
+        # the registry withdraws the quota's propagated accounting from
+        # its ancestors before dropping the node
+        self.quota_registry.remove_quota(name)
 
     def remove_gang(self, name: str) -> None:
         self.cache.gangs.pop(name, None)
         record = self.gang_manager.gangs.pop(name, None)
+        key = self.gang_manager.gang_group_key.pop(name, None)
+        group = self.gang_manager.groups.get(key) if key else None
         if record is not None:
             for uid in list(record.children):
                 self.gang_manager.pod_gang.pop(uid, None)
-        key = self.gang_manager.gang_group_key.pop(name, None)
-        group = self.gang_manager.groups.get(key) if key else None
+                if group is not None:
+                    # stale cycle attempts would wedge the group's
+                    # schedule cycle (ganggroup.go:101-124 counts them)
+                    group.child_cycle.pop(uid, None)
         if group is not None:
             group.gangs.discard(name)
             if not group.gangs:
@@ -203,18 +205,34 @@ class Scheduler:
             return
         if old is pod:
             return
-        if (
+        accounted_changed = (
             old.quota != pod.quota
             or old.requests != pod.requests
             or old.gang != pod.gang
             or old.preemptible != pod.preemptible
-        ):
+        )
+        assigned = old.node_name is not None
+        if accounted_changed and not assigned:
             self.remove_pod(old)
             self.add_pod(pod)
             return
-        # in-place object refresh preserving placement state
+        # object refresh preserving placement state
         pod.node_name = old.node_name
         pod.assign_time = old.assign_time
+        if accounted_changed:
+            # assigned pod with changed accounting: swap the quota
+            # request AND used deltas in place — a remove/add round trip
+            # would drop the 'used' accounting (add_pod never re-accounts
+            # already-assigned pods) and the NUMA/device holds
+            self._quota_plugin.on_pod_delete(old)
+            self._account_quota(old, release=True)
+            if old.gang != pod.gang:
+                self.gang_manager.on_pod_delete(pod.uid)
+                if pod.gang:
+                    self.gang_manager.on_pod_add(pod.uid, pod.gang)
+                    self.gang_manager.on_pod_bound(pod.uid)
+            self._quota_plugin.on_pod_add(pod)
+            self._account_quota(pod)
         if pod.uid in self.cache.pods:
             self.cache.pods[pod.uid] = pod
         else:

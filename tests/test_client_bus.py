@@ -164,3 +164,91 @@ def test_deletes_propagate_for_every_kind(tmp_path):
 
     bus.delete(Kind.NODE, "n0")
     assert "n0" not in s.cache.nodes
+
+
+def test_quota_delete_withdraws_parent_accounting():
+    """Deleting a child quota must withdraw its propagated request from
+    ancestors (round-2 review fix)."""
+    bus = APIServer()
+    s = Scheduler(cluster_total={R.CPU: 100000})
+    wire_scheduler(bus, s)
+    bus.apply(Kind.QUOTA, "parent", QuotaSpec(
+        name="parent", is_parent=True, min={R.CPU: 10000}, max={R.CPU: 50000}))
+    bus.apply(Kind.QUOTA, "child", QuotaSpec(
+        name="child", parent="parent", min={R.CPU: 1000}, max={R.CPU: 50000}))
+    pod = PodSpec(name="p", quota="child", requests={R.CPU: 2000})
+    bus.apply(Kind.POD, "default/p", pod)
+    assert s.quota_manager.quotas["parent"].child_request[int(R.CPU)] == 2000
+    bus.delete(Kind.QUOTA, "child")
+    assert "child" not in s.quota_manager.quotas
+    assert s.quota_manager.quotas["parent"].child_request[int(R.CPU)] == 0
+
+
+def test_assigned_pod_request_update_keeps_used_accounted():
+    """A MODIFIED event changing an assigned pod's requests swaps the
+    quota used in place instead of dropping it (round-2 review fix)."""
+    import dataclasses
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.NODE, "n0", NodeSpec(
+        name="n0", allocatable={R.CPU: 16000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+        node_name="n0", node_usage={}, update_time=99.0))
+    bus.apply(Kind.QUOTA, "t", QuotaSpec(name="t", min={R.CPU: 1000},
+                                         max={R.CPU: 10000}))
+    pod = PodSpec(name="p", quota="t", requests={R.CPU: 2000})
+    bus.apply(Kind.POD, "default/p", pod)
+    s.schedule_pending(now=100.0)
+    live = s.cache.pods["default/p"]
+    assert live.node_name == "n0"
+    assert s.quota_manager.quotas["t"].used[int(R.CPU)] == 2000
+
+    resized = dataclasses.replace(live, requests={R.CPU: 3000})
+    bus.apply(Kind.POD, "default/p", resized)
+    updated = s.cache.pods["default/p"]
+    assert updated.node_name == "n0"            # placement preserved
+    assert s.quota_manager.quotas["t"].used[int(R.CPU)] == 3000
+    assert s.quota_manager.quotas["t"].request[int(R.CPU)] == 3000
+
+
+def test_gang_delete_unwedges_group_cycle():
+    """Deleting a gang clears its children's schedule-cycle attempts so
+    sibling gangs in the group can proceed (round-2 review fix)."""
+    from koordinator_tpu.apis.types import GangSpec
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.NODE, "n0", NodeSpec(
+        name="n0", allocatable={R.CPU: 16000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+        node_name="n0", node_usage={}, update_time=99.0))
+    bus.apply(Kind.GANG, "g1", GangSpec(name="g1", min_member=2,
+                                        gang_group=["g1", "g2"]))
+    bus.apply(Kind.GANG, "g2", GangSpec(name="g2", min_member=2,
+                                        gang_group=["g1", "g2"]))
+    for g in ("g1", "g2"):
+        for i in range(2):
+            bus.apply(Kind.POD, f"default/{g}-{i}",
+                      PodSpec(name=f"{g}-{i}", gang=g,
+                              requests={R.CPU: 99000}))  # never fits
+    # everyone attempts and fails; strict rejection invalidates the cycle
+    for g in ("g1", "g2"):
+        for i in range(2):
+            s.schedule_one(f"default/{g}-{i}", now=100.0)
+
+    # g2 (and its pods) go away; g1's pods shrink to schedulable size
+    bus.delete(Kind.GANG, "g2")
+    for i in range(2):
+        bus.delete(Kind.POD, f"default/g2-{i}")
+        bus.apply(Kind.POD, f"default/g1-{i}",
+                  PodSpec(name=f"g1-{i}", gang="g1", requests={R.CPU: 1000}))
+    # first round records the cycle attempts (rejections count, matching
+    # the reference's deferred setChildScheduleCycle); the cycle then
+    # re-opens and the second round places the gang
+    [s.schedule_one(f"default/g1-{i}", now=101.0) for i in range(2)]
+    outcomes = [s.schedule_one(f"default/g1-{i}", now=102.0) for i in range(2)]
+    assert {o.status for o in outcomes} <= {"waiting", "bound"}
+    assert outcomes[-1].status == "bound"  # barrier opened
