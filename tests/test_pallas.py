@@ -1,0 +1,103 @@
+"""Pallas placement kernel: bit-identity vs the scan solver (interpret
+mode — the TPU path is exercised by bench.py on hardware)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName as R
+from koordinator_tpu.ops.binpack import (
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    schedule_batch,
+)
+from koordinator_tpu.ops.pallas_binpack import (
+    pallas_schedule_batch,
+    pallas_supported,
+)
+
+
+def _problem(n_nodes=96, n_pods=150, seed=0, stale_frac=0.2,
+             unsched_frac=0.1, ds_frac=0.2, blocked_frac=0.1):
+    rng = np.random.default_rng(seed)
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+    alloc[:, R.CPU] = rng.choice([4000, 16000, 64000], n_nodes)
+    alloc[:, R.MEMORY] = rng.choice([8192, 32768], n_nodes)
+    usage = (alloc * rng.uniform(0, 0.9, alloc.shape)).astype(np.int32)
+    state = NodeState(
+        alloc=jnp.asarray(alloc),
+        used_req=jnp.asarray((alloc * rng.uniform(0, 0.3, alloc.shape)).astype(np.int32)),
+        usage=jnp.asarray(usage),
+        prod_usage=jnp.asarray(usage // 2),
+        est_extra=jnp.asarray((usage // 4)),
+        prod_base=jnp.asarray(usage // 3),
+        metric_fresh=jnp.asarray(rng.uniform(size=n_nodes) > stale_frac),
+        schedulable=jnp.asarray(rng.uniform(size=n_nodes) > unsched_frac),
+    )
+    req = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+    req[:, R.CPU] = rng.choice([500, 1000, 4000, 100000], n_pods)
+    req[:, R.MEMORY] = rng.choice([0, 1024, 4096], n_pods)
+    pods = PodBatch.build(
+        req=jnp.asarray(req),
+        est=jnp.asarray((req * 85) // 100),
+        is_prod=jnp.asarray(rng.uniform(size=n_pods) < 0.5),
+        is_daemonset=jnp.asarray(rng.uniform(size=n_pods) < ds_frac),
+        blocked=jnp.asarray(rng.uniform(size=n_pods) < blocked_frac),
+    )
+    weights = np.zeros(NUM_RESOURCES, np.int32)
+    weights[R.CPU] = 1
+    weights[R.MEMORY] = 1
+    thresholds = np.zeros(NUM_RESOURCES, np.int32)
+    thresholds[R.CPU] = 65
+    thresholds[R.MEMORY] = 95
+    params = ScoreParams(
+        weights=jnp.asarray(weights),
+        thresholds=jnp.asarray(thresholds),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+    return state, pods, params
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_identical_to_scan(seed):
+    state, pods, params = _problem(seed=seed)
+    config = SolverConfig()
+    want_state, want = schedule_batch(state, pods, params, config)
+    got_state, got = pallas_schedule_batch(
+        state, pods, params, config, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    for field in ("used_req", "est_extra", "prod_base"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got_state, field)),
+            np.asarray(getattr(want_state, field)),
+            err_msg=field,
+        )
+
+
+def test_supported_gate():
+    state, pods, params = _problem()
+    assert pallas_supported(params, SolverConfig())
+    assert not pallas_supported(params, SolverConfig(score_according_prod=True))
+    prod = params._replace(
+        prod_thresholds=jnp.full(NUM_RESOURCES, 50, jnp.int32)
+    )
+    assert not pallas_supported(prod, SolverConfig())
+    with pytest.raises(ValueError):
+        pallas_schedule_batch(
+            state, pods, params, SolverConfig(score_according_prod=True)
+        )
+
+
+def test_nonaligned_sizes():
+    # N and P not multiples of 128 exercise the padding paths
+    state, pods, params = _problem(n_nodes=33, n_pods=41, seed=3)
+    config = SolverConfig()
+    _, want = schedule_batch(state, pods, params, config)
+    _, got = pallas_schedule_batch(
+        state, pods, params, config, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
