@@ -2,6 +2,7 @@
 gating as the deciding factor (not masked by fit rejection)."""
 
 import numpy as np
+import pytest
 
 from koordinator_tpu.apis.extension import ResourceName as R
 from koordinator_tpu.apis.types import (
@@ -165,3 +166,82 @@ class TestPodBucketing:
         plain = PlacementModel(pod_bucketing=False).schedule(snap())
         assert dict(bucketed) == dict(plain)
         assert len(bucketed) == 7  # padding never leaks into results
+
+
+class TestRandomizedDifferential:
+    """Broad randomized sweep: the batched solver must equal the
+    pure-python sequential oracle on arbitrary cluster shapes (stale
+    metrics, unschedulable nodes, daemonsets, prod mix, zero requests,
+    tight capacity)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_batched_equals_oracle(self, seed):
+        import numpy as np
+
+        from koordinator_tpu.apis.extension import NUM_RESOURCES
+        from koordinator_tpu.apis.extension import ResourceName as R
+        from koordinator_tpu.oracle.placement import schedule_sequential
+        from koordinator_tpu.ops.binpack import (
+            NodeState,
+            PodBatch,
+            ScoreParams,
+            SolverConfig,
+            schedule_batch,
+        )
+
+        rng = np.random.default_rng(seed)
+        n_nodes = int(rng.integers(3, 40))
+        n_pods = int(rng.integers(5, 80))
+        alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+        alloc[:, R.CPU] = rng.choice([2000, 8000, 32000], n_nodes)
+        alloc[:, R.MEMORY] = rng.choice([0, 4096, 32768], n_nodes)
+        usage = (alloc * rng.uniform(0, 1.0, alloc.shape)).astype(np.int32)
+        used0 = (alloc * rng.uniform(0, 0.4, alloc.shape)).astype(np.int32)
+        est_extra = (usage * rng.uniform(0, 0.3, usage.shape)).astype(np.int32)
+        prod_base = (usage * rng.uniform(0, 0.5, usage.shape)).astype(np.int32)
+        fresh = rng.uniform(size=n_nodes) > 0.25
+        sched = rng.uniform(size=n_nodes) > 0.1
+        req = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+        req[:, R.CPU] = rng.choice([0, 250, 1000, 6000, 50000], n_pods)
+        req[:, R.MEMORY] = rng.choice([0, 512, 8192], n_pods)
+        est = (req * 85) // 100
+        is_prod = rng.uniform(size=n_pods) < 0.5
+        is_ds = rng.uniform(size=n_pods) < 0.15
+        weights = np.zeros(NUM_RESOURCES, np.int32)
+        weights[R.CPU] = int(rng.integers(1, 3))
+        weights[R.MEMORY] = int(rng.integers(1, 3))
+        thresholds = np.zeros(NUM_RESOURCES, np.int32)
+        thresholds[R.CPU] = 65
+        thresholds[R.MEMORY] = 95
+        prod_thresholds = np.zeros(NUM_RESOURCES, np.int32)
+        score_prod = bool(rng.integers(0, 2))
+        if score_prod:
+            prod_thresholds[R.CPU] = 70
+
+        import jax.numpy as jnp
+
+        state = NodeState(
+            alloc=jnp.asarray(alloc), used_req=jnp.asarray(used0),
+            usage=jnp.asarray(usage), prod_usage=jnp.asarray(usage // 2),
+            est_extra=jnp.asarray(est_extra),
+            prod_base=jnp.asarray(prod_base),
+            metric_fresh=jnp.asarray(fresh), schedulable=jnp.asarray(sched),
+        )
+        pods = PodBatch.build(
+            req=jnp.asarray(req), est=jnp.asarray(est),
+            is_prod=jnp.asarray(is_prod), is_daemonset=jnp.asarray(is_ds),
+        )
+        params = ScoreParams(
+            weights=jnp.asarray(weights),
+            thresholds=jnp.asarray(thresholds),
+            prod_thresholds=jnp.asarray(prod_thresholds),
+        )
+        config = SolverConfig(score_according_prod=score_prod)
+        _, got = schedule_batch(state, pods, params, config)
+        want = schedule_sequential(
+            alloc, used0, usage, usage // 2, est_extra, prod_base,
+            fresh, sched, req, est, is_prod, is_ds,
+            weights, thresholds, prod_thresholds,
+            score_according_prod=score_prod,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
