@@ -124,8 +124,9 @@ def bench_flagship(repeats):
                   file=sys.stderr)
 
     # p99 round latency (the BASELINE metric pairs pods/s with p99
-    # schedule latency): distribution over extra timed rounds
-    lat_rounds = max(10, repeats)
+    # schedule latency): interpolated over 20+ timed rounds (fewer would
+    # make "p99" just the single worst sample)
+    lat_rounds = max(20, repeats)
     lats = []
     for _i in range(lat_rounds):
         t0 = time.time()

@@ -149,6 +149,12 @@ class NodeMetric:
     )
     # percentile -> usage, for aggregated usage mode (p50/p90/p95/p99)
     aggregated_usage: Dict[int, Resources] = dataclasses.field(default_factory=dict)
+    # host application name -> usage (reference: NodeMetric
+    # HostApplicationMetric list, which also carries the app's QoS)
+    host_app_usages: Dict[str, Resources] = dataclasses.field(
+        default_factory=dict
+    )
+    host_app_qos: Dict[str, QoSClass] = dataclasses.field(default_factory=dict)
     update_time: float = 0.0
     report_interval: float = 60.0
 

@@ -92,6 +92,7 @@ def build_koordlet(
     from koordinator_tpu.koordlet.metricsadvisor.collectors import (
         BEResourceCollector,
         ColdMemoryCollector,
+        HostApplicationCollector,
         NodeResourceCollector,
         PageCacheCollector,
         PodResourceCollector,
@@ -151,6 +152,7 @@ def build_koordlet(
         PodResourceCollector(),
         BEResourceCollector(),
         SysResourceCollector(),
+        HostApplicationCollector(slo_provider=states_informer.get_node_slo),
     ]
     if gates.enabled("PSICollector"):
         collectors.append(PSICollector())

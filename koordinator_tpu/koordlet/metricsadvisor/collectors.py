@@ -425,3 +425,51 @@ class PageCacheCollector:
                         return
         except (OSError, ValueError, IndexError):
             return
+
+
+class HostApplicationCollector:
+    """Host-application usage collector (reference: collectors/
+    hostapplication): per NodeSLO host app, read its cgroup cpu/memory
+    and append HOST_APP_* samples with the app label. The informer's
+    NodeSLO carries the app list (statesinformer.get_node_slo)."""
+
+    name = "hostapplication"
+
+    def __init__(self, slo_provider=None):
+        #: callable returning the current NodeSLOSpec (the informer)
+        self.slo_provider = slo_provider
+        self.ctx: Optional[CollectorContext] = None
+        self._rates = _RateTracker()
+
+    def setup(self, ctx: CollectorContext) -> None:
+        self.ctx = ctx
+
+    def enabled(self) -> bool:
+        return self.slo_provider is not None
+
+    def collect(self, now: float) -> None:
+        ctx = self.ctx
+        cfg = ctx.system_config
+        slo = self.slo_provider()
+        raw = getattr(slo, "host_applications", None) or []
+        # duplicate names would interleave unrelated cumulative counters
+        # through one rate-tracker key (garbage rates) — first wins
+        apps = list({app.name: app for app in reversed(raw)}.values())[::-1]
+        for app in apps:
+            if not app.cgroup_dir:
+                continue
+            ns = read_cgroup_cpu_ns(app.cgroup_dir, cfg)
+            if ns is not None:
+                rate = self._rates.rate(f"hostapp:{app.name}", now, float(ns))
+                if rate is not None:
+                    ctx.metric_cache.append(
+                        MetricKind.HOST_APP_CPU_USAGE, {"app": app.name},
+                        now, rate / 1e9 * 1000.0,
+                    )
+            mem = read_cgroup_memory_mib(app.cgroup_dir, cfg)
+            if mem is not None:
+                ctx.metric_cache.append(
+                    MetricKind.HOST_APP_MEMORY_USAGE, {"app": app.name},
+                    now, mem,
+                )
+        self._rates.forget_missing([f"hostapp:{a.name}" for a in apps])

@@ -141,6 +141,30 @@ class NodeMetricReporter:
         if sys_aggs[1][A.AVG] is not None:
             metric.sys_usage[ResourceName.MEMORY] = int(sys_aggs[1][A.AVG])
 
+        # host applications (reference: NodeMetric HostApplicationMetric)
+        apps = self.informer.get_node_slo().host_applications
+        if apps:
+            app_reqs = []
+            for app in apps:
+                app_reqs.append(
+                    (MetricKind.HOST_APP_CPU_USAGE, {"app": app.name})
+                )
+                app_reqs.append(
+                    (MetricKind.HOST_APP_MEMORY_USAGE, {"app": app.name})
+                )
+            app_aggs = mc.aggregate_batch(app_reqs, start, now, [A.AVG])
+            for i, app in enumerate(apps):
+                usage = {}
+                cpu = app_aggs[2 * i][A.AVG]
+                mem = app_aggs[2 * i + 1][A.AVG]
+                if cpu is not None:
+                    usage[ResourceName.CPU] = int(cpu)
+                if mem is not None:
+                    usage[ResourceName.MEMORY] = int(mem)
+                if usage:
+                    metric.host_app_usages[app.name] = usage
+                    metric.host_app_qos[app.name] = app.qos
+
         # predictor: prod reclaimable (feeds MID resources)
         if self.predict_server is not None:
             rec = prod_reclaimable(

@@ -233,6 +233,15 @@ class NodeResourceController:
             fresh[i] = _is_metric_fresh(metric, strategies[i], snapshot.now)
             if metric is not None:
                 system_used[i] = resources_to_vector(metric.sys_usage)
+                # BE host applications run on reclaimed resources: their
+                # usage must not shrink batch capacity (reference:
+                # batchresource plugin — hostAppBEUsed subtracted from
+                # systemUsed, clamped at zero)
+                for app, usage in metric.host_app_usages.items():
+                    if metric.host_app_qos.get(app) == QoSClass.BE:
+                        system_used[i] = np.maximum(
+                            system_used[i] - resources_to_vector(usage), 0
+                        )
                 prod_reclaimable[i] = resources_to_vector(
                     metric.prod_reclaimable
                 )

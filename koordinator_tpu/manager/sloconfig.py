@@ -256,6 +256,18 @@ class SystemStrategy:
 
 
 @dataclasses.dataclass
+class HostApplicationSpec:
+    """A non-pod host process under QoS management (reference:
+    slov1alpha1 host_application.go HostApplicationSpec): named, with a
+    QoS class and the cgroup directory its processes live in."""
+
+    name: str
+    qos: QoSClass = QoSClass.NONE
+    cgroup_dir: str = ""
+    priority: int = 0
+
+
+@dataclasses.dataclass
 class NodeSLOSpec:
     """The rendered per-node SLO (reference: slov1alpha1.NodeSLOSpec)."""
 
@@ -270,6 +282,9 @@ class NodeSLOSpec:
     )
     system_strategy: SystemStrategy = dataclasses.field(
         default_factory=SystemStrategy
+    )
+    host_applications: List[HostApplicationSpec] = dataclasses.field(
+        default_factory=list
     )
     extensions: Dict[str, object] = dataclasses.field(default_factory=dict)
 

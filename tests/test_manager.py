@@ -535,3 +535,41 @@ class TestNodeSLO:
         s = cfg.strategy_for_node({"pool": "x"})
         assert s.enable and s.cpu_reclaim_threshold_percent == 70
         assert s.memory_reclaim_threshold_percent == 50
+
+
+def test_be_host_app_usage_excluded_from_system_used():
+    """BE host applications run on reclaimed resources: their usage is
+    subtracted from system used so it doesn't shrink batch capacity
+    (reference: batchresource hostAppBEUsed; round-2 review fix)."""
+    from koordinator_tpu.apis.extension import QoSClass, ResourceName as R
+    from koordinator_tpu.apis.types import ClusterSnapshot, NodeMetric, NodeSpec
+    from koordinator_tpu.manager.noderesource import NodeResourceController
+
+    def snap(with_be_app):
+        metric = NodeMetric(
+            node_name="n0",
+            node_usage={R.CPU: 10000, R.MEMORY: 8192},
+            sys_usage={R.CPU: 4000, R.MEMORY: 2048},
+            update_time=100.0,
+        )
+        if with_be_app:
+            metric.host_app_usages["miner"] = {R.CPU: 3000, R.MEMORY: 1024}
+            metric.host_app_qos["miner"] = QoSClass.BE
+        return ClusterSnapshot(
+            nodes=[NodeSpec(name="n0",
+                            allocatable={R.CPU: 32000, R.MEMORY: 65536})],
+            node_metrics={"n0": metric},
+            now=110.0,
+        )
+
+    ctrl = NodeResourceController()
+    plain = snap(False)
+    ctrl.reconcile_all(plain)
+    without_app = plain.nodes[0].allocatable.get(R.BATCH_CPU, 0)
+
+    ctrl2 = NodeResourceController()
+    s2 = snap(True)
+    ctrl2.reconcile_all(s2)
+    with_be_app = s2.nodes[0].allocatable.get(R.BATCH_CPU, 0)
+    # the BE app's 3000m is returned to batch capacity
+    assert with_be_app == without_app + 3000

@@ -293,3 +293,60 @@ class TestPLEG:
         ensure_cgroup_dir("kubepods/podX", cfg)
         pleg.poll()
         assert len(got) == 1 and got[0].event == EventType.POD_ADDED
+
+
+def test_host_application_collection_and_report(tmp_path):
+    """Host apps (NodeSLO hostApplications): collector reads their cgroup
+    usage, the reporter publishes per-app usage on the NodeMetric
+    (reference: collectors/hostapplication + HostApplicationMetric)."""
+    import os
+
+    from koordinator_tpu.apis.extension import ResourceName as R
+    from koordinator_tpu.apis.types import NodeSpec
+    from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
+    from koordinator_tpu.koordlet.metricsadvisor.collectors import (
+        HostApplicationCollector,
+    )
+    from koordinator_tpu.koordlet.metricsadvisor.framework import (
+        CollectorContext,
+    )
+    from koordinator_tpu.koordlet.statesinformer import (
+        NodeMetricReporter,
+        StatesInformer,
+    )
+    from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+    from koordinator_tpu.manager.sloconfig import (
+        HostApplicationSpec,
+        NodeSLOSpec,
+    )
+
+    cfg = SystemConfig(cgroup_root=str(tmp_path / "cg"),
+                       proc_root=str(tmp_path / "proc"))
+    app_dir = "host-latency-sensitive/nginx"
+    for sub in ("cpuacct", "memory"):
+        os.makedirs(tmp_path / "cg" / sub / app_dir, exist_ok=True)
+    cpu_path = tmp_path / "cg" / "cpuacct" / app_dir / "cpuacct.usage"
+    mem_path = tmp_path / "cg" / "memory" / app_dir / "memory.usage_in_bytes"
+    mem_path.write_text(str(256 * 1024 * 1024))
+
+    informer = StatesInformer()
+    informer.set_node(NodeSpec(name="n0", allocatable={R.CPU: 16000}))
+    informer.set_node_slo(NodeSLOSpec(host_applications=[
+        HostApplicationSpec(name="nginx", cgroup_dir=app_dir),
+    ]))
+    mc = MetricCache()
+    ctx = CollectorContext(metric_cache=mc, system_config=cfg)
+    collector = HostApplicationCollector(slo_provider=informer.get_node_slo)
+    collector.setup(ctx)
+    assert collector.enabled()
+    cpu_path.write_text("0")
+    collector.collect(now=0.0)
+    cpu_path.write_text(str(2 * 10**9))  # 2 cpu-seconds over 1s -> 2000m
+    collector.collect(now=1.0)
+    ts, vs = mc.query(MetricKind.HOST_APP_CPU_USAGE, {"app": "nginx"})
+    assert list(vs) == [2000.0]
+
+    reporter = NodeMetricReporter(mc, informer)
+    metric = reporter.report(now=2.0)
+    assert metric.host_app_usages["nginx"][R.CPU] == 2000
+    assert metric.host_app_usages["nginx"][R.MEMORY] == 256
