@@ -101,3 +101,50 @@ def test_nonaligned_sizes():
         state, pods, params, config, interpret=True
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_placement_model_pallas_path_identical():
+    """PlacementModel routes eligible plain solves onto the kernel with
+    identical end-to-end output (forced-on in interpret mode here)."""
+    from koordinator_tpu.apis.types import ClusterSnapshot, NodeMetric, NodeSpec, PodSpec
+    from koordinator_tpu.models.placement import PlacementModel
+
+    def snap():
+        return ClusterSnapshot(
+            nodes=[
+                NodeSpec(name=f"n{i}",
+                         allocatable={R.CPU: 16000, R.MEMORY: 32768})
+                for i in range(3)
+            ],
+            pending_pods=[
+                PodSpec(name=f"p{i}", requests={R.CPU: 1000 + 500 * i})
+                for i in range(5)
+            ],
+            node_metrics={
+                f"n{i}": NodeMetric(node_name=f"n{i}", node_usage={},
+                                    update_time=99.0)
+                for i in range(3)
+            },
+            now=100.0,
+        )
+
+    model = PlacementModel(use_pallas=True)
+    via_pallas = model.schedule(snap())
+    via_scan = PlacementModel(use_pallas=False).schedule(snap())
+    assert dict(via_pallas) == dict(via_scan)
+    assert all(v is not None for v in via_pallas.values())
+    # the kernel path was actually taken (no silent fallback)
+    assert model.use_pallas
+
+
+def test_model_pallas_breaker_not_tripped_by_empty_solves():
+    """Zero-node / zero-pod snapshots route to the scan's shape early-out
+    without permanently disabling the kernel (review fix)."""
+    from koordinator_tpu.apis.types import ClusterSnapshot, PodSpec
+    from koordinator_tpu.models.placement import PlacementModel
+
+    model = PlacementModel(use_pallas=True)
+    out = model.schedule(ClusterSnapshot(
+        pending_pods=[PodSpec(name="p", requests={R.CPU: 100})]))
+    assert out["default/p"] is None
+    assert model.use_pallas  # breaker untouched
