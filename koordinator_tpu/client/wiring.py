@@ -72,6 +72,27 @@ def wire_scheduler(bus: APIServer, scheduler) -> None:
     bus.watch(Kind.DEVICE, on_device)
 
 
+def snapshot_from_bus(bus: APIServer, now: float, with_reservations=False):
+    """Assigned-pod cluster snapshot from the bus (shared by the manager
+    and descheduler loops)."""
+    from koordinator_tpu.apis.types import ClusterSnapshot
+
+    return ClusterSnapshot(
+        nodes=list(bus.list(Kind.NODE).values()),
+        pods=[
+            p for p in bus.list(Kind.POD).values()
+            if getattr(p, "node_name", None) is not None
+        ],
+        node_metrics=bus.list(Kind.NODE_METRIC),
+        reservations=(
+            list(bus.list(Kind.RESERVATION).values())
+            if with_reservations
+            else []
+        ),
+        now=now,
+    )
+
+
 class ManagerLoop:
     """The slo-controller noderesource reconcile loop over the bus
     (SURVEY.md §3.3): NodeMetric + pods in, Node allocatable PATCH out."""
@@ -82,19 +103,7 @@ class ManagerLoop:
 
     def reconcile(self, now: float) -> int:
         """One pass; returns how many nodes were synced back to the bus."""
-        from koordinator_tpu.apis.types import ClusterSnapshot
-
-        nodes = list(self.bus.list(Kind.NODE).values())
-        pods = [
-            p for p in self.bus.list(Kind.POD).values()
-            if getattr(p, "node_name", None) is not None
-        ]
-        snapshot = ClusterSnapshot(
-            nodes=nodes,
-            pods=pods,
-            node_metrics=self.bus.list(Kind.NODE_METRIC),
-            now=now,
-        )
+        snapshot = snapshot_from_bus(self.bus, now)
         updates = self.controller.reconcile_all(snapshot)
         synced = 0
         for update, node in zip(updates, snapshot.nodes):
@@ -110,3 +119,110 @@ def wire_manager(bus: APIServer, controller=None) -> ManagerLoop:
     from koordinator_tpu.manager.noderesource import NodeResourceController
 
     return ManagerLoop(bus, controller or NodeResourceController())
+
+
+class DeschedulerLoop:
+    """The descheduling cycle over the bus (SURVEY.md §3.4): classify and
+    emit PodMigrationJobs, reconcile them reservation-first — the
+    destination is found by the SAME batched solver the scheduler runs
+    (the reference creates a Reservation CR and lets koord-scheduler bind
+    it) — then the eviction flows back as a Pod re-apply so every wired
+    component observes the move."""
+
+    def __init__(self, bus: APIServer, descheduler, place_model=None):
+        from koordinator_tpu.descheduler.migration import MigrationController
+        from koordinator_tpu.models.placement import PlacementModel
+
+        if not hasattr(descheduler.evictor, "jobs"):
+            # a direct evictor would mutate shared pod objects without
+            # any bus event — only the migration evictor is coherent here
+            raise TypeError(
+                "DeschedulerLoop requires a MigrationEvictor (jobs-based) "
+                "evictor; direct eviction bypasses the bus"
+            )
+        self.bus = bus
+        self.descheduler = descheduler
+        self._model = place_model or PlacementModel()
+        self.controller = MigrationController(self._place)
+
+    def _place(self, snapshot, reservation):
+        """Reservation placement through the batched solver: the probe is
+        the VICTIM pod's shape (requests, devices, selector, QoS) so the
+        reserved node can actually host it after the eviction."""
+        import dataclasses
+
+        from koordinator_tpu.apis.types import ClusterSnapshot, PodSpec
+
+        victim = None
+        if reservation.owner_pod_uids:
+            victim = next(
+                (p for p in snapshot.pods
+                 if p.uid == reservation.owner_pod_uids[0]), None,
+            )
+        if victim is not None:
+            probe = dataclasses.replace(
+                victim,
+                name=f"__resv__{reservation.name}",
+                uid=f"__resv__{reservation.name}",
+                node_name=None,
+                gang=None,
+                quota=None,  # reservation capacity is not quota-gated
+            )
+        else:
+            probe = PodSpec(
+                name=f"__resv__{reservation.name}",
+                requests=dict(reservation.requests),
+            )
+        out = self._model.schedule(ClusterSnapshot(
+            nodes=snapshot.nodes,
+            pods=snapshot.pods,
+            pending_pods=[probe],
+            node_metrics=snapshot.node_metrics,
+            reservations=snapshot.reservations,
+            now=snapshot.now,
+        ))
+        return out.get(probe.uid)
+
+    def run_once(self, now: float):
+        from koordinator_tpu.apis.types import MigrationPhase
+
+        snapshot = snapshot_from_bus(self.bus, now, with_reservations=True)
+        pre_assign = {p.uid: p.node_name for p in snapshot.pods}
+        pre_resv = {r.name for r in snapshot.reservations}
+        self.descheduler.run_once(snapshot)
+        evictor = self.descheduler.evictor
+        jobs = list(evictor.jobs)
+        migrated = []
+        if jobs:
+            self.controller.reconcile(snapshot, jobs)
+            # reservation deltas only (blanket re-applies would grow bus
+            # traffic and resurrect GC'd reservations)
+            post = {r.name: r for r in snapshot.reservations}
+            for name in pre_resv - set(post):
+                self.bus.delete(Kind.RESERVATION, name)
+            for name, resv in post.items():
+                if name not in pre_resv:
+                    self.bus.apply(Kind.RESERVATION, name, resv)
+            for job in jobs:
+                self.bus.apply(Kind.MIGRATION_JOB, job.name, job)
+            for pod in snapshot.pending_pods:
+                # the reference EVICTS (deletes) the pod and the workload
+                # recreates it. The controller already cleared node_name
+                # on the shared object, so restore it for the DELETE —
+                # the scheduler's release path (quota used, NUMA/device
+                # holds) keys off the assigned state.
+                pod.node_name = pre_assign.get(pod.uid)
+                self.bus.delete(Kind.POD, pod.uid)
+                pod.node_name = None
+                self.bus.apply(Kind.POD, pod.uid, pod)
+                migrated.append(pod.uid)
+            # completed jobs leave the dedup window
+            evictor.jobs = [
+                j for j in evictor.jobs
+                if j.phase in (MigrationPhase.PENDING, MigrationPhase.RUNNING)
+            ]
+        return migrated
+
+
+def wire_descheduler(bus: APIServer, descheduler, place_model=None) -> DeschedulerLoop:
+    return DeschedulerLoop(bus, descheduler, place_model)

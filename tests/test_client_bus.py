@@ -252,3 +252,123 @@ def test_gang_delete_unwedges_group_cycle():
     outcomes = [s.schedule_one(f"default/g1-{i}", now=102.0) for i in range(2)]
     assert {o.status for o in outcomes} <= {"waiting", "bound"}
     assert outcomes[-1].status == "bound"  # barrier opened
+
+
+def test_descheduler_loop_migrates_over_bus():
+    """§3.4 over the bus: an overloaded node's pod gets a MigrationJob,
+    a reservation placed by the batched solver, and flows back through
+    the bus into the scheduler's queue — then lands on the idle node."""
+    from koordinator_tpu.apis.extension import ResourceName as R
+    from koordinator_tpu.client.wiring import wire_descheduler
+    from koordinator_tpu.descheduler.framework import (
+        Descheduler,
+        MigrationEvictor,
+        Profile,
+    )
+    from koordinator_tpu.descheduler.loadaware import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        NodePool,
+    )
+
+    bus = APIServer()
+    scheduler = Scheduler()
+    wire_scheduler(bus, scheduler)
+    # hot: 90% cpu usage; cold: idle
+    bus.apply(Kind.NODE, "hot", NodeSpec(
+        name="hot", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE, "cold", NodeSpec(
+        name="cold", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "hot", NodeMetric(
+        node_name="hot", node_usage={R.CPU: 9000}, update_time=100.0))
+    bus.apply(Kind.NODE_METRIC, "cold", NodeMetric(
+        node_name="cold", node_usage={R.CPU: 200}, update_time=100.0))
+    victim = PodSpec(name="heavy", requests={R.CPU: 4000}, node_name="hot")
+    bus.apply(Kind.POD, "default/heavy", victim)
+
+    plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={R.CPU: 30}, high_thresholds={R.CPU: 70},
+    )]))
+    loop = wire_descheduler(
+        bus,
+        Descheduler(profiles=[Profile(name="d", balance_plugins=[plugin])],
+                    evictor=MigrationEvictor()),
+    )
+    migrated = loop.run_once(now=110.0)
+    assert migrated == ["default/heavy"]
+    # the job + its reservation are on the bus
+    jobs = bus.list(Kind.MIGRATION_JOB)
+    assert len(jobs) == 1
+    resvs = bus.list(Kind.RESERVATION)
+    assert len(resvs) == 1
+    resv = next(iter(resvs.values()))
+    assert resv.node_name == "cold"   # solver chose the idle node
+    # the evicted pod is pending in the scheduler; next round binds it
+    # on the reserved cold node
+    out = scheduler.schedule_pending(now=120.0)
+    assert out["default/heavy"] == "cold"
+
+
+def test_migration_releases_assigned_state_and_prunes():
+    """Migrating a scheduler-ASSUMED pod releases its quota used via the
+    bus delete, completed jobs leave the dedup window, and no stale
+    reservations resurrect (round-2 review fixes)."""
+    from koordinator_tpu.client.wiring import wire_descheduler
+    from koordinator_tpu.descheduler.framework import (
+        Descheduler,
+        DirectEvictor,
+        MigrationEvictor,
+        Profile,
+    )
+    from koordinator_tpu.descheduler.loadaware import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        NodePool,
+    )
+    import pytest
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.NODE, "hot", NodeSpec(
+        name="hot", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE, "cold", NodeSpec(
+        name="cold", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    # hot looks fine at schedule time; cold starts unschedulable so the
+    # pod lands on hot
+    bus.apply(Kind.NODE_METRIC, "hot", NodeMetric(
+        node_name="hot", node_usage={R.CPU: 1000}, update_time=100.0))
+    bus.apply(Kind.NODE_METRIC, "cold", NodeMetric(
+        node_name="cold", node_usage={R.CPU: 100}, update_time=100.0))
+    bus.apply(Kind.QUOTA, "t", QuotaSpec(name="t", min={R.CPU: 1000},
+                                         max={R.CPU: 9000}))
+    bus.apply(Kind.NODE, "cold", NodeSpec(
+        name="cold", allocatable={R.CPU: 10000, R.MEMORY: 32768},
+        unschedulable=True))
+    bus.apply(Kind.POD, "default/heavy", PodSpec(
+        name="heavy", quota="t", requests={R.CPU: 4000}))
+    out0 = s.schedule_pending(now=100.0)
+    assert out0["default/heavy"] == "hot"
+    assert s.quota_manager.quotas["t"].used[int(R.CPU)] == 4000
+    # hot then runs hot; cold reopens before the descheduling cycle
+    bus.apply(Kind.NODE_METRIC, "hot", NodeMetric(
+        node_name="hot", node_usage={R.CPU: 9000}, update_time=105.0))
+    bus.apply(Kind.NODE, "cold", NodeSpec(
+        name="cold", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+
+    plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={R.CPU: 30}, high_thresholds={R.CPU: 70})]))
+    loop = wire_descheduler(bus, Descheduler(
+        profiles=[Profile(name="d", balance_plugins=[plugin])],
+        evictor=MigrationEvictor()))
+    loop.run_once(now=110.0)
+    # the delete released the quota used exactly once; the re-apply
+    # re-registered the request (pending)
+    assert s.quota_manager.quotas["t"].used[int(R.CPU)] == 0
+    assert "default/heavy" in s.cache.pending
+    # completed jobs pruned from the evictor's dedup window
+    assert loop.descheduler.evictor.jobs == []
+
+    # direct evictors are rejected outright
+    with pytest.raises(TypeError):
+        wire_descheduler(bus, Descheduler(profiles=[], evictor=DirectEvictor()))
