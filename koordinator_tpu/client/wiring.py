@@ -23,10 +23,16 @@ def wire_scheduler(bus: APIServer, scheduler) -> None:
         else:
             scheduler.add_node(node)
 
+    # bus key per pod uid: conventionally identical, but eviction must
+    # delete the key the pod was actually applied under
+    pod_bus_name = {}
+
     def on_pod(event, name, pod):
         if event is EventType.DELETED:
+            pod_bus_name.pop(pod.uid, None)
             scheduler.remove_pod(pod)
         else:
+            pod_bus_name[pod.uid] = name
             # update_pod handles both first-sight and refresh without
             # re-running quota/gang registration for status-only changes
             scheduler.update_pod(pod)
@@ -70,6 +76,13 @@ def wire_scheduler(bus: APIServer, scheduler) -> None:
 
     bus.watch(Kind.NODE_RESOURCE_TOPOLOGY, on_nrt)
     bus.watch(Kind.DEVICE, on_device)
+
+    # preemption victims must be evicted THROUGH the bus (the reference
+    # deletes them via the API server) so koordlet/manager/descheduler
+    # observe the eviction; the DELETED event re-enters remove_pod
+    scheduler.evict_pod_fn = lambda pod: bus.delete(
+        Kind.POD, pod_bus_name.get(pod.uid, pod.uid)
+    )
 
 
 def snapshot_from_bus(bus: APIServer, now: float, with_reservations=False):
@@ -171,8 +184,13 @@ class DeschedulerLoop:
         else:
             probe = PodSpec(
                 name=f"__resv__{reservation.name}",
+                uid=f"__resv__{reservation.name}",  # is_reserve_pod marker
                 requests=dict(reservation.requests),
             )
+        # the probe's __resv__ uid marks it a reserve pod: it never
+        # MATCHES reservations (is_reserve_pod), but existing
+        # reservations stay in the snapshot so their capacity holds
+        # still count against the nodes
         out = self._model.schedule(ClusterSnapshot(
             nodes=snapshot.nodes,
             pods=snapshot.pods,
@@ -189,6 +207,9 @@ class DeschedulerLoop:
         snapshot = snapshot_from_bus(self.bus, now, with_reservations=True)
         pre_assign = {p.uid: p.node_name for p in snapshot.pods}
         pre_resv = {r.name for r in snapshot.reservations}
+        # bus key per pod uid (conventionally identical): deletes and
+        # re-applies must address the key the pod was applied under
+        key_of = {p.uid: k for k, p in self.bus.list(Kind.POD).items()}
         self.descheduler.run_once(snapshot)
         evictor = self.descheduler.evictor
         jobs = list(evictor.jobs)
@@ -212,9 +233,9 @@ class DeschedulerLoop:
                 # the scheduler's release path (quota used, NUMA/device
                 # holds) keys off the assigned state.
                 pod.node_name = pre_assign.get(pod.uid)
-                self.bus.delete(Kind.POD, pod.uid)
+                self.bus.delete(Kind.POD, key_of.get(pod.uid, pod.uid))
                 pod.node_name = None
-                self.bus.apply(Kind.POD, pod.uid, pod)
+                self.bus.apply(Kind.POD, key_of.get(pod.uid, pod.uid), pod)
                 migrated.append(pod.uid)
             # completed jobs leave the dedup window
             evictor.jobs = [

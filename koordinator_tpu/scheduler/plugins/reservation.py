@@ -48,10 +48,21 @@ from koordinator_tpu.scheduler.plugins.lowering import node_view
 _MATCH_KEY = "__resv_matched__"
 
 
+def is_reserve_pod(pod: PodSpec) -> bool:
+    """Placement probes for reservations themselves (the descheduler's
+    migration probe) — the reference's reservationutil.IsReservePod.
+    Reserve pods never *match* reservations (they would burn real
+    allocate_once capacity from a throwaway solve), but they still see
+    reserved capacity as occupied through the lowering's remainder hold."""
+    return pod.uid.startswith("__resv__")
+
+
 def reservation_matches_pod(resv: ReservationSpec, pod: PodSpec) -> bool:
     """Owner match: explicit pod-uid owners (migration reservations,
     reference: reservation_types.go ReservationOwner.Object) or label
     owners (every owner label present on the pod)."""
+    if is_reserve_pod(pod):
+        return False
     if resv.state != ReservationState.AVAILABLE or resv.node_name is None:
         return False
     if resv.owner_pod_uids:

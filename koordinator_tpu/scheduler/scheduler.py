@@ -87,6 +87,12 @@ class Scheduler:
         #: BatchedPlacement feature gate: False falls back to per-pod
         #: incremental cycles in schedule_pending
         self.batched_placement = True
+        #: preemption eviction sink (set by client.wiring.wire_scheduler):
+        #: deletes the victim from the bus so every wired component
+        #: observes the eviction — the reference deletes victims via the
+        #: API server (defaultpreemption). None = local cache only
+        #: (standalone scheduler, no bus).
+        self.evict_pod_fn = None
         #: waiting pods' fine-grained allocation state, annotated at the
         #: barrier (uid -> (node name, CycleState))
         self._fine_waiting: Dict[str, tuple] = {}
@@ -415,7 +421,14 @@ class Scheduler:
     def _evict_victims(self, uids: List[str]) -> None:
         for uid in uids:
             victim = self.cache.pods.get(uid)
-            if victim is not None:
+            if victim is None:
+                continue
+            if self.evict_pod_fn is not None:
+                # bus deletion; the DELETED watch event re-enters
+                # remove_pod synchronously, so the local cache stays
+                # coherent with every other wired component
+                self.evict_pod_fn(victim)
+            else:
                 self.remove_pod(victim)
 
     def expire_waiting(self, now: float) -> List[str]:

@@ -24,7 +24,7 @@ from koordinator_tpu.service.codec import (
 
 
 class PlacementClient:
-    def __init__(self, address, timeout: float = 60.0):
+    def __init__(self, address, timeout: float = 60.0, secret=None):
         if isinstance(address, str):
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         else:
@@ -32,6 +32,10 @@ class PlacementClient:
         self._sock.settimeout(timeout)
         self._sock.connect(address)
         self._stream = self._sock.makefile("rwb")
+        if secret is not None:
+            # shared-secret hello frame (server.py handshake)
+            write_frame(self._stream, secret)
+            self._stream.flush()
 
     def solve(self, request: SolveRequest) -> SolveResponse:
         write_frame(self._stream, encode_request(request))

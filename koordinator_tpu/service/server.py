@@ -5,10 +5,17 @@ One thread per connection, one solve per request frame. The solver keeps
 its jit cache across requests (the first solve pays compilation; repeat
 shapes are cached), which is the point of the sidecar: the control plane
 restarts freely while the compiled solver stays warm.
+
+Security: the UDS default inherits filesystem permissions. The TCP mode
+is for trusted networks (the control-plane↔solver link of the north
+star rides the cluster network); for anything beyond that, pass
+``secret=`` — the first frame of every connection must then carry the
+shared secret or the connection is dropped before any solve runs.
 """
 
 from __future__ import annotations
 
+import hmac
 import socket
 import socketserver
 import threading
@@ -74,6 +81,11 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         stream = self.request.makefile("rwb")
         try:
+            secret = self.server.shared_secret
+            if secret is not None:
+                hello = read_frame(stream)
+                if hello is None or not hmac.compare_digest(hello, secret):
+                    return  # unauthenticated peer: drop before any solve
             while True:
                 payload = read_frame(stream)
                 if payload is None:
@@ -97,9 +109,11 @@ class _Handler(socketserver.BaseRequestHandler):
 
 
 class PlacementService:
-    """The sidecar server (UDS by default; TCP for cross-host)."""
+    """The sidecar server (UDS by default; TCP for cross-host —
+    trusted-network-only unless ``secret`` is set)."""
 
-    def __init__(self, address, config: SolverConfig = SolverConfig()):
+    def __init__(self, address, config: SolverConfig = SolverConfig(),
+                 secret: Optional[bytes] = None):
         self.address = address
         if isinstance(address, str):
             server_cls = type(
@@ -115,6 +129,7 @@ class PlacementService:
             )
         self._server = server_cls(address, _Handler)
         self._server.solver_config = config
+        self._server.shared_secret = secret
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:

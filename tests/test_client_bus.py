@@ -372,3 +372,138 @@ def test_migration_releases_assigned_state_and_prunes():
     # direct evictors are rejected outright
     with pytest.raises(TypeError):
         wire_descheduler(bus, Descheduler(profiles=[], evictor=DirectEvictor()))
+
+
+def test_preemption_eviction_propagates_to_bus():
+    """ADVICE round-2 fix: a preemption victim must be deleted from the
+    bus (the reference deletes via the API server), not just the local
+    cache — otherwise koordlet/manager keep treating it as running and a
+    later MODIFIED event double-books the node."""
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.NODE, "n0", NodeSpec(
+        name="n0", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+        node_name="n0", node_usage={}, update_time=99.0))
+    bus.apply(Kind.QUOTA, "a", QuotaSpec(
+        name="a", min={R.CPU: 10000}, max={R.CPU: 10000}))
+    victim = PodSpec(name="low", quota="a", priority=10,
+                     requests={R.CPU: 8000})
+    bus.apply(Kind.POD, "default/low", victim)
+    out = s.schedule_pending(now=100.0)
+    assert out["default/low"] == "n0"
+
+    preemptor = PodSpec(name="high", quota="a", priority=100,
+                        requests={R.CPU: 4000})
+    bus.apply(Kind.POD, "default/high", preemptor)
+    result = s.schedule_pending(now=101.0)
+    assert result.nominations == {"default/high": "n0"}
+    # the victim is gone from the BUS, not just the scheduler cache
+    assert bus.get(Kind.POD, "default/low") is None
+    assert "default/low" not in s.cache.pods
+    # the preemptor binds next round on the freed capacity
+    out = s.schedule_pending(now=102.0)
+    assert out["default/high"] == "n0"
+
+
+def test_migration_probe_does_not_consume_reservations():
+    """ADVICE round-2 fix: the descheduler's reservation-placement probe
+    carries the victim's labels; it must not consume label-owned
+    reservations (the reference skips reservation matching for reserve
+    pods — reservationutil.IsReservePod)."""
+    from koordinator_tpu.apis.types import ReservationSpec, ReservationState
+    from koordinator_tpu.client.wiring import wire_descheduler
+    from koordinator_tpu.descheduler.framework import (
+        Descheduler,
+        MigrationEvictor,
+        Profile,
+    )
+    from koordinator_tpu.descheduler.loadaware import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        NodePool,
+    )
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.NODE, "hot", NodeSpec(
+        name="hot", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE, "cold", NodeSpec(
+        name="cold", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "hot", NodeMetric(
+        node_name="hot", node_usage={R.CPU: 9000}, update_time=100.0))
+    bus.apply(Kind.NODE_METRIC, "cold", NodeMetric(
+        node_name="cold", node_usage={R.CPU: 200}, update_time=100.0))
+    victim = PodSpec(name="heavy", requests={R.CPU: 4000}, node_name="hot",
+                     labels={"app": "web"})
+    bus.apply(Kind.POD, "default/heavy", victim)
+    # a pre-existing allocate_once reservation owned by the SAME labels:
+    # the probe must not burn it
+    bus.apply(Kind.RESERVATION, "standing", ReservationSpec(
+        name="standing", node_name="cold", state=ReservationState.AVAILABLE,
+        allocatable={R.CPU: 4000}, owner_labels={"app": "web"},
+        allocate_once=True))
+
+    plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={R.CPU: 30}, high_thresholds={R.CPU: 70})]))
+    loop = wire_descheduler(bus, Descheduler(
+        profiles=[Profile(name="d", balance_plugins=[plugin])],
+        evictor=MigrationEvictor()))
+    migrated = loop.run_once(now=110.0)
+    assert migrated == ["default/heavy"]
+    standing = bus.get(Kind.RESERVATION, "standing")
+    assert standing.state == ReservationState.AVAILABLE
+    assert not standing.allocated
+    assert not any(u.startswith("__resv__")
+                   for u in standing.allocated_pod_uids)
+
+
+def test_migration_probe_sees_reserved_capacity_as_occupied():
+    """Review fix follow-up: the probe skips reservation MATCHING but must
+    still see existing reservations' capacity holds — otherwise two
+    migrations double-book one free node."""
+    from koordinator_tpu.apis.types import ReservationSpec, ReservationState
+    from koordinator_tpu.client.wiring import wire_descheduler
+    from koordinator_tpu.descheduler.framework import (
+        Descheduler,
+        MigrationEvictor,
+        Profile,
+    )
+    from koordinator_tpu.descheduler.loadaware import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        NodePool,
+    )
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    bus.apply(Kind.NODE, "hot", NodeSpec(
+        name="hot", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE, "cold", NodeSpec(
+        name="cold", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "hot", NodeMetric(
+        node_name="hot", node_usage={R.CPU: 9000}, update_time=100.0))
+    bus.apply(Kind.NODE_METRIC, "cold", NodeMetric(
+        node_name="cold", node_usage={R.CPU: 200}, update_time=100.0))
+    bus.apply(Kind.POD, "default/heavy", PodSpec(
+        name="heavy", requests={R.CPU: 4000}, node_name="hot"))
+    # an unrelated reservation already holds 7000 of cold's 10000: the
+    # victim's 4000 probe cannot fit there any more
+    bus.apply(Kind.RESERVATION, "taken", ReservationSpec(
+        name="taken", node_name="cold", state=ReservationState.AVAILABLE,
+        allocatable={R.CPU: 7000}, owner_labels={"app": "other"},
+        allocate_once=True))
+
+    plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={R.CPU: 30}, high_thresholds={R.CPU: 70})]))
+    loop = wire_descheduler(bus, Descheduler(
+        profiles=[Profile(name="d", balance_plugins=[plugin])],
+        evictor=MigrationEvictor()))
+    migrated = loop.run_once(now=110.0)
+    # no node can host the victim: nothing migrates, no new reservation
+    assert migrated == []
+    assert list(bus.list(Kind.RESERVATION)) == ["taken"]
+    assert bus.get(Kind.POD, "default/heavy").node_name == "hot"

@@ -212,3 +212,25 @@ def test_malformed_payload_keeps_connection(tmp_path):
         sock.close()
     finally:
         service.stop()
+
+
+class TestSharedSecret:
+    def test_tcp_secret_gates_solves(self):
+        """ADVICE round-2 fix: TCP mode can require a shared-secret hello
+        frame; unauthenticated peers are dropped before any solve."""
+        service = PlacementService(("127.0.0.1", 0), secret=b"s3cret")
+        service.start()
+        addr = service._server.server_address
+        try:
+            with PlacementClient(addr, timeout=10.0,
+                                 secret=b"s3cret") as client:
+                assert (client.solve(_problem()).assignments >= 0).all()
+            with pytest.raises((ConnectionError, OSError)):
+                with PlacementClient(addr, timeout=10.0,
+                                     secret=b"wrong") as client:
+                    client.solve(_problem())
+            with pytest.raises((ConnectionError, OSError)):
+                with PlacementClient(addr, timeout=10.0) as client:
+                    client.solve(_problem())
+        finally:
+            service.stop()
