@@ -78,9 +78,13 @@ def build_descheduler(
 
 
 def main(argv=None) -> int:
+    import time
+
     parser = argparse.ArgumentParser("koord-descheduler")
     parser.add_argument("--feature-gates", default="")
     parser.add_argument("--descheduling-interval", type=float, default=120.0)
+    parser.add_argument("--once", action="store_true")
+    parser.add_argument("--cluster-json", default=None)
     args = parser.parse_args(argv)
     descheduler = build_descheduler(
         DeschedulerConfig(
@@ -88,12 +92,26 @@ def main(argv=None) -> int:
             descheduling_interval_seconds=args.descheduling_interval,
         )
     )
+    from koordinator_tpu.client.bus import APIServer
+    from koordinator_tpu.client.wiring import wire_descheduler
+
+    bus = APIServer()
+    loop = wire_descheduler(bus, descheduler)
+    if args.cluster_json:
+        from koordinator_tpu.cmd.scheduler import seed_bus_from_json
+
+        seed_bus_from_json(bus, args.cluster_json)
     print(
         "koord-descheduler: profiles="
         f"{[p.name for p in descheduler.profiles]}, "
         f"interval={descheduler.descheduling_interval}s"
     )
-    return 0
+    while True:
+        migrated = loop.run_once(now=time.time())
+        print(f"descheduling cycle: migrated {len(migrated)} pods")
+        if args.once:
+            return 0
+        time.sleep(descheduler.descheduling_interval)
 
 
 if __name__ == "__main__":

@@ -77,10 +77,17 @@ def build_manager(config: ManagerConfig, gates: Optional[FeatureGate] = None) ->
 
 
 def main(argv=None) -> int:
+    import time
+
     parser = argparse.ArgumentParser("koord-manager")
     parser.add_argument("--feature-gates", default="")
+    parser.add_argument("--sync-interval", type=float, default=60.0)
+    parser.add_argument("--once", action="store_true")
+    parser.add_argument("--cluster-json", default=None)
     args = parser.parse_args(argv)
-    manager = build_manager(ManagerConfig(feature_gates=args.feature_gates))
+    config = ManagerConfig(feature_gates=args.feature_gates,
+                           sync_interval_seconds=args.sync_interval)
+    manager = build_manager(config)
     enabled = [
         name
         for name, component in (
@@ -92,8 +99,22 @@ def main(argv=None) -> int:
         )
         if component is not None
     ]
+    from koordinator_tpu.client.bus import APIServer
+    from koordinator_tpu.client.wiring import wire_manager
+
+    bus = APIServer()
+    loop = wire_manager(bus, manager.noderesource)
+    if args.cluster_json:
+        from koordinator_tpu.cmd.scheduler import seed_bus_from_json
+
+        seed_bus_from_json(bus, args.cluster_json)
     print("koord-manager components:", ", ".join(enabled))
-    return 0
+    while True:
+        synced = loop.reconcile(now=time.time())
+        print(f"noderesource reconcile: {synced} nodes synced")
+        if args.once:
+            return 0
+        time.sleep(config.sync_interval_seconds)
 
 
 if __name__ == "__main__":

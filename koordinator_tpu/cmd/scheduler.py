@@ -26,6 +26,13 @@ class SchedulerConfig:
     loadaware_weight: int = 1
     score_according_prod: bool = False
     cluster_total: Optional[dict] = None
+    #: the north-star backend selector (reference: the plugin-factory
+    #: wiring at cmd/koord-scheduler/app/server.go:331-398):
+    #: ``inprocess`` solves in this process; ``sidecar`` routes every
+    #: batched solve through a koord-solver process at solver_address.
+    placement_backend: str = "inprocess"
+    solver_address: str = "/tmp/koord-solver.sock"
+    solver_secret: Optional[bytes] = None
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -36,12 +43,25 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
 
     gates = gates or SCHEDULER_GATES.copy()
     gates.set_from_spec(config.feature_gates)
+    backend = None
+    if config.placement_backend == "sidecar":
+        from koordinator_tpu.cmd.solver import parse_address
+        from koordinator_tpu.service.client import RemoteSolver
+
+        backend = RemoteSolver(
+            parse_address(config.solver_address), secret=config.solver_secret
+        )
+    elif config.placement_backend != "inprocess":
+        raise ValueError(
+            f"unknown placement backend: {config.placement_backend!r}"
+        )
     model = PlacementModel(
         config=SolverConfig(
             fit_weight=config.fit_weight,
             loadaware_weight=config.loadaware_weight,
             score_according_prod=config.score_according_prod,
-        )
+        ),
+        backend=backend,
     )
     scheduler = Scheduler(
         model=model,
@@ -54,6 +74,66 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
     return scheduler
 
 
+def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
+             log=print) -> int:
+    """The scheduling loop over a wired bus: solve the pending queue
+    every interval; a sidecar outage skips the round (the control plane
+    retries — Run at cmd/koord-scheduler/app/server.go:159)."""
+    from koordinator_tpu.service.client import SolverUnavailable
+
+    while True:
+        try:
+            out = scheduler.schedule_pending()
+        except SolverUnavailable as e:
+            log(f"round skipped: {e}")
+            if once:
+                return 1
+        else:
+            placed = sum(1 for v in out.values() if v is not None)
+            log(f"round: {placed}/{len(out)} placed, "
+                f"{len(out.waiting)} waiting")
+            if once:
+                return 0
+        time.sleep(config.schedule_interval_seconds)
+
+
+def seed_bus_from_json(bus, path: str) -> None:
+    """Populate the bus from a simple cluster-spec JSON file:
+    ``{"nodes": [{"name", "cpu", "memory"}],
+    "pods": [{"name", "cpu", "memory", "node"?}]}`` (cpu in millicores,
+    memory in MiB) — the in-process stand-in for a kubeconfig."""
+    import json
+
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+    from koordinator_tpu.client.bus import Kind
+
+    with open(path) as f:
+        spec = json.load(f)
+    now = time.time()
+    for n in spec.get("nodes", ()):
+        bus.apply(Kind.NODE, n["name"], NodeSpec(
+            name=n["name"],
+            allocatable={
+                ResourceName.CPU: int(n.get("cpu", 0)),
+                ResourceName.MEMORY: int(n.get("memory", 0)),
+            },
+        ))
+        bus.apply(Kind.NODE_METRIC, n["name"], NodeMetric(
+            node_name=n["name"], node_usage={}, update_time=now,
+        ))
+    for p in spec.get("pods", ()):
+        pod = PodSpec(
+            name=p["name"],
+            requests={
+                ResourceName.CPU: int(p.get("cpu", 0)),
+                ResourceName.MEMORY: int(p.get("memory", 0)),
+            },
+            node_name=p.get("node"),
+        )
+        bus.apply(Kind.POD, pod.uid, pod)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser("koord-scheduler")
     parser.add_argument("--feature-gates", default="",
@@ -61,19 +141,38 @@ def main(argv=None) -> int:
     parser.add_argument("--schedule-interval", type=float, default=1.0)
     parser.add_argument("--once", action="store_true",
                         help="run a single scheduling round and exit")
+    parser.add_argument(
+        "--placement-backend", choices=("inprocess", "sidecar"),
+        default="inprocess",
+        help="where batched solves run (north star: the solver sidecar)",
+    )
+    parser.add_argument("--solver-address", default="/tmp/koord-solver.sock")
+    parser.add_argument("--solver-secret-file", default=None)
+    parser.add_argument(
+        "--cluster-json", default=None,
+        help="seed the bus from a cluster-spec JSON file",
+    )
     args = parser.parse_args(argv)
+    secret = None
+    if args.solver_secret_file:
+        with open(args.solver_secret_file, "rb") as f:
+            secret = f.read().strip()
     config = SchedulerConfig(
         feature_gates=args.feature_gates,
         schedule_interval_seconds=args.schedule_interval,
+        placement_backend=args.placement_backend,
+        solver_address=args.solver_address,
+        solver_secret=secret,
     )
+    from koordinator_tpu.client.bus import APIServer
+    from koordinator_tpu.client.wiring import wire_scheduler
+
     scheduler = build_scheduler(config)
-    while True:
-        out = scheduler.schedule_pending()
-        placed = sum(1 for v in out.values() if v is not None)
-        print(f"round: {placed}/{len(out)} placed, {len(out.waiting)} waiting")
-        if args.once:
-            return 0
-        time.sleep(config.schedule_interval_seconds)
+    bus = APIServer()
+    wire_scheduler(bus, scheduler)
+    if args.cluster_json:
+        seed_bus_from_json(bus, args.cluster_json)
+    return run_loop(scheduler, config, once=args.once)
 
 
 if __name__ == "__main__":

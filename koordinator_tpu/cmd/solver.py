@@ -1,0 +1,62 @@
+"""koord-solver entry point: the placement-solver sidecar.
+
+The north-star deployment splits the control plane from the compiled
+solver (SURVEY.md §5.8): the scheduler speaks the framed-npz protocol to
+this process, which keeps its jit cache warm across control-plane
+restarts. Reference boundary: the plugin-backend selection at
+cmd/koord-scheduler/app/server.go:331-398 — here the backend selection
+is ``--placement-backend=sidecar`` on the scheduler side, and this is
+the process it talks to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional, Tuple, Union
+
+
+def parse_address(spec: str) -> Union[str, Tuple[str, int]]:
+    """``host:port`` -> TCP tuple; anything else is a UDS path."""
+    if ":" in spec and not spec.startswith("/"):
+        host, _, port = spec.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+    return spec
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("koord-solver")
+    parser.add_argument(
+        "--listen", default="/tmp/koord-solver.sock",
+        help="UDS path or host:port to serve the solve protocol on",
+    )
+    parser.add_argument(
+        "--secret-file", default=None,
+        help="path to a shared secret required from TCP peers",
+    )
+    parser.add_argument("--once", action="store_true",
+                        help="start, report readiness, and exit (smoke)")
+    args = parser.parse_args(argv)
+
+    from koordinator_tpu.service.server import PlacementService
+
+    secret: Optional[bytes] = None
+    if args.secret_file:
+        with open(args.secret_file, "rb") as f:
+            secret = f.read().strip()
+    service = PlacementService(parse_address(args.listen), secret=secret)
+    service.start()
+    print(f"koord-solver: serving on {args.listen}")
+    try:
+        if args.once:
+            return 0
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        service.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

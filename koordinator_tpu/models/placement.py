@@ -108,6 +108,7 @@ class PlacementModel:
         fine: Optional[FineGrained] = None,
         pod_bucketing: bool = True,
         use_pallas: Optional[bool] = None,
+        backend=None,
     ):
         self.config = config
         self.resource_weights = dict(resource_weights or DEFAULT_RESOURCE_WEIGHTS)
@@ -122,6 +123,9 @@ class PlacementModel:
         self.sharding = sharding
         self.fine = fine
         self.pod_bucketing = pod_bucketing
+        #: remote solve backend (service.client.RemoteSolver) — the
+        #: ``--placement-backend=sidecar`` boundary. None = in-process.
+        self.backend = backend
         #: use the VMEM-resident pallas kernel for eligible plain solves
         #: (single TPU device, no quota/gang/reservation/NUMA/extras;
         #: bit-identical — ops/pallas_binpack.py). None = auto-detect.
@@ -442,7 +446,14 @@ class PlacementModel:
     def _dispatch_solve(self, state, batch, quota_state, gang_state,
                         extras, resv_arrays, numa_aux):
         """Route eligible plain solves onto the pallas kernel (identical
-        results, ~2x on TPU); everything else runs the fused scan."""
+        results, ~2x on TPU); everything else runs the fused scan. A
+        configured remote backend (the solver sidecar) takes the whole
+        solve instead — same arrays over the wire, same epilogue."""
+        if self.backend is not None:
+            return self.backend.solve_result(
+                state, batch, self.params, self.config, quota_state,
+                gang_state, extras, resv_arrays, numa_aux,
+            )
         plain = (
             quota_state is None
             and gang_state is None

@@ -3,7 +3,13 @@
 Speaks the framed npz protocol; ``solve_arrays`` takes the same host
 arrays the in-process path lowers (state/cluster.py), so a control plane
 swaps between in-process and sidecar solving without changing its
-lowering.
+lowering. :class:`RemoteSolver` is the full PlacementModel backend
+behind ``--placement-backend=sidecar`` (reference:
+cmd/koord-scheduler/app/server.go:331-398 selects the plugin backend at
+the same boundary): it serializes every feature state the batched solve
+takes, reconnects transparently when the sidecar restarts, and raises
+:class:`SolverUnavailable` when the sidecar stays down so the control
+plane can skip the round and retry.
 """
 
 from __future__ import annotations
@@ -12,6 +18,10 @@ import socket
 from typing import Dict, Optional
 
 import numpy as np
+
+
+class SolverUnavailable(ConnectionError):
+    """The sidecar cannot be reached (after reconnect attempts)."""
 
 from koordinator_tpu.service.codec import (
     SolveRequest,
@@ -65,3 +75,107 @@ class PlacementClient:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def _group(nt) -> Optional[Dict[str, np.ndarray]]:
+    """NamedTuple-of-arrays -> wire group (None fields dropped)."""
+    if nt is None:
+        return None
+    return {
+        f: np.asarray(v)
+        for f, v in zip(nt._fields, nt)
+        if v is not None
+    }
+
+
+class RemoteSolver:
+    """Routes ``PlacementModel``'s batched solves through the sidecar.
+
+    The control plane keeps its lowering and epilogue; only the device
+    solve crosses the wire. One persistent connection, re-established on
+    failure (the sidecar restarting is the designed-for case: the
+    control plane reconnects and the new sidecar re-warms its jit cache
+    per shape bucket).
+    """
+
+    def __init__(self, address, secret: Optional[bytes] = None,
+                 timeout: float = 120.0, retries: int = 1):
+        self.address = address
+        self.secret = secret
+        self.timeout = timeout
+        self.retries = retries
+        self._client: Optional[PlacementClient] = None
+
+    def _connect(self) -> PlacementClient:
+        if self._client is None:
+            self._client = PlacementClient(
+                self.address, timeout=self.timeout, secret=self.secret
+            )
+        return self._client
+
+    def _drop(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except OSError:
+                pass
+            self._client = None
+
+    def close(self) -> None:
+        self._drop()
+
+    def solve_result(self, state, batch, params, config,
+                     quota_state=None, gang_state=None, extras=None,
+                     resv=None, numa=None):
+        """The ``solve_batch`` call over the wire; returns a
+        ``SolveResult`` with host (numpy) arrays."""
+        from koordinator_tpu.ops.binpack import SolveResult
+
+        request = SolveRequest(
+            node=_group(state),
+            pods=_group(batch),
+            params=_group(params),
+            quota=_group(quota_state),
+            gang=_group(gang_state),
+            extras=_group(extras),
+            resv=_group(resv),
+            numa=_group(numa),
+            config={
+                f: np.asarray(v) for f, v in zip(config._fields, config)
+            },
+        )
+        last_error: Optional[Exception] = None
+        for _attempt in range(self.retries + 1):
+            try:
+                response = self._connect().solve(request)
+                break
+            except (ConnectionError, OSError, EOFError) as e:
+                last_error = e
+                self._drop()
+            except Exception:
+                # protocol-level failure (e.g. a solver error response):
+                # the stream may be desynced — never reuse it, or a
+                # retry would read the previous round's assignments
+                self._drop()
+                raise
+        else:
+            raise SolverUnavailable(
+                f"placement sidecar at {self.address!r} unreachable: "
+                f"{type(last_error).__name__}: {last_error}"
+            )
+        new_state = state
+        if response.node_used_req is not None:
+            new_state = state._replace(used_req=response.node_used_req)
+        return SolveResult(
+            node_state=new_state,
+            quota_state=None,
+            resv_free=None,
+            assign=response.assignments,
+            commit=response.commit,
+            waiting=response.waiting,
+            rejected=response.rejected,
+            raw_assign=response.raw_assign,
+            resv_vstar=response.resv_vstar,
+            resv_delta=response.resv_delta,
+            numa_consumed=None,
+        )

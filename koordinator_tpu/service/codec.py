@@ -25,18 +25,38 @@ MAX_FRAME = 1 << 30
 
 @dataclasses.dataclass
 class SolveRequest:
-    """One batched solve: the scan's inputs as host arrays."""
+    """One batched solve: the scan's inputs as host arrays.
+
+    The optional groups mirror ``solve_batch``'s optional feature states
+    (quota admission, gang resolution, host extras, reservation credit,
+    NUMA aux) plus the static SolverConfig scalars — absent groups mean
+    the plain path, so old plain requests decode unchanged."""
 
     node: Dict[str, np.ndarray]    # alloc/used_req/usage/... [N,R]+masks
     pods: Dict[str, np.ndarray]    # req/est/is_prod/... [P,...]
     params: Dict[str, np.ndarray]  # weights/thresholds/prod_thresholds [R]
+    quota: Optional[Dict[str, np.ndarray]] = None   # QuotaState fields
+    gang: Optional[Dict[str, np.ndarray]] = None    # GangState fields
+    extras: Optional[Dict[str, np.ndarray]] = None  # Extras fields
+    resv: Optional[Dict[str, np.ndarray]] = None    # ResvArrays fields
+    numa: Optional[Dict[str, np.ndarray]] = None    # NumaAux fields
+    config: Optional[Dict[str, np.ndarray]] = None  # SolverConfig scalars
 
 
 @dataclasses.dataclass
 class SolveResponse:
+    """Everything the control plane's epilogue consumes (the SolveResult
+    columns models/placement.py reads after a solve)."""
+
     assignments: np.ndarray              # [P] int32 node index or -1
     node_used_req: Optional[np.ndarray] = None  # [N,R] post-solve
     error: str = ""
+    commit: Optional[np.ndarray] = None      # [P] bool
+    waiting: Optional[np.ndarray] = None     # [P] bool
+    rejected: Optional[np.ndarray] = None    # [P] bool
+    raw_assign: Optional[np.ndarray] = None  # [P] int32 pre-gang placement
+    resv_vstar: Optional[np.ndarray] = None  # [P] int32 consumed resv, -1
+    resv_delta: Optional[np.ndarray] = None  # [P,R] consumed amount
 
 
 def write_frame(stream: BinaryIO, payload: bytes) -> None:
@@ -44,12 +64,13 @@ def write_frame(stream: BinaryIO, payload: bytes) -> None:
     stream.write(payload)
 
 
-def read_frame(stream: BinaryIO) -> Optional[bytes]:
+def read_frame(stream: BinaryIO,
+               max_frame: int = MAX_FRAME) -> Optional[bytes]:
     header = stream.read(_LEN.size)
     if len(header) < _LEN.size:
         return None  # peer closed
     (length,) = _LEN.unpack(header)
-    if length > MAX_FRAME:
+    if length > max_frame:
         raise ValueError(f"frame too large: {length}")
     chunks = []
     remaining = length
@@ -73,22 +94,43 @@ def _unpack(payload: bytes) -> Dict[str, np.ndarray]:
         return {k: z[k] for k in z.files}
 
 
+#: request group -> wire prefix (single-char + "."); optional groups are
+#: simply absent from the archive when None
+_REQ_GROUPS = (
+    ("node", "n."), ("pods", "p."), ("params", "s."), ("quota", "q."),
+    ("gang", "g."), ("extras", "x."), ("resv", "r."), ("numa", "u."),
+    ("config", "c."),
+)
+
+_RESP_OPTIONAL = (
+    "node_used_req", "commit", "waiting", "rejected", "raw_assign",
+    "resv_vstar", "resv_delta",
+)
+
+
 def encode_request(req: SolveRequest) -> bytes:
     arrays: Dict[str, np.ndarray] = {}
-    for prefix, group in (("n.", req.node), ("p.", req.pods), ("s.", req.params)):
+    for field, prefix in _REQ_GROUPS:
+        group = getattr(req, field)
+        if group is None:
+            continue
         for key, value in group.items():
             arrays[prefix + key] = np.asarray(value)
     return _pack(arrays)
 
 
 def decode_request(payload: bytes) -> SolveRequest:
-    node: Dict[str, np.ndarray] = {}
-    pods: Dict[str, np.ndarray] = {}
-    params: Dict[str, np.ndarray] = {}
+    by_prefix = {prefix: field for field, prefix in _REQ_GROUPS}
+    groups: Dict[str, Dict[str, np.ndarray]] = {}
     for key, value in _unpack(payload).items():
         prefix, name = key[:2], key[2:]
-        {"n.": node, "p.": pods, "s.": params}[prefix][name] = value
-    return SolveRequest(node=node, pods=pods, params=params)
+        groups.setdefault(by_prefix[prefix], {})[name] = value
+    return SolveRequest(
+        node=groups.get("node", {}),
+        pods=groups.get("pods", {}),
+        params=groups.get("params", {}),
+        **{f: groups.get(f) for f, _p in _REQ_GROUPS[3:]},
+    )
 
 
 def encode_response(resp: SolveResponse) -> bytes:
@@ -96,8 +138,10 @@ def encode_response(resp: SolveResponse) -> bytes:
         "assignments": np.asarray(resp.assignments, dtype=np.int32),
         "error": np.frombuffer(resp.error.encode(), dtype=np.uint8),
     }
-    if resp.node_used_req is not None:
-        arrays["node_used_req"] = np.asarray(resp.node_used_req)
+    for field in _RESP_OPTIONAL:
+        value = getattr(resp, field)
+        if value is not None:
+            arrays[field] = np.asarray(value)
     return _pack(arrays)
 
 
@@ -105,6 +149,6 @@ def decode_response(payload: bytes) -> SolveResponse:
     arrays = _unpack(payload)
     return SolveResponse(
         assignments=arrays["assignments"],
-        node_used_req=arrays.get("node_used_req"),
         error=bytes(arrays["error"]).decode() if "error" in arrays else "",
+        **{f: arrays.get(f) for f in _RESP_OPTIONAL},
     )

@@ -21,16 +21,22 @@ import socketserver
 import threading
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.ops.binpack import (
+    Extras,
     NodeState,
+    NumaAux,
     PodBatch,
+    ResvArrays,
     ScoreParams,
     SolverConfig,
     solve_batch,
 )
+from koordinator_tpu.ops.gang import GangState
+from koordinator_tpu.ops.quota import QuotaState
 from koordinator_tpu.service.codec import (
     SolveRequest,
     SolveResponse,
@@ -46,16 +52,48 @@ NODE_FIELDS = (
 )
 POD_FIELDS = (
     "req", "est", "is_prod", "is_daemonset", "quota_id", "non_preemptible",
-    "gang_id", "blocked",
+    "gang_id", "blocked", "has_numa_policy",
 )
+
+#: one jit cache for every connection (static config hashes per value)
+_jit_solve = jax.jit(solve_batch, static_argnames=("config",))
+
+
+def _state_group(cls, group):
+    """Reconstruct a NamedTuple-of-arrays feature state from its wire
+    group (fields absent on the wire stay None)."""
+    if group is None:
+        return None
+    return cls(**{
+        f: (jnp.asarray(group[f]) if f in group else None)
+        for f in cls._fields
+    })
+
+
+def _decode_config(group) -> SolverConfig:
+    if group is None:
+        return SolverConfig()
+    defaults = SolverConfig()
+    kwargs = {}
+    for f in SolverConfig._fields:
+        if f in group:
+            default = getattr(defaults, f)
+            kwargs[f] = type(default)(np.asarray(group[f]).item())
+    return SolverConfig(**kwargs)
 
 
 def solve_from_request(req: SolveRequest,
                        config: SolverConfig = SolverConfig()) -> SolveResponse:
-    """Run one batched solve from wire arrays (the RPC handler body)."""
+    """Run one batched solve from wire arrays (the RPC handler body).
+
+    The request's optional groups map 1:1 onto ``solve_batch``'s feature
+    states; a wire config overrides the server default so the control
+    plane's SolverConfig rides along."""
     try:
         state = NodeState(
-            **{f: jnp.asarray(req.node[f]) for f in NODE_FIELDS}
+            **{f: jnp.asarray(req.node[f]) for f in NODE_FIELDS},
+            **{f: jnp.asarray(req.node[f])
+               for f in ("numa_cap", "numa_free") if f in req.node},
         )
         pods = PodBatch.build(
             **{f: jnp.asarray(req.pods[f])
@@ -66,10 +104,26 @@ def solve_from_request(req: SolveRequest,
             thresholds=jnp.asarray(req.params["thresholds"]),
             prod_thresholds=jnp.asarray(req.params["prod_thresholds"]),
         )
-        result = solve_batch(state, pods, params, config)
+        if req.config is not None:
+            config = _decode_config(req.config)
+        result = _jit_solve(
+            state, pods, params, config,
+            _state_group(QuotaState, req.quota),
+            _state_group(GangState, req.gang),
+            _state_group(Extras, req.extras),
+            _state_group(ResvArrays, req.resv),
+            _state_group(NumaAux, req.numa),
+        )
+        opt = lambda a: None if a is None else np.asarray(a)
         return SolveResponse(
             assignments=np.asarray(result.assign),
             node_used_req=np.asarray(result.node_state.used_req),
+            commit=np.asarray(result.commit),
+            waiting=np.asarray(result.waiting),
+            rejected=np.asarray(result.rejected),
+            raw_assign=np.asarray(result.raw_assign),
+            resv_vstar=opt(result.resv_vstar),
+            resv_delta=opt(result.resv_delta),
         )
     except Exception as e:  # the boundary returns errors, never crashes
         return SolveResponse(
@@ -80,10 +134,16 @@ def solve_from_request(req: SolveRequest,
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         stream = self.request.makefile("rwb")
+        self.server.active_connections.add(self.request)
         try:
             secret = self.server.shared_secret
             if secret is not None:
-                hello = read_frame(stream)
+                # secrets are short: cap the pre-auth frame so an
+                # unauthenticated peer can't make us buffer MAX_FRAME
+                try:
+                    hello = read_frame(stream, max_frame=4096)
+                except ValueError:
+                    return
                 if hello is None or not hmac.compare_digest(hello, secret):
                     return  # unauthenticated peer: drop before any solve
             while True:
@@ -105,6 +165,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 write_frame(stream, encode_response(response))
                 stream.flush()
         finally:
+            self.server.active_connections.discard(self.request)
             stream.close()
 
 
@@ -116,6 +177,19 @@ class PlacementService:
                  secret: Optional[bytes] = None):
         self.address = address
         if isinstance(address, str):
+            # a dead predecessor leaves its socket file behind; unlink it
+            # iff nothing is accepting (the restart-in-place flow)
+            import os
+
+            if os.path.exists(address):
+                probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    probe.connect(address)
+                except OSError:
+                    os.unlink(address)
+                else:
+                    probe.close()
+                    raise OSError(f"address in use: {address}")
             server_cls = type(
                 "_UnixServer",
                 (socketserver.ThreadingUnixStreamServer,),
@@ -130,6 +204,7 @@ class PlacementService:
         self._server = server_cls(address, _Handler)
         self._server.solver_config = config
         self._server.shared_secret = secret
+        self._server.active_connections = set()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
@@ -140,6 +215,13 @@ class PlacementService:
 
     def stop(self) -> None:
         self._server.shutdown()
+        # sever live connections too — a stopped sidecar must look like
+        # a dead process to its clients, not a half-open socket
+        for conn in list(self._server.active_connections):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
