@@ -4,26 +4,36 @@ Flagship (the driver metric): 10k-pending-pod / 5k-node churn burst —
 target < 1 s wall-clock (>= 10k pods/s). Prints exactly ONE JSON line:
 ``{"metric": ..., "value": pods_per_sec, "unit": "pods/s",
 "vs_baseline": pods_per_sec / 10000, "matrix": {...}}`` where ``matrix``
-carries the BASELINE comparison configs #1-#5:
+carries the BASELINE comparison configs #1-#5. Every matrix entry
+reports ``{pods_per_sec, p99_s, identical_to_oracle}``:
 
-1. NodeResourcesFit LeastAllocated, 100 pods / 20 nodes (+ host-oracle
-   python reference on the same config -> speedup);
+1. NodeResourcesFit LeastAllocated, 100 pods / 20 nodes — production
+   routing (the PlacementModel host-fallback cutoff) runs this on the
+   host sequential path, so the entry reports the host numbers plus the
+   device-vs-oracle identity;
 2. LoadAware mixed LS/BE, 2k pods / 500 nodes (usage + thresholds live);
-3. ElasticQuota, 5k pods / 50 groups / 1k nodes (water-filled runtime +
-   admission fused into the solve);
-4. Coscheduling, 200 gangs x 32 pods, all-or-nothing at batch end;
-5. Descheduler LoadAware rebalance sweep, 5k nodes / 30k pods.
+3. ElasticQuota, 5k pods / 50 groups / 1k nodes — the in-kernel quota
+   gate (pallas) vs the scan, winner kept, bit-identity enforced;
+4. Coscheduling, 200 gangs x 32 pods — kernel scan + batch-end gang
+   resolution vs the scan solver, winner kept, bit-identity enforced;
+5. Descheduler LoadAware rebalance sweep, 5k nodes / 30k pods, checked
+   against a numpy re-derivation;
+plus a ``sharded`` entry: multi-device solve throughput when >1 device
+is attached, else the 8-device virtual-CPU dryrun wall time (smoke).
 
-State is device-resident; the timed section is solve + assignments
-readback (what a scheduling round costs). Pod-shape bucketing
-(models/placement.py pod_bucket) amortizes compiles across queue sizes.
+Oracle identity for configs 2-4 runs on a scaled-down shape of the same
+family (the pure-Python oracle is O(P*N) and would dominate the bench at
+full size); the full-size runs are covered by the scan<->pallas
+bit-identity checks on hardware.
 
 Env knobs: KTPU_BENCH_NODES, KTPU_BENCH_PODS, KTPU_BENCH_REPEATS,
-KTPU_BENCH_MATRIX=0 to skip the matrix (flagship only).
+KTPU_BENCH_MATRIX=0 to skip the matrix (flagship only),
+KTPU_BENCH_SHARDED=0 to skip the sharded/dryrun entry.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -46,10 +56,39 @@ def _timed(fn, repeats, *args):
     return min(times), warmup, out
 
 
+def _lat_stats(fn, args, rounds):
+    """(best_s, p99_s) over >= 20 timed rounds (fewer would make "p99"
+    just the single worst sample)."""
+    lats = []
+    for _i in range(rounds):
+        t0 = time.time()
+        out = fn(*args)
+        _ = np.asarray(out[1] if isinstance(out, tuple) else out)
+        lats.append(time.time() - t0)
+    return float(min(lats)), float(np.percentile(lats, 99))
+
+
+def _p99(fn, args, rounds):
+    return _lat_stats(fn, args, rounds)[1]
+
+
 def _problem(n_nodes, n_pods, seed=1):
     from __graft_entry__ import _example_problem
 
     return _example_problem(n_nodes, n_pods, seed=seed)
+
+
+def _oracle_args(state, pods, params):
+    return (
+        np.asarray(state.alloc), np.asarray(state.used_req),
+        np.asarray(state.usage), np.asarray(state.prod_usage),
+        np.asarray(state.est_extra), np.asarray(state.prod_base),
+        np.asarray(state.metric_fresh), np.asarray(state.schedulable),
+        np.asarray(pods.req), np.asarray(pods.est),
+        np.asarray(pods.is_prod), np.asarray(pods.is_daemonset),
+        np.asarray(params.weights), np.asarray(params.thresholds),
+        np.asarray(params.prod_thresholds),
+    )
 
 
 def bench_flagship(repeats):
@@ -123,17 +162,7 @@ def bench_flagship(repeats):
             print(f"pallas path skipped: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
-    # p99 round latency (the BASELINE metric pairs pods/s with p99
-    # schedule latency): interpolated over 20+ timed rounds (fewer would
-    # make "p99" just the single worst sample)
-    lat_rounds = max(20, repeats)
-    lats = []
-    for _i in range(lat_rounds):
-        t0 = time.time()
-        o = win_fn(state, pods, params)
-        _ = np.asarray(o[1])
-        lats.append(time.time() - t0)
-    p99_s = float(np.percentile(lats, 99))
+    p99_s = _p99(win_fn, (state, pods, params), max(20, repeats))
 
     assignments = np.asarray(out[1])
     scheduled = int((assignments >= 0).sum())
@@ -151,11 +180,19 @@ def bench_flagship(repeats):
     }
 
 
+def _host_fallback_cells():
+    """The production cutoff, from the component config (kept in sync by
+    reference, not by copy)."""
+    from koordinator_tpu.cmd.scheduler import SchedulerConfig
+
+    return SchedulerConfig().host_fallback_cells
+
+
 def bench_fit_with_oracle(repeats, n_nodes=20, n_pods=100):
-    """Config #1 on device AND through the pure-python host oracle — the
-    measured host-oracle speedup + bit-identity check. At the 100x20
-    scale a single host<->device round trip dominates; the 500x200
-    variant shows the crossover."""
+    """Config #1 on device AND through the pure-python host oracle. At
+    100x20 a single host<->device round trip dominates, so production
+    (PlacementModel.host_fallback_cells) routes this shape to the host —
+    the reported pods/s is the routed path's; identity is device==host."""
     import jax
 
     from koordinator_tpu.oracle.placement import schedule_sequential
@@ -165,50 +202,72 @@ def bench_fit_with_oracle(repeats, n_nodes=20, n_pods=100):
     solve = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))
     best, _warm, out = _timed(solve, repeats, state, pods, params)
 
-    args = (
-        np.asarray(state.alloc), np.asarray(state.used_req),
-        np.asarray(state.usage), np.asarray(state.prod_usage),
-        np.asarray(state.est_extra), np.asarray(state.prod_base),
-        np.asarray(state.metric_fresh), np.asarray(state.schedulable),
-        np.asarray(pods.req), np.asarray(pods.est),
-        np.asarray(pods.is_prod), np.asarray(pods.is_daemonset),
-        np.asarray(params.weights), np.asarray(params.thresholds),
-        np.asarray(params.prod_thresholds),
-    )
+    args = _oracle_args(state, pods, params)
     t0 = time.time()
     oracle = schedule_sequential(*args)
     oracle_s = time.time() - t0
     identical = bool((np.asarray(out[1]) == np.asarray(oracle)).all())
+    # the model's routing predicate uses the BUCKETED pod count
+    # (models/placement.py _dispatch_solve after _pad_pods)
+    from koordinator_tpu.models.placement import PlacementModel
+
+    routed_host = (
+        n_nodes * PlacementModel.pod_bucket(n_pods) <= _host_fallback_cells()
+    )
+    if routed_host:
+        routed_best, p99_s = _lat_stats(
+            lambda *a: np.asarray(schedule_sequential(*a)),
+            args, max(20, repeats),
+        )
+    else:
+        routed_best, p99_s = best, _p99(
+            solve, (state, pods, params), max(20, repeats)
+        )
     return {
-        "pods_per_sec": n_pods / best,
-        "oracle_pods_per_sec": n_pods / oracle_s,
-        "speedup_vs_host_oracle": oracle_s / best,
+        "pods_per_sec": n_pods / routed_best,
+        "p99_s": p99_s,
         "identical_to_oracle": identical,
+        "solver": "host" if routed_host else "device",
+        "device_pods_per_sec": n_pods / best,
+        "oracle_pods_per_sec": n_pods / oracle_s,
+        "speedup_vs_host_oracle": oracle_s / routed_best,
     }
 
 
 def bench_loadaware(repeats):
     import jax
 
+    from koordinator_tpu.oracle.placement import schedule_sequential
     from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
 
     state, pods, params = _problem(500, 2000, seed=2)
     solve = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))
     best, _warm, _out = _timed(solve, repeats, state, pods, params)
-    return {"pods_per_sec": 2000 / best, "wall_s": best}
+    p99_s = _p99(solve, (state, pods, params), max(20, repeats))
+
+    # oracle identity on a scaled-down shape of the same family (the
+    # pure-Python oracle is O(P*N); full-size would dominate the bench)
+    s_state, s_pods, s_params = _problem(100, 300, seed=2)
+    _b, _w, s_out = _timed(solve, 1, s_state, s_pods, s_params)
+    oracle = schedule_sequential(*_oracle_args(s_state, s_pods, s_params))
+    identical = bool((np.asarray(s_out[1]) == np.asarray(oracle)).all())
+    return {
+        "pods_per_sec": 2000 / best,
+        "p99_s": p99_s,
+        "identical_to_oracle": identical,
+        "oracle_check_shape": "300x100",
+        "wall_s": best,
+    }
 
 
-def bench_quota(repeats):
-    import jax
+def _quota_problem(n_nodes, n_pods, n_quota, seed):
     import jax.numpy as jnp
 
     from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
-    from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
     from koordinator_tpu.ops.quota import QuotaState
 
-    n_nodes, n_pods, n_quota = 1000, 5000, 50
-    state, pods, params = _problem(n_nodes, n_pods, seed=3)
-    rng = np.random.default_rng(3)
+    state, pods, params = _problem(n_nodes, n_pods, seed=seed)
+    rng = np.random.default_rng(seed)
     quota_id = rng.integers(0, n_quota, n_pods).astype(np.int32)
     pods = pods._replace(quota_id=jnp.asarray(quota_id))
     total = np.asarray(state.alloc).astype(np.int64).sum(axis=0)
@@ -226,21 +285,91 @@ def bench_quota(repeats):
         min=mn, max=mx, weight=mx, allow_lent=np.ones(n_quota, bool),
         total=total, child_request=req,
     )
-    solve = jax.jit(
-        lambda s, p, pr, q: schedule_batch(s, p, pr, SolverConfig(), q)[1]
+    return state, pods, params, qstate, quota_id
+
+
+def _pick_kernel_or_scan(scan_fn, kernel_fn, repeats, args, compare):
+    """Time both paths, enforce bit-identity, keep the winner."""
+    import jax
+
+    best, _warm, out = _timed(scan_fn, repeats, *args)
+    name = "scan"
+    win = scan_fn
+    if (jax.devices()[0].platform == "tpu"
+            and os.environ.get("KTPU_BENCH_PALLAS", "1") != "0"):
+        try:
+            k_best, _kw, k_out = _timed(kernel_fn, repeats, *args)
+            if not compare(out, k_out):
+                print("WARNING: pallas kernel diverged from the scan on "
+                      "hardware — using the scan result", file=sys.stderr)
+            elif k_best < best:
+                best, out, name, win = k_best, k_out, "pallas", kernel_fn
+        except Exception as e:
+            print(f"pallas path skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return best, out, name, win
+
+
+def bench_quota(repeats):
+    import jax
+
+    from koordinator_tpu.oracle.placement import (
+        SequentialQuota,
+        schedule_sequential_quota,
     )
-    best, _warm, out = _timed(lambda *a: solve(*a), repeats,
-                              state, pods, params, qstate)
+    from koordinator_tpu.ops.binpack import SolverConfig, solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    n_nodes, n_pods, n_quota = 1000, 5000, 50
+    state, pods, params, qstate, _qid = _quota_problem(
+        n_nodes, n_pods, n_quota, seed=3
+    )
+    config = SolverConfig()
+    scan = jax.jit(lambda s, p, pr, q: solve_batch(s, p, pr, config, q).assign)
+    kern = lambda s, p, pr, q: pallas_solve_batch(s, p, pr, config, q).assign
+    cmp_assign = lambda a, b: bool((np.asarray(a) == np.asarray(b)).all())
+    best, out, solver, win = _pick_kernel_or_scan(
+        scan, kern, repeats, (state, pods, params, qstate), cmp_assign
+    )
+    p99_s = _p99(win, (state, pods, params, qstate), max(20, repeats))
     placed = int((np.asarray(out) >= 0).sum())
-    return {"pods_per_sec": n_pods / best, "wall_s": best, "placed": placed}
+
+    # scaled-down oracle identity (full quota semantics incl. admission)
+    s_state, s_pods, s_params, s_qstate, s_qid = _quota_problem(
+        100, 400, 10, seed=3
+    )
+    s_assign = np.asarray(scan(s_state, s_pods, s_params, s_qstate))
+    sq = SequentialQuota(
+        np.asarray(s_qstate.min), np.asarray(s_qstate.max),
+        np.asarray(s_qstate.auto_min), np.asarray(s_qstate.weight),
+        np.asarray(s_qstate.allow_lent), np.asarray(s_qstate.total),
+    )
+    oracle = schedule_sequential_quota(
+        *_oracle_args(s_state, s_pods, s_params)[:12],
+        s_qid, np.asarray(s_pods.non_preemptible), sq,
+        np.asarray(s_params.weights), np.asarray(s_params.thresholds),
+        np.asarray(s_params.prod_thresholds),
+    )
+    identical = bool((s_assign == np.asarray(oracle)).all())
+    return {
+        "pods_per_sec": n_pods / best,
+        "p99_s": p99_s,
+        "identical_to_oracle": identical,
+        "oracle_check_shape": "400x100x10q",
+        "solver": solver,
+        "wall_s": best,
+        "placed": placed,
+    }
 
 
 def bench_gang(repeats):
     import jax
     import jax.numpy as jnp
 
-    from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+    from koordinator_tpu.oracle.placement import schedule_sequential
+    from koordinator_tpu.ops.binpack import SolverConfig, solve_batch
     from koordinator_tpu.ops.gang import GangState
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
 
     n_gangs, size = 200, 32
     n_pods = n_gangs * size
@@ -249,14 +378,42 @@ def bench_gang(repeats):
     gang_id = np.repeat(np.arange(n_gangs, dtype=np.int32), size)
     pods = pods._replace(gang_id=jnp.asarray(gang_id))
     gstate = GangState.build(min_member=[size] * n_gangs)
-    solve = jax.jit(
-        lambda s, p, pr, g: schedule_batch(s, p, pr, SolverConfig(), None, g)[1]
+    config = SolverConfig()
+    scan = jax.jit(
+        lambda s, p, pr, g: solve_batch(s, p, pr, config, None, g)[3:7]
+    )  # (assign, commit, waiting, rejected)
+    kern = lambda s, p, pr, g: (lambda r: (r.assign, r.commit, r.waiting,
+                                           r.rejected))(
+        pallas_solve_batch(s, p, pr, config, None, g))
+
+    def cmp_tuple(a, b):
+        return all(bool((np.asarray(x) == np.asarray(y)).all())
+                   for x, y in zip(a, b))
+
+    best, out, solver, win = _pick_kernel_or_scan(
+        scan, kern, repeats, (state, pods, params, gstate), cmp_tuple
     )
-    best, _warm, out = _timed(lambda *a: solve(*a), repeats,
-                              state, pods, params, gstate)
+    p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, gstate),
+                 max(20, repeats))
     committed = int(np.asarray(out[1]).sum())
+
+    # gangs don't alter in-scan placement: the raw assignment sequence
+    # must equal the plain sequential oracle at a checkable scale
+    s_state, s_pods, s_params = _problem(100, 160, seed=4)
+    s_pods = s_pods._replace(
+        gang_id=jnp.asarray(np.repeat(np.arange(20, dtype=np.int32), 8)))
+    s_gstate = GangState.build(min_member=[8] * 20)
+    s_raw = np.asarray(jax.jit(
+        lambda s, p, pr, g: solve_batch(s, p, pr, config, None, g).raw_assign
+    )(s_state, s_pods, s_params, s_gstate))
+    oracle = schedule_sequential(*_oracle_args(s_state, s_pods, s_params))
+    identical = bool((s_raw == np.asarray(oracle)).all())
     return {
         "pods_per_sec": n_pods / best,
+        "p99_s": p99_s,
+        "identical_to_oracle": identical,
+        "oracle_check_shape": "160x100x20g",
+        "solver": solver,
         "wall_s": best,
         "committed": committed,
         "gangs": n_gangs,
@@ -294,12 +451,68 @@ def bench_rebalance(repeats):
     )
     best, _warm, out = _timed(lambda *a: fn(*a), repeats,
                               jnp.asarray(usage), jnp.asarray(alloc))
+    p99_s = _p99(lambda *a: fn(*a),
+                 (jnp.asarray(usage), jnp.asarray(alloc)), max(20, repeats))
+
+    # numpy re-derivation of the A.7 classification: overutilized iff
+    # usage > trunc(high% * capacity / 100) on any thresholded resource
+    high_q = (int(high[ResourceName.CPU])
+              * alloc[:, ResourceName.CPU].astype(np.int64)) // 100
+    want_high = usage[:, ResourceName.CPU].astype(np.int64) > high_q
+    identical = bool((np.asarray(out) == want_high).all())
     return {
         "sweeps_per_sec": 1.0 / best,
+        "p99_s": p99_s,
+        "identical_to_oracle": identical,
         "wall_ms": best * 1000,
         "nodes": n_nodes,
         "pods": n_pods,
         "overloaded": int(np.asarray(out).sum()),
+    }
+
+
+def bench_sharded(repeats):
+    """Multi-device solve throughput when the env has >1 device; else a
+    smoke timing of the 8-device virtual-CPU dryrun (so shard_solver
+    regressions are at least visible in the captured JSON)."""
+    import jax
+
+    devices = jax.devices()
+    if len(devices) > 1:
+        from koordinator_tpu.parallel.mesh import (
+            make_mesh, shard_node_state, shard_solver,
+        )
+
+        n_nodes = int(os.environ.get("KTPU_BENCH_NODES", 5000))
+        n_pods = int(os.environ.get("KTPU_BENCH_PODS", 10000))
+        state, pods, params = _problem(n_nodes, n_pods)
+        mesh = make_mesh(devices)
+        state = shard_node_state(state, mesh)
+        solve = shard_solver(mesh)
+        best, warmup, _out = _timed(solve, repeats, state, pods, params)
+        p99_s = _p99(solve, (state, pods, params), max(20, repeats))
+        return {
+            "mode": "multichip",
+            "devices": len(devices),
+            "pods_per_sec": n_pods / best,
+            "p99_s": p99_s,
+            "warmup_s": warmup,
+        }
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "__graft_entry__.py"),
+         "--dryrun-multichip", "8"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    wall = time.time() - t0
+    return {
+        "mode": "dryrun_smoke",
+        "devices": 8,
+        "ok": proc.returncode == 0 and "dryrun ok" in proc.stdout,
+        "wall_s": wall,
     }
 
 
@@ -317,12 +530,14 @@ def main():
         matrix["3_quota_5k_50q_1k"] = bench_quota(repeats)
         matrix["4_gang_200x32"] = bench_gang(repeats)
         matrix["5_rebalance_5kx30k"] = bench_rebalance(repeats)
+    if os.environ.get("KTPU_BENCH_SHARDED", "1") != "0":
+        matrix["sharded"] = bench_sharded(repeats)
 
     def _round(obj):
         if isinstance(obj, dict):
             return {k: _round(v) for k, v in obj.items()}
         if isinstance(obj, float):
-            return round(obj, 3)
+            return round(obj, 4)
         return obj
 
     pods_per_sec = flagship["pods_per_sec"]

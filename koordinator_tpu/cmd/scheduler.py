@@ -33,6 +33,9 @@ class SchedulerConfig:
     placement_backend: str = "inprocess"
     solver_address: str = "/tmp/koord-solver.sock"
     solver_secret: Optional[bytes] = None
+    #: plain solves with pods*nodes under this run on the host sequential
+    #: path — a device round trip costs more than the whole solve there
+    host_fallback_cells: int = 16384
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -62,6 +65,9 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
             score_according_prod=config.score_according_prod,
         ),
         backend=backend,
+        host_fallback_cells=(
+            0 if backend is not None else config.host_fallback_cells
+        ),
     )
     scheduler = Scheduler(
         model=model,

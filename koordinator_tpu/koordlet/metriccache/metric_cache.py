@@ -51,6 +51,12 @@ class MetricKind(str, enum.Enum):
     HOST_APP_MEMORY_USAGE = "host_app_memory_usage"
     NODE_COLD_PAGE_BYTES = "node_cold_page_bytes"    # kidled cold file pages
     NODE_PAGE_CACHE_MIB = "node_page_cache_mib"      # meminfo Cached
+    DEVICE_UTIL = "device_util"                  # percent, label minor=
+    DEVICE_MEMORY_USED = "device_memory_used"    # MiB, label minor=
+    POD_CPU_THROTTLED_RATIO = "pod_cpu_throttled_ratio"  # 0..1, label pod=
+    NODE_DISK_READ_BPS = "node_disk_read_bps"    # bytes/s, label dev=
+    NODE_DISK_WRITE_BPS = "node_disk_write_bps"  # bytes/s, label dev=
+    NODE_DISK_IO_UTIL = "node_disk_io_util"      # percent, label dev=
 
 
 class AggregationType(str, enum.Enum):

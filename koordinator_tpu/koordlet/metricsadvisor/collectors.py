@@ -333,13 +333,23 @@ class PSICollector:
 
 def default_collectors():
     """The standard collector set (reference: metrics_advisor.go
-    collector registry)."""
+    collector registry). Device/throttled/storage collectors self-gate
+    via enabled() on their source trees."""
+    from koordinator_tpu.koordlet.metricsadvisor.devices import (
+        DeviceCollector,
+        NodeStorageInfoCollector,
+        PodThrottledCollector,
+    )
+
     return [
         NodeResourceCollector(),
         PodResourceCollector(),
         BEResourceCollector(),
         SysResourceCollector(),
         PSICollector(),
+        DeviceCollector(),
+        PodThrottledCollector(),
+        NodeStorageInfoCollector(),
     ]
 
 

@@ -357,6 +357,11 @@ MEMORY_USAGE = CgroupResource(
 CPU_ACCT_USAGE = CgroupResource(
     "cpuacct.usage", "cpuacct", "cpuacct.usage", "cpu.stat",
 )
+#: cfs throttling stats (nr_periods/nr_throttled/throttled_time) — the
+#: podthrottled collector's source; same key/value format on v1 and v2
+CPU_STAT = CgroupResource(
+    "cpu.stat", "cpu", "cpu.stat", "cpu.stat",
+)
 BLKIO_IO_WEIGHT = CgroupResource(
     "blkio.cost.weight", "blkio", "blkio.cost.weight", "io.cost.weight",
     validator=_range_validator(1, 100),
@@ -424,7 +429,7 @@ _KNOWN: List[CgroupResource] = [
     MEMORY_HIGH, MEMORY_WMARK_RATIO, MEMORY_WMARK_SCALE_FACTOR,
     MEMORY_PRIORITY, MEMORY_OOM_GROUP, MEMORY_USAGE, BLKIO_IO_WEIGHT,
     BLKIO_READ_BPS, BLKIO_WRITE_BPS, BLKIO_READ_IOPS, BLKIO_WRITE_IOPS,
-    CPU_ACCT_USAGE,
+    CPU_ACCT_USAGE, CPU_STAT,
 ]
 _BY_TYPE: Dict[str, CgroupResource] = {r.resource_type: r for r in _KNOWN}
 

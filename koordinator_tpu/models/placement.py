@@ -109,6 +109,7 @@ class PlacementModel:
         pod_bucketing: bool = True,
         use_pallas: Optional[bool] = None,
         backend=None,
+        host_fallback_cells: int = 0,
     ):
         self.config = config
         self.resource_weights = dict(resource_weights or DEFAULT_RESOURCE_WEIGHTS)
@@ -126,6 +127,14 @@ class PlacementModel:
         #: remote solve backend (service.client.RemoteSolver) — the
         #: ``--placement-backend=sidecar`` boundary. None = in-process.
         self.backend = backend
+        #: route plain solves with pods*nodes <= this through the host
+        #: sequential path (oracle/placement.py): at tiny shapes a single
+        #: host<->device round trip costs more than the whole solve
+        #: (BENCH r2: 100x20 device 1.1k pods/s vs host 2.4k). 0 = off
+        #: (the default; cmd/build_scheduler enables it for production).
+        self.host_fallback_cells = host_fallback_cells
+        #: which path the last _dispatch_solve took (observability/tests)
+        self.last_solver: Optional[str] = None
         #: use the VMEM-resident pallas kernel for eligible plain solves
         #: (single TPU device, no quota/gang/reservation/NUMA/extras;
         #: bit-identical — ops/pallas_binpack.py). None = auto-detect.
@@ -450,14 +459,24 @@ class PlacementModel:
         configured remote backend (the solver sidecar) takes the whole
         solve instead — same arrays over the wire, same epilogue."""
         if self.backend is not None:
+            self.last_solver = "remote"
             return self.backend.solve_result(
                 state, batch, self.params, self.config, quota_state,
                 gang_state, extras, resv_arrays, numa_aux,
             )
+        n, p = int(state.alloc.shape[0]), int(batch.req.shape[0])
         plain = (
             quota_state is None
             and gang_state is None
             and extras is None
+            and resv_arrays is None
+            and numa_aux is None
+        )
+        if plain and 0 < n * p <= self.host_fallback_cells:
+            self.last_solver = "host"
+            return self._host_solve(state, batch)
+        kernel_ok = (
+            extras is None
             and resv_arrays is None
             and numa_aux is None
             # empty solves take solve_batch's shape early-out; they must
@@ -465,15 +484,16 @@ class PlacementModel:
             and state.alloc.shape[0] > 0
             and batch.req.shape[0] > 0
         )
-        if plain and self.use_pallas and self._pallas_eligible:
-            from koordinator_tpu.ops.pallas_binpack import (
-                pallas_schedule_batch,
-            )
+        if kernel_ok and self.use_pallas and self._pallas_eligible:
+            from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
 
             try:
-                new_state, assign = pallas_schedule_batch(
-                    state, batch, self.params, self.config
+                result = pallas_solve_batch(
+                    state, batch, self.params, self.config,
+                    quota_state, gang_state,
                 )
+                self.last_solver = "pallas"
+                return result
             except Exception as e:
                 # a real kernel failure must be visible, not a silent
                 # 2x slowdown for the model's lifetime
@@ -485,24 +505,64 @@ class PlacementModel:
                     RuntimeWarning,
                 )
                 self.use_pallas = False
-            else:
-                falses = jnp.zeros(assign.shape[0], bool)
-                return SolveResult(
-                    node_state=new_state,
-                    quota_state=None,
-                    resv_free=None,
-                    assign=assign,
-                    commit=assign >= 0,
-                    waiting=falses,
-                    rejected=falses,
-                    raw_assign=assign,
-                    resv_vstar=None,
-                    resv_delta=None,
-                    numa_consumed=None,
-                )
+        self.last_solver = "scan"
         return self._solve(
             state, batch, self.params, self.config, quota_state,
             gang_state, extras, resv_arrays, numa_aux,
+        )
+
+    def _host_solve(self, state, batch) -> SolveResult:
+        """Tiny plain solves on the host sequential path (bit-identical
+        to the scan by the differential-test contract of
+        oracle/placement.py) — no device round trip."""
+        from koordinator_tpu.oracle.placement import schedule_sequential
+
+        req = np.asarray(batch.req).copy()
+        blocked = np.asarray(batch.blocked)
+        # blocked (and bucket-padding) pods can never fit — the same
+        # hard-block encoding the pallas kernel uses
+        req[blocked, 0] = 2**30
+        assign = np.asarray(schedule_sequential(
+            np.asarray(state.alloc), np.asarray(state.used_req),
+            np.asarray(state.usage), np.asarray(state.prod_usage),
+            np.asarray(state.est_extra), np.asarray(state.prod_base),
+            np.asarray(state.metric_fresh), np.asarray(state.schedulable),
+            req, np.asarray(batch.est),
+            np.asarray(batch.is_prod), np.asarray(batch.is_daemonset),
+            np.asarray(self.params.weights),
+            np.asarray(self.params.thresholds),
+            np.asarray(self.params.prod_thresholds),
+            fit_weight=self.config.fit_weight,
+            loadaware_weight=self.config.loadaware_weight,
+            score_according_prod=self.config.score_according_prod,
+        ), dtype=np.int32)
+        used = np.asarray(state.used_req).copy()
+        estx = np.asarray(state.est_extra).copy()
+        prodb = np.asarray(state.prod_base).copy()
+        real_req = np.asarray(batch.req)
+        est = np.asarray(batch.est)
+        is_prod = np.asarray(batch.is_prod)
+        for i, a in enumerate(assign):
+            if a >= 0:
+                used[a] += real_req[i]
+                estx[a] += est[i]
+                if is_prod[i]:
+                    prodb[a] += est[i]
+        falses = np.zeros(assign.shape[0], bool)
+        return SolveResult(
+            node_state=state._replace(
+                used_req=used, est_extra=estx, prod_base=prodb
+            ),
+            quota_state=None,
+            resv_free=None,
+            assign=assign,
+            commit=assign >= 0,
+            waiting=falses,
+            rejected=falses,
+            raw_assign=assign,
+            resv_vstar=None,
+            resv_delta=None,
+            numa_consumed=None,
         )
 
     def _pad_pods(self, batch, extras, resv, n_real):

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the placement scan's plain fast path.
+"""Pallas TPU kernel for the placement scan's hot paths.
 
 The jit `lax.scan` solver (ops/binpack.py) streams the [N,R] node state
 through HBM every step; this kernel keeps the whole carry in VMEM across
@@ -6,8 +6,8 @@ all P sequential placements — one `pallas_call`, zero HBM round trips in
 the loop — for ~2x the scan's throughput (~114k pods/s vs ~56k at
 10k x 5k on one v5e chip; the baseline target is 10k/s).
 
-Bit-identical to ``schedule_batch``'s plain path (differentially tested
-in interpret mode and on hardware):
+Bit-identical to ``solve_batch`` on the covered paths (differentially
+tested in interpret mode and on hardware):
 
 - node arrays are laid out ``[R, N]`` (lanes = nodes) so the VPU runs
   full-width; pods stream through SMEM in 128-pod grid chunks (the TPU
@@ -20,16 +20,27 @@ in interpret mode and on hardware):
 - integer division uses the same exact reciprocal-multiply identity as
   the scan path (ops/common.floor_div_exact).
 
-Supported configuration (checked by :func:`pallas_supported`): no quota/
-gang/reservation/extras/NUMA state, ``score_according_prod=False``, and
-zero prod thresholds — exactly the flagship churn configuration. Other
-configurations use `solve_batch`.
+**Quota admission runs inside the kernel** (BASELINE config #3): the
+per-group ``used``/``np_used`` [Q,R] arrays live in VMEM scratch beside
+the node carry; each pod's gate is a row-masked ``used + req <= runtime``
+reduction (runtime is water-filled ONCE per solve outside the kernel —
+requests are static within a solve, ops/quota.py). **Gang resolution**
+(config #4) needs no kernel support at all: the scan places gang members
+individually and resolves all-or-nothing at batch end, so the same
+``gang_outcomes``/``release_rejected`` XLA ops run on the kernel's
+outputs — identical by construction.
+
+Supported configuration (checked by :func:`pallas_supported`):
+``score_according_prod=False``, unit plugin weights, zero prod
+thresholds; quota and gang states are covered, reservation/extras/NUMA
+still ride the scan. Reference semantics: elasticquota plugin.go:210-255
+(admission), coscheduling core/core.go:358-385 (batch-end gang gate).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,19 +48,35 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from koordinator_tpu.ops.binpack import NodeState, PodBatch, ScoreParams
+from koordinator_tpu.ops.binpack import (
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolveResult,
+)
 from koordinator_tpu.ops.common import floor_div_exact, percent_rounded
 
 CHUNK = 128
 
 
-def _make_kernel(R: int, wsum: int):
-    def kernel(req_ref, est_ref, flags_ref,       # SMEM pod chunks
-               alloc_ref, recip_ref, usage_ref, weight_ref,
-               la_ok_ref, sched_ref, fresh_ref,
-               used0_ref, est0_ref, prod0_ref,    # VMEM node state
-               assign_ref, used_out_ref, est_out_ref, prod_out_ref,
-               used_ref, estx_ref, prod_ref):     # VMEM scratch carries
+def _make_kernel(R: int, wsum: int, use_quota: bool):
+    def kernel(*refs):
+        it = iter(refs)
+        req_ref, est_ref, flags_ref = next(it), next(it), next(it)  # SMEM
+        alloc_ref, recip_ref, usage_ref, weight_ref = (
+            next(it), next(it), next(it), next(it))
+        la_ok_ref, sched_ref, fresh_ref = next(it), next(it), next(it)
+        used0_ref, est0_ref, prod0_ref = next(it), next(it), next(it)
+        if use_quota:
+            qmin_ref, qrt_ref, qused0_ref, qnp0_ref = (
+                next(it), next(it), next(it), next(it))
+        assign_ref, used_out_ref, est_out_ref, prod_out_ref = (
+            next(it), next(it), next(it), next(it))
+        if use_quota:
+            qused_out_ref, qnp_out_ref = next(it), next(it)
+        used_ref, estx_ref, prod_ref = next(it), next(it), next(it)
+        if use_quota:
+            qused_ref, qnp_ref = next(it), next(it)
         c = pl.program_id(0)
 
         @pl.when(c == 0)
@@ -57,6 +84,9 @@ def _make_kernel(R: int, wsum: int):
             used_ref[...] = used0_ref[...]
             estx_ref[...] = est0_ref[...]
             prod_ref[...] = prod0_ref[...]
+            if use_quota:
+                qused_ref[...] = qused0_ref[...]
+                qnp_ref[...] = qnp0_ref[...]
 
         alloc = alloc_ref[...]
         recip = recip_ref[...]
@@ -69,6 +99,13 @@ def _make_kernel(R: int, wsum: int):
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         chunk_lane = jax.lax.broadcasted_iota(jnp.int32, (1, CHUNK), 1)
         sub = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
+        if use_quota:
+            qmin = qmin_ref[...]
+            qrt = qrt_ref[...]
+            Qp, QL = qmin.shape  # lanes padded to the native 128 tile —
+            # Mosaic rejects bool-vector ops at odd lane widths like [Q,8]
+            qrow = jax.lax.broadcasted_iota(jnp.int32, (Qp, 1), 0)
+            lane_r = jax.lax.broadcasted_iota(jnp.int32, (1, QL), 1)
 
         def exact_div(y):
             # the shared exact reciprocal-multiply floor division — plain
@@ -102,6 +139,27 @@ def _make_kernel(R: int, wsum: int):
             is_ds = flags_ref[j, 0] > 0
             is_prod = flags_ref[j, 1] > 0
             mask = fit & (is_ds | ~fresh | la_ok)
+
+            if use_quota:
+                # row-masked admission (ops/quota.quota_admit): on the
+                # pod's requested dims, used+req <= runtime, and for
+                # non-preemptible pods np_used+req <= min
+                qid = flags_ref[j, 2]
+                non_pre = flags_ref[j, 3] > 0
+                req_lane = jnp.zeros((1, QL), jnp.int32)
+                for r in range(R):
+                    req_lane = jnp.where(lane_r == r, req_ref[j, r], req_lane)
+                sel = (qrow == qid) & (req_lane > 0)       # [Qp,QL]
+                qused = qused_ref[...]
+                qnp = qnp_ref[...]
+                # no bool-select here: Mosaic rejects select_n on i1
+                # vectors (i8->i1 trunci); violations compose from
+                # comparisons and ANDs like the plain kernel's masks
+                viol_rt = sel & (qused + req_lane > qrt)
+                viol_np = sel & non_pre & (qnp + req_lane > qmin)
+                admit = (qid < 0) | ~(jnp.any(viol_rt) | jnp.any(viol_np))
+                mask = mask & admit
+
             masked = jnp.where(mask, s1 + s2, -1)
             top = jnp.max(masked)
             # first-max tie-break (Mosaic argmax doesn't guarantee it)
@@ -117,19 +175,26 @@ def _make_kernel(R: int, wsum: int):
             prod_ref[...] = prod_ref[...] + jnp.where(
                 hit & is_prod, est_v, 0
             )
+            if use_quota:
+                addq = jnp.where(sel & ok & (qid >= 0), req_lane, 0)
+                qused_ref[...] = qused + addq
+                qnp_ref[...] = qnp + jnp.where(non_pre, addq, 0)
             return 0
 
         jax.lax.fori_loop(0, CHUNK, body, 0)
         used_out_ref[...] = used_ref[...]
         est_out_ref[...] = estx_ref[...]
         prod_out_ref[...] = prod_ref[...]
+        if use_quota:
+            qused_out_ref[...] = qused_ref[...]
+            qnp_out_ref[...] = qnp_ref[...]
 
     return kernel
 
 
 def pallas_supported(params: ScoreParams, config) -> bool:
-    """Whether this configuration maps onto the kernel (the flagship
-    plain path)."""
+    """Whether this configuration maps onto the kernel (quota and gang
+    states are additionally supported as solve arguments)."""
     return (
         not config.score_according_prod
         and config.fit_weight == 1
@@ -140,11 +205,14 @@ def pallas_supported(params: ScoreParams, config) -> bool:
 
 @functools.partial(jax.jit, static_argnames=("wsum", "interpret"))
 def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
-                  wsum: int, interpret: bool):
+                  wsum: int, interpret: bool, quota=None):
+    """quota = None | (min[Q,R], runtime[Q,R], used[Q,R], np_used[Q,R]).
+    Returns (new_state, assign[P], qused[Q,R]|None, qnp[Q,R]|None)."""
     n, r = state.alloc.shape
     p = pods.req.shape[0]
     N = ((n + 127) // 128) * 128
     P = ((p + CHUNK - 1) // CHUNK) * CHUNK
+    use_quota = quota is not None
 
     def padn(a2):
         return jnp.zeros((r, N), jnp.int32).at[:, :n].set(
@@ -172,11 +240,14 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     fresh = padmask(state.metric_fresh)
     reqs = jnp.zeros((P, r), jnp.int32).at[:p].set(pods.req)
     ests = jnp.zeros((P, r), jnp.int32).at[:p].set(pods.est)
-    flags = jnp.zeros((P, 2), jnp.int32)
+    flags = jnp.zeros((P, 4), jnp.int32)
     flags = flags.at[:p, 0].set(
         (pods.is_daemonset & ~pods.blocked).astype(jnp.int32)
     )
     flags = flags.at[:p, 1].set(pods.is_prod.astype(jnp.int32))
+    flags = flags.at[:, 2].set(-1)
+    flags = flags.at[:p, 2].set(pods.quota_id.astype(jnp.int32))
+    flags = flags.at[:p, 3].set(pods.non_preemptible.astype(jnp.int32))
     # padding pods (and host-blocked pods) can never fit
     blocked_req = jnp.int32(2**30)
     reqs = reqs.at[:p, 0].set(
@@ -186,48 +257,175 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
         reqs = reqs.at[p:, 0].set(blocked_req)
 
     full = lambda shape: pl.BlockSpec(shape, lambda c: (0, 0))
+    in_specs = [
+        pl.BlockSpec((CHUNK, r), lambda c: (c, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((CHUNK, r), lambda c: (c, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((CHUNK, 4), lambda c: (c, 0), memory_space=pltpu.SMEM),
+        full((r, N)), full((r, N)), full((r, N)),
+        pl.BlockSpec((r, 1), lambda c: (0, 0)),
+        full((1, N)), full((1, N)), full((1, N)),
+        full((r, N)), full((r, N)), full((r, N)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, CHUNK), lambda c: (0, c)),
+        full((r, N)), full((r, N)), full((r, N)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((1, P), jnp.int32),
+        jax.ShapeDtypeStruct((r, N), jnp.int32),
+        jax.ShapeDtypeStruct((r, N), jnp.int32),
+        jax.ShapeDtypeStruct((r, N), jnp.int32),
+    ]
+    scratch = [
+        pltpu.VMEM((r, N), jnp.int32),
+        pltpu.VMEM((r, N), jnp.int32),
+        pltpu.VMEM((r, N), jnp.int32),
+    ]
+    args = [reqs, ests, flags, alloc, recip, usage, weight, la_ok, sched,
+            fresh, used0, est0, prod0]
+    if use_quota:
+        qmin, qrt, qused0, qnp0 = quota
+        q = qmin.shape[0]
+        Qp = max(8, ((q + 7) // 8) * 8)  # sublane-aligned quota rows
+        QL = 128  # lane-padded to the native tile (real columns: r)
+
+        def padq(a2):
+            return jnp.zeros((Qp, QL), jnp.int32).at[:q, :r].set(
+                a2.astype(jnp.int32)
+            )
+
+        args += [padq(qmin), padq(qrt), padq(qused0), padq(qnp0)]
+        in_specs += [full((Qp, QL))] * 4
+        out_specs += [full((Qp, QL))] * 2
+        out_shape += [jax.ShapeDtypeStruct((Qp, QL), jnp.int32)] * 2
+        scratch += [pltpu.VMEM((Qp, QL), jnp.int32)] * 2
+
     out = pl.pallas_call(
-        _make_kernel(r, wsum),
+        _make_kernel(r, wsum, use_quota),
         grid=(P // CHUNK,),
-        in_specs=[
-            pl.BlockSpec((CHUNK, r), lambda c: (c, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((CHUNK, r), lambda c: (c, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((CHUNK, 2), lambda c: (c, 0),
-                         memory_space=pltpu.SMEM),
-            full((r, N)), full((r, N)), full((r, N)),
-            pl.BlockSpec((r, 1), lambda c: (0, 0)),
-            full((1, N)), full((1, N)), full((1, N)),
-            full((r, N)), full((r, N)), full((r, N)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, CHUNK), lambda c: (0, c)),
-            full((r, N)), full((r, N)), full((r, N)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, P), jnp.int32),
-            jax.ShapeDtypeStruct((r, N), jnp.int32),
-            jax.ShapeDtypeStruct((r, N), jnp.int32),
-            jax.ShapeDtypeStruct((r, N), jnp.int32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((r, N), jnp.int32),
-            pltpu.VMEM((r, N), jnp.int32),
-            pltpu.VMEM((r, N), jnp.int32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret,
-    )
-    assign, used, est, prod = out(
-        reqs, ests, flags, alloc, recip, usage, weight, la_ok, sched,
-        fresh, used0, est0, prod0,
-    )
+    )(*args)
+    if use_quota:
+        assign, used, est, prod, qused, qnp = out
+        qused, qnp = qused[:q, :r], qnp[:q, :r]
+    else:
+        assign, used, est, prod = out
+        qused = qnp = None
     new_state = state._replace(
         used_req=used[:, :n].T,
         est_extra=est[:, :n].T,
         prod_base=prod[:, :n].T,
     )
-    return new_state, assign[0, :p]
+    return new_state, assign[0, :p], qused, qnp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("wsum", "interpret", "has_gang")
+)
+def _solve_full(state, pods, params, quota_state, gang_state,
+                wsum: int, interpret: bool, has_gang: bool):
+    """Kernel scan + the scan solver's exact post-batch epilogue (gang
+    resolution, rejected releases) — one jitted program."""
+    from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
+    from koordinator_tpu.ops.quota import quota_runtime
+
+    n_pods = pods.req.shape[0]
+    quota_in = None
+    if quota_state is not None:
+        runtime = quota_runtime(quota_state)
+        quota_in = (
+            quota_state.min, runtime, quota_state.used, quota_state.np_used
+        )
+    new_state, assign, qused, qnp = _pallas_solve(
+        state, pods, params, wsum, interpret, quota_in
+    )
+    final_qstate = (
+        None if quota_state is None
+        else quota_state._replace(used=qused, np_used=qnp)
+    )
+    falses = jnp.zeros(n_pods, bool)
+    if not has_gang:
+        return SolveResult(
+            node_state=new_state,
+            quota_state=final_qstate,
+            resv_free=None,
+            assign=assign,
+            commit=assign >= 0,
+            waiting=falses,
+            rejected=falses,
+            raw_assign=assign,
+            resv_vstar=None,
+            resv_delta=None,
+            numa_consumed=None,
+        )
+    commit, waiting, rejected = gang_outcomes(assign, pods.gang_id, gang_state)
+    used_req, est_extra, prod_base = release_rejected(
+        new_state.used_req,
+        new_state.est_extra,
+        new_state.prod_base,
+        assign,
+        rejected,
+        pods.req,
+        pods.est,
+        pods.is_prod,
+    )
+    new_state = new_state._replace(
+        used_req=used_req, est_extra=est_extra, prod_base=prod_base
+    )
+    out_assign = jnp.where(commit | waiting, assign, -1).astype(jnp.int32)
+    if final_qstate is not None:
+        # release rejected pods' quota accounting (solve_batch's tail)
+        q = final_qstate.used.shape[0]
+        qidx = jnp.where(rejected & (pods.quota_id >= 0), pods.quota_id, q)
+        rel = jnp.where((rejected & (pods.quota_id >= 0))[:, None], pods.req, 0)
+        sub = jax.ops.segment_sum(rel, qidx, num_segments=q + 1)[:q]
+        np_rel = jnp.where(pods.non_preemptible[:, None], rel, 0)
+        np_sub = jax.ops.segment_sum(np_rel, qidx, num_segments=q + 1)[:q]
+        final_qstate = final_qstate._replace(
+            used=final_qstate.used - sub, np_used=final_qstate.np_used - np_sub
+        )
+    return SolveResult(
+        node_state=new_state,
+        quota_state=final_qstate,
+        resv_free=None,
+        assign=out_assign,
+        commit=commit,
+        waiting=waiting,
+        rejected=rejected,
+        raw_assign=assign,
+        resv_vstar=None,
+        resv_delta=None,
+        numa_consumed=None,
+    )
+
+
+def pallas_solve_batch(
+    state: NodeState,
+    pods: PodBatch,
+    params: ScoreParams,
+    config,
+    quota_state=None,
+    gang_state=None,
+    interpret: Optional[bool] = None,
+) -> SolveResult:
+    """Drop-in for ``solve_batch`` on the kernel paths (plain, quota,
+    gang, quota+gang). Raises ValueError for unsupported configurations —
+    callers gate on :func:`pallas_supported`."""
+    if not pallas_supported(params, config):
+        raise ValueError("configuration not supported by the pallas kernel")
+    if state.alloc.shape[0] == 0 or pods.req.shape[0] == 0:
+        raise ValueError("empty solve: use solve_batch's shape early-out")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    wsum = int(np.asarray(params.weights).sum()) or 1
+    return _solve_full(
+        state, pods, params, quota_state, gang_state, wsum, interpret,
+        gang_state is not None,
+    )
 
 
 def pallas_schedule_batch(
@@ -237,14 +435,8 @@ def pallas_schedule_batch(
     config,
     interpret: bool = None,
 ) -> Tuple[NodeState, jnp.ndarray]:
-    """Drop-in for ``schedule_batch``'s plain path on the kernel.
-
-    Raises ValueError for unsupported configurations — callers gate on
-    :func:`pallas_supported`.
-    """
-    if not pallas_supported(params, config):
-        raise ValueError("configuration not supported by the pallas kernel")
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    wsum = int(np.asarray(params.weights).sum()) or 1
-    return _pallas_solve(state, pods, params, wsum, interpret)
+    """Legacy-shaped plain-path wrapper: ``(new_state, assignments)``."""
+    result = pallas_solve_batch(
+        state, pods, params, config, interpret=interpret
+    )
+    return result.node_state, result.assign

@@ -148,3 +148,155 @@ def test_model_pallas_breaker_not_tripped_by_empty_solves():
         pending_pods=[PodSpec(name="p", requests={R.CPU: 100})]))
     assert out["default/p"] is None
     assert model.use_pallas  # breaker untouched
+
+
+def _quota_setup(state, pods, n_quota=7, seed=5, preempt_frac=0.3):
+    """Tight quotas over the _problem pods: some groups exhaust runtime
+    mid-batch so admission actually rejects."""
+    from koordinator_tpu.ops.quota import QuotaState
+
+    rng = np.random.default_rng(seed)
+    n_pods = pods.req.shape[0]
+    quota_id = rng.integers(-1, n_quota, n_pods).astype(np.int32)
+    pods = pods._replace(
+        quota_id=jnp.asarray(quota_id),
+        non_preemptible=jnp.asarray(rng.uniform(size=n_pods) < preempt_frac),
+    )
+    total = np.asarray(state.alloc).astype(np.int64).sum(axis=0)
+    mn = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mx = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mn[:, R.CPU] = total[R.CPU] // (4 * n_quota)
+    mn[:, R.MEMORY] = total[R.MEMORY] // (4 * n_quota)
+    mx[:, R.CPU] = total[R.CPU] // (n_quota + 2)
+    mx[:, R.MEMORY] = total[R.MEMORY] // (n_quota + 2)
+    req = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    pr = np.asarray(pods.req).astype(np.int64)
+    for q in range(n_quota):
+        req[q] = pr[quota_id == q].sum(axis=0)
+    qstate = QuotaState.build(
+        min=mn, max=mx, weight=mx,
+        allow_lent=np.ones(n_quota, bool), total=total, child_request=req,
+    )
+    return pods, qstate
+
+
+def _gang_setup(pods, n_gangs=9, seed=6):
+    from koordinator_tpu.ops.gang import GangState
+
+    rng = np.random.default_rng(seed)
+    n_pods = pods.req.shape[0]
+    gang_id = rng.integers(-1, n_gangs, n_pods).astype(np.int32)
+    pods = pods._replace(gang_id=jnp.asarray(gang_id))
+    sizes = [max(1, int((gang_id == g).sum())) for g in range(n_gangs)]
+    gstate = GangState.build(
+        min_member=[max(1, s - rng.integers(0, 2)) for s in sizes],
+        bound_count=rng.integers(0, 2, n_gangs),
+        strict=rng.uniform(size=n_gangs) < 0.6,
+        group_id=[f"grp{g // 2}" for g in range(n_gangs)],  # shared groups
+    )
+    return pods, gstate
+
+
+def _assert_result_identical(got, want):
+    for field in ("assign", "commit", "waiting", "rejected", "raw_assign"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, field)),
+            np.asarray(getattr(want, field)), err_msg=field)
+    for field in ("used_req", "est_extra", "prod_base"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got.node_state, field)),
+            np.asarray(getattr(want.node_state, field)), err_msg=field)
+    if want.quota_state is not None:
+        for field in ("used", "np_used"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got.quota_state, field)),
+                np.asarray(getattr(want.quota_state, field)), err_msg=field)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_quota_identical_to_scan(seed):
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    pods, qstate = _quota_setup(state, pods, seed=seed + 5)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, qstate)
+    got = pallas_solve_batch(state, pods, params, config, qstate,
+                             interpret=True)
+    _assert_result_identical(got, want)
+    # the quota gate actually fired (otherwise this test proves nothing)
+    assert int((np.asarray(want.assign) < 0).sum()) > 0
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_gang_identical_to_scan(seed):
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    pods, gstate = _gang_setup(pods, seed=seed + 7)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, None, gstate)
+    got = pallas_solve_batch(state, pods, params, config, None, gstate,
+                             interpret=True)
+    _assert_result_identical(got, want)
+    assert int(np.asarray(want.rejected).sum()) > 0  # gangs really gated
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_quota_and_gang_identical_to_scan(seed):
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    pods, qstate = _quota_setup(state, pods, seed=seed + 5)
+    pods, gstate = _gang_setup(pods, seed=seed + 7)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, qstate, gstate)
+    got = pallas_solve_batch(state, pods, params, config, qstate, gstate,
+                             interpret=True)
+    _assert_result_identical(got, want)
+
+
+def test_model_quota_gang_pallas_path_identical():
+    """PlacementModel routes quota+gang solves onto the kernel now —
+    end-to-end schedule() identity incl. waiting pods."""
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot, GangSpec, NodeMetric, NodeSpec, PodSpec, QuotaSpec,
+    )
+    from koordinator_tpu.models.placement import PlacementModel
+
+    def snap():
+        return ClusterSnapshot(
+            nodes=[NodeSpec(name=f"n{i}",
+                            allocatable={R.CPU: 8000, R.MEMORY: 16384})
+                   for i in range(4)],
+            pending_pods=(
+                [PodSpec(name=f"q{i}", quota="t", requests={R.CPU: 3000})
+                 for i in range(4)]
+                + [PodSpec(name=f"g{i}", gang="g", requests={R.CPU: 1000})
+                   for i in range(3)]
+                + [PodSpec(name="solo", requests={R.CPU: 500})]
+            ),
+            node_metrics={
+                f"n{i}": NodeMetric(node_name=f"n{i}", node_usage={},
+                                    update_time=99.0)
+                for i in range(4)
+            },
+            quotas={"t": QuotaSpec(name="t", min={R.CPU: 3000},
+                                   max={R.CPU: 6000})},
+            gangs={"g": GangSpec(name="g", min_member=3)},
+            now=100.0,
+        )
+
+    model = PlacementModel(use_pallas=True)
+    via_pallas = model.schedule(snap())
+    via_scan = PlacementModel(use_pallas=False).schedule(snap())
+    assert dict(via_pallas) == dict(via_scan)
+    assert via_pallas.waiting == via_scan.waiting
+    # quota really capped: only 2 of 4 quota pods fit 6000/3000
+    placed_q = [u for u, n in via_pallas.items()
+                if n is not None and u.startswith("default/q")]
+    assert len(placed_q) == 2
+    assert model.use_pallas  # no silent fallback
