@@ -106,7 +106,6 @@ def bench_flagship(repeats):
     state, pods, params = _problem(n_nodes, n_pods)
 
     devices = jax.devices()
-    solver_name = "scan"
     if len(devices) > 1:
         mesh = make_mesh(devices)
         state = shard_node_state(state, mesh)
@@ -116,52 +115,32 @@ def bench_flagship(repeats):
             lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig())
         )
 
-    best, warmup, out = _timed(solve, repeats, state, pods, params)
-    scan_pods_per_sec = n_pods / best
-    win_fn = solve
+    # the VMEM-resident pallas kernel leg runs single-chip only; results
+    # must be bit-identical to the scan (tests/test_pallas.py)
+    pallas_fn = None
+    if len(devices) == 1:
+        from koordinator_tpu.ops.pallas_binpack import (
+            pallas_schedule_batch,
+            pallas_supported,
+        )
 
-    if (
-        len(devices) == 1
-        and devices[0].platform == "tpu"  # interpret mode can't win
-        and os.environ.get("KTPU_BENCH_PALLAS", "1") != "0"
-    ):
-        # the VMEM-resident pallas kernel (single-chip): keep whichever
-        # path wins; results are bit-identical (tests/test_pallas.py)
-        try:
-            from koordinator_tpu.ops.pallas_binpack import (
-                pallas_schedule_batch,
-                pallas_supported,
-            )
-
+        if pallas_supported(params, SolverConfig()):
             pallas_fn = lambda s, p, pr: pallas_schedule_batch(
                 s, p, pr, SolverConfig()
             )
-            if pallas_supported(params, SolverConfig()):
-                p_best, p_warm, p_out = _timed(
-                    pallas_fn, repeats, state, pods, params,
-                )
-                identical = bool(
-                    (np.asarray(p_out[1]) == np.asarray(out[1])).all()
-                ) and all(
-                    bool((np.asarray(a) == np.asarray(b)).all())
-                    for a, b in zip(p_out[0], out[0])
-                )
-                if not identical:
-                    # a hardware divergence from the scan is a kernel bug
-                    # and must be loud, not silently discarded
-                    print(
-                        "WARNING: pallas kernel diverged from the scan on "
-                        "hardware — using the scan result",
-                        file=sys.stderr,
-                    )
-                elif p_best < best:
-                    best, warmup, out = p_best, warmup + p_warm, p_out
-                    solver_name = "pallas"
-                    win_fn = pallas_fn
-        except Exception as e:  # kernel unavailable: keep the scan, say so
-            print(f"pallas path skipped: {type(e).__name__}: {e}",
-                  file=sys.stderr)
 
+    def cmp_state_and_assign(a, b):
+        return bool(
+            (np.asarray(a[1]) == np.asarray(b[1])).all()
+        ) and all(
+            bool((np.asarray(x) == np.asarray(y)).all())
+            for x, y in zip(a[0], b[0])
+        )
+
+    best, warmup, out, solver_name, win_fn, scan_best = _pick_kernel_or_scan(
+        solve, pallas_fn, repeats, (state, pods, params), cmp_state_and_assign
+    )
+    scan_pods_per_sec = n_pods / scan_best
     p99_s = _p99(win_fn, (state, pods, params), max(20, repeats))
 
     assignments = np.asarray(out[1])
@@ -289,17 +268,26 @@ def _quota_problem(n_nodes, n_pods, n_quota, seed):
 
 
 def _pick_kernel_or_scan(scan_fn, kernel_fn, repeats, args, compare):
-    """Time both paths, enforce bit-identity, keep the winner."""
+    """Time both paths, enforce bit-identity, keep the winner — THE
+    selection policy, shared by the flagship and the matrix configs.
+    ``kernel_fn=None`` skips the kernel leg (unsupported shape/config).
+    Returns (best_s, warmup_s_total, out, solver_name, win_fn,
+    scan_best_s)."""
     import jax
 
-    best, _warm, out = _timed(scan_fn, repeats, *args)
+    best, warm, out = _timed(scan_fn, repeats, *args)
+    scan_best = best
     name = "scan"
     win = scan_fn
-    if (jax.devices()[0].platform == "tpu"
+    if (kernel_fn is not None
+            and jax.devices()[0].platform == "tpu"  # interpret can't win
             and os.environ.get("KTPU_BENCH_PALLAS", "1") != "0"):
         try:
-            k_best, _kw, k_out = _timed(kernel_fn, repeats, *args)
+            k_best, k_warm, k_out = _timed(kernel_fn, repeats, *args)
+            warm += k_warm
             if not compare(out, k_out):
+                # a hardware divergence from the scan is a kernel bug
+                # and must be loud, not silently discarded
                 print("WARNING: pallas kernel diverged from the scan on "
                       "hardware — using the scan result", file=sys.stderr)
             elif k_best < best:
@@ -307,7 +295,7 @@ def _pick_kernel_or_scan(scan_fn, kernel_fn, repeats, args, compare):
         except Exception as e:
             print(f"pallas path skipped: {type(e).__name__}: {e}",
                   file=sys.stderr)
-    return best, out, name, win
+    return best, warm, out, name, win, scan_best
 
 
 def bench_quota(repeats):
@@ -328,7 +316,7 @@ def bench_quota(repeats):
     scan = jax.jit(lambda s, p, pr, q: solve_batch(s, p, pr, config, q).assign)
     kern = lambda s, p, pr, q: pallas_solve_batch(s, p, pr, config, q).assign
     cmp_assign = lambda a, b: bool((np.asarray(a) == np.asarray(b)).all())
-    best, out, solver, win = _pick_kernel_or_scan(
+    best, _warm, out, solver, win, _scan_best = _pick_kernel_or_scan(
         scan, kern, repeats, (state, pods, params, qstate), cmp_assign
     )
     p99_s = _p99(win, (state, pods, params, qstate), max(20, repeats))
@@ -390,7 +378,7 @@ def bench_gang(repeats):
         return all(bool((np.asarray(x) == np.asarray(y)).all())
                    for x, y in zip(a, b))
 
-    best, out, solver, win = _pick_kernel_or_scan(
+    best, _warm, out, solver, win, _scan_best = _pick_kernel_or_scan(
         scan, kern, repeats, (state, pods, params, gstate), cmp_tuple
     )
     p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, gstate),

@@ -29,6 +29,8 @@ class Kind(str, enum.Enum):
     DEVICE = "Device"
     NODE_RESOURCE_TOPOLOGY = "NodeResourceTopology"
     MIGRATION_JOB = "PodMigrationJob"
+    LEASE = "Lease"
+    RECOMMENDATION = "Recommendation"
 
 
 class EventType(str, enum.Enum):
@@ -70,6 +72,13 @@ class APIServer:
                 return
             for fn in list(self._watchers[kind]):
                 fn(EventType.DELETED, name, obj)
+
+    def transact(self, fn: Callable[[], object]) -> object:
+        """Run ``fn`` atomically under the store lock (``fn`` may call
+        get/apply/delete reentrantly) — the compare-and-swap primitive
+        leader election builds its lease acquisition on."""
+        with self._lock:
+            return fn()
 
     # -- reads ---------------------------------------------------------------
 

@@ -12,10 +12,12 @@ from __future__ import annotations
 from koordinator_tpu.client.bus import APIServer, EventType, Kind
 
 
-def wire_scheduler(bus: APIServer, scheduler) -> None:
+def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
     """Subscribe a Scheduler to every kind it consumes (the reference's
     informer factory in cmd/koord-scheduler/app/server.go + frameworkext
-    eventhandlers)."""
+    eventhandlers). With ``elector`` (a LeaderElector), leader-gated bus
+    mutations (victim eviction) are fenced: a deposed leader's in-flight
+    eviction raises FencingError instead of double-applying."""
 
     def on_node(event, name, node):
         if event is EventType.DELETED:
@@ -80,9 +82,16 @@ def wire_scheduler(bus: APIServer, scheduler) -> None:
     # preemption victims must be evicted THROUGH the bus (the reference
     # deletes them via the API server) so koordlet/manager/descheduler
     # observe the eviction; the DELETED event re-enters remove_pod
-    scheduler.evict_pod_fn = lambda pod: bus.delete(
-        Kind.POD, pod_bus_name.get(pod.uid, pod.uid)
-    )
+    def _evict(pod):
+        def do():
+            bus.delete(Kind.POD, pod_bus_name.get(pod.uid, pod.uid))
+
+        if elector is not None:
+            elector.fenced(do)
+        else:
+            do()
+
+    scheduler.evict_pod_fn = _evict
 
 
 def snapshot_from_bus(bus: APIServer, now: float, with_reservations=False):
@@ -110,28 +119,46 @@ class ManagerLoop:
     """The slo-controller noderesource reconcile loop over the bus
     (SURVEY.md §3.3): NodeMetric + pods in, Node allocatable PATCH out."""
 
-    def __init__(self, bus: APIServer, controller):
+    def __init__(self, bus: APIServer, controller, elector=None):
         self.bus = bus
         self.controller = controller
+        self.elector = elector
 
     def reconcile(self, now: float) -> int:
         """One pass; returns how many nodes were synced back to the bus."""
+        import dataclasses
+
         snapshot = snapshot_from_bus(self.bus, now)
+        # the controller mutates synced nodes' allocatable in place;
+        # reconcile over COPIES so a fenced-off (deposed) or failed
+        # write-back leaks nothing into the shared bus objects — the
+        # reference's PATCH has the same all-or-nothing property
+        snapshot = dataclasses.replace(snapshot, nodes=[
+            dataclasses.replace(n, allocatable=dict(n.allocatable))
+            for n in snapshot.nodes
+        ])
         updates = self.controller.reconcile_all(snapshot)
         synced = 0
         for update, node in zip(updates, snapshot.nodes):
             if update.synced:
                 # the reference PATCHes Node.status.allocatable; here the
-                # mutated NodeSpec is re-applied, fanning out to watchers
-                self.bus.apply(Kind.NODE, node.name, node)
+                # mutated NodeSpec is re-applied, fanning out to watchers.
+                # Leader-elected managers fence the PATCH: a deposed
+                # instance must not overwrite the new leader's numbers.
+                if self.elector is not None:
+                    self.elector.fenced(
+                        lambda n=node: self.bus.apply(Kind.NODE, n.name, n)
+                    )
+                else:
+                    self.bus.apply(Kind.NODE, node.name, node)
                 synced += 1
         return synced
 
 
-def wire_manager(bus: APIServer, controller=None) -> ManagerLoop:
+def wire_manager(bus: APIServer, controller=None, elector=None) -> ManagerLoop:
     from koordinator_tpu.manager.noderesource import NodeResourceController
 
-    return ManagerLoop(bus, controller or NodeResourceController())
+    return ManagerLoop(bus, controller or NodeResourceController(), elector)
 
 
 class DeschedulerLoop:

@@ -84,6 +84,8 @@ def main(argv=None) -> int:
     parser.add_argument("--sync-interval", type=float, default=60.0)
     parser.add_argument("--once", action="store_true")
     parser.add_argument("--cluster-json", default=None)
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--leader-elect-identity", default=None)
     args = parser.parse_args(argv)
     config = ManagerConfig(feature_gates=args.feature_gates,
                            sync_interval_seconds=args.sync_interval)
@@ -100,20 +102,45 @@ def main(argv=None) -> int:
         if component is not None
     ]
     from koordinator_tpu.client.bus import APIServer
+    from koordinator_tpu.client.leaderelection import FencingError
     from koordinator_tpu.client.wiring import wire_manager
 
     bus = APIServer()
-    loop = wire_manager(bus, manager.noderesource)
+    elector = None
+    if args.leader_elect:
+        import os
+
+        from koordinator_tpu.client.leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            bus, "koord-manager",
+            args.leader_elect_identity or f"koord-manager-{os.getpid()}",
+        )
+    loop = wire_manager(bus, manager.noderesource, elector=elector)
     if args.cluster_json:
         from koordinator_tpu.cmd.scheduler import seed_bus_from_json
 
         seed_bus_from_json(bus, args.cluster_json)
     print("koord-manager components:", ", ".join(enabled))
     while True:
-        synced = loop.reconcile(now=time.time())
-        print(f"noderesource reconcile: {synced} nodes synced")
-        if args.once:
-            return 0
+        if elector is not None and not elector.tick(time.time()):
+            print("standby: lease held elsewhere")
+            if args.once:
+                return 0
+            time.sleep(elector.retry_period)
+            continue
+        try:
+            synced = loop.reconcile(now=time.time())
+        except FencingError as e:
+            # deposed mid-reconcile: demote to standby, don't crash
+            # (the scheduler run_loop handles the same exception)
+            print(f"leadership lost mid-reconcile: {e}")
+            if args.once:
+                return 1
+        else:
+            print(f"noderesource reconcile: {synced} nodes synced")
+            if args.once:
+                return 0
         time.sleep(config.sync_interval_seconds)
 
 

@@ -81,17 +81,31 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
 
 
 def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
-             log=print) -> int:
+             log=print, elector=None, now_fn=time.time) -> int:
     """The scheduling loop over a wired bus: solve the pending queue
     every interval; a sidecar outage skips the round (the control plane
-    retries — Run at cmd/koord-scheduler/app/server.go:159)."""
+    retries — Run at cmd/koord-scheduler/app/server.go:159). With
+    ``elector``, rounds run only while holding the lease (the reference
+    gates sched.Run on OnStartedLeading, server.go:226-252); losing the
+    lease mid-round surfaces as FencingError and demotes to standby."""
+    from koordinator_tpu.client.leaderelection import FencingError
     from koordinator_tpu.service.client import SolverUnavailable
 
     while True:
+        if elector is not None and not elector.tick(now_fn()):
+            log("standby: lease held elsewhere")
+            if once:
+                return 0
+            time.sleep(elector.retry_period)
+            continue
         try:
             out = scheduler.schedule_pending()
         except SolverUnavailable as e:
             log(f"round skipped: {e}")
+            if once:
+                return 1
+        except FencingError as e:
+            log(f"leadership lost mid-round: {e}")
             if once:
                 return 1
         else:
@@ -158,6 +172,12 @@ def main(argv=None) -> int:
         "--cluster-json", default=None,
         help="seed the bus from a cluster-spec JSON file",
     )
+    parser.add_argument(
+        "--leader-elect", action="store_true",
+        help="gate scheduling rounds on holding the koord-scheduler "
+             "lease (reference: --leader-elect on every binary)",
+    )
+    parser.add_argument("--leader-elect-identity", default=None)
     args = parser.parse_args(argv)
     secret = None
     if args.solver_secret_file:
@@ -175,10 +195,20 @@ def main(argv=None) -> int:
 
     scheduler = build_scheduler(config)
     bus = APIServer()
-    wire_scheduler(bus, scheduler)
+    elector = None
+    if args.leader_elect:
+        import os
+
+        from koordinator_tpu.client.leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            bus, "koord-scheduler",
+            args.leader_elect_identity or f"koord-scheduler-{os.getpid()}",
+        )
+    wire_scheduler(bus, scheduler, elector=elector)
     if args.cluster_json:
         seed_bus_from_json(bus, args.cluster_json)
-    return run_loop(scheduler, config, once=args.once)
+    return run_loop(scheduler, config, once=args.once, elector=elector)
 
 
 if __name__ == "__main__":
