@@ -117,20 +117,44 @@ def main(argv=None) -> int:
             args.leader_elect_identity or f"koord-manager-{os.getpid()}",
         )
     loop = wire_manager(bus, manager.noderesource, elector=elector)
+    from koordinator_tpu.manager.recommendation import wire_recommendation
+
+    recommender = wire_recommendation(bus, manager.mutating_webhook,
+                                      elector=elector)
     if args.cluster_json:
         from koordinator_tpu.cmd.scheduler import seed_bus_from_json
 
         seed_bus_from_json(bus, args.cluster_json)
     print("koord-manager components:", ", ".join(enabled))
+
+    def wait(seconds: float) -> bool:
+        """Sleep ``seconds`` while keeping the lease renewed: the sync
+        interval (60s) far exceeds renew_deadline (10s), so a leader
+        must tick at retry_period cadence between reconciles. Returns
+        False as soon as leadership is lost."""
+        if elector is None:
+            time.sleep(seconds)
+            return True
+        deadline = time.time() + seconds
+        while time.time() < deadline:
+            time.sleep(min(elector.retry_period, max(deadline - time.time(), 0)))
+            if not elector.tick(time.time()):
+                return False
+        return True
+
     while True:
         if elector is not None and not elector.tick(time.time()):
+            # standby: keep the recommendation histograms warm so a
+            # failover doesn't start from an empty bank
+            recommender.observe(now=time.time())
             print("standby: lease held elsewhere")
             if args.once:
-                return 0
+                return 3  # distinct from success: no reconcile ran
             time.sleep(elector.retry_period)
             continue
         try:
             synced = loop.reconcile(now=time.time())
+            recommender.run_once(now=time.time())
         except FencingError as e:
             # deposed mid-reconcile: demote to standby, don't crash
             # (the scheduler run_loop handles the same exception)
@@ -141,7 +165,7 @@ def main(argv=None) -> int:
             print(f"noderesource reconcile: {synced} nodes synced")
             if args.once:
                 return 0
-        time.sleep(config.sync_interval_seconds)
+        wait(config.sync_interval_seconds)
 
 
 if __name__ == "__main__":

@@ -95,7 +95,7 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
         if elector is not None and not elector.tick(now_fn()):
             log("standby: lease held elsewhere")
             if once:
-                return 0
+                return 3  # distinct from success: no round ran
             time.sleep(elector.retry_period)
             continue
         try:

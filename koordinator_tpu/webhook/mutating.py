@@ -71,6 +71,10 @@ class PodMutatingWebhook:
         }
         #: namespace -> labels (the reference reads Namespace objects)
         self.namespace_labels: Dict[str, Dict[str, str]] = {}
+        #: optional right-sizer: pod -> recommended requests (the
+        #: analysis.koordinator.sh consumption point; set by
+        #: manager.recommendation.wire_recommendation)
+        self.recommendation_for = None
 
     def update_profile(self, profile: ClusterColocationProfile) -> None:
         self.profiles[profile.name] = profile
@@ -89,6 +93,7 @@ class PodMutatingWebhook:
         like the reference (:66-69), only runs when at least one profile
         matched; unmanaged pods pass through untouched."""
         ns_labels = self.namespace_labels.get(pod.namespace, {})
+        self._apply_recommendation(pod)
         matched = False
         for name in sorted(self.profiles):
             profile = self.profiles[name]
@@ -98,6 +103,22 @@ class PodMutatingWebhook:
         if matched:
             self._mutate_resource_spec(pod)
         return pod
+
+    def _apply_recommendation(self, pod: PodSpec) -> None:
+        """Right-size native requests from a covering Recommendation
+        (before profile translation so batch/mid rewrites see the sized
+        values). Limits only ever grow to keep limit >= request."""
+        if self.recommendation_for is None:
+            return
+        recommended = self.recommendation_for(pod)
+        if not recommended:
+            return
+        for res, value in recommended.items():
+            if res not in pod.requests:
+                continue  # only size resources the pod actually requests
+            pod.requests[res] = int(value)
+            if res in pod.limits and pod.limits[res] < pod.requests[res]:
+                pod.limits[res] = pod.requests[res]
 
     def _apply_profile(self, pod: PodSpec, profile: ClusterColocationProfile) -> None:
         pod.labels.update(profile.labels)

@@ -1,0 +1,200 @@
+"""Recommendation/analysis pipeline (VERDICT round-2 ask 6).
+
+Reference: apis/analysis/v1alpha1/recommendation_types.go:55 — targets a
+workload or pod selector; status carries recommended resources. The
+controller computes status from the same decaying-histogram peaks the
+koordlet prediction subsystem uses, and the webhook consumes it to
+right-size pod requests from observed usage.
+"""
+
+from koordinator_tpu.apis.analysis import (
+    CONDITION_NO_SAMPLES,
+    CONDITION_READY,
+    Recommendation,
+    RecommendationTarget,
+)
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+from koordinator_tpu.client import APIServer, Kind
+from koordinator_tpu.manager.recommendation import (
+    RecommendationController,
+    wire_recommendation,
+)
+from koordinator_tpu.webhook import PodMutatingWebhook
+
+WORKLOAD = "Deployment/default/web"
+
+
+def seed(bus, n_pods=3):
+    bus.apply(Kind.NODE, "n0", NodeSpec(
+        name="n0", allocatable={R.CPU: 32000, R.MEMORY: 65536}))
+    for i in range(n_pods):
+        bus.apply(Kind.POD, f"default/web-{i}", PodSpec(
+            name=f"web-{i}", owner=WORKLOAD, node_name="n0",
+            labels={"app": "web"},
+            requests={R.CPU: 4000, R.MEMORY: 8192}))
+
+
+def report(bus, t, cpu, mem, n_pods=3):
+    bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+        node_name="n0",
+        node_usage={R.CPU: cpu * n_pods, R.MEMORY: mem * n_pods},
+        pod_usages={
+            f"default/web-{i}": {R.CPU: cpu, R.MEMORY: mem}
+            for i in range(n_pods)
+        },
+        update_time=t,
+    ))
+
+
+class TestController:
+    def test_no_samples_condition(self):
+        bus = APIServer()
+        c = RecommendationController(bus)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD)))
+        assert c.run_once(now=10.0) == 1
+        rec = bus.get(Kind.RECOMMENDATION, "web")
+        assert rec.conditions[CONDITION_NO_SAMPLES] is True
+        assert not rec.ready
+
+    def test_peaks_become_status(self):
+        """Pods requesting 4000m/8192Mi but using ~1000m/2048Mi get a
+        recommendation near usage x safety margin (p95 cpu / p98 mem,
+        +10% — predict_server semantics), far below the request."""
+        bus = APIServer()
+        c = RecommendationController(bus)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD)))
+        seed(bus)
+        for k in range(20):
+            report(bus, t=float(k + 1), cpu=1000, mem=2048)
+            c.observe(now=float(k + 1))
+        assert c.reconcile(now=30.0) == 1
+        rec = bus.get(Kind.RECOMMENDATION, "web")
+        assert rec.ready and rec.conditions[CONDITION_READY]
+        assert 1000 <= rec.recommended[R.CPU] <= 1400
+        assert 2048 <= rec.recommended[R.MEMORY] <= 2600
+
+    def test_selector_target_and_stale_metric_dedup(self):
+        bus = APIServer()
+        c = RecommendationController(bus)
+        bus.apply(Kind.RECOMMENDATION, "by-label", Recommendation(
+            name="by-label",
+            target=RecommendationTarget(pod_selector={"app": "web"})))
+        seed(bus, n_pods=1)
+        report(bus, t=5.0, cpu=500, mem=1024, n_pods=1)
+        assert c.observe(now=5.0) == 1
+        # same update_time again: no new samples folded in
+        assert c.observe(now=6.0) == 0
+        report(bus, t=7.0, cpu=500, mem=1024, n_pods=1)
+        assert c.observe(now=7.0) == 1
+
+    def test_unmatched_pods_ignored(self):
+        bus = APIServer()
+        c = RecommendationController(bus)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload="Deployment/default/other")))
+        seed(bus)
+        report(bus, t=1.0, cpu=1000, mem=2048)
+        assert c.observe(now=1.0) == 0
+
+
+class TestFailoverSafety:
+    def test_fresh_controller_does_not_clobber_ready_status(self):
+        """Post-failover warm-up: a new leader's empty histogram bank
+        must not overwrite a ready Recommendation a previous leader
+        published (code-review regression)."""
+        bus = APIServer()
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD),
+            recommended={R.CPU: 1200}, update_time=5.0,
+            conditions={CONDITION_READY: True}))
+        fresh = RecommendationController(bus)
+        assert fresh.reconcile(now=10.0) == 0
+        rec = bus.get(Kind.RECOMMENDATION, "web")
+        assert rec.ready and rec.recommended == {R.CPU: 1200}
+
+    def test_deposed_controller_publish_is_fenced(self):
+        from koordinator_tpu.client.leaderelection import (
+            FencingError,
+            LeaderElector,
+        )
+
+        bus = APIServer()
+        ea = LeaderElector(bus, "koord-manager", "a")
+        eb = LeaderElector(bus, "koord-manager", "b")
+        c = RecommendationController(bus, elector=ea)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD)))
+        seed(bus, n_pods=1)
+        report(bus, t=1.0, cpu=500, mem=1024, n_pods=1)
+        ea.tick(0.0)
+        c.observe(now=1.0)
+        eb.tick(20.0)                 # failover: a deposed
+        import pytest
+
+        with pytest.raises(FencingError):
+            c.reconcile(now=21.0)
+        assert not bus.get(Kind.RECOMMENDATION, "web").ready
+
+
+class TestWebhookConsumption:
+    def test_pod_requests_right_sized_from_observed_usage(self):
+        """The VERDICT done-criterion: a pod's requests get right-sized
+        from observed usage in a bus test."""
+        bus = APIServer()
+        webhook = PodMutatingWebhook()
+        controller = wire_recommendation(bus, webhook)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD)))
+        seed(bus)
+        for k in range(20):
+            report(bus, t=float(k + 1), cpu=1000, mem=2048)
+            controller.observe(now=float(k + 1))
+        controller.reconcile(now=30.0)
+
+        # a new replica arrives over-requesting 4 cores; admission sizes
+        # it to the observed peak (and lifts no limits since none set)
+        pod = PodSpec(name="web-new", owner=WORKLOAD,
+                      requests={R.CPU: 4000, R.MEMORY: 8192})
+        webhook.mutate(pod)
+        assert 1000 <= pod.requests[R.CPU] <= 1400
+        assert 2048 <= pod.requests[R.MEMORY] <= 2600
+
+    def test_limits_grow_to_cover_request(self):
+        bus = APIServer()
+        webhook = PodMutatingWebhook()
+        wire_recommendation(bus, webhook)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD),
+            recommended={R.CPU: 3000}, update_time=1.0,
+            conditions={CONDITION_READY: True}))
+        pod = PodSpec(name="p", owner=WORKLOAD,
+                      requests={R.CPU: 1000}, limits={R.CPU: 2000})
+        webhook.mutate(pod)
+        assert pod.requests[R.CPU] == 3000
+        assert pod.limits[R.CPU] == 3000
+
+    def test_not_ready_recommendation_leaves_pod_untouched(self):
+        bus = APIServer()
+        webhook = PodMutatingWebhook()
+        wire_recommendation(bus, webhook)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD)))
+        pod = PodSpec(name="p", owner=WORKLOAD, requests={R.CPU: 1000})
+        webhook.mutate(pod)
+        assert pod.requests[R.CPU] == 1000
+
+    def test_only_requested_resources_sized(self):
+        bus = APIServer()
+        webhook = PodMutatingWebhook()
+        wire_recommendation(bus, webhook)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD),
+            recommended={R.CPU: 3000, R.MEMORY: 4096}, update_time=1.0,
+            conditions={CONDITION_READY: True}))
+        pod = PodSpec(name="p", owner=WORKLOAD, requests={R.CPU: 1000})
+        webhook.mutate(pod)
+        assert pod.requests[R.CPU] == 3000
+        assert R.MEMORY not in pod.requests  # never invents a request
