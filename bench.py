@@ -28,7 +28,8 @@ bit-identity checks on hardware.
 
 Env knobs: KTPU_BENCH_NODES, KTPU_BENCH_PODS, KTPU_BENCH_REPEATS,
 KTPU_BENCH_MATRIX=0 to skip the matrix (flagship only),
-KTPU_BENCH_SHARDED=0 to skip the sharded/dryrun entry.
+KTPU_BENCH_SHARDED=0 to skip the sharded/dryrun entry,
+KTPU_BENCH_PALLAS=0 to disable the pallas kernel legs (scan only).
 """
 
 import json

@@ -54,6 +54,7 @@ from koordinator_tpu.koordlet.runtimehooks.protocol import (
     milli_cpu_to_shares,
 )
 from koordinator_tpu.koordlet.runtimehooks.reconciler import Reconciler
+from koordinator_tpu.koordlet.runtimehooks.nri import NriServer
 from koordinator_tpu.koordlet.runtimehooks.server import RuntimeHookServer
 from koordinator_tpu.koordlet.statesinformer.states_informer import (
     StateKind,
@@ -80,6 +81,7 @@ __all__ = [
     "PodContext",
     "Reconciler",
     "Resources",
+    "NriServer",
     "RuntimeHookServer",
     "RuntimeHooks",
     "Stage",
@@ -146,3 +148,11 @@ class RuntimeHooks:
 
     def reconcile(self) -> int:
         return self.reconciler.reconcile(self.informer.running_pods())
+
+    def attach_nri(self, pleg, events=None, disable_stages=None):
+        """Enable NRI mode: subscribe the hook server to a PLEG event
+        stream (nri/server.go); returns the attached NriServer."""
+        return NriServer(
+            self.server, self.informer, events=events,
+            disable_stages=disable_stages,
+        ).attach(pleg)

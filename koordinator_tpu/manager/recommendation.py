@@ -125,7 +125,12 @@ class RecommendationController:
                 recommended[ResourceName.MEMORY] = int(
                     math.ceil(peak["memory"])
                 )
-            if recommended != rec.recommended:
+            # publish on value change OR when the Ready condition is
+            # missing (a pre-seeded recommended value without conditions
+            # must still become consumable)
+            if recommended != rec.recommended or not rec.conditions.get(
+                CONDITION_READY
+            ):
                 self._publish(name, dataclasses.replace(
                     rec,
                     recommended=recommended,

@@ -115,6 +115,22 @@ class TestFailoverSafety:
         rec = bus.get(Kind.RECOMMENDATION, "web")
         assert rec.ready and rec.recommended == {R.CPU: 1200}
 
+    def test_preseeded_value_still_gains_ready_condition(self):
+        """A Recommendation seeded with a recommended value but no
+        conditions must become consumable once the controller computes
+        the same value (code-review regression)."""
+        bus = APIServer()
+        c = RecommendationController(bus)
+        bus.apply(Kind.RECOMMENDATION, "web", Recommendation(
+            name="web", target=RecommendationTarget(workload=WORKLOAD),
+            recommended={R.CPU: 550, R.MEMORY: 1123}))
+        seed(bus, n_pods=1)
+        for k in range(10):
+            report(bus, t=float(k + 1), cpu=500, mem=1024, n_pods=1)
+            c.observe(now=float(k + 1))
+        assert c.reconcile(now=20.0) == 1
+        assert bus.get(Kind.RECOMMENDATION, "web").ready
+
     def test_deposed_controller_publish_is_fenced(self):
         from koordinator_tpu.client.leaderelection import (
             FencingError,
