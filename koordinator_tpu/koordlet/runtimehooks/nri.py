@@ -29,6 +29,7 @@ from typing import Dict, Iterable, Optional, Set, Tuple
 
 from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
 from koordinator_tpu.koordlet.pleg.pleg import EventType, PodLifecycleEvent
+from koordinator_tpu.koordlet.runtimehooks.hooks import Stage
 from koordinator_tpu.koordlet.runtimehooks.server import RuntimeHookServer
 
 #: reference event names (nriConfig.Events) keyed by PLEG event type
@@ -66,8 +67,12 @@ class NriServer:
         # cgroup-dir index, rebuilt only when the pod set changes — a
         # PLEG burst after downtime must not do O(pods) work per event.
         # With an informer we invalidate on its PODS callback; without
-        # one (plain pods() source) every event rebuilds.
+        # one (plain pods() source) every event rebuilds. The previous
+        # index is retained so DELETE events still resolve after the
+        # informer drops the pod (the reference NRI event carries pod
+        # info in-band; PLEG only carries the cgroup dir).
         self._index: Optional[Dict[str, Tuple[PodMeta, Optional[str]]]] = None
+        self._prev: Dict[str, Tuple[PodMeta, Optional[str]]] = {}
         self._index_tracked = False
         register = getattr(pod_provider, "register_callback", None)
         if register is not None:
@@ -75,8 +80,20 @@ class NriServer:
 
             register(StateKind.PODS, lambda _kind, _pods: self._invalidate())
             self._index_tracked = True
+            # eager build: the retained-previous-index guarantee for
+            # stop events needs a snapshot from BEFORE the pod drops
+            self._index = self._build_index()
         self.events = frozenset(events) if events is not None else ALL_EVENTS
-        self.disable_stages = disable_stages or set()
+        unknown = self.events - ALL_EVENTS
+        if unknown:
+            raise ValueError(f"unknown NRI events: {sorted(unknown)}; "
+                             f"valid: {sorted(ALL_EVENTS)}")
+        self.disable_stages = set(disable_stages or ())
+        valid_stages = {s.value for s in Stage}
+        unknown = self.disable_stages - valid_stages
+        if unknown:
+            raise ValueError(f"unknown stages: {sorted(unknown)}; "
+                             f"valid: {sorted(valid_stages)}")
         #: counters per event name (observability parity with the
         #: reference's klog'd handlers)
         self.handled: Dict[str, int] = {}
@@ -114,7 +131,9 @@ class NriServer:
         return stage_name in self.disable_stages
 
     def _invalidate(self) -> None:
-        self._index = None
+        if self._index is not None:
+            self._prev = self._index
+        self._index = self._build_index()
 
     def _build_index(self) -> Dict[str, Tuple[PodMeta, Optional[str]]]:
         index: Dict[str, Tuple[PodMeta, Optional[str]]] = {}
@@ -124,19 +143,31 @@ class NriServer:
                 index[cdir] = (pod, name)
         return index
 
-    def _resolve(self, cgroup_dir: str) -> Tuple[Optional[PodMeta], Optional[str]]:
+    def _resolve(self, cgroup_dir: str, include_retired: bool = False
+                 ) -> Tuple[Optional[PodMeta], Optional[str]]:
         """(pod, container_name) for a PLEG cgroup dir; container_name
-        is None for pod-level dirs."""
+        is None for pod-level dirs. ``include_retired`` also consults
+        the previous index so stop events resolve after the informer
+        already dropped the pod."""
         if self._index is None or not self._index_tracked:
+            if self._index is not None:
+                self._prev = self._index
             self._index = self._build_index()
-        return self._index.get(cgroup_dir, (None, None))
+        hit = self._index.get(cgroup_dir)
+        if hit is None and include_retired:
+            hit = self._prev.get(cgroup_dir)
+        return hit if hit is not None else (None, None)
 
     def handle_event(self, event: PodLifecycleEvent) -> bool:
         """PLEG handler: returns True if a hook stage ran."""
         name = EVENT_NAMES[event.event]
         if name not in self.events:
             return False
-        pod, container = self._resolve(event.cgroup_dir)
+        is_stop = event.event in (
+            EventType.POD_DELETED, EventType.CONTAINER_DELETED
+        )
+        pod, container = self._resolve(event.cgroup_dir,
+                                       include_retired=is_stop)
         if pod is None:
             self.dropped += 1
             return False

@@ -125,12 +125,10 @@ class RecommendationController:
                 recommended[ResourceName.MEMORY] = int(
                     math.ceil(peak["memory"])
                 )
-            # publish on value change OR when the Ready condition is
-            # missing (a pre-seeded recommended value without conditions
-            # must still become consumable)
-            if recommended != rec.recommended or not rec.conditions.get(
-                CONDITION_READY
-            ):
+            # publish on value change OR when not yet consumable (a
+            # pre-seeded recommended value without conditions must still
+            # gain the Ready condition)
+            if recommended != rec.recommended or not rec.ready:
                 self._publish(name, dataclasses.replace(
                     rec,
                     recommended=recommended,

@@ -1,0 +1,294 @@
+"""Full-system e2e sim (VERDICT round-2 ask 7) — the kind-e2e analogue.
+
+ALL five components composed on ONE bus with fake cgroupfs per node,
+converging over multiple rounds (reference scope:
+test/e2e/scheduling/ + test/e2e/slocontroller/):
+
+  webhook admits (BE cpu -> batch-cpu) ->
+  scheduler places (batched solver) ->
+  koordlet actuates cpuset/bvt/cfs THROUGH THE NRI EVENT PATH and
+  reports NodeMetric from its metric cache ->
+  manager recomputes batch allocatable from the reports ->
+  descheduler migrates off the hot node (reservation-first) ->
+  the moved pod re-places and the BE pod lands on reclaimed capacity.
+"""
+
+import dataclasses
+
+from koordinator_tpu.apis.extension import QoSClass, ResourceName as R
+from koordinator_tpu.apis.types import PodSpec
+from koordinator_tpu.client import (
+    APIServer,
+    Kind,
+    wire_manager,
+    wire_scheduler,
+)
+from koordinator_tpu.client.wiring import wire_descheduler
+from koordinator_tpu.cmd.manager import ManagerConfig, build_manager
+from koordinator_tpu.descheduler.framework import (
+    Descheduler,
+    MigrationEvictor,
+    Profile,
+)
+from koordinator_tpu.descheduler.loadaware import (
+    LowNodeLoad,
+    LowNodeLoadArgs,
+    NodePool,
+)
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
+from koordinator_tpu.koordlet.metricsadvisor.framework import (
+    ContainerBatchResources,
+    PodMeta,
+)
+from koordinator_tpu.koordlet.pleg import PLEG
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.resourceexecutor.executor import ensure_cgroup_dir
+from koordinator_tpu.koordlet.runtimehooks import RuntimeHooks
+from koordinator_tpu.koordlet.statesinformer import (
+    NodeMetricReporter,
+    StatesInformer,
+)
+from koordinator_tpu.koordlet.system.cgroup import (
+    CPU_BVT_WARP_NS,
+    CPU_CFS_QUOTA,
+    SystemConfig,
+)
+from koordinator_tpu.manager.sloconfig import NodeSLOSpec
+from koordinator_tpu.scheduler import Scheduler
+
+NODE_CPU = 10000
+NODE_MEM = 32768
+
+
+class KoordletSim:
+    """One node agent over fake cgroupfs: informer + metric cache +
+    runtimehooks (NRI mode off the PLEG stream) + NodeMetric reporter."""
+
+    def __init__(self, bus, node_name, root):
+        self.bus = bus
+        self.node_name = node_name
+        self.cfg = SystemConfig(cgroup_root=str(root / "cg"),
+                                proc_root=str(root / "proc"))
+        for d in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
+            ensure_cgroup_dir(d, self.cfg)
+        self.informer = StatesInformer()
+        self.executor = ResourceUpdateExecutor(self.cfg, auditor=Auditor())
+        self.hooks = RuntimeHooks(self.informer, self.executor)
+        slo = NodeSLOSpec()
+        for tier in ("lsr", "ls", "be"):
+            getattr(slo.resource_qos_strategy, tier).enable = True
+        self.informer.set_node_slo(slo)
+        self.cache = MetricCache()
+        self.reporter = NodeMetricReporter(self.cache, self.informer)
+        self.pleg = PLEG(self.cfg)
+        self.nri = self.hooks.attach_nri(self.pleg)
+        self.pleg.poll()  # primer
+
+    def pod_meta(self, pod: PodSpec) -> PodMeta:
+        tier = "besteffort" if pod.qos == QoSClass.BE else "burstable"
+        base = f"kubepods/{tier}/pod{pod.name}"
+        meta = PodMeta(
+            pod.uid, base, pod.qos,
+            containers={"main": f"{base}/main"},
+            name=pod.name,
+            priority=pod.priority,
+            cpu_request_mcpu=pod.requests.get(R.CPU, 0),
+            memory_request_mib=pod.requests.get(R.MEMORY, 0),
+            labels=dict(pod.labels),
+            annotations=dict(pod.annotations),
+        )
+        batch_cpu = pod.requests.get(R.BATCH_CPU, 0)
+        if batch_cpu:
+            meta.batch_resources["main"] = ContainerBatchResources(
+                request_mcpu=batch_cpu, limit_mcpu=batch_cpu,
+                memory_limit_bytes=pod.requests.get(
+                    R.BATCH_MEMORY, 0) * 1024 * 1024,
+            )
+        return meta
+
+    def step(self, now: float, usage_by_uid) -> None:
+        """One agent tick: sync pods from the bus, let the "runtime"
+        create cgroup dirs (PLEG -> NRI hooks actuate), sample usage
+        into the cache, report NodeMetric onto the bus."""
+        node = self.bus.get(Kind.NODE, self.node_name)
+        self.informer.set_node(node)
+        pods = [p for p in self.bus.list(Kind.POD).values()
+                if p.node_name == self.node_name]
+        metas = [self.pod_meta(p) for p in pods]
+        self.informer.set_pods(metas)
+        for meta in metas:  # the runtime materializes the cgroups
+            ensure_cgroup_dir(meta.cgroup_dir, self.cfg)
+            for cdir in meta.containers.values():
+                ensure_cgroup_dir(cdir, self.cfg)
+        self.pleg.poll()   # lifecycle events -> NRI hook dispatch
+
+        node_cpu = node_mem = 0
+        for meta in metas:
+            cpu, mem = usage_by_uid.get(meta.uid, (0, 0))
+            self.cache.append(MetricKind.POD_CPU_USAGE, {"pod": meta.uid},
+                              now, cpu)
+            self.cache.append(MetricKind.POD_MEMORY_USAGE, {"pod": meta.uid},
+                              now, mem)
+            node_cpu += cpu
+            node_mem += mem
+        self.cache.append(MetricKind.SYS_CPU_USAGE, None, now, 300)
+        self.cache.append(MetricKind.SYS_MEMORY_USAGE, None, now, 512)
+        self.cache.append(MetricKind.NODE_CPU_USAGE, None, now,
+                          node_cpu + 300)
+        self.cache.append(MetricKind.NODE_MEMORY_USAGE, None, now,
+                          node_mem + 512)
+        metric = self.reporter.report(now)
+        self.bus.apply(Kind.NODE_METRIC, self.node_name, metric)
+
+
+def test_five_components_converge(tmp_path):
+    bus = APIServer()
+
+    # -- koord-manager: webhook chain + noderesource loop; a
+    # ClusterColocationProfile makes label-selected pods BE/batch (the
+    # reference injection path — translation only runs on profile match)
+    from koordinator_tpu.webhook import ClusterColocationProfile
+
+    manager = build_manager(ManagerConfig())
+    manager.mutating_webhook.update_profile(ClusterColocationProfile(
+        name="colo-be", selector={"colocation": "true"},
+        qos_class=QoSClass.BE, priority=5500,
+    ))
+    manager_loop = wire_manager(bus, manager.noderesource)
+
+    # -- koord-scheduler (batched placement)
+    scheduler = Scheduler()
+    wire_scheduler(bus, scheduler)
+
+    # -- koord-descheduler: LowNodeLoad -> reservation-first migration
+    desch_loop = wire_descheduler(bus, Descheduler(
+        profiles=[Profile(name="lnl", balance_plugins=[LowNodeLoad(
+            LowNodeLoadArgs(node_pools=[NodePool(
+                low_thresholds={R.CPU: 30}, high_thresholds={R.CPU: 70},
+            )])
+        )])],
+        evictor=MigrationEvictor(),
+    ))
+
+    # -- two nodes, each with its own koordlet over fake cgroupfs
+    from koordinator_tpu.apis.types import NodeSpec
+
+    for name in ("hot", "cold"):
+        bus.apply(Kind.NODE, name, NodeSpec(
+            name=name, allocatable={R.CPU: NODE_CPU, R.MEMORY: NODE_MEM}))
+    sims = {name: KoordletSim(bus, name, tmp_path / name)
+            for name in ("hot", "cold")}
+
+    # -- workload arrives through admission
+    web1 = PodSpec(name="web1", qos=QoSClass.LS, priority=9500,
+                   requests={R.CPU: 3000, R.MEMORY: 4096})
+    web2 = PodSpec(name="web2", qos=QoSClass.LS, priority=9500,
+                   requests={R.CPU: 3000, R.MEMORY: 4096})
+    batch = PodSpec(name="crunch", labels={"colocation": "true"},
+                    requests={R.CPU: 2000, R.MEMORY: 2048})
+    for pod in (web1, web2, batch):
+        admitted, violations = manager.admit_pod(pod)
+        assert not violations
+        bus.apply(Kind.POD, admitted.uid, admitted)
+    # the profile made the pod BE/batch and translated its resources
+    assert batch.qos == QoSClass.BE and batch.priority == 5500
+    assert batch.requests == {R.BATCH_CPU: 2000, R.BATCH_MEMORY: 2048}
+
+    # usage model: web1 runs hot (8200m) until the rebalance spreads the
+    # load, then normalizes; web2 and crunch stay light
+    usage = {"default/web1": (8200, 4096), "default/web2": (600, 2048),
+             "default/crunch": (400, 1024)}
+
+    migrated = []
+    web1_home = None
+    for i in range(8):
+        t = 100.0 + 40.0 * i
+        for sim in sims.values():
+            sim.step(t, usage)
+        manager_loop.reconcile(now=t + 1)
+        scheduler.schedule_pending(now=t + 2)
+        if web1_home is None:
+            web1_home = bus.get(Kind.POD, "default/web1").node_name
+        if i >= 2:  # metrics warmed: let the descheduler act
+            migrated += desch_loop.run_once(now=t + 3)
+        if migrated:
+            usage["default/web1"] = (2000, 4096)
+
+    # -- convergence assertions -------------------------------------------
+    pods = {p.name: p for p in bus.list(Kind.POD).values()}
+
+    # 1. everything is placed
+    assert pods["web1"].node_name is not None
+    assert pods["web2"].node_name is not None
+    assert pods["crunch"].node_name is not None
+
+    # 2. the manager recomputed batch allocatable from koordlet reports
+    #    (BE pod schedules only against reclaimed kubernetes.io/batch-*)
+    crunch_node = bus.get(Kind.NODE, pods["crunch"].node_name)
+    assert crunch_node.allocatable.get(R.BATCH_CPU, 0) >= 2000
+
+    # 3. the descheduler migrated web1 off its hot node through a
+    #    reservation on the other node
+    assert "default/web1" in migrated
+    assert len(bus.list(Kind.MIGRATION_JOB)) >= 1
+    assert pods["web1"].node_name != web1_home  # actually moved
+
+    # 4. koordlet actuated QoS through the NRI path: bvt landed for the
+    #    LS pods, cfs quota for the BE pod, on the RIGHT node's cgroupfs
+    for name in ("web1", "web2"):
+        node = pods[name].node_name
+        sim = sims[node]
+        assert sim.nri.handled.get("RunPodSandbox", 0) >= 1
+        assert CPU_BVT_WARP_NS.read(
+            f"kubepods/burstable/pod{name}", sim.cfg) == "2"
+    be_sim = sims[pods["crunch"].node_name]
+    assert CPU_BVT_WARP_NS.read(
+        "kubepods/besteffort/podcrunch", be_sim.cfg) == "-1"
+    # batch limit 2000m -> cfs quota 200000us on the container
+    assert CPU_CFS_QUOTA.read(
+        "kubepods/besteffort/podcrunch/main", be_sim.cfg) == "200000"
+
+    # 5. NodeMetric reports round-tripped: web1's current node reports
+    #    its (normalized, windowed-average) usage on the bus
+    hot_metric = bus.get(Kind.NODE_METRIC, pods["web1"].node_name)
+    reported = hot_metric.pod_usages["default/web1"][R.CPU]
+    assert 2000 <= reported <= 8200
+
+
+def test_sim_survives_pod_churn(tmp_path):
+    """Deleting a pod mid-sim: the koordlet drops it, the reporter stops
+    reporting it, the manager's batch numbers grow back."""
+    bus = APIServer()
+    manager = build_manager(ManagerConfig())
+    manager_loop = wire_manager(bus, manager.noderesource)
+    scheduler = Scheduler()
+    wire_scheduler(bus, scheduler)
+    from koordinator_tpu.apis.types import NodeSpec
+
+    bus.apply(Kind.NODE, "n0", NodeSpec(
+        name="n0", allocatable={R.CPU: NODE_CPU, R.MEMORY: NODE_MEM}))
+    sim = KoordletSim(bus, "n0", tmp_path)
+
+    heavy = PodSpec(name="heavy", qos=QoSClass.LS, priority=9500,
+                    requests={R.CPU: 6000, R.MEMORY: 8192})
+    admitted, _ = manager.admit_pod(heavy)
+    bus.apply(Kind.POD, admitted.uid, admitted)
+    usage = {"default/heavy": (6000, 8192)}
+
+    for i in range(3):
+        t = 100.0 + 40.0 * i
+        sim.step(t, usage)
+        manager_loop.reconcile(now=t + 1)
+        scheduler.schedule_pending(now=t + 2)
+    low_batch = bus.get(Kind.NODE, "n0").allocatable.get(R.BATCH_CPU, 0)
+
+    bus.delete(Kind.POD, "default/heavy")
+    for i in range(3, 9):
+        t = 100.0 + 40.0 * i
+        sim.step(t, {})
+        manager_loop.reconcile(now=t + 1)
+    high_batch = bus.get(Kind.NODE, "n0").allocatable.get(R.BATCH_CPU, 0)
+    assert high_batch > low_batch  # reclaimed capacity grew back
+    metric = bus.get(Kind.NODE_METRIC, "n0")
+    assert "default/heavy" not in metric.pod_usages

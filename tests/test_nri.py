@@ -127,6 +127,48 @@ class TestDispatch:
         assert not nri.handled
 
 
+class TestStopEvents:
+    def test_stop_hooks_run_after_informer_dropped_pod(self, tmp_path):
+        """Deletion ordering in practice: the informer drops the pod
+        BEFORE the runtime tears down the cgroup dir. The stop stages
+        must still resolve through the retained index (code-review
+        regression)."""
+        import shutil
+        import os
+
+        cfg, informer, hooks = make_env(tmp_path)
+        pleg = PLEG(cfg)
+        pod = ls_pod()
+        informer.set_pods([pod])
+        nri = hooks.attach_nri(pleg)
+        ensure_cgroup_dir(pod.cgroup_dir, cfg)
+        ensure_cgroup_dir(pod.containers["main"], cfg)
+        pleg.poll()
+
+        informer.set_pods([])        # informer drops the pod first...
+        shutil.rmtree(os.path.join(cfg.cgroup_root, "cpu",
+                                   pod.cgroup_dir))  # ...then the dir goes
+        pleg.poll()
+        assert nri.handled.get("StopPodSandbox") == 1
+        assert nri.handled.get("StopContainer") == 1
+        assert nri.dropped == 0
+
+    def test_unknown_event_name_rejected(self, tmp_path):
+        import pytest
+
+        cfg, informer, hooks = make_env(tmp_path)
+        with pytest.raises(ValueError, match="CreateContainers"):
+            hooks.attach_nri(PLEG(cfg), events={"CreateContainers"})
+
+    def test_unknown_stage_name_rejected(self, tmp_path):
+        import pytest
+
+        cfg, informer, hooks = make_env(tmp_path)
+        with pytest.raises(ValueError, match="PreRunPodsandbox"):
+            hooks.attach_nri(PLEG(cfg),
+                             disable_stages={"PreRunPodsandbox"})
+
+
 class TestSynchronize:
     def test_attach_synchronizes_existing_pods(self, tmp_path):
         """A restarted koordlet converges immediately: attach() re-runs
