@@ -21,10 +21,12 @@ tested in interpret mode and on hardware):
   the scan path (ops/common.floor_div_exact).
 
 **Quota admission runs inside the kernel** (BASELINE config #3): the
-per-group ``used``/``np_used`` [Q,R] arrays live in VMEM scratch beside
-the node carry; each pod's gate is a row-masked ``used + req <= runtime``
-reduction (runtime is water-filled ONCE per solve outside the kernel —
-requests are static within a solve, ops/quota.py). **Gang resolution**
+per-group ``used``/``np_used`` arrays live in VMEM scratch beside the
+node carry, laid out ``[R, Qp]`` — groups on lanes, resources on
+sublanes, the same orientation as the node arrays — so each pod's gate
+is a single-tile lane-masked ``used + req <= runtime`` check (runtime is
+water-filled ONCE per solve outside the kernel — requests are static
+within a solve, ops/quota.py). **Gang resolution**
 (config #4) needs no kernel support at all: the scan places gang members
 individually and resolves all-or-nothing at batch end, so the same
 ``gang_outcomes``/``release_rejected`` XLA ops run on the kernel's
@@ -102,10 +104,11 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
         if use_quota:
             qmin = qmin_ref[...]
             qrt = qrt_ref[...]
-            Qp, QL = qmin.shape  # lanes padded to the native 128 tile —
-            # Mosaic rejects bool-vector ops at odd lane widths like [Q,8]
-            qrow = jax.lax.broadcasted_iota(jnp.int32, (Qp, 1), 0)
-            lane_r = jax.lax.broadcasted_iota(jnp.int32, (1, QL), 1)
+            # groups on LANES, resources on sublanes ([R, Qp]) — the
+            # same layout as the node arrays, so the whole gate works a
+            # single (8, 128k) tile instead of a row-padded [Q, 128]
+            Qp = qmin.shape[1]
+            qlane = jax.lax.broadcasted_iota(jnp.int32, (1, Qp), 1)
 
         def exact_div(y):
             # the shared exact reciprocal-multiply floor division — plain
@@ -141,22 +144,21 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
             mask = fit & (is_ds | ~fresh | la_ok)
 
             if use_quota:
-                # row-masked admission (ops/quota.quota_admit): on the
-                # pod's requested dims, used+req <= runtime, and for
-                # non-preemptible pods np_used+req <= min
+                # masked admission (ops/quota.quota_admit): on the pod's
+                # requested dims, used+req <= runtime, and for
+                # non-preemptible pods np_used+req <= min. sel picks the
+                # pod's group column x its requested resource rows; the
+                # per-pod req_v column vector broadcasts across lanes.
                 qid = flags_ref[j, 2]
                 non_pre = flags_ref[j, 3] > 0
-                req_lane = jnp.zeros((1, QL), jnp.int32)
-                for r in range(R):
-                    req_lane = jnp.where(lane_r == r, req_ref[j, r], req_lane)
-                sel = (qrow == qid) & (req_lane > 0)       # [Qp,QL]
+                sel = (qlane == qid) & (req_v > 0)         # [R,Qp]
                 qused = qused_ref[...]
                 qnp = qnp_ref[...]
                 # no bool-select here: Mosaic rejects select_n on i1
                 # vectors (i8->i1 trunci); violations compose from
                 # comparisons and ANDs like the plain kernel's masks
-                viol_rt = sel & (qused + req_lane > qrt)
-                viol_np = sel & non_pre & (qnp + req_lane > qmin)
+                viol_rt = sel & (qused + req_v > qrt)
+                viol_np = sel & non_pre & (qnp + req_v > qmin)
                 admit = (qid < 0) | ~(jnp.any(viol_rt) | jnp.any(viol_np))
                 mask = mask & admit
 
@@ -176,7 +178,7 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
                 hit & is_prod, est_v, 0
             )
             if use_quota:
-                addq = jnp.where(sel & ok & (qid >= 0), req_lane, 0)
+                addq = jnp.where(sel & ok & (qid >= 0), req_v, 0)
                 qused_ref[...] = qused + addq
                 qnp_ref[...] = qnp + jnp.where(non_pre, addq, 0)
             return 0
@@ -286,19 +288,20 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     if use_quota:
         qmin, qrt, qused0, qnp0 = quota
         q = qmin.shape[0]
-        Qp = max(8, ((q + 7) // 8) * 8)  # sublane-aligned quota rows
-        QL = 128  # lane-padded to the native tile (real columns: r)
+        Qp = ((q + 127) // 128) * 128  # groups on lanes, tile-aligned
 
         def padq(a2):
-            return jnp.zeros((Qp, QL), jnp.int32).at[:q, :r].set(
-                a2.astype(jnp.int32)
+            # [Q, R] -> [R, Qp]: group lanes, resource sublanes (the
+            # node-array layout, so the admission gate is one tile)
+            return jnp.zeros((r, Qp), jnp.int32).at[:, :q].set(
+                a2.astype(jnp.int32).T
             )
 
         args += [padq(qmin), padq(qrt), padq(qused0), padq(qnp0)]
-        in_specs += [full((Qp, QL))] * 4
-        out_specs += [full((Qp, QL))] * 2
-        out_shape += [jax.ShapeDtypeStruct((Qp, QL), jnp.int32)] * 2
-        scratch += [pltpu.VMEM((Qp, QL), jnp.int32)] * 2
+        in_specs += [full((r, Qp))] * 4
+        out_specs += [full((r, Qp))] * 2
+        out_shape += [jax.ShapeDtypeStruct((r, Qp), jnp.int32)] * 2
+        scratch += [pltpu.VMEM((r, Qp), jnp.int32)] * 2
 
     out = pl.pallas_call(
         _make_kernel(r, wsum, use_quota),
@@ -311,7 +314,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     )(*args)
     if use_quota:
         assign, used, est, prod, qused, qnp = out
-        qused, qnp = qused[:q, :r], qnp[:q, :r]
+        qused, qnp = qused[:, :q].T, qnp[:, :q].T
     else:
         assign, used, est, prod = out
         qused = qnp = None

@@ -213,6 +213,21 @@ def _assert_result_identical(got, want):
                 np.asarray(getattr(want.quota_state, field)), err_msg=field)
 
 
+def test_quota_many_groups_identical_to_scan():
+    """>128 quota groups exercises the multi-tile lane padding of the
+    [R, Qp] quota layout (groups on lanes)."""
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=3)
+    pods, qstate = _quota_setup(state, pods, n_quota=150, seed=9)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, qstate)
+    got = pallas_solve_batch(state, pods, params, config, qstate,
+                             interpret=True)
+    _assert_result_identical(got, want)
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_quota_identical_to_scan(seed):
     from koordinator_tpu.ops.binpack import solve_batch
