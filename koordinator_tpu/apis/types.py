@@ -102,6 +102,11 @@ class PodSpec:
     node_selector: Optional[Dict[str, str]] = None
     #: Σ container restart counts (status) — TooManyRestarts input
     restart_count: int = 0
+    #: assumed on a node behind a gang Permit barrier, NOT yet bound —
+    #: the scheduler holds capacity but the placement is not observable
+    #: (the reference keeps WaitOnPermit assumptions out of the API
+    #: server; node agents must not treat such a pod as running)
+    waiting_permit: bool = False
 
     def __post_init__(self) -> None:
         if self.priority_class is None:

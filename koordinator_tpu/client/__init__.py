@@ -12,6 +12,8 @@ consumes and publish what it produces.
 
 from koordinator_tpu.client.bus import APIServer, Kind  # noqa: F401
 from koordinator_tpu.client.wiring import (  # noqa: F401
+    wire_descheduler,
+    wire_koordlet,
     wire_manager,
     wire_scheduler,
 )

@@ -79,6 +79,26 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
     bus.watch(Kind.NODE_RESOURCE_TOPOLOGY, on_nrt)
     bus.watch(Kind.DEVICE, on_device)
 
+    # bindings must be PUBLISHED through the bus (the reference's Bind
+    # goes to the API server) so node agents and controllers observe
+    # them: re-apply each newly committed pod after the round. The
+    # resulting MODIFIED event re-enters update_pod, which handles
+    # refreshes of assigned pods idempotently.
+    inner_schedule = scheduler.schedule_pending
+
+    def schedule_and_publish(now=None):
+        out = inner_schedule(now=now)
+        for uid, node in out.items():
+            if node is None:
+                continue
+            key = pod_bus_name.get(uid, uid)
+            pod = bus.get(Kind.POD, key)
+            if pod is not None and getattr(pod, "node_name", None) == node:
+                bus.apply(Kind.POD, key, pod)
+        return out
+
+    scheduler.schedule_pending = schedule_and_publish
+
     # preemption victims must be evicted THROUGH the bus (the reference
     # deletes them via the API server) so koordlet/manager/descheduler
     # observe the eviction; the DELETED event re-enters remove_pod
@@ -119,10 +139,15 @@ class ManagerLoop:
     """The slo-controller noderesource reconcile loop over the bus
     (SURVEY.md §3.3): NodeMetric + pods in, Node allocatable PATCH out."""
 
-    def __init__(self, bus: APIServer, controller, elector=None):
+    def __init__(self, bus: APIServer, controller, elector=None,
+                 nodeslo=None):
         self.bus = bus
         self.controller = controller
         self.elector = elector
+        #: optional NodeSLO renderer (manager/nodeslo.py) — when set,
+        #: reconcile also publishes each node's rendered NodeSLO on the
+        #: bus (the nodeslo_controller.go loop) for koordlets to consume
+        self.nodeslo = nodeslo
 
     def reconcile(self, now: float) -> int:
         """One pass; returns how many nodes were synced back to the bus."""
@@ -152,13 +177,95 @@ class ManagerLoop:
                 else:
                     self.bus.apply(Kind.NODE, node.name, node)
                 synced += 1
+        if self.nodeslo is not None:
+            for node in snapshot.nodes:
+                spec = self.nodeslo.render(node.name, node.labels)
+                if spec != self.bus.get(Kind.NODE_SLO, node.name):
+                    # fenced like the Node PATCH above: a deposed
+                    # manager must not overwrite the leader's render
+                    if self.elector is not None:
+                        self.elector.fenced(lambda n=node.name, s=spec:
+                                            self.bus.apply(Kind.NODE_SLO, n, s))
+                    else:
+                        self.bus.apply(Kind.NODE_SLO, node.name, spec)
         return synced
 
 
-def wire_manager(bus: APIServer, controller=None, elector=None) -> ManagerLoop:
+def wire_manager(bus: APIServer, controller=None, elector=None,
+                 nodeslo=None) -> ManagerLoop:
     from koordinator_tpu.manager.noderesource import NodeResourceController
 
-    return ManagerLoop(bus, controller or NodeResourceController(), elector)
+    return ManagerLoop(bus, controller or NodeResourceController(), elector,
+                       nodeslo)
+
+
+class KoordletLoop:
+    """One node agent on the bus (the koordlet side of §1's layer map):
+    consumes its Node, its node's pods, and its rendered NodeSLO through
+    watches — the informer then fans those into runtimehooks/qosmanager —
+    and reports NodeMetric status back (states_nodemetric.go sync)."""
+
+    def __init__(self, bus: APIServer, informer, node_name: str,
+                 reporter=None, pod_meta_fn=None):
+        from koordinator_tpu.koordlet.statesinformer import pod_meta_from_spec
+
+        self.bus = bus
+        self.informer = informer
+        self.node_name = node_name
+        self.reporter = reporter
+        self._meta_fn = pod_meta_fn or pod_meta_from_spec
+        self._pods = {}
+
+        def on_node(event, name, node):
+            if name == node_name and event is not EventType.DELETED:
+                informer.set_node(node)
+
+        def on_slo(event, name, slo):
+            if name == node_name and event is not EventType.DELETED:
+                informer.set_node_slo(slo)
+
+        def on_pod(event, name, pod):
+            # a gang member waiting at the Permit barrier is assumed
+            # (node_name set in the scheduler cache) but NOT bound: the
+            # agent must not run it (the reference keeps WaitOnPermit
+            # assumptions out of the API server)
+            mine = (
+                getattr(pod, "node_name", None) == node_name
+                and not getattr(pod, "waiting_permit", False)
+            )
+            if event is EventType.DELETED or not mine:
+                if self._pods.pop(pod.uid, None) is None:
+                    return  # never ours: don't rebuild the pod list
+            else:
+                self._pods[pod.uid] = pod
+            informer.set_pods(
+                [self._meta_fn(p) for p in self._pods.values()]
+            )
+
+        bus.watch(Kind.NODE, on_node)
+        bus.watch(Kind.NODE_SLO, on_slo)
+        bus.watch(Kind.POD, on_pod)
+
+    def pods(self):
+        return list(self._pods.values())
+
+    def report(self, now: float):
+        """Aggregate the metric cache into a NodeMetric and publish it
+        (requires a NodeMetricReporter)."""
+        if self.reporter is None:
+            raise RuntimeError(
+                "wire_koordlet was built without a NodeMetricReporter; "
+                "pass reporter= to report NodeMetric"
+            )
+        metric = self.reporter.report(now)
+        if metric is not None:
+            self.bus.apply(Kind.NODE_METRIC, self.node_name, metric)
+        return metric
+
+
+def wire_koordlet(bus: APIServer, informer, node_name: str, reporter=None,
+                  pod_meta_fn=None) -> KoordletLoop:
+    return KoordletLoop(bus, informer, node_name, reporter, pod_meta_fn)
 
 
 class DeschedulerLoop:

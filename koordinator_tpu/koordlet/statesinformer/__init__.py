@@ -9,6 +9,7 @@ from koordinator_tpu.koordlet.statesinformer.reporters import (
     DeviceReporter,
     NodeTopologyReporter,
     PodsInformer,
+    pod_meta_from_spec,
 )
 
 __all__ = [
@@ -18,4 +19,5 @@ __all__ = [
     "DeviceReporter",
     "NodeTopologyReporter",
     "PodsInformer",
+    "pod_meta_from_spec",
 ]

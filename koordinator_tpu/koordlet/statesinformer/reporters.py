@@ -46,6 +46,51 @@ class KubeletStub(Protocol):
     def get_all_pods(self) -> Sequence[PodMeta]: ...
 
 
+def pod_meta_from_spec(pod) -> PodMeta:
+    """Scheduler-side PodSpec -> node-agent PodMeta (the projection a
+    kubelet scrape would yield for a pod bound to this node): kube-QoS
+    tier from the koordinator QoS class, one ``main`` container, batch
+    resources populated for pods running on reclaimed batch-* columns."""
+    from koordinator_tpu.apis.extension import QoSClass
+    from koordinator_tpu.koordlet.metricsadvisor.framework import (
+        ContainerBatchResources,
+    )
+
+    # kubelet layout: BE -> besteffort, LS -> burstable, LSR/LSE
+    # (guaranteed) sit DIRECTLY under kubepods — cgreconcile's tier
+    # rollups and memory.min protection depend on this nesting
+    if pod.qos == QoSClass.BE:
+        base = f"kubepods/besteffort/pod{pod.name}"
+    elif pod.qos in (QoSClass.LSR, QoSClass.LSE):
+        base = f"kubepods/pod{pod.name}"
+    else:
+        base = f"kubepods/burstable/pod{pod.name}"
+    meta = PodMeta(
+        pod.uid, base, pod.qos,
+        containers={"main": f"{base}/main"},
+        name=pod.name,
+        priority=pod.priority,
+        cpu_request_mcpu=pod.requests.get(ResourceName.CPU, 0),
+        cpu_limit_mcpu=pod.limits.get(ResourceName.CPU, 0),
+        memory_request_mib=pod.requests.get(ResourceName.MEMORY, 0),
+        memory_limit_mib=pod.limits.get(ResourceName.MEMORY, 0),
+        labels=dict(pod.labels),
+        annotations=dict(pod.annotations),
+    )
+    batch_cpu = pod.requests.get(ResourceName.BATCH_CPU, 0)
+    if batch_cpu:
+        limit_cpu = pod.limits.get(ResourceName.BATCH_CPU, batch_cpu)
+        meta.batch_resources["main"] = ContainerBatchResources(
+            request_mcpu=batch_cpu,
+            limit_mcpu=limit_cpu,
+            memory_limit_bytes=pod.limits.get(
+                ResourceName.BATCH_MEMORY,
+                pod.requests.get(ResourceName.BATCH_MEMORY, 0),
+            ) * 1024 * 1024,
+        )
+    return meta
+
+
 class PodsInformer:
     """Polls the kubelet stub and publishes the pod list (the reference's
     pods informer plugin; the poll interval is the caller's tick)."""

@@ -100,11 +100,15 @@ class SchedulerCache:
             self.assumed.pop(uid, None)
             if pod is not None:
                 pod.node_name = None
+                pod.waiting_permit = False
                 self.pending[pod.uid] = pod
 
     def finish_binding(self, uid: str) -> None:
         with self._lock:
             self.assumed.pop(uid, None)
+            pod = self.pods.get(uid)
+            if pod is not None:
+                pod.waiting_permit = False  # the Permit barrier opened
 
     # -- snapshot -----------------------------------------------------------
 

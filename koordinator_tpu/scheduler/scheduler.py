@@ -333,8 +333,12 @@ class Scheduler:
                 self._account_quota(pending.get(uid))
         for uid, node in result.waiting.items():
             # waiting gang members hold their node (and their quota, as
-            # the incremental Reserve does) but are not bound
+            # the incremental Reserve does) but are not bound — flagged
+            # so bus observers (node agents) don't treat them as running
             self.cache.assume_pod(uid, node, now=at)
+            held = self.cache.pods.get(uid)
+            if held is not None:
+                held.waiting_permit = True
             self._account_quota(pending.get(uid))
             self._waiting[uid] = node
             self._waiting_since.setdefault(uid, at)
@@ -598,6 +602,9 @@ class Scheduler:
                 self.gang_manager.on_pod_bound(pod_uid)
             else:
                 at = now if now is not None else time.time()
+                held = self.cache.pods.get(pod_uid)
+                if held is not None:
+                    held.waiting_permit = True
                 self._waiting[pod_uid] = outcome.node
                 self._waiting_since.setdefault(pod_uid, at)
                 state = outcome.cycle_state

@@ -60,6 +60,92 @@ class TestWiredScheduler:
         assert "default/p" not in s.cache.pods
 
 
+def test_bindings_published_and_koordlet_wired():
+    """Bindings flow THROUGH the bus (the reference Binds via the API
+    server): a wired koordlet sees its node's pods appear via watch, and
+    the manager-rendered NodeSLO reaches its informer."""
+    from koordinator_tpu.client import wire_koordlet, wire_manager
+    from koordinator_tpu.koordlet.statesinformer import StatesInformer
+    from koordinator_tpu.manager.nodeslo import NodeSLOController
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    informer = StatesInformer()
+    loop = wire_koordlet(bus, informer, "n0")
+    events = []
+    bus.watch(Kind.POD, lambda e, n, o: events.append((e, n)))
+
+    bus.apply(Kind.NODE, "n0", NodeSpec(
+        name="n0", allocatable={R.CPU: 16000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+        node_name="n0", node_usage={}, update_time=99.0))
+    bus.apply(Kind.POD, "default/p", PodSpec(name="p", qos=QoSClass.LS,
+                                             requests={R.CPU: 1000}))
+    assert informer.running_pods() == []      # pending: not on any node
+    out = s.schedule_pending(now=100.0)
+    assert out["default/p"] == "n0"
+    # the bind was re-published as a MODIFIED event...
+    assert (EventType.MODIFIED, "default/p") in events
+    # ...and the koordlet informer now holds the pod as PodMeta
+    metas = informer.running_pods()
+    assert [m.uid for m in metas] == ["default/p"]
+    assert metas[0].cpu_request_mcpu == 1000
+    assert loop.pods()[0].node_name == "n0"
+
+    # manager renders NodeSLO onto the bus; the informer receives it
+    manager = wire_manager(bus, nodeslo=NodeSLOController())
+    manager.reconcile(now=100.0)
+    assert bus.get(Kind.NODE_SLO, "n0") is not None
+    assert informer.get_node_slo() is bus.get(Kind.NODE_SLO, "n0")
+
+    # eviction through the bus drops it from the informer too
+    bus.delete(Kind.POD, "default/p")
+    assert informer.running_pods() == []
+
+
+def test_waiting_gang_member_not_visible_to_koordlet():
+    """A gang member held at the Permit barrier is assumed (node_name
+    set) but NOT bound: a MODIFIED event on it must not make a wired
+    koordlet run it (code-review regression)."""
+    from koordinator_tpu.apis.types import GangMode, GangSpec
+    from koordinator_tpu.client import wire_koordlet
+    from koordinator_tpu.koordlet.statesinformer import StatesInformer
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    informer = StatesInformer()
+    wire_koordlet(bus, informer, "n0")
+    bus.apply(Kind.NODE, "n0", NodeSpec(
+        name="n0", allocatable={R.CPU: 16000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+        node_name="n0", node_usage={}, update_time=99.0))
+    # a 2-member NonStrict gang with one member present: the placed
+    # member waits at Permit holding its node
+    bus.apply(Kind.GANG, "g", GangSpec(name="g", min_member=2,
+                                       mode=GangMode.NON_STRICT))
+    lone = PodSpec(name="m0", gang="g", requests={R.CPU: 1000})
+    bus.apply(Kind.POD, "default/m0", lone)
+    out = s.schedule_pending(now=100.0)
+    assert out["default/m0"] is None and out.waiting["default/m0"] == "n0"
+    assert s.cache.pods["default/m0"].waiting_permit
+    # a stray MODIFIED event (e.g. a label refresh) must not leak the
+    # held placement to the agent
+    bus.apply(Kind.POD, "default/m0", s.cache.pods["default/m0"])
+    assert informer.running_pods() == []
+
+    # the second member arrives: the barrier opens, both publish, the
+    # agent now runs both
+    bus.apply(Kind.POD, "default/m1", PodSpec(
+        name="m1", gang="g", requests={R.CPU: 1000}))
+    out = s.schedule_pending(now=101.0)
+    assert out["default/m0"] == "n0" and out["default/m1"] == "n0"
+    assert not s.cache.pods["default/m0"].waiting_permit
+    assert sorted(m.uid for m in informer.running_pods()) == [
+        "default/m0", "default/m1"]
+
+
 def test_full_colocation_loop_over_bus():
     """§3.2 + §3.3 + §3.1 end-to-end: NodeMetric report → manager batch
     overcommit PATCH → scheduler places a BE pod against batch-cpu."""

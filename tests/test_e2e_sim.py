@@ -20,10 +20,11 @@ from koordinator_tpu.apis.types import PodSpec
 from koordinator_tpu.client import (
     APIServer,
     Kind,
+    wire_descheduler,
+    wire_koordlet,
     wire_manager,
     wire_scheduler,
 )
-from koordinator_tpu.client.wiring import wire_descheduler
 from koordinator_tpu.cmd.manager import ManagerConfig, build_manager
 from koordinator_tpu.descheduler.framework import (
     Descheduler,
@@ -37,10 +38,6 @@ from koordinator_tpu.descheduler.loadaware import (
 )
 from koordinator_tpu.koordlet.audit import Auditor
 from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
-from koordinator_tpu.koordlet.metricsadvisor.framework import (
-    ContainerBatchResources,
-    PodMeta,
-)
 from koordinator_tpu.koordlet.pleg import PLEG
 from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
 from koordinator_tpu.koordlet.resourceexecutor.executor import ensure_cgroup_dir
@@ -54,6 +51,7 @@ from koordinator_tpu.koordlet.system.cgroup import (
     CPU_CFS_QUOTA,
     SystemConfig,
 )
+from koordinator_tpu.manager.nodeslo import NodeSLOController
 from koordinator_tpu.manager.sloconfig import NodeSLOSpec
 from koordinator_tpu.scheduler import Scheduler
 
@@ -61,9 +59,20 @@ NODE_CPU = 10000
 NODE_MEM = 32768
 
 
+def enabled_slo_controller():
+    """Cluster NodeSLO with the groupidentity tiers enabled — rendered
+    per node by the manager and consumed by koordlets over the bus."""
+    slo = NodeSLOSpec()
+    for tier in ("lsr", "ls", "be"):
+        getattr(slo.resource_qos_strategy, tier).enable = True
+    return NodeSLOController(cluster_spec=slo)
+
+
 class KoordletSim:
-    """One node agent over fake cgroupfs: informer + metric cache +
-    runtimehooks (NRI mode off the PLEG stream) + NodeMetric reporter."""
+    """One node agent over fake cgroupfs, wired to the bus through
+    wire_koordlet: its informer state (node, node's pods, NodeSLO) is
+    driven entirely by bus watches; actuation runs through runtimehooks
+    (NRI mode off the PLEG stream); NodeMetric reports flow back."""
 
     def __init__(self, bus, node_name, root):
         self.bus = bus
@@ -75,48 +84,20 @@ class KoordletSim:
         self.informer = StatesInformer()
         self.executor = ResourceUpdateExecutor(self.cfg, auditor=Auditor())
         self.hooks = RuntimeHooks(self.informer, self.executor)
-        slo = NodeSLOSpec()
-        for tier in ("lsr", "ls", "be"):
-            getattr(slo.resource_qos_strategy, tier).enable = True
-        self.informer.set_node_slo(slo)
         self.cache = MetricCache()
-        self.reporter = NodeMetricReporter(self.cache, self.informer)
+        self.loop = wire_koordlet(
+            bus, self.informer, node_name,
+            reporter=NodeMetricReporter(self.cache, self.informer),
+        )
         self.pleg = PLEG(self.cfg)
         self.nri = self.hooks.attach_nri(self.pleg)
         self.pleg.poll()  # primer
 
-    def pod_meta(self, pod: PodSpec) -> PodMeta:
-        tier = "besteffort" if pod.qos == QoSClass.BE else "burstable"
-        base = f"kubepods/{tier}/pod{pod.name}"
-        meta = PodMeta(
-            pod.uid, base, pod.qos,
-            containers={"main": f"{base}/main"},
-            name=pod.name,
-            priority=pod.priority,
-            cpu_request_mcpu=pod.requests.get(R.CPU, 0),
-            memory_request_mib=pod.requests.get(R.MEMORY, 0),
-            labels=dict(pod.labels),
-            annotations=dict(pod.annotations),
-        )
-        batch_cpu = pod.requests.get(R.BATCH_CPU, 0)
-        if batch_cpu:
-            meta.batch_resources["main"] = ContainerBatchResources(
-                request_mcpu=batch_cpu, limit_mcpu=batch_cpu,
-                memory_limit_bytes=pod.requests.get(
-                    R.BATCH_MEMORY, 0) * 1024 * 1024,
-            )
-        return meta
-
     def step(self, now: float, usage_by_uid) -> None:
-        """One agent tick: sync pods from the bus, let the "runtime"
-        create cgroup dirs (PLEG -> NRI hooks actuate), sample usage
-        into the cache, report NodeMetric onto the bus."""
-        node = self.bus.get(Kind.NODE, self.node_name)
-        self.informer.set_node(node)
-        pods = [p for p in self.bus.list(Kind.POD).values()
-                if p.node_name == self.node_name]
-        metas = [self.pod_meta(p) for p in pods]
-        self.informer.set_pods(metas)
+        """One agent tick: the informer already tracks the bus; let the
+        "runtime" create cgroup dirs (PLEG -> NRI hooks actuate), sample
+        usage into the cache, report NodeMetric onto the bus."""
+        metas = self.informer.running_pods()
         for meta in metas:  # the runtime materializes the cgroups
             ensure_cgroup_dir(meta.cgroup_dir, self.cfg)
             for cdir in meta.containers.values():
@@ -138,8 +119,7 @@ class KoordletSim:
                           node_cpu + 300)
         self.cache.append(MetricKind.NODE_MEMORY_USAGE, None, now,
                           node_mem + 512)
-        metric = self.reporter.report(now)
-        self.bus.apply(Kind.NODE_METRIC, self.node_name, metric)
+        self.loop.report(now)
 
 
 def test_five_components_converge(tmp_path):
@@ -155,7 +135,8 @@ def test_five_components_converge(tmp_path):
         name="colo-be", selector={"colocation": "true"},
         qos_class=QoSClass.BE, priority=5500,
     ))
-    manager_loop = wire_manager(bus, manager.noderesource)
+    manager_loop = wire_manager(bus, manager.noderesource,
+                                nodeslo=enabled_slo_controller())
 
     # -- koord-scheduler (batched placement)
     scheduler = Scheduler()
@@ -261,7 +242,8 @@ def test_sim_survives_pod_churn(tmp_path):
     reporting it, the manager's batch numbers grow back."""
     bus = APIServer()
     manager = build_manager(ManagerConfig())
-    manager_loop = wire_manager(bus, manager.noderesource)
+    manager_loop = wire_manager(bus, manager.noderesource,
+                                nodeslo=enabled_slo_controller())
     scheduler = Scheduler()
     wire_scheduler(bus, scheduler)
     from koordinator_tpu.apis.types import NodeSpec
