@@ -121,9 +121,13 @@ def snapshot_from_bus(bus: APIServer, now: float, with_reservations=False):
 
     return ClusterSnapshot(
         nodes=list(bus.list(Kind.NODE).values()),
+        # Permit-held gang members (waiting_permit) are assumed but not
+        # bound: the manager must not count them as running and the
+        # descheduler must never pick one as a migration victim
         pods=[
             p for p in bus.list(Kind.POD).values()
             if getattr(p, "node_name", None) is not None
+            and not getattr(p, "waiting_permit", False)
         ],
         node_metrics=bus.list(Kind.NODE_METRIC),
         reservations=(

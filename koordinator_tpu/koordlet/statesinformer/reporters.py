@@ -58,13 +58,16 @@ def pod_meta_from_spec(pod) -> PodMeta:
 
     # kubelet layout: BE -> besteffort, LS -> burstable, LSR/LSE
     # (guaranteed) sit DIRECTLY under kubepods — cgreconcile's tier
-    # rollups and memory.min protection depend on this nesting
+    # rollups and memory.min protection depend on this nesting. Dirs
+    # key by pod UID (like the kubelet), not name: same-named pods in
+    # different namespaces must not share a cgroup.
+    uid_dir = "pod" + pod.uid.replace("/", "_")
     if pod.qos == QoSClass.BE:
-        base = f"kubepods/besteffort/pod{pod.name}"
+        base = f"kubepods/besteffort/{uid_dir}"
     elif pod.qos in (QoSClass.LSR, QoSClass.LSE):
-        base = f"kubepods/pod{pod.name}"
+        base = f"kubepods/{uid_dir}"
     else:
-        base = f"kubepods/burstable/pod{pod.name}"
+        base = f"kubepods/burstable/{uid_dir}"
     meta = PodMeta(
         pod.uid, base, pod.qos,
         containers={"main": f"{base}/main"},

@@ -162,13 +162,18 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
                 admit = (qid < 0) | ~(jnp.any(viol_rt) | jnp.any(viol_np))
                 mask = mask & admit
 
-            masked = jnp.where(mask, s1 + s2, -1)
-            top = jnp.max(masked)
-            # first-max tie-break (Mosaic argmax doesn't guarantee it)
-            best = jnp.min(
-                jnp.where(masked == top, lane, jnp.int32(2**30))
-            ).astype(jnp.int32)
-            ok = top >= 0
+            # single-reduction argmax: pack (score, first-occurrence
+            # tie-break) into one int32 — score <= 200 (two
+            # 100-capped weighted means), lane < 8192, so
+            # score<<13 | (8191-lane) fits with room; max of the pack
+            # IS the max score at its smallest lane. Halves the
+            # [1,N]-to-scalar reductions vs max-then-min-where.
+            packed = jnp.where(
+                mask, ((s1 + s2) << 13) | (8191 - lane), -1
+            )
+            m = jnp.max(packed)
+            ok = m >= 0
+            best = (8191 - (m & 8191)).astype(jnp.int32)
             node = jnp.where(ok, best, -1).astype(jnp.int32)
             assign_ref[...] = jnp.where(chunk_lane == j, node, assign_ref[...])
             hit = (lane == best) & ok
@@ -422,6 +427,9 @@ def pallas_solve_batch(
         raise ValueError("configuration not supported by the pallas kernel")
     if state.alloc.shape[0] == 0 or pods.req.shape[0] == 0:
         raise ValueError("empty solve: use solve_batch's shape early-out")
+    if state.alloc.shape[0] > 8192:
+        # the packed single-reduction argmax carries the lane in 13 bits
+        raise ValueError("more than 8192 nodes: use the scan solver")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     wsum = int(np.asarray(params.weights).sum()) or 1

@@ -222,13 +222,13 @@ def test_five_components_converge(tmp_path):
         sim = sims[node]
         assert sim.nri.handled.get("RunPodSandbox", 0) >= 1
         assert CPU_BVT_WARP_NS.read(
-            f"kubepods/burstable/pod{name}", sim.cfg) == "2"
+            f"kubepods/burstable/poddefault_{name}", sim.cfg) == "2"
     be_sim = sims[pods["crunch"].node_name]
     assert CPU_BVT_WARP_NS.read(
-        "kubepods/besteffort/podcrunch", be_sim.cfg) == "-1"
+        "kubepods/besteffort/poddefault_crunch", be_sim.cfg) == "-1"
     # batch limit 2000m -> cfs quota 200000us on the container
     assert CPU_CFS_QUOTA.read(
-        "kubepods/besteffort/podcrunch/main", be_sim.cfg) == "200000"
+        "kubepods/besteffort/poddefault_crunch/main", be_sim.cfg) == "200000"
 
     # 5. NodeMetric reports round-tripped: web1's current node reports
     #    its (normalized, windowed-average) usage on the bus

@@ -216,6 +216,63 @@ class TestBusWiredMains:
         assert rc == 0
         assert "descheduling cycle" in capsys.readouterr().out
 
+    def test_runtimeproxy_main_once(self, tmp_path):
+        """The 5th binary: serve one connection over UDS, intercept a
+        hooked method, run a registered hook, reply with its response."""
+        import json
+        import socket
+        import threading
+
+        from koordinator_tpu.cmd import runtimeproxy as cmd_proxy
+        from koordinator_tpu.koordlet.runtimehooks import (
+            HookRegistry,
+            RuntimeHookServer,
+            Stage,
+        )
+
+        registry = HookRegistry()
+
+        def set_shares(ctx):
+            ctx.response.cpu_shares = 512
+
+        registry.register(Stage.PRE_RUN_POD_SANDBOX, "t", "", set_shares)
+        proxy = cmd_proxy.build_proxy(
+            cmd_proxy.RuntimeProxyConfig(),
+            hook_server=RuntimeHookServer(registry, executor=None),
+        )
+        sock_path = str(tmp_path / "proxy.sock")
+        t = threading.Thread(
+            target=cmd_proxy.serve,
+            args=(proxy, sock_path),
+            kwargs={"once": True, "log": lambda *_: None},
+            daemon=True,
+        )
+        t.start()
+        import time as _time
+
+        for _ in range(100):
+            if cmd_proxy.os.path.exists(sock_path):
+                break
+            _time.sleep(0.02)
+        client = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        client.connect(sock_path)
+        # unknown method: transparent pass-through
+        client.sendall(b'{"method": "Version"}\n')
+        f = client.makefile()
+        out = json.loads(f.readline())
+        assert out["backend"]["ok"] and out["hook"] is None
+        # hooked method with a pod in the store
+        from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+
+        proxy.store.record_pod(PodMeta("u1", "kubepods/podu1"))
+        client.sendall(json.dumps(
+            {"method": "RunPodSandbox", "payload": {"pod_uid": "u1"}}
+        ).encode() + b"\n")
+        out = json.loads(f.readline())
+        assert out["hook"]["cpu_shares"] == 512
+        client.close()
+        t.join(timeout=5)
+
     def test_solver_main_once(self, tmp_path, capsys):
         from koordinator_tpu.cmd import solver as cmd_solver
 
