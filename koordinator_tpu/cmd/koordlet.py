@@ -27,6 +27,10 @@ class KoordletConfig:
     reconcile_interval_seconds: float = 10.0
     node_capacity_mcpu: int = 0
     node_capacity_mem_mib: int = 0
+    #: runtimehooks actuation mode (reference --runtime-hooks-mode):
+    #: ``reconciler`` heals periodically off informer state; ``nri``
+    #: additionally dispatches hook stages from the PLEG event stream
+    runtime_hooks_mode: str = "reconciler"
 
 
 @dataclasses.dataclass
@@ -41,13 +45,27 @@ class KoordletDaemon:
     auditor: object
     executor: object
     collector_ctx: object = None
+    runtime_hooks: object = None
+    pleg: object = None
+    nri_server: object = None
+    reconcile_interval_seconds: float = 10.0
+    _last_reconcile: float = 0.0
 
     def tick(self, now: Optional[float] = None) -> None:
-        """One daemon step: collect → predict → actuate."""
+        """One daemon step: collect → predict → actuate → hooks (the
+        run order of koordlet.go:127-188)."""
         now = time.time() if now is None else now
         self.metrics_advisor.tick(now)
         self._feed_predictor(now)
         self.qos_manager.tick(now)
+        if self.pleg is not None:
+            # NRI mode: lifecycle events dispatch hook stages directly
+            self.pleg.poll()
+        if self.runtime_hooks is not None and (
+            now - self._last_reconcile >= self.reconcile_interval_seconds
+        ):
+            self._last_reconcile = now
+            self.runtime_hooks.reconcile()
 
     def _feed_predictor(self, now: float) -> None:
         """Stream the latest usage samples into the peak predictor
@@ -161,6 +179,16 @@ def build_koordlet(
     if gates.enabled("ColdPageCollector"):
         collectors.append(ColdMemoryCollector())
         collectors.append(PageCacheCollector())
+    from koordinator_tpu.koordlet.metricsadvisor.devices import (
+        DeviceCollector,
+        NodeStorageInfoCollector,
+        PodThrottledCollector,
+    )
+
+    collectors.append(PodThrottledCollector())
+    collectors.append(NodeStorageInfoCollector())
+    if gates.enabled("Accelerators"):
+        collectors.append(DeviceCollector())
     metrics_advisor = MetricsAdvisor(
         collector_ctx, collectors,
         interval_seconds=config.collect_interval_seconds,
@@ -209,6 +237,23 @@ def build_koordlet(
         lambda kind, slo: setattr(qos_ctx, "node_slo", slo),
     )
 
+    # runtimehooks: bvt/cpuset/batchresource actuation (koordlet.go runs
+    # runtimeHooks last); reconciler mode is always armed, NRI mode
+    # additionally streams PLEG lifecycle events into the hook server
+    from koordinator_tpu.koordlet.pleg import PLEG
+    from koordinator_tpu.koordlet.runtimehooks import RuntimeHooks
+
+    runtime_hooks = RuntimeHooks(states_informer, executor)
+    pleg = nri_server = None
+    if config.runtime_hooks_mode == "nri":
+        pleg = PLEG(system_config)
+        nri_server = runtime_hooks.attach_nri(pleg)
+        pleg.poll()  # primer
+    elif config.runtime_hooks_mode != "reconciler":
+        raise ValueError(
+            f"unknown runtime hooks mode: {config.runtime_hooks_mode!r}"
+        )
+
     return KoordletDaemon(
         states_informer=states_informer,
         metric_cache=metric_cache,
@@ -218,6 +263,10 @@ def build_koordlet(
         auditor=auditor,
         executor=executor,
         collector_ctx=collector_ctx,
+        runtime_hooks=runtime_hooks,
+        pleg=pleg,
+        nri_server=nri_server,
+        reconcile_interval_seconds=config.reconcile_interval_seconds,
     )
 
 
@@ -228,6 +277,8 @@ def main(argv=None) -> int:
     parser.add_argument("--proc-root", default="/proc")
     parser.add_argument("--cgroup-v2", action="store_true")
     parser.add_argument("--collect-interval", type=float, default=1.0)
+    parser.add_argument("--runtime-hooks-mode",
+                        choices=("reconciler", "nri"), default="reconciler")
     parser.add_argument("--once", action="store_true")
     args = parser.parse_args(argv)
     daemon = build_koordlet(
@@ -237,6 +288,7 @@ def main(argv=None) -> int:
             proc_root=args.proc_root,
             use_cgroup_v2=args.cgroup_v2,
             collect_interval_seconds=args.collect_interval,
+            runtime_hooks_mode=args.runtime_hooks_mode,
         )
     )
     while True:

@@ -6,8 +6,10 @@ interposes kubelet↔containerd CRI calls and dispatches the hook server
 pre/post (pkg/runtimeproxy/server/cri/criserver.go:44,90-102).
 
 The in-process transport serves the interposer over a framed-JSON UDS
-socket: each line is a CRIRequest ``{"method", "pod_uid", "payload"}``;
-the reply carries the hook-merged resources. A kubelet stand-in (tests,
+socket: each line is a CRIRequest
+``{"method", "pod_uid", "container"?, "payload"?}`` — ``container``
+names the container for container-level methods; the reply carries the
+hook-merged resources. A kubelet stand-in (tests,
 demos) connects instead of gRPC — the interception/merge/failover logic
 is the same `RuntimeManagerCriServer` the library exposes.
 """
@@ -71,8 +73,20 @@ def build_proxy(config: RuntimeProxyConfig, hook_server=None,
 def serve(proxy: RuntimeManagerCriServer, listen: str, once: bool = False,
           log=print) -> int:
     """Line-framed JSON request loop over UDS."""
+    import socket
+
     if os.path.exists(listen):
-        os.unlink(listen)
+        # a dead predecessor leaves its socket behind; unlink it iff
+        # nothing is accepting — never hijack a live proxy's endpoint
+        # (same restart-in-place flow as service/server.py)
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            probe.connect(listen)
+        except OSError:
+            os.unlink(listen)
+        else:
+            probe.close()
+            raise OSError(f"address in use: {listen}")
 
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):
@@ -86,6 +100,7 @@ def serve(proxy: RuntimeManagerCriServer, listen: str, once: bool = False,
                         payload.setdefault("pod_uid", req["pod_uid"])
                     request = CRIRequest(
                         method=req["method"],
+                        container=req.get("container"),
                         payload=payload,
                     )
                     response = proxy.intercept(request)

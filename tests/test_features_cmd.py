@@ -120,6 +120,78 @@ class TestSchedulerEntry:
         assert out["default/a"] == "n0"
 
 
+class TestKoordletDaemonAssembly:
+    def _config(self, tmp_path, **kw):
+        from koordinator_tpu.cmd.koordlet import KoordletConfig
+
+        return KoordletConfig(
+            cgroup_root=str(tmp_path / "cg"),
+            proc_root=str(tmp_path / "proc"), **kw,
+        )
+
+    def test_runtimehooks_wired_with_collectors(self, tmp_path):
+        from koordinator_tpu.cmd.koordlet import build_koordlet
+
+        daemon = build_koordlet(self._config(tmp_path))
+        assert daemon.runtime_hooks is not None
+        assert daemon.pleg is None           # reconciler mode default
+        names = {c.name for c in daemon.metrics_advisor.collectors}
+        assert {"podthrottled", "nodestorageinfo"} <= names
+        assert "device" not in names         # Accelerators off by default
+
+        accel = build_koordlet(
+            self._config(tmp_path, feature_gates="Accelerators=true")
+        )
+        assert "device" in {
+            c.name for c in accel.metrics_advisor.collectors
+        }
+
+    def test_nri_mode_actuates_from_pleg(self, tmp_path):
+        """--runtime-hooks-mode=nri: a pod cgroup dir appearing drives
+        hook dispatch through the daemon's own PLEG."""
+        from koordinator_tpu.apis.extension import QoSClass
+        from koordinator_tpu.cmd.koordlet import build_koordlet
+        from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+        from koordinator_tpu.koordlet.resourceexecutor.executor import (
+            ensure_cgroup_dir,
+        )
+        from koordinator_tpu.koordlet.system.cgroup import (
+            CPU_BVT_WARP_NS,
+            SystemConfig,
+        )
+        from koordinator_tpu.manager.sloconfig import NodeSLOSpec
+
+        cfg = SystemConfig(cgroup_root=str(tmp_path / "cg"),
+                           proc_root=str(tmp_path / "proc"))
+        for d in ("kubepods", "kubepods/burstable"):
+            ensure_cgroup_dir(d, cfg)
+        daemon = build_koordlet(
+            self._config(tmp_path, runtime_hooks_mode="nri")
+        )
+        assert daemon.pleg is not None and daemon.nri_server is not None
+        slo = NodeSLOSpec()
+        for tier in ("lsr", "ls", "be"):
+            getattr(slo.resource_qos_strategy, tier).enable = True
+        daemon.states_informer.set_node_slo(slo)
+        pod = PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS,
+                      containers={"main": "kubepods/burstable/podls/main"})
+        daemon.states_informer.set_pods([pod])
+        ensure_cgroup_dir(pod.cgroup_dir, cfg)
+        daemon.tick(now=100.0)
+        assert daemon.nri_server.handled.get("RunPodSandbox") == 1
+        assert CPU_BVT_WARP_NS.read(pod.cgroup_dir, cfg) == "2"
+
+    def test_unknown_hooks_mode_rejected(self, tmp_path):
+        import pytest
+
+        from koordinator_tpu.cmd.koordlet import build_koordlet
+
+        with pytest.raises(ValueError, match="sidecar"):
+            build_koordlet(
+                self._config(tmp_path, runtime_hooks_mode="sidecar")
+            )
+
+
 class TestManagerDescheduler:
     def test_manager_gates(self):
         m = build_manager(ManagerConfig())
@@ -264,12 +336,24 @@ class TestBusWiredMains:
         # hooked method with a pod in the store
         from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
 
-        proxy.store.record_pod(PodMeta("u1", "kubepods/podu1"))
+        proxy.store.record_pod(PodMeta(
+            "u1", "kubepods/podu1", containers={"main": "kubepods/podu1/main"}
+        ))
+        # documented frame: pod_uid at TOP level (no payload nesting)
         client.sendall(json.dumps(
-            {"method": "RunPodSandbox", "payload": {"pod_uid": "u1"}}
+            {"method": "RunPodSandbox", "pod_uid": "u1"}
         ).encode() + b"\n")
         out = json.loads(f.readline())
         assert out["hook"]["cpu_shares"] == 512
+        # container-level method carries the container name
+        registry.register(Stage.PRE_CREATE_CONTAINER, "t", "",
+                          lambda ctx: setattr(ctx.response, "cpuset", "0-1"))
+        client.sendall(json.dumps(
+            {"method": "CreateContainer", "pod_uid": "u1",
+             "container": "main"}
+        ).encode() + b"\n")
+        out = json.loads(f.readline())
+        assert out["hook"]["cpuset"] == "0-1"
         client.close()
         t.join(timeout=5)
 
