@@ -100,6 +100,9 @@ class PodSpec:
     #: required node selector (spec.nodeSelector) — the node-affinity
     #: slice the compat descheduler plugin enforces
     node_selector: Optional[Dict[str, str]] = None
+    #: requested host ports (containers[].ports[].hostPort): ints (TCP
+    #: implied) or "<proto>:<port>" strings — the NodePorts filter input
+    host_ports: Optional[List] = None
     #: Σ container restart counts (status) — TooManyRestarts input
     restart_count: int = 0
     #: assumed on a node behind a gang Permit barrier, NOT yet bound —

@@ -81,12 +81,14 @@ def serve(proxy: RuntimeManagerCriServer, listen: str, once: bool = False,
         # (same restart-in-place flow as service/server.py)
         probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
-            probe.connect(listen)
-        except OSError:
-            os.unlink(listen)
-        else:
+            try:
+                probe.connect(listen)
+            except OSError:
+                os.unlink(listen)
+            else:
+                raise OSError(f"address in use: {listen}")
+        finally:
             probe.close()
-            raise OSError(f"address in use: {listen}")
 
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):

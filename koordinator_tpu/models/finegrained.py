@@ -55,9 +55,11 @@ class FineGrained:
     ResourceManager / nodeDeviceCache singletons).
     """
 
-    def __init__(self, numa_plugin=None, device_plugin=None):
+    def __init__(self, numa_plugin=None, device_plugin=None,
+                 ports_plugin=None):
         self.numa_plugin = numa_plugin
         self.device_plugin = device_plugin
+        self.ports_plugin = ports_plugin
 
     # -- topology lowering --------------------------------------------------
 
@@ -114,13 +116,17 @@ class FineGrained:
         node declares a policy (hint-merge gating), and pods with managed
         device requests."""
         special = False
+        if self.ports_plugin is not None and getattr(pod, "host_ports", None):
+            # host-port pods need the validate loop: batch-internal
+            # conflicts are only visible through the plugin's holds
+            special = True
         if self.device_plugin is not None and pod.device_requests:
             from koordinator_tpu.scheduler.plugins.deviceshare import (
                 _PreFilterState as DevState,
             )
 
             try:
-                special = not DevState(pod).skip
+                special = special or not DevState(pod).skip
             except Exception:
                 special = True  # malformed device spec: row computation rejects
         pod_policy = False
@@ -148,7 +154,11 @@ class FineGrained:
     # -- rows: per-pod×node mask + extra score ------------------------------
 
     def _plugins(self):
-        return [p for p in (self.numa_plugin, self.device_plugin) if p is not None]
+        return [
+            p
+            for p in (self.numa_plugin, self.device_plugin, self.ports_plugin)
+            if p is not None
+        ]
 
     def rows(
         self, snapshot: ClusterSnapshot, pod: PodSpec, nodes: List[NodeSpec]

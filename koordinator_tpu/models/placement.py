@@ -312,7 +312,17 @@ class PlacementModel:
         selector_pods = [
             i for i, pod in enumerate(pods_in_order) if pod.node_selector
         ]
-        if specials or selector_pods:
+        # host-port pods WITH a fine manager are specials (the ports
+        # plugin filters + holds through the validate loop); without one
+        # (standalone model) they get a static conflict row against
+        # assigned pods — conservative, no batch-internal resolution
+        port_pods = []
+        if fine is None or fine.ports_plugin is None:
+            port_pods = [
+                i for i, pod in enumerate(pods_in_order)
+                if getattr(pod, "host_ports", None)
+            ]
+        if specials or selector_pods or port_pods:
             p, n = len(pods_in_order), node_arrays.n
             mask_np = np.ones((p, n), bool)
             score_np = np.zeros((p, n), np.int32)
@@ -334,6 +344,26 @@ class PlacementModel:
                         count=n,
                     )
                     affinity_rows[i] = row
+                    mask_np[i] &= row
+            if port_pods:
+                from koordinator_tpu.scheduler.plugins.nodeports import (
+                    pod_host_ports,
+                )
+
+                used_by_node = [set() for _ in range(n)]
+                node_idx = {nd.name: j for j, nd in enumerate(snapshot.nodes)}
+                for ap in snapshot.pods:
+                    j = node_idx.get(ap.node_name)
+                    if j is not None:
+                        used_by_node[j] |= pod_host_ports(ap)
+                for i in port_pods:
+                    want = pod_host_ports(pods_in_order[i])
+                    row = np.fromiter(
+                        (not (want & used_by_node[j]) for j in range(n)),
+                        dtype=bool, count=n,
+                    )
+                    affinity_rows[i] = affinity_rows.get(
+                        i, np.ones(n, bool)) & row
                     mask_np[i] &= row
             extras = Extras(mask=jnp.asarray(mask_np), score=jnp.asarray(score_np))
 

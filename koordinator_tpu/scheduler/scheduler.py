@@ -111,8 +111,15 @@ class Scheduler:
         )
         self._numa_plugin = NodeNUMAResourcePlugin(self.numa_manager)
         self._device_plugin = DeviceSharePlugin(self.device_cache)
+        from koordinator_tpu.scheduler.plugins.nodeports import (
+            NodePortsPlugin,
+        )
+
+        self._ports_plugin = NodePortsPlugin()
         fine = FineGrained(
-            numa_plugin=self._numa_plugin, device_plugin=self._device_plugin
+            numa_plugin=self._numa_plugin,
+            device_plugin=self._device_plugin,
+            ports_plugin=self._ports_plugin,
         )
         if model is None:
             model = PlacementModel()
@@ -127,6 +134,7 @@ class Scheduler:
                 self._quota_plugin,
                 self._numa_plugin,
                 self._device_plugin,
+                self._ports_plugin,
                 NodeResourcesFit(),
                 LoadAwareScheduling(),
                 DefaultPreBind(),

@@ -1,0 +1,146 @@
+"""NodePorts host-port conflict filtering.
+
+Oracle: the upstream k8s NodePorts plugin the reference vendors
+(k8s.io/kubernetes v1.24 pkg/scheduler/framework/plugins/nodeports) and
+its hostport e2e scope (test/e2e/scheduling/). Covers both paths:
+incremental framework chain and the batched validate loop.
+"""
+
+import pytest
+
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+from koordinator_tpu.scheduler import Scheduler
+from koordinator_tpu.scheduler.plugins.nodeports import pod_host_ports
+
+
+def scheduler_with_nodes(*names, cpu=16000):
+    s = Scheduler()
+    for name in names:
+        s.add_node(NodeSpec(name=name,
+                            allocatable={R.CPU: cpu, R.MEMORY: 32768}))
+        s.update_node_metric(NodeMetric(node_name=name, node_usage={},
+                                        update_time=99.0))
+    return s
+
+
+class TestNormalization:
+    def test_int_is_tcp(self):
+        assert pod_host_ports(PodSpec(name="p", host_ports=[80])) == {"tcp:80"}
+
+    def test_string_protocols(self):
+        got = pod_host_ports(PodSpec(name="p", host_ports=["udp:53", "TCP:80"]))
+        assert got == {"udp:53", "tcp:80"}
+
+    def test_no_ports(self):
+        assert pod_host_ports(PodSpec(name="p")) == frozenset()
+
+
+class TestBatched:
+    def test_conflict_routes_to_other_node(self):
+        s = scheduler_with_nodes("n0", "n1")
+        s.add_pod(PodSpec(name="a", host_ports=[8080],
+                          requests={R.CPU: 1000}))
+        out = s.schedule_pending(now=100.0)
+        first = out["default/a"]
+        assert first in ("n0", "n1")
+        s.add_pod(PodSpec(name="b", host_ports=[8080],
+                          requests={R.CPU: 1000}))
+        out = s.schedule_pending(now=101.0)
+        assert out["default/b"] is not None
+        assert out["default/b"] != first
+
+    def test_single_node_conflict_unschedulable(self):
+        s = scheduler_with_nodes("n0")
+        s.add_pod(PodSpec(name="a", host_ports=[80], requests={R.CPU: 100}))
+        s.schedule_pending(now=100.0)
+        s.add_pod(PodSpec(name="b", host_ports=[80], requests={R.CPU: 100}))
+        out = s.schedule_pending(now=101.0)
+        assert out["default/b"] is None
+
+    def test_same_batch_conflict_spreads(self):
+        """Two pending pods with the same port in ONE batch: the
+        validate loop's holds force them onto different nodes."""
+        s = scheduler_with_nodes("n0", "n1")
+        s.add_pod(PodSpec(name="a", host_ports=[443], requests={R.CPU: 100}))
+        s.add_pod(PodSpec(name="b", host_ports=[443], requests={R.CPU: 100}))
+        out = s.schedule_pending(now=100.0)
+        assert {out["default/a"], out["default/b"]} == {"n0", "n1"}
+
+    def test_different_protocols_no_conflict(self):
+        s = scheduler_with_nodes("n0")
+        s.add_pod(PodSpec(name="a", host_ports=["tcp:53"],
+                          requests={R.CPU: 100}))
+        s.schedule_pending(now=100.0)
+        s.add_pod(PodSpec(name="b", host_ports=["udp:53"],
+                          requests={R.CPU: 100}))
+        out = s.schedule_pending(now=101.0)
+        assert out["default/b"] == "n0"
+
+    def test_port_freed_on_delete(self):
+        s = scheduler_with_nodes("n0")
+        pod = PodSpec(name="a", host_ports=[9000], requests={R.CPU: 100})
+        s.add_pod(pod)
+        s.schedule_pending(now=100.0)
+        s.remove_pod(s.cache.pods["default/a"])
+        s.add_pod(PodSpec(name="b", host_ports=[9000],
+                          requests={R.CPU: 100}))
+        out = s.schedule_pending(now=101.0)
+        assert out["default/b"] == "n0"
+
+
+class TestIncremental:
+    def test_incremental_cycle_respects_ports(self):
+        s = scheduler_with_nodes("n0", "n1")
+        s.batched_placement = False
+        s.add_pod(PodSpec(name="a", host_ports=[8080],
+                          requests={R.CPU: 1000}))
+        out = s.schedule_pending(now=100.0)
+        first = out["default/a"]
+        s.add_pod(PodSpec(name="b", host_ports=[8080],
+                          requests={R.CPU: 1000}))
+        out = s.schedule_pending(now=101.0)
+        assert out["default/b"] is not None and out["default/b"] != first
+
+    def test_incremental_single_node_unschedulable(self):
+        s = scheduler_with_nodes("n0")
+        s.batched_placement = False
+        s.add_pod(PodSpec(name="a", host_ports=[80], requests={R.CPU: 100}))
+        s.schedule_pending(now=100.0)
+        s.add_pod(PodSpec(name="b", host_ports=[80], requests={R.CPU: 100}))
+        out = s.schedule_pending(now=101.0)
+        assert out["default/b"] is None
+
+
+def test_host_port_pod_with_unmanaged_device_stays_special():
+    """A host-port pod whose device_requests hold only unmanaged vendor
+    resources must keep its special flag (code-review regression: the
+    device block used to clobber it)."""
+    s = scheduler_with_nodes("n0")
+    s.add_pod(PodSpec(name="a", host_ports=[80], requests={R.CPU: 100}))
+    s.schedule_pending(now=100.0)
+    s.add_pod(PodSpec(name="b", host_ports=[80], requests={R.CPU: 100},
+                      device_requests={"vendor.example/foo": 1}))
+    out = s.schedule_pending(now=101.0)
+    assert out["default/b"] is None  # port conflict still enforced
+
+
+def test_standalone_model_static_port_rows():
+    """A bare PlacementModel (no fine manager) still filters host-port
+    conflicts against assigned pods."""
+    from koordinator_tpu.apis.types import ClusterSnapshot
+    from koordinator_tpu.models.placement import PlacementModel
+
+    nodes = [NodeSpec(name=f"n{i}", allocatable={R.CPU: 8000,
+                                                 R.MEMORY: 16384})
+             for i in range(2)]
+    metrics = {n.name: NodeMetric(node_name=n.name, update_time=99.0)
+               for n in nodes}
+    assigned = PodSpec(name="a", host_ports=[8080], node_name="n0",
+                       requests={R.CPU: 100})
+    pending = PodSpec(name="b", host_ports=[8080], requests={R.CPU: 100})
+    out = PlacementModel().schedule(ClusterSnapshot(
+        nodes=nodes, pods=[assigned], pending_pods=[pending],
+        node_metrics=metrics, now=100.0,
+    ))
+    assert out["default/b"] == "n1"
