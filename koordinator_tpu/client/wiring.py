@@ -280,7 +280,8 @@ class DeschedulerLoop:
     it) — then the eviction flows back as a Pod re-apply so every wired
     component observes the move."""
 
-    def __init__(self, bus: APIServer, descheduler, place_model=None):
+    def __init__(self, bus: APIServer, descheduler, place_model=None,
+                 elector=None):
         from koordinator_tpu.descheduler.migration import MigrationController
         from koordinator_tpu.models.placement import PlacementModel
 
@@ -295,6 +296,9 @@ class DeschedulerLoop:
         self.descheduler = descheduler
         self._model = place_model or PlacementModel()
         self.controller = MigrationController(self._place)
+        #: leader-elected deployments verify the lease before the
+        #: mutation phase (evictions/reservations must not double-apply)
+        self.elector = elector
 
     def _place(self, snapshot, reservation):
         """Reservation placement through the batched solver: the probe is
@@ -353,28 +357,48 @@ class DeschedulerLoop:
         jobs = list(evictor.jobs)
         migrated = []
         if jobs:
+            # the reconcile COMPUTE (state machine + placement probes —
+            # slow) runs outside any lock; every bus WRITE runs in one
+            # fenced transaction so a leader deposed between compute and
+            # apply raises FencingError with nothing half-applied
             self.controller.reconcile(snapshot, jobs)
-            # reservation deltas only (blanket re-applies would grow bus
-            # traffic and resurrect GC'd reservations)
-            post = {r.name: r for r in snapshot.reservations}
-            for name in pre_resv - set(post):
-                self.bus.delete(Kind.RESERVATION, name)
-            for name, resv in post.items():
-                if name not in pre_resv:
-                    self.bus.apply(Kind.RESERVATION, name, resv)
-            for job in jobs:
-                self.bus.apply(Kind.MIGRATION_JOB, job.name, job)
-            for pod in snapshot.pending_pods:
-                # the reference EVICTS (deletes) the pod and the workload
-                # recreates it. The controller already cleared node_name
-                # on the shared object, so restore it for the DELETE —
-                # the scheduler's release path (quota used, NUMA/device
-                # holds) keys off the assigned state.
-                pod.node_name = pre_assign.get(pod.uid)
-                self.bus.delete(Kind.POD, key_of.get(pod.uid, pod.uid))
-                pod.node_name = None
-                self.bus.apply(Kind.POD, key_of.get(pod.uid, pod.uid), pod)
-                migrated.append(pod.uid)
+
+            def apply_mutations():
+                # reservation deltas only (blanket re-applies would grow
+                # bus traffic and resurrect GC'd reservations)
+                post = {r.name: r for r in snapshot.reservations}
+                for name in pre_resv - set(post):
+                    self.bus.delete(Kind.RESERVATION, name)
+                for name, resv in post.items():
+                    if name not in pre_resv:
+                        self.bus.apply(Kind.RESERVATION, name, resv)
+                for job in jobs:
+                    self.bus.apply(Kind.MIGRATION_JOB, job.name, job)
+                for pod in snapshot.pending_pods:
+                    # the reference EVICTS (deletes) the pod and the
+                    # workload recreates it. The controller already
+                    # cleared node_name on the shared object, so restore
+                    # it for the DELETE — the scheduler's release path
+                    # (quota used, NUMA/device holds) keys off the
+                    # assigned state.
+                    pod.node_name = pre_assign.get(pod.uid)
+                    self.bus.delete(Kind.POD, key_of.get(pod.uid, pod.uid))
+                    pod.node_name = None
+                    self.bus.apply(Kind.POD, key_of.get(pod.uid, pod.uid), pod)
+                    migrated.append(pod.uid)
+
+            if self.elector is not None:
+                try:
+                    self.elector.fenced(apply_mutations)
+                except Exception:
+                    # undo the controller's in-place victim mutation so
+                    # the shared bus objects stay consistent with the
+                    # (never-applied) eviction
+                    for pod in snapshot.pending_pods:
+                        pod.node_name = pre_assign.get(pod.uid)
+                    raise
+            else:
+                apply_mutations()
             # completed jobs leave the dedup window
             evictor.jobs = [
                 j for j in evictor.jobs
@@ -383,5 +407,6 @@ class DeschedulerLoop:
         return migrated
 
 
-def wire_descheduler(bus: APIServer, descheduler, place_model=None) -> DeschedulerLoop:
-    return DeschedulerLoop(bus, descheduler, place_model)
+def wire_descheduler(bus: APIServer, descheduler, place_model=None,
+                     elector=None) -> DeschedulerLoop:
+    return DeschedulerLoop(bus, descheduler, place_model, elector)

@@ -85,6 +85,8 @@ def main(argv=None) -> int:
     parser.add_argument("--descheduling-interval", type=float, default=120.0)
     parser.add_argument("--once", action="store_true")
     parser.add_argument("--cluster-json", default=None)
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--leader-elect-identity", default=None)
     args = parser.parse_args(argv)
     descheduler = build_descheduler(
         DeschedulerConfig(
@@ -96,7 +98,17 @@ def main(argv=None) -> int:
     from koordinator_tpu.client.wiring import wire_descheduler
 
     bus = APIServer()
-    loop = wire_descheduler(bus, descheduler)
+    elector = None
+    if args.leader_elect:
+        import os
+
+        from koordinator_tpu.client.leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            bus, "koord-descheduler",
+            args.leader_elect_identity or f"koord-descheduler-{os.getpid()}",
+        )
+    loop = wire_descheduler(bus, descheduler, elector=elector)
     if args.cluster_json:
         from koordinator_tpu.cmd.scheduler import seed_bus_from_json
 
@@ -106,12 +118,39 @@ def main(argv=None) -> int:
         f"{[p.name for p in descheduler.profiles]}, "
         f"interval={descheduler.descheduling_interval}s"
     )
+    from koordinator_tpu.client.leaderelection import FencingError
+
+    def wait(seconds: float) -> None:
+        """Sleep while renewing: the descheduling interval (120s) far
+        exceeds the lease renew deadline (10s)."""
+        if elector is None:
+            time.sleep(seconds)
+            return
+        deadline = time.time() + seconds
+        while time.time() < deadline:
+            time.sleep(min(elector.retry_period,
+                           max(deadline - time.time(), 0)))
+            if not elector.tick(time.time()):
+                return
+
     while True:
-        migrated = loop.run_once(now=time.time())
-        print(f"descheduling cycle: migrated {len(migrated)} pods")
-        if args.once:
-            return 0
-        time.sleep(descheduler.descheduling_interval)
+        if elector is not None and not elector.tick(time.time()):
+            print("standby: lease held elsewhere")
+            if args.once:
+                return 3
+            time.sleep(elector.retry_period)
+            continue
+        try:
+            migrated = loop.run_once(now=time.time())
+        except FencingError as e:
+            print(f"leadership lost mid-cycle: {e}")
+            if args.once:
+                return 1
+        else:
+            print(f"descheduling cycle: migrated {len(migrated)} pods")
+            if args.once:
+                return 0
+        wait(descheduler.descheduling_interval)
 
 
 if __name__ == "__main__":

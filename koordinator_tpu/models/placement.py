@@ -356,8 +356,18 @@ class PlacementModel:
                     j = node_idx.get(ap.node_name)
                     if j is not None:
                         used_by_node[j] |= pod_host_ports(ap)
+                # same-batch conflicts have no validate loop here, so
+                # later pending claimants of an already-claimed port are
+                # DEFERRED (all-False row, placed next round once the
+                # first claimant is assigned) — delayed, never conflicting
+                claimed: set = set()
                 for i in port_pods:
                     want = pod_host_ports(pods_in_order[i])
+                    if want & claimed:
+                        mask_np[i] &= False
+                        affinity_rows[i] = np.zeros(n, bool)
+                        continue
+                    claimed |= want
                     row = np.fromiter(
                         (not (want & used_by_node[j]) for j in range(n)),
                         dtype=bool, count=n,

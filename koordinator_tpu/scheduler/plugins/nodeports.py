@@ -53,20 +53,21 @@ class NodePortsPlugin(Plugin):
 
     def _snapshot_used(self, state: CycleState, snapshot,
                        node_name: str) -> FrozenSet[str]:
-        """Ports held by assigned pods on the node, cached per cycle."""
-        cache = None
-        if state is not None:
-            cache = state.setdefault(_STATE_KEY, {})
-            if node_name in cache:
-                return cache[node_name]
-        used = set()
-        for p in snapshot.pods:
-            if p.node_name == node_name:
-                used |= pod_host_ports(p)
-        used = frozenset(used)
-        if cache is not None:
-            cache[node_name] = used
-        return used
+        """Ports held by assigned pods on the node. The whole
+        node -> ports map is built in ONE O(pods) pass and cached per
+        cycle — per-node snapshot scans would make a rows() computation
+        O(nodes x pods)."""
+        by_node = state.get(_STATE_KEY) if state is not None else None
+        if by_node is None:
+            by_node = {}
+            for p in snapshot.pods:
+                if p.node_name is not None:
+                    ports = pod_host_ports(p)
+                    if ports:
+                        by_node.setdefault(p.node_name, set()).update(ports)
+            if state is not None:
+                state[_STATE_KEY] = by_node
+        return frozenset(by_node.get(node_name, ()))
 
     def _held(self, state: CycleState, snapshot, node_name: str,
               skip_uid: str) -> FrozenSet[str]:

@@ -178,6 +178,51 @@ class TestFailoverNoDoublePlacement:
         assert loop_b.reconcile(now=102.0) == 1
 
 
+def test_deposed_descheduler_discards_migrations():
+    """A descheduler loop that computed migrations while holding the
+    lease, but was deposed before the mutation phase, raises instead of
+    double-evicting (matches the scheduler/manager fencing)."""
+    from koordinator_tpu.client.wiring import wire_descheduler
+    from koordinator_tpu.descheduler.framework import (
+        Descheduler,
+        MigrationEvictor,
+        Profile,
+    )
+    from koordinator_tpu.descheduler.loadaware import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        NodePool,
+    )
+
+    bus = APIServer()
+    ea = LeaderElector(bus, "koord-descheduler", "a")
+    eb = LeaderElector(bus, "koord-descheduler", "b")
+    plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={R.CPU: 30}, high_thresholds={R.CPU: 70})]))
+    loop = wire_descheduler(bus, Descheduler(
+        profiles=[Profile(name="d", balance_plugins=[plugin])],
+        evictor=MigrationEvictor()), elector=ea)
+    bus.apply(Kind.NODE, "hot", NodeSpec(
+        name="hot", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE, "cold", NodeSpec(
+        name="cold", allocatable={R.CPU: 10000, R.MEMORY: 32768}))
+    bus.apply(Kind.NODE_METRIC, "hot", NodeMetric(
+        node_name="hot", node_usage={R.CPU: 9000}, update_time=100.0))
+    bus.apply(Kind.NODE_METRIC, "cold", NodeMetric(
+        node_name="cold", node_usage={R.CPU: 200}, update_time=100.0))
+    victim = PodSpec(name="heavy", requests={R.CPU: 4000}, node_name="hot")
+    bus.apply(Kind.POD, "default/heavy", victim)
+
+    ea.tick(0.0)
+    eb.tick(20.0)  # a deposed before its cycle's mutation phase
+    with pytest.raises(FencingError):
+        loop.run_once(now=110.0)
+    # nothing was applied: no jobs, no reservations, pod untouched
+    assert not bus.list(Kind.MIGRATION_JOB)
+    assert not bus.list(Kind.RESERVATION)
+    assert bus.get(Kind.POD, "default/heavy").node_name == "hot"
+
+
 def test_evict_through_bus_is_fenced(monkeypatch):
     """wire_scheduler's eviction callback routes through the elector:
     a deposed leader cannot delete a victim pod from the bus."""

@@ -144,3 +144,30 @@ def test_standalone_model_static_port_rows():
         node_metrics=metrics, now=100.0,
     ))
     assert out["default/b"] == "n1"
+
+
+def test_standalone_model_defers_same_batch_port_claimants():
+    """Without the validate loop the standalone model must never emit
+    two same-port placements in one batch: the later claimant is
+    deferred to the next round (code-review regression)."""
+    from koordinator_tpu.apis.types import ClusterSnapshot
+    from koordinator_tpu.models.placement import PlacementModel
+
+    node = NodeSpec(name="n0", allocatable={R.CPU: 8000, R.MEMORY: 16384})
+    metrics = {"n0": NodeMetric(node_name="n0", update_time=99.0)}
+    a = PodSpec(name="a", host_ports=[80], requests={R.CPU: 100})
+    b = PodSpec(name="b", host_ports=[80], requests={R.CPU: 100})
+    model = PlacementModel()
+    out = model.schedule(ClusterSnapshot(
+        nodes=[node], pods=[], pending_pods=[a, b],
+        node_metrics=metrics, now=100.0,
+    ))
+    placed = [uid for uid, nd in out.items() if nd is not None]
+    assert placed == ["default/a"]      # b deferred, not conflicting
+    # next round: a is assigned; b sees the port taken on n0
+    a.node_name = out["default/a"]
+    out = model.schedule(ClusterSnapshot(
+        nodes=[node], pods=[a], pending_pods=[b],
+        node_metrics=metrics, now=101.0,
+    ))
+    assert out["default/b"] is None     # single node: genuinely stuck
