@@ -393,9 +393,16 @@ class DeschedulerLoop:
                 except Exception:
                     # undo the controller's in-place victim mutation so
                     # the shared bus objects stay consistent with the
-                    # (never-applied) eviction
+                    # (never-applied) eviction, and DISCARD the advanced
+                    # jobs — a re-elected leader must re-detect, not
+                    # publish phantom SUCCEEDED migrations
                     for pod in snapshot.pending_pods:
                         pod.node_name = pre_assign.get(pod.uid)
+                    evictor.jobs = [
+                        j for j in evictor.jobs
+                        if j.phase in (MigrationPhase.PENDING,
+                                       MigrationPhase.RUNNING)
+                    ]
                     raise
             else:
                 apply_mutations()

@@ -31,6 +31,10 @@ class KoordletConfig:
     #: ``reconciler`` heals periodically off informer state; ``nri``
     #: additionally dispatches hook stages from the PLEG event stream
     runtime_hooks_mode: str = "reconciler"
+    #: local checkpoint dir (reference §5.4: prediction histograms +
+    #: TSDB survive restarts); empty = no persistence
+    checkpoint_dir: str = ""
+    checkpoint_interval_seconds: float = 60.0
 
 
 @dataclasses.dataclass
@@ -49,7 +53,10 @@ class KoordletDaemon:
     pleg: object = None
     nri_server: object = None
     reconcile_interval_seconds: float = 10.0
+    checkpoint_dir: str = ""
+    checkpoint_interval_seconds: float = 60.0
     _last_reconcile: float = 0.0
+    _last_checkpoint: float = 0.0
 
     def tick(self, now: Optional[float] = None) -> None:
         """One daemon step: collect → predict → actuate → hooks (the
@@ -66,6 +73,24 @@ class KoordletDaemon:
         ):
             self._last_reconcile = now
             self.runtime_hooks.reconcile()
+        if self.checkpoint_dir and (
+            now - self._last_checkpoint >= self.checkpoint_interval_seconds
+        ):
+            self._last_checkpoint = now
+            self.checkpoint()
+
+    def checkpoint(self) -> None:
+        """Persist restart state (§5.4): the metric TSDB + the
+        prediction histograms."""
+        import os
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        self.metric_cache.save(
+            os.path.join(self.checkpoint_dir, "metriccache.npz")
+        )
+        self.predict_server.save_checkpoint(
+            os.path.join(self.checkpoint_dir, "prediction.json")
+        )
 
     def _feed_predictor(self, now: float) -> None:
         """Stream the latest usage samples into the peak predictor
@@ -254,6 +279,17 @@ def build_koordlet(
             f"unknown runtime hooks mode: {config.runtime_hooks_mode!r}"
         )
 
+    if config.checkpoint_dir:
+        # resume from the previous incarnation's state (§5.4)
+        import os
+
+        metric_cache.load(
+            os.path.join(config.checkpoint_dir, "metriccache.npz")
+        )
+        predict_server.load_checkpoint(
+            os.path.join(config.checkpoint_dir, "prediction.json")
+        )
+
     return KoordletDaemon(
         states_informer=states_informer,
         metric_cache=metric_cache,
@@ -267,6 +303,8 @@ def build_koordlet(
         pleg=pleg,
         nri_server=nri_server,
         reconcile_interval_seconds=config.reconcile_interval_seconds,
+        checkpoint_dir=config.checkpoint_dir,
+        checkpoint_interval_seconds=config.checkpoint_interval_seconds,
     )
 
 
@@ -279,6 +317,9 @@ def main(argv=None) -> int:
     parser.add_argument("--collect-interval", type=float, default=1.0)
     parser.add_argument("--runtime-hooks-mode",
                         choices=("reconciler", "nri"), default="reconciler")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="persist TSDB + prediction state across "
+                             "restarts (empty = off)")
     parser.add_argument("--once", action="store_true")
     args = parser.parse_args(argv)
     daemon = build_koordlet(
@@ -289,6 +330,7 @@ def main(argv=None) -> int:
             use_cgroup_v2=args.cgroup_v2,
             collect_interval_seconds=args.collect_interval,
             runtime_hooks_mode=args.runtime_hooks_mode,
+            checkpoint_dir=args.checkpoint_dir,
         )
     )
     while True:

@@ -177,6 +177,61 @@ class MetricCache:
         _, vals = self.query(kind, labels, start, end)
         return aggregate_points(vals, agg)
 
+    # -- persistence (reference: the TSDB lives on disk,
+    # metriccache/tsdb_storage.go — a koordlet restart keeps its
+    # aggregation window instead of reporting from empty) -------------------
+
+    def save(self, path: str) -> None:
+        """Atomic npz snapshot of every series (chronological points)."""
+        import json
+        import math as _math
+        import os
+
+        arrays = {}
+        meta = []
+        for i, (key, ring) in enumerate(self._series.items()):
+            ts, vals = ring.window(-_math.inf, _math.inf)
+            arrays[f"ts_{i}"] = ts
+            arrays[f"v_{i}"] = vals
+            meta.append(list(key))
+        arrays["meta"] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> bool:
+        """Restore a snapshot; returns False if absent/corrupt."""
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return False
+        # restore into a LOCAL dict and commit only on full success: a
+        # corrupt snapshot (zipfile.BadZipFile, truncated arrays, missing
+        # keys — anything) must leave the cache untouched, not
+        # half-populated
+        restored: Dict[SeriesKey, _Ring] = {}
+        try:
+            with np.load(path) as data:
+                meta = json.loads(bytes(data["meta"]).decode())
+                for i, key in enumerate(meta):
+                    kind, labels = key
+                    ring = _Ring(self._capacity)
+                    ts, vals = data[f"ts_{i}"], data[f"v_{i}"]
+                    order = np.argsort(ts, kind="stable")
+                    for t, v in zip(ts[order], vals[order]):
+                        ring.append(float(t), float(v))
+                    restored[
+                        (kind, tuple(tuple(kv) for kv in labels))
+                    ] = ring
+        except Exception:
+            return False
+        self._series.update(restored)
+        return True
+
     def aggregate_batch(
         self,
         requests: Sequence[Tuple[MetricKind, Optional[Mapping[str, str]]]],

@@ -359,7 +359,10 @@ class PlacementModel:
                 # same-batch conflicts have no validate loop here, so
                 # later pending claimants of an already-claimed port are
                 # DEFERRED (all-False row, placed next round once the
-                # first claimant is assigned) — delayed, never conflicting
+                # first claimant is assigned) — delayed, never
+                # conflicting. Only pods with at least one feasible node
+                # claim: an unplaceable pod must not starve later
+                # claimants of its ports.
                 claimed: set = set()
                 for i in port_pods:
                     want = pod_host_ports(pods_in_order[i])
@@ -367,11 +370,12 @@ class PlacementModel:
                         mask_np[i] &= False
                         affinity_rows[i] = np.zeros(n, bool)
                         continue
-                    claimed |= want
                     row = np.fromiter(
                         (not (want & used_by_node[j]) for j in range(n)),
                         dtype=bool, count=n,
                     )
+                    if row.any():
+                        claimed |= want
                     affinity_rows[i] = affinity_rows.get(
                         i, np.ones(n, bool)) & row
                     mask_np[i] &= row

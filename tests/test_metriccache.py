@@ -262,3 +262,61 @@ class TestCollectors:
         adv.tick(10.0)  # 1 core over 10s
         assert mc.aggregate(
             MetricKind.NODE_CPU_USAGE, agg=A.LAST) == pytest.approx(100.0)
+
+
+class TestPersistence:
+    def test_save_load_round_trip(self, tmp_path):
+        """§5.4: the TSDB survives restarts (reference keeps it on
+        disk) — aggregates over the restored cache match the original."""
+        from koordinator_tpu.koordlet.metriccache import MetricCache
+
+        mc = MetricCache()
+        for t in range(20):
+            mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), 100.0 + t)
+            mc.append(MetricKind.POD_CPU_USAGE, {"pod": "u1"},
+                      float(t), 50.0 + t)
+        path = str(tmp_path / "tsdb.npz")
+        mc.save(path)
+
+        fresh = MetricCache()
+        assert fresh.load(path)
+        for kind, labels in ((MetricKind.NODE_CPU_USAGE, None),
+                             (MetricKind.POD_CPU_USAGE, {"pod": "u1"})):
+            for agg in (A.AVG, A.P90, A.LAST, A.COUNT):
+                assert fresh.aggregate(kind, labels, agg=agg) == \
+                    mc.aggregate(kind, labels, agg=agg)
+
+    def test_load_missing_or_corrupt(self, tmp_path):
+        from koordinator_tpu.koordlet.metriccache import MetricCache
+
+        mc = MetricCache()
+        assert not mc.load(str(tmp_path / "absent.npz"))
+        bad = tmp_path / "bad.npz"
+        bad.write_bytes(b"not an npz")
+        assert not mc.load(str(bad))
+
+    def test_daemon_checkpoint_restart(self, tmp_path):
+        """A rebuilt daemon resumes with the previous TSDB + prediction
+        state from --checkpoint-dir."""
+        from koordinator_tpu.cmd.koordlet import (
+            KoordletConfig,
+            build_koordlet,
+        )
+
+        config = KoordletConfig(
+            cgroup_root=str(tmp_path / "cg"),
+            proc_root=str(tmp_path / "proc"),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        )
+        d1 = build_koordlet(config)
+        for t in range(10):
+            d1.metric_cache.append(
+                MetricKind.NODE_CPU_USAGE, None, float(t), 500.0)
+            d1.predict_server.update("pod/u1", 700.0, 900.0, float(t))
+        d1.checkpoint()
+
+        d2 = build_koordlet(config)  # the restart
+        assert d2.metric_cache.aggregate(
+            MetricKind.NODE_CPU_USAGE, agg=A.AVG) == 500.0
+        peak = d2.predict_server.peak("pod/u1")
+        assert peak["cpu"] is not None and peak["cpu"] >= 700.0

@@ -146,6 +146,26 @@ def test_standalone_model_static_port_rows():
     assert out["default/b"] == "n1"
 
 
+def test_unplaceable_claimant_does_not_starve_later_pod():
+    """An all-conflicted first claimant must not claim its ports and
+    starve a placeable later pod (code-review regression)."""
+    from koordinator_tpu.apis.types import ClusterSnapshot
+    from koordinator_tpu.models.placement import PlacementModel
+
+    node = NodeSpec(name="n0", allocatable={R.CPU: 8000, R.MEMORY: 16384})
+    metrics = {"n0": NodeMetric(node_name="n0", update_time=99.0)}
+    holder = PodSpec(name="h", host_ports=[81], node_name="n0",
+                     requests={R.CPU: 100})
+    stuck = PodSpec(name="a", host_ports=[80, 81], requests={R.CPU: 100})
+    free = PodSpec(name="b", host_ports=[80], requests={R.CPU: 100})
+    out = PlacementModel().schedule(ClusterSnapshot(
+        nodes=[node], pods=[holder], pending_pods=[stuck, free],
+        node_metrics=metrics, now=100.0,
+    ))
+    assert out["default/a"] is None     # 81 genuinely conflicted
+    assert out["default/b"] == "n0"     # 80 free: not starved
+
+
 def test_standalone_model_defers_same_batch_port_claimants():
     """Without the validate loop the standalone model must never emit
     two same-port placements in one batch: the later claimant is
