@@ -32,6 +32,9 @@ class Manager:
     validating_webhook: Optional[object]
     quota_guard: Optional[object]
     profile_controller_factory: object  # scheduler -> QuotaProfileController
+    node_mutating_webhook: Optional[object] = None
+    node_validating_webhook: Optional[object] = None
+    slo_config_webhook: Optional[object] = None
 
     def admit_pod(self, pod, old_pod=None):
         """The webhook chain every pod passes (mutate → validate);
@@ -43,15 +46,27 @@ class Manager:
             violations = self.validating_webhook.validate(pod, old_pod)
         return pod, violations
 
+    def admit_node(self, node, old_node=None):
+        """Node admission (amplification mutate → validate)."""
+        if self.node_mutating_webhook is not None:
+            node = self.node_mutating_webhook.mutate(node, old_node)
+        violations = []
+        if self.node_validating_webhook is not None:
+            violations = self.node_validating_webhook.validate(node, old_node)
+        return node, violations
+
 
 def build_manager(config: ManagerConfig, gates: Optional[FeatureGate] = None) -> Manager:
     from koordinator_tpu.manager.noderesource import NodeResourceController
     from koordinator_tpu.manager.nodeslo import NodeSLOController
     from koordinator_tpu.quota.profile import QuotaProfileController
     from koordinator_tpu.webhook import (
+        NodeMutatingWebhook,
+        NodeValidatingWebhook,
         PodMutatingWebhook,
         PodValidatingWebhook,
         QuotaTopologyGuard,
+        SLOConfigValidatingWebhook,
     )
 
     gates = gates or MANAGER_GATES.copy()
@@ -73,6 +88,21 @@ def build_manager(config: ManagerConfig, gates: Optional[FeatureGate] = None) ->
             else None
         ),
         profile_controller_factory=QuotaProfileController,
+        node_mutating_webhook=(
+            NodeMutatingWebhook()
+            if gates.enabled("NodeMutatingWebhook")
+            else None
+        ),
+        node_validating_webhook=(
+            NodeValidatingWebhook()
+            if gates.enabled("NodeValidatingWebhook")
+            else None
+        ),
+        slo_config_webhook=(
+            SLOConfigValidatingWebhook()
+            if gates.enabled("ConfigMapValidatingWebhook")
+            else None
+        ),
     )
 
 

@@ -374,7 +374,11 @@ class PlacementModel:
                         (not (want & used_by_node[j]) for j in range(n)),
                         dtype=bool, count=n,
                     )
-                    if row.any():
+                    # claim only with a feasible node under the FULL
+                    # accumulated mask (selector rows etc. included) —
+                    # a pod unplaceable for any reason must not starve
+                    # later claimants
+                    if (mask_np[i] & row).any():
                         claimed |= want
                     affinity_rows[i] = affinity_rows.get(
                         i, np.ones(n, bool)) & row

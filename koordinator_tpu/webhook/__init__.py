@@ -1,10 +1,13 @@
-"""Admission webhooks: the typed ingress for pods and quotas.
+"""Admission webhooks: the typed ingress for pods, nodes, quotas, and
+the SLO configmaps.
 
 Rebuild of /root/reference/pkg/webhook/: pod mutation
 (ClusterColocationProfile injection + batch/mid resource translation,
 pod/mutating/cluster_colocation_profile.go), pod validation
-(pod/validating/cluster_colocation_profile.go), and the ElasticQuota
-topology guard (elasticquota/quota_topology.go).
+(pod/validating/cluster_colocation_profile.go), the ElasticQuota
+topology guard (elasticquota/quota_topology.go), node amplification
+admit/validate (node/plugins/resourceamplification), and the SLO
+configmap checkers (cm/plugins/sloconfig).
 """
 
 from koordinator_tpu.webhook.mutating import (  # noqa: F401
@@ -17,4 +20,11 @@ from koordinator_tpu.webhook.validating import (  # noqa: F401
 from koordinator_tpu.webhook.quota_topology import (  # noqa: F401
     QuotaTopologyGuard,
     QuotaTopologyError,
+)
+from koordinator_tpu.webhook.node import (  # noqa: F401
+    NodeMutatingWebhook,
+    NodeValidatingWebhook,
+)
+from koordinator_tpu.webhook.cm import (  # noqa: F401
+    SLOConfigValidatingWebhook,
 )

@@ -1,0 +1,96 @@
+"""Node admission: resource-amplification mutation + validation.
+
+Reference: pkg/webhook/node/ — the mutating handler's
+resourceamplification plugin (resource_amplification.go) intercepts node
+UPDATEs: when the kubelet changed raw cpu/memory allocatable on a node
+carrying an amplification-ratio annotation, it re-records the raw
+capacity annotation and amplifies the visible allocatable, so the
+scheduler keeps seeing normalized numbers; the validating handler guards
+the annotation protocol itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_NODE_RAW_ALLOCATABLE,
+    ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import NodeSpec
+
+#: only cpu/memory amplify (resource_amplification.go supportedResources)
+SUPPORTED = (ResourceName.CPU, ResourceName.MEMORY)
+
+
+def parse_ratios(node: NodeSpec) -> Optional[dict]:
+    raw = node.annotations.get(ANNOTATION_RESOURCE_AMPLIFICATION_RATIO)
+    if not raw:
+        return None
+    ratios = json.loads(raw)
+    if not isinstance(ratios, dict):
+        raise ValueError("amplification ratio annotation must be a "
+                         "JSON object of resource -> ratio")
+    return {str(k): float(v) for k, v in ratios.items()}
+
+
+class NodeMutatingWebhook:
+    """Amplification admit (resource_amplification.go Admit)."""
+
+    def mutate(self, node: NodeSpec,
+               old_node: Optional[NodeSpec] = None) -> NodeSpec:
+        """CREATE passes through (reference: Create -> nil); on UPDATE
+        with a ratio annotation, a raw cpu/memory allocatable change is
+        re-amplified and the raw values recorded."""
+        if old_node is None:
+            return node
+        try:
+            ratios = parse_ratios(node)
+        except (ValueError, TypeError):
+            return node  # validation rejects; never half-mutate
+        if not ratios:
+            return node
+        # an UPDATE echoing the current (amplified) allocatable back is a
+        # no-op — re-recording it as "raw" would COMPOUND the ratio on
+        # every label patch. Only a value differing from the visible
+        # allocatable is a fresh kubelet raw report.
+        if all(
+            node.allocatable.get(r) == old_node.allocatable.get(r)
+            for r in SUPPORTED
+        ):
+            return node
+        # the incoming allocatable is the kubelet's RAW report: record
+        # it, then amplify the supported resources
+        raw = dict(node.allocatable)
+        node.raw_allocatable = raw
+        node.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
+            {str(int(r)): raw[r] for r in SUPPORTED if r in raw}
+        )
+        for r in SUPPORTED:
+            ratio = ratios.get(str(int(r)), ratios.get(r.name.lower()))
+            if ratio and r in raw:
+                node.allocatable[r] = int(raw[r] * ratio)
+        return node
+
+
+class NodeValidatingWebhook:
+    """Annotation-protocol guard (pkg/webhook/node/validating scope)."""
+
+    def validate(self, node: NodeSpec,
+                 old_node: Optional[NodeSpec] = None) -> List[str]:
+        violations: List[str] = []
+        try:
+            ratios = parse_ratios(node)
+        except (ValueError, TypeError) as e:
+            return [f"malformed amplification ratio annotation: {e}"]
+        if ratios:
+            for key, ratio in ratios.items():
+                if ratio < 1.0:
+                    violations.append(
+                        f"amplification ratio for {key} must be >= 1.0, "
+                        f"got {ratio}"
+                    )
+        return violations

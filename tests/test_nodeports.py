@@ -191,3 +191,23 @@ def test_standalone_model_defers_same_batch_port_claimants():
         node_metrics=metrics, now=101.0,
     ))
     assert out["default/b"] is None     # single node: genuinely stuck
+
+
+def test_selector_blocked_claimant_does_not_starve():
+    """A pod unplaceable due to its node selector (ports all free) must
+    not claim its ports (code-review regression: the claim check must
+    use the FULL accumulated mask)."""
+    from koordinator_tpu.apis.types import ClusterSnapshot
+    from koordinator_tpu.models.placement import PlacementModel
+
+    node = NodeSpec(name="n0", allocatable={R.CPU: 8000, R.MEMORY: 16384})
+    metrics = {"n0": NodeMetric(node_name="n0", update_time=99.0)}
+    blocked = PodSpec(name="a", host_ports=[80], requests={R.CPU: 100},
+                      node_selector={"zone": "nowhere"})
+    free = PodSpec(name="b", host_ports=[80], requests={R.CPU: 100})
+    out = PlacementModel().schedule(ClusterSnapshot(
+        nodes=[node], pods=[], pending_pods=[blocked, free],
+        node_metrics=metrics, now=100.0,
+    ))
+    assert out["default/a"] is None
+    assert out["default/b"] == "n0"

@@ -244,3 +244,181 @@ class TestQuotaTopologyGuard:
                 QuotaSpec(name="parent", is_parent=True, tree_id="other",
                           min={R.CPU: 10000}, max={R.CPU: 20000})
             )
+
+
+class TestNodeWebhook:
+    """Reference: pkg/webhook/node/plugins/resourceamplification —
+    kubelet allocatable updates re-amplify; ratio protocol validated."""
+
+    def _ratio_node(self, cpu=32000, ratio=1.5):
+        import json
+
+        from koordinator_tpu.apis.extension import (
+            ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
+        )
+        from koordinator_tpu.apis.types import NodeSpec
+
+        return NodeSpec(
+            name="n0",
+            allocatable={R.CPU: cpu, R.MEMORY: 65536},
+            annotations={
+                ANNOTATION_RESOURCE_AMPLIFICATION_RATIO: json.dumps(
+                    {str(int(R.CPU)): ratio})},
+        )
+
+    def test_create_passes_through(self):
+        from koordinator_tpu.webhook import NodeMutatingWebhook
+
+        node = self._ratio_node()
+        NodeMutatingWebhook().mutate(node, old_node=None)
+        assert node.allocatable[R.CPU] == 32000  # untouched on CREATE
+
+    def test_kubelet_update_reamplifies(self):
+        from koordinator_tpu.webhook import NodeMutatingWebhook
+
+        old = self._ratio_node(cpu=32000)
+        old.raw_allocatable = {R.CPU: 32000, R.MEMORY: 65536}
+        old.allocatable = {R.CPU: 48000, R.MEMORY: 65536}
+        new = self._ratio_node(cpu=40000)  # kubelet re-reported raw
+        NodeMutatingWebhook().mutate(new, old_node=old)
+        assert new.allocatable[R.CPU] == 60000        # 40000 * 1.5
+        assert new.raw_allocatable[R.CPU] == 40000
+
+    def test_unchanged_raw_not_touched(self):
+        from koordinator_tpu.webhook import NodeMutatingWebhook
+
+        old = self._ratio_node(cpu=32000)
+        old.raw_allocatable = {R.CPU: 32000, R.MEMORY: 65536}
+        new = self._ratio_node(cpu=32000)
+        NodeMutatingWebhook().mutate(new, old_node=old)
+        assert new.allocatable[R.CPU] == 32000  # no spurious re-amplify
+
+    def test_validate_rejects_shrinking_ratio(self):
+        from koordinator_tpu.webhook import NodeValidatingWebhook
+
+        node = self._ratio_node(ratio=0.8)
+        violations = NodeValidatingWebhook().validate(node)
+        assert violations and ">= 1.0" in violations[0]
+
+    def test_validate_rejects_malformed_annotation(self):
+        from koordinator_tpu.apis.extension import (
+            ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
+        )
+        from koordinator_tpu.apis.types import NodeSpec
+        from koordinator_tpu.webhook import NodeValidatingWebhook
+
+        node = NodeSpec(name="n0", annotations={
+            ANNOTATION_RESOURCE_AMPLIFICATION_RATIO: "not json"})
+        assert NodeValidatingWebhook().validate(node)
+
+
+class TestSLOConfigWebhook:
+    """Reference: pkg/webhook/cm/plugins/sloconfig checkers."""
+
+    def test_valid_defaults_admitted(self):
+        from koordinator_tpu.manager.sloconfig import (
+            ColocationStrategy,
+            CPUBurstStrategy,
+            ResourceQOSStrategy,
+            ResourceThresholdStrategy,
+        )
+        from koordinator_tpu.webhook import SLOConfigValidatingWebhook
+
+        w = SLOConfigValidatingWebhook()
+        assert w.validate_colocation(ColocationStrategy()) == []
+        assert w.validate_cpu_burst(CPUBurstStrategy()) == []
+        assert w.validate_threshold(ResourceThresholdStrategy()) == []
+        assert w.validate_resource_qos(ResourceQOSStrategy()) == []
+
+    def test_colocation_bounds(self):
+        from koordinator_tpu.manager.sloconfig import ColocationStrategy
+        from koordinator_tpu.webhook import SLOConfigValidatingWebhook
+
+        bad = ColocationStrategy(cpu_reclaim_threshold_percent=150,
+                                 degrade_time_minutes=0,
+                                 cpu_calculate_policy="banana")
+        v = SLOConfigValidatingWebhook().validate_colocation(bad)
+        assert len(v) == 3
+
+    def test_cpu_burst_bounds(self):
+        from koordinator_tpu.manager.sloconfig import CPUBurstStrategy
+        from koordinator_tpu.webhook import SLOConfigValidatingWebhook
+
+        bad = CPUBurstStrategy(policy="never", cfs_quota_burst_percent=50)
+        v = SLOConfigValidatingWebhook().validate_cpu_burst(bad)
+        assert len(v) == 2
+
+    def test_resource_qos_bvt_and_resctrl(self):
+        from koordinator_tpu.manager.sloconfig import ResourceQOSStrategy
+        from koordinator_tpu.webhook import SLOConfigValidatingWebhook
+
+        bad = ResourceQOSStrategy()
+        bad.be.cpu.group_identity = 7
+        bad.ls.resctrl.cat_range_start_percent = 80
+        bad.ls.resctrl.cat_range_end_percent = 20
+        v = SLOConfigValidatingWebhook().validate_resource_qos(bad)
+        assert len(v) == 2
+
+    def test_manager_gates_wire_node_and_cm_webhooks(self):
+        from koordinator_tpu.cmd.manager import ManagerConfig, build_manager
+
+        off = build_manager(ManagerConfig())
+        assert off.node_mutating_webhook is None  # gates default False
+        on = build_manager(ManagerConfig(
+            feature_gates="NodeMutatingWebhook=true,"
+                          "NodeValidatingWebhook=true,"
+                          "ConfigMapValidatingWebhook=true"))
+        assert on.node_mutating_webhook is not None
+        assert on.node_validating_webhook is not None
+        assert on.slo_config_webhook is not None
+        from koordinator_tpu.apis.types import NodeSpec
+
+        node, violations = on.admit_node(
+            NodeSpec(name="n0", allocatable={R.CPU: 1000}))
+        assert violations == [] and node.allocatable[R.CPU] == 1000
+
+def _ratio_node(cpu=32000, ratio=1.5):
+    import json
+
+    from koordinator_tpu.apis.extension import (
+        ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
+    )
+    from koordinator_tpu.apis.types import NodeSpec
+
+    return NodeSpec(
+        name="n0",
+        allocatable={R.CPU: cpu, R.MEMORY: 65536},
+        annotations={ANNOTATION_RESOURCE_AMPLIFICATION_RATIO: json.dumps(
+            {str(int(R.CPU)): ratio})},
+    )
+
+
+def test_echoed_amplified_update_is_noop():
+    """An UPDATE echoing the amplified allocatable back must not
+    compound the ratio (code-review regression)."""
+    from koordinator_tpu.webhook import NodeMutatingWebhook
+
+    old = _ratio_node(cpu=60000)   # already amplified (raw 40000)
+    old.raw_allocatable = {R.CPU: 40000, R.MEMORY: 65536}
+    echoed = _ratio_node(cpu=60000)
+    NodeMutatingWebhook().mutate(echoed, old_node=old)
+    assert echoed.allocatable[R.CPU] == 60000   # NOT 90000
+
+
+def test_non_dict_ratio_json_is_violation_not_crash():
+    from koordinator_tpu.apis.extension import (
+        ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
+    )
+    from koordinator_tpu.apis.types import NodeSpec
+    from koordinator_tpu.webhook import (
+        NodeMutatingWebhook,
+        NodeValidatingWebhook,
+    )
+
+    for payload in ('[1.5]', '"1.5"', '1.5'):
+        node = NodeSpec(name="n0", allocatable={R.CPU: 1000},
+                        annotations={
+            ANNOTATION_RESOURCE_AMPLIFICATION_RATIO: payload})
+        assert NodeValidatingWebhook().validate(node)  # violation
+        NodeMutatingWebhook().mutate(
+            node, old_node=NodeSpec(name="n0"))        # no crash
