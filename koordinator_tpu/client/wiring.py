@@ -210,13 +210,18 @@ class KoordletLoop:
     and reports NodeMetric status back (states_nodemetric.go sync)."""
 
     def __init__(self, bus: APIServer, informer, node_name: str,
-                 reporter=None, pod_meta_fn=None):
+                 reporter=None, pod_meta_fn=None,
+                 topology_reporter=None, device_reporter=None):
         from koordinator_tpu.koordlet.statesinformer import pod_meta_from_spec
 
         self.bus = bus
         self.informer = informer
         self.node_name = node_name
         self.reporter = reporter
+        #: optional NRT / Device CR reporters (statesinformer reporters
+        #: built with koordlet_report_sinks(bus) as their sinks)
+        self.topology_reporter = topology_reporter
+        self.device_reporter = device_reporter
         self._meta_fn = pod_meta_fn or pod_meta_from_spec
         self._pods = {}
 
@@ -255,21 +260,44 @@ class KoordletLoop:
 
     def report(self, now: float):
         """Aggregate the metric cache into a NodeMetric and publish it
-        (requires a NodeMetricReporter)."""
-        if self.reporter is None:
+        (requires a NodeMetricReporter); NRT/Device reporters, when
+        wired, publish through their own bus sinks."""
+        if (self.reporter is None and self.topology_reporter is None
+                and self.device_reporter is None):
             raise RuntimeError(
-                "wire_koordlet was built without a NodeMetricReporter; "
-                "pass reporter= to report NodeMetric"
+                "wire_koordlet was built without any reporter; pass "
+                "reporter=/topology_reporter=/device_reporter="
             )
-        metric = self.reporter.report(now)
-        if metric is not None:
-            self.bus.apply(Kind.NODE_METRIC, self.node_name, metric)
+        metric = None
+        if self.reporter is not None:
+            metric = self.reporter.report(now)
+            if metric is not None:
+                self.bus.apply(Kind.NODE_METRIC, self.node_name, metric)
+        if self.topology_reporter is not None:
+            self.topology_reporter.sync()
+        if self.device_reporter is not None:
+            self.device_reporter.sync()
         return metric
 
 
+def koordlet_report_sinks(bus: APIServer):
+    """(topology_sink, device_sink) publishing the NodeResourceTopology
+    and Device CRs on the bus — the ``report`` callbacks the
+    statesinformer reporters take (the scheduler's NUMA manager and
+    device cache consume them through wire_scheduler's watches)."""
+    return (
+        lambda name, options: bus.apply(
+            Kind.NODE_RESOURCE_TOPOLOGY, name, options
+        ),
+        lambda name, entries: bus.apply(Kind.DEVICE, name, list(entries)),
+    )
+
+
 def wire_koordlet(bus: APIServer, informer, node_name: str, reporter=None,
-                  pod_meta_fn=None) -> KoordletLoop:
-    return KoordletLoop(bus, informer, node_name, reporter, pod_meta_fn)
+                  pod_meta_fn=None, topology_reporter=None,
+                  device_reporter=None) -> KoordletLoop:
+    return KoordletLoop(bus, informer, node_name, reporter, pod_meta_fn,
+                        topology_reporter, device_reporter)
 
 
 class DeschedulerLoop:

@@ -36,10 +36,12 @@ def check_colocation(strategy: ColocationStrategy) -> List[str]:
         v.append("degradeTimeMinutes must be positive")
     if strategy.update_time_threshold_seconds <= 0:
         v.append("updateTimeThresholdSeconds must be positive")
-    if not 0 <= strategy.resource_diff_threshold <= 1:
-        v.append("resourceDiffThreshold must be in [0, 1]")
+    if not 0 < strategy.resource_diff_threshold <= 1:
+        v.append("resourceDiffThreshold must be in (0, 1]")
     if strategy.metric_aggregate_duration_seconds <= 0:
         v.append("metricAggregateDurationSeconds must be positive")
+    if strategy.metric_report_interval_seconds <= 0:
+        v.append("metricReportIntervalSeconds must be positive")
     if strategy.cpu_calculate_policy not in (
         "usage", "request", "maxUsageRequest"
     ):

@@ -11,8 +11,8 @@ the annotation protocol itself.
 
 from __future__ import annotations
 
-import dataclasses
 import json
+import math
 from typing import List, Optional
 
 from koordinator_tpu.apis.extension import (
@@ -52,26 +52,32 @@ class NodeMutatingWebhook:
         except (ValueError, TypeError):
             return node  # validation rejects; never half-mutate
         if not ratios:
+            # amplification disabled: drop the stale raw record
+            # (reference handleUpdate deletes the annotation here)
+            node.annotations.pop(ANNOTATION_NODE_RAW_ALLOCATABLE, None)
+            node.raw_allocatable = None
             return node
-        # an UPDATE echoing the current (amplified) allocatable back is a
-        # no-op — re-recording it as "raw" would COMPOUND the ratio on
-        # every label patch. Only a value differing from the visible
-        # allocatable is a fresh kubelet raw report.
-        if all(
-            node.allocatable.get(r) == old_node.allocatable.get(r)
+        # reference semantics: record raw when it was never recorded OR
+        # the kubelet changed the supported resources; otherwise
+        # re-amplify from the STORED raw — an echoed amplified value (or
+        # a ratio change alone) must never compound
+        changed = any(
+            node.allocatable.get(r) != old_node.allocatable.get(r)
             for r in SUPPORTED
-        ):
-            return node
-        # the incoming allocatable is the kubelet's RAW report: record
-        # it, then amplify the supported resources
-        raw = dict(node.allocatable)
+        )
+        if changed or old_node.raw_allocatable is None:
+            raw = dict(node.allocatable)
+        else:
+            raw = dict(old_node.raw_allocatable)
         node.raw_allocatable = raw
+        # one shared encoding with the manager's cpu-normalization
+        # plugin: lowercase resource names
         node.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
-            {str(int(r)): raw[r] for r in SUPPORTED if r in raw}
+            {r.name.lower(): raw[r] for r in SUPPORTED if r in raw}
         )
         for r in SUPPORTED:
             ratio = ratios.get(str(int(r)), ratios.get(r.name.lower()))
-            if ratio and r in raw:
+            if ratio and math.isfinite(ratio) and r in raw:
                 node.allocatable[r] = int(raw[r] * ratio)
         return node
 
@@ -88,9 +94,13 @@ class NodeValidatingWebhook:
             return [f"malformed amplification ratio annotation: {e}"]
         if ratios:
             for key, ratio in ratios.items():
-                if ratio < 1.0:
+                # the explicit range also rejects NaN (all comparisons
+                # False) and infinity; the 100x cap matches the
+                # normalization guard protecting the int32 capacity
+                # columns (manager/noderesource._MAX_NORMALIZATION_RATIO)
+                if not 1.0 <= ratio <= 100.0:
                     violations.append(
-                        f"amplification ratio for {key} must be >= 1.0, "
-                        f"got {ratio}"
+                        f"amplification ratio for {key} must be in "
+                        f"[1.0, 100.0], got {ratio}"
                     )
         return violations

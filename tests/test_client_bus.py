@@ -104,6 +104,50 @@ def test_bindings_published_and_koordlet_wired():
     assert informer.running_pods() == []
 
 
+def test_koordlet_reports_nrt_and_devices_over_bus():
+    """The koordlet's NRT + Device reporters publish through the bus
+    sinks; the scheduler's NUMA manager and device cache receive them
+    through its watches."""
+    from koordinator_tpu.client import wire_koordlet
+    from koordinator_tpu.client.wiring import koordlet_report_sinks
+    from koordinator_tpu.device.cache import DeviceEntry, DeviceType
+    from koordinator_tpu.device.cache import DeviceResourceName as DR
+    from koordinator_tpu.koordlet.statesinformer import (
+        DeviceReporter,
+        NodeTopologyReporter,
+        StatesInformer,
+    )
+    from koordinator_tpu.koordlet.system.cgroup import SystemConfig
+    from koordinator_tpu.koordlet.system.cpuinfo import ProcessorInfo
+
+    bus = APIServer()
+    s = Scheduler()
+    wire_scheduler(bus, s)
+    informer = StatesInformer()
+    topo_sink, dev_sink = koordlet_report_sinks(bus)
+
+    class FakeDevices:
+        def list_devices(self):
+            return [DeviceEntry(minor=0, device_type=DeviceType.GPU,
+                                resources={DR.GPU_CORE: 100})]
+
+    cpu_infos = [ProcessorInfo(cpu_id=i, core_id=i % 2, socket_id=0,
+                               node_id=0) for i in range(4)]
+    loop = wire_koordlet(
+        bus, informer, "n0",
+        topology_reporter=NodeTopologyReporter(
+            "n0", SystemConfig(), topo_sink, cpu_infos=cpu_infos),
+        device_reporter=DeviceReporter("n0", FakeDevices(), dev_sink),
+    )
+    loop.topology_reporter.sync()
+    loop.device_reporter.sync()
+    # the CRs are on the bus and the scheduler consumed them
+    assert bus.get(Kind.NODE_RESOURCE_TOPOLOGY, "n0") is not None
+    assert bus.get(Kind.DEVICE, "n0")[0].minor == 0
+    assert s.numa_manager.get_topology("n0").numa_node_resources
+    assert s.device_cache.get("n0").device_infos
+
+
 def test_waiting_gang_member_not_visible_to_koordlet():
     """A gang member held at the Permit barrier is assumed (node_name
     set) but NOT bound: a MODIFIED event on it must not make a wired

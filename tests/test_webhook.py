@@ -251,20 +251,7 @@ class TestNodeWebhook:
     kubelet allocatable updates re-amplify; ratio protocol validated."""
 
     def _ratio_node(self, cpu=32000, ratio=1.5):
-        import json
-
-        from koordinator_tpu.apis.extension import (
-            ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
-        )
-        from koordinator_tpu.apis.types import NodeSpec
-
-        return NodeSpec(
-            name="n0",
-            allocatable={R.CPU: cpu, R.MEMORY: 65536},
-            annotations={
-                ANNOTATION_RESOURCE_AMPLIFICATION_RATIO: json.dumps(
-                    {str(int(R.CPU)): ratio})},
-        )
+        return _ratio_node(cpu=cpu, ratio=ratio)  # shared module helper
 
     def test_create_passes_through(self):
         from koordinator_tpu.webhook import NodeMutatingWebhook
@@ -284,21 +271,25 @@ class TestNodeWebhook:
         assert new.allocatable[R.CPU] == 60000        # 40000 * 1.5
         assert new.raw_allocatable[R.CPU] == 40000
 
-    def test_unchanged_raw_not_touched(self):
+    def test_unchanged_update_amplifies_from_stored_raw(self):
+        """Reference semantics: with raw recorded and no kubelet change,
+        every UPDATE re-amplifies from the STORED raw — idempotent, never
+        compounding."""
         from koordinator_tpu.webhook import NodeMutatingWebhook
 
-        old = self._ratio_node(cpu=32000)
+        old = self._ratio_node(cpu=48000)  # visible (amplified)
         old.raw_allocatable = {R.CPU: 32000, R.MEMORY: 65536}
-        new = self._ratio_node(cpu=32000)
+        new = self._ratio_node(cpu=48000)
         NodeMutatingWebhook().mutate(new, old_node=old)
-        assert new.allocatable[R.CPU] == 32000  # no spurious re-amplify
+        assert new.allocatable[R.CPU] == 48000        # 32000 * 1.5
+        assert new.raw_allocatable[R.CPU] == 32000    # raw preserved
 
     def test_validate_rejects_shrinking_ratio(self):
         from koordinator_tpu.webhook import NodeValidatingWebhook
 
         node = self._ratio_node(ratio=0.8)
         violations = NodeValidatingWebhook().validate(node)
-        assert violations and ">= 1.0" in violations[0]
+        assert violations and "[1.0, 100.0]" in violations[0]
 
     def test_validate_rejects_malformed_annotation(self):
         from koordinator_tpu.apis.extension import (
@@ -422,3 +413,81 @@ def test_non_dict_ratio_json_is_violation_not_crash():
         assert NodeValidatingWebhook().validate(node)  # violation
         NodeMutatingWebhook().mutate(
             node, old_node=NodeSpec(name="n0"))        # no crash
+
+
+def test_ratio_annotation_added_later_takes_effect():
+    """Adding the ratio annotation to an existing node must amplify on
+    that very UPDATE even though allocatable didn't change
+    (code-review regression; reference records raw when absent)."""
+    from koordinator_tpu.apis.types import NodeSpec
+    from koordinator_tpu.webhook import NodeMutatingWebhook
+
+    old = NodeSpec(name="n0", allocatable={R.CPU: 32000, R.MEMORY: 65536})
+    new = _ratio_node(cpu=32000)    # same allocatable + new annotation
+    NodeMutatingWebhook().mutate(new, old_node=old)
+    assert new.allocatable[R.CPU] == 48000
+    assert new.raw_allocatable[R.CPU] == 32000
+
+
+def test_ratio_bump_reamplifies_from_stored_raw():
+    from koordinator_tpu.webhook import NodeMutatingWebhook
+
+    old = _ratio_node(cpu=48000)      # amplified at 1.5 from raw 32000
+    old.raw_allocatable = {R.CPU: 32000, R.MEMORY: 65536}
+    new = _ratio_node(cpu=48000, ratio=2.0)
+    NodeMutatingWebhook().mutate(new, old_node=old)
+    assert new.allocatable[R.CPU] == 64000      # 32000 * 2.0, no compound
+
+
+def test_ratio_removal_cleans_raw_record():
+    import json
+
+    from koordinator_tpu.apis.extension import (
+        ANNOTATION_NODE_RAW_ALLOCATABLE,
+    )
+    from koordinator_tpu.apis.types import NodeSpec
+    from koordinator_tpu.webhook import NodeMutatingWebhook
+
+    old = _ratio_node(cpu=48000)
+    old.raw_allocatable = {R.CPU: 32000, R.MEMORY: 65536}
+    new = NodeSpec(name="n0", allocatable={R.CPU: 48000, R.MEMORY: 65536},
+                   annotations={ANNOTATION_NODE_RAW_ALLOCATABLE:
+                                json.dumps({"cpu": 32000})})
+    NodeMutatingWebhook().mutate(new, old_node=old)
+    assert ANNOTATION_NODE_RAW_ALLOCATABLE not in new.annotations
+    assert new.raw_allocatable is None
+
+
+def test_infinite_and_nan_ratios_rejected():
+    import json
+
+    from koordinator_tpu.apis.extension import (
+        ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
+    )
+    from koordinator_tpu.apis.types import NodeSpec
+    from koordinator_tpu.webhook import NodeValidatingWebhook
+
+    for payload in ('{"0": Infinity}', '{"0": NaN}', '{"0": 1000.0}'):
+        node = NodeSpec(name="n0", annotations={
+            ANNOTATION_RESOURCE_AMPLIFICATION_RATIO: payload})
+        assert NodeValidatingWebhook().validate(node)
+
+
+def test_cm_checker_matches_runtime_is_valid():
+    """The admission checker must reject everything the slo controllers'
+    is_valid rejects (code-review regression: they had diverged)."""
+    import dataclasses as dc
+
+    from koordinator_tpu.manager.sloconfig import ColocationStrategy
+    from koordinator_tpu.webhook.cm import check_colocation
+
+    for bad in (
+        ColocationStrategy(metric_report_interval_seconds=0),
+        ColocationStrategy(resource_diff_threshold=0),
+        ColocationStrategy(metric_aggregate_duration_seconds=0),
+        ColocationStrategy(cpu_reclaim_threshold_percent=0),
+        ColocationStrategy(memory_reclaim_threshold_percent=200),
+        ColocationStrategy(degrade_time_minutes=0),
+    ):
+        assert not bad.is_valid()
+        assert check_colocation(bad), dc.asdict(bad)
