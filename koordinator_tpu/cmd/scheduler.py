@@ -178,6 +178,11 @@ def main(argv=None) -> int:
              "lease (reference: --leader-elect on every binary)",
     )
     parser.add_argument("--leader-elect-identity", default=None)
+    parser.add_argument(
+        "--debug-port", type=int, default=None,
+        help="serve /healthz /metrics /apis/v1/plugins /debug on this "
+             "port (reference: the secure-serving mux on every binary)",
+    )
     args = parser.parse_args(argv)
     secret = None
     if args.solver_secret_file:
@@ -208,7 +213,21 @@ def main(argv=None) -> int:
     wire_scheduler(bus, scheduler, elector=elector)
     if args.cluster_json:
         seed_bus_from_json(bus, args.cluster_json)
-    return run_loop(scheduler, config, once=args.once, elector=elector)
+    http_server = None
+    if args.debug_port is not None:
+        from koordinator_tpu.metrics.components import SCHEDULER_METRICS
+        from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+        http_server = DebugHTTPServer(
+            services=scheduler.services, debug=scheduler.debug,
+            metrics=SCHEDULER_METRICS, port=args.debug_port,
+        ).start()
+        print(f"debug http on 127.0.0.1:{http_server.port}")
+    try:
+        return run_loop(scheduler, config, once=args.once, elector=elector)
+    finally:
+        if http_server is not None:
+            http_server.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,2 @@
+"""Shared utilities (reference: pkg/util — the slices every component
+imports; here only what the typed design still needs)."""

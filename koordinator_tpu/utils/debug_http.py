@@ -1,0 +1,143 @@
+"""Debug/observability HTTP server: the mux every reference binary runs.
+
+Reference: cmd/koord-scheduler/app/server.go:293-303 installs pprof, the
+runtime-settable score/filter debug toggles (PUT /debug/flags/s and /f,
+pkg/scheduler/frameworkext/debug.go), the per-plugin REST services
+(pkg/scheduler/frameworkext/services/services.go:44-104 — GET
+/apis/v1/plugins/<name>), plus /metrics and /healthz on every binary.
+
+One stdlib ThreadingHTTPServer serves the same surface over the typed
+registries this framework already keeps:
+
+- ``GET /healthz``                  -> 200 "ok"
+- ``GET /metrics``                  -> prometheus text exposition
+- ``GET /apis/v1/plugins``          -> registered debug service names
+- ``GET /apis/v1/plugins/<name>``   -> that service's JSON payload
+- ``PUT /debug/flags/s|f?value=1``  -> toggle score/filter dumps
+- ``GET /debug/dumps``              -> collected score/filter dumps
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class DebugHTTPServer:
+    """Serves a DebugServices registry, a DebugRecorder, and a metrics
+    gatherer (anything with ``gather() -> str``) on one port."""
+
+    def __init__(self, services=None, debug=None, metrics=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.services = services
+        self.debug = debug
+        self.metrics = metrics
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet by default
+                pass
+
+            def _send(self, code: int, body: str,
+                      content_type: str = "application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                # service callables read live scheduler state from
+                # handler threads: any race/iteration error must come
+                # back as a 500, not an aborted connection
+                try:
+                    self._get()
+                except Exception as e:
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}))
+                    except Exception:
+                        pass
+
+            def _get(self):
+                path = urlparse(self.path).path.rstrip("/")
+                if path == "/healthz":
+                    return self._send(200, "ok", "text/plain")
+                if path == "/metrics":
+                    if outer.metrics is None:
+                        return self._send(404, "no metrics registry",
+                                          "text/plain")
+                    return self._send(200, outer.metrics.gather(),
+                                      "text/plain; version=0.0.4")
+                if path == "/apis/v1/plugins":
+                    names = outer.services.names() if outer.services else []
+                    return self._send(200, json.dumps(names))
+                if path.startswith("/apis/v1/plugins/"):
+                    name = path[len("/apis/v1/plugins/"):]
+                    payload = (outer.services.query(name)
+                               if outer.services else None)
+                    if payload is None:
+                        return self._send(404, json.dumps(
+                            {"error": f"unknown plugin {name!r}"}))
+                    return self._send(200, json.dumps(payload, default=str))
+                if path == "/debug/dumps":
+                    if outer.debug is None:
+                        return self._send(404, "no debug recorder",
+                                          "text/plain")
+                    return self._send(200, json.dumps({
+                        "scores": outer.debug.scores,
+                        "filters": outer.debug.filters,
+                    }, default=str))
+                return self._send(404, "not found", "text/plain")
+
+            def do_PUT(self):
+                try:
+                    self._put()
+                except Exception as e:
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}))
+                    except Exception:
+                        pass
+
+            def _put(self):
+                # the reference's runtime toggles: PUT /debug/flags/s, /f
+                # with value=1|0 (server.go:300-303 DebugScoresSetter)
+                parsed = urlparse(self.path)
+                path = parsed.path.rstrip("/")
+                if outer.debug is not None and path in (
+                    "/debug/flags/s", "/debug/flags/f"
+                ):
+                    raw = parse_qs(parsed.query).get("value", ["1"])[0]
+                    on = raw not in ("0", "false", "off")
+                    if path.endswith("/s"):
+                        outer.debug.dump_scores = on
+                    else:
+                        outer.debug.dump_filters = on
+                    return self._send(200, json.dumps({"enabled": on}))
+                return self._send(404, "not found", "text/plain")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "DebugHTTPServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

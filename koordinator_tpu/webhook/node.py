@@ -37,6 +37,29 @@ def parse_ratios(node: NodeSpec) -> Optional[dict]:
     return {str(k): float(v) for k, v in ratios.items()}
 
 
+def stored_raw_allocatable(node: NodeSpec) -> Optional[dict]:
+    """The recorded raw capacity: the typed field when present, else
+    parsed back from the annotation — raw state must survive
+    serialization (the reference reads the annotation, never memory)."""
+    if node.raw_allocatable is not None:
+        return dict(node.raw_allocatable)
+    text = node.annotations.get(ANNOTATION_NODE_RAW_ALLOCATABLE)
+    if not text:
+        return None
+    try:
+        parsed = json.loads(text)
+    except ValueError:
+        return None
+    if not isinstance(parsed, dict):
+        return None
+    out = {}
+    for key, value in parsed.items():
+        for r in SUPPORTED:
+            if key in (r.name.lower(), str(int(r))):
+                out[r] = int(value)
+    return out or None
+
+
 class NodeMutatingWebhook:
     """Amplification admit (resource_amplification.go Admit)."""
 
@@ -65,10 +88,11 @@ class NodeMutatingWebhook:
             node.allocatable.get(r) != old_node.allocatable.get(r)
             for r in SUPPORTED
         )
-        if changed or old_node.raw_allocatable is None:
+        stored = stored_raw_allocatable(old_node)
+        if changed or stored is None:
             raw = dict(node.allocatable)
         else:
-            raw = dict(old_node.raw_allocatable)
+            raw = stored
         node.raw_allocatable = raw
         # one shared encoding with the manager's cpu-normalization
         # plugin: lowercase resource names

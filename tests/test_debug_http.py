@@ -1,0 +1,83 @@
+"""Debug/observability HTTP mux (SURVEY §5.1/§5.5 HTTP surface).
+
+Oracle: cmd/koord-scheduler/app/server.go:293-303 (debug toggles +
+services install), frameworkext/services/services.go (per-plugin REST),
+/metrics + /healthz on every binary.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+from koordinator_tpu.metrics.registry import Registry
+from koordinator_tpu.scheduler import Scheduler
+from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.read().decode()
+
+
+def _put(port, path):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method="PUT")
+    with urllib.request.urlopen(req) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture
+def served_scheduler():
+    s = Scheduler()
+    s.add_node(NodeSpec(name="n0",
+                        allocatable={R.CPU: 8000, R.MEMORY: 16384}))
+    s.update_node_metric(NodeMetric(node_name="n0", update_time=99.0))
+    registry = Registry("test")
+    registry.counter("rounds_total", "rounds").inc()
+    server = DebugHTTPServer(services=s.services, debug=s.debug,
+                             metrics=registry).start()
+    yield s, server
+    server.stop()
+
+
+def test_healthz_and_metrics(served_scheduler):
+    _, server = served_scheduler
+    assert _get(server.port, "/healthz") == (200, "ok")
+    status, body = _get(server.port, "/metrics")
+    assert status == 200 and "rounds_total" in body
+
+
+def test_plugin_services(served_scheduler):
+    s, server = served_scheduler
+    status, body = _get(server.port, "/apis/v1/plugins")
+    assert status == 200 and "Coscheduling" in json.loads(body)
+    status, body = _get(server.port, "/apis/v1/plugins/Coscheduling")
+    assert status == 200 and json.loads(body) == {}
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server.port, "/apis/v1/plugins/nope")
+    assert e.value.code == 404
+
+
+def test_debug_flag_toggles_collect_dumps(served_scheduler):
+    """The reference's PUT /debug/flags/s runtime toggle: scores dumped
+    only while enabled."""
+    s, server = served_scheduler
+    s.add_pod(PodSpec(name="p0", requests={R.CPU: 100}))
+    s.schedule_pending(now=100.0)
+    _, body = _get(server.port, "/debug/dumps")
+    assert json.loads(body)["scores"] == []      # toggle off: no dumps
+
+    assert _put(server.port, "/debug/flags/s")[0] == 200
+    assert _put(server.port, "/debug/flags/f?value=1")[0] == 200
+    s.add_pod(PodSpec(name="p1", requests={R.CPU: 100}))
+    s.batched_placement = False                  # per-pod cycles record
+    s.schedule_pending(now=101.0)
+    _, body = _get(server.port, "/debug/dumps")
+    assert json.loads(body)["scores"]            # dumped while on
+
+    status, body = _put(server.port, "/debug/flags/s?value=0")
+    assert json.loads(body) == {"enabled": False}
+    assert s.debug.dump_scores is False

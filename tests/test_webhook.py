@@ -491,3 +491,24 @@ def test_cm_checker_matches_runtime_is_valid():
     ):
         assert not bad.is_valid()
         assert check_colocation(bad), dc.asdict(bad)
+
+
+def test_raw_survives_serialization_via_annotation():
+    """Compounding protection must work when old_node arrives with only
+    the raw ANNOTATION (typed field lost to serialization/restart) —
+    code-review regression."""
+    import json
+
+    from koordinator_tpu.apis.extension import (
+        ANNOTATION_NODE_RAW_ALLOCATABLE,
+    )
+    from koordinator_tpu.webhook import NodeMutatingWebhook
+
+    old = _ratio_node(cpu=48000)       # amplified; typed raw field LOST
+    old.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
+        {"cpu": 32000})
+    assert old.raw_allocatable is None
+    echoed = _ratio_node(cpu=48000)    # label-patch echo
+    NodeMutatingWebhook().mutate(echoed, old_node=old)
+    assert echoed.allocatable[R.CPU] == 48000   # 32000*1.5, NOT 72000
+    assert echoed.raw_allocatable[R.CPU] == 32000
