@@ -320,6 +320,8 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default="",
                         help="persist TSDB + prediction state across "
                              "restarts (empty = off)")
+    parser.add_argument("--debug-port", type=int, default=None,
+                        help="serve /healthz /metrics /audit on this port")
     parser.add_argument("--once", action="store_true")
     args = parser.parse_args(argv)
     daemon = build_koordlet(
@@ -333,11 +335,32 @@ def main(argv=None) -> int:
             checkpoint_dir=args.checkpoint_dir,
         )
     )
-    while True:
-        daemon.tick()
-        if args.once:
-            return 0
-        time.sleep(args.collect_interval)
+    http_server = None
+    if args.debug_port is not None:
+        from koordinator_tpu.metrics.components import (
+            KOORDLET_EXTERNAL_METRICS,
+            KOORDLET_INTERNAL_METRICS,
+        )
+        from koordinator_tpu.metrics.registry import MergedGatherer
+        from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+        # internal + external sets on one endpoint (merged_gather.go)
+        http_server = DebugHTTPServer(
+            metrics=MergedGatherer([KOORDLET_INTERNAL_METRICS,
+                                    KOORDLET_EXTERNAL_METRICS]),
+            auditor=daemon.auditor,
+            port=args.debug_port,
+        ).start()
+        print(f"debug http on 127.0.0.1:{http_server.port}")
+    try:
+        while True:
+            daemon.tick()
+            if args.once:
+                return 0
+            time.sleep(args.collect_interval)
+    finally:
+        if http_server is not None:
+            http_server.stop()
 
 
 if __name__ == "__main__":

@@ -15,6 +15,9 @@ registries this framework already keeps:
 - ``GET /apis/v1/plugins/<name>``   -> that service's JSON payload
 - ``PUT /debug/flags/s|f?value=1``  -> toggle score/filter dumps
 - ``GET /debug/dumps``              -> collected score/filter dumps
+- ``GET /audit?group=&subject=&operation=&since=&limit=``
+                                    -> koordlet audit query
+                                       (pkg/koordlet/audit HTTP endpoint)
 """
 
 from __future__ import annotations
@@ -31,10 +34,11 @@ class DebugHTTPServer:
     gatherer (anything with ``gather() -> str``) on one port."""
 
     def __init__(self, services=None, debug=None, metrics=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 auditor=None, host: str = "127.0.0.1", port: int = 0):
         self.services = services
         self.debug = debug
         self.metrics = metrics
+        self.auditor = auditor
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -84,6 +88,24 @@ class DebugHTTPServer:
                         return self._send(404, json.dumps(
                             {"error": f"unknown plugin {name!r}"}))
                     return self._send(200, json.dumps(payload, default=str))
+                if path == "/audit":
+                    if outer.auditor is None:
+                        return self._send(404, "no auditor", "text/plain")
+                    q = parse_qs(urlparse(self.path).query)
+
+                    def one(key):
+                        return q.get(key, [None])[0]
+
+                    events = outer.auditor.query(
+                        group=one("group"), subject=one("subject"),
+                        operation=one("operation"),
+                        since=float(one("since")) if one("since") else None,
+                        limit=int(one("limit")) if one("limit") else None,
+                    )
+                    import dataclasses as _dc
+
+                    return self._send(200, json.dumps(
+                        [_dc.asdict(e) for e in events]))
                 if path == "/debug/dumps":
                     if outer.debug is None:
                         return self._send(404, "no debug recorder",
@@ -113,7 +135,7 @@ class DebugHTTPServer:
                     "/debug/flags/s", "/debug/flags/f"
                 ):
                     raw = parse_qs(parsed.query).get("value", ["1"])[0]
-                    on = raw not in ("0", "false", "off")
+                    on = raw.lower() not in ("0", "false", "off")
                     if path.endswith("/s"):
                         outer.debug.dump_scores = on
                     else:

@@ -56,7 +56,12 @@ def stored_raw_allocatable(node: NodeSpec) -> Optional[dict]:
     for key, value in parsed.items():
         for r in SUPPORTED:
             if key in (r.name.lower(), str(int(r))):
-                out[r] = int(value)
+                try:
+                    out[r] = int(value)
+                except (ValueError, TypeError):
+                    # corrupt annotation: treat as never-recorded — a
+                    # bad value must not crash admission
+                    return None
     return out or None
 
 

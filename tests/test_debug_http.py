@@ -81,3 +81,38 @@ def test_debug_flag_toggles_collect_dumps(served_scheduler):
     status, body = _put(server.port, "/debug/flags/s?value=0")
     assert json.loads(body) == {"enabled": False}
     assert s.debug.dump_scores is False
+
+
+def test_audit_query_endpoint():
+    """pkg/koordlet/audit's HTTP query: filters + limit round-trip."""
+    from koordinator_tpu.koordlet.audit import Auditor
+
+    auditor = Auditor(clock=lambda: 100.0)
+    auditor.log("qosmanager/cpusuppress", "kubepods/besteffort",
+                "suppress", "cpus=4")
+    auditor.log("resourceexecutor", "kubepods/podx", "update", "cfs=200000")
+    server = DebugHTTPServer(auditor=auditor).start()
+    try:
+        _, body = _get(server.port, "/audit")
+        events = json.loads(body)
+        assert len(events) == 2 and events[0]["operation"] == "update"
+        _, body = _get(server.port,
+                       "/audit?group=qosmanager/cpusuppress&limit=5")
+        events = json.loads(body)
+        assert len(events) == 1 and events[0]["detail"] == "cpus=4"
+    finally:
+        server.stop()
+
+
+def test_handler_error_returns_500():
+    class Boom:
+        def names(self):
+            raise RuntimeError("dictionary changed size during iteration")
+
+    server = DebugHTTPServer(services=Boom()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.port, "/apis/v1/plugins")
+        assert e.value.code == 500
+    finally:
+        server.stop()

@@ -512,3 +512,23 @@ def test_raw_survives_serialization_via_annotation():
     NodeMutatingWebhook().mutate(echoed, old_node=old)
     assert echoed.allocatable[R.CPU] == 48000   # 32000*1.5, NOT 72000
     assert echoed.raw_allocatable[R.CPU] == 32000
+
+
+def test_corrupt_raw_annotation_does_not_crash_admission():
+    """A garbage raw-allocatable annotation value falls back to
+    never-recorded instead of raising (code-review regression)."""
+    import json
+
+    from koordinator_tpu.apis.extension import (
+        ANNOTATION_NODE_RAW_ALLOCATABLE,
+    )
+    from koordinator_tpu.webhook import NodeMutatingWebhook
+    from koordinator_tpu.webhook.node import stored_raw_allocatable
+
+    old = _ratio_node(cpu=48000)
+    old.annotations[ANNOTATION_NODE_RAW_ALLOCATABLE] = json.dumps(
+        {"cpu": "garbage"})
+    assert stored_raw_allocatable(old) is None
+    echoed = _ratio_node(cpu=48000)
+    NodeMutatingWebhook().mutate(echoed, old_node=old)  # no crash
+    assert echoed.raw_allocatable[R.CPU] == 48000       # treated as raw
