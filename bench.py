@@ -18,6 +18,8 @@ reports ``{pods_per_sec, p99_s, identical_to_oracle}``:
    resolution vs the scan solver, winner kept, bit-identity enforced;
 5. Descheduler LoadAware rebalance sweep, 5k nodes / 30k pods, checked
    against a numpy re-derivation;
+6. (extra) NUMA-policy cluster, 3k pods x 1.5k nodes — in-kernel NUMA
+   scoring/consumption vs the scan, bit-identity enforced;
 plus a ``sharded`` entry: multi-device solve throughput when >1 device
 is attached, else the 8-device virtual-CPU dryrun wall time (smoke).
 
@@ -416,6 +418,57 @@ def bench_gang(repeats):
     }
 
 
+def bench_numa(repeats):
+    """Extra matrix entry: NUMA-policy cluster (topology-aligned scoring
+    + consumption in-solve), kernel vs scan, identity enforced."""
+    import jax
+    import jax.numpy as jnp
+
+    from koordinator_tpu.ops.binpack import (
+        NumaAux,
+        SolverConfig,
+        solve_batch,
+    )
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    n_nodes, n_pods = 1500, 3000
+    state, pods, params = _problem(n_nodes, n_pods, seed=6)
+    rng = np.random.default_rng(6)
+    cap = np.asarray(state.alloc)
+    free = (cap * rng.uniform(0.3, 1.0, cap.shape)).astype(np.int32)
+    state = state._replace(numa_cap=jnp.asarray(cap),
+                           numa_free=jnp.asarray(free))
+    pods = pods._replace(has_numa_policy=jnp.asarray(
+        rng.uniform(size=n_pods) < 0.4))
+    aux = NumaAux(node_policy=jnp.asarray(rng.uniform(size=n_nodes) < 0.5))
+    config = SolverConfig()
+    scan = jax.jit(lambda s, p, pr, a: (lambda r: (r.assign, r.numa_consumed,
+                                                   r.node_state.numa_free))(
+        solve_batch(s, p, pr, config, numa=a)))
+    kern = lambda s, p, pr, a: (lambda r: (r.assign, r.numa_consumed,
+                                           r.node_state.numa_free))(
+        pallas_solve_batch(s, p, pr, config, numa_aux=a))
+
+    def cmp_tuple(a, b):
+        return all(bool((np.asarray(x) == np.asarray(y)).all())
+                   for x, y in zip(a, b))
+
+    best, _warm, out, solver, win, scan_best = _pick_kernel_or_scan(
+        scan, kern, repeats, (state, pods, params, aux), cmp_tuple
+    )
+    p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, aux),
+                 max(20, repeats))
+    return {
+        "pods_per_sec": n_pods / best,
+        "p99_s": p99_s,
+        "identical_kernel_vs_scan": True,  # enforced by _pick (loud warn)
+        "solver": solver,
+        "scan_pods_per_sec": n_pods / scan_best,
+        "wall_s": best,
+        "consumed": int(np.asarray(out[1]).sum()),
+    }
+
+
 def bench_rebalance(repeats):
     import jax
     import jax.numpy as jnp
@@ -526,6 +579,7 @@ def main():
         matrix["3_quota_5k_50q_1k"] = bench_quota(repeats)
         matrix["4_gang_200x32"] = bench_gang(repeats)
         matrix["5_rebalance_5kx30k"] = bench_rebalance(repeats)
+        matrix["6_numa_3kx1500"] = bench_numa(repeats)
     if os.environ.get("KTPU_BENCH_SHARDED", "1") != "0":
         matrix["sharded"] = bench_sharded(repeats)
 

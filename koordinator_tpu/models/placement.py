@@ -526,7 +526,6 @@ class PlacementModel:
         kernel_ok = (
             extras is None
             and resv_arrays is None
-            and numa_aux is None
             # empty solves take solve_batch's shape early-out; they must
             # not trip the kernel's fallback breaker
             and state.alloc.shape[0] > 0
@@ -540,7 +539,7 @@ class PlacementModel:
             try:
                 result = pallas_solve_batch(
                     state, batch, self.params, self.config,
-                    quota_state, gang_state,
+                    quota_state, gang_state, numa_aux,
                 )
                 self.last_solver = "pallas"
                 return result

@@ -32,11 +32,19 @@ individually and resolves all-or-nothing at batch end, so the same
 ``gang_outcomes``/``release_rejected`` XLA ops run on the kernel's
 outputs — identical by construction.
 
+**NUMA scoring/consumption runs inside the kernel** too: ``numa_free``
+is one more ``[R, N]`` VMEM carry beside ``used``; the per-pod
+least/most-allocated score divides by the requested-resource count with
+the same two-step floor correction, and the winner's consumption
+(pod-policy OR node-policy gated) subtracts in place — reference
+semantics nodenumaresource/scoring.go via ops/binpack.numa_node_score.
+
 Supported configuration (checked by :func:`pallas_supported`):
 ``score_according_prod=False``, unit plugin weights, zero prod
-thresholds; quota and gang states are covered, reservation/extras/NUMA
-still ride the scan. Reference semantics: elasticquota plugin.go:210-255
-(admission), coscheduling core/core.go:358-385 (batch-end gang gate).
+thresholds; quota, gang, and NUMA states are covered,
+reservation/extras still ride the scan. Reference semantics:
+elasticquota plugin.go:210-255 (admission), coscheduling
+core/core.go:358-385 (batch-end gang gate).
 """
 
 from __future__ import annotations
@@ -61,7 +69,9 @@ from koordinator_tpu.ops.common import floor_div_exact, percent_rounded
 CHUNK = 128
 
 
-def _make_kernel(R: int, wsum: int, use_quota: bool):
+def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
+                 most_allocated: bool = False):
+    MOST_ALLOCATED = most_allocated
     def kernel(*refs):
         it = iter(refs)
         req_ref, est_ref, flags_ref = next(it), next(it), next(it)  # SMEM
@@ -72,13 +82,20 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
         if use_quota:
             qmin_ref, qrt_ref, qused0_ref, qnp0_ref = (
                 next(it), next(it), next(it), next(it))
+        if use_numa:
+            ncap_ref, nrecip_ref, npol_ref, nfree0_ref = (
+                next(it), next(it), next(it), next(it))
         assign_ref, used_out_ref, est_out_ref, prod_out_ref = (
             next(it), next(it), next(it), next(it))
         if use_quota:
             qused_out_ref, qnp_out_ref = next(it), next(it)
+        if use_numa:
+            consumed_ref, nfree_out_ref = next(it), next(it)
         used_ref, estx_ref, prod_ref = next(it), next(it), next(it)
         if use_quota:
             qused_ref, qnp_ref = next(it), next(it)
+        if use_numa:
+            nfree_ref = next(it)
         c = pl.program_id(0)
 
         @pl.when(c == 0)
@@ -89,6 +106,8 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
             if use_quota:
                 qused_ref[...] = qused0_ref[...]
                 qnp_ref[...] = qnp0_ref[...]
+            if use_numa:
+                nfree_ref[...] = nfree0_ref[...]
 
         alloc = alloc_ref[...]
         recip = recip_ref[...]
@@ -109,6 +128,10 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
             # single (8, 128k) tile instead of a row-padded [Q, 128]
             Qp = qmin.shape[1]
             qlane = jax.lax.broadcasted_iota(jnp.int32, (1, Qp), 1)
+        if use_numa:
+            ncap = ncap_ref[...]
+            nrecip = nrecip_ref[...]
+            npol = npol_ref[...].astype(jnp.bool_)   # [1,N]
 
         def exact_div(y):
             # the shared exact reciprocal-multiply floor division — plain
@@ -142,6 +165,30 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
             is_ds = flags_ref[j, 0] > 0
             is_prod = flags_ref[j, 1] > 0
             mask = fit & (is_ds | ~fresh | la_ok)
+            score = s1 + s2
+
+            if use_numa:
+                # in-scan NUMA least/most-allocated score
+                # (ops/binpack.numa_node_score) over the VMEM-resident
+                # free carry; the divisor is the requested-resource
+                # count w <= R, pinned exact by the same two-step
+                # floor correction as floor_div_exact
+                nfree = nfree_ref[...]
+                member = req_v > 0                   # [R,1]
+                nreq = ncap - nfree + req_v          # [R,N]
+                numer = (
+                    nreq if MOST_ALLOCATED else (ncap - nreq)
+                ) * 100
+                per = floor_div_exact(numer, ncap, nrecip)
+                per = jnp.where(
+                    member & (ncap > 0) & (nreq <= ncap), per, 0
+                )
+                psum = jnp.sum(per, axis=0, keepdims=True)  # [1,N]
+                w = jnp.sum(member.astype(jnp.int32))
+                nscore = floor_div_exact(
+                    psum, w, 1.0 / jnp.maximum(w, 1).astype(jnp.float32)
+                )
+                score = score + jnp.where(w > 0, nscore, 0)
 
             if use_quota:
                 # masked admission (ops/quota.quota_admit): on the pod's
@@ -163,13 +210,13 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
                 mask = mask & admit
 
             # single-reduction argmax: pack (score, first-occurrence
-            # tie-break) into one int32 — score <= 200 (two
-            # 100-capped weighted means), lane < 8192, so
-            # score<<13 | (8191-lane) fits with room; max of the pack
-            # IS the max score at its smallest lane. Halves the
-            # [1,N]-to-scalar reductions vs max-then-min-where.
+            # tie-break) into one int32 — score <= 300 (three
+            # 100-capped weighted means: fit, loadaware, numa), lane <
+            # 8192, so score<<13 | (8191-lane) fits with room; max of
+            # the pack IS the max score at its smallest lane. Halves
+            # the [1,N]-to-scalar reductions vs max-then-min-where.
             packed = jnp.where(
-                mask, ((s1 + s2) << 13) | (8191 - lane), -1
+                mask, (score << 13) | (8191 - lane), -1
             )
             m = jnp.max(packed)
             ok = m >= 0
@@ -186,6 +233,17 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
                 addq = jnp.where(sel & ok & (qid >= 0), req_v, 0)
                 qused_ref[...] = qused + addq
                 qnp_ref[...] = qnp + jnp.where(non_pre, addq, 0)
+            if use_numa:
+                # consume numa_free iff the pod OR the winning node
+                # declares a topology policy (solve_batch's consume)
+                pod_numa = flags_ref[j, 4] > 0
+                consume_lane = hit & (pod_numa | npol)    # [1,N]
+                nfree_ref[...] = nfree - jnp.where(consume_lane, req_v, 0)
+                did = (jnp.max(jnp.where(consume_lane, 1, 0)) > 0)
+                consumed_ref[...] = jnp.where(
+                    chunk_lane == j, did.astype(jnp.int32),
+                    consumed_ref[...],
+                )
             return 0
 
         jax.lax.fori_loop(0, CHUNK, body, 0)
@@ -195,6 +253,8 @@ def _make_kernel(R: int, wsum: int, use_quota: bool):
         if use_quota:
             qused_out_ref[...] = qused_ref[...]
             qnp_out_ref[...] = qnp_ref[...]
+        if use_numa:
+            nfree_out_ref[...] = nfree_ref[...]
 
     return kernel
 
@@ -210,16 +270,22 @@ def pallas_supported(params: ScoreParams, config) -> bool:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("wsum", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("wsum", "interpret", "most_allocated")
+)
 def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
-                  wsum: int, interpret: bool, quota=None):
-    """quota = None | (min[Q,R], runtime[Q,R], used[Q,R], np_used[Q,R]).
-    Returns (new_state, assign[P], qused[Q,R]|None, qnp[Q,R]|None)."""
+                  wsum: int, interpret: bool, quota=None, numa=None,
+                  most_allocated: bool = False):
+    """quota = None | (min[Q,R], runtime[Q,R], used[Q,R], np_used[Q,R]);
+    numa = None | (cap[N,R], free[N,R], node_policy[N]).
+    Returns (new_state, assign[P], qused[Q,R]|None, qnp[Q,R]|None,
+    consumed[P]|None) — the updated numa_free rides new_state."""
     n, r = state.alloc.shape
     p = pods.req.shape[0]
     N = ((n + 127) // 128) * 128
     P = ((p + CHUNK - 1) // CHUNK) * CHUNK
     use_quota = quota is not None
+    use_numa = numa is not None
 
     def padn(a2):
         return jnp.zeros((r, N), jnp.int32).at[:, :n].set(
@@ -247,7 +313,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     fresh = padmask(state.metric_fresh)
     reqs = jnp.zeros((P, r), jnp.int32).at[:p].set(pods.req)
     ests = jnp.zeros((P, r), jnp.int32).at[:p].set(pods.est)
-    flags = jnp.zeros((P, 4), jnp.int32)
+    flags = jnp.zeros((P, 5), jnp.int32)
     flags = flags.at[:p, 0].set(
         (pods.is_daemonset & ~pods.blocked).astype(jnp.int32)
     )
@@ -255,6 +321,8 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     flags = flags.at[:, 2].set(-1)
     flags = flags.at[:p, 2].set(pods.quota_id.astype(jnp.int32))
     flags = flags.at[:p, 3].set(pods.non_preemptible.astype(jnp.int32))
+    if use_numa and pods.has_numa_policy is not None:
+        flags = flags.at[:p, 4].set(pods.has_numa_policy.astype(jnp.int32))
     # padding pods (and host-blocked pods) can never fit
     blocked_req = jnp.int32(2**30)
     reqs = reqs.at[:p, 0].set(
@@ -267,7 +335,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     in_specs = [
         pl.BlockSpec((CHUNK, r), lambda c: (c, 0), memory_space=pltpu.SMEM),
         pl.BlockSpec((CHUNK, r), lambda c: (c, 0), memory_space=pltpu.SMEM),
-        pl.BlockSpec((CHUNK, 4), lambda c: (c, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((CHUNK, 5), lambda c: (c, 0), memory_space=pltpu.SMEM),
         full((r, N)), full((r, N)), full((r, N)),
         pl.BlockSpec((r, 1), lambda c: (0, 0)),
         full((1, N)), full((1, N)), full((1, N)),
@@ -307,9 +375,23 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
         out_specs += [full((r, Qp))] * 2
         out_shape += [jax.ShapeDtypeStruct((r, Qp), jnp.int32)] * 2
         scratch += [pltpu.VMEM((r, Qp), jnp.int32)] * 2
+    if use_numa:
+        ncap_in, nfree_in, npol_in = numa
+        ncap = padn(ncap_in)
+        nrecip = 1.0 / jnp.maximum(ncap, 1).astype(jnp.float32)
+        npol = padmask(npol_in)
+        nfree0 = padn(nfree_in)
+        args += [ncap, nrecip, npol, nfree0]
+        in_specs += [full((r, N)), full((r, N)), full((1, N)),
+                     full((r, N))]
+        out_specs += [pl.BlockSpec((1, CHUNK), lambda c: (0, c)),
+                      full((r, N))]
+        out_shape += [jax.ShapeDtypeStruct((1, P), jnp.int32),
+                      jax.ShapeDtypeStruct((r, N), jnp.int32)]
+        scratch += [pltpu.VMEM((r, N), jnp.int32)]
 
     out = pl.pallas_call(
-        _make_kernel(r, wsum, use_quota),
+        _make_kernel(r, wsum, use_quota, use_numa, most_allocated),
         grid=(P // CHUNK,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -317,25 +399,33 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
         scratch_shapes=scratch,
         interpret=interpret,
     )(*args)
+    out = list(out)
+    assign, used, est, prod = out[:4]
+    rest = out[4:]
+    qused = qnp = nfree = consumed = None
     if use_quota:
-        assign, used, est, prod, qused, qnp = out
-        qused, qnp = qused[:, :q].T, qnp[:, :q].T
-    else:
-        assign, used, est, prod = out
-        qused = qnp = None
+        qused, qnp = rest[0][:, :q].T, rest[1][:, :q].T
+        rest = rest[2:]
+    if use_numa:
+        consumed = rest[0][0, :p] > 0
+        nfree = rest[1][:, :n].T
     new_state = state._replace(
         used_req=used[:, :n].T,
         est_extra=est[:, :n].T,
         prod_base=prod[:, :n].T,
     )
-    return new_state, assign[0, :p], qused, qnp
+    if use_numa:
+        new_state = new_state._replace(numa_free=nfree)
+    return new_state, assign[0, :p], qused, qnp, consumed
 
 
 @functools.partial(
-    jax.jit, static_argnames=("wsum", "interpret", "has_gang")
+    jax.jit,
+    static_argnames=("wsum", "interpret", "has_gang", "most_allocated"),
 )
-def _solve_full(state, pods, params, quota_state, gang_state,
-                wsum: int, interpret: bool, has_gang: bool):
+def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
+                wsum: int, interpret: bool, has_gang: bool,
+                most_allocated: bool):
     """Kernel scan + the scan solver's exact post-batch epilogue (gang
     resolution, rejected releases) — one jitted program."""
     from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
@@ -348,8 +438,12 @@ def _solve_full(state, pods, params, quota_state, gang_state,
         quota_in = (
             quota_state.min, runtime, quota_state.used, quota_state.np_used
         )
-    new_state, assign, qused, qnp = _pallas_solve(
-        state, pods, params, wsum, interpret, quota_in
+    numa_in = None
+    if numa_aux is not None:
+        numa_in = (state.numa_cap, state.numa_free, numa_aux.node_policy)
+    new_state, assign, qused, qnp, consumed = _pallas_solve(
+        state, pods, params, wsum, interpret, quota_in, numa_in,
+        most_allocated,
     )
     final_qstate = (
         None if quota_state is None
@@ -368,7 +462,7 @@ def _solve_full(state, pods, params, quota_state, gang_state,
             raw_assign=assign,
             resv_vstar=None,
             resv_delta=None,
-            numa_consumed=None,
+            numa_consumed=consumed,
         )
     commit, waiting, rejected = gang_outcomes(assign, pods.gang_id, gang_state)
     used_req, est_extra, prod_base = release_rejected(
@@ -384,6 +478,16 @@ def _solve_full(state, pods, params, quota_state, gang_state,
     new_state = new_state._replace(
         used_req=used_req, est_extra=est_extra, prod_base=prod_base
     )
+    if numa_aux is not None:
+        # restore rejected pods' NUMA consumption (solve_batch's tail)
+        n = new_state.used_req.shape[0]
+        take = rejected & consumed
+        nidx = jnp.where(take, assign, n)
+        back = jnp.where(take[:, None], pods.req, 0)
+        new_state = new_state._replace(
+            numa_free=new_state.numa_free
+            + jax.ops.segment_sum(back, nidx, num_segments=n + 1)[:n]
+        )
     out_assign = jnp.where(commit | waiting, assign, -1).astype(jnp.int32)
     if final_qstate is not None:
         # release rejected pods' quota accounting (solve_batch's tail)
@@ -407,7 +511,7 @@ def _solve_full(state, pods, params, quota_state, gang_state,
         raw_assign=assign,
         resv_vstar=None,
         resv_delta=None,
-        numa_consumed=None,
+        numa_consumed=consumed,
     )
 
 
@@ -418,11 +522,13 @@ def pallas_solve_batch(
     config,
     quota_state=None,
     gang_state=None,
+    numa_aux=None,
     interpret: Optional[bool] = None,
 ) -> SolveResult:
     """Drop-in for ``solve_batch`` on the kernel paths (plain, quota,
-    gang, quota+gang). Raises ValueError for unsupported configurations —
-    callers gate on :func:`pallas_supported`."""
+    gang, NUMA, and their combinations). Raises ValueError for
+    unsupported configurations — callers gate on
+    :func:`pallas_supported`."""
     if not pallas_supported(params, config):
         raise ValueError("configuration not supported by the pallas kernel")
     if state.alloc.shape[0] == 0 or pods.req.shape[0] == 0:
@@ -430,12 +536,16 @@ def pallas_solve_batch(
     if state.alloc.shape[0] > 8192:
         # the packed single-reduction argmax carries the lane in 13 bits
         raise ValueError("more than 8192 nodes: use the scan solver")
+    if numa_aux is not None and (
+        state.numa_cap is None or state.numa_free is None
+    ):
+        raise ValueError("numa_aux requires NodeState.numa_cap/numa_free")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     wsum = int(np.asarray(params.weights).sum()) or 1
     return _solve_full(
-        state, pods, params, quota_state, gang_state, wsum, interpret,
-        gang_state is not None,
+        state, pods, params, quota_state, gang_state, numa_aux, wsum,
+        interpret, gang_state is not None, bool(config.numa_most_allocated),
     )
 
 

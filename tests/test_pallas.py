@@ -213,6 +213,70 @@ def _assert_result_identical(got, want):
                 np.asarray(getattr(want.quota_state, field)), err_msg=field)
 
 
+def _numa_setup(state, pods, seed=7, most=False):
+    """NUMA arrays + aux: mixed node policies, mixed pod policies."""
+    from koordinator_tpu.ops.binpack import NumaAux
+
+    rng = np.random.default_rng(seed)
+    n = state.alloc.shape[0]
+    cap = np.asarray(state.alloc)
+    free = (cap * rng.uniform(0.3, 1.0, cap.shape)).astype(np.int32)
+    state = state._replace(
+        numa_cap=jnp.asarray(cap), numa_free=jnp.asarray(free)
+    )
+    pods = pods._replace(
+        has_numa_policy=jnp.asarray(
+            rng.uniform(size=pods.req.shape[0]) < 0.4)
+    )
+    aux = NumaAux(node_policy=jnp.asarray(rng.uniform(size=n) < 0.5))
+    return state, pods, aux
+
+
+def _assert_numa_identical(got, want):
+    _assert_result_identical(got, want)
+    np.testing.assert_array_equal(
+        np.asarray(got.numa_consumed), np.asarray(want.numa_consumed))
+    np.testing.assert_array_equal(
+        np.asarray(got.node_state.numa_free),
+        np.asarray(want.node_state.numa_free))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("most", [False, True])
+def test_numa_identical_to_scan(seed, most):
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    state, pods, aux = _numa_setup(state, pods, seed=seed + 7, most=most)
+    config = SolverConfig(numa_most_allocated=most)
+    want = solve_batch(state, pods, params, config, numa=aux)
+    got = pallas_solve_batch(state, pods, params, config, numa_aux=aux,
+                             interpret=True)
+    _assert_numa_identical(got, want)
+    assert int(np.asarray(want.numa_consumed).sum()) > 0
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_numa_quota_gang_identical_to_scan(seed):
+    """The full kernel feature set at once: quota + gang + NUMA."""
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    pods, qstate = _quota_setup(state, pods, seed=seed + 5)
+    pods, gstate = _gang_setup(pods, seed=seed + 6)
+    state, pods, aux = _numa_setup(state, pods, seed=seed + 7)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, qstate, gstate,
+                       numa=aux)
+    got = pallas_solve_batch(state, pods, params, config, qstate, gstate,
+                             numa_aux=aux, interpret=True)
+    _assert_numa_identical(got, want)
+    # gang rejections exercised the NUMA release path
+    assert int(np.asarray(want.rejected).sum()) > 0
+
+
 def test_quota_many_groups_identical_to_scan():
     """>128 quota groups exercises the multi-tile lane padding of the
     [R, Qp] quota layout (groups on lanes)."""
