@@ -1,0 +1,272 @@
+"""Vectorized host oracle: the sequential reference semantics at full scale.
+
+``oracle/placement.py`` transliterates the reference's per-pod cycle into
+Python scalars — authoritative but O(P*N*R) in interpreter time, which
+capped oracle identity checks at reduced shapes. This module is the SAME
+sequential semantics (pod-by-pod, each pod seeing all prior placements,
+lowest-index tie-break) with the inner node loop vectorized in numpy
+int64 — exact integer arithmetic, no float anywhere — fast enough to run
+every BASELINE matrix config at its FULL shape.
+
+Authority chain: scalar oracle (oracle/placement.py, transliterated from
+pkg/scheduler/plugins/loadaware/load_aware.go:123-397 and SURVEY.md
+Appendix A) == this module (tests/test_oracle_vectorized.py differential
+sweep) == device scan == pallas kernel. The bench checks device output
+against THIS oracle at full BASELINE shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _i64(x) -> np.ndarray:
+    return np.asarray(x).astype(np.int64)
+
+
+def oracle_args(state, pods, params) -> tuple:
+    """Unpack (NodeState, PodBatch, ScoreParams) device structures into the
+    positional numpy argument tuple shared by schedule_vectorized and the
+    scalar oracle — the single adapter, so callers can't drift."""
+    return (
+        np.asarray(state.alloc), np.asarray(state.used_req),
+        np.asarray(state.usage), np.asarray(state.prod_usage),
+        np.asarray(state.est_extra), np.asarray(state.prod_base),
+        np.asarray(state.metric_fresh), np.asarray(state.schedulable),
+        np.asarray(pods.req), np.asarray(pods.est),
+        np.asarray(pods.is_prod), np.asarray(pods.is_daemonset),
+        np.asarray(params.weights), np.asarray(params.thresholds),
+        np.asarray(params.prod_thresholds),
+    )
+
+
+def _percent_rounded(used: np.ndarray, total: np.ndarray) -> np.ndarray:
+    """Exact-rational round(used/total*100), half away from zero
+    (oracle/scheduler.py percent_rounded, vectorized)."""
+    total_safe = np.maximum(total, 1)
+    pct = (200 * used + total_safe) // (2 * total_safe)
+    return np.where(total > 0, pct, 0)
+
+
+def _least_requested(requested: np.ndarray, capacity: np.ndarray) -> np.ndarray:
+    """(cap-req)*100//cap; 0 when cap==0 or req>cap (load_aware.go:388)."""
+    cap_safe = np.maximum(capacity, 1)
+    score = (capacity - requested) * 100 // cap_safe
+    return np.where((capacity == 0) | (requested > capacity), 0, score)
+
+
+class VectorQuota:
+    """Single-level quota accounting over [Q,R] int64 arrays, semantics of
+    oracle/placement.py SequentialQuota (itself mirroring SURVEY.md
+    A.3/A.4) with the per-pod admit vectorized."""
+
+    def __init__(self, min_, max_, auto_min, weight, allow_lent, total):
+        self.min = _i64(min_)
+        self.max = _i64(max_)
+        self.auto_min = _i64(auto_min)
+        self.weight = _i64(weight)
+        self.allow_lent = np.asarray(allow_lent, dtype=bool)
+        self.total = _i64(total)
+        q, r = self.min.shape
+        self.child_request = np.zeros((q, r), dtype=np.int64)
+        self.used = np.zeros((q, r), dtype=np.int64)
+        self.np_used = np.zeros((q, r), dtype=np.int64)
+
+    def register_requests(self, pod_req, quota_ids):
+        quota_ids = np.asarray(quota_ids)
+        sel = quota_ids >= 0
+        np.add.at(self.child_request, quota_ids[sel], _i64(pod_req)[sel])
+
+    def runtime(self) -> np.ndarray:
+        from koordinator_tpu.quota.core import water_filling
+
+        real = self.child_request.copy()
+        real[~self.allow_lent] = np.maximum(
+            real[~self.allow_lent], self.min[~self.allow_lent]
+        )
+        req = np.minimum(real, self.max)
+        runtime = np.zeros_like(req)
+        for d in range(req.shape[1]):
+            runtime[:, d] = water_filling(
+                int(self.total[d]),
+                req[:, d],
+                self.min[:, d],
+                self.auto_min[:, d],
+                self.weight[:, d],
+                self.allow_lent,
+                exact_rational=True,
+            )
+        return np.minimum(runtime, self.max)
+
+    def admit(self, quota_id, pod_req, non_preemptible, runtime_all):
+        if quota_id < 0:
+            return True
+        dims = pod_req > 0
+        if np.any(
+            (self.used[quota_id] + pod_req)[dims] > runtime_all[quota_id][dims]
+        ):
+            return False
+        if non_preemptible and np.any(
+            (self.np_used[quota_id] + pod_req)[dims] > self.min[quota_id][dims]
+        ):
+            return False
+        return True
+
+    def assume(self, quota_id, pod_req, non_preemptible):
+        if quota_id < 0:
+            return
+        self.used[quota_id] += pod_req
+        if non_preemptible:
+            self.np_used[quota_id] += pod_req
+
+
+def schedule_vectorized(
+    alloc,
+    used_req,
+    usage,
+    prod_usage,
+    est_extra,
+    prod_base,
+    metric_fresh,
+    schedulable,
+    pod_req,
+    pod_est,
+    pod_is_prod,
+    pod_is_daemonset,
+    weights,
+    thresholds,
+    prod_thresholds,
+    fit_weight: int = 1,
+    loadaware_weight: int = 1,
+    score_according_prod: bool = False,
+    pod_quota_id=None,
+    pod_non_preemptible=None,
+    quota: Optional[VectorQuota] = None,
+) -> np.ndarray:
+    """[P] node index per pod (-1 = unschedulable) — identical output to
+    oracle/placement.py schedule_sequential / schedule_sequential_quota."""
+    alloc = _i64(alloc)
+    used_req = _i64(used_req).copy()
+    usage = _i64(usage)
+    prod_usage = _i64(prod_usage)
+    est_extra = _i64(est_extra).copy()
+    prod_base = _i64(prod_base).copy()
+    metric_fresh = np.asarray(metric_fresh, dtype=bool)
+    schedulable = np.asarray(schedulable, dtype=bool)
+    pod_req = _i64(pod_req)
+    pod_est = _i64(pod_est)
+    pod_is_prod = np.asarray(pod_is_prod, dtype=bool)
+    pod_is_daemonset = np.asarray(pod_is_daemonset, dtype=bool)
+    weights = _i64(weights)
+    thresholds = _i64(thresholds)
+    prod_thresholds = _i64(prod_thresholds)
+
+    # The LoadAware filter reads only static state (usage/prod_usage and
+    # the reported allocatable), so the per-node violation masks for both
+    # pod modes are computed once for the whole batch (A.1).
+    usage_pct = _percent_rounded(usage, alloc)
+    prod_pct = _percent_rounded(prod_usage, alloc)
+    checkable = alloc > 0
+    viol_nonprod = (
+        checkable & (thresholds > 0) & (usage_pct >= thresholds)
+    ).any(axis=1)
+    viol_prod = (
+        checkable & (prod_thresholds > 0) & (prod_pct >= prod_thresholds)
+    ).any(axis=1)
+    prod_cfg = bool((prod_thresholds > 0).any())
+
+    weight_sum = max(int(weights.sum()), 1)
+    n = alloc.shape[0]
+    n_pods = pod_req.shape[0]
+    assignments = np.full(n_pods, -1, dtype=np.int64)
+
+    use_q = quota is not None
+    runtime_all = None
+    if use_q:
+        quota.register_requests(pod_req, pod_quota_id)
+        runtime_all = quota.runtime()
+
+    for p in range(n_pods):
+        req = pod_req[p]
+        est = pod_est[p]
+        is_prod = bool(pod_is_prod[p])
+        if use_q and not quota.admit(
+            int(pod_quota_id[p]), req, bool(pod_non_preemptible[p]), runtime_all
+        ):
+            continue
+
+        mask = schedulable & ((req == 0) | (used_req + req <= alloc)).all(axis=1)
+        if not bool(pod_is_daemonset[p]):
+            viol = viol_prod if (is_prod and prod_cfg) else viol_nonprod
+            mask = mask & ~(metric_fresh & viol)
+        if not mask.any():
+            continue
+
+        fit_per = _least_requested(used_req + req, alloc)
+        fit_score = (fit_per * weights).sum(axis=1) // weight_sum
+        la_base = (
+            prod_base
+            if (score_according_prod and is_prod)
+            else usage + est_extra
+        )
+        la_per = _least_requested(la_base + est, alloc)
+        la_score = np.where(
+            metric_fresh, (la_per * weights).sum(axis=1) // weight_sum, 0
+        )
+        score = fit_weight * fit_score + loadaware_weight * la_score
+
+        cand = np.where(mask, score, -1)
+        best = int(cand.argmax())  # lowest index among ties
+        if cand[best] < 0:
+            continue
+        assignments[p] = best
+        used_req[best] += req
+        est_extra[best] += est
+        if is_prod:
+            prod_base[best] += est
+        if use_q:
+            quota.assume(int(pod_quota_id[p]), req, bool(pod_non_preemptible[p]))
+    return assignments
+
+
+def gang_outcomes_np(
+    assignments: np.ndarray,  # [P] raw scan assignment
+    gang_id: np.ndarray,      # [P] int, -1 = not gang-managed
+    min_member: np.ndarray,   # [G]
+    bound_count=None,         # [G]
+    strict=None,              # [G] bool
+    group_id=None,            # [G]
+) -> tuple:
+    """Numpy re-derivation of ops/gang.py gang_outcomes (SURVEY.md A.5
+    batch-end resolution): (commit[P], waiting[P], rejected[P])."""
+    assignments = np.asarray(assignments)
+    gang_id = np.asarray(gang_id)
+    min_member = _i64(min_member)
+    g = min_member.shape[0]
+    bound_count = (
+        _i64(bound_count) if bound_count is not None else np.zeros(g, np.int64)
+    )
+    strict = (
+        np.asarray(strict, bool) if strict is not None else np.ones(g, bool)
+    )
+    group_id = (
+        np.asarray(group_id) if group_id is not None else np.arange(g)
+    )
+    placed = assignments >= 0
+    member_placed = placed & (gang_id >= 0)
+    placed_per_gang = np.bincount(
+        gang_id[member_placed], minlength=g
+    ).astype(np.int64)
+    valid = (placed_per_gang + bound_count) >= min_member
+    group_invalid = np.bincount(
+        group_id, weights=(~valid).astype(np.int64), minlength=g
+    )
+    gang_ok = group_invalid[group_id] == 0
+    gid = np.maximum(gang_id, 0)
+    pod_gang_ok = gang_ok[gid]
+    commit = placed & ((gang_id < 0) | pod_gang_ok)
+    waiting = member_placed & ~pod_gang_ok & ~strict[gid]
+    rejected = member_placed & ~pod_gang_ok & strict[gid]
+    return commit, waiting, rejected

@@ -1,0 +1,84 @@
+"""Full-BASELINE-scale correctness: sharded == unsharded == host oracle.
+
+VERDICT r3 #1: every headline number previously rested on reduced-shape
+oracle checks; a bug manifesting only past tile boundaries or at 5k-node
+padding would have shipped. These tests run the flagship shape (10k pods
+x 5k nodes) end-to-end:
+
+- the single-device scan must equal the vectorized host oracle
+  (sequential reference semantics, oracle/vectorized.py), and
+- the 8-device virtual-CPU-mesh solve (GSPMD cross-shard argmax and
+  all) must be bit-identical to the single-device scan — cross-shard
+  tie-breaks included.
+
+Slowest tests in the suite (~30 s total on CPU); they are the ones that
+make the 100k pods/s headline a proven number rather than an
+extrapolation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from __graft_entry__ import _example_problem
+from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+
+FLAGSHIP_NODES = 5000
+FLAGSHIP_PODS = 10000
+
+
+@pytest.fixture(scope="module")
+def flagship_problem():
+    return _example_problem(FLAGSHIP_NODES, FLAGSHIP_PODS)
+
+
+@pytest.fixture(scope="module")
+def single_device_solution(flagship_problem):
+    state, pods, params = flagship_problem
+    solve = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))
+    new_state, assign = solve(state, pods, params)
+    return np.asarray(assign), new_state
+
+
+def test_flagship_scan_matches_oracle_full_scale(
+    flagship_problem, single_device_solution
+):
+    from koordinator_tpu.oracle.vectorized import (
+        oracle_args,
+        schedule_vectorized,
+    )
+
+    state, pods, params = flagship_problem
+    assign, _ = single_device_solution
+    oracle = schedule_vectorized(*oracle_args(state, pods, params))
+    np.testing.assert_array_equal(assign, oracle)
+    assert (assign >= 0).sum() > 0
+
+
+def test_flagship_sharded_matches_single_device(
+    flagship_problem, single_device_solution
+):
+    from koordinator_tpu.parallel.mesh import (
+        make_mesh,
+        shard_node_state,
+        shard_solver,
+    )
+
+    state, pods, params = flagship_problem
+    want_assign, want_state = single_device_solution
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must force the 8-device CPU mesh"
+    mesh = make_mesh(devices[:8])
+    sstate = shard_node_state(state, mesh)
+    solve = shard_solver(mesh)
+    new_state, assign = solve(sstate, pods, params)
+    np.testing.assert_array_equal(np.asarray(assign), want_assign)
+    # the mutated node-side carry must agree too, not just the argmax
+    np.testing.assert_array_equal(
+        np.asarray(new_state.used_req), np.asarray(want_state.used_req)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(new_state.est_extra), np.asarray(want_state.est_extra)
+    )
+    assert len(new_state.used_req.devices()) == 8

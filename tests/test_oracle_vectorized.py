@@ -1,0 +1,149 @@
+"""Differential sweep: vectorized host oracle == scalar oracle == device scan.
+
+The vectorized oracle (oracle/vectorized.py) exists to prove device
+correctness at FULL BASELINE shapes; its own authority comes from exact
+agreement with the scalar transliteration (oracle/placement.py) across
+randomized problems covering every semantic branch: stale metrics,
+unschedulable nodes, prod thresholds + prod scoring mode, daemonset skip,
+quota admission, and gang batch-end resolution.
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.oracle.placement import (
+    SequentialQuota,
+    schedule_sequential,
+    schedule_sequential_quota,
+)
+from koordinator_tpu.oracle.vectorized import (
+    VectorQuota,
+    gang_outcomes_np,
+    schedule_vectorized,
+)
+
+
+def _rich_problem(n_nodes, n_pods, seed, prod_thresholds=False):
+    """Numpy problem with every branch exercised (stale metrics, cordoned
+    nodes, prod pods, daemonsets, near-full nodes)."""
+    rng = np.random.default_rng(seed)
+    r = 4
+    alloc = np.zeros((n_nodes, r), np.int64)
+    alloc[:, 0] = rng.choice([16000, 32000, 64000], n_nodes)
+    alloc[:, 1] = rng.choice([32768, 65536], n_nodes)
+    usage = (alloc * rng.uniform(0, 0.9, alloc.shape)).astype(np.int64)
+    used_req = (alloc * rng.uniform(0, 0.6, alloc.shape)).astype(np.int64)
+    prod_usage = (usage * rng.uniform(0, 1.0, usage.shape)).astype(np.int64)
+    est_extra = (alloc * rng.uniform(0, 0.1, alloc.shape)).astype(np.int64)
+    prod_base = prod_usage.copy()
+    metric_fresh = rng.uniform(size=n_nodes) < 0.9
+    schedulable = rng.uniform(size=n_nodes) < 0.95
+    req = np.zeros((n_pods, r), np.int64)
+    req[:, 0] = rng.choice([500, 1000, 2000, 4000], n_pods)
+    req[:, 1] = rng.choice([1024, 2048, 8192], n_pods)
+    est = (req * 85) // 100
+    is_prod = rng.uniform(size=n_pods) < 0.5
+    is_ds = rng.uniform(size=n_pods) < 0.05
+    weights = np.array([1, 1, 0, 0], np.int64)
+    thresholds = np.array([65, 95, 0, 0], np.int64)
+    prod_thr = (
+        np.array([55, 80, 0, 0], np.int64)
+        if prod_thresholds
+        else np.zeros(r, np.int64)
+    )
+    return (
+        alloc, used_req, usage, prod_usage, est_extra, prod_base,
+        metric_fresh, schedulable, req, est, is_prod, is_ds,
+        weights, thresholds, prod_thr,
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("prod_thr", [False, True])
+def test_vectorized_matches_scalar(seed, prod_thr):
+    args = _rich_problem(40, 120, seed, prod_thresholds=prod_thr)
+    want = schedule_sequential(*args)
+    got = schedule_vectorized(*args)
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_vectorized_matches_scalar_prod_scoring(seed):
+    args = _rich_problem(30, 80, seed, prod_thresholds=True)
+    want = schedule_sequential(*args, score_according_prod=True)
+    got = schedule_vectorized(*args, score_according_prod=True)
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+@pytest.mark.parametrize("seed", [7, 8, 9])
+def test_vectorized_quota_matches_scalar(seed):
+    n_nodes, n_pods, n_q = 30, 150, 6
+    args = _rich_problem(n_nodes, n_pods, seed)
+    rng = np.random.default_rng(seed + 100)
+    quota_id = rng.integers(-1, n_q, n_pods)
+    non_pre = rng.uniform(size=n_pods) < 0.3
+    total = args[0].sum(axis=0)
+    r = 4
+    mn = np.zeros((n_q, r), np.int64)
+    mx = np.zeros((n_q, r), np.int64)
+    mn[:, :2] = total[:2] // (3 * n_q)
+    mx[:, :2] = total[:2] // 4
+    qargs = (mn, mx, mn, mx, np.ones(n_q, bool), total)
+
+    sq = SequentialQuota(*qargs)
+    want = schedule_sequential_quota(
+        *args[:12], quota_id, non_pre, sq, args[12], args[13], args[14]
+    )
+    vq = VectorQuota(*qargs)
+    got = schedule_vectorized(
+        *args, pod_quota_id=quota_id, pod_non_preemptible=non_pre, quota=vq
+    )
+    np.testing.assert_array_equal(got, np.asarray(want))
+    np.testing.assert_array_equal(vq.used, sq.used)
+    np.testing.assert_array_equal(vq.np_used, sq.np_used)
+
+
+def test_vectorized_matches_device_scan():
+    """Anchor the vectorized oracle directly to the jitted scan."""
+    import jax
+
+    from __graft_entry__ import _example_problem
+    from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+
+    state, pods, params = _example_problem(80, 250, seed=11)
+    solve = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))
+    _, assign = solve(state, pods, params)
+    from koordinator_tpu.oracle.vectorized import oracle_args
+
+    got = schedule_vectorized(*oracle_args(state, pods, params))
+    np.testing.assert_array_equal(got, np.asarray(assign))
+
+
+@pytest.mark.parametrize("seed", [13, 14])
+def test_gang_outcomes_np_matches_device(seed):
+    import jax.numpy as jnp
+
+    from koordinator_tpu.ops.gang import GangState, gang_outcomes
+
+    rng = np.random.default_rng(seed)
+    g, p = 12, 200
+    gang_id = rng.integers(-1, g, p).astype(np.int32)
+    assignments = np.where(
+        rng.uniform(size=p) < 0.7, rng.integers(0, 50, p), -1
+    ).astype(np.int32)
+    min_member = rng.integers(1, 20, g)
+    bound = rng.integers(0, 3, g)
+    strict = rng.uniform(size=g) < 0.5
+    group = rng.integers(0, 5, g)
+    gs = GangState.build(
+        min_member=min_member, bound_count=bound, strict=strict, group_id=group
+    )
+    c, w, rj = gang_outcomes(jnp.asarray(assignments), jnp.asarray(gang_id), gs)
+    # gang_outcomes_np takes the densified group ids GangState.build produced
+    nc, nw, nrj = gang_outcomes_np(
+        assignments, gang_id, min_member, bound, strict,
+        np.asarray(gs.group_id),
+    )
+    np.testing.assert_array_equal(np.asarray(c), nc)
+    np.testing.assert_array_equal(np.asarray(w), nw)
+    np.testing.assert_array_equal(np.asarray(rj), nrj)
