@@ -25,6 +25,15 @@ class SchedulerConfig:
     fit_weight: int = 1
     loadaware_weight: int = 1
     score_according_prod: bool = False
+    #: LoadAware aggregated (percentile) mode — mirrors the reference's
+    #: LoadAwareSchedulingAggregatedArgs: filter substitutes the
+    #: percentile usage + these thresholds when both are set; score
+    #: substitutes the percentile base when aggregated_score_pct is set
+    aggregated_usage_thresholds: Optional[dict] = None
+    aggregated_usage_pct: Optional[int] = None
+    aggregated_usage_duration_seconds: Optional[float] = None
+    aggregated_score_pct: Optional[int] = None
+    aggregated_score_duration_seconds: Optional[float] = None
     cluster_total: Optional[dict] = None
     #: the north-star backend selector (reference: the plugin-factory
     #: wiring at cmd/koord-scheduler/app/server.go:331-398):
@@ -58,12 +67,27 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
         raise ValueError(
             f"unknown placement backend: {config.placement_backend!r}"
         )
+    aggregated = None
+    if (
+        config.aggregated_usage_pct is not None
+        or config.aggregated_score_pct is not None
+    ):
+        from koordinator_tpu.state.cluster import AggregatedArgs
+
+        aggregated = AggregatedArgs(
+            usage_thresholds=config.aggregated_usage_thresholds,
+            usage_pct=config.aggregated_usage_pct,
+            usage_duration_seconds=config.aggregated_usage_duration_seconds,
+            score_pct=config.aggregated_score_pct,
+            score_duration_seconds=config.aggregated_score_duration_seconds,
+        )
     model = PlacementModel(
         config=SolverConfig(
             fit_weight=config.fit_weight,
             loadaware_weight=config.loadaware_weight,
             score_according_prod=config.score_according_prod,
         ),
+        aggregated=aggregated,
         backend=backend,
         host_fallback_cells=(
             0 if backend is not None else config.host_fallback_cells

@@ -91,6 +91,12 @@ class NodeMetricReporter:
                 usage[ResourceName.MEMORY] = int(mem_row[agg])
             if usage:
                 metric.aggregated_usage[pct] = usage
+        if metric.aggregated_usage:
+            # the declared policy window, not the float-computed now-start
+            # difference: the scheduler's window selection compares exactly
+            metric.aggregated_duration = float(
+                policy.aggregate_duration_seconds if policy else 300
+            )
 
         # per-pod usage: ONE batched matrix reduction for all pods
         pods = self.informer.running_pods()

@@ -38,6 +38,7 @@ from koordinator_tpu.state.cluster import (
     DEFAULT_ESTIMATED_SCALING_FACTORS,
     DEFAULT_RESOURCE_WEIGHTS,
     DEFAULT_USAGE_THRESHOLDS,
+    AggregatedArgs,
     NodeArrays,
     PendingPodArrays,
     lower_nodes,
@@ -103,6 +104,7 @@ class PlacementModel:
         resource_weights=None,
         usage_thresholds=None,
         prod_usage_thresholds=None,
+        aggregated: Optional[AggregatedArgs] = None,
         scaling_factors=None,
         sharding: Optional[jax.sharding.Sharding] = None,
         fine: Optional[FineGrained] = None,
@@ -116,9 +118,17 @@ class PlacementModel:
         self.scaling_factors = dict(
             scaling_factors or DEFAULT_ESTIMATED_SCALING_FACTORS
         )
+        #: aggregated (percentile) LoadAware mode — when its filter side is
+        #: enabled, the filter threshold SET is the aggregated one and the
+        #: lowering substitutes the percentile usage (load_aware.go:157-186)
+        self.aggregated = aggregated
+        if aggregated is not None and aggregated.filter_enabled:
+            filter_thresholds = aggregated.usage_thresholds
+        else:
+            filter_thresholds = usage_thresholds or DEFAULT_USAGE_THRESHOLDS
         self.params = ScoreParams(
             weights=jnp.asarray(_vec(self.resource_weights)),
-            thresholds=jnp.asarray(_vec(usage_thresholds or DEFAULT_USAGE_THRESHOLDS)),
+            thresholds=jnp.asarray(_vec(filter_thresholds)),
             prod_thresholds=jnp.asarray(_vec(prod_usage_thresholds or {})),
         )
         self.sharding = sharding
@@ -218,6 +228,7 @@ class PlacementModel:
             snapshot,
             scaling_factors=self.scaling_factors,
             resource_weights=self.resource_weights,
+            aggregated=self.aggregated,
         )
         pod_arrays = lower_pending_pods(
             snapshot.pending_pods,

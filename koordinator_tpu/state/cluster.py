@@ -53,6 +53,65 @@ def go_round(x: float) -> int:
     return int(math.floor(x + 0.5))
 
 
+@dataclasses.dataclass
+class AggregatedArgs:
+    """LoadAware aggregated-usage (percentile) mode configuration.
+
+    Mirrors the reference's ``LoadAwareSchedulingAggregatedArgs``
+    (pkg/scheduler/apis/config/types.go): the Filter substitutes a
+    percentile usage + its own threshold set when ``usage_thresholds``
+    and ``usage_pct`` are both set (helper.go:92 filterWithAggregation);
+    the Score substitutes the percentile base when ``score_pct`` is set
+    (helper.go:96 scoreWithAggregation). Durations select the aggregation
+    window; None/0 means "the largest reported window" (helper.go:65).
+    Percentiles are 50/90/95/99 keys into NodeMetric.aggregated_usage.
+    """
+
+    usage_thresholds: Optional[Dict] = None  # filter thresholds (agg set)
+    usage_pct: Optional[int] = None          # filter aggregation percentile
+    usage_duration_seconds: Optional[float] = None
+    score_pct: Optional[int] = None          # score aggregation percentile
+    score_duration_seconds: Optional[float] = None
+
+    #: percentiles the NodeMetric reporter publishes (a typo'd percentile
+    #: would otherwise silently disable the check on every node)
+    VALID_PCTS = (50, 90, 95, 99)
+
+    def __post_init__(self):
+        for pct in (self.usage_pct, self.score_pct):
+            if pct is not None and pct not in self.VALID_PCTS:
+                raise ValueError(
+                    f"aggregation percentile {pct} not reported; "
+                    f"valid: {self.VALID_PCTS}"
+                )
+
+    @property
+    def filter_enabled(self) -> bool:
+        return bool(self.usage_thresholds) and self.usage_pct is not None
+
+    @property
+    def score_enabled(self) -> bool:
+        return self.score_pct is not None
+
+
+def target_aggregated_usage(
+    metric: NodeMetric, duration_seconds: Optional[float], pct: Optional[int]
+):
+    """The percentile usage map for the requested window, or None.
+
+    Reference: loadaware/helper.go:58-90 getTargetAggregatedUsage — no
+    aggregated usages reported → None; no duration requested → the
+    largest reported window (this reporter produces exactly one); a
+    requested duration must match a reported window exactly.
+    """
+    if not metric.aggregated_usage or pct is None:
+        return None
+    if duration_seconds and metric.aggregated_duration != duration_seconds:
+        return None
+    usage = metric.aggregated_usage.get(pct)
+    return usage or None
+
+
 def translate_resource_by_priority(
     resource: ResourceName, priority_class: PriorityClass
 ) -> ResourceName:
@@ -173,6 +232,7 @@ def lower_nodes(
     metric_expiration_seconds: float = DEFAULT_NODE_METRIC_EXPIRATION_SECONDS,
     scaling_factors: Optional[Mapping[ResourceName, int]] = None,
     resource_weights: Optional[Mapping[ResourceName, int]] = None,
+    aggregated: Optional[AggregatedArgs] = None,
 ) -> NodeArrays:
     """Lower nodes + assigned pods + metrics to ``NodeArrays``.
 
@@ -235,11 +295,40 @@ def lower_nodes(
             used_req[index[resv.node_name]] += np.maximum(alloc_vec - used_vec, 0)
 
     # metrics + estimation correction
+    agg_filter = aggregated is not None and aggregated.filter_enabled
+    agg_score = aggregated is not None and aggregated.score_enabled
     for name, metric in snapshot.node_metrics.items():
         if name not in index:
             continue
         i = index[name]
-        usage[i] = resources_to_vector(metric.node_usage)
+        avg_vec = resources_to_vector(metric.node_usage)
+        # Aggregated (percentile) mode folds into the array substrate at
+        # lowering: the filter reads ``usage`` directly, so ``usage``
+        # stores the filter-mode base (percentile when enabled; a missing
+        # percentile lowers to zeros == the reference's per-resource skip,
+        # load_aware.go:200-209); the score base is usage + est_extra, so
+        # the score-mode substitution rides est_extra (exact fold:
+        # est_extra += score_base - filter_base). Reference:
+        # load_aware.go:157-186 (filter), :310-311 (score).
+        filter_vec = avg_vec
+        score_vec = avg_vec
+        score_agg_nil = False
+        if agg_filter:
+            # a missing percentile lowers to zeros (resources_to_vector of
+            # None) == the reference's per-resource skip
+            filter_vec = resources_to_vector(target_aggregated_usage(
+                metric, aggregated.usage_duration_seconds, aggregated.usage_pct
+            ))
+        if agg_score:
+            agg = target_aggregated_usage(
+                metric, aggregated.score_duration_seconds, aggregated.score_pct
+            )
+            # nil aggregated score base lowers to zeros: node usage
+            # contributes nothing AND every assigned pod becomes
+            # estimated (the OR clause at load_aware.go:357-358)
+            score_vec = resources_to_vector(agg)
+            score_agg_nil = agg is None
+        usage[i] = filter_vec
         metric_fresh[i] = (
             snapshot.now - metric.update_time
         ) < metric_expiration_seconds
@@ -253,6 +342,7 @@ def lower_nodes(
                 prod_usage[i] += rep_vec  # prod Filter base
             should_estimate = (
                 not reported
+                or score_agg_nil
                 or pod.assign_time >= metric.update_time
                 or (metric.update_time - pod.assign_time) < metric.report_interval
             )
@@ -271,10 +361,12 @@ def lower_nodes(
             est_sum += est_vec
             if is_prod:
                 prod_base[i] += est_vec
-        # subtract reported usage of estimated pods only where node usage
-        # covers it (load_aware.go:318-323 quantity.Cmp(q) >= 0 guard)
-        sub = np.where(usage[i] >= reported_sum, reported_sum, 0)
-        est_extra[i] = est_sum - sub
+        # subtract reported usage of estimated pods only where the score
+        # base covers it (load_aware.go:318-323 quantity.Cmp(q) >= 0
+        # guard — against the aggregated base in score-aggregated mode),
+        # then fold the score-base substitution into est_extra
+        sub = np.where(score_vec >= reported_sum, reported_sum, 0)
+        est_extra[i] = (score_vec - filter_vec) + est_sum - sub
 
     return NodeArrays(
         names=names,

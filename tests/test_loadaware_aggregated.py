@@ -1,0 +1,199 @@
+"""LoadAware aggregated (percentile) usage mode, end-to-end.
+
+Reference semantics: pkg/scheduler/plugins/loadaware/load_aware.go:157-186
+(filter substitutes percentile usage + the aggregated threshold set),
+:310-311 (score substitutes the percentile base), helper.go:58-90
+(getTargetAggregatedUsage window/percentile selection). The VERDICT r3
+closure test: avg mode admits a node that p95 mode rejects.
+"""
+
+import numpy as np
+
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+)
+from koordinator_tpu.models import PlacementModel
+from koordinator_tpu.state.cluster import (
+    AggregatedArgs,
+    lower_nodes,
+    target_aggregated_usage,
+)
+
+
+def _snap(avg_cpu=5000, p95_cpu=7000, agg_duration=300.0, n=1):
+    nodes = [
+        NodeSpec(name=f"n{i}", allocatable={R.CPU: 10000, R.MEMORY: 32768})
+        for i in range(n)
+    ]
+    metrics = {
+        f"n{i}": NodeMetric(
+            node_name=f"n{i}",
+            node_usage={R.CPU: avg_cpu},
+            aggregated_usage={95: {R.CPU: p95_cpu}, 50: {R.CPU: avg_cpu // 2}},
+            aggregated_duration=agg_duration,
+            update_time=99.0,
+        )
+        for i in range(n)
+    }
+    pod = PodSpec(name="p", requests={R.CPU: 1000, R.MEMORY: 1024})
+    return ClusterSnapshot(
+        nodes=nodes, node_metrics=metrics, pending_pods=[pod], now=100.0
+    )
+
+
+AGG_FILTER = AggregatedArgs(usage_thresholds={R.CPU: 65}, usage_pct=95)
+
+
+def test_avg_admits_p95_rejects():
+    """The differential: 50% avg < 65% threshold admits; 70% p95 >= 65%
+    aggregated threshold rejects — same snapshot, same pod."""
+    snap = _snap(avg_cpu=5000, p95_cpu=7000)
+    assert PlacementModel().schedule(snap)["default/p"] == "n0"
+    out = PlacementModel(aggregated=AGG_FILTER).schedule(_snap())
+    assert out["default/p"] is None
+
+
+def test_p95_under_threshold_admits():
+    out = PlacementModel(aggregated=AGG_FILTER).schedule(
+        _snap(avg_cpu=5000, p95_cpu=6000)  # 60% < 65%
+    )
+    assert out["default/p"] == "n0"
+
+
+def test_avg_rejects_while_p95_admits():
+    """The aggregated threshold set REPLACES the avg set: a hot-avg node
+    with a calm p95 is admitted in aggregated mode (and rejected in avg
+    mode) — the substitution works both directions."""
+    snap = _snap(avg_cpu=7000, p95_cpu=5000)  # avg 70% >= 65, p95 50%
+    assert PlacementModel().schedule(snap)["default/p"] is None
+    out = PlacementModel(aggregated=AGG_FILTER).schedule(
+        _snap(avg_cpu=7000, p95_cpu=5000)
+    )
+    assert out["default/p"] == "n0"
+
+
+def test_missing_percentile_skips_check():
+    """No reported percentile -> the aggregated check is skipped and the
+    node passes (helper.go returns nil -> filter continue)."""
+    snap = _snap(avg_cpu=9900, p95_cpu=9900)
+    for m in snap.node_metrics.values():
+        m.aggregated_usage = {}
+        m.aggregated_duration = None
+    out = PlacementModel(aggregated=AGG_FILTER).schedule(snap)
+    assert out["default/p"] == "n0"
+
+
+def test_duration_mismatch_skips_check():
+    """A requested window that no reported aggregation matches -> nil ->
+    check skipped (helper.go:79-89 exact duration match)."""
+    args = AggregatedArgs(
+        usage_thresholds={R.CPU: 65}, usage_pct=95,
+        usage_duration_seconds=600.0,  # metric reports 300s
+    )
+    out = PlacementModel(aggregated=args).schedule(_snap(p95_cpu=9000))
+    assert out["default/p"] == "n0"
+    # matching window enforces the threshold again
+    args_match = AggregatedArgs(
+        usage_thresholds={R.CPU: 65}, usage_pct=95,
+        usage_duration_seconds=300.0,
+    )
+    out = PlacementModel(aggregated=args_match).schedule(_snap(p95_cpu=9000))
+    assert out["default/p"] is None
+
+
+def test_score_aggregated_prefers_calm_p95_node():
+    """Two nodes, identical avg usage; n1 has the lower p95. Aggregated
+    score mode places on n1; avg mode tie-breaks to n0."""
+    def snap2():
+        nodes = [
+            NodeSpec(name=f"n{i}", allocatable={R.CPU: 10000, R.MEMORY: 32768})
+            for i in range(2)
+        ]
+        metrics = {
+            "n0": NodeMetric(
+                node_name="n0", node_usage={R.CPU: 4000},
+                aggregated_usage={95: {R.CPU: 8000}},
+                aggregated_duration=300.0, update_time=99.0,
+            ),
+            "n1": NodeMetric(
+                node_name="n1", node_usage={R.CPU: 4000},
+                aggregated_usage={95: {R.CPU: 5000}},
+                aggregated_duration=300.0, update_time=99.0,
+            ),
+        }
+        pod = PodSpec(name="p", requests={R.CPU: 1000, R.MEMORY: 1024})
+        return ClusterSnapshot(
+            nodes=nodes, node_metrics=metrics, pending_pods=[pod], now=100.0
+        )
+
+    assert PlacementModel().schedule(snap2())["default/p"] == "n0"
+    out = PlacementModel(
+        aggregated=AggregatedArgs(score_pct=95)
+    ).schedule(snap2())
+    assert out["default/p"] == "n1"
+
+
+def test_score_aggregated_nil_estimates_all_assigned():
+    """Score-aggregated mode with no reported percentiles: the node usage
+    base is dropped and every assigned pod becomes estimated
+    (load_aware.go:357-358 OR clause) — visible as est_extra == the pod
+    estimate with no node-usage term."""
+    node = NodeSpec(name="n0", allocatable={R.CPU: 10000, R.MEMORY: 32768})
+    assigned = PodSpec(
+        name="a", node_name="n0", requests={R.CPU: 2000, R.MEMORY: 1024},
+        assign_time=0.0,
+    )
+    metric = NodeMetric(
+        node_name="n0", node_usage={R.CPU: 6000},
+        pod_usages={"default/a": {R.CPU: 1000}},
+        update_time=99.0, report_interval=10.0,
+    )
+    snap = ClusterSnapshot(
+        nodes=[node], pods=[assigned], node_metrics={"n0": metric}, now=100.0
+    )
+    arrays = lower_nodes(snap, aggregated=AggregatedArgs(score_pct=95))
+    # filter side untouched (filter mode off): usage stays the avg
+    assert arrays.usage[0, R.CPU] == 6000
+    # score base = usage + est_extra must equal the bare pod estimate:
+    # max(est(2000*85%), reported 1000) = 1700, node usage dropped
+    assert arrays.usage[0, R.CPU] + arrays.est_extra[0, R.CPU] == 1700
+
+
+def test_target_aggregated_usage_selection():
+    m = NodeMetric(
+        node_name="n", aggregated_usage={95: {R.CPU: 5}},
+        aggregated_duration=300.0,
+    )
+    assert target_aggregated_usage(m, None, 95) == {R.CPU: 5}
+    assert target_aggregated_usage(m, 300.0, 95) == {R.CPU: 5}
+    assert target_aggregated_usage(m, 600.0, 95) is None
+    assert target_aggregated_usage(m, None, 90) is None
+    assert target_aggregated_usage(NodeMetric(node_name="n"), None, 95) is None
+
+
+def test_reporter_stamps_aggregated_duration():
+    """The koordlet reporter records the aggregation window so the
+    scheduler's duration selection has something to match against."""
+    from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
+    from koordinator_tpu.koordlet.statesinformer import (
+        NodeMetricReporter,
+        StatesInformer,
+    )
+    from koordinator_tpu.manager.nodemetric import NodeMetricCollectPolicy
+
+    mc = MetricCache()
+    informer = StatesInformer()
+    informer.set_node(
+        NodeSpec("n0", allocatable={R.CPU: 8000, R.MEMORY: 16384})
+    )
+    informer.set_pods([])
+    informer.set_collect_policy(NodeMetricCollectPolicy(300, 60))
+    for t in range(10):
+        mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), 3000.0)
+    m = NodeMetricReporter(mc, informer).report(now=10.0)
+    assert m.aggregated_usage[95][R.CPU] == 3000
+    assert m.aggregated_duration == 300.0
