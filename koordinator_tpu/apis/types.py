@@ -105,6 +105,9 @@ class PodSpec:
     host_ports: Optional[List] = None
     #: Σ container restart counts (status) — TooManyRestarts input
     restart_count: int = 0
+    #: volume name -> PVC claim key "namespace/name" (spec.volumes[] with
+    #: persistentVolumeClaim) — blkio pod-volume throttle resolution
+    volumes: Dict[str, str] = dataclasses.field(default_factory=dict)
     #: assumed on a node behind a gang Permit barrier, NOT yet bound —
     #: the scheduler holds capacity but the placement is not observable
     #: (the reference keeps WaitOnPermit assumptions out of the API
@@ -167,8 +170,35 @@ class NodeMetric:
         default_factory=dict
     )
     host_app_qos: Dict[str, QoSClass] = dataclasses.field(default_factory=dict)
+    # device name -> disk throughput/utilization over the window
+    # (storage accounting from the nodestorageinfo collector)
+    disk_usages: Dict[str, "DiskUsage"] = dataclasses.field(
+        default_factory=dict
+    )
     update_time: float = 0.0
     report_interval: float = 60.0
+
+
+@dataclasses.dataclass
+class DiskUsage:
+    """One block device's throughput/utilization over the report window."""
+
+    read_bps: int = 0
+    write_bps: int = 0
+    io_util_pct: int = 0
+
+
+@dataclasses.dataclass
+class PVCSpec:
+    """A PersistentVolumeClaim as the node agent needs it (reference:
+    statesinformer/impl/states_pvc.go — the informer keeps only the
+    claim -> bound-PV mapping the blkio reconciler resolves through).
+
+    ``name`` is the namespaced claim key ("namespace/name")."""
+
+    name: str
+    volume_name: str = ""       # bound PV name ("" = unbound)
+    capacity_mib: int = 0
 
 
 class GangMode(enum.Enum):

@@ -31,6 +31,7 @@ class Kind(str, enum.Enum):
     MIGRATION_JOB = "PodMigrationJob"
     LEASE = "Lease"
     RECOMMENDATION = "Recommendation"
+    PVC = "PersistentVolumeClaim"
 
 
 class EventType(str, enum.Enum):

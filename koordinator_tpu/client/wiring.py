@@ -251,9 +251,18 @@ class KoordletLoop:
                 [self._meta_fn(p) for p in self._pods.values()]
             )
 
+        def on_pvc(event, name, pvc):
+            # claim -> bound-PV map for the blkio pod-volume resolution
+            # (reference: states_pvc.go event handlers)
+            if event is EventType.DELETED:
+                informer.remove_pvc(name)
+            else:
+                informer.upsert_pvc(pvc)
+
         bus.watch(Kind.NODE, on_node)
         bus.watch(Kind.NODE_SLO, on_slo)
         bus.watch(Kind.POD, on_pod)
+        bus.watch(Kind.PVC, on_pvc)
 
     def pods(self):
         return list(self._pods.values())

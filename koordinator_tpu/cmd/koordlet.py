@@ -35,6 +35,10 @@ class KoordletConfig:
     #: TSDB survive restarts); empty = no persistence
     checkpoint_dir: str = ""
     checkpoint_interval_seconds: float = 60.0
+    #: PV name -> block device "MAJ:MIN" (the host's volume-attachment
+    #: view; the reference walks /var/lib/kubelet + sysfs — here the CSI
+    #: layer/operator supplies the map). Feeds blkio pod-volume throttles.
+    volume_devices: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -229,6 +233,9 @@ def build_koordlet(
         auditor=auditor,
         node_capacity_mcpu=config.node_capacity_mcpu,
         node_capacity_mem_mib=config.node_capacity_mem_mib,
+        # PVC claim -> bound PV -> device for blkio pod-volume throttles
+        volume_name_fn=states_informer.get_volume_name,
+        volume_devices=dict(config.volume_devices or {}),
     )
     strategies: List[object] = []
     if gates.enabled("BECPUSuppress"):

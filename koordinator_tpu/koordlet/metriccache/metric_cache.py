@@ -169,6 +169,18 @@ class MetricCache:
             return np.zeros(0), np.zeros(0)
         return ring.window(start, end)
 
+    def label_values(self, kind: MetricKind, label: str) -> List[str]:
+        """Distinct values of one label across a kind's series (e.g. the
+        block devices the storage collector has reported)."""
+        out = set()
+        for key_kind, labels in self._series:
+            if key_kind != kind.value:
+                continue
+            for name, value in labels:
+                if name == label:
+                    out.add(value)
+        return sorted(out)
+
     def aggregate(self, kind: MetricKind,
                   labels: Optional[Mapping[str, str]] = None,
                   start: float = -math.inf, end: float = math.inf,

@@ -56,6 +56,14 @@ class PodMeta:
     batch_resources: Dict[str, "ContainerBatchResources"] = (
         dataclasses.field(default_factory=dict)
     )
+    #: container name -> cpu limit (mCPU); feeds container-level cfs
+    #: quota hooks (cpu-normalization). Absent entry = unknown/unlimited.
+    container_limits_mcpu: Dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )
+    #: volume name -> PVC claim key ("namespace/name"); feeds the blkio
+    #: pod-volume device resolution (pod.Spec.Volumes projection)
+    volumes: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 class PodProvider(Protocol):

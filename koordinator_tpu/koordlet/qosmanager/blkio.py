@@ -12,6 +12,7 @@ devices by MAJ:MIN directly. A zero limit removes the throttle (writes
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List
 
 from koordinator_tpu.apis.extension import QoSClass
@@ -74,14 +75,41 @@ class BlkIOReconcile:
                 updates.extend(block_updaters(parent_dir, block))
                 live.setdefault(parent_dir, set()).add(block.device)
 
+        def resolve_pod_volume(pod, block):
+            """volume name -> PVC claim -> bound PV -> device
+            (blkio_reconcile.go:387-411 BlockTypePodVolume); None when
+            any link is missing — the throttle is skipped, matching the
+            reference's error-and-continue."""
+            claim = pod.volumes.get(block.name)
+            if not claim or ctx.volume_name_fn is None:
+                return None
+            pv = ctx.volume_name_fn(claim)
+            device = ctx.volume_devices.get(pv) if pv else None
+            if not device:
+                return None
+            return dataclasses.replace(
+                block, device=device, block_type="device", name=""
+            )
+
         for qos, tier_dir in _QOS_DIR.items():
             blocks = strategy.for_qos(qos).blkio
             if not blocks:
                 continue
-            throttle(tier_dir, blocks)
+            device_blocks = [
+                b for b in blocks if b.block_type != "pod_volume"
+            ]
+            volume_blocks = [
+                b for b in blocks if b.block_type == "pod_volume"
+            ]
+            throttle(tier_dir, device_blocks)
             for pod in ctx.pod_provider.running_pods():
-                if pod.qos == qos:
-                    throttle(pod.cgroup_dir, blocks)
+                if pod.qos != qos:
+                    continue
+                throttle(pod.cgroup_dir, device_blocks)
+                for block in volume_blocks:
+                    resolved = resolve_pod_volume(pod, block)
+                    if resolved is not None:
+                        throttle(pod.cgroup_dir, [resolved])
 
         # stale devices: explicitly clear the kernel throttle
         for parent_dir, devices in self._applied.items():

@@ -54,6 +54,12 @@ class QoSContext:
     #: cgroup parent of the best-effort QoS tier (reference:
     #: koordletutil.GetPodQoSRelativePath(PodQOSBestEffort))
     be_cgroup_dir: str = "kubepods/besteffort"
+    #: PVC claim key ("namespace/name") -> bound PV name (the
+    #: statesinformer's get_volume_name; states_pvc.go)
+    volume_name_fn: Optional[Callable[[str], str]] = None
+    #: PV name -> block device "MAJ:MIN" (the host's volume attachment
+    #: view; the reference walks /var/lib/kubelet + sysfs for this)
+    volume_devices: Dict[str, str] = dataclasses.field(default_factory=dict)
     #: how far back "latest" metric queries look
     metric_collect_interval: float = 60.0
 

@@ -25,10 +25,15 @@ from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
 from koordinator_tpu.koordlet.runtimehooks.batchresource import (
     BatchResourcePlugin,
 )
+from koordinator_tpu.koordlet.runtimehooks.cpunormalization import (
+    CPUNormalizationPlugin,
+)
 from koordinator_tpu.koordlet.runtimehooks.cpuset import (
     CpusetPlugin,
     NodeTopoInfo,
 )
+from koordinator_tpu.koordlet.runtimehooks.devices import DeviceEnvPlugin
+from koordinator_tpu.koordlet.runtimehooks.terwayqos import TerwayQosPlugin
 from koordinator_tpu.koordlet.runtimehooks.groupidentity import (
     BvtPlugin,
     BvtRule,
@@ -66,6 +71,9 @@ __all__ = [
     "BatchResourcePlugin",
     "BvtPlugin",
     "BvtRule",
+    "CPUNormalizationPlugin",
+    "DeviceEnvPlugin",
+    "TerwayQosPlugin",
     "ContainerBatchResources",
     "ContainerContext",
     "CpusetPlugin",
@@ -110,9 +118,17 @@ class RuntimeHooks:
         self.groupidentity = BvtPlugin()
         self.cpuset = CpusetPlugin()
         self.batchresource = BatchResourcePlugin()
+        self.devices = DeviceEnvPlugin()
+        self.cpunormalization = CPUNormalizationPlugin()
+        self.terwayqos = TerwayQosPlugin(
+            root_path=executor.config.terway_qos_root,
+            auditor=executor.auditor,
+        )
         self.groupidentity.register(self.registry)
         self.cpuset.register(self.registry)
         self.batchresource.register(self.registry)
+        self.devices.register(self.registry)
+        self.cpunormalization.register(self.registry)
 
         self.reconciler = Reconciler(
             self.registry, executor, bvt_plugin=self.groupidentity
@@ -121,8 +137,12 @@ class RuntimeHooks:
 
         informer.register_callback(StateKind.NODE_SLO, self._on_node_slo)
         informer.register_callback(StateKind.PODS, self._on_pods)
-        # arm the rule from whatever the informer already holds
+        informer.register_callback(StateKind.NODE, self._on_node)
+        # arm the rules from whatever the informer already holds
         self.groupidentity.update_rule(informer.get_node_slo())
+        self.cpunormalization.update_rule(informer.get_node())
+        self.terwayqos.update_node_slo(informer.get_node_slo())
+        self.terwayqos.update_pods(informer.running_pods())
 
     # -- informer callbacks --------------------------------------------------
 
@@ -133,9 +153,17 @@ class RuntimeHooks:
             self.groupidentity.rule_update(
                 self.informer.running_pods(), self.executor
             )
+        self.terwayqos.update_node_slo(slo)
 
     def _on_pods(self, kind: StateKind, pods: Sequence[PodMeta]) -> None:
+        self.terwayqos.update_pods(pods)
         self.reconcile()
+
+    def _on_node(self, kind: StateKind, node) -> None:
+        # cpu-normalization ratio rides the node annotation (the rule's
+        # RegisterTypeNodeMetadata parse); a change re-actuates quotas
+        if self.cpunormalization.update_rule(node):
+            self.reconcile()
 
     # -- public surface ------------------------------------------------------
 

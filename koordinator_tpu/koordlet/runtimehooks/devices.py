@@ -1,0 +1,91 @@
+"""Device hook: inject DeviceShare allocations into container env.
+
+Reference: pkg/koordlet/runtimehooks/hooks/gpu/gpu.go — at
+PreCreateContainer, parse the scheduler's device-allocation annotation
+(``koordinator.sh/device-allocated``, written by the DeviceShare
+plugin's PreBind — scheduler/plugins/deviceshare.py) and inject the
+allocated device minors into the container's environment so the runtime
+(device plugin / accelerator stack) actually confines the container to
+its allocation. This is the actuation edge that makes the device
+allocator's output land in a container.
+
+TPU-first: the primary env is ``TPU_VISIBLE_CHIPS`` (the libtpu chip
+confinement variable); ``NVIDIA_VISIBLE_DEVICES`` (gpu.go:32 GpuAllocEnv)
+is kept for NVML-backed nodes, and RDMA VF bus ids ride
+``KOORDINATOR_RDMA_VFS`` (the reference injects VFs through device
+mounts; an env carrying bus ids is the runtime-agnostic equivalent).
+
+Env injection is meaningful at container *creation* (NRI adjustment /
+CRI-proxy request merge). In standalone reconcile mode the env response
+is inert — a running container's environment cannot be changed — which
+matches the reference (its gpu hook also only registers
+PreCreateContainer).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from koordinator_tpu.apis.extension import ANNOTATION_DEVICE_ALLOCATED
+from koordinator_tpu.device.cache import DeviceType
+from koordinator_tpu.koordlet.runtimehooks.hooks import HookRegistry, Stage
+from koordinator_tpu.koordlet.runtimehooks.protocol import ContainerContext
+
+NAME = "DeviceEnvInject"
+
+#: libtpu chip confinement (TPU-native primary)
+TPU_ALLOC_ENV = "TPU_VISIBLE_CHIPS"
+#: gpu.go:32 GpuAllocEnv (NVML variant, kept optional per SURVEY §2.9)
+GPU_ALLOC_ENV = "NVIDIA_VISIBLE_DEVICES"
+RDMA_VFS_ENV = "KOORDINATOR_RDMA_VFS"
+
+
+def parse_device_allocations(
+    annotations: Dict[str, str]
+) -> Optional[Dict[str, List[dict]]]:
+    """The PreBind allocation payload: {type: [{minor, resources, vfs?}]}
+    (reference: ext.GetDeviceAllocations)."""
+    raw = annotations.get(ANNOTATION_DEVICE_ALLOCATED)
+    if not raw:
+        return None
+    try:
+        alloc = json.loads(raw)
+    except ValueError:
+        return None
+    return alloc if isinstance(alloc, dict) else None
+
+
+class DeviceEnvPlugin:
+    name = NAME
+
+    def inject_container_device_env(self, proto) -> None:
+        """gpu.go:51 InjectContainerGPUEnv, generalized per device type."""
+        if not isinstance(proto, ContainerContext):
+            return
+        alloc = parse_device_allocations(proto.request.annotations)
+        if not alloc:
+            return
+        devices = alloc.get(DeviceType.GPU.value) or []
+        minors = ",".join(str(int(d.get("minor", 0))) for d in devices)
+        if minors:
+            envs = proto.response.add_envs or {}
+            envs[TPU_ALLOC_ENV] = minors
+            envs[GPU_ALLOC_ENV] = minors
+            proto.response.add_envs = envs
+        vfs = [
+            vf
+            for d in (alloc.get(DeviceType.RDMA.value) or [])
+            for vf in (d.get("vfs") or [])
+        ]
+        if vfs:
+            envs = proto.response.add_envs or {}
+            envs[RDMA_VFS_ENV] = ",".join(vfs)
+            proto.response.add_envs = envs
+
+    def register(self, registry: HookRegistry) -> None:
+        registry.register(
+            Stage.PRE_CREATE_CONTAINER, self.name,
+            "inject allocated device env into container",
+            self.inject_container_device_env,
+        )

@@ -89,6 +89,11 @@ class Resources:
     cpuset: Optional[str] = None
     memory_limit_bytes: Optional[int] = None
     cpu_bvt: Optional[int] = None
+    #: env vars to ADD to the container (reference: ContainerResponse
+    #: AddContainerEnvs, used by the device hook). Only meaningful at
+    #: container creation — NRI adjustment / CRI-proxy request merge;
+    #: inert in standalone cgroup reconcile (no cgroup file to write).
+    add_envs: Optional[Dict[str, str]] = None
 
     def is_origin_res_changed(self) -> bool:
         return (

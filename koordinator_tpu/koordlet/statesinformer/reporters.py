@@ -79,6 +79,10 @@ def pod_meta_from_spec(pod) -> PodMeta:
         memory_limit_mib=pod.limits.get(ResourceName.MEMORY, 0),
         labels=dict(pod.labels),
         annotations=dict(pod.annotations),
+        container_limits_mcpu={
+            "main": pod.limits.get(ResourceName.CPU, 0)
+        },
+        volumes=dict(pod.volumes),
     )
     batch_cpu = pod.requests.get(ResourceName.BATCH_CPU, 0)
     if batch_cpu:

@@ -28,6 +28,7 @@ class StateKind(enum.Enum):
     PODS = "pods"
     NODE_SLO = "nodeslo"
     COLLECT_POLICY = "collect_policy"
+    PVCS = "pvcs"
 
 
 Callback = Callable[[StateKind, object], None]
@@ -41,6 +42,9 @@ class StatesInformer:
         self._pods: List[PodMeta] = []
         self._node_slo: NodeSLOSpec = NodeSLOSpec()
         self._collect_policy: Optional[NodeMetricCollectPolicy] = None
+        #: claim key ("namespace/name") -> bound PV name (reference:
+        #: states_pvc.go volumeNameMap)
+        self._volume_names: Dict[str, str] = {}
         self._callbacks: Dict[StateKind, List[Callback]] = {
             k: [] for k in StateKind
         }
@@ -72,6 +76,15 @@ class StatesInformer:
         self._collect_policy = policy
         self._fire(StateKind.COLLECT_POLICY, policy)
 
+    def upsert_pvc(self, pvc) -> None:
+        """PVC add/update (states_pvc.go updateVolumeNameMap)."""
+        self._volume_names[pvc.name] = pvc.volume_name
+        self._fire(StateKind.PVCS, dict(self._volume_names))
+
+    def remove_pvc(self, claim_key: str) -> None:
+        if self._volume_names.pop(claim_key, None) is not None:
+            self._fire(StateKind.PVCS, dict(self._volume_names))
+
     # -- getters (what subsystems consume) ----------------------------------
 
     def get_node(self) -> Optional[NodeSpec]:
@@ -86,3 +99,8 @@ class StatesInformer:
 
     def get_collect_policy(self) -> Optional[NodeMetricCollectPolicy]:
         return self._collect_policy
+
+    def get_volume_name(self, claim_key: str) -> str:
+        """Bound PV for a "namespace/name" claim key; "" when unknown
+        (reference: states_pvc.go GetVolumeName)."""
+        return self._volume_names.get(claim_key, "")

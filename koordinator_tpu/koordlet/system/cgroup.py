@@ -44,6 +44,9 @@ class SystemConfig:
     use_cgroup_v2: bool = False
     #: cgroup path prefix for the kubepods hierarchy
     kubepods_dir: str = "kubepods"
+    #: terway net-QoS dataplane config dir (reference:
+    #: runtimehooks/hooks/terwayqos rootPath "/host-var-lib/terway/qos")
+    terway_qos_root: str = "/host-var-lib/terway/qos"
 
 
 #: Module-level active config; tests replace it (reference: system.Conf).

@@ -177,13 +177,35 @@ class ResctrlQOS:
 class BlockCfg:
     """One throttled block device (reference: slov1alpha1.BlockCfg/
     BlkIOQOS, blkio_reconcile.go:311-373 getBlkIOUpdaterFromBlockCfg).
-    Devices are addressed by their MAJ:MIN number; 0 = unlimited."""
+    Devices are addressed by their MAJ:MIN number; 0 = unlimited.
 
-    device: str                 # "MAJ:MIN"
+    ``block_type="pod_volume"`` addresses a pod volume by name instead:
+    the reconciler resolves volume -> PVC claim -> bound PV (the PVC
+    informer's map) -> device (blkio_reconcile.go:375-418
+    getDiskNumberFromBlockCfg, BlockTypePodVolume)."""
+
+    device: str = ""            # "MAJ:MIN" (block_type="device")
     read_bps: int = 0
     write_bps: int = 0
     read_iops: int = 0
     write_iops: int = 0
+    block_type: str = "device"  # "device" | "pod_volume"
+    name: str = ""              # volume name (block_type="pod_volume")
+
+
+@dataclasses.dataclass
+class NetworkQOS:
+    """Per-class network bandwidth QoS (reference: slov1alpha1
+    NetworkQOSCfg). Request/limit values follow the reference's
+    IntOrString convention: an int is a percentage of the node's total
+    bandwidth; a str is an absolute bits-per-second quantity
+    (terwayqos.go:352-371 parseQuantity)."""
+
+    enable: bool = False
+    ingress_request: Optional[object] = None  # int % | str bits/s
+    ingress_limit: Optional[object] = None
+    egress_request: Optional[object] = None
+    egress_limit: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -193,6 +215,7 @@ class QoSConfig:
     memory: MemoryQOS = dataclasses.field(default_factory=MemoryQOS)
     resctrl: ResctrlQOS = dataclasses.field(default_factory=ResctrlQOS)
     blkio: List[BlockCfg] = dataclasses.field(default_factory=list)
+    network: NetworkQOS = dataclasses.field(default_factory=NetworkQOS)
 
 
 def default_qos_config(qos: QoSClass) -> QoSConfig:
@@ -222,6 +245,10 @@ class ResourceQOSStrategy:
     system: QoSConfig = dataclasses.field(
         default_factory=lambda: default_qos_config(QoSClass.SYSTEM)
     )
+
+    #: strategy-level policy switches (reference: ResourceQOSPolicies);
+    #: key "netQOSPolicy" == "terway-qos" enables the terway net-QoS hook
+    policies: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def for_qos(self, qos: QoSClass) -> QoSConfig:
         return {
@@ -253,6 +280,9 @@ class SystemStrategy:
     min_free_kbytes_factor: int = 100   # 1/10000 of total memory
     watermark_scale_factor: int = 150   # 1/10000
     memcg_reap_background: int = 0
+    #: node NIC capacity in bits/s (reference: SystemStrategy
+    #: TotalNetworkBandwidth); 0 = unknown (net QoS disabled)
+    total_network_bandwidth_bps: int = 0
 
 
 @dataclasses.dataclass

@@ -541,8 +541,8 @@ class PlacementModel:
             # not trip the kernel's fallback breaker
             and state.alloc.shape[0] > 0
             and batch.req.shape[0] > 0
-            # the kernel's packed argmax carries the lane in 13 bits
-            and state.alloc.shape[0] <= 8192
+            # the kernel's packed argmax carries the lane in 16 bits
+            and state.alloc.shape[0] <= 65536
         )
         if kernel_ok and self.use_pallas and self._pallas_eligible:
             from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch

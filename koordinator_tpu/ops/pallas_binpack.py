@@ -212,15 +212,17 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
             # single-reduction argmax: pack (score, first-occurrence
             # tie-break) into one int32 — score <= 300 (three
             # 100-capped weighted means: fit, loadaware, numa), lane <
-            # 8192, so score<<13 | (8191-lane) fits with room; max of
-            # the pack IS the max score at its smallest lane. Halves
-            # the [1,N]-to-scalar reductions vs max-then-min-where.
+            # 2^16, so score<<16 | (65535-lane) <= 300*65536+65535 <
+            # 2^31 with room; max of the pack IS the max score at its
+            # smallest lane. Halves the [1,N]-to-scalar reductions vs
+            # max-then-min-where. 16 lane bits lift the node cap to
+            # 65536 (VMEM becomes the binding constraint first).
             packed = jnp.where(
-                mask, (score << 13) | (8191 - lane), -1
+                mask, (score << 16) | (65535 - lane), -1
             )
             m = jnp.max(packed)
             ok = m >= 0
-            best = (8191 - (m & 8191)).astype(jnp.int32)
+            best = (65535 - (m & 65535)).astype(jnp.int32)
             node = jnp.where(ok, best, -1).astype(jnp.int32)
             assign_ref[...] = jnp.where(chunk_lane == j, node, assign_ref[...])
             hit = (lane == best) & ok
@@ -533,9 +535,9 @@ def pallas_solve_batch(
         raise ValueError("configuration not supported by the pallas kernel")
     if state.alloc.shape[0] == 0 or pods.req.shape[0] == 0:
         raise ValueError("empty solve: use solve_batch's shape early-out")
-    if state.alloc.shape[0] > 8192:
-        # the packed single-reduction argmax carries the lane in 13 bits
-        raise ValueError("more than 8192 nodes: use the scan solver")
+    if state.alloc.shape[0] > 65536:
+        # the packed single-reduction argmax carries the lane in 16 bits
+        raise ValueError("more than 65536 nodes: use the scan solver")
     if numa_aux is not None and (
         state.numa_cap is None or state.numa_free is None
     ):

@@ -61,6 +61,14 @@ class SchedulerCache:
             self.pending.pop(uid, None)
             self.assumed.pop(uid, None)
 
+    def promote_assigned(self, pod: PodSpec) -> None:
+        """A binding became visible through the bus (another scheduler's
+        Bind, or in-place mutation on the in-process bus): move the pod
+        from pending to assigned without touching assign bookkeeping."""
+        with self._lock:
+            self.pending.pop(pod.uid, None)
+            self.pods[pod.uid] = pod
+
     def update_node_metric(self, metric: NodeMetric) -> None:
         with self._lock:
             self.node_metrics[metric.node_name] = metric

@@ -218,6 +218,26 @@ class Scheduler:
             self.add_pod(pod)
             return
         if old is pod:
+            # in-process bus: the same object may have been MUTATED in
+            # place by another component's bind (schedule_and_publish
+            # re-applies the bound object). A standby must still observe
+            # the binding, or a failover would re-place a bound pod.
+            if (
+                pod.node_name is not None
+                and not getattr(pod, "waiting_permit", False)
+                and pod.uid in self.cache.pending
+            ):
+                self._observe_binding(pod)
+            return
+        if (
+            old.node_name is None
+            and pod.node_name is not None
+            and not getattr(pod, "waiting_permit", False)
+        ):
+            # another scheduler's Bind arrived as a fresh object: mirror
+            # the assume (the reference's assign cache does this on the
+            # informer update of a scheduled pod)
+            self._observe_binding(pod)
             return
         accounted_changed = (
             old.quota != pod.quota
@@ -277,9 +297,30 @@ class Scheduler:
 
     def add_pod(self, pod: PodSpec) -> None:
         self.cache.add_pod(pod)
+        bound = (
+            pod.node_name is not None
+            and not getattr(pod, "waiting_permit", False)
+        )
         if pod.gang:
             self.gang_manager.on_pod_add(pod.uid, pod.gang)
+            if bound:
+                self.gang_manager.on_pod_bound(pod.uid)
         self._quota_plugin.on_pod_add(pod)
+        if bound:
+            # an already-bound pod entering the cache (restart catch-up /
+            # standby watch): its quota 'used' was accounted by whoever
+            # bound it — mirror it here, as the reference's OnPodAdd does
+            # for scheduled pods (elasticquota plugin.go updatePodUsed)
+            self._account_quota(pod)
+
+    def _observe_binding(self, pod: PodSpec) -> None:
+        """A binding decided elsewhere became visible: promote the pod
+        pending -> assigned and mirror the accounting the deciding
+        scheduler applied locally (quota used, gang bound)."""
+        self.cache.promote_assigned(pod)
+        self._account_quota(pod)
+        if pod.gang:
+            self.gang_manager.on_pod_bound(pod.uid)
 
     def remove_pod(self, pod: PodSpec) -> None:
         cached = self.cache.pods.get(pod.uid)
@@ -296,9 +337,15 @@ class Scheduler:
         self._fine_waiting.pop(pod.uid, None)
         # a deleted waiting pod never ran: undo its reservation consumption
         self._rollback_reservation(pod.uid)
-        if was_assigned:
+        if was_assigned and (
+            not getattr(cached, "waiting_permit", False)
+            or pod.uid in self._waiting
+        ):
             # an assigned pod's quota 'used' was accounted at assume time
-            # (bind or Permit hold) and must be released with it
+            # (bind or Permit hold, both local) or at bound-pod intake
+            # (standby/restart); a STANDBY never accounts a Permit-held
+            # pod (waiting_permit, not in our _waiting), so it must not
+            # release one either
             self._account_quota(cached, release=True)
         self._waiting.pop(pod.uid, None)
         self._waiting_since.pop(pod.uid, None)

@@ -1,0 +1,396 @@
+"""Actuation-edge hooks: device env inject, cpu-normalization quota
+scaling, terway net-QoS config files (VERDICT r3 #3/#7).
+
+Oracles: runtimehooks/hooks/gpu/gpu.go:51 (InjectContainerGPUEnv),
+hooks/cpunormalization/cpu_normalization.go:79-171 (quota scaling +
+isPodCPUShare), hooks/terwayqos/terwayqos.go (config generation,
+parseNetQoS tiers, getPodPrio).
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from koordinator_tpu.apis.extension import (
+    ANNOTATION_CPU_NORMALIZATION_RATIO,
+    ANNOTATION_DEVICE_ALLOCATED,
+    ANNOTATION_RESOURCE_STATUS,
+    LABEL_QOS_CLASS,
+    QoSClass,
+)
+from koordinator_tpu.apis.types import NodeSpec
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.metricsadvisor.framework import PodMeta
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.resourceexecutor.executor import (
+    ensure_cgroup_dir,
+)
+from koordinator_tpu.koordlet.runtimehooks import (
+    CPUNormalizationPlugin,
+    DeviceEnvPlugin,
+    HookRegistry,
+    RuntimeHooks,
+    RuntimeHookServer,
+    TerwayQosPlugin,
+    milli_cpu_to_quota,
+)
+from koordinator_tpu.koordlet.runtimehooks.terwayqos import (
+    ANNOTATION_NET_QOS,
+    NET_QOS_POLICY_KEY,
+    NET_QOS_POLICY_TERWAY,
+)
+from koordinator_tpu.koordlet.statesinformer import StatesInformer
+from koordinator_tpu.koordlet.system.cgroup import CPU_CFS_QUOTA, SystemConfig
+from koordinator_tpu.manager.sloconfig import NetworkQOS, NodeSLOSpec
+
+
+def device_annotations(gpu_minors=(0, 2), rdma_vfs=("0000:81:00.2",)):
+    return {
+        ANNOTATION_DEVICE_ALLOCATED: json.dumps({
+            "gpu": [{"minor": m, "resources": {}} for m in gpu_minors],
+            "rdma": [{"minor": 0, "resources": {}, "vfs": list(rdma_vfs)}],
+        })
+    }
+
+
+class TestDeviceEnvInject:
+    def _server(self):
+        registry = HookRegistry()
+        DeviceEnvPlugin().register(registry)
+        return RuntimeHookServer(registry)
+
+    def test_allocated_pod_gets_env(self):
+        pod = PodMeta(
+            "p1", "kubepods/podp1", QoSClass.LSR,
+            containers={"main": "kubepods/podp1/main"},
+            annotations=device_annotations(),
+        )
+        resp = self._server().create_container(pod, "main", apply=False)
+        assert resp.add_envs["TPU_VISIBLE_CHIPS"] == "0,2"
+        assert resp.add_envs["NVIDIA_VISIBLE_DEVICES"] == "0,2"
+        assert resp.add_envs["KOORDINATOR_RDMA_VFS"] == "0000:81:00.2"
+
+    def test_no_allocation_no_env(self):
+        pod = PodMeta("p2", "kubepods/podp2", QoSClass.LS,
+                      containers={"main": "kubepods/podp2/main"})
+        resp = self._server().create_container(pod, "main", apply=False)
+        assert resp.add_envs is None
+
+    def test_injection_through_cri_proxy(self):
+        """The NRI/proxy path: the env response merges into the container
+        creation request the runtime actually sees — the allocator's
+        output lands in the container (VERDICT r3 #3)."""
+        from koordinator_tpu.runtimeproxy import (
+            CRIRequest,
+            RuntimeManagerCriServer,
+        )
+
+        class Backend:
+            def __init__(self):
+                self.requests = []
+
+            def handle(self, request):
+                self.requests.append(request)
+                return {"ok": True}
+
+            def list_pods(self):
+                return []
+
+        registry = HookRegistry()
+        DeviceEnvPlugin().register(registry)
+        backend = Backend()
+        proxy = RuntimeManagerCriServer(
+            RuntimeHookServer(registry), backend
+        )
+        pod = PodMeta(
+            "p3", "kubepods/podp3", QoSClass.LSR,
+            containers={"main": "kubepods/podp3/main"},
+            annotations=device_annotations(gpu_minors=(1,)),
+        )
+        proxy.intercept(CRIRequest(method="RunPodSandbox", pod=pod))
+        proxy.intercept(
+            CRIRequest(method="CreateContainer", pod=pod, container="main")
+        )
+        forwarded = backend.requests[-1]
+        assert forwarded.resources.add_envs["TPU_VISIBLE_CHIPS"] == "1"
+
+
+def test_device_pod_scheduler_to_env_e2e():
+    """The full actuation loop (VERDICT r3 #3 done-criterion): a
+    device-requesting pod is placed by the scheduler, DeviceShare PreBind
+    writes the allocation annotation, the koordlet-side projection turns
+    the bound PodSpec into PodMeta, and the device hook injects the
+    allocated minors into the container env at creation."""
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot,
+        NodeMetric,
+        NodeSpec,
+        PodSpec,
+    )
+    from koordinator_tpu.device.cache import (
+        DeviceEntry,
+        DeviceResourceName as DR,
+        DeviceType,
+    )
+    from koordinator_tpu.koordlet.statesinformer.reporters import (
+        pod_meta_from_spec,
+    )
+    from koordinator_tpu.scheduler import Scheduler
+
+    from koordinator_tpu.apis.extension import ResourceName as RN
+
+    sched = Scheduler()
+    sched.add_node(NodeSpec(name="n0", allocatable={
+        RN.CPU: 16000, RN.MEMORY: 32768,
+    }))
+    sched.update_node_metric(NodeMetric(
+        node_name="n0", node_usage={}, update_time=99.0
+    ))
+    sched.update_node_devices("n0", [
+        DeviceEntry(minor=i, device_type=DeviceType.GPU,
+                    resources={DR.GPU_CORE: 100, DR.GPU_MEMORY: 16384,
+                               DR.GPU_MEMORY_RATIO: 100},
+                    numa_node=0, pcie_id="0")
+        for i in range(2)
+    ])
+    pod = PodSpec(
+        name="gpu-pod",
+        requests={RN.CPU: 1000, RN.MEMORY: 1024},
+        device_requests={DR.NVIDIA_GPU: 1},
+    )
+    sched.update_pod(pod)
+    result = sched.schedule_pending(now=100.0)
+    assert result["default/gpu-pod"] == "n0"
+    bound = sched.cache.pods["default/gpu-pod"]
+    assert ANNOTATION_DEVICE_ALLOCATED in bound.annotations
+
+    registry = HookRegistry()
+    DeviceEnvPlugin().register(registry)
+    meta = pod_meta_from_spec(bound)
+    resp = RuntimeHookServer(registry).create_container(
+        meta, "main", apply=False
+    )
+    assert resp.add_envs["TPU_VISIBLE_CHIPS"] in ("0", "1")
+
+
+class TestCPUNormalization:
+    def _plugin(self, ratio):
+        p = CPUNormalizationPlugin()
+        node = NodeSpec(name="n0", annotations={
+            ANNOTATION_CPU_NORMALIZATION_RATIO: str(ratio)
+        })
+        p.update_rule(node)
+        return p
+
+    def _pod_ctx(self, pod):
+        from koordinator_tpu.koordlet.runtimehooks.protocol import PodContext
+
+        return PodContext.from_meta(pod)
+
+    def test_ls_pod_quota_scaled_ceil(self):
+        p = self._plugin(1.3)
+        pod = PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS,
+                      cpu_limit_mcpu=2000)
+        ctx = self._pod_ctx(pod)
+        p.adjust_pod_cfs_quota(ctx)
+        assert ctx.response.cfs_quota_us == math.ceil(
+            milli_cpu_to_quota(2000) / 1.3
+        )
+
+    def test_container_quota_scaled(self):
+        from koordinator_tpu.koordlet.runtimehooks.protocol import (
+            ContainerContext,
+        )
+
+        p = self._plugin(2.0)
+        pod = PodMeta(
+            "ls", "kubepods/burstable/podls", QoSClass.LS,
+            containers={"main": "kubepods/burstable/podls/main"},
+            container_limits_mcpu={"main": 1000},
+        )
+        ctx = ContainerContext.from_meta(pod, "main")
+        p.adjust_container_cfs_quota(ctx)
+        assert ctx.response.cfs_quota_us == math.ceil(
+            milli_cpu_to_quota(1000) / 2.0
+        )
+
+    def test_ratio_at_most_one_restores_spec_quota(self):
+        """No kubelet re-asserts spec quotas here: a removed/<=1 ratio
+        must actively write the UNSCALED quota back, or a previously
+        shrunk pod would stay shrunk forever."""
+        p = self._plugin(1.0)
+        pod = PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS,
+                      cpu_limit_mcpu=2000)
+        ctx = self._pod_ctx(pod)
+        p.adjust_pod_cfs_quota(ctx)
+        assert ctx.response.cfs_quota_us == milli_cpu_to_quota(2000)
+
+    def test_ratio_removal_restores_in_cgroupfs(self, tmp_path):
+        """Shrink under ratio 2.0, then remove the annotation: the next
+        reconcile writes the full spec quota back."""
+        pod = PodMeta(
+            "ls", "kubepods/burstable/podls", QoSClass.LS,
+            containers={"main": "kubepods/burstable/podls/main"},
+            cpu_limit_mcpu=4000,
+            container_limits_mcpu={"main": 4000},
+        )
+        cfg = SystemConfig(
+            cgroup_root=str(tmp_path / "cg"),
+            proc_root=str(tmp_path / "proc"),
+            terway_qos_root=str(tmp_path / "terway"),
+        )
+        for d in ("kubepods", "kubepods/burstable", "kubepods/besteffort",
+                  pod.cgroup_dir, pod.containers["main"]):
+            ensure_cgroup_dir(d, cfg)
+        executor = ResourceUpdateExecutor(cfg, auditor=Auditor())
+        informer = StatesInformer()
+        informer.set_pods([pod])
+        RuntimeHooks(informer, executor)
+        quota_file = os.path.join(
+            cfg.cgroup_root, "cpu", pod.cgroup_dir, "cpu.cfs_quota_us"
+        )
+        informer.set_node(NodeSpec(name="n0", annotations={
+            ANNOTATION_CPU_NORMALIZATION_RATIO: "2.0",
+        }))
+        assert open(quota_file).read() == str(
+            math.ceil(milli_cpu_to_quota(4000) / 2.0)
+        )
+        informer.set_node(NodeSpec(name="n0", annotations={}))
+        assert open(quota_file).read() == str(milli_cpu_to_quota(4000))
+
+    def test_be_pod_excluded(self):
+        p = self._plugin(1.5)
+        pod = PodMeta("be", "kubepods/besteffort/podbe", QoSClass.BE,
+                      cpu_limit_mcpu=2000)
+        ctx = self._pod_ctx(pod)
+        p.adjust_pod_cfs_quota(ctx)
+        assert ctx.response.cfs_quota_us is None
+
+    def test_pinned_pod_excluded(self):
+        p = self._plugin(1.5)
+        pod = PodMeta(
+            "pin", "kubepods/podpin", QoSClass.NONE, cpu_limit_mcpu=2000,
+            annotations={
+                ANNOTATION_RESOURCE_STATUS: json.dumps({"cpuset": [0, 1]})
+            },
+        )
+        ctx = self._pod_ctx(pod)
+        p.adjust_pod_cfs_quota(ctx)
+        assert ctx.response.cfs_quota_us is None
+
+    def test_unlimited_pod_untouched(self):
+        p = self._plugin(1.5)
+        pod = PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS)
+        ctx = self._pod_ctx(pod)
+        p.adjust_pod_cfs_quota(ctx)
+        assert ctx.response.cfs_quota_us is None
+
+    def test_normalized_node_scales_quota_in_fake_cgroupfs(self, tmp_path):
+        """End-to-end (VERDICT r3 #3 done-criterion): annotated node ->
+        informer NODE callback -> reconcile writes the scaled quota into
+        the fake cgroupfs for the LS pod."""
+        pod = PodMeta(
+            "ls", "kubepods/burstable/podls", QoSClass.LS,
+            containers={"main": "kubepods/burstable/podls/main"},
+            cpu_limit_mcpu=4000,
+            container_limits_mcpu={"main": 4000},
+        )
+        cfg = SystemConfig(
+            cgroup_root=str(tmp_path / "cg"),
+            proc_root=str(tmp_path / "proc"),
+            terway_qos_root=str(tmp_path / "terway"),
+        )
+        for d in ("kubepods", "kubepods/burstable", "kubepods/besteffort",
+                  pod.cgroup_dir, pod.containers["main"]):
+            ensure_cgroup_dir(d, cfg)
+        executor = ResourceUpdateExecutor(cfg, auditor=Auditor())
+        informer = StatesInformer()
+        informer.set_pods([pod])
+        hooks = RuntimeHooks(informer, executor)
+        informer.set_node(NodeSpec(name="n0", annotations={
+            ANNOTATION_CPU_NORMALIZATION_RATIO: "1.6",
+        }))
+        want = str(math.ceil(milli_cpu_to_quota(4000) / 1.6))
+        quota_file = os.path.join(
+            cfg.cgroup_root, "cpu", pod.cgroup_dir, "cpu.cfs_quota_us"
+        )
+        assert open(quota_file).read() == want
+
+
+class TestTerwayQos:
+    def _slo(self, policy=NET_QOS_POLICY_TERWAY, total_bps=10_000_000_000):
+        slo = NodeSLOSpec()
+        slo.resource_qos_strategy.policies[NET_QOS_POLICY_KEY] = policy
+        slo.system_strategy.total_network_bandwidth_bps = total_bps
+        slo.resource_qos_strategy.ls.network = NetworkQOS(
+            enable=True, ingress_request=50, ingress_limit=100,
+            egress_request=50, egress_limit=100,
+        )
+        slo.resource_qos_strategy.be.network = NetworkQOS(
+            enable=True, ingress_request=10, ingress_limit=40,
+            egress_request=10, egress_limit="2000000000",
+        )
+        return slo
+
+    def test_node_config_tiers(self, tmp_path):
+        plugin = TerwayQosPlugin(str(tmp_path))
+        plugin.update_node_slo(self._slo())
+        text = open(plugin.node_file).read()
+        cfg = dict(
+            line.split("=") for line in text.strip().splitlines()
+        )
+        total_bytes = 10_000_000_000 // 8
+        assert int(cfg["hw_tx_bps_max"]) == total_bytes
+        assert int(cfg["l1_rx_bps_min"]) == total_bytes // 2   # 50%
+        assert int(cfg["l2_rx_bps_max"]) == total_bytes * 40 // 100
+        # absolute bits/s string -> bytes
+        assert int(cfg["l2_tx_bps_max"]) == 2_000_000_000 // 8
+
+    def test_pod_config_prio_and_limits(self, tmp_path):
+        plugin = TerwayQosPlugin(str(tmp_path))
+        plugin.update_node_slo(self._slo())
+        pods = [
+            PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS,
+                    labels={LABEL_QOS_CLASS: QoSClass.LS.value},
+                    annotations={ANNOTATION_NET_QOS: json.dumps(
+                        {"ingressLimit": "800000000", "egressLimit": "400000000"}
+                    )}),
+            PodMeta("be", "kubepods/besteffort/podbe", QoSClass.BE),
+            PodMeta("plain", "kubepods/podplain", QoSClass.NONE),
+        ]
+        plugin.update_pods(pods)
+        data = json.loads(open(plugin.pod_file).read())
+        assert data["ls"]["prio"] == 1
+        assert data["ls"]["ingress_bandwidth"] == 100_000_000
+        assert data["ls"]["egress_bandwidth"] == 50_000_000
+        assert data["be"]["prio"] == 2       # kube besteffort tier
+        assert data["plain"]["prio"] == 1    # guaranteed tier fallback
+
+    def test_disable_removes_files(self, tmp_path):
+        plugin = TerwayQosPlugin(str(tmp_path))
+        plugin.update_node_slo(self._slo())
+        assert os.path.exists(plugin.node_file)
+        plugin.update_node_slo(self._slo(policy="none"))
+        assert not os.path.exists(plugin.node_file)
+        assert not os.path.exists(plugin.pod_file)
+
+    def test_wired_through_runtimehooks_callbacks(self, tmp_path):
+        cfg = SystemConfig(
+            cgroup_root=str(tmp_path / "cg"),
+            proc_root=str(tmp_path / "proc"),
+            terway_qos_root=str(tmp_path / "terway"),
+        )
+        for d in ("kubepods", "kubepods/burstable", "kubepods/besteffort"):
+            ensure_cgroup_dir(d, cfg)
+        executor = ResourceUpdateExecutor(cfg, auditor=Auditor())
+        informer = StatesInformer()
+        hooks = RuntimeHooks(informer, executor)
+        informer.set_node_slo(self._slo())
+        assert os.path.exists(hooks.terwayqos.node_file)
+        informer.set_pods([
+            PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS,
+                    containers={}),
+        ])
+        assert "ls" in json.loads(open(hooks.terwayqos.pod_file).read())

@@ -89,8 +89,9 @@ class TestIncrementalPreemption:
         for i, prio in enumerate((30, 20)):
             pod = PodSpec(name=f"v{i}", quota="a", priority=prio,
                           requests={R.CPU: 4000}, node_name="n0")
+            # add_pod accounts an already-assigned pod's quota used
+            # (restart/standby catch-up contract) — no manual Reserve
             s.add_pod(pod)
-            s._quota_plugin.reserve(None, None, pod, None)
         # n0 has 2000 free; the preemptor needs 4000 there: ONE victim
         # suffices. Fill n1 so it isn't a free alternative.
         filler = PodSpec(name="filler", priority=1000, preemptible=False,
