@@ -161,9 +161,14 @@ class NodeMetric:
     # percentile -> usage, for aggregated usage mode (p50/p90/p95/p99)
     aggregated_usage: Dict[int, Resources] = dataclasses.field(default_factory=dict)
     # the aggregation window (seconds) the percentiles above were computed
-    # over (reference: AggregatedNodeUsages[].Duration; this reporter
-    # produces one window — the collect policy's aggregate duration)
+    # over (the collect policy's primary aggregate duration)
     aggregated_duration: Optional[float] = None
+    # additional windows: duration seconds -> percentile -> usage
+    # (reference: AggregatedNodeUsages[] — one entry per
+    # AggregatePolicy.Durations window)
+    aggregated_windows: Dict[float, Dict[int, Resources]] = dataclasses.field(
+        default_factory=dict
+    )
     # host application name -> usage (reference: NodeMetric
     # HostApplicationMetric list, which also carries the app's QoS)
     host_app_usages: Dict[str, Resources] = dataclasses.field(

@@ -46,6 +46,20 @@ _PCTS = {
 }
 
 
+def _percentile_usages(cpu_row, mem_row) -> Dict[int, dict]:
+    """percentile -> sparse usage map from one window's aggregate rows."""
+    out: Dict[int, dict] = {}
+    for pct, agg in _PCTS.items():
+        usage = {}
+        if cpu_row[agg] is not None:
+            usage[ResourceName.CPU] = int(cpu_row[agg])
+        if mem_row[agg] is not None:
+            usage[ResourceName.MEMORY] = int(mem_row[agg])
+        if usage:
+            out[pct] = usage
+    return out
+
+
 class NodeMetricReporter:
     def __init__(self, metric_cache: MetricCache, informer: StatesInformer,
                  predict_server: Optional[PeakPredictServer] = None):
@@ -83,20 +97,27 @@ class NodeMetricReporter:
             metric.node_usage[ResourceName.CPU] = int(cpu_row[A.AVG])
         if mem_row[A.AVG] is not None:
             metric.node_usage[ResourceName.MEMORY] = int(mem_row[A.AVG])
-        for pct, agg in _PCTS.items():
-            usage = {}
-            if cpu_row[agg] is not None:
-                usage[ResourceName.CPU] = int(cpu_row[agg])
-            if mem_row[agg] is not None:
-                usage[ResourceName.MEMORY] = int(mem_row[agg])
-            if usage:
-                metric.aggregated_usage[pct] = usage
+        metric.aggregated_usage = _percentile_usages(cpu_row, mem_row)
         if metric.aggregated_usage:
             # the declared policy window, not the float-computed now-start
             # difference: the scheduler's window selection compares exactly
             metric.aggregated_duration = float(
                 policy.aggregate_duration_seconds if policy else 300
             )
+        # extra aggregation windows (reference: AggregatePolicy.Durations
+        # -> one AggregatedNodeUsages entry each); batched per window
+        for dur in getattr(policy, "aggregate_durations", ()) or ():
+            dur = float(dur)
+            if dur == metric.aggregated_duration:
+                continue
+            w_cpu, w_mem = mc.aggregate_batch(
+                [(MetricKind.NODE_CPU_USAGE, None),
+                 (MetricKind.NODE_MEMORY_USAGE, None)],
+                now - dur, now, list(_PCTS.values()),
+            )
+            by_pct = _percentile_usages(w_cpu, w_mem)
+            if by_pct:
+                metric.aggregated_windows[dur] = by_pct
 
         # per-pod usage: ONE batched matrix reduction for all pods
         pods = self.informer.running_pods()

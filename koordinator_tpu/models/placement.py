@@ -126,6 +126,10 @@ class PlacementModel:
             filter_thresholds = aggregated.usage_thresholds
         else:
             filter_thresholds = usage_thresholds or DEFAULT_USAGE_THRESHOLDS
+        #: dict forms retained so the incremental plugin chain can be
+        #: configured identically (scheduler/scheduler.py wiring)
+        self.usage_thresholds = dict(filter_thresholds)
+        self.prod_usage_thresholds = dict(prod_usage_thresholds or {})
         self.params = ScoreParams(
             weights=jnp.asarray(_vec(self.resource_weights)),
             thresholds=jnp.asarray(_vec(filter_thresholds)),
@@ -162,6 +166,16 @@ class PlacementModel:
 
         self._pallas_eligible = pallas_supported(self.params, self.config)
         self._solve = jax.jit(solve_batch, static_argnames=("config",))
+
+    def lowering_kwargs(self) -> dict:
+        """The lower_nodes configuration this model schedules with —
+        shared with the incremental plugin chain and the preemption
+        path so every consumer lowers identically."""
+        return {
+            "scaling_factors": self.scaling_factors,
+            "resource_weights": self.resource_weights,
+            "aggregated": self.aggregated,
+        }
 
     # -- staging ------------------------------------------------------------
 

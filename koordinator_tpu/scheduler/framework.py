@@ -134,10 +134,15 @@ class ScheduleOutcome:
 class SchedulingFramework:
     """Runs one pod through the full plugin chain (SURVEY.md §3.1)."""
 
-    def __init__(self, plugins: Sequence[Plugin], monitor=None, debug=None):
+    def __init__(self, plugins: Sequence[Plugin], monitor=None, debug=None,
+                 cycle_seed=None):
         self.plugins = list(plugins)
         self.monitor = monitor
         self.debug = debug
+        #: entries copied into every fresh CycleState (per-scheduler
+        #: configuration the shared lowering needs, e.g. the LoadAware
+        #: aggregated profile)
+        self.cycle_seed = dict(cycle_seed or {})
 
     def schedule_one(
         self, snapshot: ClusterSnapshot, pod: PodSpec
@@ -172,7 +177,7 @@ class SchedulingFramework:
         )
 
     def _schedule_one(self, snapshot, pod) -> ScheduleOutcome:
-        state = CycleState()
+        state = CycleState(self.cycle_seed)
 
         for plugin in self.plugins:
             plugin.before_pre_filter(state, snapshot, pod)

@@ -123,8 +123,13 @@ class ElasticQuotaPlugin(Plugin):
                     if self.enable_runtime_quota
                     else info.max
                 )
+        from koordinator_tpu.scheduler.plugins.lowering import THRESHOLDS_KEY
+
         arrays = state.get(ARRAYS_STATE_KEY) if state is not None else None
+        thr = state.get(THRESHOLDS_KEY) if state is not None else None
         return find_preemption(
             snapshot, pod, quota_used=quota_used, used_limit=used_limit,
             arrays=arrays,
+            thresholds=thr[0] if thr else None,
+            prod_thresholds=thr[1] if thr else None,
         )

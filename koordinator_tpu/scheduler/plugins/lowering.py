@@ -16,6 +16,15 @@ import numpy as np
 from koordinator_tpu.state.cluster import NodeArrays, lower_nodes
 
 _VIEW_KEY = "__node_view__"
+#: CycleState seed key: lower_nodes kwargs (scaling factors, resource
+#: weights, LoadAware aggregated profile) — set by the Scheduler's
+#: framework cycle_seed from PlacementModel.lowering_kwargs() so the
+#: incremental chain lowers exactly as the batched solver does
+LOWERING_KEY = "__lowering_kwargs__"
+#: CycleState seed key: (thresholds[R], prod_thresholds[R]) numpy vectors
+#: the LoadAware filter runs with — consumed by the preemption path so
+#: it never nominates a node the configured filter would reject
+THRESHOLDS_KEY = "__loadaware_thresholds__"
 
 
 @dataclasses.dataclass
@@ -30,7 +39,7 @@ class NodeView:
 def node_view(state, snapshot) -> NodeView:
     view = state.get(_VIEW_KEY)
     if view is None or view.arrays.n != len(snapshot.nodes):
-        arrays = lower_nodes(snapshot)
+        arrays = lower_nodes(snapshot, **(state.get(LOWERING_KEY) or {}))
         view = NodeView(arrays=arrays, index=arrays.index(), extra_used={})
         state[_VIEW_KEY] = view
     return view

@@ -13,6 +13,8 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from koordinator_tpu.apis.types import (
     GangSpec,
     NodeMetric,
@@ -127,6 +129,11 @@ class Scheduler:
         # across schedulers would otherwise apply holds to the old one's
         model.fine = fine
         self.model = model
+        from koordinator_tpu.scheduler.plugins.lowering import (
+            LOWERING_KEY,
+            THRESHOLDS_KEY,
+        )
+
         self.framework = SchedulingFramework(
             plugins=[
                 ReservationPlugin(),
@@ -135,12 +142,31 @@ class Scheduler:
                 self._numa_plugin,
                 self._device_plugin,
                 self._ports_plugin,
-                NodeResourcesFit(),
-                LoadAwareScheduling(),
+                NodeResourcesFit(
+                    weights=model.resource_weights,
+                    weight=model.config.fit_weight,
+                ),
+                # configured from the model so the incremental chain and
+                # the batched solver apply the same thresholds/modes
+                LoadAwareScheduling(
+                    resource_weights=model.resource_weights,
+                    usage_thresholds=model.usage_thresholds,
+                    prod_usage_thresholds=model.prod_usage_thresholds,
+                    scaling_factors=model.scaling_factors,
+                    score_according_prod=model.config.score_according_prod,
+                    weight=model.config.loadaware_weight,
+                ),
                 DefaultPreBind(),
             ],
             monitor=self.monitor,
             debug=self.debug,
+            cycle_seed={
+                LOWERING_KEY: model.lowering_kwargs(),
+                THRESHOLDS_KEY: (
+                    np.asarray(model.params.thresholds),
+                    np.asarray(model.params.prod_thresholds),
+                ),
+            },
         )
         self.services.register(
             "Coscheduling",
@@ -461,8 +487,10 @@ class Scheduler:
             attempts += 1
             PREEMPTION_ATTEMPTS.inc()
             if arrays is None:
-                arrays = lower_nodes(snapshot)
-            state = CycleState()
+                arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
+            # seeded like a plugin-chain cycle: the preemption filter
+            # must run with the model's thresholds/aggregated profile
+            state = CycleState(self.framework.cycle_seed)
             state[ARRAYS_STATE_KEY] = arrays
             nomination = self._quota_plugin.post_filter(state, snapshot, pod)
             if nomination is None:
@@ -474,7 +502,7 @@ class Scheduler:
             snapshot.pods = [
                 p for p in snapshot.pods if p.uid not in victim_uids
             ]
-            arrays = lower_nodes(snapshot)
+            arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
             result.nominations[uid] = node_name
 
     def _evict_victims(self, uids: List[str]) -> None:

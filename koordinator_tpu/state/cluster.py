@@ -101,15 +101,33 @@ def target_aggregated_usage(
 
     Reference: loadaware/helper.go:58-90 getTargetAggregatedUsage — no
     aggregated usages reported → None; no duration requested → the
-    largest reported window (this reporter produces exactly one); a
-    requested duration must match a reported window exactly.
+    LARGEST reported window; a requested duration must match a reported
+    window exactly. Windows are the primary ``aggregated_usage`` (at
+    ``aggregated_duration``) plus the extra ``aggregated_windows``.
     """
-    if not metric.aggregated_usage or pct is None:
+    if pct is None:
         return None
-    if duration_seconds and metric.aggregated_duration != duration_seconds:
+    # (duration, by_pct) candidates: the primary window (duration may be
+    # unreported -> treated as 0 for the max-window default) + extras
+    candidates = []
+    if metric.aggregated_usage:
+        candidates.append(
+            (metric.aggregated_duration or 0.0, metric.aggregated_usage)
+        )
+    candidates += [
+        (dur, by_pct)
+        for dur, by_pct in metric.aggregated_windows.items()
+        if by_pct
+    ]
+    if not candidates:
         return None
-    usage = metric.aggregated_usage.get(pct)
-    return usage or None
+    if duration_seconds:
+        for dur, by_pct in candidates:
+            if dur == duration_seconds:
+                return by_pct.get(pct) or None
+        return None
+    _, by_pct = max(candidates, key=lambda t: t[0])
+    return by_pct.get(pct) or None
 
 
 def translate_resource_by_priority(

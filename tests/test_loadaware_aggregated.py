@@ -175,6 +175,111 @@ def test_target_aggregated_usage_selection():
     assert target_aggregated_usage(NodeMetric(node_name="n"), None, 95) is None
 
 
+def test_target_aggregated_usage_multi_window():
+    """Multiple reported windows: exact duration match; no duration ->
+    the LARGEST window (helper.go:65-78 default policy)."""
+    m = NodeMetric(
+        node_name="n", aggregated_usage={95: {R.CPU: 5}},
+        aggregated_duration=300.0,
+        aggregated_windows={
+            900.0: {95: {R.CPU: 7}},
+            1800.0: {95: {R.CPU: 9}, 50: {R.CPU: 2}},
+        },
+    )
+    assert target_aggregated_usage(m, 300.0, 95) == {R.CPU: 5}
+    assert target_aggregated_usage(m, 900.0, 95) == {R.CPU: 7}
+    assert target_aggregated_usage(m, None, 95) == {R.CPU: 9}  # max window
+    assert target_aggregated_usage(m, None, 50) == {R.CPU: 2}
+    assert target_aggregated_usage(m, 1200.0, 95) is None
+
+
+def test_reporter_fills_extra_windows():
+    from koordinator_tpu.koordlet.metriccache import MetricCache, MetricKind
+    from koordinator_tpu.koordlet.statesinformer import (
+        NodeMetricReporter,
+        StatesInformer,
+    )
+    from koordinator_tpu.manager.nodemetric import NodeMetricCollectPolicy
+
+    mc = MetricCache()
+    informer = StatesInformer()
+    informer.set_node(
+        NodeSpec("n0", allocatable={R.CPU: 8000, R.MEMORY: 16384})
+    )
+    informer.set_pods([])
+    informer.set_collect_policy(NodeMetricCollectPolicy(300, 60))
+    # a spike 10 min ago is visible in the 900/1800s windows' p99 but
+    # not in the 300s window
+    for t in range(0, 1200, 60):
+        val = 7000.0 if t < 300 else 2000.0
+        mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), val)
+    m = NodeMetricReporter(mc, informer).report(now=1200.0)
+    assert m.aggregated_duration == 300.0
+    assert set(m.aggregated_windows) == {900.0, 1800.0}
+    assert m.aggregated_windows[1800.0][99][R.CPU] > \
+        m.aggregated_usage[99][R.CPU]
+
+
+def test_incremental_path_applies_aggregated_mode():
+    """BatchedPlacement=false must apply the same aggregated profile:
+    the plugin-chain cycle lowers with the model's AggregatedArgs
+    (cycle_seed -> node_view), so p95 rejects there too."""
+    from koordinator_tpu.models import PlacementModel
+    from koordinator_tpu.scheduler import Scheduler
+
+    for batched, expected in ((True, None), (False, None)):
+        s = Scheduler(model=PlacementModel(aggregated=AGG_FILTER))
+        s.batched_placement = batched
+        snap = _snap()  # avg 50% admits, p95 70% rejects at 65
+        s.add_node(snap.nodes[0])
+        s.update_node_metric(snap.node_metrics["n0"])
+        s.update_pod(snap.pending_pods[0])
+        out = s.schedule_pending(now=100.0)
+        assert out["default/p"] is expected, f"batched={batched}"
+    # control: without the profile both paths admit
+    for batched in (True, False):
+        s = Scheduler()
+        s.batched_placement = batched
+        snap = _snap()
+        s.add_node(snap.nodes[0])
+        s.update_node_metric(snap.node_metrics["n0"])
+        s.update_pod(snap.pending_pods[0])
+        out = s.schedule_pending(now=100.0)
+        assert out["default/p"] == "n0", f"batched={batched}"
+
+
+def test_incremental_lowering_uses_model_scaling_factors():
+    """The plugin-chain cycle must lower assigned-pod estimation with
+    the MODEL's scaling factors, not the defaults — otherwise the two
+    paths score the same queue differently."""
+    from koordinator_tpu.models import PlacementModel
+    from koordinator_tpu.scheduler import Scheduler
+    from koordinator_tpu.scheduler.framework import CycleState
+    from koordinator_tpu.scheduler.plugins.lowering import node_view
+
+    assigned = PodSpec(
+        name="a", node_name="n0", requests={R.CPU: 2000}, assign_time=99.5,
+    )
+    node = NodeSpec(name="n0", allocatable={R.CPU: 10000, R.MEMORY: 32768})
+    metric = NodeMetric(node_name="n0", node_usage={R.CPU: 100},
+                        update_time=99.0, report_interval=10.0)
+    snap = ClusterSnapshot(
+        nodes=[node], pods=[assigned], node_metrics={"n0": metric},
+        now=100.0,
+    )
+    s = Scheduler(model=PlacementModel(
+        scaling_factors={R.CPU: 50, R.MEMORY: 70}
+    ))
+    state = CycleState(s.framework.cycle_seed)
+    view = node_view(state, snap)
+    # assigned pod estimated at 50% of its 2000m request (assign after
+    # metric update -> should-estimate; no reported usage to subtract)
+    assert view.arrays.est_extra[0, R.CPU] == 1000
+    # a default-config scheduler estimates the same pod at 85%
+    view2 = node_view(CycleState(Scheduler().framework.cycle_seed), snap)
+    assert view2.arrays.est_extra[0, R.CPU] == 1700
+
+
 def test_reporter_stamps_aggregated_duration():
     """The koordlet reporter records the aggregation window so the
     scheduler's duration selection has something to match against."""
