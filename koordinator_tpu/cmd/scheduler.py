@@ -45,6 +45,10 @@ class SchedulerConfig:
     #: plain solves with pods*nodes under this run on the host sequential
     #: path — a device round trip costs more than the whole solve there
     host_fallback_cells: int = 16384
+    #: scan unroll (ops/binpack.SolverConfig.unroll): 32 is the measured
+    #: throughput optimum on v5e; the library default (8) favors compile
+    #: time instead
+    solver_unroll: int = 32
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -86,6 +90,7 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
             fit_weight=config.fit_weight,
             loadaware_weight=config.loadaware_weight,
             score_according_prod=config.score_according_prod,
+            unroll=config.solver_unroll,
         ),
         aggregated=aggregated,
         backend=backend,
