@@ -268,6 +268,19 @@ def build_koordlet(
         StateKind.NODE_SLO,
         lambda kind, slo: setattr(qos_ctx, "node_slo", slo),
     )
+    # the cpu-normalization ratio (node annotation) feeds quota-burst
+    # bases so burst scaling floors at the normalized quota
+    from koordinator_tpu.koordlet.runtimehooks.cpunormalization import (
+        parse_ratio_from_annotations,
+    )
+
+    states_informer.register_callback(
+        StateKind.NODE,
+        lambda kind, node: setattr(
+            qos_ctx, "cpu_normalization_ratio",
+            parse_ratio_from_annotations(getattr(node, "annotations", None)),
+        ),
+    )
 
     # runtimehooks: bvt/cpuset/batchresource actuation (koordlet.go runs
     # runtimeHooks last); reconciler mode is always armed, NRI mode

@@ -57,6 +57,10 @@ class QoSContext:
     #: PVC claim key ("namespace/name") -> bound PV name (the
     #: statesinformer's get_volume_name; states_pvc.go)
     volume_name_fn: Optional[Callable[[str], str]] = None
+    #: active cpu-normalization ratio (node annotation, parsed by the
+    #: informer wiring); quota-burst bases divide by it so the two
+    #: features compose instead of fighting
+    cpu_normalization_ratio: Optional[float] = None
     #: PV name -> block device "MAJ:MIN" (the host's volume attachment
     #: view; the reference walks /var/lib/kubelet + sysfs for this)
     volume_devices: Dict[str, str] = dataclasses.field(default_factory=dict)

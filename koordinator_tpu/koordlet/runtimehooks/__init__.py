@@ -161,9 +161,11 @@ class RuntimeHooks:
 
     def _on_node(self, kind: StateKind, node) -> None:
         # cpu-normalization ratio rides the node annotation (the rule's
-        # RegisterTypeNodeMetadata parse); a change re-actuates quotas
+        # RegisterTypeNodeMetadata parse); a change re-actuates quotas,
+        # and a removal restores spec quotas exactly once
         if self.cpunormalization.update_rule(node):
             self.reconcile()
+            self.cpunormalization.finish_restore()
 
     # -- public surface ------------------------------------------------------
 

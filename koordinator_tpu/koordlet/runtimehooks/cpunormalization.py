@@ -74,6 +74,9 @@ class CPUNormalizationPlugin:
 
     def __init__(self):
         self.ratio: Optional[float] = None  # None/<=1 = disabled
+        #: one-shot restore: True between a ratio-removal rule change and
+        #: the reconcile pass that writes spec quotas back
+        self.restoring: bool = False
 
     def update_rule(self, node) -> bool:
         """parseRule from the node metadata; returns True on change."""
@@ -81,23 +84,29 @@ class CPUNormalizationPlugin:
             getattr(node, "annotations", None) if node is not None else None
         )
         changed = new != self.ratio
+        if changed and new is None:
+            self.restoring = True
         self.ratio = new
         return changed
 
+    def finish_restore(self) -> None:
+        """Called after the restore reconcile pass has run."""
+        self.restoring = False
+
     def _scaled_quota(self, limit_mcpu: int) -> Optional[int]:
-        """ceil(spec quota / ratio) when scaling; the UNSCALED spec quota
-        when the ratio is absent/<= 1. The restore matters: there is no
-        kubelet in this framework re-asserting spec quotas, so a removed
-        ratio must actively write the full quota back or every LS pod
-        would stay shrunk forever (the reference's reconciler gets the
-        live cgroup value restored by the kubelet instead)."""
+        """ceil(spec quota / ratio) when scaling; during the ONE restore
+        pass after a ratio removal, the UNSCALED spec quota (no kubelet
+        re-asserts spec quotas in this framework — without the one-shot
+        write every LS pod would stay shrunk forever). Steady state
+        without a ratio is inert so the hook never fights the
+        cfs-quota-burst strategy's scale-ups (qosmanager/cpuburst.py)."""
         if limit_mcpu <= 0:
             return None
         quota = milli_cpu_to_quota(limit_mcpu)
         if quota <= 0:
             return None
         if self.ratio is None:
-            return quota
+            return quota if self.restoring else None
         return math.ceil(quota / self.ratio)
 
     def adjust_pod_cfs_quota(self, proto) -> None:

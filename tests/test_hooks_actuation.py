@@ -216,16 +216,30 @@ class TestCPUNormalization:
             milli_cpu_to_quota(1000) / 2.0
         )
 
-    def test_ratio_at_most_one_restores_spec_quota(self):
-        """No kubelet re-asserts spec quotas here: a removed/<=1 ratio
-        must actively write the UNSCALED quota back, or a previously
-        shrunk pod would stay shrunk forever."""
-        p = self._plugin(1.0)
+    def test_ratio_removal_restores_spec_quota_once(self):
+        """No kubelet re-asserts spec quotas here: removing the ratio
+        writes the UNSCALED quota back for ONE pass (then the hook goes
+        inert so it never fights cfs-quota-burst scale-ups)."""
+        p = self._plugin(1.5)
+        p.update_rule(NodeSpec(name="n0", annotations={}))  # removed
         pod = PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS,
                       cpu_limit_mcpu=2000)
         ctx = self._pod_ctx(pod)
         p.adjust_pod_cfs_quota(ctx)
         assert ctx.response.cfs_quota_us == milli_cpu_to_quota(2000)
+        p.finish_restore()
+        ctx2 = self._pod_ctx(pod)
+        p.adjust_pod_cfs_quota(ctx2)
+        assert ctx2.response.cfs_quota_us is None  # steady state: inert
+
+    def test_never_scaled_stays_inert(self):
+        p = CPUNormalizationPlugin()
+        p.update_rule(NodeSpec(name="n0", annotations={}))
+        pod = PodMeta("ls", "kubepods/burstable/podls", QoSClass.LS,
+                      cpu_limit_mcpu=2000)
+        ctx = self._pod_ctx(pod)
+        p.adjust_pod_cfs_quota(ctx)
+        assert ctx.response.cfs_quota_us is None
 
     def test_ratio_removal_restores_in_cgroupfs(self, tmp_path):
         """Shrink under ratio 2.0, then remove the annotation: the next

@@ -340,6 +340,126 @@ class TestCPUBurst:
                               ctx.system_config) == "0"
 
 
+class TestCFSQuotaBurst:
+    """The quota-burst half (cpu_burst.go applyCFSQuotaBurst): throttled
+    pods scale up 1.2x toward base*CFSQuotaBurstPercent; exhausted
+    limiter / overloaded node scale down 0.8x toward base."""
+
+    def _pod(self):
+        return PodMeta("ls", "kubepods/burstable/ls", QoSClass.LS,
+                       cpu_limit_mcpu=2000,
+                       containers={"c": "kubepods/burstable/ls/c"},
+                       container_limits_mcpu={"c": 2000})
+
+    def _ctx(self, tmp_path, quota_us=200000):
+        slo = NodeSLOSpec()
+        slo.cpu_burst_strategy.policy = "auto"
+        ctx = make_ctx(tmp_path, [self._pod()], slo=slo)
+        CPU_CFS_QUOTA.write("kubepods/burstable/ls", str(quota_us),
+                            ctx.system_config)
+        CPU_CFS_QUOTA.write("kubepods/burstable/ls/c", str(quota_us),
+                            ctx.system_config)
+        # idle share pool (an UNKNOWN node state holds scale-ups,
+        # matching changeOperationByNode)
+        ctx.metric_cache.append(
+            MetricKind.NODE_CPU_USAGE, None, 100.0, 1000.0)
+        return ctx
+
+    def test_throttled_pod_scales_up(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": "ls"}, 100.0, 0.4)
+        CPUBurst().execute(ctx, now=100.0)
+        # 200000 * 1.2 = 240000, under ceil 600000 (300%)
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "240000"
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls/c",
+                                  ctx.system_config) == "240000"
+
+    def test_scale_up_clamped_at_ceil(self, tmp_path):
+        ctx = self._ctx(tmp_path, quota_us=590000)
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": "ls"}, 100.0, 0.4)
+        CPUBurst().execute(ctx, now=100.0)
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "600000"
+
+    def test_unthrottled_pod_remains(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": "ls"}, 100.0, 0.0)
+        CPUBurst().execute(ctx, now=100.0)
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "200000"
+
+    def test_overloaded_node_scales_down(self, tmp_path):
+        ctx = self._ctx(tmp_path, quota_us=400000)
+        ctx.metric_cache.append(
+            MetricKind.NODE_CPU_USAGE, None, 100.0, 4800.0)  # 60% > 50%
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": "ls"}, 100.0, 0.4)
+        CPUBurst().execute(ctx, now=100.0)
+        # down step 0.8: 400000 -> 320000, floored at base 200000
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "320000"
+
+    def test_exhausted_limiter_scales_down(self, tmp_path):
+        ctx = self._ctx(tmp_path, quota_us=400000)
+        ctx.node_slo.cpu_burst_strategy.cfs_quota_burst_period_seconds = 10
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": "ls"}, 100.0, 0.4)
+        burst = CPUBurst()
+        # drain the token bucket: sustained usage at 300% of limit
+        for t in range(100, 160, 10):
+            ctx.metric_cache.append(
+                MetricKind.POD_CPU_USAGE, {"pod": "ls"}, float(t), 6000.0)
+            burst.execute(ctx, now=float(t))
+        assert burst._limiters["ls"].token <= 0
+        value = int(CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                       ctx.system_config))
+        assert value < 400000  # scaled down, not up, despite throttling
+
+    def test_reset_when_quota_burst_disabled(self, tmp_path):
+        ctx = self._ctx(tmp_path, quota_us=400000)
+        ctx.node_slo.cpu_burst_strategy.policy = "cpuBurstOnly"
+        CPUBurst().execute(ctx, now=100.0)
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "200000"
+
+    def test_policy_none_runs_one_cleanup_pass(self, tmp_path):
+        """Disabling the feature must not leave a 3x quota override:
+        the plugin stays enabled for ONE cleanup pass (reset quota,
+        zero burst buffer), then goes quiet."""
+        ctx = self._ctx(tmp_path)
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": "ls"}, 100.0, 0.4)
+        burst = CPUBurst()
+        burst.execute(ctx, now=100.0)
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "240000"
+        ctx.node_slo.cpu_burst_strategy.policy = "none"
+        assert burst.enabled(ctx)  # dirty: cleanup still due
+        burst.execute(ctx, now=101.0)
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "200000"
+        assert CPU_BURST.read("kubepods/burstable/ls",
+                              ctx.system_config) == "0"
+        assert not burst.enabled(ctx)  # clean: stays off now
+
+    def test_normalized_node_burst_floors_at_normalized_quota(self, tmp_path):
+        """With a cpu-normalization ratio active, burst bases divide by
+        the ratio: an overload scale-down shrinks toward the NORMALIZED
+        quota instead of inflating back to full spec."""
+        ctx = self._ctx(tmp_path, quota_us=125000)  # ceil(200000/1.6)
+        ctx.cpu_normalization_ratio = 1.6
+        ctx.metric_cache.append(
+            MetricKind.NODE_CPU_USAGE, None, 100.5, 4800.0)  # overload
+        CPUBurst().execute(ctx, now=100.0)
+        # down step 0.8 from 125000 clamps at base 125000 — NOT 200000
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx.system_config) == "125000"
+
+
 class TestQoSManager:
     def test_tick_intervals(self, tmp_path):
         runs = []
