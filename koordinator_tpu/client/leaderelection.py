@@ -64,10 +64,14 @@ class LeaderElector:
     """Acquire/renew loop for one identity on one lease.
 
     Drive with :meth:`tick` (idempotent, safe at any cadence; production
-    loops call it every ``retry_period``). Exactly one elector per lease
-    name observes ``is_leader() == True`` at any instant; the proof
-    obligation is discharged by doing every transition inside
-    ``bus.transact``.
+    loops call it every ``retry_period``). Like client-go, a deposed
+    leader may still observe ``is_leader() == True`` until its next tick
+    (the zombie window between losing the lease and noticing) —
+    ``is_leader`` is advisory. The HARD guarantee is :meth:`fenced`: at
+    most one identity's fenced writes succeed per lease token, checked
+    under the store lock, so a zombie's write raises :class:`FencingError`
+    instead of double-applying (tests/test_concurrency.py drives 16
+    electors from real threads to hold this).
     """
 
     def __init__(
@@ -197,13 +201,19 @@ class LeaderElector:
         return self.bus.transact(txn)
 
     def release(self) -> None:
-        """Voluntarily step down (graceful shutdown): clear the lease so
-        a standby can take over without waiting out the duration."""
+        """Voluntarily step down (graceful shutdown): expire the lease in
+        place so a standby can take over without waiting out the
+        duration. The lease object is KEPT (holder cleared, token
+        preserved) — deleting it would reset the token sequence to 1 and
+        let a later holder reuse an old token, breaking the fencing
+        tokens' monotonicity that external consumers order by."""
         def txn():
             lease = self.bus.get(Kind.LEASE, self.lease_name)
             if lease is not None and lease.holder == self.identity \
                     and lease.token == self._token:
-                self.bus.delete(Kind.LEASE, self.lease_name)
+                self.bus.apply(Kind.LEASE, self.lease_name, dataclasses.replace(
+                    lease, holder="", renew_time=float("-inf"),
+                ))
 
         if self._leading:
             self.bus.transact(txn)

@@ -117,11 +117,17 @@ class CPUBurst:
         }
 
     def _base_quota_us(self, ctx: QoSContext, limit_mcpu: int) -> int:
-        """The pod's steady-state quota: spec-derived, divided by the
-        active cpu-normalization ratio (the hook's ceil(quota/ratio)) so
-        burst scaling floors at the NORMALIZED value instead of silently
-        defeating normalization."""
-        quota = limit_mcpu * CFS_PERIOD_US // 1000
+        """The pod's steady-state quota: the SAME formula the
+        cpu-normalization hook writes (milli_cpu_to_quota, then
+        ceil(quota/ratio) when a ratio is active) so burst scaling floors
+        at the normalized value instead of ping-ponging against it."""
+        from koordinator_tpu.koordlet.runtimehooks.protocol import (
+            milli_cpu_to_quota,
+        )
+
+        quota = milli_cpu_to_quota(limit_mcpu)
+        if quota <= 0:
+            return quota
         ratio = ctx.cpu_normalization_ratio
         if ratio and ratio > 1.0:
             quota = math.ceil(quota / ratio)
@@ -204,16 +210,20 @@ class CPUBurst:
         return op
 
     def _scale_quota_dir(self, ctx: QoSContext, cgroup_dir: str,
-                         base: int, ceil: int, op: str) -> None:
+                         base: int, ceil: int, op: str) -> str:
         """Scale one dir's cfs quota (applyCFSQuotaBurst :397-407):
-        target = clamp(step(current), base, ceil)."""
+        target = clamp(step(current), base, ceil). Returns "wrote",
+        "unreadable" (dir not materialized — the cleanup pass must stay
+        armed), or "noop"."""
+        if base <= 0:
+            return "noop"
         try:
             raw = CPU_CFS_QUOTA.read(cgroup_dir, ctx.system_config)
             current = int(raw)
         except (OSError, ValueError):
-            return  # dir not materialized yet: skip this round
+            return "unreadable"  # not materialized yet: skip this round
         if current <= 0:
-            return  # unlimited: nothing to scale (:389-392)
+            return "noop"  # unlimited: nothing to scale (:389-392)
         if op == "up":
             target = int(current * CFS_INCREASE_STEP)
         elif op == "down":
@@ -221,15 +231,16 @@ class CPUBurst:
         elif op == "reset":
             target = base
         else:
-            return
+            return "noop"
         target = max(base, min(target, ceil))
         if target == current:
-            return
+            return "noop"
         ctx.executor.update(True, CgroupUpdater(
             "cpu.cfs_quota_us", cgroup_dir, str(target)))
         self._dirty = True
         ctx.log("cpuburst", cgroup_dir, "cfs_quota_burst",
                 f"{op}: {current} -> {target}")
+        return "wrote"
 
     # -- main ---------------------------------------------------------------
 
@@ -248,6 +259,7 @@ class CPUBurst:
         burst_allowed = strategy.policy in ("auto", "cpuBurstOnly") and (
             node_state != OVERLOAD
         )
+        cleanup_incomplete = False
         live_uids = set()
         for pod in pods:
             if pod.qos is QoSClass.BE or pod.cpu_limit_mcpu <= 0:
@@ -278,7 +290,10 @@ class CPUBurst:
             ceil = base
             if not cleanup and strategy.cfs_quota_burst_percent > 100:
                 ceil = base * strategy.cfs_quota_burst_percent // 100
-            self._scale_quota_dir(ctx, pod.cgroup_dir, base, ceil, op)
+            unreadable = (
+                self._scale_quota_dir(ctx, pod.cgroup_dir, base, ceil, op)
+                == "unreadable"
+            )
             for name, cdir in pod.containers.items():
                 climit = pod.container_limits_mcpu.get(name, 0)
                 if climit <= 0:
@@ -287,10 +302,20 @@ class CPUBurst:
                 cceil = cbase
                 if not cleanup and strategy.cfs_quota_burst_percent > 100:
                     cceil = cbase * strategy.cfs_quota_burst_percent // 100
-                self._scale_quota_dir(ctx, cdir, cbase, cceil, op)
+                if self._scale_quota_dir(
+                        ctx, cdir, cbase, cceil, op) == "unreadable":
+                    unreadable = True
+            if cleanup and unreadable:
+                cleanup_incomplete = True
         if cleanup:
-            self._dirty = False
-            self._limiters.clear()
+            # stay armed (dirty) while any scaled dir was unreadable this
+            # pass, so the reset retries next tick instead of stranding a
+            # burst quota override. (A pod absent from running_pods()
+            # during the window is the residual gap — same exposure the
+            # reference has when a pod vanishes mid-reconcile.)
+            if not cleanup_incomplete:
+                self._dirty = False
+                self._limiters.clear()
             return
         # limiter recycle (Recycle :638-645)
         for uid in list(self._limiters):

@@ -158,13 +158,20 @@ class RuntimeHooks:
     def _on_pods(self, kind: StateKind, pods: Sequence[PodMeta]) -> None:
         self.terwayqos.update_pods(pods)
         self.reconcile()
+        self._finish_restore_if_settled(pods)
 
     def _on_node(self, kind: StateKind, node) -> None:
         # cpu-normalization ratio rides the node annotation (the rule's
         # RegisterTypeNodeMetadata parse); a change re-actuates quotas,
-        # and a removal restores spec quotas exactly once
+        # and a removal restores spec quotas (one-shot, but kept armed
+        # while the informer's pod view is empty so a pod missing during
+        # the rule change still gets restored on its next PODS update)
         if self.cpunormalization.update_rule(node):
             self.reconcile()
+            self._finish_restore_if_settled(self.informer.running_pods())
+
+    def _finish_restore_if_settled(self, pods) -> None:
+        if self.cpunormalization.restoring and len(pods) > 0:
             self.cpunormalization.finish_restore()
 
     # -- public surface ------------------------------------------------------

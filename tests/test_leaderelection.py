@@ -84,7 +84,10 @@ class TestElection:
         a.release()
         assert not a.is_leader()
         assert b.tick(0.5) is True            # no expiry wait
-        assert bus.get(Kind.LEASE, "koord-scheduler").token == 1
+        # tokens stay monotone ACROSS a release: the lease object is
+        # kept (holder cleared), so b's token bumps past a's instead of
+        # restarting at 1 — fencing-token consumers order by it
+        assert bus.get(Kind.LEASE, "koord-scheduler").token == 2
 
     def test_deposed_leader_write_is_fenced(self):
         bus = APIServer()

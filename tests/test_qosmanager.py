@@ -453,11 +453,20 @@ class TestCFSQuotaBurst:
         ctx = self._ctx(tmp_path, quota_us=125000)  # ceil(200000/1.6)
         ctx.cpu_normalization_ratio = 1.6
         ctx.metric_cache.append(
-            MetricKind.NODE_CPU_USAGE, None, 100.5, 4800.0)  # overload
+            MetricKind.NODE_CPU_USAGE, None, 100.0, 4800.0)  # overload
         CPUBurst().execute(ctx, now=100.0)
         # down step 0.8 from 125000 clamps at base 125000 — NOT 200000
         assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
                                   ctx.system_config) == "125000"
+        # and scaling up from the normalized base stays under the
+        # normalized ceiling: 125000*1.2 = 150000 <= 375000
+        ctx2 = self._ctx(tmp_path, quota_us=125000)
+        ctx2.cpu_normalization_ratio = 1.6
+        ctx2.metric_cache.append(
+            MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": "ls"}, 100.0, 0.4)
+        CPUBurst().execute(ctx2, now=100.0)
+        assert CPU_CFS_QUOTA.read("kubepods/burstable/ls",
+                                  ctx2.system_config) == "150000"
 
 
 class TestQoSManager:
