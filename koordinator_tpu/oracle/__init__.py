@@ -6,4 +6,31 @@ Python floats are the same IEEE doubles).
 The JAX ops in ``koordinator_tpu.ops`` must match these bit-for-bit on
 canonical-unit inputs — golden tests in tests/ enforce it. The oracle also
 doubles as the measured "reference path" in bench comparisons.
+
+``oracle.vectorized`` carries the SAME sequential semantics with the
+inner node loop vectorized in int64 numpy — fast enough to prove device
+identity at full BASELINE shapes (its authority: the differential sweep
+against the scalar oracle in tests/test_oracle_vectorized.py).
 """
+
+from koordinator_tpu.oracle.placement import (
+    SequentialQuota,
+    schedule_sequential,
+    schedule_sequential_quota,
+)
+from koordinator_tpu.oracle.vectorized import (
+    VectorQuota,
+    gang_outcomes_np,
+    oracle_args,
+    schedule_vectorized,
+)
+
+__all__ = [
+    "SequentialQuota",
+    "VectorQuota",
+    "gang_outcomes_np",
+    "oracle_args",
+    "schedule_sequential",
+    "schedule_sequential_quota",
+    "schedule_vectorized",
+]
