@@ -99,3 +99,45 @@ def shard_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
         in_shardings=(state_sh, pods_sh, params_sh),
         out_shardings=(state_sh, rep),
     )
+
+
+def shard_full_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
+    """Jitted FULL solve (quota admission, gang resolution, NUMA) with
+    the node axis sharded — the multi-chip counterpart of
+    ``ops.binpack.solve_batch(state, pods, params, config, quota_state,
+    gang_state, numa=numa_aux)``.
+
+    Node-major arrays (NodeState incl. numa inventories, NumaAux's
+    node_policy) shard over ``nodes``; pod batches, quota and gang state
+    replicate — quota groups and gangs are small [Q,R]/[G] tables every
+    chip can hold, while the [N,R] node axis is the scaling dimension.
+    GSPMD inserts the cross-shard argmax and the segment reductions of
+    the gang epilogue. Optional features are trace-time static: pass
+    None to drop a subsystem (a separate program per combination, as in
+    the single-chip path).
+    """
+    from koordinator_tpu.ops.binpack import NumaAux, solve_batch
+
+    ns = node_sharding(mesh)
+    rep = replicated(mesh)
+    jit_full = jax.jit(
+        lambda s, p, pr, q, g, n: solve_batch(s, p, pr, config, q, g, numa=n)
+    )
+
+    def solve(state, pods, params, quota_state=None, gang_state=None,
+              numa_aux=None):
+        put_rep = lambda x: jax.device_put(x, rep)
+        state = shard_node_state(state, mesh)
+        pods = jax.tree_util.tree_map(put_rep, pods)
+        params = jax.tree_util.tree_map(put_rep, params)
+        if quota_state is not None:
+            quota_state = jax.tree_util.tree_map(put_rep, quota_state)
+        if gang_state is not None:
+            gang_state = jax.tree_util.tree_map(put_rep, gang_state)
+        if numa_aux is not None:
+            numa_aux = NumaAux(
+                node_policy=jax.device_put(numa_aux.node_policy, ns)
+            )
+        return jit_full(state, pods, params, quota_state, gang_state, numa_aux)
+
+    return solve
