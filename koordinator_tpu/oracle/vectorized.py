@@ -181,6 +181,11 @@ def schedule_vectorized(
     n = alloc.shape[0]
     n_pods = pod_req.shape[0]
     assignments = np.full(n_pods, -1, dtype=np.int64)
+    if n == 0:
+        # empty cluster: nothing placeable (solve_batch's shape early-out)
+        if quota is not None:
+            quota.register_requests(pod_req, pod_quota_id)
+        return assignments
 
     use_q = quota is not None
     runtime_all = None
@@ -188,22 +193,16 @@ def schedule_vectorized(
         quota.register_requests(pod_req, pod_quota_id)
         runtime_all = quota.runtime()
 
-    for p in range(n_pods):
-        req = pod_req[p]
-        est = pod_est[p]
-        is_prod = bool(pod_is_prod[p])
-        if use_q and not quota.admit(
-            int(pod_quota_id[p]), req, bool(pod_non_preemptible[p]), runtime_all
-        ):
-            continue
-
-        mask = schedulable & ((req == 0) | (used_req + req <= alloc)).all(axis=1)
-        if not bool(pod_is_daemonset[p]):
+    def class_cand(req, est, is_prod, is_daemonset):
+        """[N] packed candidate vector (score, -1 where infeasible) for
+        one pod shape against the CURRENT node state — the same math as
+        the per-pod dense pass, vectorized over nodes."""
+        mask = schedulable & (
+            (req == 0) | (used_req + req <= alloc)
+        ).all(axis=1)
+        if not is_daemonset:
             viol = viol_prod if (is_prod and prod_cfg) else viol_nonprod
             mask = mask & ~(metric_fresh & viol)
-        if not mask.any():
-            continue
-
         fit_per = _least_requested(used_req + req, alloc)
         fit_score = (fit_per * weights).sum(axis=1) // weight_sum
         la_base = (
@@ -216,8 +215,62 @@ def schedule_vectorized(
             metric_fresh, (la_per * weights).sum(axis=1) // weight_sum, 0
         )
         score = fit_weight * fit_score + loadaware_weight * la_score
+        return np.where(mask, score, -1)
 
-        cand = np.where(mask, score, -1)
+    def class_cand_row(i, req, est, is_prod, is_daemonset):
+        """The single-node row of class_cand — identical integer math on
+        the [R] slice, so a cached vector patched at row i equals a full
+        recompute."""
+        a, u = alloc[i], used_req[i]
+        ok = bool(schedulable[i]) and bool(
+            ((req == 0) | (u + req <= a)).all()
+        )
+        if ok and not is_daemonset:
+            viol = viol_prod if (is_prod and prod_cfg) else viol_nonprod
+            ok = not (bool(metric_fresh[i]) and bool(viol[i]))
+        if not ok:
+            return -1
+        fit_per = _least_requested(u + req, a)
+        fit_score = int((fit_per * weights).sum()) // weight_sum
+        base = (
+            prod_base[i]
+            if (score_according_prod and is_prod)
+            else usage[i] + est_extra[i]
+        )
+        la_per = _least_requested(base + est, a)
+        la_score = (
+            int((la_per * weights).sum()) // weight_sum
+            if metric_fresh[i]
+            else 0
+        )
+        return fit_weight * fit_score + loadaware_weight * la_score
+
+    # Pod-shape cache: a placement mutates exactly ONE node row, so a
+    # cached class vector stays valid after patching that row. Bounds
+    # the cache so adversarial all-distinct pod batches degrade to the
+    # dense per-pod pass instead of O(P * classes) patch work.
+    CACHE_CAP = 96
+    cache = {}
+
+    for p in range(n_pods):
+        req = pod_req[p]
+        est = pod_est[p]
+        is_prod = bool(pod_is_prod[p])
+        is_ds = bool(pod_is_daemonset[p])
+        if use_q and not quota.admit(
+            int(pod_quota_id[p]), req, bool(pod_non_preemptible[p]), runtime_all
+        ):
+            continue
+
+        key = (req.tobytes(), est.tobytes(), is_prod, is_ds)
+        entry = cache.get(key)
+        if entry is None:
+            cand = class_cand(req, est, is_prod, is_ds)
+            if len(cache) < CACHE_CAP:
+                cache[key] = (req, est, is_prod, is_ds, cand)
+        else:
+            cand = entry[4]
+
         best = int(cand.argmax())  # lowest index among ties
         if cand[best] < 0:
             continue
@@ -228,6 +281,8 @@ def schedule_vectorized(
             prod_base[best] += est
         if use_q:
             quota.assume(int(pod_quota_id[p]), req, bool(pod_non_preemptible[p]))
+        for kreq, kest, kprod, kds, kcand in cache.values():
+            kcand[best] = class_cand_row(best, kreq, kest, kprod, kds)
     return assignments
 
 

@@ -126,14 +126,13 @@ def shard_full_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
 
     def solve(state, pods, params, quota_state=None, gang_state=None,
               numa_aux=None):
-        put_rep = lambda x: jax.device_put(x, rep)
         state = shard_node_state(state, mesh)
-        pods = jax.tree_util.tree_map(put_rep, pods)
-        params = jax.tree_util.tree_map(put_rep, params)
+        pods = jax.device_put(pods, rep)
+        params = jax.device_put(params, rep)
         if quota_state is not None:
-            quota_state = jax.tree_util.tree_map(put_rep, quota_state)
+            quota_state = jax.device_put(quota_state, rep)
         if gang_state is not None:
-            gang_state = jax.tree_util.tree_map(put_rep, gang_state)
+            gang_state = jax.device_put(gang_state, rep)
         if numa_aux is not None:
             numa_aux = NumaAux(
                 node_policy=jax.device_put(numa_aux.node_policy, ns)

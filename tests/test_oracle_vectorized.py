@@ -103,6 +103,25 @@ def test_vectorized_quota_matches_scalar(seed):
     np.testing.assert_array_equal(vq.np_used, sq.np_used)
 
 
+def test_zero_nodes_returns_all_unplaced():
+    """Empty cluster mirrors solve_batch's shape early-out: all -1, no
+    crash, quota requests still registered."""
+    args = _rich_problem(0, 10, seed=99)
+    out = schedule_vectorized(*args)
+    np.testing.assert_array_equal(out, np.full(10, -1))
+    vq = VectorQuota(
+        np.zeros((2, 4), np.int64), np.full((2, 4), 100, np.int64),
+        np.zeros((2, 4), np.int64), np.ones((2, 4), np.int64),
+        np.ones(2, bool), np.full(4, 1000, np.int64),
+    )
+    out = schedule_vectorized(
+        *args, pod_quota_id=np.zeros(10, np.int64),
+        pod_non_preemptible=np.zeros(10, bool), quota=vq,
+    )
+    np.testing.assert_array_equal(out, np.full(10, -1))
+    assert vq.child_request[0].sum() > 0  # requests registered anyway
+
+
 def test_vectorized_matches_device_scan():
     """Anchor the vectorized oracle directly to the jitted scan."""
     import jax
