@@ -194,3 +194,48 @@ ANNOTATION_RESOURCE_AMPLIFICATION_RATIO = (
     f"{DOMAIN}/node-resource-amplification-ratio"
 )
 ANNOTATION_NODE_RAW_ALLOCATABLE = f"{DOMAIN}/node-raw-allocatable"
+
+
+def parse_node_reservation(
+    annotations: Optional[Mapping[str, str]],
+) -> Optional[dict]:
+    """The node-reservation annotation, parsed once for every consumer.
+
+    Reference: apis/extension/node_reservation.go GetNodeReservation +
+    util.GetNodeReservationResources. Accepts the reference's nested form
+    ``{"resources": {"cpu": N, "memory": N}, "applyPolicy": "..."}`` and
+    the flat legacy form ``{"cpu": N, "memory": N}``. Returns
+    ``{"cpu": mcpu, "memory": mib, "apply_policy": str}`` (canonical
+    units, zeros for absent dims) or None for absent/malformed — the two
+    consumers must agree on what a reservation says:
+
+    - the scheduler-side node transform (client/wiring.transform_node)
+      trims allocatable only under the Default policy
+      (TrimNodeAllocatableByNodeReservation, node.go:130);
+    - the manager's batch-overcommit inputs subtract it regardless of
+      policy (GetNodeReservationFromAnnotation, node.go:85-100).
+    """
+    import json
+
+    raw = (annotations or {}).get(ANNOTATION_NODE_RESERVATION)
+    if not raw:
+        return None
+    try:
+        spec = json.loads(raw)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(spec, dict):
+        return None
+    res = spec.get("resources", spec)
+    if not isinstance(res, dict):
+        return None
+    try:
+        cpu = int(res.get("cpu", 0))
+        mem = int(res.get("memory", 0))
+    except (ValueError, TypeError):
+        return None
+    return {
+        "cpu": max(cpu, 0),
+        "memory": max(mem, 0),
+        "apply_policy": str(spec.get("applyPolicy", "Default") or "Default"),
+    }

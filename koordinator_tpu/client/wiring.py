@@ -9,7 +9,44 @@ via the snapshot) and PATCHes Node allocatable back onto the bus.
 
 from __future__ import annotations
 
+import dataclasses
+
 from koordinator_tpu.client.bus import APIServer, EventType, Kind
+
+
+def transform_node(node):
+    """Scheduler-side node transform (reference: pkg/util/transformer/
+    node_transformer.go TransformNodeWithNodeReservation +
+    util.TrimNodeAllocatableByNodeReservation, node.go:121-150): subtract
+    the node-reservation annotation's resources from allocatable before
+    the scheduler's cache sees the node. Only the Default apply policy
+    trims (ReservedCPUsOnly reserves cores without shrinking schedulable
+    totals); malformed annotations leave the node untouched. Returns a
+    COPY when trimming — the in-process bus shares objects, and other
+    watchers (the manager's overcommit math reads the annotation itself)
+    must keep the raw view.
+    """
+    from koordinator_tpu.apis.extension import (
+        ResourceName,
+        parse_node_reservation,
+    )
+
+    spec = parse_node_reservation(node.annotations)
+    if spec is None or spec["apply_policy"] not in ("", "Default"):
+        return node
+    cpu, mem = spec["cpu"], spec["memory"]
+    if cpu <= 0 and mem <= 0:
+        return node
+    alloc = dict(node.allocatable)
+    if cpu > 0:
+        alloc[ResourceName.CPU] = max(
+            alloc.get(ResourceName.CPU, 0) - cpu, 0
+        )
+    if mem > 0:
+        alloc[ResourceName.MEMORY] = max(
+            alloc.get(ResourceName.MEMORY, 0) - mem, 0
+        )
+    return dataclasses.replace(node, allocatable=alloc)
 
 
 def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
@@ -23,7 +60,9 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
         if event is EventType.DELETED:
             scheduler.remove_node(name)
         else:
-            scheduler.add_node(node)
+            # informer-level node transform: trim allocatable by the
+            # node-reservation annotation before the scheduler sees it
+            scheduler.add_node(transform_node(node))
 
     # bus key per pod uid: conventionally identical, but eviction must
     # delete the key the pod was actually applied under
@@ -369,9 +408,13 @@ class DeschedulerLoop:
         # the probe's __resv__ uid marks it a reserve pod: it never
         # MATCHES reservations (is_reserve_pod), but existing
         # reservations stay in the snapshot so their capacity holds
-        # still count against the nodes
+        # still count against the nodes. Nodes go through the SAME
+        # node-reservation trim the scheduler's informer applies — a
+        # destination probe that over-estimated a reserved node's
+        # capacity would create a Reservation the scheduler can never
+        # bind, looping the migration.
         out = self._model.schedule(ClusterSnapshot(
-            nodes=snapshot.nodes,
+            nodes=[transform_node(n) for n in snapshot.nodes],
             pods=snapshot.pods,
             pending_pods=[probe],
             node_metrics=snapshot.node_metrics,

@@ -29,7 +29,7 @@ import numpy as np
 from koordinator_tpu.apis.extension import (
     ANNOTATION_CPU_NORMALIZATION_RATIO,
     ANNOTATION_NODE_RAW_ALLOCATABLE,
-    ANNOTATION_NODE_RESERVATION,
+    parse_node_reservation,
     ANNOTATION_RESOURCE_AMPLIFICATION_RATIO,
     NUM_RESOURCES,
     PriorityClass,
@@ -245,19 +245,15 @@ class NodeResourceController:
                 prod_reclaimable[i] = resources_to_vector(
                     metric.prod_reclaimable
                 )
-            anno = node.annotations.get(ANNOTATION_NODE_RESERVATION)
-            if anno:
-                # malformed annotations on one node must not abort the
-                # cluster-wide reconcile
-                try:
-                    spec = json.loads(anno)
-                    if isinstance(spec, dict):
-                        reserved[i, ResourceName.CPU] = int(spec.get("cpu", 0))
-                        reserved[i, ResourceName.MEMORY] = int(
-                            spec.get("memory", 0)
-                        )
-                except (ValueError, TypeError):
-                    reserved[i] = 0
+            # shared parse (apis/extension.parse_node_reservation):
+            # malformed annotations on one node must not abort the
+            # cluster-wide reconcile; the batch calculator subtracts the
+            # reservation regardless of applyPolicy
+            # (GetNodeReservationFromAnnotation, node.go:85-100)
+            spec = parse_node_reservation(node.annotations)
+            if spec is not None:
+                reserved[i, ResourceName.CPU] = spec["cpu"]
+                reserved[i, ResourceName.MEMORY] = spec["memory"]
         return NodeOvercommitInputs(
             capacity=jnp.asarray(capacity),
             system_used=jnp.asarray(system_used),

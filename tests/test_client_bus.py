@@ -637,3 +637,73 @@ def test_migration_probe_sees_reserved_capacity_as_occupied():
     assert migrated == []
     assert list(bus.list(Kind.RESERVATION)) == ["taken"]
     assert bus.get(Kind.POD, "default/heavy").node_name == "hot"
+
+
+class TestNodeReservationTransform:
+    """Scheduler-side informer transform (node_transformer.go
+    TransformNodeWithNodeReservation): node-reservation trims the
+    scheduler's allocatable view; other bus watchers keep the raw node."""
+
+    def _node(self, policy=None, nested=True, cpu=4000):
+        import json as _json
+
+        from koordinator_tpu.apis.extension import (
+            ANNOTATION_NODE_RESERVATION,
+        )
+
+        spec = {"resources": {"cpu": cpu}} if nested else {"cpu": cpu}
+        if policy is not None:
+            spec["applyPolicy"] = policy
+        return NodeSpec(
+            name="n0",
+            allocatable={R.CPU: 10000, R.MEMORY: 32768},
+            annotations={
+                ANNOTATION_NODE_RESERVATION: _json.dumps(spec)
+            },
+        )
+
+    def test_scheduler_sees_trimmed_allocatable(self):
+        from koordinator_tpu.scheduler import Scheduler
+
+        bus = APIServer()
+        sched = Scheduler()
+        wire_scheduler(bus, sched)
+        bus.apply(Kind.NODE, "n0", self._node())
+        bus.apply(Kind.NODE_METRIC, "n0", NodeMetric(
+            node_name="n0", node_usage={}, update_time=99.0))
+        # 7000m fits raw 10000m but not the trimmed 6000m
+        bus.apply(Kind.POD, "default/p", PodSpec(
+            name="p", requests={R.CPU: 7000}))
+        out = sched.schedule_pending(now=100.0)
+        assert out["default/p"] is None
+        # the bus object itself stays untrimmed (shared raw view)
+        assert bus.get(Kind.NODE, "n0").allocatable[R.CPU] == 10000
+        # a fitting pod still places
+        bus.apply(Kind.POD, "default/q", PodSpec(
+            name="q", requests={R.CPU: 5000}))
+        assert sched.schedule_pending(now=101.0)["default/q"] == "n0"
+
+    def test_reserved_cpus_only_policy_not_trimmed(self):
+        from koordinator_tpu.client.wiring import transform_node
+
+        node = transform_node(self._node(policy="ReservedCPUsOnly"))
+        assert node.allocatable[R.CPU] == 10000
+
+    def test_flat_form_and_malformed_tolerated(self):
+        import json as _json
+
+        from koordinator_tpu.apis.extension import (
+            ANNOTATION_NODE_RESERVATION,
+        )
+        from koordinator_tpu.client.wiring import transform_node
+
+        assert transform_node(
+            self._node(nested=False)
+        ).allocatable[R.CPU] == 6000
+        broken = NodeSpec(
+            name="n0", allocatable={R.CPU: 10000},
+            annotations={ANNOTATION_NODE_RESERVATION: "{not json"},
+        )
+        assert transform_node(broken).allocatable[R.CPU] == 10000
+        oversub = transform_node(self._node(cpu=999999))
+        assert oversub.allocatable[R.CPU] == 0  # non-negative clamp
