@@ -169,6 +169,11 @@ class NodeMetric:
     aggregated_windows: Dict[float, Dict[int, Resources]] = dataclasses.field(
         default_factory=dict
     )
+    # system-usage percentiles per window (reference:
+    # AggregatedSystemUsages — reported, no in-tree consumer)
+    aggregated_system_usage: Dict[float, Dict[int, Resources]] = (
+        dataclasses.field(default_factory=dict)
+    )
     # host application name -> usage (reference: NodeMetric
     # HostApplicationMetric list, which also carries the app's QoS)
     host_app_usages: Dict[str, Resources] = dataclasses.field(

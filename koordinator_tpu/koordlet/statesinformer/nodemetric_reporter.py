@@ -98,26 +98,32 @@ class NodeMetricReporter:
         if mem_row[A.AVG] is not None:
             metric.node_usage[ResourceName.MEMORY] = int(mem_row[A.AVG])
         metric.aggregated_usage = _percentile_usages(cpu_row, mem_row)
+        # the declared policy window, not the float-computed now-start
+        # difference: the scheduler's window selection compares exactly
+        primary_dur = float(policy.aggregate_duration_seconds if policy else 300)
         if metric.aggregated_usage:
-            # the declared policy window, not the float-computed now-start
-            # difference: the scheduler's window selection compares exactly
-            metric.aggregated_duration = float(
-                policy.aggregate_duration_seconds if policy else 300
-            )
+            metric.aggregated_duration = primary_dur
         # extra aggregation windows (reference: AggregatePolicy.Durations
-        # -> one AggregatedNodeUsages entry each); batched per window
+        # -> one AggregatedNodeUsages + AggregatedSystemUsages entry
+        # each); node + system series reduce in ONE batched pass per
+        # window
         for dur in getattr(policy, "aggregate_durations", ()) or ():
             dur = float(dur)
-            if dur == metric.aggregated_duration:
+            if dur == primary_dur:
                 continue
-            w_cpu, w_mem = mc.aggregate_batch(
+            w_cpu, w_mem, ws_cpu, ws_mem = mc.aggregate_batch(
                 [(MetricKind.NODE_CPU_USAGE, None),
-                 (MetricKind.NODE_MEMORY_USAGE, None)],
+                 (MetricKind.NODE_MEMORY_USAGE, None),
+                 (MetricKind.SYS_CPU_USAGE, None),
+                 (MetricKind.SYS_MEMORY_USAGE, None)],
                 now - dur, now, list(_PCTS.values()),
             )
             by_pct = _percentile_usages(w_cpu, w_mem)
             if by_pct:
                 metric.aggregated_windows[dur] = by_pct
+            sys_pct = _percentile_usages(ws_cpu, ws_mem)
+            if sys_pct:
+                metric.aggregated_system_usage[dur] = sys_pct
 
         # per-pod usage: ONE batched matrix reduction for all pods
         pods = self.informer.running_pods()
@@ -181,16 +187,21 @@ class NodeMetricReporter:
                 io_util_pct=int(util or 0),
             )
 
-        # system residual
+        # system residual: avg + primary-window percentiles (reference:
+        # AggregatedSystemUsages, states_nodemetric.go:342); extra
+        # windows fold into the per-window batch above
         sys_aggs = mc.aggregate_batch(
             [(MetricKind.SYS_CPU_USAGE, None),
              (MetricKind.SYS_MEMORY_USAGE, None)],
-            start, now, [A.AVG],
+            start, now, [A.AVG] + list(_PCTS.values()),
         )
         if sys_aggs[0][A.AVG] is not None:
             metric.sys_usage[ResourceName.CPU] = int(sys_aggs[0][A.AVG])
         if sys_aggs[1][A.AVG] is not None:
             metric.sys_usage[ResourceName.MEMORY] = int(sys_aggs[1][A.AVG])
+        sys_pct = _percentile_usages(sys_aggs[0], sys_aggs[1])
+        if sys_pct:
+            metric.aggregated_system_usage[primary_dur] = sys_pct
 
         # host applications (reference: NodeMetric HostApplicationMetric)
         apps = self.informer.get_node_slo().host_applications

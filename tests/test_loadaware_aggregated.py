@@ -213,11 +213,16 @@ def test_reporter_fills_extra_windows():
     for t in range(0, 1200, 60):
         val = 7000.0 if t < 300 else 2000.0
         mc.append(MetricKind.NODE_CPU_USAGE, None, float(t), val)
+    for t in range(0, 1200, 60):
+        mc.append(MetricKind.SYS_CPU_USAGE, None, float(t), 300.0)
     m = NodeMetricReporter(mc, informer).report(now=1200.0)
     assert m.aggregated_duration == 300.0
     assert set(m.aggregated_windows) == {900.0, 1800.0}
     assert m.aggregated_windows[1800.0][99][R.CPU] > \
         m.aggregated_usage[99][R.CPU]
+    # system-usage percentiles reported per window (AggregatedSystemUsages)
+    assert m.aggregated_system_usage[300.0][95][R.CPU] == 300
+    assert set(m.aggregated_system_usage) == {300.0, 900.0, 1800.0}
 
 
 def test_incremental_path_applies_aggregated_mode():
