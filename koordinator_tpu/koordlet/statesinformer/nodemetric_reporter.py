@@ -68,17 +68,22 @@ class NodeMetricReporter:
         self.predict_server = predict_server
         self.last_report: Optional[NodeMetric] = None
 
-    def _window(self, now: float) -> float:
+    def _primary_duration(self) -> float:
+        """The declared policy window — the SINGLE site defining both
+        the query window and the reported aggregated_duration key."""
         policy = self.informer.get_collect_policy()
-        dur = policy.aggregate_duration_seconds if policy else 300
-        return now - dur
+        return float(policy.aggregate_duration_seconds if policy else 300)
+
+    def _window(self, now: float) -> float:
+        return now - self._primary_duration()
 
     def report(self, now: float) -> Optional[NodeMetric]:
         node = self.informer.get_node()
         if node is None:
             return None
         mc = self.metric_cache
-        start = self._window(now)
+        primary_dur = self._primary_duration()
+        start = now - primary_dur
         A = AggregationType
 
         metric = NodeMetric(node_name=node.name, update_time=now)
@@ -86,21 +91,21 @@ class NodeMetricReporter:
         if policy is not None:
             metric.report_interval = float(policy.report_interval_seconds)
 
-        # node usage (avg over the window) + aggregated percentiles
+        # node + system usage (avg over the window) + aggregated
+        # percentiles — one batched pass for the primary window
         node_aggs = mc.aggregate_batch(
             [(MetricKind.NODE_CPU_USAGE, None),
-             (MetricKind.NODE_MEMORY_USAGE, None)],
+             (MetricKind.NODE_MEMORY_USAGE, None),
+             (MetricKind.SYS_CPU_USAGE, None),
+             (MetricKind.SYS_MEMORY_USAGE, None)],
             start, now, [A.AVG] + list(_PCTS.values()),
         )
-        cpu_row, mem_row = node_aggs
+        cpu_row, mem_row, sys_cpu_row, sys_mem_row = node_aggs
         if cpu_row[A.AVG] is not None:
             metric.node_usage[ResourceName.CPU] = int(cpu_row[A.AVG])
         if mem_row[A.AVG] is not None:
             metric.node_usage[ResourceName.MEMORY] = int(mem_row[A.AVG])
         metric.aggregated_usage = _percentile_usages(cpu_row, mem_row)
-        # the declared policy window, not the float-computed now-start
-        # difference: the scheduler's window selection compares exactly
-        primary_dur = float(policy.aggregate_duration_seconds if policy else 300)
         if metric.aggregated_usage:
             metric.aggregated_duration = primary_dur
         # extra aggregation windows (reference: AggregatePolicy.Durations
@@ -188,18 +193,13 @@ class NodeMetricReporter:
             )
 
         # system residual: avg + primary-window percentiles (reference:
-        # AggregatedSystemUsages, states_nodemetric.go:342); extra
-        # windows fold into the per-window batch above
-        sys_aggs = mc.aggregate_batch(
-            [(MetricKind.SYS_CPU_USAGE, None),
-             (MetricKind.SYS_MEMORY_USAGE, None)],
-            start, now, [A.AVG] + list(_PCTS.values()),
-        )
-        if sys_aggs[0][A.AVG] is not None:
-            metric.sys_usage[ResourceName.CPU] = int(sys_aggs[0][A.AVG])
-        if sys_aggs[1][A.AVG] is not None:
-            metric.sys_usage[ResourceName.MEMORY] = int(sys_aggs[1][A.AVG])
-        sys_pct = _percentile_usages(sys_aggs[0], sys_aggs[1])
+        # AggregatedSystemUsages, states_nodemetric.go:342), from the
+        # rows the primary batch already produced
+        if sys_cpu_row[A.AVG] is not None:
+            metric.sys_usage[ResourceName.CPU] = int(sys_cpu_row[A.AVG])
+        if sys_mem_row[A.AVG] is not None:
+            metric.sys_usage[ResourceName.MEMORY] = int(sys_mem_row[A.AVG])
+        sys_pct = _percentile_usages(sys_cpu_row, sys_mem_row)
         if sys_pct:
             metric.aggregated_system_usage[primary_dur] = sys_pct
 
