@@ -164,7 +164,7 @@ def bench_flagship(repeats):
             for x, y in zip(a[0], b[0])
         )
 
-    best, warmup, out, solver_name, win_fn, scan_best = _pick_kernel_or_scan(
+    best, warmup, out, solver_name, win_fn, scan_best, _kvs = _pick_kernel_or_scan(
         solve, pallas_fn, repeats, (state, pods, params), cmp_state_and_assign
     )
     scan_pods_per_sec = n_pods / scan_best
@@ -311,13 +311,15 @@ def _pick_kernel_or_scan(scan_fn, kernel_fn, repeats, args, compare):
     selection policy, shared by the flagship and the matrix configs.
     ``kernel_fn=None`` skips the kernel leg (unsupported shape/config).
     Returns (best_s, warmup_s_total, out, solver_name, win_fn,
-    scan_best_s)."""
+    scan_best_s, kernel_vs_scan) where kernel_vs_scan is "identical",
+    "DIVERGED", or "not_run" (kernel leg never executed)."""
     import jax
 
     best, warm, out = _timed(scan_fn, repeats, *args)
     scan_best = best
     name = "scan"
     win = scan_fn
+    kernel_vs_scan = "not_run"
     if (kernel_fn is not None
             and jax.devices()[0].platform == "tpu"  # interpret can't win
             and os.environ.get("KTPU_BENCH_PALLAS", "1") != "0"):
@@ -327,14 +329,17 @@ def _pick_kernel_or_scan(scan_fn, kernel_fn, repeats, args, compare):
             if not compare(out, k_out):
                 # a hardware divergence from the scan is a kernel bug
                 # and must be loud, not silently discarded
+                kernel_vs_scan = "DIVERGED"
                 print("WARNING: pallas kernel diverged from the scan on "
                       "hardware — using the scan result", file=sys.stderr)
-            elif k_best < best:
-                best, out, name, win = k_best, k_out, "pallas", kernel_fn
+            else:
+                kernel_vs_scan = "identical"
+                if k_best < best:
+                    best, out, name, win = k_best, k_out, "pallas", kernel_fn
         except Exception as e:
             print(f"pallas path skipped: {type(e).__name__}: {e}",
                   file=sys.stderr)
-    return best, warm, out, name, win, scan_best
+    return best, warm, out, name, win, scan_best, kernel_vs_scan
 
 
 def bench_quota(repeats):
@@ -355,7 +360,7 @@ def bench_quota(repeats):
     scan = jax.jit(lambda s, p, pr, q: solve_batch(s, p, pr, config, q).assign)
     kern = lambda s, p, pr, q: pallas_solve_batch(s, p, pr, config, q).assign
     cmp_assign = lambda a, b: bool((np.asarray(a) == np.asarray(b)).all())
-    best, _warm, out, solver, win, _scan_best = _pick_kernel_or_scan(
+    best, _warm, out, solver, win, _scan_best, _kvs = _pick_kernel_or_scan(
         scan, kern, repeats, (state, pods, params, qstate), cmp_assign
     )
     p99_s = _p99(win, (state, pods, params, qstate), max(20, repeats))
@@ -415,7 +420,7 @@ def bench_gang(repeats):
         return all(bool((np.asarray(x) == np.asarray(y)).all())
                    for x, y in zip(a, b))
 
-    best, _warm, out, solver, win, _scan_best = _pick_kernel_or_scan(
+    best, _warm, out, solver, win, _scan_best, _kvs = _pick_kernel_or_scan(
         scan, kern, repeats, (state, pods, params, gstate), cmp_tuple
     )
     p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, gstate),
@@ -492,7 +497,7 @@ def bench_numa(repeats):
         return all(bool((np.asarray(x) == np.asarray(y)).all())
                    for x, y in zip(a, b))
 
-    best, _warm, out, solver, win, scan_best = _pick_kernel_or_scan(
+    best, _warm, out, solver, win, scan_best, kvs = _pick_kernel_or_scan(
         scan, kern, repeats, (state, pods, params, aux), cmp_tuple
     )
     p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, aux),
@@ -500,7 +505,7 @@ def bench_numa(repeats):
     return {
         "pods_per_sec": n_pods / best,
         "p99_s": p99_s,
-        "identical_kernel_vs_scan": True,  # enforced by _pick (loud warn)
+        "kernel_vs_scan": kvs,  # "identical" | "DIVERGED" | "not_run"
         "solver": solver,
         "scan_pods_per_sec": n_pods / scan_best,
         "wall_s": best,
@@ -536,7 +541,7 @@ def bench_fit_16k(repeats):
             for x, y in zip(a[0], b[0])
         )
 
-    best, _warm, out, solver, win, scan_best = _pick_kernel_or_scan(
+    best, _warm, out, solver, win, scan_best, kvs = _pick_kernel_or_scan(
         scan, kern, repeats, (state, pods, params), cmp_state_and_assign
     )
     p99_s = _p99(win, (state, pods, params), max(20, repeats))
@@ -545,7 +550,7 @@ def bench_fit_16k(repeats):
         "scan_pods_per_sec": n_pods / scan_best,
         "p99_s": p99_s,
         "solver": solver,
-        "identical_kernel_vs_scan": True,  # enforced by _pick (loud warn)
+        "kernel_vs_scan": kvs,  # "identical" | "DIVERGED" | "not_run"
         "n_nodes": n_nodes,
         "wall_s": best,
     }

@@ -69,11 +69,19 @@ class BurstLimiter:
         if new_capacity != self.capacity:
             self.__init__(period_sec, max_scale_pct)
 
+    #: a gap longer than this means the plugin was not reconciling
+    #: (disabled, or the daemon was down — the reference's limiter is
+    #: in-memory so a restart starts fresh); integrating it as one dt
+    #: would slam the bucket to +-capacity
+    GAP_RESET_SEC = 30.0
+
     def allow(self, now: float, usage_scale_pct: int) -> bool:
         # float dt throughout: the reference truncates to whole seconds
         # (:142), which at a ~1s tick cadence would discard most of the
         # elapsed time and let the bucket never drain
         dt = 0.0 if self.last is None else max(now - self.last, 0.0)
+        if dt > self.GAP_RESET_SEC:
+            dt = 0.0
         if usage_scale_pct >= LIMITER_CONSUME_ABOVE_PCT:
             self.token -= (usage_scale_pct - 100) * dt
         elif usage_scale_pct < LIMITER_SAVE_BELOW_PCT:
@@ -168,12 +176,16 @@ class CPUBurst:
     def _quota_operation(self, ctx: QoSContext, pod, strategy, usages,
                          now: float) -> str:
         """genOperationByContainer (:467-501), pod-granular: 'up',
-        'down', 'remain', or 'reset'."""
-        if strategy.policy not in ("auto", "cfsQuotaBurstOnly"):
-            return "reset"
-        if strategy.cfs_quota_burst_period_seconds >= 0:
-            if strategy.cfs_quota_burst_percent < 100:
-                return "down"  # illegal config -> not allowed (:558-561)
+        'down', 'remain', or 'reset'.
+
+        The limiter ticks BEFORE the policy check — the reference runs
+        cfsBurstAllowedByLimiter first — so across a disabled stretch
+        the clock keeps advancing and tokens keep refilling while usage
+        is low, instead of freezing and then integrating the whole gap
+        as one dt on re-enable (ADVICE r4)."""
+        allowed = True
+        if (strategy.cfs_quota_burst_period_seconds >= 0
+                and strategy.cfs_quota_burst_percent >= 100):
             limiter = self._limiters.get(pod.uid)
             if limiter is None:
                 limiter = self._limiters[pod.uid] = BurstLimiter(
@@ -189,7 +201,13 @@ class CPUBurst:
             scale_pct = 100
             if usage is not None and pod.cpu_limit_mcpu > 0:
                 scale_pct = int(usage / pod.cpu_limit_mcpu * 100)
-            if not limiter.allow(now, scale_pct):
+            allowed = limiter.allow(now, scale_pct)
+        if strategy.policy not in ("auto", "cfsQuotaBurstOnly"):
+            return "reset"
+        if strategy.cfs_quota_burst_period_seconds >= 0:
+            if strategy.cfs_quota_burst_percent < 100:
+                return "down"  # illegal config -> not allowed (:558-561)
+            if not allowed:
                 return "down"
         throttled = ctx.metric_cache.aggregate(
             MetricKind.POD_CPU_THROTTLED_RATIO, {"pod": pod.uid},

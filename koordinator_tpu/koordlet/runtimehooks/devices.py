@@ -66,18 +66,32 @@ class DeviceEnvPlugin:
         alloc = parse_device_allocations(proto.request.annotations)
         if not alloc:
             return
-        devices = alloc.get(DeviceType.GPU.value) or []
-        minors = ",".join(str(int(d.get("minor", 0))) for d in devices)
+        # malformed annotation entries skip (error-and-continue, like the
+        # JSON parse above) — raising here would fail container creation
+        # on the proxy/NRI path
+        minor_list = []
+        gpu_entries = alloc.get(DeviceType.GPU.value) or []
+        for d in gpu_entries if isinstance(gpu_entries, list) else []:
+            try:
+                minor_list.append(str(int(d.get("minor", 0))))
+            except (TypeError, ValueError, AttributeError):
+                continue
+        minors = ",".join(minor_list)
         if minors:
             envs = proto.response.add_envs or {}
             envs[TPU_ALLOC_ENV] = minors
             envs[GPU_ALLOC_ENV] = minors
             proto.response.add_envs = envs
-        vfs = [
-            vf
-            for d in (alloc.get(DeviceType.RDMA.value) or [])
-            for vf in (d.get("vfs") or [])
-        ]
+        vfs = []
+        rdma_entries = alloc.get(DeviceType.RDMA.value) or []
+        for d in rdma_entries if isinstance(rdma_entries, list) else []:
+            try:
+                entry_vfs = d.get("vfs") or []
+            except AttributeError:
+                continue
+            if isinstance(entry_vfs, list):
+                vfs.extend(str(v) for v in entry_vfs
+                           if isinstance(v, (str, int)))
         if vfs:
             envs = proto.response.add_envs or {}
             envs[RDMA_VFS_ENV] = ",".join(vfs)

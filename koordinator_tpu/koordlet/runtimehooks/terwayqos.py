@@ -58,17 +58,32 @@ def bits_to_bytes(bits: int) -> int:
     return int(bits) // 8
 
 
+class QuantityError(ValueError):
+    """An IntOrString bandwidth value the reference's parseQuantity
+    rejects (malformed, or absolute value over the node total).  The
+    rule update carrying it is discarded and the prior config kept."""
+
+
 def _parse_quantity(value, total_bits: int) -> int:
     """IntOrString: int = percent of total, str = absolute bits/s;
-    result Bytes/s (terwayqos.go:352-371). Malformed/over-total -> 0."""
+    result Bytes/s (terwayqos.go:352-371). Malformed or over-total
+    raises QuantityError — returning 0 would mean "no limit", silently
+    removing the cap on a typo'd value."""
     if value is None:
         return 0
     if isinstance(value, str):
         try:
             bps = bits_to_bytes(int(float(value)))
-        except ValueError:
-            return 0
-        return bps if bps <= bits_to_bytes(total_bits) else 0
+        except ValueError as e:
+            raise QuantityError(f"bad bandwidth quantity {value!r}") from e
+        if bps < 0 or bps > bits_to_bytes(total_bits):
+            raise QuantityError(
+                f"bandwidth {value!r} outside [0, node total "
+                f"{total_bits} bits/s]"
+            )
+        return bps
+    if int(value) < 0:
+        raise QuantityError(f"negative bandwidth percent {value!r}")
     return int(value) * bits_to_bytes(total_bits) // 100
 
 
@@ -150,9 +165,17 @@ class TerwayQosPlugin:
     def update_node_slo(self, slo: NodeSLOSpec) -> None:
         """parseRuleForNodeSLO (:86-120) + syncNodeConfig."""
         policy = slo.resource_qos_strategy.policies.get(NET_QOS_POLICY_KEY)
-        self.enabled = policy == NET_QOS_POLICY_TERWAY
-        if self.enabled:
-            self.node_config = parse_node_config(slo)
+        enabled = policy == NET_QOS_POLICY_TERWAY
+        if enabled:
+            try:
+                node_config = parse_node_config(slo)
+            except QuantityError as e:
+                # reference parseQuantity errors reject the rule update
+                # and keep the previous config (no sync)
+                self.auditor.log("terwayqos", "nodeslo", "reject", str(e))
+                return
+            self.node_config = node_config
+        self.enabled = enabled
         self.sync()
 
     def update_pods(self, pods) -> None:

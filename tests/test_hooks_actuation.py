@@ -78,6 +78,21 @@ class TestDeviceEnvInject:
         resp = self._server().create_container(pod, "main", apply=False)
         assert resp.add_envs is None
 
+    def test_malformed_entries_skipped_not_raised(self):
+        """A junk allocation entry must not fail container creation on
+        the proxy/NRI path: skip it and inject the rest (ADVICE r4)."""
+        pod = PodMeta(
+            "p4", "kubepods/podp4", QoSClass.LSR,
+            containers={"main": "kubepods/podp4/main"},
+            annotations={ANNOTATION_DEVICE_ALLOCATED: json.dumps({
+                "gpu": [{"minor": 0}, "not-a-dict", {"minor": "x"}],
+                "rdma": ["nope", {"minor": 0, "vfs": ["0000:81:00.2"]}],
+            })},
+        )
+        resp = self._server().create_container(pod, "main", apply=False)
+        assert resp.add_envs["TPU_VISIBLE_CHIPS"] == "0"
+        assert resp.add_envs["KOORDINATOR_RDMA_VFS"] == "0000:81:00.2"
+
     def test_injection_through_cri_proxy(self):
         """The NRI/proxy path: the env response merges into the container
         creation request the runtime actually sees — the allocator's
@@ -381,6 +396,22 @@ class TestTerwayQos:
         assert data["ls"]["egress_bandwidth"] == 50_000_000
         assert data["be"]["prio"] == 2       # kube besteffort tier
         assert data["plain"]["prio"] == 1    # guaranteed tier fallback
+
+    def test_over_total_absolute_rejected_keeps_prior(self, tmp_path):
+        """An absolute bits/s value above the node total is a parse
+        error that rejects the whole rule update (reference
+        parseQuantity); mapping it to 0 would silently mean 'no limit'
+        (ADVICE r4)."""
+        plugin = TerwayQosPlugin(str(tmp_path))
+        plugin.update_node_slo(self._slo())
+        before = open(plugin.node_file).read()
+        bad = self._slo()
+        bad.resource_qos_strategy.be.network = NetworkQOS(
+            enable=True, ingress_request=10, ingress_limit=40,
+            egress_request=10, egress_limit="20000000000",  # > 10G total
+        )
+        plugin.update_node_slo(bad)
+        assert open(plugin.node_file).read() == before
 
     def test_disable_removes_files(self, tmp_path):
         plugin = TerwayQosPlugin(str(tmp_path))

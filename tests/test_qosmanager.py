@@ -419,6 +419,26 @@ class TestCFSQuotaBurst:
                                        ctx.system_config))
         assert value < 400000  # scaled down, not up, despite throttling
 
+    def test_limiter_ticks_while_policy_disabled(self, tmp_path):
+        """The limiter clock must advance during a disabled stretch:
+        otherwise the first allow() after re-enable integrates the whole
+        gap as one dt and slams the bucket to -capacity (ADVICE r4)."""
+        ctx = self._ctx(tmp_path, quota_us=400000)
+        ctx.node_slo.cpu_burst_strategy.cfs_quota_burst_period_seconds = 10
+        burst = CPUBurst()
+        burst.execute(ctx, now=100.0)  # creates the limiter
+        lim = burst._limiters["ls"]
+        token_before = lim.token
+        ctx.node_slo.cpu_burst_strategy.policy = "cpuBurstOnly"
+        burst.execute(ctx, now=500.0)  # long disabled stretch
+        assert lim.last == 500.0
+        ctx.node_slo.cpu_burst_strategy.policy = "auto"
+        ctx.metric_cache.append(
+            MetricKind.POD_CPU_USAGE, {"pod": "ls"}, 501.0, 6000.0)
+        burst.execute(ctx, now=501.0)
+        # dt = 1s at 300% usage drains 200 tokens — NOT 400s worth
+        assert lim.token >= token_before - 250
+
     def test_reset_when_quota_burst_disabled(self, tmp_path):
         ctx = self._ctx(tmp_path, quota_us=400000)
         ctx.node_slo.cpu_burst_strategy.policy = "cpuBurstOnly"
