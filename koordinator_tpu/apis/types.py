@@ -113,6 +113,10 @@ class PodSpec:
     #: (the reference keeps WaitOnPermit assumptions out of the API
     #: server; node agents must not treat such a pod as running)
     waiting_permit: bool = False
+    #: metadata.creationTimestamp (wall-clock seconds) — eviction-order
+    #: final tiebreak (descheduler sorter PodCreationTimestamp: newer
+    #: pods evict first) and lifetime/arbitrator inputs
+    creation_time: float = 0.0
 
     def __post_init__(self) -> None:
         if self.priority_class is None:

@@ -1,13 +1,23 @@
 """LowNodeLoad: balance actual utilization across the pool.
 
 Semantics oracle: pkg/descheduler/framework/plugins/loadaware/
-{low_node_load.go, utilization_util.go} (see SURVEY.md A.7): classify
-nodes by *real* utilization (NodeMetric) against low/high thresholds —
-underutilized iff below all lows, overutilized iff above any high —
-debounce with the anomaly detector, then evict the heaviest pods from
-overutilized nodes while the destination pool has headroom. The
-classification runs as one vectorized pass over the (nodes × resources)
-matrix (``ops.rebalance.classify_nodes``).
+{low_node_load.go:134-326, utilization_util.go} (see SURVEY.md A.7):
+classify nodes by *real* utilization (NodeMetric) against low/high
+thresholds — underutilized iff below all lows, overutilized iff above
+any high — debounce with the anomaly detector, then evict the heaviest
+pods from overutilized nodes while the destination pool has headroom.
+The classification runs as one vectorized pass over the
+(nodes × resources) matrix (``ops.rebalance``), threshold resolution in
+reference-exact float64; victim ordering uses the full PodSorter chain
+(``descheduler.sorter``).
+
+Design note (getNodeUsage, utilization_util.go:132-191): the reference
+recomposes node usage as systemUsage + Σ podUsage from the NodeMetric
+CR. Our ``NodeMetric.node_usage`` is reported by the koordlet as exactly
+that total, so the plugin reads it directly — same quantity, one hop
+shorter. Pods without a metric entry behave as in the reference: they
+can still be evicted, but decrement neither the node usage nor the
+destination headroom (:339-352).
 """
 
 from __future__ import annotations
@@ -23,7 +33,11 @@ from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
 from koordinator_tpu.apis.types import resources_to_vector, selector_matches
 from koordinator_tpu.descheduler.anomaly import BasicDetector, State
 from koordinator_tpu.descheduler.framework import BalancePlugin, Evictor
-from koordinator_tpu.ops.rebalance import classify_nodes
+from koordinator_tpu.descheduler.sorter import (
+    pod_sort_key,
+    resource_usage_score,
+)
+from koordinator_tpu.ops.rebalance import classify_nodes, threshold_quantities
 
 
 @dataclasses.dataclass
@@ -119,19 +133,23 @@ class LowNodeLoad(BalancePlugin):
         )
         if not nodes:
             return
+        low_q, high_q, res_mask = threshold_quantities(
+            usage, alloc,
+            _percent_vec(pool.low_thresholds),
+            _percent_vec(pool.high_thresholds),
+            fresh,
+            use_deviation=pool.use_deviation_thresholds,
+        )
         verdict = classify_nodes(
             jnp.asarray(usage),
-            jnp.asarray(alloc),
-            jnp.asarray(_percent_vec(pool.low_thresholds)),
-            jnp.asarray(_percent_vec(pool.high_thresholds)),
+            jnp.asarray(low_q),
+            jnp.asarray(high_q),
+            jnp.asarray(res_mask),
             jnp.asarray(fresh),
             jnp.asarray(schedulable),
-            use_deviation=pool.use_deviation_thresholds,
         )
         low = np.asarray(verdict.low)
         high = np.asarray(verdict.high)
-        over_res = np.asarray(verdict.over_resource)
-        high_q = np.asarray(verdict.high_quantity)
 
         source_idx = [i for i in np.flatnonzero(high)]
         for i in source_idx:
@@ -178,14 +196,9 @@ class LowNodeLoad(BalancePlugin):
         if len(low_idx) == len(nodes):
             return
 
-        # destination headroom: Σ over low nodes of (high threshold − usage),
-        # tracked only on thresholded resources (the reference's
-        # resourceNames set — union of low and high threshold names,
-        # utilization_util.go newThresholds)
-        thresholded = (
-            (_percent_vec(pool.low_thresholds) >= 0)
-            | (_percent_vec(pool.high_thresholds) >= 0)
-        )
+        # destination headroom: Σ over low nodes of (high threshold −
+        # usage), tracked on the participating resourceNames only
+        # (evictPodsFromSourceNodes:247-267)
         available = np.zeros(NUM_RESOURCES, dtype=np.int64)
         for i in low_idx:
             available += high_q[i] - usage[i]
@@ -193,13 +206,21 @@ class LowNodeLoad(BalancePlugin):
         weights = np.zeros(NUM_RESOURCES, dtype=np.int64)
         for r, w in pool.resource_weights.items():
             weights[int(r)] = w
+        # the reference scorer iterates the node usage map, whose keys
+        # are exactly resourceNames — weights outside that set never
+        # contribute to score or weight-sum
+        weights = np.where(res_mask, weights, 0)
 
-        # heaviest source nodes first (reference: sortNodesByUsage desc)
+        # heaviest source nodes first (reference: sortNodesByUsage desc,
+        # sorter.ResourceUsageScorer — weighted mean of 1000-scale
+        # mostRequestedScore over resourceNames)
+        res_idx = [int(r) for r in np.flatnonzero(res_mask)]
+
         def node_score(i):
-            cap = np.maximum(alloc[i], 1)
-            pct = usage[i] * 100 // cap
-            wsum = max(int(weights.sum()), 1)
-            return int((pct * weights).sum() // wsum)
+            u = {r: int(usage[i][r]) for r in res_idx}
+            a = {r: int(alloc[i][r]) for r in res_idx}
+            w = {r: int(weights[r]) for r in res_idx}
+            return resource_usage_score(u, a, w)
 
         abnormal_idx.sort(key=node_score, reverse=True)
         # one pass over the pod list, not one per source node
@@ -208,24 +229,33 @@ class LowNodeLoad(BalancePlugin):
             if pod.node_name:
                 pods_by_node.setdefault(pod.node_name, []).append(pod)
         low_arr = np.asarray(low_idx, dtype=np.int64)
+        fits_any = _FitProbe(alloc[low_arr] - usage[low_arr])
         for i in abnormal_idx:
             self._evict_from_node(
                 pool, snapshot, evictor, nodes[i],
                 pods_by_node.get(nodes[i].name, []), usage[i], high_q[i],
-                over_res[i], available, thresholded, weights,
-                alloc, usage, low_arr,
+                available, res_mask, weights, fits_any,
             )
+        # one normal observation on every abnormal node at the end of
+        # the pass (reference: tryMarkNodesAsNormal)
+        for i in abnormal_idx:
+            det = self.detectors.get(nodes[i].name)
+            if det is not None:
+                det.mark(True)
 
-    def _pod_usage(self, snapshot, pod) -> np.ndarray:
-        metric = snapshot.node_metrics.get(pod.node_name or "")
+    def _pod_metric(self, snapshot, node, pod):
+        """The pod's metric ResourceList from the SOURCE NODE's metric
+        map, or None when absent (reference nodeInfo.podMetrics lookup
+        :338-341 — keyed off the node being drained, so eviction
+        clearing pod.node_name cannot orphan the lookup)."""
+        metric = snapshot.node_metrics.get(node.name)
         if metric is not None and pod.uid in metric.pod_usages:
-            return resources_to_vector(metric.pod_usages[pod.uid])
-        return resources_to_vector(pod.requests)
+            return metric.pod_usages[pod.uid]
+        return None
 
     def _evict_from_node(
         self, pool, snapshot, evictor, node, node_pods, node_usage,
-        node_high_q, node_over, available, thresholded, weights, alloc,
-        usage, low_arr,
+        node_high_q, available, res_mask, weights, fits_any,
     ) -> None:
         removable = []
         for pod in node_pods:
@@ -235,49 +265,73 @@ class LowNodeLoad(BalancePlugin):
                 continue
             if not evictor.filter(pod):
                 continue
-            if self.args.node_fit and not self._fits_any(
-                pod, alloc, usage, low_arr
+            if self.args.node_fit and not fits_any(
+                resources_to_vector(pod.requests)
             ):
                 continue
             removable.append(pod)
         if not removable:
             return
 
-        # evict biggest consumers of the *overused* resources first
-        # (reference: sortPodsOnOneOverloadedNode — weights zeroed for
-        # resources the node is not overusing)
-        over_weights = np.where(node_over, weights, 0)
-        cap = np.maximum(resources_to_vector(node.allocatable), 1)
-        wsum = max(int(over_weights.sum()), 1)
-
-        def pod_score(pod):
-            u = self._pod_usage(snapshot, pod)
-            return int((u * 100 // cap * over_weights).sum() // wsum)
-
-        removable.sort(key=pod_score, reverse=True)
+        # evict biggest consumers of the *overused* resources first,
+        # under the full PodSorter chain (priority class, priority, QoS,
+        # costs, usage desc, creation) — sortPodsOnOneOverloadedNode:
+        # weights restricted to resources the node is overusing
+        over = (node_usage > node_high_q) & res_mask
+        over_weights = {
+            ResourceName(r): int(weights[r]) for r in np.flatnonzero(over)
+        }
+        removable.sort(key=lambda pod: pod_sort_key(
+            pod, self._pod_metric(snapshot, node, pod), node.allocatable,
+            over_weights,
+        ))
         for pod in removable:
             # stop once the node is back under every high threshold or the
             # destination headroom is gone (reference: continueEvictionCond)
-            if not ((node_usage > node_high_q).any()):
+            if not ((node_usage > node_high_q) & res_mask).any():
                 det = self.detectors.get(node.name)
                 if det is not None:
                     det.reset()
                 return
-            if (available[thresholded] <= 0).any():
+            if (available[res_mask] <= 0).any():
                 return
             if not evictor.evict(snapshot, pod, reason=(
                 f"node {node.name} over-utilized"
             )):
                 continue
-            u = self._pod_usage(snapshot, pod)
-            available -= u
-            node_usage -= u
+            pod_metric = self._pod_metric(snapshot, node, pod)
+            if pod_metric is None:
+                # evicted, but with no metric there is nothing to
+                # subtract (reference evictPods:339-341 continue)
+                continue
+            u = resources_to_vector(pod_metric)
+            available -= np.where(res_mask, u, 0)
+            node_usage -= np.where(res_mask, u, 0)
 
-    def _fits_any(self, pod, alloc, usage, low_arr) -> bool:
-        """nodeFit gate (reference: nodeutil.PodFitsAnyNode): some
-        underutilized node has headroom for the pod's request."""
-        if low_arr.size == 0:
+class _FitProbe:
+    """nodeFit gate (reference: nodeutil.PodFitsAnyNode): some
+    underutilized node has headroom for the pod's request.
+
+    Exact, with two O(R) screens before the O(low_nodes × R) scan:
+    a pod whose request exceeds the columnwise max headroom fits
+    nowhere, and a pod that fits the single emptiest node needs no
+    scan — at bench shape (~5k low nodes) that removes ~99% of the
+    full scans without changing any answer."""
+
+    def __init__(self, headroom: np.ndarray):
+        self.headroom = headroom
+        if headroom.size:
+            self.col_max = headroom.max(axis=0)
+            # anchor: row maximizing the columnwise-normalized minimum
+            # headroom (any anchor is correct; this one catches most)
+            norm = headroom / np.maximum(self.col_max, 1)[None, :]
+            self.anchor = headroom[int(np.argmax(norm.min(axis=1)))]
+
+    def __call__(self, req: np.ndarray) -> bool:
+        if not self.headroom.size:
             return False
-        req = resources_to_vector(pod.requests)
-        fits = (usage[low_arr] + req[None, :]) <= alloc[low_arr]
-        return bool(fits.all(axis=1).any())
+        if (req > self.col_max).any():
+            return False
+        if (req <= self.anchor).all():
+            return True
+        return bool((req[None, :] <= self.headroom).all(axis=1).any())

@@ -2,73 +2,136 @@
 
 Semantics oracle: pkg/descheduler/framework/plugins/loadaware/
 {low_node_load.go:286-326, utilization_util.go getNodeThresholds /
-isNodeOverutilized / isNodeUnderutilized / calcAverageResourceUsagePercent}.
-The reference classifies nodes one by one; here the whole (nodes ×
-resources) matrix classifies in one fused XLA computation so a 5k-node
-pool (BASELINE config #5) is a single device pass.
+isNodeOverutilized / isNodeUnderutilized / calcAverageResourceUsagePercent,
+newThresholds}. The reference classifies nodes one by one; here the whole
+(nodes × resources) matrix classifies in one fused pass so a 5k-node pool
+(BASELINE config #5) is a single vector op.
 
-Threshold quantities follow the reference exactly:
-``q = int(percent * 0.01 * capacity)`` (truncation), a node is
-*underutilized* iff usage <= low_q on every thresholded resource, and
-*overutilized* iff usage > high_q on any. A percent of -1 marks an unset
-threshold: the resource never triggers (its threshold becomes capacity).
-Deviation mode offsets thresholds by the pool's average utilization
-percent.
+Two stages, split by arithmetic domain:
+
+- ``threshold_quantities`` resolves percent thresholds into absolute
+  quantities on the host in **float64**, because the reference's
+  ``resourceThreshold`` computes ``int64(float64(pct) * 0.01 *
+  float64(capacity))`` — float rounding included (0.29 * 100 truncates
+  to 28, not 29). Integer ``pct * cap // 100`` is NOT equivalent, and
+  these quantities are the semantics the oracle checks bit-for-bit.
+  It also resolves the *participating resource set* (``resourceNames``
+  in the reference): union of low/high threshold names **plus memory,
+  always** (utilization_util.go newThresholds), missing entries filled
+  with 100% (or 0% in deviation mode, which resolves to full capacity).
+- ``classify_nodes`` compares usage against the resolved quantities as
+  one vector op: *underutilized* iff usage <= low_q on every
+  participating resource, *overutilized* iff usage > high_q on any.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
 
 
 class RebalanceVerdict(NamedTuple):
     low: jax.Array          # [N] bool: underutilized
     high: jax.Array         # [N] bool: overutilized
     over_resource: jax.Array  # [N, R] bool: which resources are over
-    low_quantity: jax.Array   # [N, R] i32 resolved low threshold quantities
-    high_quantity: jax.Array  # [N, R] i32 resolved high threshold quantities
+    low_quantity: jax.Array   # [N, R] i64 resolved low threshold quantities
+    high_quantity: jax.Array  # [N, R] i64 resolved high threshold quantities
+
+
+def threshold_quantities(
+    usage: np.ndarray,        # [N, R] int
+    alloc: np.ndarray,        # [N, R] int capacity/allocatable
+    low_percent: np.ndarray,  # [R] int, -1 = unset
+    high_percent: np.ndarray,  # [R] int, -1 = unset
+    active: np.ndarray,       # [N] bool (nodes with fresh metrics)
+    use_deviation: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Resolve (low_q, high_q, resource_mask) exactly as the reference.
+
+    resource_mask[r] is True iff r participates at all (is in the
+    reference's ``resourceNames``): explicitly thresholded on either
+    side, or MEMORY (always appended by newThresholds). Non-participating
+    resources never classify a node and get quantity = capacity so any
+    downstream compare is inert.
+    """
+    alloc = np.asarray(alloc, dtype=np.int64)
+    usage = np.asarray(usage, dtype=np.int64)
+    low_percent = np.asarray(low_percent, dtype=np.int64)
+    high_percent = np.asarray(high_percent, dtype=np.int64)
+    mask = (low_percent >= 0) | (high_percent >= 0)
+    mask[int(ResourceName.MEMORY)] = True
+
+    # missing names fill with MaxResourcePercentage (100) — or
+    # MinResourcePercentage (0) in deviation mode, where the 0 fill is
+    # special-cased to full capacity (getNodeThresholds:100-102)
+    fill = 0.0 if use_deviation else 100.0
+    low_p = np.where(low_percent >= 0, low_percent, fill).astype(np.float64)
+    high_p = np.where(high_percent >= 0, high_percent, fill).astype(np.float64)
+
+    if use_deviation:
+        # calcAverageResourceUsagePercent: float percent per (node,
+        # resource) over nodes with usable metrics, zero-capacity
+        # resources skipped, averaged over that node count
+        n_active = max(int(np.asarray(active).sum()), 1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            pct = np.where(
+                alloc > 0, usage.astype(np.float64) / alloc * 100.0, 0.0
+            )
+        avg = (pct * np.asarray(active, dtype=np.float64)[:, None]).sum(
+            axis=0
+        ) / n_active
+        dev_low = np.clip(avg - low_p, 0.0, 100.0)
+        dev_high = np.clip(avg + high_p, 0.0, 100.0)
+        # the MinResourcePercentage fill resolves to full capacity on
+        # both sides, NOT avg±0
+        low_q = np.where(
+            low_p == 0.0, alloc,
+            (dev_low[None, :] * 0.01 * alloc.astype(np.float64)).astype(
+                np.int64
+            ),
+        )
+        high_q = np.where(
+            low_p == 0.0, alloc,
+            (dev_high[None, :] * 0.01 * alloc.astype(np.float64)).astype(
+                np.int64
+            ),
+        )
+    else:
+        # q = int64(float64(pct) * 0.01 * float64(cap)) — float on
+        # purpose, see module docstring
+        low_q = (low_p[None, :] * 0.01 * alloc.astype(np.float64)).astype(
+            np.int64
+        )
+        high_q = (high_p[None, :] * 0.01 * alloc.astype(np.float64)).astype(
+            np.int64
+        )
+    low_q = np.where(mask[None, :], low_q, alloc)
+    high_q = np.where(mask[None, :], high_q, alloc)
+    return low_q, high_q, mask
 
 
 def classify_nodes(
     usage: jax.Array,        # [N, R] int
-    alloc: jax.Array,        # [N, R] int capacity/allocatable
-    low_percent: jax.Array,  # [R] int, -1 = unset
-    high_percent: jax.Array,  # [R] int, -1 = unset
+    low_q: jax.Array,        # [N, R] int resolved low quantities
+    high_q: jax.Array,       # [N, R] int resolved high quantities
+    resource_mask: jax.Array,  # [R] bool: participates in classification
     active: jax.Array,       # [N] bool: nodes participating (pool + fresh
                              # metric, reference low_node_load.go:153)
     schedulable: jax.Array,  # [N] bool: unschedulable nodes can't be "low"
-    use_deviation: bool = False,
 ) -> RebalanceVerdict:
+    # i32 on device: quantities are millicores/MiB, well under 2^31
+    # (resolution already happened in host float64)
     usage = usage.astype(jnp.int32)
-    alloc = alloc.astype(jnp.int32)
-    thresholded = low_percent >= 0
+    low_q = low_q.astype(jnp.int32)
+    high_q = high_q.astype(jnp.int32)
 
-    low_p = jnp.where(thresholded, low_percent, 100).astype(jnp.int32)
-    high_p = jnp.where(high_percent >= 0, high_percent, 100).astype(jnp.int32)
-
-    if use_deviation:
-        # pool-average utilization percent per resource (reference:
-        # calcAverageResourceUsagePercent — mean over active nodes of
-        # usage*100/capacity, integer division per node)
-        node_pct = jnp.where(
-            alloc > 0, usage * 100 // jnp.maximum(alloc, 1), 0
-        )
-        n_active = jnp.maximum(active.sum(), 1)
-        avg = (node_pct * active[:, None]).sum(axis=0) // n_active
-        low_p = jnp.clip(avg - low_p, 0, 100)
-        high_p = jnp.clip(avg + high_p, 0, 100)
-        low_p = jnp.where(thresholded, low_p, 100)
-        high_p = jnp.where(high_percent >= 0, high_p, 100)
-
-    # q = trunc(percent * 0.01 * capacity), exact in integer math
-    low_q = low_p[None, :] * alloc // 100
-    high_q = high_p[None, :] * alloc // 100
-
-    under_each = usage <= low_q
-    over_each = (usage > high_q) & (high_percent >= 0)[None, :]
+    under_each = (usage <= low_q) | ~resource_mask[None, :]
+    over_each = (usage > high_q) & resource_mask[None, :]
 
     low = under_each.all(axis=1) & active & schedulable
     high = over_each.any(axis=1) & active

@@ -28,7 +28,7 @@ from koordinator_tpu.descheduler import (
     Profile,
 )
 from koordinator_tpu.descheduler.anomaly import State
-from koordinator_tpu.ops.rebalance import classify_nodes
+from koordinator_tpu.ops.rebalance import classify_nodes, threshold_quantities
 
 CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
 
@@ -40,6 +40,19 @@ def pvec(d):
     return v
 
 
+def classify(usage, alloc, low_d, high_d, active, sched,
+             use_deviation=False):
+    """threshold_quantities + classify_nodes, the way the plugin runs."""
+    low_q, high_q, mask = threshold_quantities(
+        usage, alloc, pvec(low_d), pvec(high_d), np.asarray(active),
+        use_deviation=use_deviation,
+    )
+    return classify_nodes(
+        jnp.asarray(usage), jnp.asarray(low_q), jnp.asarray(high_q),
+        jnp.asarray(mask), jnp.asarray(active), jnp.asarray(sched),
+    )
+
+
 class TestClassifyOp:
     def test_basic_classification(self):
         alloc = np.tile(np.array([[0] * NUM_RESOURCES]), (3, 1))
@@ -48,11 +61,8 @@ class TestClassifyOp:
         usage[0, CPU] = 1000   # 10% → low
         usage[1, CPU] = 5000   # 50% → neither
         usage[2, CPU] = 9000   # 90% → high
-        v = classify_nodes(
-            jnp.asarray(usage), jnp.asarray(alloc),
-            jnp.asarray(pvec({CPU: 30})), jnp.asarray(pvec({CPU: 70})),
-            jnp.ones(3, bool), jnp.ones(3, bool),
-        )
+        v = classify(usage, alloc, {CPU: 30}, {CPU: 70},
+                     np.ones(3, bool), np.ones(3, bool))
         assert list(np.asarray(v.low)) == [True, False, False]
         assert list(np.asarray(v.high)) == [False, False, True]
 
@@ -63,12 +73,8 @@ class TestClassifyOp:
         usage = np.zeros_like(alloc)
         usage[0, CPU] = 1000   # under cpu low
         usage[0, MEM] = 900    # over mem high
-        v = classify_nodes(
-            jnp.asarray(usage), jnp.asarray(alloc),
-            jnp.asarray(pvec({CPU: 30, MEM: 30})),
-            jnp.asarray(pvec({CPU: 70, MEM: 70})),
-            jnp.ones(1, bool), jnp.ones(1, bool),
-        )
+        v = classify(usage, alloc, {CPU: 30, MEM: 30}, {CPU: 70, MEM: 70},
+                     np.ones(1, bool), np.ones(1, bool))
         assert not bool(np.asarray(v.low)[0])
         assert bool(np.asarray(v.high)[0])
 
@@ -78,11 +84,8 @@ class TestClassifyOp:
         usage = np.zeros_like(alloc)
         usage[0, CPU] = 2000  # 20%
         usage[1, CPU] = 8000  # 80%  avg=50
-        v = classify_nodes(
-            jnp.asarray(usage), jnp.asarray(alloc),
-            jnp.asarray(pvec({CPU: 10})), jnp.asarray(pvec({CPU: 10})),
-            jnp.ones(2, bool), jnp.ones(2, bool), use_deviation=True,
-        )
+        v = classify(usage, alloc, {CPU: 10}, {CPU: 10},
+                     np.ones(2, bool), np.ones(2, bool), use_deviation=True)
         # thresholds become low=40%, high=60%
         assert list(np.asarray(v.low)) == [True, False]
         assert list(np.asarray(v.high)) == [False, True]
@@ -92,12 +95,41 @@ class TestClassifyOp:
         alloc[0, CPU] = 10000
         usage = np.zeros_like(alloc)
         usage[0, CPU] = 9900
-        v = classify_nodes(
-            jnp.asarray(usage), jnp.asarray(alloc),
-            jnp.asarray(pvec({CPU: 30})), jnp.asarray(pvec({CPU: 70})),
-            jnp.zeros(1, bool), jnp.ones(1, bool),
-        )
+        v = classify(usage, alloc, {CPU: 30}, {CPU: 70},
+                     np.zeros(1, bool), np.ones(1, bool))
         assert not bool(np.asarray(v.high)[0])
+
+    def test_float_threshold_rounding_matches_reference(self):
+        """resourceThreshold is int64(float64(pct)*0.01*float64(cap)) —
+        0.29*100 truncates to 28 in float64, NOT the integer 29."""
+        alloc = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+        alloc[0, CPU] = 100
+        usage = np.zeros_like(alloc)
+        low_q, high_q, _ = threshold_quantities(
+            usage, alloc, pvec({CPU: 29}), pvec({CPU: 29}),
+            np.ones(1, bool),
+        )
+        assert int(low_q[0, CPU]) == int(0.29 * 100.0)  # 28, not 29
+        assert int(low_q[0, CPU]) == 28
+
+    def test_memory_always_participates(self):
+        """newThresholds appends memory to resourceNames always: with
+        only a cpu threshold set, memory usage above capacity still
+        flags the node overutilized (fill = 100%)."""
+        alloc = np.zeros((1, NUM_RESOURCES), dtype=np.int64)
+        alloc[0, CPU] = 10000
+        alloc[0, MEM] = 1000
+        usage = np.zeros_like(alloc)
+        usage[0, MEM] = 1500   # above 100% of capacity
+        v = classify(usage, alloc, {CPU: 30}, {CPU: 70},
+                     np.ones(1, bool), np.ones(1, bool))
+        assert bool(np.asarray(v.high)[0])
+        # but a non-thresholded, non-memory resource never triggers
+        usage2 = np.zeros_like(alloc)
+        usage2[0, ResourceName.GPU] = 99999
+        v2 = classify(usage2, alloc, {CPU: 30}, {CPU: 70},
+                      np.ones(1, bool), np.ones(1, bool))
+        assert not bool(np.asarray(v2.high)[0])
 
 
 class TestAnomalyDetector:
@@ -210,12 +242,8 @@ class TestLowNodeLoad:
         alloc[:, CPU] = 10000
         usage = np.zeros_like(alloc)
         usage[0, CPU] = 9500
-        v = classify_nodes(
-            jnp.asarray(usage), jnp.asarray(alloc),
-            jnp.asarray(pvec({MEM: 60})),       # low only on memory
-            jnp.asarray(pvec({CPU: 70})),       # high only on cpu
-            jnp.ones(2, bool), jnp.ones(2, bool),
-        )
+        v = classify(usage, alloc, {MEM: 60}, {CPU: 70},
+                     np.ones(2, bool), np.ones(2, bool))
         assert bool(np.asarray(v.high)[0])
 
     def test_flapping_node_not_anomalous(self):

@@ -1,0 +1,245 @@
+"""Differential: the vectorized oracle's NUMA + reservation + quota +
+gang modeling vs the device scan solver (VERDICT r4 #2).
+
+The oracle (oracle/vectorized.py) re-derives each feature from the
+reference semantics (nodenumaresource/scoring.go for the NUMA term,
+reservation transformer restore + Reserve for credit/consumption) in
+sequential numpy, structured nothing like the lax.scan; these tests
+randomize shapes and feature mixes and require bit-identity on the
+assignment AND every mutated carry (used_req, numa_free, resv_free,
+quota used) — so configs 6/7-style workloads are checked against
+reference semantics, not merely kernel==scan self-consistency.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _example_problem
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.ops.binpack import (
+    NumaAux,
+    ResvArrays,
+    SolverConfig,
+    solve_batch,
+)
+from koordinator_tpu.oracle.vectorized import (
+    VectorQuota,
+    solve_full_vectorized,
+)
+
+
+def _with_numa(state, pods, rng):
+    cap = np.asarray(state.alloc)
+    free = (cap * rng.uniform(0.2, 1.0, cap.shape)).astype(np.int32)
+    state = state._replace(
+        numa_cap=jnp.asarray(cap), numa_free=jnp.asarray(free)
+    )
+    n_pods = np.asarray(pods.req).shape[0]
+    pods = pods._replace(
+        has_numa_policy=jnp.asarray(rng.uniform(size=n_pods) < 0.4)
+    )
+    aux = NumaAux(
+        node_policy=jnp.asarray(rng.uniform(size=cap.shape[0]) < 0.5)
+    )
+    return state, pods, aux
+
+
+def _resv_arrays(n_nodes, n_pods, n_resv, rng):
+    node = rng.integers(0, n_nodes, n_resv).astype(np.int32)
+    free = np.zeros((n_resv, NUM_RESOURCES), np.int32)
+    free[:, ResourceName.CPU] = rng.integers(0, 3000, n_resv)
+    free[:, ResourceName.MEMORY] = rng.integers(0, 3000, n_resv)
+    allocate_once = rng.uniform(size=n_resv) < 0.5
+    # owner-style match: each reservation matches a contiguous slice of
+    # pods; some pods match several reservations, most match none
+    match = np.zeros((n_pods, n_resv), bool)
+    for v in range(n_resv):
+        lo = int(rng.integers(0, max(n_pods - 8, 1)))
+        match[lo:lo + int(rng.integers(2, 10)), v] = True
+    return ResvArrays(
+        node=jnp.asarray(node),
+        free=jnp.asarray(free),
+        allocate_once=jnp.asarray(allocate_once),
+        match=jnp.asarray(match),
+    )
+
+
+def _quota(state, pods, n_quota, rng):
+    from koordinator_tpu.ops.quota import QuotaState
+
+    cap = np.asarray(state.alloc)
+    n_pods = np.asarray(pods.req).shape[0]
+    qid = rng.integers(-1, n_quota, n_pods).astype(np.int32)
+    pods = pods._replace(
+        quota_id=jnp.asarray(qid),
+        non_preemptible=jnp.asarray(rng.uniform(size=n_pods) < 0.3),
+    )
+    total = cap.astype(np.int64).sum(axis=0)
+    mn = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mx = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    for r in (ResourceName.CPU, ResourceName.MEMORY):
+        mn[:, r] = total[r] // (2 * n_quota)
+        mx[:, r] = total[r] // 3
+    req_np = np.asarray(pods.req).astype(np.int64)
+    child_request = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    sel = qid >= 0
+    np.add.at(child_request, qid[sel], req_np[sel])
+    qstate = QuotaState.build(
+        min=mn, max=mx, weight=mx, allow_lent=np.ones(n_quota, bool),
+        total=total, child_request=child_request,
+    )
+    vq = VectorQuota(
+        min_=mn, max_=mx, auto_min=np.asarray(qstate.auto_min),
+        weight=mx, allow_lent=np.ones(n_quota, bool), total=total,
+    )
+    return pods, qstate, vq, qid
+
+
+def _gang(pods, n_gangs, members, rng):
+    from koordinator_tpu.ops.gang import GangState
+
+    n_pods = np.asarray(pods.req).shape[0]
+    gang_id = np.full(n_pods, -1, np.int32)
+    count = min(n_gangs * members, n_pods)
+    gang_id[:count] = np.repeat(
+        np.arange(n_gangs, dtype=np.int32), members
+    )[:count]
+    strict = rng.uniform(size=n_gangs) < 0.7
+    gstate = GangState.build(
+        min_member=[members] * n_gangs, strict=strict
+    )
+    return pods._replace(gang_id=jnp.asarray(gang_id)), gstate, gang_id
+
+
+def _check(result, oracle, qstate_used=None):
+    np.testing.assert_array_equal(
+        np.asarray(result.assign), oracle["assign"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(result.node_state.used_req), oracle["used_req"]
+    )
+    if "numa_free" in oracle:
+        np.testing.assert_array_equal(
+            np.asarray(result.node_state.numa_free), oracle["numa_free"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(result.numa_consumed), oracle["numa_consumed"]
+        )
+    if "resv_free" in oracle:
+        np.testing.assert_array_equal(
+            np.asarray(result.resv_free), oracle["resv_free"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(result.resv_vstar), oracle["resv_vstar"]
+        )
+    if qstate_used is not None:
+        np.testing.assert_array_equal(
+            np.asarray(result.quota_state.used), qstate_used
+        )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_numa_oracle_identity(seed):
+    n_nodes, n_pods = 96, 256
+    state, pods, params = _example_problem(n_nodes, n_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    state, pods, aux = _with_numa(state, pods, rng)
+    result = jax.jit(
+        lambda s, p, pr: solve_batch(s, p, pr, SolverConfig(), numa=aux)
+    )(state, pods, params)
+    oracle = solve_full_vectorized(state, pods, params, numa_aux=aux)
+    _check(result, oracle)
+    assert int(np.asarray(result.numa_consumed).sum()) > 0
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_reservation_oracle_identity(seed):
+    n_nodes, n_pods, n_resv = 64, 200, 24
+    state, pods, params = _example_problem(n_nodes, n_pods, seed=seed)
+    rng = np.random.default_rng(100 + seed)
+    resv = _resv_arrays(n_nodes, n_pods, n_resv, rng)
+    result = jax.jit(
+        lambda s, p, pr: solve_batch(s, p, pr, SolverConfig(), resv=resv)
+    )(state, pods, params)
+    oracle = solve_full_vectorized(state, pods, params, resv=resv)
+    _check(result, oracle)
+    assert int((np.asarray(result.resv_vstar) >= 0).sum()) > 0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_all_features_oracle_identity(seed):
+    """Quota + gang + NUMA + reservations fused in one solve — the full
+    epilogue (strict-gang release of node/NUMA/reservation/quota holds)
+    checked bit-for-bit."""
+    n_nodes, n_pods, n_quota, n_gangs, n_resv = 80, 320, 8, 12, 16
+    state, pods, params = _example_problem(n_nodes, n_pods, seed=seed)
+    rng = np.random.default_rng(200 + seed)
+    state, pods, aux = _with_numa(state, pods, rng)
+    resv = _resv_arrays(n_nodes, n_pods, n_resv, rng)
+    pods, qstate, vq, qid = _quota(state, pods, n_quota, rng)
+    pods, gstate, gang_id = _gang(pods, n_gangs, 8, rng)
+
+    result = jax.jit(
+        lambda s, p, pr, q, g: solve_batch(
+            s, p, pr, SolverConfig(), q, g, resv=resv, numa=aux
+        )
+    )(state, pods, params, qstate, gstate)
+
+    oracle = solve_full_vectorized(
+        state, pods, params,
+        quota=vq, pod_quota_id=qid,
+        pod_non_preemptible=np.asarray(pods.non_preemptible),
+        gang_id=gang_id,
+        gang_min_member=np.asarray(gstate.min_member),
+        gang_bound_count=np.asarray(gstate.bound_count),
+        gang_strict=np.asarray(gstate.strict),
+        gang_group_id=np.asarray(gstate.group_id),
+        numa_aux=aux, resv=resv,
+    )
+    _check(result, oracle, qstate_used=vq.used)
+    assert int((np.asarray(result.resv_vstar) >= 0).sum()) > 0
+
+
+def test_all_features_epilogue_forced_rejection():
+    """A gang too large to place fully forces the Strict release path:
+    node, NUMA, reservation and quota holds all roll back, oracle
+    bit-identical."""
+    n_nodes, n_pods, n_quota, n_resv = 24, 160, 4, 10
+    state, pods, params = _example_problem(n_nodes, n_pods, seed=99)
+    rng = np.random.default_rng(99)
+    state, pods, aux = _with_numa(state, pods, rng)
+    resv = _resv_arrays(n_nodes, n_pods, n_resv, rng)
+    pods, qstate, vq, qid = _quota(state, pods, n_quota, rng)
+    # one strict gang whose min_member exceeds the pod count: never
+    # satisfiable, so every placed member rolls back through the
+    # epilogue release
+    pods, gstate, gang_id = _gang(pods, 1, n_pods, rng)
+    gstate = gstate._replace(
+        min_member=jnp.asarray([n_pods + 1], jnp.int32),
+        strict=jnp.ones(1, bool),
+    )
+
+    result = jax.jit(
+        lambda s, p, pr, q, g: solve_batch(
+            s, p, pr, SolverConfig(), q, g, resv=resv, numa=aux
+        )
+    )(state, pods, params, qstate, gstate)
+
+    oracle = solve_full_vectorized(
+        state, pods, params,
+        quota=vq, pod_quota_id=qid,
+        pod_non_preemptible=np.asarray(pods.non_preemptible),
+        gang_id=gang_id,
+        gang_min_member=np.asarray(gstate.min_member),
+        gang_bound_count=np.asarray(gstate.bound_count),
+        gang_strict=np.asarray(gstate.strict),
+        gang_group_id=np.asarray(gstate.group_id),
+        numa_aux=aux, resv=resv,
+    )
+    _check(result, oracle, qstate_used=vq.used)
+    assert int(np.asarray(result.rejected).sum()) > 0
+    assert int((np.asarray(result.resv_vstar) >= 0).sum()) > 0
+    assert int(np.asarray(result.numa_consumed).sum()) > 0
