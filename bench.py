@@ -23,8 +23,15 @@ reports ``{pods_per_sec, p99_s, identical_to_oracle}``:
 7. (extra) 16k-node flagship leg — past the old 8192-node kernel cap
    (the packed argmax now carries the lane in 16 bits), kernel vs scan
    winner-kept with bit-identity;
+8. (extra) full-features flagship leg — quota + strict gangs + NUMA +
+   reservations fused in one 5k x 10k solve, oracle-identical on every
+   mutated carry;
 plus a ``sharded`` entry: multi-device solve throughput when >1 device
-is attached, else the 8-device virtual-CPU dryrun wall time (smoke).
+is attached — the sharded PALLAS kernel (per-shard VMEM carry,
+in-kernel per-pod cross-shard winner merge) vs the GSPMD scan, winner
+kept with bit-identity — else the 8-device virtual-CPU dryrun wall
+time, whose ``ok`` now certifies sharded==single-device bit-identity
+at a non-toy full-feature shape.
 
 Kernel-vs-scan crossover (measured r4, one v5e chip, 3-5 reps): the
 kernel wins every gang shape tried (400-6400 nodes, 1.1-1.6x) and every
@@ -33,12 +40,17 @@ run-to-run tunnel variance (kernel won 2 of 3 trials); at 16k nodes the
 kernel is ~2x the scan. The per-config winner-keep below therefore IS
 the dispatch policy, re-measured every run.
 
-Oracle identity for the flagship and configs 2-4 runs at the FULL config
-shape through the vectorized host oracle (oracle/vectorized.py — the
-sequential reference semantics with the node loop vectorized in int64
-numpy; its own authority is the differential sweep against the scalar
-transliteration in tests/test_oracle_vectorized.py). Config 5's check is
-a full-shape numpy re-derivation. No reduced-shape extrapolation remains.
+Oracle identity for the flagship and configs 2-4 and 6-8 runs at the
+FULL config shape through the vectorized host oracle
+(oracle/vectorized.py — the sequential reference semantics with the
+node loop vectorized in int64 numpy; its own authority is the
+differential sweep against the scalar transliteration in
+tests/test_oracle_vectorized.py, plus the feature differentials in
+tests/test_oracle_full_features.py). Config 5's check is the
+independent scalar transliteration of the complete reference Balance
+sweep (oracle/rebalance.py) — the ORDERED eviction sequence must match.
+No reduced-shape extrapolation and no self-consistency-only entry
+remains.
 
 Env knobs: KTPU_BENCH_NODES, KTPU_BENCH_PODS, KTPU_BENCH_REPEATS,
 KTPU_BENCH_MATRIX=0 to skip the matrix (flagship only),
@@ -841,22 +853,47 @@ def bench_sharded(repeats):
 
     devices = jax.devices()
     if len(devices) > 1:
+        from koordinator_tpu.ops.binpack import SolverConfig
         from koordinator_tpu.parallel.mesh import (
-            make_mesh, shard_node_state, shard_solver,
+            make_mesh, shard_kernel_solver, shard_node_state, shard_solver,
         )
 
         n_nodes = int(os.environ.get("KTPU_BENCH_NODES", 5000))
         n_pods = int(os.environ.get("KTPU_BENCH_PODS", 10000))
         state, pods, params = _problem(n_nodes, n_pods)
         mesh = make_mesh(devices)
-        state = shard_node_state(state, mesh)
-        solve = shard_solver(mesh, SolverConfig(unroll=BENCH_UNROLL))
-        best, warmup, _out = _timed(solve, repeats, state, pods, params)
-        p99_s = _p99(solve, (state, pods, params), max(20, repeats))
+        sstate = shard_node_state(state, mesh)
+        scan = shard_solver(mesh, SolverConfig(unroll=BENCH_UNROLL))
+        scan_fn = lambda s, p, pr: scan(s, p, pr)
+        kern_fn = None
+        if devices[0].platform == "tpu":
+            # sharded pallas kernel: per-shard VMEM carry, in-kernel
+            # per-pod cross-shard winner merge over remote DMAs
+            ksolve = shard_kernel_solver(mesh, SolverConfig())
+            kern_fn = lambda s, p, pr: (
+                lambda r: (r.node_state, r.assign)
+            )(ksolve(s, p, pr))
+
+        def cmp(a, b):
+            return bool(
+                (np.asarray(a[1]) == np.asarray(b[1])).all()
+            ) and bool(
+                (np.asarray(a[0].used_req) == np.asarray(b[0].used_req)).all()
+            )
+
+        best, warmup, _out, solver, win, scan_best, kvs = (
+            _pick_kernel_or_scan(
+                scan_fn, kern_fn, repeats, (sstate, pods, params), cmp
+            )
+        )
+        p99_s = _p99(win, (sstate, pods, params), max(20, repeats))
         return {
             "mode": "multichip",
             "devices": len(devices),
             "pods_per_sec": n_pods / best,
+            "scan_pods_per_sec": n_pods / scan_best,
+            "solver": solver,
+            "kernel_vs_scan": kvs,
             "p99_s": p99_s,
             "warmup_s": warmup,
         }

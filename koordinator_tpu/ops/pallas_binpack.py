@@ -70,8 +70,19 @@ CHUNK = 128
 
 
 def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
-                 most_allocated: bool = False):
+                 most_allocated: bool = False, n_shards: int = 1,
+                 axis_name: Optional[str] = None):
+    """``n_shards > 1`` builds the DISTRIBUTED kernel (VERDICT r4 #3):
+    each device keeps its node shard's carry in VMEM and, per pod,
+    all-to-all exchanges its packed local best (score<<16 | lane
+    complement, lane GLOBAL) over remote DMAs, takes the max, and
+    mutates its carry only when the winning node is local. Quota arrays
+    are replicated and every shard replays identical quota mutations,
+    so the gate stays bit-exact without extra traffic. The packed max
+    ordering is unchanged, so tie-breaks (smallest global node index)
+    are bit-identical to the single-device kernel and the scan."""
     MOST_ALLOCATED = most_allocated
+    dist = n_shards > 1
     def kernel(*refs):
         it = iter(refs)
         req_ref, est_ref, flags_ref = next(it), next(it), next(it)  # SMEM
@@ -96,6 +107,13 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
             qused_ref, qnp_ref = next(it), next(it)
         if use_numa:
             nfree_ref = next(it)
+        if dist:
+            inbox_ref, outbox_ref, send_sem, recv_sem, ack_sem = (
+                next(it), next(it), next(it), next(it), next(it))
+            me = jax.lax.axis_index(axis_name)
+            shard_lane = jax.lax.broadcasted_iota(
+                jnp.int32, (1, n_shards), 1
+            )
         c = pl.program_id(0)
 
         @pl.when(c == 0)
@@ -217,15 +235,63 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
             # smallest lane. Halves the [1,N]-to-scalar reductions vs
             # max-then-min-where. 16 lane bits lift the node cap to
             # 65536 (VMEM becomes the binding constraint first).
+            # Distributed mode packs the GLOBAL lane (shard offset +
+            # local lane) so the cross-shard max IS the global argmax
+            # with the same smallest-node-index tie-break.
+            glane = lane + (me * N if dist else 0)
             packed = jnp.where(
-                mask, (score << 16) | (65535 - lane), -1
+                mask, (score << 16) | (65535 - glane), -1
             )
             m = jnp.max(packed)
+            if dist:
+                # per-pod cross-shard winner merge. My packed best goes
+                # to peers from a separate one-slot outbox; the inbox is
+                # written ONLY by peer RDMAs — never read-modify-written
+                # locally — so an in-flight peer delivery can't be lost
+                # to a stale full-row store. Protocol per pod: stage
+                # outbox, send to all peers, wait K-1 deliveries, read
+                # the inbox (my own column masked with my local best),
+                # THEN ack — a peer may only overwrite my inbox for the
+                # next pod after my ack, so the read is race-free.
+                outbox_ref[0, 0] = m
+                for d in range(n_shards):
+                    @pl.when(d != me)
+                    def _send():
+                        rdma = pltpu.make_async_remote_copy(
+                            src_ref=outbox_ref.at[0, 0],
+                            dst_ref=inbox_ref.at[0, me],
+                            send_sem=send_sem,
+                            recv_sem=recv_sem,
+                            device_id=d,
+                            device_id_type=pltpu.DeviceIdType.LOGICAL,
+                        )
+                        rdma.start()
+                        rdma.wait_send()
+                for _i in range(n_shards - 1):
+                    pltpu.make_async_remote_copy(
+                        src_ref=outbox_ref.at[0, 0],
+                        dst_ref=inbox_ref.at[0, me],
+                        send_sem=send_sem,
+                        recv_sem=recv_sem,
+                        device_id=me,
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    ).wait_recv()
+                # my own inbox column was never written: mask it with
+                # the local best
+                m = jnp.max(jnp.where(shard_lane == me, m, inbox_ref[...]))
+                for d in range(n_shards):
+                    @pl.when(d != me)
+                    def _ack():
+                        pltpu.semaphore_signal(
+                            ack_sem, inc=1, device_id=d,
+                            device_id_type=pltpu.DeviceIdType.LOGICAL,
+                        )
+                pltpu.semaphore_wait(ack_sem, n_shards - 1)
             ok = m >= 0
             best = (65535 - (m & 65535)).astype(jnp.int32)
             node = jnp.where(ok, best, -1).astype(jnp.int32)
             assign_ref[...] = jnp.where(chunk_lane == j, node, assign_ref[...])
-            hit = (lane == best) & ok
+            hit = (glane == best) & ok
             used_ref[...] = used + jnp.where(hit, req_v, 0)
             estx_ref[...] = estx + jnp.where(hit, est_v, 0)
             prod_ref[...] = prod_ref[...] + jnp.where(
@@ -273,15 +339,25 @@ def pallas_supported(params: ScoreParams, config) -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("wsum", "interpret", "most_allocated")
+    jax.jit,
+    static_argnames=("wsum", "interpret", "most_allocated", "n_shards",
+                     "axis_name"),
 )
 def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
                   wsum: int, interpret: bool, quota=None, numa=None,
-                  most_allocated: bool = False):
+                  most_allocated: bool = False, n_shards: int = 1,
+                  axis_name: Optional[str] = None):
     """quota = None | (min[Q,R], runtime[Q,R], used[Q,R], np_used[Q,R]);
     numa = None | (cap[N,R], free[N,R], node_policy[N]).
     Returns (new_state, assign[P], qused[Q,R]|None, qnp[Q,R]|None,
-    consumed[P]|None) — the updated numa_free rides new_state."""
+    consumed[P]|None) — the updated numa_free rides new_state.
+
+    With ``n_shards > 1`` this runs INSIDE ``jax.shard_map`` on the
+    node-shard local arrays: assign carries GLOBAL packed lane ids
+    (shard * padded_local_width + local lane — the caller remaps),
+    consumed is the LOCAL consumption bit (caller ORs across shards),
+    and quota outputs are replicated (every shard replays the same
+    global quota trajectory)."""
     n, r = state.alloc.shape
     p = pods.req.shape[0]
     N = ((n + 127) // 128) * 128
@@ -392,14 +468,33 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
                       jax.ShapeDtypeStruct((r, N), jnp.int32)]
         scratch += [pltpu.VMEM((r, N), jnp.int32)]
 
+    dist = n_shards > 1
+    compiler_params = None
+    if dist:
+        scratch += [
+            pltpu.VMEM((1, n_shards), jnp.int32),  # peer-written inbox
+            pltpu.VMEM((1, 1), jnp.int32),         # my staged outbox
+            pltpu.SemaphoreType.DMA,               # send
+            pltpu.SemaphoreType.DMA,               # recv
+            pltpu.SemaphoreType.REGULAR,           # ack barrier
+        ]
+        compiler_params = pltpu.CompilerParams(
+            has_side_effects=True, collective_id=0
+        )
+        if interpret:
+            # the distributed interpreter (remote DMAs + semaphores
+            # under shard_map) needs InterpretParams, not legacy True
+            interpret = pltpu.InterpretParams()
     out = pl.pallas_call(
-        _make_kernel(r, wsum, use_quota, use_numa, most_allocated),
+        _make_kernel(r, wsum, use_quota, use_numa, most_allocated,
+                     n_shards, axis_name),
         grid=(P // CHUNK,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
+        compiler_params=compiler_params,
     )(*args)
     out = list(out)
     assign, used, est, prod = out[:4]
@@ -451,6 +546,20 @@ def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
         None if quota_state is None
         else quota_state._replace(used=qused, np_used=qnp)
     )
+    return _kernel_epilogue(
+        new_state, assign, consumed, final_qstate, pods, gang_state,
+        has_gang, numa_aux is not None,
+    )
+
+
+def _kernel_epilogue(new_state, assign, consumed, final_qstate, pods,
+                     gang_state, has_gang: bool, has_numa: bool):
+    """The scan solver's exact post-batch tail (gang resolution +
+    rejected releases) on a kernel's outputs — shared by the
+    single-chip and sharded kernel paths."""
+    from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
+
+    n_pods = pods.req.shape[0]
     falses = jnp.zeros(n_pods, bool)
     if not has_gang:
         return SolveResult(
@@ -480,7 +589,7 @@ def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
     new_state = new_state._replace(
         used_req=used_req, est_extra=est_extra, prod_base=prod_base
     )
-    if numa_aux is not None:
+    if has_numa:
         # restore rejected pods' NUMA consumption (solve_batch's tail)
         n = new_state.used_req.shape[0]
         take = rejected & consumed

@@ -87,8 +87,13 @@ def threshold_quantities(
         ) / n_active
         dev_low = np.clip(avg - low_p, 0.0, 100.0)
         dev_high = np.clip(avg + high_p, 0.0, 100.0)
-        # the MinResourcePercentage fill resolves to full capacity on
-        # both sides, NOT avg±0
+        # Reference quirk, kept deliberately (getNodeThresholds:100-102):
+        # the full-capacity special case keys BOTH sides off the LOW
+        # percent equaling MinResourcePercentage. So with only a high
+        # threshold set (low filled to 0), both resolve to capacity —
+        # the explicit high threshold is inert in deviation mode — and
+        # with only a low threshold set, the high side resolves to
+        # avg+0: anything above pool average is overutilized.
         low_q = np.where(
             low_p == 0.0, alloc,
             (dev_low[None, :] * 0.01 * alloc.astype(np.float64)).astype(

@@ -101,6 +101,150 @@ def shard_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
     )
 
 
+def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
+                        interpret: Optional[bool] = None):
+    """The pallas kernel composed under ``jax.shard_map`` (VERDICT r4
+    #3): each device keeps its node shard's carry in VMEM and the
+    kernels merge every pod's winner across shards with an in-kernel
+    all-to-all of the packed (score, global node) best over remote DMAs
+    — multi-chip inherits kernel throughput instead of dropping to the
+    HBM-streaming scan.
+
+    Returns ``solve(state, pods, params, quota_state=None,
+    gang_state=None, numa_aux=None) -> SolveResult`` with bit-identical
+    outputs to single-device ``solve_batch``/``pallas_solve_batch``
+    (smallest-node-index tie-breaks included — the packed exchange
+    carries global lane ids). Node-count padding: the node axis is
+    padded with unschedulable zero rows to shards x 128 lanes before
+    sharding; assignments are remapped back to original indices.
+
+    On CPU (tests / the driver dryrun) the kernels run under the TPU
+    interpreter with emulated remote DMAs — the same program, same
+    synchronization, slower clock.
+    """
+    import functools
+
+    from koordinator_tpu.ops.pallas_binpack import (
+        _kernel_epilogue,
+        _pallas_solve,
+    )
+    from koordinator_tpu.ops.quota import quota_runtime
+
+    devices = list(mesh.devices.flat)
+    k = len(devices)
+
+    def solve(state, pods, params, quota_state=None, gang_state=None,
+              numa_aux=None):
+        import jax.numpy as jnp
+
+        nonlocal_interpret = interpret
+        if nonlocal_interpret is None:
+            nonlocal_interpret = devices[0].platform != "tpu"
+        use_q = quota_state is not None
+        use_n = numa_aux is not None
+        wsum = int(np.asarray(params.weights).sum()) or 1
+        n = state.alloc.shape[0]
+        # pad the node axis to shards x 128-lane multiples with
+        # unschedulable zero rows (they can never win)
+        n_loc = ((n + 128 * k - 1) // (128 * k)) * 128
+        n_pad = n_loc * k
+        if n_pad > 65536:
+            raise ValueError("packed argmax carries 16 lane bits")
+
+        def padn(a, fill=0):
+            if a is None:
+                return None
+            pw = [(0, n_pad - n)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.pad(a, pw, constant_values=fill)
+
+        state = NodeState(
+            alloc=padn(state.alloc),
+            used_req=padn(state.used_req),
+            usage=padn(state.usage),
+            prod_usage=padn(state.prod_usage),
+            est_extra=padn(state.est_extra),
+            prod_base=padn(state.prod_base),
+            metric_fresh=padn(state.metric_fresh),
+            schedulable=padn(state.schedulable),
+            numa_cap=padn(state.numa_cap),
+            numa_free=padn(state.numa_free),
+        )
+        npol = padn(numa_aux.node_policy) if use_n else None
+        quota_in = None
+        if use_q:
+            runtime = quota_runtime(quota_state)
+            quota_in = (quota_state.min, runtime, quota_state.used,
+                        quota_state.np_used)
+
+        ns_spec = P(NODE_AXIS)
+        rep = P()
+        state_specs = NodeState(
+            alloc=ns_spec, used_req=ns_spec, usage=ns_spec,
+            prod_usage=ns_spec, est_extra=ns_spec, prod_base=ns_spec,
+            metric_fresh=ns_spec, schedulable=ns_spec,
+            numa_cap=ns_spec if use_n else None,
+            numa_free=ns_spec if use_n else None,
+        )
+        pods_specs = jax.tree.map(lambda _: rep, pods)
+        quota_specs = (rep, rep, rep, rep) if use_q else None
+
+        def body(state_l, pods_l, params_l, quota_l, npol_l):
+            numa_in = None
+            if use_n:
+                numa_in = (state_l.numa_cap, state_l.numa_free, npol_l)
+            new_state, assign, qused, qnp, consumed = _pallas_solve(
+                state_l, pods_l, params_l, wsum, nonlocal_interpret,
+                quota_l, numa_in, bool(config.numa_most_allocated),
+                n_shards=k, axis_name=NODE_AXIS,
+            )
+            if consumed is None:
+                consumed = jnp.zeros(assign.shape[0], bool)
+            return new_state, assign, qused, qnp, consumed[None, :]
+
+        body_sharded = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(state_specs, pods_specs,
+                      jax.tree.map(lambda _: rep, params),
+                      quota_specs, ns_spec if use_n else None),
+            out_specs=(state_specs, rep,
+                       rep if use_q else None,
+                       rep if use_q else None,
+                       P(NODE_AXIS, None)),
+            check_vma=False,
+        )
+
+        @functools.partial(jax.jit, static_argnames=())
+        def run(state, pods, params, quota_in, npol, quota_state,
+                gang_state):
+            new_state, assign, qused, qnp, consumed_k = body_sharded(
+                state, pods, params, quota_in, npol
+            )
+            # the node axis was padded GLOBALLY (then sharded), and each
+            # shard's width is already a 128-lane multiple, so the
+            # kernel's global packed lane IS the original node index —
+            # no remap needed, and tie-breaks match single-device
+            consumed = consumed_k.any(axis=0) if use_n else None
+            final_qstate = (
+                quota_state._replace(used=qused, np_used=qnp)
+                if use_q else None
+            )
+            result = _kernel_epilogue(
+                new_state, assign, consumed, final_qstate, pods,
+                gang_state, gang_state is not None, use_n,
+            )
+            return result
+
+        result = run(state, pods, params, quota_in, npol, quota_state,
+                     gang_state)
+        # strip node padding back off
+        trim = lambda a: None if a is None else a[:n]
+        return result._replace(
+            node_state=NodeState(*(trim(x) for x in result.node_state))
+        )
+
+    return solve
+
+
 def shard_full_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
     """Jitted FULL solve (quota admission, gang resolution, NUMA) with
     the node axis sharded — the multi-chip counterpart of
@@ -121,11 +265,13 @@ def shard_full_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
     ns = node_sharding(mesh)
     rep = replicated(mesh)
     jit_full = jax.jit(
-        lambda s, p, pr, q, g, n: solve_batch(s, p, pr, config, q, g, numa=n)
+        lambda s, p, pr, q, g, x, r, n: solve_batch(
+            s, p, pr, config, q, g, extras=x, resv=r, numa=n
+        )
     )
 
     def solve(state, pods, params, quota_state=None, gang_state=None,
-              numa_aux=None):
+              numa_aux=None, extras=None, resv=None):
         state = shard_node_state(state, mesh)
         pods = jax.device_put(pods, rep)
         params = jax.device_put(params, rep)
@@ -133,10 +279,18 @@ def shard_full_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
             quota_state = jax.device_put(quota_state, rep)
         if gang_state is not None:
             gang_state = jax.device_put(gang_state, rep)
+        if extras is not None:
+            extras = jax.device_put(extras, rep)
+        if resv is not None:
+            # reservation tables are tiny [V,R]; replicate them and let
+            # GSPMD gather/scatter the per-node credit against the
+            # sharded used_req
+            resv = jax.device_put(resv, rep)
         if numa_aux is not None:
             numa_aux = NumaAux(
                 node_policy=jax.device_put(numa_aux.node_policy, ns)
             )
-        return jit_full(state, pods, params, quota_state, gang_state, numa_aux)
+        return jit_full(state, pods, params, quota_state, gang_state,
+                        extras, resv, numa_aux)
 
     return solve

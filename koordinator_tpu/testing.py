@@ -1,0 +1,145 @@
+"""Shared synthetic-problem builders and identity assertions.
+
+Used by the test suite, ``bench.py``, and the driver's
+``dryrun_multichip`` evidence run — library code, so the multichip
+artifact does not depend on the tests/ tree being shipped.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def example_problem(n_nodes, n_pods, seed=0):
+    """The standard random placement problem: (NodeState, PodBatch,
+    ScoreParams) with mixed node sizes, 0-50% ambient usage, and
+    cpu+memory thresholds — the flagship bench/test workload shape."""
+    import jax.numpy as jnp
+
+    from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+    from koordinator_tpu.ops.binpack import NodeState, PodBatch, ScoreParams
+
+    rng = np.random.default_rng(seed)
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), dtype=np.int32)
+    alloc[:, ResourceName.CPU] = rng.choice([16000, 32000, 64000], n_nodes)
+    alloc[:, ResourceName.MEMORY] = rng.choice([32768, 65536], n_nodes)
+    usage = (alloc * rng.uniform(0, 0.5, alloc.shape)).astype(np.int32)
+    state = NodeState(
+        alloc=jnp.asarray(alloc),
+        used_req=jnp.zeros_like(jnp.asarray(alloc)),
+        usage=jnp.asarray(usage),
+        prod_usage=jnp.asarray(usage // 2),
+        est_extra=jnp.zeros_like(jnp.asarray(alloc)),
+        prod_base=jnp.asarray(usage // 2),
+        metric_fresh=jnp.ones(n_nodes, bool),
+        schedulable=jnp.ones(n_nodes, bool),
+    )
+    req = np.zeros((n_pods, NUM_RESOURCES), dtype=np.int32)
+    req[:, ResourceName.CPU] = rng.choice([500, 1000, 2000], n_pods)
+    req[:, ResourceName.MEMORY] = rng.choice([1024, 2048], n_pods)
+    est = (req * 85) // 100
+    pods = PodBatch.build(
+        req=jnp.asarray(req),
+        est=jnp.asarray(est),
+        is_prod=jnp.asarray(rng.uniform(size=n_pods) < 0.5),
+        is_daemonset=jnp.zeros(n_pods, bool),
+    )
+    weights = np.zeros(NUM_RESOURCES, dtype=np.int32)
+    weights[ResourceName.CPU] = 1
+    weights[ResourceName.MEMORY] = 1
+    thresholds = np.zeros(NUM_RESOURCES, dtype=np.int32)
+    thresholds[ResourceName.CPU] = 65
+    thresholds[ResourceName.MEMORY] = 95
+    params = ScoreParams(
+        weights=jnp.asarray(weights),
+        thresholds=jnp.asarray(thresholds),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+    return state, pods, params
+
+
+def full_feature_problem(n_nodes, n_pods, n_quota, n_gangs, n_resv, seed):
+    """Quota + gang + NUMA + reservation inputs at the given shape
+    (shared by the sharded-identity tests and the driver dryrun)."""
+    import jax.numpy as jnp
+
+    from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+    from koordinator_tpu.ops.binpack import NumaAux, ResvArrays
+    from koordinator_tpu.ops.gang import GangState
+    from koordinator_tpu.ops.quota import QuotaState
+
+    state, pods, params = example_problem(n_nodes, n_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    cap = np.asarray(state.alloc)
+    free = (cap * rng.uniform(0.3, 1.0, cap.shape)).astype(np.int32)
+    state = state._replace(numa_cap=jnp.asarray(cap),
+                           numa_free=jnp.asarray(free))
+    gang_id = np.full(n_pods, -1, np.int32)
+    gang_id[: n_gangs * 8] = np.repeat(np.arange(n_gangs, dtype=np.int32), 8)
+    pods = pods._replace(
+        quota_id=jnp.asarray(rng.integers(0, n_quota, n_pods).astype(np.int32)),
+        gang_id=jnp.asarray(gang_id),
+        has_numa_policy=jnp.asarray(rng.uniform(size=n_pods) < 0.4),
+        non_preemptible=jnp.asarray(rng.uniform(size=n_pods) < 0.3),
+    )
+    total = cap.astype(np.int64).sum(axis=0)
+    mn = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mx = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mn[:, ResourceName.CPU] = total[ResourceName.CPU] // (2 * n_quota)
+    mn[:, ResourceName.MEMORY] = total[ResourceName.MEMORY] // (2 * n_quota)
+    mx[:, ResourceName.CPU] = total[ResourceName.CPU] // 8
+    mx[:, ResourceName.MEMORY] = total[ResourceName.MEMORY] // 8
+    qid = np.asarray(pods.quota_id)
+    req_np = np.asarray(pods.req).astype(np.int64)
+    child_request = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    np.add.at(child_request, qid, req_np)
+    quota_state = QuotaState.build(
+        min=mn, max=mx, weight=mx, allow_lent=np.ones(n_quota, bool),
+        total=total, child_request=child_request,
+    )
+    gang_state = GangState.build(min_member=[8] * n_gangs)
+    numa_aux = NumaAux(
+        node_policy=jnp.asarray(rng.uniform(size=n_nodes) < 0.5)
+    )
+    node_of = rng.integers(0, n_nodes, n_resv).astype(np.int32)
+    rfree = np.zeros((n_resv, NUM_RESOURCES), np.int32)
+    rfree[:, ResourceName.CPU] = rng.integers(500, 4000, n_resv)
+    rfree[:, ResourceName.MEMORY] = rng.integers(500, 4000, n_resv)
+    match = np.zeros((n_pods, n_resv), bool)
+    for v in range(n_resv):
+        lo = (v * 16) % max(n_pods - 16, 1)
+        match[lo:lo + 16, v] = True
+    resv = ResvArrays(
+        node=jnp.asarray(node_of), free=jnp.asarray(rfree),
+        allocate_once=jnp.asarray(rng.uniform(size=n_resv) < 0.5),
+        match=jnp.asarray(match),
+    )
+    return state, pods, params, quota_state, gang_state, numa_aux, resv
+
+
+def assert_full_identity(sharded, single, n_devices=8):
+    """Bit-identity of a sharded full-feature SolveResult against the
+    single-device one, across every mutated carry."""
+    np.testing.assert_array_equal(
+        np.asarray(sharded.assign), np.asarray(single.assign)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.commit), np.asarray(single.commit)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_state.used_req),
+        np.asarray(single.node_state.used_req),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_state.numa_free),
+        np.asarray(single.node_state.numa_free),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.quota_state.used),
+        np.asarray(single.quota_state.used),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.resv_free), np.asarray(single.resv_free)
+    )
+    assert len(sharded.node_state.used_req.devices()) == n_devices
+    assert int(np.asarray(sharded.commit).sum()) > 0

@@ -182,7 +182,7 @@ def test_five_components_converge(tmp_path):
              "default/crunch": (400, 1024)}
 
     migrated = []
-    web1_home = None
+    web1_home = crunch_home = None
     for i in range(8):
         t = 100.0 + 40.0 * i
         for sim in sims.values():
@@ -191,6 +191,8 @@ def test_five_components_converge(tmp_path):
         scheduler.schedule_pending(now=t + 2)
         if web1_home is None:
             web1_home = bus.get(Kind.POD, "default/web1").node_name
+        if crunch_home is None:
+            crunch_home = bus.get(Kind.POD, "default/crunch").node_name
         if i >= 2:  # metrics warmed: let the descheduler act
             migrated += desch_loop.run_once(now=t + 3)
         if migrated:
@@ -209,11 +211,17 @@ def test_five_components_converge(tmp_path):
     crunch_node = bus.get(Kind.NODE, pods["crunch"].node_name)
     assert crunch_node.allocatable.get(R.BATCH_CPU, 0) >= 2000
 
-    # 3. the descheduler migrated web1 off its hot node through a
-    #    reservation on the other node
-    assert "default/web1" in migrated
+    # 3. the descheduler drained the hot node through a reservation-first
+    #    migration. Victim order follows the reference PodSorter chain:
+    #    the BE/batch pod evicts BEFORE the heavier LS pod (lower
+    #    priority band wins over higher usage), and removing it already
+    #    brings the node back under the high threshold — so crunch
+    #    moves, web1 (prod, LS) stays put.
+    assert "default/crunch" in migrated
+    assert "default/web1" not in migrated
     assert len(bus.list(Kind.MIGRATION_JOB)) >= 1
-    assert pods["web1"].node_name != web1_home  # actually moved
+    assert pods["crunch"].node_name != crunch_home  # actually moved
+    assert pods["web1"].node_name == web1_home      # prod pod protected
 
     # 4. koordlet actuated QoS through the NRI path: bvt landed for the
     #    LS pods, cfs quota for the BE pod, on the RIGHT node's cgroupfs

@@ -185,6 +185,31 @@ def test_deviation_mode_identity(seed):
     run_both(args, snapshot)
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_deviation_mode_asymmetric_thresholds_identity(seed):
+    """Asymmetric deviation configs hit the reference's
+    getNodeThresholds:100-102 quirk (the capacity special case keys
+    BOTH sides off the LOW percent): low-only means 'above pool average
+    is overutilized', high-only is inert. Plugin and oracle must agree
+    on both."""
+    rng = np.random.default_rng(300 + seed)
+    snapshot = random_cluster(rng, stale_frac=0.0)
+    low_only = LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={CPU: 15},
+        high_thresholds={},
+        use_deviation_thresholds=True,
+    )])
+    run_both(low_only, snapshot)
+    # high-only: the quirk resolves BOTH sides to full capacity (the
+    # explicit high percent is ignored; only usage > capacity triggers)
+    high_only = LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={},
+        high_thresholds={CPU: 10, MEM: 10},
+        use_deviation_thresholds=True,
+    )])
+    run_both(high_only, snapshot)
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_multi_sweep_debounce_identity(seed):
     """consecutive_abnormalities=2: eviction needs a streak; detector

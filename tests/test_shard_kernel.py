@@ -1,0 +1,166 @@
+"""Sharded pallas kernel == single-device solver, bit-for-bit.
+
+VERDICT r4 #3: the multi-chip path previously lowered to the
+HBM-streaming scan; the pallas kernel now composes under
+``jax.shard_map`` — per-shard VMEM carry, per-pod cross-shard winner
+merge over in-kernel remote DMAs (``parallel.mesh.shard_kernel_solver``,
+``ops/pallas_binpack._make_kernel`` n_shards > 1). On the 8-device
+virtual CPU mesh the kernels run under the TPU interpreter with
+emulated remote DMAs — same program, same synchronization.
+
+Identity bar: assignments AND every mutated carry equal the
+single-device ``solve_batch`` exactly, cross-shard argmax tie-breaks
+(smallest node index) included.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _example_problem
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.ops.binpack import NumaAux, SolverConfig, solve_batch
+from koordinator_tpu.parallel.mesh import make_mesh, shard_kernel_solver
+
+
+def _single(state, pods, params, *args, **kw):
+    return jax.jit(
+        lambda s, p, pr: solve_batch(s, p, pr, SolverConfig(), *args, **kw)
+    )(state, pods, params)
+
+
+def _assert_result_equal(sharded, single, quota=False, numa=False):
+    np.testing.assert_array_equal(
+        np.asarray(sharded.assign), np.asarray(single.assign)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.commit), np.asarray(single.commit)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_state.used_req),
+        np.asarray(single.node_state.used_req),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.node_state.est_extra),
+        np.asarray(single.node_state.est_extra),
+    )
+    if numa:
+        np.testing.assert_array_equal(
+            np.asarray(sharded.node_state.numa_free),
+            np.asarray(single.node_state.numa_free),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sharded.numa_consumed),
+            np.asarray(single.numa_consumed),
+        )
+    if quota:
+        np.testing.assert_array_equal(
+            np.asarray(sharded.quota_state.used),
+            np.asarray(single.quota_state.used),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sharded.quota_state.np_used),
+            np.asarray(single.quota_state.np_used),
+        )
+
+
+def test_two_device_plain_identity():
+    state, pods, params = _example_problem(256, 96, seed=3)
+    mesh = make_mesh(jax.devices()[:2])
+    res = shard_kernel_solver(mesh)(state, pods, params)
+    single = _single(state, pods, params)
+    _assert_result_equal(res, single)
+    assert int((np.asarray(res.assign) >= 0).sum()) > 0
+
+
+def test_eight_device_unpadded_node_count():
+    """327 nodes is not a multiple of 8 x 128: the global padding path
+    (unschedulable zero rows) must keep indices and tie-breaks exact."""
+    state, pods, params = _example_problem(327, 64, seed=7)
+    mesh = make_mesh(jax.devices()[:8])
+    res = shard_kernel_solver(mesh)(state, pods, params)
+    single = _single(state, pods, params)
+    _assert_result_equal(res, single)
+
+
+def test_eight_device_full_features_identity():
+    """Quota + strict gangs + NUMA through the sharded kernel: the
+    replicated quota replay, local NUMA consumption with cross-shard
+    consumed-OR, and the gang release epilogue must all match the
+    single-device solve bit-for-bit."""
+    from koordinator_tpu.ops.gang import GangState
+    from koordinator_tpu.ops.quota import QuotaState
+
+    n_nodes, n_pods, n_quota, n_gangs = 1024, 256, 8, 8
+    state, pods, params = _example_problem(n_nodes, n_pods, seed=11)
+    rng = np.random.default_rng(11)
+    cap = np.asarray(state.alloc)
+    free = (cap * rng.uniform(0.3, 1.0, cap.shape)).astype(np.int32)
+    state = state._replace(
+        numa_cap=jnp.asarray(cap), numa_free=jnp.asarray(free)
+    )
+    gang_id = np.full(n_pods, -1, np.int32)
+    gang_id[: n_gangs * 8] = np.repeat(
+        np.arange(n_gangs, dtype=np.int32), 8
+    )
+    pods = pods._replace(
+        quota_id=jnp.asarray(
+            rng.integers(0, n_quota, n_pods).astype(np.int32)
+        ),
+        gang_id=jnp.asarray(gang_id),
+        has_numa_policy=jnp.asarray(rng.uniform(size=n_pods) < 0.4),
+        non_preemptible=jnp.asarray(rng.uniform(size=n_pods) < 0.3),
+    )
+    total = cap.astype(np.int64).sum(axis=0)
+    mn = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    mx = np.zeros_like(mn)
+    mn[:, ResourceName.CPU] = total[ResourceName.CPU] // (2 * n_quota)
+    mn[:, ResourceName.MEMORY] = total[ResourceName.MEMORY] // (2 * n_quota)
+    mx[:, ResourceName.CPU] = total[ResourceName.CPU] // 6
+    mx[:, ResourceName.MEMORY] = total[ResourceName.MEMORY] // 6
+    qid = np.asarray(pods.quota_id)
+    child = np.zeros((n_quota, NUM_RESOURCES), np.int64)
+    np.add.at(child, qid, np.asarray(pods.req).astype(np.int64))
+    qstate = QuotaState.build(
+        min=mn, max=mx, weight=mx, allow_lent=np.ones(n_quota, bool),
+        total=total, child_request=child,
+    )
+    gstate = GangState.build(min_member=[8] * n_gangs)
+    aux = NumaAux(node_policy=jnp.asarray(rng.uniform(size=n_nodes) < 0.5))
+
+    single = jax.jit(
+        lambda s, p, pr, q, g, n_: solve_batch(
+            s, p, pr, SolverConfig(), q, g, numa=n_
+        )
+    )(state, pods, params, qstate, gstate, aux)
+    mesh = make_mesh(jax.devices()[:8])
+    sharded = shard_kernel_solver(mesh)(
+        state, pods, params, qstate, gstate, aux
+    )
+    _assert_result_equal(sharded, single, quota=True, numa=True)
+    assert int(np.asarray(sharded.numa_consumed).sum()) > 0
+
+
+@pytest.mark.skipif(
+    os.environ.get("KTPU_SLOW", "1") == "0",
+    reason="interpret-mode remote DMA emulation at 5k nodes is slow",
+)
+def test_eight_device_5k_nodes_identity():
+    """The VERDICT bar: sharded-kernel == single-device at >= 5k nodes
+    on the 8-device virtual mesh (interpret-mode remote DMAs)."""
+    state, pods, params = _example_problem(5120, 256, seed=5)
+    mesh = make_mesh(jax.devices()[:8])
+    t0 = time.time()
+    res = shard_kernel_solver(mesh)(state, pods, params)
+    np.asarray(res.assign)
+    wall = time.time() - t0
+    single = _single(state, pods, params)
+    _assert_result_equal(res, single)
+    assert int((np.asarray(res.assign) >= 0).sum()) > 0
+    # emulated wall time recorded for visibility, not asserted
+    print(f"5120-node 8-device interpret solve: {wall:.1f}s")
