@@ -49,6 +49,19 @@ def transform_node(node):
     return dataclasses.replace(node, allocatable=alloc)
 
 
+def _updater(update_fn, delete_fn):
+    """Bus watch adapter: DELETED events dispatch by name, everything
+    else by object."""
+
+    def on_event(event, name, obj):
+        if event is EventType.DELETED:
+            delete_fn(name)
+        else:
+            update_fn(obj)
+
+    return on_event
+
+
 def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
     """Subscribe a Scheduler to every kind it consumes (the reference's
     informer factory in cmd/koord-scheduler/app/server.go + frameworkext
@@ -78,14 +91,7 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
             # re-running quota/gang registration for status-only changes
             scheduler.update_pod(pod)
 
-    def updater(update_fn, delete_fn):
-        def on_event(event, name, obj):
-            if event is EventType.DELETED:
-                delete_fn(name)
-            else:
-                update_fn(obj)
-
-        return on_event
+    updater = _updater
 
     bus.watch(Kind.NODE, on_node)
     bus.watch(Kind.POD, on_pod)
@@ -151,6 +157,21 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
             do()
 
     scheduler.evict_pod_fn = _evict
+
+
+def wire_pod_webhook(bus: APIServer, webhook) -> None:
+    """Feed the pod mutating webhook's quota-tree registries from the
+    bus (ElasticQuota + ElasticQuotaProfile watches) so admission can
+    inject multi-quota-tree node affinity
+    (multi_quota_tree_affinity.go's Client reads, informer-fed here)."""
+
+    bus.watch(
+        Kind.QUOTA, _updater(webhook.update_quota, webhook.remove_quota)
+    )
+    bus.watch(
+        Kind.QUOTA_PROFILE,
+        _updater(webhook.update_quota_profile, webhook.remove_quota_profile),
+    )
 
 
 def snapshot_from_bus(bus: APIServer, now: float, with_reservations=False):

@@ -43,8 +43,11 @@ class SchedulerConfig:
     solver_address: str = "/tmp/koord-solver.sock"
     solver_secret: Optional[bytes] = None
     #: plain solves with pods*nodes under this run on the host sequential
-    #: path — a device round trip costs more than the whole solve there
-    host_fallback_cells: int = 16384
+    #: path — a device round trip costs more than the whole solve there.
+    #: -1 = MEASURE at startup (models/placement.py
+    #: measure_host_fallback_cells: host per-cell cost vs device round
+    #: latency on THIS backend/link, ~1 s probe)
+    host_fallback_cells: int = -1
     #: scan unroll (ops/binpack.SolverConfig.unroll): 32 is the measured
     #: throughput optimum on v5e; the library default (8) favors compile
     #: time instead
@@ -85,18 +88,29 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
             score_pct=config.aggregated_score_pct,
             score_duration_seconds=config.aggregated_score_duration_seconds,
         )
+    solver_config = SolverConfig(
+        fit_weight=config.fit_weight,
+        loadaware_weight=config.loadaware_weight,
+        score_according_prod=config.score_according_prod,
+        unroll=config.solver_unroll,
+    )
+    if backend is not None or not gates.enabled("BatchedPlacement"):
+        # the sidecar routes everything remote; gated-off batched
+        # placement never consults the cutoff — don't pay the probe
+        fallback_cells = 0
+    elif config.host_fallback_cells < 0:
+        from koordinator_tpu.models.placement import (
+            measure_host_fallback_cells,
+        )
+
+        fallback_cells = measure_host_fallback_cells(solver_config)
+    else:
+        fallback_cells = config.host_fallback_cells
     model = PlacementModel(
-        config=SolverConfig(
-            fit_weight=config.fit_weight,
-            loadaware_weight=config.loadaware_weight,
-            score_according_prod=config.score_according_prod,
-            unroll=config.solver_unroll,
-        ),
+        config=solver_config,
         aggregated=aggregated,
         backend=backend,
-        host_fallback_cells=(
-            0 if backend is not None else config.host_fallback_cells
-        ),
+        host_fallback_cells=fallback_cells,
     )
     scheduler = Scheduler(
         model=model,
@@ -213,6 +227,14 @@ def main(argv=None) -> int:
              "port (reference: the secure-serving mux on every binary)",
     )
     args = parser.parse_args(argv)
+
+    # persistent XLA cache: a failed-over leader's in-process solver
+    # warms from disk instead of recompiling
+    from koordinator_tpu.utils.compilation_cache import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
     secret = None
     if args.solver_secret_file:
         with open(args.solver_secret_file, "rb") as f:

@@ -38,6 +38,14 @@ def main(argv=None) -> int:
                         help="start, report readiness, and exit (smoke)")
     args = parser.parse_args(argv)
 
+    # before the first jit: a restarted sidecar deserializes its
+    # compiled programs instead of recompiling (cold-start blackout)
+    from koordinator_tpu.utils.compilation_cache import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+
     from koordinator_tpu.service.server import PlacementService
 
     secret: Optional[bytes] = None

@@ -137,6 +137,15 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
               numa_aux=None):
         import jax.numpy as jnp
 
+        from koordinator_tpu.ops.pallas_binpack import pallas_supported
+
+        if not pallas_supported(params, config):
+            # same guard as the single-chip kernel dispatch: scoring
+            # modes the kernel does not implement must raise, not
+            # silently diverge
+            raise ValueError(
+                "configuration not supported by the pallas kernel"
+            )
         nonlocal_interpret = interpret
         if nonlocal_interpret is None:
             nonlocal_interpret = devices[0].platform != "tpu"

@@ -58,6 +58,68 @@ POD_FIELDS = (
 #: one jit cache for every connection (static config hashes per value)
 _jit_solve = jax.jit(solve_batch, static_argnames=("config",))
 
+#: AOT warm-start: compiled executables persisted across process
+#: restarts (utils/compilation_cache.ExecutableCache) — a respawned
+#: sidecar's first solve deserializes instead of re-tracing+compiling
+_loaded_execs: dict = {}
+
+
+def _exec_cache():
+    from koordinator_tpu.utils.compilation_cache import ExecutableCache
+
+    return ExecutableCache()
+
+
+def _program_key(config, *groups) -> str:
+    """Program identity: every leaf's (path, shape, dtype) + the static
+    config — the same key means the same compiled executable."""
+    parts = [repr(tuple(config))]
+    for group in groups:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(group)[0]:
+            parts.append(
+                f"{path}:{getattr(leaf, 'shape', ())}:"
+                f"{getattr(leaf, 'dtype', type(leaf).__name__)}"
+            )
+    return "|".join(parts)
+
+
+def _cached_solve(state, pods, params, config, quota, gang, extras, resv,
+                  numa):
+    if len(jax.devices()) != 1:
+        # AOT executables pin device placement; the sidecar's production
+        # shape is one chip per process — multi-device processes use the
+        # plain jit cache
+        return _jit_solve(state, pods, params, config, quota, gang,
+                          extras, resv, numa)
+    key = _program_key(
+        config, state, pods, params, quota, gang, extras, resv, numa
+    )
+    entry = _loaded_execs.get(key)
+    if entry is None:
+        jit_fn = jax.jit(
+            lambda s, p, pr, q, g, x, r, n: solve_batch(
+                s, p, pr, config, q, g, x, r, n
+            )
+        )
+        try:
+            fn = _exec_cache().get_or_compile(
+                key, jit_fn, state, pods, params, quota, gang, extras,
+                resv, numa,
+            )
+        except Exception:
+            fn = jit_fn  # AOT path is an optimization, never a gate
+        entry = _loaded_execs[key] = (fn, jit_fn)
+    fn, jit_fn = entry
+    try:
+        return fn(state, pods, params, quota, gang, extras, resv, numa)
+    except Exception:
+        # a stale/incompatible cached executable must not poison every
+        # solve for this shape: fall back to the jit path and memoize it
+        if fn is jit_fn:
+            raise
+        _loaded_execs[key] = (jit_fn, jit_fn)
+        return jit_fn(state, pods, params, quota, gang, extras, resv, numa)
+
 
 def _state_group(cls, group):
     """Reconstruct a NamedTuple-of-arrays feature state from its wire
@@ -106,7 +168,7 @@ def solve_from_request(req: SolveRequest,
         )
         if req.config is not None:
             config = _decode_config(req.config)
-        result = _jit_solve(
+        result = _cached_solve(
             state, pods, params, config,
             _state_group(QuotaState, req.quota),
             _state_group(GangState, req.gang),

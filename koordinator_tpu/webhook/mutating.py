@@ -61,9 +61,16 @@ class ClusterColocationProfile:
         return True
 
 
+#: node-selector value that matches no node label — the dict analogue of
+#: the reference's unsatisfiable merged NodeSelectorRequirements (two In
+#: requirements on one key with disjoint values)
+UNSATISFIABLE = "\x00conflict"
+
+
 class PodMutatingWebhook:
-    """Applies every matching profile, then the batch/mid resource
-    rewrite — the ingress every pod passes before reaching the scheduler."""
+    """Applies every matching profile, the batch/mid resource rewrite,
+    then multi-quota-tree affinity injection — the ingress every pod
+    passes before reaching the scheduler."""
 
     def __init__(self, profiles: Optional[List[ClusterColocationProfile]] = None):
         self.profiles: Dict[str, ClusterColocationProfile] = {
@@ -75,9 +82,28 @@ class PodMutatingWebhook:
         #: analysis.koordinator.sh consumption point; set by
         #: manager.recommendation.wire_recommendation)
         self.recommendation_for = None
+        #: quota name -> QuotaSpec and quota-profile registries for the
+        #: multi-quota-tree affinity mutator
+        #: (multi_quota_tree_affinity.go:37-113)
+        self.quota_specs: Dict[str, object] = {}
+        self.quota_profiles: Dict[str, object] = {}
 
     def update_profile(self, profile: ClusterColocationProfile) -> None:
         self.profiles[profile.name] = profile
+
+    # -- quota-tree registries (bus-fed) ------------------------------------
+
+    def update_quota(self, spec) -> None:
+        self.quota_specs[spec.name] = spec
+
+    def remove_quota(self, name: str) -> None:
+        self.quota_specs.pop(name, None)
+
+    def update_quota_profile(self, profile) -> None:
+        self.quota_profiles[profile.name] = profile
+
+    def remove_quota_profile(self, name: str) -> None:
+        self.quota_profiles.pop(name, None)
 
     def remove_profile(self, name: str) -> None:
         self.profiles.pop(name, None)
@@ -102,7 +128,44 @@ class PodMutatingWebhook:
                 matched = True
         if matched:
             self._mutate_resource_spec(pod)
+        self._apply_tree_affinity(pod)
         return pod
+
+    def _apply_tree_affinity(self, pod: PodSpec) -> None:
+        """Multi-quota-tree node affinity (reference:
+        pkg/webhook/pod/mutating/multi_quota_tree_affinity.go:37-113):
+        when the pod's ElasticQuota belongs to a quota tree whose
+        profile carries a node selector, inject that selector as
+        REQUIRED node affinity, so tree pods stay on tree nodes even
+        when other nodes score higher. The reference appends In
+        requirements to every existing term (AND); in the dict selector
+        model that is a key-wise merge, with a conflicting value
+        resolving to an unsatisfiable sentinel — exactly as conflicting
+        required In terms match no node."""
+        quota_name = pod.quota or pod.namespace
+        spec = self.quota_specs.get(quota_name)
+        if spec is None:
+            return
+        tree_id = getattr(spec, "tree_id", "")
+        if not tree_id:
+            return
+        selector = None
+        for name in sorted(self.quota_profiles):
+            profile = self.quota_profiles[name]
+            if profile.effective_tree_id() == tree_id:
+                selector = profile.node_selector
+                break
+        if not selector:
+            return
+        if pod.node_selector is None:
+            pod.node_selector = dict(selector)
+            return
+        for key, value in selector.items():
+            mine = pod.node_selector.get(key)
+            if mine is not None and mine != value:
+                pod.node_selector[key] = UNSATISFIABLE
+            else:
+                pod.node_selector[key] = value
 
     def _apply_recommendation(self, pod: PodSpec) -> None:
         """Right-size native requests from a covering Recommendation

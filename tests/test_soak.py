@@ -105,6 +105,7 @@ def test_churn_soak_with_leader_and_sidecar_failover(tmp_path):
     live = []                 # uids in arrival order
     leader_killed = False
     solver_outage_rounds = 0
+    failover_blackout_s = None
 
     for i in range(ROUNDS):
         t = 100.0 + 30.0 * i
@@ -151,7 +152,18 @@ def test_churn_soak_with_leader_and_sidecar_failover(tmp_path):
             solver_outage_rounds -= 1  # round skipped (retry next tick)
             out_b = None
         else:
+            import time as _time
+
+            probe = leader_killed and failover_blackout_s is None
+            t0 = _time.monotonic()
             out_b = elected_round(eb, sched_b, t + 2.5)
+            if probe and out_b is not None:
+                # the failover blackout: wall time of the new leader's
+                # FIRST completed scheduling round after the old leader
+                # died (solver warm-up included — the persistent
+                # compilation cache is what keeps this bounded across
+                # real process restarts, tests/test_compilation_cache.py)
+                failover_blackout_s = _time.monotonic() - t0
 
         # exactly one scheduler acted
         assert out_a is None or out_b is None
@@ -200,6 +212,13 @@ def test_churn_soak_with_leader_and_sidecar_failover(tmp_path):
 
     # -- post-soak: the failover actually happened and was fenced --------
     assert leader_killed
+    # the new leader's first round completed within a bounded blackout
+    # (warm-path bound; the cross-process cold path is bounded by the
+    # persistent compilation cache, tests/test_compilation_cache.py)
+    assert failover_blackout_s is not None
+    assert failover_blackout_s < 10.0, (
+        f"failover solver blackout {failover_blackout_s:.1f}s"
+    )
     with pytest.raises(FencingError):
         ea.fenced(lambda: None)
     placed = [u for u, p in bus.list(Kind.POD).items()
@@ -223,3 +242,163 @@ def test_churn_soak_with_leader_and_sidecar_failover(tmp_path):
             elif pod.qos is QoSClass.BE:
                 assert CPU_BVT_WARP_NS.read(
                     f"kubepods/besteffort/{uid_dir}", sim.cfg) == "-1"
+
+
+def test_scaled_soak_trees_reservations_migrations():
+    """VERDICT r4 #8: the soak at fleet scale — 56 nodes in two
+    quota-tree pools, reservations and migration jobs active in the
+    loop, the same placement/fit/quota invariants every round PLUS
+    quota-tree isolation (admission-injected tree affinity keeps every
+    tree pod on its pool even while the descheduler drains hot nodes
+    through reservation-first migrations)."""
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.client.wiring import wire_descheduler, wire_pod_webhook
+    from koordinator_tpu.descheduler import (
+        Descheduler,
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        MigrationEvictor,
+        NodePool,
+        Profile,
+    )
+    from koordinator_tpu.quota.profile import QuotaProfile
+
+    N_PER_POOL = 28
+    ROUNDS_SCALED = 120
+    bus = APIServer()
+    manager = build_manager(ManagerConfig())
+    wire_pod_webhook(bus, manager.mutating_webhook)
+    scheduler = Scheduler()
+    wire_scheduler(bus, scheduler)
+    desch_loop = wire_descheduler(bus, Descheduler(
+        profiles=[Profile(name="lnl", balance_plugins=[LowNodeLoad(
+            LowNodeLoadArgs(node_pools=[NodePool(
+                low_thresholds={R.CPU: 30}, high_thresholds={R.CPU: 70},
+            )])
+        )])],
+        evictor=MigrationEvictor(),
+    ))
+
+    # two quota trees, one node pool each
+    for pool in ("a", "b"):
+        bus.apply(Kind.QUOTA_PROFILE, f"pool-{pool}", QuotaProfile(
+            name=f"pool-{pool}", quota_name=f"root-{pool}",
+            tree_id=f"tree-{pool}", node_selector={"pool": pool},
+        ))
+        bus.apply(Kind.QUOTA, f"team-{pool}", QuotaSpec(
+            name=f"team-{pool}", tree_id=f"tree-{pool}",
+            min={R.CPU: 20000, R.MEMORY: 40960},
+            max={R.CPU: 300000, R.MEMORY: 600000},
+        ))
+        for i in range(N_PER_POOL):
+            name = f"{pool}{i}"
+            bus.apply(Kind.NODE, name, NodeSpec(
+                name=name, labels={"pool": pool},
+                allocatable={R.CPU: NODE_CPU, R.MEMORY: NODE_MEM},
+            ))
+
+    rng = np.random.default_rng(77)
+    placements = {}
+    migrated_uids = set()
+    live = []
+    next_pod = 0
+    jobs_seen = 0
+    resv_seen = 0
+
+    def publish_metrics(now):
+        """Synthesized NodeMetric per node: usage tracks assigned
+        requests; a rotating hot set reports extra load to trigger the
+        rebalancer."""
+        by_node = {}
+        for pod in bus.list(Kind.POD).values():
+            if pod.node_name is not None:
+                by_node.setdefault(pod.node_name, []).append(pod)
+        for name in list(bus.list(Kind.NODE)):
+            on_node = by_node.get(name, [])
+            cpu = sum(p.requests.get(R.CPU, 0) for p in on_node)
+            hot = name in hot_nodes
+            metric = NodeMetric(
+                node_name=name,
+                node_usage={
+                    R.CPU: min(cpu + (12000 if hot else 500), NODE_CPU),
+                    R.MEMORY: 2048,
+                },
+                pod_usages={
+                    p.uid: {R.CPU: p.requests.get(R.CPU, 0),
+                            R.MEMORY: p.requests.get(R.MEMORY, 0)}
+                    for p in on_node
+                },
+                update_time=now,
+            )
+            bus.apply(Kind.NODE_METRIC, name, metric)
+
+    for i in range(ROUNDS_SCALED):
+        t = 100.0 + 30.0 * i
+        hot_nodes = {f"a{(i // 10) % N_PER_POOL}", f"b{(i // 7) % N_PER_POOL}"}
+
+        # churn: two arrivals a round, a deletion every 3rd
+        for _ in range(2):
+            pod = _mk_pod(next_pod, rng)
+            next_pod += 1
+            admitted, violations = manager.admit_pod(pod)
+            assert not violations
+            # admission injected the tree selector for the pod's quota
+            assert admitted.node_selector == {
+                "pool": "a" if admitted.quota == "team-a" else "b"
+            }
+            bus.apply(Kind.POD, admitted.uid, admitted)
+            live.append(admitted.uid)
+        if i % 3 == 2 and len(live) > 12:
+            victim = live.pop(int(rng.integers(0, len(live) - 8)))
+            bus.delete(Kind.POD, victim)
+            placements.pop(victim, None)
+
+        publish_metrics(t)
+        scheduler.schedule_pending(now=t + 1)
+        if i >= 10 and i % 5 == 0:
+            migrated_uids.update(desch_loop.run_once(now=t + 2))
+            scheduler.schedule_pending(now=t + 3)  # re-place migrants
+        jobs_seen = max(jobs_seen, len(bus.list(Kind.MIGRATION_JOB)))
+        resv_seen = max(resv_seen, len(bus.list(Kind.RESERVATION)))
+
+        # -- invariants, every round ------------------------------------
+        pods_on_bus = bus.list(Kind.POD)
+        per_node_cpu = {}
+        for uid, pod in pods_on_bus.items():
+            if pod.node_name is None:
+                continue
+            prev = placements.get(uid)
+            if prev is not None and prev != pod.node_name:
+                # a placement may only change through a migration
+                assert uid in migrated_uids, (
+                    f"round {i}: {uid} moved {prev} -> {pod.node_name} "
+                    "without a migration job"
+                )
+            placements[uid] = pod.node_name
+            per_node_cpu[pod.node_name] = (
+                per_node_cpu.get(pod.node_name, 0)
+                + pod.requests.get(R.CPU, 0)
+            )
+            # quota-tree isolation: tree pods stay on tree nodes
+            want_pool = "a" if pod.quota == "team-a" else "b"
+            assert pod.node_name.startswith(want_pool), (
+                f"round {i}: {uid} (quota {pod.quota}) escaped to "
+                f"{pod.node_name}"
+            )
+        for name, used in per_node_cpu.items():
+            node = bus.get(Kind.NODE, name)
+            assert used <= node.allocatable[R.CPU]
+        for qname in ("team-a", "team-b"):
+            want = _quota_used_by_pods(bus, qname)
+            info = scheduler.quota_manager.quotas.get(qname)
+            if info is not None:
+                got = np.asarray(info.used, dtype=np.int64)
+                assert got[R.CPU] == want[0] and got[R.MEMORY] == want[1]
+
+    # the loop genuinely exercised the machinery at scale
+    placed = [u for u, p in bus.list(Kind.POD).items()
+              if p.node_name is not None]
+    assert len(placed) > 50
+    assert jobs_seen >= 1, "no migration job was ever created"
+    assert resv_seen >= 1, "no reservation was ever created"
+    assert migrated_uids, "no pod was actually migrated"

@@ -137,6 +137,119 @@ class TestMutating:
         assert out2["batch-ns/job2"] is None
 
 
+class TestMultiQuotaTreeAffinity:
+    """multi_quota_tree_affinity.go:37-113: a pod whose ElasticQuota
+    belongs to a quota tree with a node-selector profile gets that
+    selector injected as REQUIRED node affinity at admission."""
+
+    def _webhook(self):
+        from koordinator_tpu.quota.profile import QuotaProfile
+
+        wh = PodMutatingWebhook()
+        wh.update_quota(QuotaSpec(
+            name="team-a", tree_id="tree-1",
+            min={R.CPU: 8000}, max={R.CPU: 16000},
+        ))
+        wh.update_quota(QuotaSpec(
+            name="team-free", min={R.CPU: 8000}, max={R.CPU: 16000},
+        ))
+        wh.update_quota_profile(QuotaProfile(
+            name="pool-a", quota_name="root-a", tree_id="tree-1",
+            node_selector={"pool": "a"},
+        ))
+        return wh
+
+    def test_tree_quota_pod_gains_selector(self):
+        wh = self._webhook()
+        pod = wh.mutate(PodSpec(name="p", quota="team-a"))
+        assert pod.node_selector == {"pool": "a"}
+
+    def test_treeless_quota_untouched(self):
+        wh = self._webhook()
+        pod = wh.mutate(PodSpec(name="p", quota="team-free"))
+        assert pod.node_selector is None
+
+    def test_unknown_quota_untouched(self):
+        wh = self._webhook()
+        pod = wh.mutate(PodSpec(name="p", quota="nope"))
+        assert pod.node_selector is None
+
+    def test_existing_selector_merges_and_conflicts_unsatisfiable(self):
+        from koordinator_tpu.webhook.mutating import UNSATISFIABLE
+
+        wh = self._webhook()
+        pod = wh.mutate(PodSpec(
+            name="p", quota="team-a", node_selector={"zone": "z1"},
+        ))
+        assert pod.node_selector == {"zone": "z1", "pool": "a"}
+        # a conflicting required value can match no node (the reference
+        # merges In requirements into every term: AND of disjoint Ins)
+        pod2 = wh.mutate(PodSpec(
+            name="p2", quota="team-a", node_selector={"pool": "b"},
+        ))
+        assert pod2.node_selector["pool"] == UNSATISFIABLE
+
+    def test_tree_pod_lands_only_on_tree_nodes(self):
+        """The done-criterion differential: the tree pod takes the tree
+        node even though the off-tree node is emptier and scores
+        higher; without the webhook it would land off-tree."""
+        from koordinator_tpu.scheduler import Scheduler
+
+        def cluster():
+            s = Scheduler()
+            # off-tree node: empty, scores higher
+            s.add_node(NodeSpec(name="big-free",
+                                allocatable={R.CPU: 64000, R.MEMORY: 65536}))
+            # tree node: smaller and busier
+            s.add_node(NodeSpec(name="tree-node", labels={"pool": "a"},
+                                allocatable={R.CPU: 16000, R.MEMORY: 16384}))
+            for n in ("big-free", "tree-node"):
+                s.update_node_metric(NodeMetric(
+                    node_name=n, node_usage={}, update_time=99.0))
+            s.update_quota(QuotaSpec(
+                name="team-a", tree_id="tree-1",
+                min={R.CPU: 8000, R.MEMORY: 8192},
+                max={R.CPU: 16000, R.MEMORY: 16384},
+            ))
+            return s
+
+        def pod():
+            return PodSpec(name="p", quota="team-a",
+                           requests={R.CPU: 1000, R.MEMORY: 1024})
+
+        s = cluster()
+        s.add_pod(pod())  # no webhook: scores win
+        assert s.schedule_pending(now=100.0)["default/p"] == "big-free"
+
+        s = cluster()
+        s.add_pod(self._webhook().mutate(pod()))  # admission: tree wins
+        assert s.schedule_pending(now=100.0)["default/p"] == "tree-node"
+
+    def test_wired_through_bus(self):
+        """The registries fill from ElasticQuota/ElasticQuotaProfile
+        watches (wire_pod_webhook), including deletes."""
+        from koordinator_tpu.client.bus import APIServer, Kind
+        from koordinator_tpu.client.wiring import wire_pod_webhook
+        from koordinator_tpu.quota.profile import QuotaProfile
+
+        bus = APIServer()
+        wh = PodMutatingWebhook()
+        wire_pod_webhook(bus, wh)
+        bus.apply(Kind.QUOTA, "team-a", QuotaSpec(
+            name="team-a", tree_id="tree-1",
+            min={R.CPU: 1000}, max={R.CPU: 2000},
+        ))
+        bus.apply(Kind.QUOTA_PROFILE, "pool-a", QuotaProfile(
+            name="pool-a", quota_name="root-a", tree_id="tree-1",
+            node_selector={"pool": "a"},
+        ))
+        pod = wh.mutate(PodSpec(name="p", quota="team-a"))
+        assert pod.node_selector == {"pool": "a"}
+        bus.delete(Kind.QUOTA_PROFILE, "pool-a")
+        pod2 = wh.mutate(PodSpec(name="p2", quota="team-a"))
+        assert pod2.node_selector is None
+
+
 class TestValidating:
     def test_batch_resources_require_be(self):
         v = PodValidatingWebhook()
