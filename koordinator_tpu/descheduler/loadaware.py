@@ -25,7 +25,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, List, Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
@@ -141,15 +140,10 @@ class LowNodeLoad(BalancePlugin):
             use_deviation=pool.use_deviation_thresholds,
         )
         verdict = classify_nodes(
-            jnp.asarray(usage),
-            jnp.asarray(low_q),
-            jnp.asarray(high_q),
-            jnp.asarray(res_mask),
-            jnp.asarray(fresh),
-            jnp.asarray(schedulable),
+            usage, low_q, high_q, res_mask, fresh, schedulable
         )
-        low = np.asarray(verdict.low)
-        high = np.asarray(verdict.high)
+        low = verdict.low
+        high = verdict.high
 
         source_idx = [i for i in np.flatnonzero(high)]
         for i in source_idx:

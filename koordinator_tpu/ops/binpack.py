@@ -64,6 +64,12 @@ class SolverConfig(NamedTuple):
     #: time (2.2s -> 7.3s CPU), which dominates tests and cold starts;
     #: production (cmd/scheduler) and the bench scan legs set 32.
     unroll: int = 8
+    #: pallas kernel inner-loop unroll (per-pod fori_loop). Mosaic only
+    #: lowers unroll=1 or full (=128); measured r5 on one v5e at
+    #: 10k x 5k: full unroll is NO faster (88.9 ms vs 85.0 ms) and
+    #: costs 55 s compile — the kernel is not loop-overhead-bound.
+    #: Kept as a knob for future shapes; leave at 1.
+    kernel_unroll: int = 1
 
 
 class NodeState(NamedTuple):

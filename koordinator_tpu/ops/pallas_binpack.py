@@ -71,7 +71,7 @@ CHUNK = 128
 
 def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
                  most_allocated: bool = False, n_shards: int = 1,
-                 axis_name: Optional[str] = None):
+                 axis_name: Optional[str] = None, kernel_unroll: int = 1):
     """``n_shards > 1`` builds the DISTRIBUTED kernel (VERDICT r4 #3):
     each device keeps its node shard's carry in VMEM and, per pod,
     all-to-all exchanges its packed local best (score<<16 | lane
@@ -314,7 +314,7 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
                 )
             return 0
 
-        jax.lax.fori_loop(0, CHUNK, body, 0)
+        jax.lax.fori_loop(0, CHUNK, body, 0, unroll=kernel_unroll)
         used_out_ref[...] = used_ref[...]
         est_out_ref[...] = estx_ref[...]
         prod_out_ref[...] = prod_ref[...]
@@ -341,12 +341,12 @@ def pallas_supported(params: ScoreParams, config) -> bool:
 @functools.partial(
     jax.jit,
     static_argnames=("wsum", "interpret", "most_allocated", "n_shards",
-                     "axis_name"),
+                     "axis_name", "kernel_unroll"),
 )
 def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
                   wsum: int, interpret: bool, quota=None, numa=None,
                   most_allocated: bool = False, n_shards: int = 1,
-                  axis_name: Optional[str] = None):
+                  axis_name: Optional[str] = None, kernel_unroll: int = 1):
     """quota = None | (min[Q,R], runtime[Q,R], used[Q,R], np_used[Q,R]);
     numa = None | (cap[N,R], free[N,R], node_policy[N]).
     Returns (new_state, assign[P], qused[Q,R]|None, qnp[Q,R]|None,
@@ -487,7 +487,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
             interpret = pltpu.InterpretParams()
     out = pl.pallas_call(
         _make_kernel(r, wsum, use_quota, use_numa, most_allocated,
-                     n_shards, axis_name),
+                     n_shards, axis_name, kernel_unroll),
         grid=(P // CHUNK,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -518,11 +518,12 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("wsum", "interpret", "has_gang", "most_allocated"),
+    static_argnames=("wsum", "interpret", "has_gang", "most_allocated",
+                     "kernel_unroll"),
 )
 def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
                 wsum: int, interpret: bool, has_gang: bool,
-                most_allocated: bool):
+                most_allocated: bool, kernel_unroll: int = 1):
     """Kernel scan + the scan solver's exact post-batch epilogue (gang
     resolution, rejected releases) — one jitted program."""
     from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
@@ -540,7 +541,7 @@ def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
         numa_in = (state.numa_cap, state.numa_free, numa_aux.node_policy)
     new_state, assign, qused, qnp, consumed = _pallas_solve(
         state, pods, params, wsum, interpret, quota_in, numa_in,
-        most_allocated,
+        most_allocated, kernel_unroll=kernel_unroll,
     )
     final_qstate = (
         None if quota_state is None
@@ -657,6 +658,7 @@ def pallas_solve_batch(
     return _solve_full(
         state, pods, params, quota_state, gang_state, numa_aux, wsum,
         interpret, gang_state is not None, bool(config.numa_most_allocated),
+        kernel_unroll=int(getattr(config, "kernel_unroll", 1)),
     )
 
 
