@@ -122,8 +122,6 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
     interpreter with emulated remote DMAs — the same program, same
     synchronization, slower clock.
     """
-    import functools
-
     from koordinator_tpu.ops.pallas_binpack import (
         _kernel_epilogue,
         _pallas_solve,
@@ -222,7 +220,7 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
             check_vma=False,
         )
 
-        @functools.partial(jax.jit, static_argnames=())
+        @jax.jit
         def run(state, pods, params, quota_in, npol, quota_state,
                 gang_state):
             new_state, assign, qused, qnp, consumed_k = body_sharded(

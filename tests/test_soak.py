@@ -18,6 +18,8 @@ Invariants (per round):
    pod's is -1 in that node's fake cgroupfs after actuation.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -152,10 +154,8 @@ def test_churn_soak_with_leader_and_sidecar_failover(tmp_path):
             solver_outage_rounds -= 1  # round skipped (retry next tick)
             out_b = None
         else:
-            import time as _time
-
             probe = leader_killed and failover_blackout_s is None
-            t0 = _time.monotonic()
+            t0 = time.monotonic()
             out_b = elected_round(eb, sched_b, t + 2.5)
             if probe and out_b is not None:
                 # the failover blackout: wall time of the new leader's
@@ -163,7 +163,7 @@ def test_churn_soak_with_leader_and_sidecar_failover(tmp_path):
                 # died (solver warm-up included — the persistent
                 # compilation cache is what keeps this bounded across
                 # real process restarts, tests/test_compilation_cache.py)
-                failover_blackout_s = _time.monotonic() - t0
+                failover_blackout_s = time.monotonic() - t0
 
         # exactly one scheduler acted
         assert out_a is None or out_b is None
@@ -251,7 +251,6 @@ def test_scaled_soak_trees_reservations_migrations():
     quota-tree isolation (admission-injected tree affinity keeps every
     tree pod on its pool even while the descheduler drains hot nodes
     through reservation-first migrations)."""
-    from koordinator_tpu.apis.extension import ResourceName
     from koordinator_tpu.client.wiring import wire_descheduler, wire_pod_webhook
     from koordinator_tpu.descheduler import (
         Descheduler,
