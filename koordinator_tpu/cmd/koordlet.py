@@ -129,6 +129,18 @@ class KoordletDaemon:
         self.predict_server.gc(live_keys)
 
 
+def _be_allocatable(states_informer) -> Optional[int]:
+    """BE tier allocatable (node batch-cpu) from the informer's node
+    view — the cpu-evict evictByAllocatable denominator."""
+    from koordinator_tpu.apis.extension import ResourceName
+
+    node = states_informer.get_node()
+    if node is None:
+        return None
+    value = node.allocatable.get(ResourceName.BATCH_CPU)
+    return int(value) if value else None
+
+
 def build_koordlet(
     config: KoordletConfig, gates: Optional[FeatureGate] = None
 ) -> KoordletDaemon:
@@ -236,6 +248,7 @@ def build_koordlet(
         # PVC claim -> bound PV -> device for blkio pod-volume throttles
         volume_name_fn=states_informer.get_volume_name,
         volume_devices=dict(config.volume_devices or {}),
+        be_allocatable_fn=lambda: _be_allocatable(states_informer),
     )
     strategies: List[object] = []
     if gates.enabled("BECPUSuppress"):

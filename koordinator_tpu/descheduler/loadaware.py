@@ -89,6 +89,9 @@ class LowNodeLoad(BalancePlugin):
     def __init__(self, args: Optional[LowNodeLoadArgs] = None):
         self.args = args or LowNodeLoadArgs()
         self.detectors: Dict[str, BasicDetector] = {}
+        #: dry-run mode: the would-be evictions of the last balance pass,
+        #: in order (the reference logs them; this is the queryable form)
+        self.last_proposals: List = []
 
     # -- usage gathering (reference: utilization_util.go getNodeUsage) -----
     def _gather(self, pool: NodePool, snapshot: ClusterSnapshot,
@@ -121,6 +124,7 @@ class LowNodeLoad(BalancePlugin):
     def balance(self, snapshot: ClusterSnapshot, evictor: Evictor) -> None:
         if self.args.paused:
             return
+        self.last_proposals = []
         processed: set = set()
         for pool in self.args.node_pools:
             self._process_pool(pool, snapshot, evictor, processed)
@@ -289,7 +293,12 @@ class LowNodeLoad(BalancePlugin):
                 return
             if (available[res_mask] <= 0).any():
                 return
-            if not evictor.evict(snapshot, pod, reason=(
+            if self.args.dry_run:
+                # reference evictPods dry-run branch: log instead of
+                # evicting, but keep the sweep's accounting identical so
+                # the proposals match what a live run would do
+                self.last_proposals.append(pod)
+            elif not evictor.evict(snapshot, pod, reason=(
                 f"node {node.name} over-utilized"
             )):
                 continue

@@ -135,6 +135,20 @@ class CPUEvictor:
             return float(ctx.node_capacity_mcpu)
         return quota / CFS_PERIOD_US * 1000.0
 
+    def _be_limit_mcpu(self, ctx: QoSContext, t) -> float:
+        """The satisfaction denominator per CPUEvictPolicy
+        (cpu_evict.go:148-151): evictByAllocatable uses the BE tier's
+        allocatable (node batch-cpu), the default uses the cfs-quota
+        real limit. An unknown allocatable falls back to the real
+        limit rather than guessing."""
+        if t.cpu_evict_policy == "evictByAllocatable":
+            alloc = (
+                ctx.be_allocatable_fn() if ctx.be_allocatable_fn else None
+            )
+            if alloc is not None and alloc > 0:
+                return float(alloc)
+        return self._be_real_limit_mcpu(ctx)
+
     def execute(self, ctx: QoSContext, now: float) -> None:
         t = ctx.node_slo.resource_used_threshold_with_be
         if now - self._last_evict < self.cooldown_seconds:
@@ -143,16 +157,22 @@ class CPUEvictor:
         be_request = float(sum(p.cpu_request_mcpu for p in be_pods))
         if be_request <= 0:
             return
-        real_limit = self._be_real_limit_mcpu(ctx)
+        real_limit = self._be_limit_mcpu(ctx, t)
         satisfaction = real_limit / be_request
         lower = t.cpu_evict_be_satisfaction_lower_percent / 100.0
         upper = t.cpu_evict_be_satisfaction_upper_percent / 100.0
         if satisfaction > lower:
             return
-        # only evict when BE is actually starved: usage near its real limit
+        # only evict when BE is actually starved: avg usage near its
+        # limit over the configured window (cpu_evict.go:111-114 —
+        # the window applies when larger than the collect interval)
+        window = max(
+            2 * ctx.metric_collect_interval,
+            float(t.cpu_evict_time_window_seconds or 0),
+        )
         be_usage = ctx.metric_cache.aggregate(
             MetricKind.BE_CPU_USAGE,
-            start=now - ctx.metric_collect_interval, end=now,
+            start=now - window, end=now,
             agg=AggregationType.AVG,
         )
         if be_usage is None or real_limit <= 0:

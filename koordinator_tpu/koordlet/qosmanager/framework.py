@@ -66,6 +66,10 @@ class QoSContext:
     volume_devices: Dict[str, str] = dataclasses.field(default_factory=dict)
     #: how far back "latest" metric queries look
     metric_collect_interval: float = 60.0
+    #: BE tier allocatable (node batch-cpu), for the cpu-evict
+    #: evictByAllocatable policy (cpu_evict.go getBEMilliAllocatable);
+    #: None = unknown, the policy falls back to the real-limit path
+    be_allocatable_fn: Optional[Callable[[], Optional[int]]] = None
 
     def log(self, group: str, subject: str, op: str, detail: str = "") -> None:
         if self.auditor is not None:

@@ -5,6 +5,8 @@ Oracles: cpu_suppress.go:137-163 (budget), :653 (cpuset policy), :589
 (cfs quota); memory_evict.go:101-160; cpu_evict.go:246-360.
 """
 
+import dataclasses
+
 import pytest
 
 from koordinator_tpu.apis.extension import QoSClass
@@ -301,6 +303,70 @@ class TestCPUEvictor:
                             ctx.system_config)
         # usage far below limit: not starved
         ctx.metric_cache.append(MetricKind.BE_CPU_USAGE, None, 100.0, 500.0)
+        CPUEvictor().execute(ctx, now=100.0)
+        assert evicted == []
+
+    def test_evict_by_allocatable_policy(self, tmp_path):
+        """CPUEvictPolicy=evictByAllocatable (cpu_evict.go:148-151):
+        satisfaction uses the BE tier's batch allocatable, not the cfs
+        real limit — the same cluster that is healthy by real-limit is
+        starved by allocatable."""
+        evicted = []
+        pods = [
+            PodMeta("be1", "kubepods/besteffort/be1", QoSClass.BE,
+                    priority=5000, cpu_request_mcpu=2000),
+            PodMeta("be2", "kubepods/besteffort/be2", QoSClass.BE,
+                    priority=5500, cpu_request_mcpu=2000),
+        ]
+        slo = self._slo()
+        slo.resource_used_threshold_with_be.cpu_evict_policy = (
+            "evictByAllocatable"
+        )
+        ctx = make_ctx(tmp_path, pods, slo=slo,
+                       evict=lambda ps, r: evicted.extend(
+                           p.uid for p in ps) or [])
+        # real limit healthy (4 cores for 4000m requested = 100%)...
+        CPU_CFS_QUOTA.write("kubepods/besteffort", "400000",
+                            ctx.system_config)
+        # ...but batch allocatable reclaimed down to 2 cores: 50% < 60%
+        ctx = dataclasses.replace(ctx, be_allocatable_fn=lambda: 2000)
+        ctx.metric_cache.append(
+            MetricKind.BE_CPU_USAGE, None, 100.0, 1900.0)
+        CPUEvictor().execute(ctx, now=100.0)
+        assert evicted == ["be1"]
+        # the default (real-limit) policy does NOT evict here
+        evicted2 = []
+        slo2 = self._slo()
+        ctx2 = make_ctx(tmp_path, pods, slo=slo2,
+                        evict=lambda ps, r: evicted2.extend(ps) or [])
+        CPU_CFS_QUOTA.write("kubepods/besteffort", "400000",
+                            ctx2.system_config)
+        ctx2.metric_cache.append(
+            MetricKind.BE_CPU_USAGE, None, 100.0, 1900.0)
+        CPUEvictor().execute(ctx2, now=100.0)
+        assert evicted2 == []
+
+    def test_evict_window_averages_out_spike(self, tmp_path):
+        """cpu_evict_time_window_seconds widens the usage average: a
+        single stale spike inside a long window no longer clears the
+        usage-high-enough gate."""
+        evicted = []
+        pods = [PodMeta("be1", "kubepods/besteffort/be1", QoSClass.BE,
+                        priority=5000, cpu_request_mcpu=4000)]
+        slo = self._slo()
+        slo.resource_used_threshold_with_be.cpu_evict_time_window_seconds = (
+            300
+        )
+        ctx = make_ctx(tmp_path, pods, slo=slo,
+                       evict=lambda ps, r: evicted.extend(ps) or [])
+        CPU_CFS_QUOTA.write("kubepods/besteffort", "200000",
+                            ctx.system_config)
+        # one old spike + mostly idle samples across the 300s window:
+        # the windowed average stays under the usage threshold
+        mc = ctx.metric_cache
+        mc.append(MetricKind.BE_CPU_USAGE, None, -150.0, 1900.0)
+        for t in range(-140, 101, 20):
+            mc.append(MetricKind.BE_CPU_USAGE, None, float(t), 100.0)
         CPUEvictor().execute(ctx, now=100.0)
         assert evicted == []
 

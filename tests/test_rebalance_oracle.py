@@ -233,6 +233,33 @@ def test_multi_sweep_debounce_identity(seed):
     run_both(args, snapshot, sweeps=4, mutate=drift, rng=rng)
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_dry_run_proposes_exactly_the_live_sequence(seed):
+    """dry_run computes the same ordered victim sequence a live run
+    would evict (reference evictPods' dry-run branch keeps the sweep
+    accounting identical), touching the evictor not at all."""
+    rng = np.random.default_rng(400 + seed)
+    snapshot = random_cluster(rng, stale_frac=0.0)
+
+    def args(dry):
+        return LowNodeLoadArgs(dry_run=dry, node_pools=[NodePool(
+            low_thresholds={CPU: 30, MEM: 30},
+            high_thresholds={CPU: 60, MEM: 75},
+        )])
+
+    live = RecordingEvictor()
+    LowNodeLoad(args(False)).balance(snapshot, live)
+
+    dry_evictor = RecordingEvictor()
+    plugin = LowNodeLoad(args(True))
+    plugin.balance(snapshot, dry_evictor)
+    assert dry_evictor.evicted == []            # nothing actually evicted
+    got = [(p.node_name, p.uid) for p in plugin.last_proposals]
+    assert got == live.sequence
+    # and the dry proposals equal the oracle's live sweep too
+    assert got == RebalanceOracle(args(False)).sweep(snapshot)
+
+
 def test_multi_pool_processed_exclusion():
     """A node claimed as a source by pool 1 must not be reprocessed by
     pool 2 (processedNodes threading)."""
