@@ -232,6 +232,14 @@ class GangSpec:
 
     name: str
     min_member: int
+    #: declared child count. The reference feeds this into its
+    #: schedule-cycle validity machinery (ganggroup.go:110-127: a cycle
+    #: only advances once every child attempted), which exists because
+    #: its per-pod scheduler interleaves gangs across cycles. The
+    #: batched solver places a whole pending queue per solve and
+    #: resolves gangs at batch end — one batch IS one cycle — so the
+    #: field is carried for API parity and surfaced in summaries, not
+    #: consumed by admission logic.
     total_member: int = 0
     wait_time: float = 600.0
     mode: GangMode = GangMode.STRICT

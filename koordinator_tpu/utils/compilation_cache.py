@@ -12,6 +12,13 @@ identity, so a shared directory is safe across processes and restarts
 (writes are atomic renames). Reference counterpart: the Go scheduler has
 no compilation step — this is the TPU-native cost the sidecar/cache
 design pays once per (program, chip) instead of once per process.
+
+Operational note: a cache entry corrupted by an abnormal process death
+(observed once after a machine-wide OOM) can crash JAX's zstd cache
+READER, which our code cannot catch — the recovery is deleting the
+cache directory (or KTPU_COMPILATION_CACHE_DIR="" to disable). The test
+suite therefore isolates itself from the user-global directory
+(tests/conftest.py); production restarts share it on purpose.
 """
 
 from __future__ import annotations

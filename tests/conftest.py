@@ -11,6 +11,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Isolate the suite from the user-global persistent compilation cache:
+# cmd entry points enable it in-process (by design for production), and
+# a shared on-disk cache would couple test runs to whatever any earlier
+# crashed process left behind. The cache's own tests use tmp_path dirs.
+os.environ.setdefault("KTPU_COMPILATION_CACHE_DIR", "")
 # The ambient environment may preset JAX_PLATFORMS (e.g. a TPU tunnel);
 # tests always run on the virtual CPU mesh, so force-override it. A
 # site-level PJRT plugin may additionally have force-updated the
@@ -20,3 +26,21 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free compiled executables between test modules.
+
+    Every XLA:CPU executable holds JIT code mappings; the full suite
+    compiles thousands of programs and was hitting the kernel's
+    vm.max_map_count (~65k mappings -> mmap failure -> segfault inside
+    LLVM, measured r5). Cross-module cache reuse is negligible — each
+    module compiles its own shapes — so clearing per module bounds the
+    mapping count at a small runtime cost.
+    """
+    yield
+    jax.clear_caches()
