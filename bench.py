@@ -364,6 +364,12 @@ def _pick_kernel_or_scan(scan_fn, kernel_fn, repeats, args, compare):
     return best, warm, out, name, win, scan_best, kernel_vs_scan
 
 
+def _cmp_tuple(a, b):
+    """Elementwise bit-identity over two output tuples."""
+    return all(bool((np.asarray(x) == np.asarray(y)).all())
+               for x, y in zip(a, b))
+
+
 def bench_quota(repeats):
     import jax
 
@@ -438,12 +444,8 @@ def bench_gang(repeats):
                                            r.rejected, r.raw_assign))(
         pallas_solve_batch(s, p, pr, config, None, g))
 
-    def cmp_tuple(a, b):
-        return all(bool((np.asarray(x) == np.asarray(y)).all())
-                   for x, y in zip(a, b))
-
     best, _warm, out, solver, win, _scan_best, _kvs = _pick_kernel_or_scan(
-        scan, kern, repeats, (state, pods, params, gstate), cmp_tuple
+        scan, kern, repeats, (state, pods, params, gstate), _cmp_tuple
     )
     p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, gstate),
                  max(20, repeats))
@@ -515,12 +517,8 @@ def bench_numa(repeats):
                                            r.node_state.numa_free))(
         pallas_solve_batch(s, p, pr, config, numa_aux=a))
 
-    def cmp_tuple(a, b):
-        return all(bool((np.asarray(x) == np.asarray(y)).all())
-                   for x, y in zip(a, b))
-
     best, _warm, out, solver, win, scan_best, kvs = _pick_kernel_or_scan(
-        scan, kern, repeats, (state, pods, params, aux), cmp_tuple
+        scan, kern, repeats, (state, pods, params, aux), _cmp_tuple
     )
     p99_s = _p99(lambda *a: win(*a)[0], (state, pods, params, aux),
                  max(20, repeats))
@@ -632,7 +630,11 @@ def bench_full_features(repeats):
 
     n_nodes = int(os.environ.get("KTPU_BENCH_NODES", 5000))
     n_pods = int(os.environ.get("KTPU_BENCH_PODS", 10000))
-    n_quota, n_gangs, members, n_resv = 50, 100, 16, 64
+    n_quota, members = 50, 16
+    # gangs cover <= 1/4 of the batch so shrunken smoke shapes
+    # (KTPU_BENCH_PODS) keep a valid mix of gang and solo pods
+    n_gangs = min(100, max(1, n_pods // (4 * members)))
+    n_resv = min(64, n_gangs)
     state, pods, params = _problem(n_nodes, n_pods, seed=8)
     rng = np.random.default_rng(8)
 
@@ -705,19 +707,36 @@ def bench_full_features(repeats):
         s, p, pr, config, q, g, resv=resv, numa=aux
     ))
 
-    def run(s, p, pr, q, g):
-        r = solve(s, p, pr, q, g)
+    def pick(r):
         return (r.assign, r.node_state.used_req, r.node_state.numa_free,
                 r.resv_free, r.quota_state.used)
 
-    best, warmup, out = _timed(run, repeats, state, pods, params, qstate,
-                               gstate)
-    p99_s = _p99(lambda *a: run(*a)[0],
+    scan = lambda s, p, pr, q, g: pick(solve(s, p, pr, q, g))
+    # the kernel covers the full feature set incl. reservations (r5):
+    # the credit matmul + [R,Vp] rfree carry — winner-kept on identity
+    from koordinator_tpu.ops.pallas_binpack import (
+        pallas_resv_supported,
+        pallas_solve_batch,
+    )
+
+    kern = None
+    if pallas_resv_supported(n_resv, n_nodes):
+        kern = lambda s, p, pr, q, g: pick(pallas_solve_batch(
+            s, p, pr, config, q, g, numa_aux=aux, resv=resv
+        ))
+
+    best, _warm, out, solver, win, scan_best, kvs = _pick_kernel_or_scan(
+        scan, kern, repeats, (state, pods, params, qstate, gstate),
+        _cmp_tuple,
+    )
+    p99_s = _p99(lambda *a: win(*a)[0],
                  (state, pods, params, qstate, gstate), max(20, repeats))
     result = {
         "pods_per_sec": n_pods / best,
         "p99_s": p99_s,
-        "solver": "scan",  # reservations ride the scan (kernel: no resv)
+        "solver": solver,
+        "scan_pods_per_sec": n_pods / scan_best,
+        "kernel_vs_scan": kvs,
         "wall_s": best,
         "placed": int((np.asarray(out[0]) >= 0).sum()),
         "features": "quota+gang+numa+reservation",

@@ -392,7 +392,7 @@ class PlacementModel:
                 snapshot, quota_names, quota_index, node_arrays
             )
 
-        resv_arrays, resv_specs = self._build_resv(
+        resv_arrays, resv_specs, resv_kernel_safe = self._build_resv(
             snapshot, node_arrays, pods_in_order
         )
 
@@ -514,6 +514,7 @@ class PlacementModel:
                 extras,
                 resv_arrays,
                 numa_aux,
+                resv_kernel_safe=resv_kernel_safe,
             )
             if not specials:
                 break
@@ -595,7 +596,8 @@ class PlacementModel:
         )
 
     def _dispatch_solve(self, state, batch, quota_state, gang_state,
-                        extras, resv_arrays, numa_aux):
+                        extras, resv_arrays, numa_aux,
+                        resv_kernel_safe: bool = True):
         """Route eligible plain solves onto the pallas kernel (identical
         results, ~2x on TPU); everything else runs the fused scan. A
         configured remote backend (the solver sidecar) takes the whole
@@ -617,9 +619,20 @@ class PlacementModel:
         if plain and 0 < n * p <= self.host_fallback_cells:
             self.last_solver = "host"
             return self._host_solve(state, batch)
+        from koordinator_tpu.ops.pallas_binpack import pallas_resv_supported
+
         kernel_ok = (
             extras is None
-            and resv_arrays is None
+            and (
+                resv_arrays is None
+                or (
+                    pallas_resv_supported(
+                        int(resv_arrays.node.shape[0]), n
+                    )
+                    # score-budget pre-check from _build_resv's host pass
+                    and resv_kernel_safe
+                )
+            )
             # empty solves take solve_batch's shape early-out; they must
             # not trip the kernel's fallback breaker
             and state.alloc.shape[0] > 0
@@ -633,7 +646,10 @@ class PlacementModel:
             try:
                 result = pallas_solve_batch(
                     state, batch, self.params, self.config,
-                    quota_state, gang_state, numa_aux,
+                    quota_state, gang_state, numa_aux, resv_arrays,
+                    # score budget pre-validated in _build_resv; skip
+                    # the per-solve device->host sync
+                    resv_score_checked=True,
                 )
                 self.last_solver = "pallas"
                 return result
@@ -748,7 +764,11 @@ class PlacementModel:
 
     def _build_resv(self, snapshot, node_arrays, pods_in_order):
         """Lower Available reservations with free remainder to
-        :class:`ResvArrays` (+ the spec list, indexed by v)."""
+        (:class:`ResvArrays`, spec list indexed by v, kernel_safe flag).
+        ``kernel_safe`` is the packed-argmax score-budget verdict
+        computed on the host arrays, so dispatch can route a
+        pathological table to the scan without tripping the kernel
+        breaker."""
         from koordinator_tpu.scheduler.plugins.reservation import (
             reservation_free,
             reservation_matches_pod,
@@ -769,19 +789,27 @@ class PlacementModel:
             frees.append(free)
             once.append(resv.allocate_once)
         if not specs:
-            return None, []
+            return None, [], True
         match = np.zeros((len(pods_in_order), len(specs)), bool)
         for i, pod in enumerate(pods_in_order):
             for v, resv in enumerate(specs):
                 match[i, v] = reservation_matches_pod(resv, pod)
+        node_np = np.asarray(nodes, np.int32)
+        free_np = np.stack(frees).astype(np.int32)
+        from koordinator_tpu.ops.pallas_binpack import pallas_resv_score_safe
+
+        kernel_safe = pallas_resv_score_safe(
+            node_np, free_np, node_arrays.alloc
+        )
         return (
             ResvArrays(
-                node=jnp.asarray(np.asarray(nodes, np.int32)),
-                free=jnp.asarray(np.stack(frees).astype(np.int32)),
+                node=jnp.asarray(node_np),
+                free=jnp.asarray(free_np),
                 allocate_once=jnp.asarray(np.asarray(once, bool)),
                 match=jnp.asarray(match),
             ),
             specs,
+            kernel_safe,
         )
 
     def _apply_reservations(

@@ -39,12 +39,26 @@ the same two-step floor correction, and the winner's consumption
 (pod-policy OR node-policy gated) subtracts in place — reference
 semantics nodenumaresource/scoring.go via ops/binpack.numa_node_score.
 
+**Reservations run inside the kernel** (r5): the ``[R,Vp]`` free-
+remainder table (reservations on lanes) is one more VMEM carry. The
+per-pod matched credit — the transformer.go restore that discounts a
+node's used by its matched reservations' free — is an MXU matmul:
+``credit[R,N] = masked_rfree[R,Vp] @ onehot[Vp,N]`` with the static
+reservation→node one-hot, split hi/lo 16 bits so every f32 partial is
+an exact integer (Vp <= 256 keeps lo-sums < 2^24; the int32
+recombination wraps exactly like the scan's ``at[].add``). The winner's
+consumption picks the most-free matched reservation on the chosen node
+(first-max tie-break) with lane-masked column updates, and emits
+per-pod vstar/delta/rem for the host's incremental Reserve mutation.
+
 Supported configuration (checked by :func:`pallas_supported`):
 ``score_according_prod=False``, unit plugin weights, zero prod
-thresholds; quota, gang, and NUMA states are covered,
-reservation/extras still ride the scan. Reference semantics:
-elasticquota plugin.go:210-255 (admission), coscheduling
-core/core.go:358-385 (batch-end gang gate).
+thresholds; quota, gang, NUMA, and reservation states are covered
+(reservations additionally gated by :func:`pallas_resv_supported`),
+extras still ride the scan. Reference semantics: elasticquota
+plugin.go:210-255 (admission), coscheduling core/core.go:358-385
+(batch-end gang gate), reservation transformer.go:241-266 (restore) +
+plugin Reserve (consumption).
 """
 
 from __future__ import annotations
@@ -78,7 +92,8 @@ CHUNK = 128
 
 def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
                  most_allocated: bool = False, n_shards: int = 1,
-                 axis_name: Optional[str] = None, kernel_unroll: int = 1):
+                 axis_name: Optional[str] = None, kernel_unroll: int = 1,
+                 use_resv: bool = False):
     """``n_shards > 1`` builds the DISTRIBUTED kernel (VERDICT r4 #3):
     each device keeps its node shard's carry in VMEM and, per pod,
     all-to-all exchanges its packed local best (score<<16 | lane
@@ -103,17 +118,25 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
         if use_numa:
             ncap_ref, nrecip_ref, npol_ref, nfree0_ref = (
                 next(it), next(it), next(it), next(it))
+        if use_resv:
+            rnode_ref, aonce_ref, bhot_ref, rfree0_ref, match_ref = (
+                next(it), next(it), next(it), next(it), next(it))
         assign_ref, used_out_ref, est_out_ref, prod_out_ref = (
             next(it), next(it), next(it), next(it))
         if use_quota:
             qused_out_ref, qnp_out_ref = next(it), next(it)
         if use_numa:
             consumed_ref, nfree_out_ref = next(it), next(it)
+        if use_resv:
+            vstar_ref, delta_ref, rem_ref, rfree_out_ref = (
+                next(it), next(it), next(it), next(it))
         used_ref, estx_ref, prod_ref = next(it), next(it), next(it)
         if use_quota:
             qused_ref, qnp_ref = next(it), next(it)
         if use_numa:
             nfree_ref = next(it)
+        if use_resv:
+            rfree_ref = next(it)
         if dist:
             inbox_ref, outbox_ref, send_sem, recv_sem, ack_sem = (
                 next(it), next(it), next(it), next(it), next(it))
@@ -133,6 +156,8 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
                 qnp_ref[...] = qnp0_ref[...]
             if use_numa:
                 nfree_ref[...] = nfree0_ref[...]
+            if use_resv:
+                rfree_ref[...] = rfree0_ref[...]
 
         alloc = alloc_ref[...]
         recip = recip_ref[...]
@@ -157,6 +182,12 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
             ncap = ncap_ref[...]
             nrecip = nrecip_ref[...]
             npol = npol_ref[...].astype(jnp.bool_)   # [1,N]
+        if use_resv:
+            rnode = rnode_ref[...]                   # [1,Vp] global node ids
+            aonce = aonce_ref[...]                   # [1,Vp] allocate_once
+            Vp = rnode.shape[1]
+            vlane = jax.lax.broadcasted_iota(jnp.int32, (1, Vp), 1)
+            msub = jax.lax.broadcasted_iota(jnp.int32, (CHUNK, Vp), 0)
 
         def exact_div(y):
             # the shared exact reciprocal-multiply floor division — plain
@@ -171,7 +202,33 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
             for r in range(R):
                 req_v = jnp.where(sub == r, req_ref[j, r], req_v)
                 est_v = jnp.where(sub == r, est_ref[j, r], est_v)
-            requested = used + req_v
+            if use_resv:
+                # matched reservations' free remainder credited back on
+                # their nodes for this pod's fit path (transformer.go
+                # restore): credit[R,N] = masked_rfree[R,Vp] @ onehot[Vp,N]
+                # on the MXU, hi/lo 16-bit split so every f32 partial is
+                # an exact integer (Vp <= 256 bounds the lo sums < 2^24;
+                # the int32 recombination wraps exactly like the scan's
+                # at[].add)
+                mrow = jnp.sum(
+                    jnp.where(msub == j, match_ref[...], 0),
+                    axis=0, keepdims=True,
+                )                                         # [1,Vp]
+                rfree = rfree_ref[...]                    # [R,Vp]
+                mfree = jnp.where(mrow > 0, rfree, 0)
+                bhot = bhot_ref[...]                      # [Vp,N] f32 0/1
+                hi_s = jnp.dot(
+                    (mfree >> 16).astype(jnp.float32), bhot,
+                    preferred_element_type=jnp.float32,
+                ).astype(jnp.int32)
+                lo_s = jnp.dot(
+                    (mfree & 0xFFFF).astype(jnp.float32), bhot,
+                    preferred_element_type=jnp.float32,
+                ).astype(jnp.int32)
+                used_fit = used - ((hi_s << 16) + lo_s)
+            else:
+                used_fit = used
+            requested = used_fit + req_v
             fit = sched & jnp.all(
                 (req_v == 0) | (requested <= alloc), axis=0, keepdims=True
             )
@@ -299,7 +356,37 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
             node = jnp.where(ok, best, -1).astype(jnp.int32)
             assign_ref[...] = jnp.where(chunk_lane == j, node, assign_ref[...])
             hit = (glane == best) & ok
-            used_ref[...] = used + jnp.where(hit, req_v, 0)
+            net_req = req_v
+            if use_resv:
+                # consume the matched reservation with the most free
+                # capacity on the chosen node (reservation.py Reserve;
+                # first-max tie-break = smallest reservation index);
+                # allocate_once releases the remainder with the hold
+                on_node = (mrow > 0) & (rnode == best) & ok   # [1,Vp]
+                fsum = jnp.sum(rfree, axis=0, keepdims=True)  # int32 wrap
+                fm = jnp.max(jnp.where(on_node, fsum, -1))
+                has = fm > 0
+                vsel = on_node & (fsum == fm)
+                v_star = jnp.min(jnp.where(vsel, vlane, Vp))
+                col = vlane == v_star                         # [1,Vp]
+                rfree_col = jnp.sum(
+                    jnp.where(col, rfree, 0), axis=1, keepdims=True
+                )                                             # [R,1]
+                delta = jnp.where(has, jnp.minimum(rfree_col, req_v), 0)
+                once = has & (jnp.max(jnp.where(col, aonce, 0)) > 0)
+                rem = jnp.where(once, rfree_col - delta, 0)
+                new_col = jnp.where(once, 0, rfree_col - delta)
+                rfree_ref[...] = jnp.where(col & has, new_col, rfree)
+                vstar_v = jnp.where(has, v_star, -1).astype(jnp.int32)
+                vstar_ref[...] = jnp.where(
+                    chunk_lane == j, vstar_v, vstar_ref[...]
+                )
+                delta_ref[...] = jnp.where(
+                    chunk_lane == j, delta, delta_ref[...]
+                )
+                rem_ref[...] = jnp.where(chunk_lane == j, rem, rem_ref[...])
+                net_req = req_v - delta - rem
+            used_ref[...] = used + jnp.where(hit, net_req, 0)
             estx_ref[...] = estx + jnp.where(hit, est_v, 0)
             prod_ref[...] = prod_ref[...] + jnp.where(
                 hit & is_prod, est_v, 0
@@ -330,6 +417,8 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
             qnp_out_ref[...] = qnp_ref[...]
         if use_numa:
             nfree_out_ref[...] = nfree_ref[...]
+        if use_resv:
+            rfree_out_ref[...] = rfree_ref[...]
 
     return kernel
 
@@ -353,11 +442,16 @@ def pallas_supported(params: ScoreParams, config) -> bool:
 def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
                   wsum: int, interpret: bool, quota=None, numa=None,
                   most_allocated: bool = False, n_shards: int = 1,
-                  axis_name: Optional[str] = None, kernel_unroll: int = 1):
+                  axis_name: Optional[str] = None, kernel_unroll: int = 1,
+                  resv=None):
     """quota = None | (min[Q,R], runtime[Q,R], used[Q,R], np_used[Q,R]);
-    numa = None | (cap[N,R], free[N,R], node_policy[N]).
+    numa = None | (cap[N,R], free[N,R], node_policy[N]);
+    resv = None | (node[V], free[V,R], allocate_once[V], match[P,V]) —
+    node indices are GLOBAL under sharding, free/match replicated.
     Returns (new_state, assign[P], qused[Q,R]|None, qnp[Q,R]|None,
-    consumed[P]|None) — the updated numa_free rides new_state.
+    consumed[P]|None, resv_out) where resv_out is None or
+    (vstar[P], delta[P,R], rem[P,R], rfree[V,R]) — the updated
+    numa_free rides new_state.
 
     With ``n_shards > 1`` this runs INSIDE ``jax.shard_map`` on the
     node-shard local arrays: assign carries GLOBAL packed lane ids
@@ -371,6 +465,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     P = ((p + CHUNK - 1) // CHUNK) * CHUNK
     use_quota = quota is not None
     use_numa = numa is not None
+    use_resv = resv is not None
 
     def padn(a2):
         return jnp.zeros((r, N), jnp.int32).at[:, :n].set(
@@ -474,6 +569,43 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
         out_shape += [jax.ShapeDtypeStruct((1, P), jnp.int32),
                       jax.ShapeDtypeStruct((r, N), jnp.int32)]
         scratch += [pltpu.VMEM((r, N), jnp.int32)]
+    if use_resv:
+        rnode_in, rfree_in, aonce_in, match_in = resv
+        v = rnode_in.shape[0]
+        Vp = ((v + 127) // 128) * 128
+        rn = jnp.full((Vp,), -1, jnp.int32).at[:v].set(
+            rnode_in.astype(jnp.int32)
+        )
+        aonce = jnp.zeros((1, Vp), jnp.int32).at[0, :v].set(
+            aonce_in.astype(jnp.int32)
+        )
+        rfree0 = jnp.zeros((r, Vp), jnp.int32).at[:, :v].set(
+            rfree_in.astype(jnp.int32).T
+        )
+        # zero blocked pods' match rows so their credit stays 0 and the
+        # blocked_req fit trick keeps them unplaceable exactly
+        match_pad = jnp.zeros((P, Vp), jnp.int32).at[:p, :v].set(
+            (match_in & ~pods.blocked[:, None]).astype(jnp.int32)
+        )
+        # static reservation -> node-lane one-hot for the credit matmul;
+        # lanes are GLOBAL node ids (shard offset under shard_map)
+        lane_ids = jax.lax.broadcasted_iota(jnp.int32, (Vp, N), 1)
+        if n_shards > 1:
+            lane_ids = lane_ids + jax.lax.axis_index(axis_name) * N
+        bhot = (rn[:, None] == lane_ids).astype(jnp.float32)
+        args += [rn[None, :], aonce, bhot, rfree0, match_pad]
+        in_specs += [full((1, Vp)), full((1, Vp)), full((Vp, N)),
+                     full((r, Vp)),
+                     pl.BlockSpec((CHUNK, Vp), lambda c: (c, 0))]
+        out_specs += [pl.BlockSpec((1, CHUNK), lambda c: (0, c)),
+                      pl.BlockSpec((r, CHUNK), lambda c: (0, c)),
+                      pl.BlockSpec((r, CHUNK), lambda c: (0, c)),
+                      full((r, Vp))]
+        out_shape += [jax.ShapeDtypeStruct((1, P), jnp.int32),
+                      jax.ShapeDtypeStruct((r, P), jnp.int32),
+                      jax.ShapeDtypeStruct((r, P), jnp.int32),
+                      jax.ShapeDtypeStruct((r, Vp), jnp.int32)]
+        scratch += [pltpu.VMEM((r, Vp), jnp.int32)]
 
     dist = n_shards > 1
     compiler_params = None
@@ -494,7 +626,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
             interpret = pltpu.InterpretParams()
     out = pl.pallas_call(
         _make_kernel(r, wsum, use_quota, use_numa, most_allocated,
-                     n_shards, axis_name, kernel_unroll),
+                     n_shards, axis_name, kernel_unroll, use_resv),
         grid=(P // CHUNK,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -506,13 +638,17 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     out = list(out)
     assign, used, est, prod = out[:4]
     rest = out[4:]
-    qused = qnp = nfree = consumed = None
+    qused = qnp = nfree = consumed = resv_out = None
     if use_quota:
         qused, qnp = rest[0][:, :q].T, rest[1][:, :q].T
         rest = rest[2:]
     if use_numa:
         consumed = rest[0][0, :p] > 0
         nfree = rest[1][:, :n].T
+        rest = rest[2:]
+    if use_resv:
+        resv_out = (rest[0][0, :p], rest[1][:, :p].T, rest[2][:, :p].T,
+                    rest[3][:, :v].T)
     new_state = state._replace(
         used_req=used[:, :n].T,
         est_extra=est[:, :n].T,
@@ -520,7 +656,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     )
     if use_numa:
         new_state = new_state._replace(numa_free=nfree)
-    return new_state, assign[0, :p], qused, qnp, consumed
+    return new_state, assign[0, :p], qused, qnp, consumed, resv_out
 
 
 @functools.partial(
@@ -530,13 +666,11 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
 )
 def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
                 wsum: int, interpret: bool, has_gang: bool,
-                most_allocated: bool, kernel_unroll: int = 1):
+                most_allocated: bool, kernel_unroll: int = 1, resv=None):
     """Kernel scan + the scan solver's exact post-batch epilogue (gang
     resolution, rejected releases) — one jitted program."""
-    from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
     from koordinator_tpu.ops.quota import quota_runtime
 
-    n_pods = pods.req.shape[0]
     quota_in = None
     if quota_state is not None:
         runtime = quota_runtime(quota_state)
@@ -546,9 +680,12 @@ def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
     numa_in = None
     if numa_aux is not None:
         numa_in = (state.numa_cap, state.numa_free, numa_aux.node_policy)
-    new_state, assign, qused, qnp, consumed = _pallas_solve(
+    resv_in = None
+    if resv is not None:
+        resv_in = (resv.node, resv.free, resv.allocate_once, resv.match)
+    new_state, assign, qused, qnp, consumed, resv_out = _pallas_solve(
         state, pods, params, wsum, interpret, quota_in, numa_in,
-        most_allocated, kernel_unroll=kernel_unroll,
+        most_allocated, kernel_unroll=kernel_unroll, resv=resv_in,
     )
     final_qstate = (
         None if quota_state is None
@@ -556,41 +693,53 @@ def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
     )
     return _kernel_epilogue(
         new_state, assign, consumed, final_qstate, pods, gang_state,
-        has_gang, numa_aux is not None,
+        has_gang, numa_aux is not None, resv_out=resv_out,
     )
 
 
 def _kernel_epilogue(new_state, assign, consumed, final_qstate, pods,
-                     gang_state, has_gang: bool, has_numa: bool):
+                     gang_state, has_gang: bool, has_numa: bool,
+                     resv_out=None):
     """The scan solver's exact post-batch tail (gang resolution +
     rejected releases) on a kernel's outputs — shared by the
-    single-chip and sharded kernel paths."""
+    single-chip and sharded kernel paths. ``resv_out`` is the kernel's
+    (vstar[P], delta[P,R], rem[P,R], rfree[V,R]) reservation outputs."""
     from koordinator_tpu.ops.gang import gang_outcomes, release_rejected
 
     n_pods = pods.req.shape[0]
     falses = jnp.zeros(n_pods, bool)
+    has_resv = resv_out is not None
+    if has_resv:
+        resv_vstar, resv_delta, resv_rem, final_rfree = resv_out
+    else:
+        resv_vstar = resv_delta = resv_rem = final_rfree = None
     if not has_gang:
         return SolveResult(
             node_state=new_state,
             quota_state=final_qstate,
-            resv_free=None,
+            resv_free=final_rfree,
             assign=assign,
             commit=assign >= 0,
             waiting=falses,
             rejected=falses,
             raw_assign=assign,
-            resv_vstar=None,
-            resv_delta=None,
+            resv_vstar=resv_vstar,
+            resv_delta=resv_delta,
             numa_consumed=consumed,
         )
     commit, waiting, rejected = gang_outcomes(assign, pods.gang_id, gang_state)
+    # a rejected pod held only its net request (reservation delta+rem
+    # were absorbed by the hold shrink) — release exactly that
+    rel_req = pods.req
+    if has_resv:
+        rel_req = pods.req - resv_delta - resv_rem
     used_req, est_extra, prod_base = release_rejected(
         new_state.used_req,
         new_state.est_extra,
         new_state.prod_base,
         assign,
         rejected,
-        pods.req,
+        rel_req,
         pods.est,
         pods.is_prod,
     )
@@ -607,6 +756,16 @@ def _kernel_epilogue(new_state, assign, consumed, final_qstate, pods,
             numa_free=new_state.numa_free
             + jax.ops.segment_sum(back, nidx, num_segments=n + 1)[:n]
         )
+    if has_resv:
+        # restore rejected pods' reservation consumption (+ the released
+        # allocate_once remainder): the incremental Unreserve equivalent
+        v = final_rfree.shape[0]
+        take = rejected & (resv_vstar >= 0)
+        vidx = jnp.where(take, resv_vstar, v)
+        back = jnp.where(take[:, None], resv_delta + resv_rem, 0)
+        final_rfree = final_rfree + jax.ops.segment_sum(
+            back, vidx, num_segments=v + 1
+        )[:v]
     out_assign = jnp.where(commit | waiting, assign, -1).astype(jnp.int32)
     if final_qstate is not None:
         # release rejected pods' quota accounting (solve_batch's tail)
@@ -622,14 +781,14 @@ def _kernel_epilogue(new_state, assign, consumed, final_qstate, pods,
     return SolveResult(
         node_state=new_state,
         quota_state=final_qstate,
-        resv_free=None,
+        resv_free=final_rfree,
         assign=out_assign,
         commit=commit,
         waiting=waiting,
         rejected=rejected,
         raw_assign=assign,
-        resv_vstar=None,
-        resv_delta=None,
+        resv_vstar=resv_vstar,
+        resv_delta=resv_delta,
         numa_consumed=consumed,
     )
 
@@ -642,12 +801,18 @@ def pallas_solve_batch(
     quota_state=None,
     gang_state=None,
     numa_aux=None,
+    resv=None,
     interpret: Optional[bool] = None,
+    resv_score_checked: bool = False,
 ) -> SolveResult:
     """Drop-in for ``solve_batch`` on the kernel paths (plain, quota,
-    gang, NUMA, and their combinations). Raises ValueError for
-    unsupported configurations — callers gate on
-    :func:`pallas_supported`."""
+    gang, NUMA, reservation, and their combinations). Raises ValueError
+    for unsupported configurations — callers gate on
+    :func:`pallas_supported` / :func:`pallas_resv_supported`.
+    ``resv_score_checked=True`` skips the per-solve
+    :func:`pallas_resv_score_safe` host check for callers that already
+    validated the initial table (the verdict cannot change within a
+    solve — in-kernel rfree only decreases)."""
     if not pallas_supported(params, config):
         raise ValueError("configuration not supported by the pallas kernel")
     if state.alloc.shape[0] == 0 or pods.req.shape[0] == 0:
@@ -659,14 +824,79 @@ def pallas_solve_batch(
         state.numa_cap is None or state.numa_free is None
     ):
         raise ValueError("numa_aux requires NodeState.numa_cap/numa_free")
+    if resv is not None:
+        if not pallas_resv_supported(
+            resv.node.shape[0], state.alloc.shape[0]
+        ):
+            raise ValueError(
+                "reservation table unsupported by the kernel (empty "
+                "table: pass resv=None; the hi/lo f32 credit matmul is "
+                "exact for <= 256 reservations and the one-hot must fit "
+                "VMEM) — use the scan solver"
+            )
+        safe = True
+        if not resv_score_checked:
+            try:
+                safe = pallas_resv_score_safe(
+                    resv.node, resv.free, state.alloc
+                )
+            except (jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError) as e:
+                # the gate must stay loud: silently skipping it under
+                # tracing could return placements that diverge from the
+                # scan on an unsafe table
+                raise ValueError(
+                    "cannot validate the reservation score budget under "
+                    "tracing: pre-validate with pallas_resv_score_safe "
+                    "and pass resv_score_checked=True"
+                ) from e
+        if not safe:
+            raise ValueError(
+                "reservation credit could overflow the packed argmax's "
+                "15-bit score budget — use the scan solver"
+            )
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     wsum = int(np.asarray(params.weights).sum()) or 1
     return _solve_full(
         state, pods, params, quota_state, gang_state, numa_aux, wsum,
         interpret, gang_state is not None, bool(config.numa_most_allocated),
-        kernel_unroll=int(getattr(config, "kernel_unroll", 1)),
+        kernel_unroll=int(getattr(config, "kernel_unroll", 1)), resv=resv,
     )
+
+
+def pallas_resv_supported(n_resv: int, n_nodes: int) -> bool:
+    """Whether a reservation table maps onto the kernel: at least one
+    reservation (an empty table must be passed as ``resv=None`` — the
+    kernel's lane padding cannot express zero-width tables), <= 256
+    (keeps every f32 lo-partial of the credit matmul an exact integer:
+    256 * (2^16 - 1) < 2^24), and a one-hot small enough to leave VMEM
+    for the [R,N] carries (~8 MB budget)."""
+    if n_resv < 1:
+        return False
+    vp = ((n_resv + 127) // 128) * 128
+    np_ = ((n_nodes + 127) // 128) * 128
+    return vp <= 256 and vp * np_ * 4 <= 8 * 2**20
+
+
+def pallas_resv_score_safe(node, free, alloc) -> bool:
+    """The packed single-reduction argmax budgets 15 bits for the score
+    (``score << 16`` must stay positive in int32). Without reservations
+    every component is <= 100 (fit + loadaware + numa <= 300); the
+    matched credit can push the fit term to ~100 * (1 + credit/alloc)
+    because ``used - credit`` may go far negative. A table whose
+    worst-case per-node credit ratio could overflow the budget must
+    ride the scan. In-kernel ``rfree`` only ever decreases from the
+    initial table, so the initial per-node column sums bound the credit
+    for the whole solve. Host-side (concrete arrays) check."""
+    node = np.asarray(node)
+    free = np.asarray(free).astype(np.int64)
+    alloc = np.asarray(alloc).astype(np.int64)
+    credit = np.zeros_like(alloc)
+    np.add.at(credit, node, free)
+    ratio = -(-credit // np.maximum(alloc, 1))  # ceil; alloc==0 scores 0
+    worst = 300 + 100 * int(np.where(alloc > 0, ratio, 0).max(initial=0))
+    return worst <= 32767
 
 
 def pallas_schedule_batch(

@@ -132,10 +132,13 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
     k = len(devices)
 
     def solve(state, pods, params, quota_state=None, gang_state=None,
-              numa_aux=None):
+              numa_aux=None, resv=None):
         import jax.numpy as jnp
 
-        from koordinator_tpu.ops.pallas_binpack import pallas_supported
+        from koordinator_tpu.ops.pallas_binpack import (
+            pallas_resv_supported,
+            pallas_supported,
+        )
 
         if not pallas_supported(params, config):
             # same guard as the single-chip kernel dispatch: scoring
@@ -157,6 +160,24 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
         n_pad = n_loc * k
         if n_pad > 65536:
             raise ValueError("packed argmax carries 16 lane bits")
+        use_r = resv is not None
+        if use_r and not pallas_resv_supported(resv.node.shape[0], n_loc):
+            raise ValueError(
+                "reservation table unsupported by the sharded kernel "
+                "(empty table: pass resv=None; otherwise too large) — "
+                "use the sharded scan"
+            )
+        if use_r:
+            from koordinator_tpu.ops.pallas_binpack import (
+                pallas_resv_score_safe,
+            )
+
+            if not pallas_resv_score_safe(resv.node, resv.free,
+                                          state.alloc):
+                raise ValueError(
+                    "reservation credit could overflow the packed "
+                    "argmax's score budget — use the sharded scan"
+                )
 
         def padn(a, fill=0):
             if a is None:
@@ -182,6 +203,14 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
             runtime = quota_runtime(quota_state)
             quota_in = (quota_state.min, runtime, quota_state.used,
                         quota_state.np_used)
+        # reservation tables are tiny [V,R]; they replicate, every shard
+        # replays the same global consumption trajectory (the merged
+        # winner is global), and the one-hot's lanes get the shard
+        # offset inside _pallas_solve
+        resv_in = (
+            (resv.node, resv.free, resv.allocate_once, resv.match)
+            if use_r else None
+        )
 
         ns_spec = P(NODE_AXIS)
         rep = P()
@@ -195,36 +224,40 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
         pods_specs = jax.tree.map(lambda _: rep, pods)
         quota_specs = (rep, rep, rep, rep) if use_q else None
 
-        def body(state_l, pods_l, params_l, quota_l, npol_l):
+        def body(state_l, pods_l, params_l, quota_l, npol_l, resv_l):
             numa_in = None
             if use_n:
                 numa_in = (state_l.numa_cap, state_l.numa_free, npol_l)
-            new_state, assign, qused, qnp, consumed = _pallas_solve(
-                state_l, pods_l, params_l, wsum, nonlocal_interpret,
-                quota_l, numa_in, bool(config.numa_most_allocated),
-                n_shards=k, axis_name=NODE_AXIS,
+            new_state, assign, qused, qnp, consumed, resv_out = (
+                _pallas_solve(
+                    state_l, pods_l, params_l, wsum, nonlocal_interpret,
+                    quota_l, numa_in, bool(config.numa_most_allocated),
+                    n_shards=k, axis_name=NODE_AXIS, resv=resv_l,
+                )
             )
             if consumed is None:
                 consumed = jnp.zeros(assign.shape[0], bool)
-            return new_state, assign, qused, qnp, consumed[None, :]
+            return new_state, assign, qused, qnp, consumed[None, :], resv_out
 
         body_sharded = jax.shard_map(
             body, mesh=mesh,
             in_specs=(state_specs, pods_specs,
                       jax.tree.map(lambda _: rep, params),
-                      quota_specs, ns_spec if use_n else None),
+                      quota_specs, ns_spec if use_n else None,
+                      (rep, rep, rep, rep) if use_r else None),
             out_specs=(state_specs, rep,
                        rep if use_q else None,
                        rep if use_q else None,
-                       P(NODE_AXIS, None)),
+                       P(NODE_AXIS, None),
+                       (rep, rep, rep, rep) if use_r else None),
             check_vma=False,
         )
 
         @jax.jit
-        def run(state, pods, params, quota_in, npol, quota_state,
+        def run(state, pods, params, quota_in, npol, resv_in, quota_state,
                 gang_state):
-            new_state, assign, qused, qnp, consumed_k = body_sharded(
-                state, pods, params, quota_in, npol
+            new_state, assign, qused, qnp, consumed_k, resv_out = (
+                body_sharded(state, pods, params, quota_in, npol, resv_in)
             )
             # the node axis was padded GLOBALLY (then sharded), and each
             # shard's width is already a 128-lane multiple, so the
@@ -238,11 +271,12 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
             result = _kernel_epilogue(
                 new_state, assign, consumed, final_qstate, pods,
                 gang_state, gang_state is not None, use_n,
+                resv_out=resv_out,
             )
             return result
 
-        result = run(state, pods, params, quota_in, npol, quota_state,
-                     gang_state)
+        result = run(state, pods, params, quota_in, npol, resv_in,
+                     quota_state, gang_state)
         # strip node padding back off
         trim = lambda a: None if a is None else a[:n]
         return result._replace(

@@ -379,3 +379,210 @@ def test_model_quota_gang_pallas_path_identical():
                 if n is not None and u.startswith("default/q")]
     assert len(placed_q) == 2
     assert model.use_pallas  # no silent fallback
+
+
+def _resv_setup(state, pods, n_resv=11, seed=8, once_frac=0.4,
+                match_frac=0.25):
+    """Reservation tables over the _problem pods: holds big enough that
+    the credit path flips some fit decisions, with allocate_once mixed
+    in so remainder release is exercised."""
+    from koordinator_tpu.ops.binpack import ResvArrays
+
+    rng = np.random.default_rng(seed)
+    n_nodes = state.alloc.shape[0]
+    n_pods = pods.req.shape[0]
+    node = rng.integers(0, n_nodes, n_resv).astype(np.int32)
+    free = np.zeros((n_resv, NUM_RESOURCES), np.int32)
+    free[:, R.CPU] = rng.integers(500, 100001, n_resv)
+    free[:, R.MEMORY] = rng.integers(0, 8192, n_resv)
+    match = rng.uniform(size=(n_pods, n_resv)) < match_frac
+    return ResvArrays(
+        node=jnp.asarray(node),
+        free=jnp.asarray(free),
+        allocate_once=jnp.asarray(rng.uniform(size=n_resv) < once_frac),
+        match=jnp.asarray(match),
+    )
+
+
+def _assert_resv_identical(got, want):
+    _assert_result_identical(got, want)
+    for field in ("resv_free", "resv_vstar", "resv_delta"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, field)),
+            np.asarray(getattr(want, field)), err_msg=field)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_resv_identical_to_scan(seed):
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    resv = _resv_setup(state, pods, seed=seed + 8)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, resv=resv)
+    got = pallas_solve_batch(state, pods, params, config, resv=resv,
+                             interpret=True)
+    _assert_resv_identical(got, want)
+    # reservations really consumed (else the credit matmul is untested)
+    assert int((np.asarray(want.resv_vstar) >= 0).sum()) > 0
+    assert not np.array_equal(
+        np.asarray(want.resv_free), np.asarray(resv.free))
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_resv_gang_identical_to_scan(seed):
+    """Gang rejections release reservation consumption — the epilogue's
+    segment-sum restore must match the scan's."""
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    resv = _resv_setup(state, pods, seed=seed + 8)
+    pods, gstate = _gang_setup(pods, seed=seed + 7)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, None, gstate,
+                       resv=resv)
+    got = pallas_solve_batch(state, pods, params, config, None, gstate,
+                             resv=resv, interpret=True)
+    _assert_resv_identical(got, want)
+    rej_consumed = (np.asarray(want.rejected)
+                    & (np.asarray(want.resv_vstar) >= 0))
+    assert rej_consumed.sum() > 0  # the restore path really ran
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_resv_quota_gang_numa_identical_to_scan(seed):
+    """EVERY kernel feature fused at once: quota admission + strict
+    gangs + NUMA scoring/consumption + reservation credit/consumption."""
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(seed=seed)
+    pods, qstate = _quota_setup(state, pods, seed=seed + 5)
+    pods, gstate = _gang_setup(pods, seed=seed + 6)
+    state, pods, aux = _numa_setup(state, pods, seed=seed + 7)
+    resv = _resv_setup(state, pods, seed=seed + 8)
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, qstate, gstate,
+                       resv=resv, numa=aux)
+    got = pallas_solve_batch(state, pods, params, config, qstate, gstate,
+                             numa_aux=aux, resv=resv, interpret=True)
+    _assert_numa_identical(got, want)
+    _assert_resv_identical(got, want)
+
+
+def test_resv_multi_tile_and_gate():
+    """129 reservations exercise the second lane tile (Vp=256); 257
+    overflows the exactness bound and must raise."""
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import (
+        pallas_resv_supported,
+        pallas_solve_batch,
+    )
+
+    state, pods, params = _problem(seed=4)
+    config = SolverConfig()
+    resv = _resv_setup(state, pods, n_resv=129, seed=12, match_frac=0.1)
+    want = solve_batch(state, pods, params, config, resv=resv)
+    got = pallas_solve_batch(state, pods, params, config, resv=resv,
+                             interpret=True)
+    _assert_resv_identical(got, want)
+
+    assert pallas_resv_supported(256, 5000)
+    assert not pallas_resv_supported(257, 5000)
+    assert not pallas_resv_supported(256, 20000)  # one-hot VMEM gate
+    assert not pallas_resv_supported(0, 5000)  # empty: pass resv=None
+    big = _resv_setup(state, pods, n_resv=257, seed=13)
+    with pytest.raises(ValueError):
+        pallas_solve_batch(state, pods, params, config, resv=big,
+                           interpret=True)
+
+
+def test_resv_credit_flips_fit():
+    """A pod that does NOT fit on any node by raw used_req fits via a
+    matched reservation's credited hold — the hi/lo credit matmul must
+    discount exactly (transformer.go restore semantics)."""
+    from koordinator_tpu.ops.binpack import ResvArrays, solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    n_nodes = 5
+    alloc = np.full((n_nodes, NUM_RESOURCES), 0, np.int32)
+    alloc[:, R.CPU] = 8000
+    alloc[:, R.MEMORY] = 16384
+    used = alloc.copy()  # every node fully held
+    state = NodeState(
+        alloc=jnp.asarray(alloc),
+        used_req=jnp.asarray(used),
+        usage=jnp.zeros_like(jnp.asarray(alloc)),
+        prod_usage=jnp.zeros_like(jnp.asarray(alloc)),
+        est_extra=jnp.zeros_like(jnp.asarray(alloc)),
+        prod_base=jnp.zeros_like(jnp.asarray(alloc)),
+        metric_fresh=jnp.ones(n_nodes, bool),
+        schedulable=jnp.ones(n_nodes, bool),
+    )
+    req = np.zeros((2, NUM_RESOURCES), np.int32)
+    req[:, R.CPU] = 2000
+    pods = PodBatch.build(
+        req=jnp.asarray(req), est=jnp.asarray(req),
+        is_prod=jnp.zeros(2, bool), is_daemonset=jnp.zeros(2, bool),
+    )
+    free = np.zeros((1, NUM_RESOURCES), np.int32)
+    free[0, R.CPU] = 4000
+    free[0, R.MEMORY] = 4096
+    resv = ResvArrays(
+        node=jnp.asarray(np.array([3], np.int32)),
+        free=jnp.asarray(free),
+        allocate_once=jnp.asarray([False]),
+        match=jnp.asarray(np.ones((2, 1), bool)),
+    )
+    params = ScoreParams(
+        weights=jnp.asarray(np.array([1, 1] + [0] * (NUM_RESOURCES - 2),
+                                     np.int32)),
+        thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+    config = SolverConfig()
+    want = solve_batch(state, pods, params, config, resv=resv)
+    got = pallas_solve_batch(state, pods, params, config, resv=resv,
+                             interpret=True)
+    _assert_resv_identical(got, want)
+    # both pods land on the reserved node through the credit
+    np.testing.assert_array_equal(np.asarray(got.assign), [3, 3])
+    np.testing.assert_array_equal(
+        np.asarray(got.resv_free)[0, R.CPU], 0)  # 2x2000 consumed
+
+
+def test_resv_score_budget_gate():
+    """A reservation table whose credit could overflow the packed
+    argmax's 15-bit score budget must be rejected (rides the scan);
+    normal tables pass."""
+    from koordinator_tpu.ops.binpack import ResvArrays, solve_batch
+    from koordinator_tpu.ops.pallas_binpack import (
+        pallas_resv_score_safe,
+        pallas_solve_batch,
+    )
+
+    state, pods, params = _problem(seed=5)
+    ok_resv = _resv_setup(state, pods, seed=15)
+    assert pallas_resv_score_safe(ok_resv.node, ok_resv.free, state.alloc)
+
+    # ~325x the smallest node's allocatable as matched free => the fit
+    # term alone could exceed 32767
+    n_nodes = state.alloc.shape[0]
+    small = int(np.asarray(state.alloc)[:, R.CPU].min())
+    free = np.zeros((1, NUM_RESOURCES), np.int32)
+    free[0, R.CPU] = small * 330
+    node = int(np.asarray(state.alloc)[:, R.CPU].argmin())
+    bad = ResvArrays(
+        node=jnp.asarray(np.array([node], np.int32)),
+        free=jnp.asarray(free),
+        allocate_once=jnp.asarray([False]),
+        match=jnp.asarray(np.ones((pods.req.shape[0], 1), bool)),
+    )
+    assert not pallas_resv_score_safe(bad.node, bad.free, state.alloc)
+    with pytest.raises(ValueError):
+        pallas_solve_batch(state, pods, params, SolverConfig(), resv=bad,
+                           interpret=True)
+    # the scan handles it fine (the contract the router falls back to)
+    solve_batch(state, pods, params, SolverConfig(), resv=bad)
