@@ -33,7 +33,8 @@ from koordinator_tpu.apis.types import resources_to_vector, selector_matches
 from koordinator_tpu.descheduler.anomaly import BasicDetector, State
 from koordinator_tpu.descheduler.framework import BalancePlugin, Evictor
 from koordinator_tpu.descheduler.sorter import (
-    pod_sort_key,
+    pod_sort_key_from_static,
+    pod_sort_static,
     resource_usage_score,
 )
 from koordinator_tpu.ops.rebalance import classify_nodes, threshold_quantities
@@ -125,9 +126,22 @@ class LowNodeLoad(BalancePlugin):
         if self.args.paused:
             return
         self.last_proposals = []
+        #: per-sweep pod cache: uid -> (static sort prefix, request
+        #: vector). Pod specs are immutable within one sweep, so the
+        #: static key parts and the request lowering are computed once
+        #: per pod instead of once per comparator/filter call.
+        self._sweep_cache: Dict[str, tuple] = {}
         processed: set = set()
         for pool in self.args.node_pools:
             self._process_pool(pool, snapshot, evictor, processed)
+
+    def _pod_cached(self, pod) -> tuple:
+        """(pod_sort_static prefix, request vector) for this sweep."""
+        ent = self._sweep_cache.get(pod.uid)
+        if ent is None:
+            ent = (pod_sort_static(pod), resources_to_vector(pod.requests))
+            self._sweep_cache[pod.uid] = ent
+        return ent
 
     def _process_pool(self, pool: NodePool, snapshot: ClusterSnapshot,
                       evictor: Evictor, processed: set) -> None:
@@ -263,9 +277,7 @@ class LowNodeLoad(BalancePlugin):
                 continue
             if not evictor.filter(pod):
                 continue
-            if self.args.node_fit and not fits_any(
-                resources_to_vector(pod.requests)
-            ):
+            if self.args.node_fit and not fits_any(self._pod_cached(pod)[1]):
                 continue
             removable.append(pod)
         if not removable:
@@ -279,8 +291,9 @@ class LowNodeLoad(BalancePlugin):
         over_weights = {
             ResourceName(r): int(weights[r]) for r in np.flatnonzero(over)
         }
-        removable.sort(key=lambda pod: pod_sort_key(
-            pod, self._pod_metric(snapshot, node, pod), node.allocatable,
+        removable.sort(key=lambda pod: pod_sort_key_from_static(
+            self._pod_cached(pod)[0],
+            self._pod_metric(snapshot, node, pod), node.allocatable,
             over_weights,
         ))
         for pod in removable:

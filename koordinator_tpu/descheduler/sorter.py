@@ -119,22 +119,10 @@ def resource_usage_score(
     return score // weight_sum
 
 
-def pod_sort_key(
-    pod: PodSpec,
-    pod_usage: Optional[Mapping],
-    node_allocatable: Mapping,
-    weights: Mapping,
-) -> Tuple:
-    """The full PodSorter comparator chain as one ascending key.
-
-    ``pod_usage`` is the pod's metric ResourceList (None = no metric,
-    which sorts after all metered pods)."""
-    if pod_usage is None:
-        usage_key = (1, 0)
-    else:
-        usage_key = (
-            0, -resource_usage_score(pod_usage, node_allocatable, weights)
-        )
+def pod_sort_static(pod: PodSpec) -> Tuple:
+    """The node-independent prefix of the PodSorter chain (everything
+    but the usage score) — computable once per pod per sweep and cached
+    by callers that sort the same pod set against many nodes."""
     return (
         KOORD_PRIORITY_ORDER.get(
             pod.priority_class or PriorityClass.NONE, 5
@@ -144,6 +132,38 @@ def pod_sort_key(
         KOORD_QOS_ORDER.get(pod.qos, 5),
         _annotation_cost(pod, ANNOTATION_DELETION_COST),
         _annotation_cost(pod, ANNOTATION_EVICTION_COST),
-        usage_key,
         -pod.creation_time,
+    )
+
+
+def pod_sort_key_from_static(
+    static: Tuple,
+    pod_usage: Optional[Mapping],
+    node_allocatable: Mapping,
+    weights: Mapping,
+) -> Tuple:
+    """Assemble the full ascending key from a cached
+    :func:`pod_sort_static` prefix plus the node-dependent usage score.
+
+    ``pod_usage`` is the pod's metric ResourceList (None = no metric,
+    which sorts after all metered pods)."""
+    if pod_usage is None:
+        usage_key = (1, 0)
+    else:
+        usage_key = (
+            0, -resource_usage_score(pod_usage, node_allocatable, weights)
+        )
+    # the usage score slots in just before the creation-time tail
+    return static[:-1] + (usage_key, static[-1])
+
+
+def pod_sort_key(
+    pod: PodSpec,
+    pod_usage: Optional[Mapping],
+    node_allocatable: Mapping,
+    weights: Mapping,
+) -> Tuple:
+    """The full PodSorter comparator chain as one ascending key."""
+    return pod_sort_key_from_static(
+        pod_sort_static(pod), pod_usage, node_allocatable, weights
     )

@@ -167,6 +167,15 @@ class PlacementModel:
         step = power // 8                      # quarter steps of power/2
         return ((p + step - 1) // step) * step
 
+    @staticmethod
+    def resv_bucket(v: int) -> int:
+        """Shape bucket for the reservation axis (next power of two,
+        floor 8): a cluster whose Available-reservation count drifts by
+        ones would otherwise trace a fresh program per count. Padding
+        rows are inert — match all-False, zero free — so no pod can ever
+        match or consume them."""
+        return max(8, 1 << (v - 1).bit_length())
+
     def __init__(
         self,
         config: SolverConfig = SolverConfig(),
@@ -395,6 +404,8 @@ class PlacementModel:
         resv_arrays, resv_specs, resv_kernel_safe = self._build_resv(
             snapshot, node_arrays, pods_in_order
         )
+        if resv_arrays is not None and self.pod_bucketing:
+            resv_arrays = self._pad_resv(resv_arrays)
 
         # -- special pods + required node selectors: host Extras rows ------
         # node selectors (the NodeAffinity slice the incremental fit
@@ -761,6 +772,28 @@ class PlacementModel:
         if resv is not None:
             resv = resv._replace(match=padp(resv.match, False))
         return batch, extras, resv
+
+    def _pad_resv(self, resv):
+        """Pad the reservation axis to its shape bucket with inert rows
+        (node 0, zero free, no matches) — identical semantics, one
+        compiled program per bucket."""
+        v = int(resv.node.shape[0])
+        target = self.resv_bucket(v)
+        if target == v:
+            return resv
+        pad = target - v
+
+        def padv(a, fill):
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.pad(a, widths, constant_values=fill)
+
+        return resv._replace(
+            node=padv(resv.node, 0),
+            free=padv(resv.free, 0),
+            allocate_once=padv(resv.allocate_once, False),
+            match=jnp.pad(resv.match, [(0, 0), (0, pad)],
+                          constant_values=False),
+        )
 
     def _build_resv(self, snapshot, node_arrays, pods_in_order):
         """Lower Available reservations with free remainder to

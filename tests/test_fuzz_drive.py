@@ -29,6 +29,9 @@ from koordinator_tpu.apis.types import (
     NodeSpec,
     PodSpec,
     QuotaSpec,
+    ReservationSpec,
+    ReservationState,
+    resources_to_vector,
 )
 from koordinator_tpu.client import APIServer, Kind, wire_scheduler
 from koordinator_tpu.client.wiring import wire_descheduler
@@ -46,6 +49,13 @@ NODE_CPU, NODE_MEM = 16000, 32768
 
 
 def _drive(seed: int, rounds: int = 60) -> dict:
+    # NOTE on compile-cache pressure: reservation-bearing snapshots
+    # would trace a fresh program per raw [P,V] match shape and the
+    # accumulated executables exhaust the process mmap budget (the
+    # conftest per-module clear can't help within one module). The
+    # model's reservation-axis bucketing (PlacementModel.resv_bucket)
+    # collapses V to power-of-two buckets, so drives reuse each other's
+    # programs instead — no per-drive cache clearing needed.
     rng = np.random.default_rng(seed)
     bus = APIServer()
     scheduler = Scheduler()
@@ -73,16 +83,25 @@ def _drive(seed: int, rounds: int = 60) -> dict:
 
     next_id = 0
     next_gang = 0
+    next_resv = 0
     live: list = []
     gang_min: dict = {}
     cordoned: set = set()
     placements: dict = {}
     migrated: set = set()
+    owner_keys: list = []
+    #: allocate_once reservations' consumer count at SUCCEEDED time
+    consumed_now: dict = {}
     stats = {"placed": 0, "migrated": 0, "gangs": 0, "deleted": 0,
-             "cordons": 0}
+             "cordons": 0, "reservations": 0, "resv_consumed": 0}
 
     def arrive_plain():
         nonlocal next_id
+        # some arrivals carry a reservation owner label so live
+        # reservations actually get matched and consumed
+        labels = {}
+        if owner_keys and rng.random() < 0.5:
+            labels = {"own": str(rng.choice(owner_keys))}
         pod = PodSpec(
             name=f"p{next_id}",
             qos=[QoSClass.LS, QoSClass.BE, QoSClass.NONE][next_id % 3],
@@ -90,10 +109,29 @@ def _drive(seed: int, rounds: int = 60) -> dict:
             requests={R.CPU: int(rng.integers(200, 4000)),
                       R.MEMORY: int(rng.integers(256, 4096))},
             quota=str(rng.choice(["qa", "qb"])),
+            labels=labels,
         )
         next_id += 1
         bus.apply(Kind.POD, pod.uid, pod)
         live.append(pod.uid)
+
+    def reserve():
+        nonlocal next_resv
+        key = f"w{next_resv}"
+        spec = ReservationSpec(
+            name=f"r{next_resv}",
+            node_name=f"n{int(rng.integers(0, n_nodes))}",
+            state=ReservationState.AVAILABLE,
+            requests={R.CPU: int(rng.integers(2000, 8000)),
+                      R.MEMORY: int(rng.integers(1024, 8192))},
+            owner_labels={"own": key},
+            allocate_once=bool(rng.random() < 0.4),
+        )
+        spec.allocatable = dict(spec.requests)
+        next_resv += 1
+        owner_keys.append(key)
+        bus.apply(Kind.RESERVATION, spec.name, spec)
+        stats["reservations"] += 1
 
     def arrive_gang():
         nonlocal next_id, next_gang
@@ -167,8 +205,10 @@ def _drive(seed: int, rounds: int = 60) -> dict:
             arrive_plain()
         elif roll < 0.7:
             arrive_gang()
-        elif roll < 0.9:
+        elif roll < 0.85:
             delete_pod()
+        elif roll < 0.92:
+            reserve()
         elif roll < 0.95 and len(cordoned) < n_nodes - 2:
             cordon()
 
@@ -253,6 +293,33 @@ def _drive(seed: int, rounds: int = 60) -> dict:
                 assert uid in pods_on_bus, (
                     f"seed {seed} step {step}: cache holds deleted {uid}"
                 )
+        # 7. reservation accounting: allocated never exceeds allocatable,
+        #    consumers are real pods, and a SUCCEEDED allocate_once
+        #    reservation stops admitting new consumers
+        live_resv = bus.list(Kind.RESERVATION)
+        for rname, spec in live_resv.items():
+            # allocatable falls back to requests when unset (migration
+            # reservations) — same rule as reservation_free
+            alloc_vec = resources_to_vector(spec.allocatable or spec.requests)
+            used_vec = resources_to_vector(spec.allocated)
+            assert (used_vec <= alloc_vec).all(), (
+                f"seed {seed} step {step}: reservation {rname} "
+                f"over-allocated {spec.allocated} > {spec.allocatable}"
+            )
+            for uid in spec.allocated_pod_uids:
+                assert uid.startswith("default/p"), uid
+            if (spec.allocate_once
+                    and getattr(spec.state, "value", spec.state)
+                    == "Succeeded"):
+                consumed_now.setdefault(rname, len(spec.allocated_pod_uids))
+                assert len(spec.allocated_pod_uids) == consumed_now[rname], (
+                    f"seed {seed} step {step}: SUCCEEDED allocate_once "
+                    f"{rname} kept admitting consumers"
+                )
+        stats["resv_consumed"] = max(
+            stats["resv_consumed"],
+            sum(len(s.allocated_pod_uids) for s in live_resv.values()),
+        )
 
     stats["placed"] = sum(
         1 for p in bus.list(Kind.POD).values() if p.node_name is not None
@@ -271,7 +338,7 @@ def test_fuzz_coverage_aggregate():
     """Across the seeds, every op class and outcome must actually have
     occurred — no vacuously green fuzzing."""
     total = {"placed": 0, "migrated": 0, "gangs": 0, "deleted": 0,
-             "cordons": 0}
+             "cordons": 0, "reservations": 0, "resv_consumed": 0}
     for seed in range(8):
         stats = _drive(seed)
         for k in total:
@@ -281,3 +348,5 @@ def test_fuzz_coverage_aggregate():
     assert total["deleted"] > 20
     assert total["cordons"] > 3
     assert total["migrated"] >= 1
+    assert total["reservations"] > 5
+    assert total["resv_consumed"] > 0  # reservations really got consumed

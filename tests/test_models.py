@@ -295,3 +295,55 @@ def test_host_fallback_identical_and_routed():
         pod.quota = "t"
     host.schedule(s)
     assert host.last_solver != "host"
+
+
+def test_resv_axis_bucketing_identity():
+    """Reservation tables of different sizes pad to one shape bucket
+    with inert rows — identical schedules with bucketing on and off
+    (the off path solves at the raw V)."""
+    from koordinator_tpu.apis.extension import ResourceName as R
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot,
+        NodeMetric,
+        NodeSpec,
+        PodSpec,
+        ReservationSpec,
+        ReservationState,
+    )
+    from koordinator_tpu.models.placement import PlacementModel
+
+    assert PlacementModel.resv_bucket(1) == 8
+    assert PlacementModel.resv_bucket(8) == 8
+    assert PlacementModel.resv_bucket(9) == 16
+
+    def snap(n_resv):
+        nodes = [NodeSpec(name=f"n{i}",
+                          allocatable={R.CPU: 8000, R.MEMORY: 16384})
+                 for i in range(6)]
+        resvs = [ReservationSpec(
+            name=f"r{v}", node_name=f"n{v % 6}",
+            state=ReservationState.AVAILABLE,
+            requests={R.CPU: 2000 + 100 * v},
+            allocatable={R.CPU: 2000 + 100 * v},
+            owner_labels={"own": "w"},
+        ) for v in range(n_resv)]
+        return ClusterSnapshot(
+            nodes=nodes,
+            pending_pods=[
+                PodSpec(name=f"p{i}", requests={R.CPU: 1500},
+                        labels={"own": "w"})
+                for i in range(4)
+            ],
+            node_metrics={f"n{i}": NodeMetric(node_name=f"n{i}",
+                                              node_usage={},
+                                              update_time=99.0)
+                          for i in range(6)},
+            reservations=resvs,
+            now=100.0,
+        )
+
+    for n_resv in (1, 3, 7):
+        bucketed = PlacementModel(pod_bucketing=True).schedule(snap(n_resv))
+        raw = PlacementModel(pod_bucketing=False).schedule(snap(n_resv))
+        assert dict(bucketed) == dict(raw), n_resv
+        assert bucketed.resv_allocs == raw.resv_allocs
