@@ -93,6 +93,9 @@ class LowNodeLoad(BalancePlugin):
         #: dry-run mode: the would-be evictions of the last balance pass,
         #: in order (the reference logs them; this is the queryable form)
         self.last_proposals: List = []
+        #: per-sweep pod cache (see balance()); initialized here so
+        #: direct _process_pool calls work too
+        self._sweep_cache: Dict[str, tuple] = {}
 
     # -- usage gathering (reference: utilization_util.go getNodeUsage) -----
     def _gather(self, pool: NodePool, snapshot: ClusterSnapshot,
@@ -126,11 +129,12 @@ class LowNodeLoad(BalancePlugin):
         if self.args.paused:
             return
         self.last_proposals = []
-        #: per-sweep pod cache: uid -> (static sort prefix, request
-        #: vector). Pod specs are immutable within one sweep, so the
-        #: static key parts and the request lowering are computed once
-        #: per pod instead of once per comparator/filter call.
-        self._sweep_cache: Dict[str, tuple] = {}
+        # per-sweep pod cache: uid -> (static sort prefix, request
+        # vector). Pod specs are immutable within one sweep, so the
+        # static key parts and the request lowering are computed once
+        # per pod instead of once per comparator/filter call; cleared
+        # here so stale snapshots don't pin memory between sweeps.
+        self._sweep_cache = {}
         processed: set = set()
         for pool in self.args.node_pools:
             self._process_pool(pool, snapshot, evictor, processed)

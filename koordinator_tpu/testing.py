@@ -143,3 +143,23 @@ def assert_full_identity(sharded, single, n_devices=8):
     )
     assert len(sharded.node_state.used_req.devices()) == n_devices
     assert int(np.asarray(sharded.commit).sum()) > 0
+
+
+def example_resv(n_resv, n_nodes, n_pods, seed=9):
+    """A random-but-seeded reservation table (shared by the sharded
+    kernel tests and the driver dryrun so the two can't drift)."""
+    import jax.numpy as jnp
+
+    from koordinator_tpu.apis.extension import NUM_RESOURCES
+    from koordinator_tpu.ops.binpack import ResvArrays
+
+    rng = np.random.default_rng(seed)
+    free = np.zeros((n_resv, NUM_RESOURCES), np.int32)
+    free[:, 0] = rng.integers(500, 60000, n_resv)
+    free[:, 1] = rng.integers(0, 8192, n_resv)
+    return ResvArrays(
+        node=jnp.asarray(rng.integers(0, n_nodes, n_resv).astype(np.int32)),
+        free=jnp.asarray(free),
+        allocate_once=jnp.asarray(rng.uniform(size=n_resv) < 0.4),
+        match=jnp.asarray(rng.uniform(size=(n_pods, n_resv)) < 0.3),
+    )

@@ -173,27 +173,18 @@ def test_four_device_resv_identity():
     included. Shape kept small (4 devices x 256 nodes x 64 pods) so the
     interpret-mode remote-DMA emulation finishes in ordinary per-test
     budgets — cross-shard exchange is fully exercised at any K >= 2."""
-    from koordinator_tpu.ops.binpack import ResvArrays
     from koordinator_tpu.ops.gang import GangState
+    from koordinator_tpu.testing import example_resv
 
     n_nodes, n_pods, n_resv, n_gangs = 256, 64, 9, 4
     state, pods, params = _example_problem(n_nodes, n_pods, seed=13)
-    rng = np.random.default_rng(13)
     gang_id = np.full(n_pods, -1, np.int32)
     gang_id[: n_gangs * 8] = np.repeat(
         np.arange(n_gangs, dtype=np.int32), 8
     )
     pods = pods._replace(gang_id=jnp.asarray(gang_id))
     gstate = GangState.build(min_member=[8] * n_gangs)
-    free = np.zeros((n_resv, NUM_RESOURCES), np.int32)
-    free[:, ResourceName.CPU] = rng.integers(500, 60000, n_resv)
-    free[:, ResourceName.MEMORY] = rng.integers(0, 8192, n_resv)
-    resv = ResvArrays(
-        node=jnp.asarray(rng.integers(0, n_nodes, n_resv).astype(np.int32)),
-        free=jnp.asarray(free),
-        allocate_once=jnp.asarray(rng.uniform(size=n_resv) < 0.4),
-        match=jnp.asarray(rng.uniform(size=(n_pods, n_resv)) < 0.3),
-    )
+    resv = example_resv(n_resv, n_nodes, n_pods, seed=13)
     single = jax.jit(
         lambda s, p, pr, g, r: solve_batch(
             s, p, pr, SolverConfig(), None, g, resv=r
