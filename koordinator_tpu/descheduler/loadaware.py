@@ -132,12 +132,16 @@ class LowNodeLoad(BalancePlugin):
         # per-sweep pod cache: uid -> (static sort prefix, request
         # vector). Pod specs are immutable within one sweep, so the
         # static key parts and the request lowering are computed once
-        # per pod instead of once per comparator/filter call; cleared
-        # here so stale snapshots don't pin memory between sweeps.
+        # per pod instead of once per comparator/filter call. Cleared
+        # again after the sweep so a finished (or never-again-invoked)
+        # plugin doesn't pin the last snapshot's per-pod data.
         self._sweep_cache = {}
-        processed: set = set()
-        for pool in self.args.node_pools:
-            self._process_pool(pool, snapshot, evictor, processed)
+        try:
+            processed: set = set()
+            for pool in self.args.node_pools:
+                self._process_pool(pool, snapshot, evictor, processed)
+        finally:
+            self._sweep_cache = {}
 
     def _pod_cached(self, pod) -> tuple:
         """(pod_sort_static prefix, request vector) for this sweep."""

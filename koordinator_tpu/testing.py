@@ -150,13 +150,13 @@ def example_resv(n_resv, n_nodes, n_pods, seed=9):
     kernel tests and the driver dryrun so the two can't drift)."""
     import jax.numpy as jnp
 
-    from koordinator_tpu.apis.extension import NUM_RESOURCES
+    from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
     from koordinator_tpu.ops.binpack import ResvArrays
 
     rng = np.random.default_rng(seed)
     free = np.zeros((n_resv, NUM_RESOURCES), np.int32)
-    free[:, 0] = rng.integers(500, 60000, n_resv)
-    free[:, 1] = rng.integers(0, 8192, n_resv)
+    free[:, ResourceName.CPU] = rng.integers(500, 60000, n_resv)
+    free[:, ResourceName.MEMORY] = rng.integers(0, 8192, n_resv)
     return ResvArrays(
         node=jnp.asarray(rng.integers(0, n_nodes, n_resv).astype(np.int32)),
         free=jnp.asarray(free),

@@ -328,9 +328,21 @@ def _drive(seed: int, rounds: int = 60) -> dict:
     return stats
 
 
+#: drive results by seed — the aggregate check reuses the per-seed
+#: test runs instead of re-running all eight drives (halves the
+#: module's wall time)
+_DRIVE_STATS: dict = {}
+
+
+def _drive_cached(seed: int) -> dict:
+    if seed not in _DRIVE_STATS:
+        _DRIVE_STATS[seed] = _drive(seed)
+    return _DRIVE_STATS[seed]
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_fuzz_drive(seed):
-    stats = _drive(seed)
+    stats = _drive_cached(seed)
     assert stats["placed"] > 5  # the drive genuinely scheduled work
 
 
@@ -340,7 +352,7 @@ def test_fuzz_coverage_aggregate():
     total = {"placed": 0, "migrated": 0, "gangs": 0, "deleted": 0,
              "cordons": 0, "reservations": 0, "resv_consumed": 0}
     for seed in range(8):
-        stats = _drive(seed)
+        stats = _drive_cached(seed)
         for k in total:
             total[k] += stats[k]
     assert total["placed"] > 100
