@@ -630,26 +630,13 @@ class PlacementModel:
         if plain and 0 < n * p <= self.host_fallback_cells:
             self.last_solver = "host"
             return self._host_solve(state, batch)
-        from koordinator_tpu.ops.pallas_binpack import pallas_resv_supported
+        from koordinator_tpu.ops.pallas_binpack import pallas_routing_ok
 
-        kernel_ok = (
-            extras is None
-            and (
-                resv_arrays is None
-                or (
-                    pallas_resv_supported(
-                        int(resv_arrays.node.shape[0]), n
-                    )
-                    # score-budget pre-check from _build_resv's host pass
-                    and resv_kernel_safe
-                )
-            )
-            # empty solves take solve_batch's shape early-out; they must
-            # not trip the kernel's fallback breaker
-            and state.alloc.shape[0] > 0
-            and batch.req.shape[0] > 0
-            # the kernel's packed argmax carries the lane in 16 bits
-            and state.alloc.shape[0] <= 65536
+        # the shared dispatch predicate (shape bounds, numa/reservation
+        # gates — same one the solver sidecar uses); resv_kernel_safe is
+        # _build_resv's host-side score-budget pre-check
+        kernel_ok = pallas_routing_ok(
+            state, batch, extras, resv_arrays, resv_kernel_safe, numa_aux
         )
         if kernel_ok and self.use_pallas and self._pallas_eligible:
             from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch

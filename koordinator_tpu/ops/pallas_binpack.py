@@ -879,6 +879,38 @@ def pallas_resv_supported(n_resv: int, n_nodes: int) -> bool:
     return vp <= 256 and vp * np_ * 4 <= 8 * 2**20
 
 
+def pallas_routing_ok(state, pods, extras, resv, resv_score_safe=True,
+                      numa_aux=None) -> bool:
+    """Shared kernel-eligibility predicate for the dispatch layers (the
+    in-process PlacementModel and the solver sidecar) — shape bounds,
+    feature support, and the reservation gates, so the two routers
+    cannot drift. Deliberately EXCLUDES ``pallas_supported(params,
+    config)``: that check reads the params arrays (a device->host sync
+    on the hot path), so callers evaluate it once on host data and
+    cache the verdict."""
+    n = int(state.alloc.shape[0])
+    return (
+        extras is None
+        # empty solves take the scan's shape early-out; they must not
+        # trip a caller's kernel breaker
+        and 0 < n <= 65536  # the packed argmax carries the lane in 16 bits
+        and pods.req.shape[0] > 0
+        # a numa request without node inventories is a per-request input
+        # problem (both solvers reject it), not a kernel failure
+        and (
+            numa_aux is None
+            or (state.numa_cap is not None and state.numa_free is not None)
+        )
+        and (
+            resv is None
+            or (
+                pallas_resv_supported(int(resv.node.shape[0]), n)
+                and resv_score_safe
+            )
+        )
+    )
+
+
 def pallas_resv_score_safe(node, free, alloc) -> bool:
     """The packed single-reduction argmax budgets 15 bits for the score
     (``score << 16`` must stay positive in int32). Without reservations

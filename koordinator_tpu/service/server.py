@@ -58,6 +58,66 @@ POD_FIELDS = (
 #: one jit cache for every connection (static config hashes per value)
 _jit_solve = jax.jit(solve_batch, static_argnames=("config",))
 
+#: kernel routing breaker, mirroring PlacementModel.use_pallas: None =
+#: decide at first solve (single TPU chip => on), False after any
+#: kernel error (visible via warning, never a silent slow path).
+#: KTPU_SOLVER_PALLAS=1 forces it on (interpret mode off-TPU — tests),
+#: =0 disables it.
+_pallas_enabled: list = [None]
+
+
+def _pallas_routing_on() -> bool:
+    if _pallas_enabled[0] is None:
+        import os
+
+        forced = os.environ.get("KTPU_SOLVER_PALLAS")
+        if forced is not None:
+            _pallas_enabled[0] = forced != "0"
+        else:
+            devices = jax.devices()
+            _pallas_enabled[0] = (
+                len(devices) == 1 and devices[0].platform == "tpu"
+            )
+    return _pallas_enabled[0]
+
+
+def _dispatch_solve(state, pods, params, config, quota, gang, extras,
+                    resv, numa, resv_score_safe: bool, params_ok: bool):
+    """Route eligible solves onto the pallas kernel (bit-identical,
+    ~2-3x on TPU — the same routing the in-process PlacementModel does);
+    everything else takes the scan with its AOT warm-start cache.
+    ``resv_score_safe`` and ``params_ok`` are precomputed from the WIRE
+    numpy arrays so the hot path pays no device->host sync."""
+    from koordinator_tpu.ops.pallas_binpack import pallas_routing_ok
+
+    kernel_ok = (
+        _pallas_routing_on()
+        and params_ok
+        and pallas_routing_ok(
+            state, pods, extras, resv, resv_score_safe, numa
+        )
+    )
+    if kernel_ok:
+        from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+        try:
+            return pallas_solve_batch(
+                state, pods, params, config, quota, gang, numa, resv,
+                resv_score_checked=True,
+            )
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"solver sidecar pallas kernel disabled after error: "
+                f"{type(e).__name__}: {e}",
+                RuntimeWarning,
+            )
+            _pallas_enabled[0] = False
+    return _cached_solve(
+        state, pods, params, config, quota, gang, extras, resv, numa
+    )
+
 #: AOT warm-start: compiled executables persisted across process
 #: restarts (utils/compilation_cache.ExecutableCache) — a respawned
 #: sidecar's first solve deserializes instead of re-tracing+compiling
@@ -168,13 +228,34 @@ def solve_from_request(req: SolveRequest,
         )
         if req.config is not None:
             config = _decode_config(req.config)
-        result = _cached_solve(
+        # kernel-eligibility verdicts from the WIRE numpy arrays — free,
+        # before anything lands on device; skipped entirely when routing
+        # is off (CPU sidecar, tripped breaker)
+        resv_score_safe = True
+        params_ok = False
+        if _pallas_routing_on():
+            from koordinator_tpu.ops.pallas_binpack import (
+                pallas_resv_score_safe,
+                pallas_supported,
+            )
+
+            params_ok = pallas_supported(
+                ScoreParams(**{k: req.params[k] for k in
+                               ScoreParams._fields}), config
+            )
+            if req.resv is not None:
+                resv_score_safe = pallas_resv_score_safe(
+                    req.resv["node"], req.resv["free"], req.node["alloc"]
+                )
+        result = _dispatch_solve(
             state, pods, params, config,
             _state_group(QuotaState, req.quota),
             _state_group(GangState, req.gang),
             _state_group(Extras, req.extras),
             _state_group(ResvArrays, req.resv),
             _state_group(NumaAux, req.numa),
+            resv_score_safe,
+            params_ok,
         )
         opt = lambda a: None if a is None else np.asarray(a)
         return SolveResponse(

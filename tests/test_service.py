@@ -234,3 +234,58 @@ class TestSharedSecret:
                     client.solve(_problem())
         finally:
             service.stop()
+
+
+class TestKernelRouting:
+    def test_forced_pallas_matches_scan_path(self, monkeypatch):
+        """KTPU_SOLVER_PALLAS=1 routes the sidecar's solve onto the
+        pallas kernel (interpret mode off-TPU) — responses must be
+        byte-identical to the scan path, reservation outputs included."""
+        import koordinator_tpu.service.server as server
+
+        rng = np.random.default_rng(7)
+        req = _problem(n_nodes=40, n_pods=24)
+        # give the solve a reservation table so the kernel's newest
+        # path crosses the wire too
+        n_resv = 3
+        free = np.zeros((n_resv, NUM_RESOURCES), np.int32)
+        free[:, R.CPU] = rng.integers(2000, 9000, n_resv)
+        req.resv = {
+            "node": rng.integers(0, 40, n_resv).astype(np.int32),
+            "free": free,
+            "allocate_once": rng.uniform(size=n_resv) < 0.5,
+            "match": rng.uniform(size=(24, n_resv)) < 0.5,
+        }
+
+        def run(flag):
+            monkeypatch.setenv("KTPU_SOLVER_PALLAS", flag)
+            monkeypatch.setattr(server, "_pallas_enabled", [None])
+            return solve_from_request(req)
+
+        kern = run("1")
+        scan = run("0")
+        assert not kern.error and not scan.error
+        for field in ("assignments", "node_used_req", "commit", "waiting",
+                      "rejected", "raw_assign", "resv_vstar", "resv_delta"):
+            np.testing.assert_array_equal(
+                getattr(kern, field), getattr(scan, field), err_msg=field)
+        assert (kern.resv_vstar >= 0).sum() > 0  # reservations consumed
+
+    def test_kernel_error_trips_breaker_not_request(self, monkeypatch):
+        """A kernel failure falls back to the scan for THAT request and
+        disables routing afterwards — never an error response."""
+        import koordinator_tpu.service.server as server
+
+        monkeypatch.setenv("KTPU_SOLVER_PALLAS", "1")
+        monkeypatch.setattr(server, "_pallas_enabled", [None])
+
+        def boom(*a, **kw):
+            raise RuntimeError("kernel exploded")
+
+        import koordinator_tpu.ops.pallas_binpack as pb
+
+        monkeypatch.setattr(pb, "pallas_solve_batch", boom)
+        with pytest.warns(RuntimeWarning, match="disabled after error"):
+            resp = solve_from_request(_problem())
+        assert not resp.error
+        assert server._pallas_enabled[0] is False  # breaker tripped
