@@ -93,9 +93,10 @@ class LowNodeLoad(BalancePlugin):
         #: dry-run mode: the would-be evictions of the last balance pass,
         #: in order (the reference logs them; this is the queryable form)
         self.last_proposals: List = []
-        #: per-sweep pod cache (see balance()); initialized here so
-        #: direct _process_pool calls work too
+        #: per-snapshot pod cache (see _process_pool); initialized here
+        #: so direct _process_pool calls work too
         self._sweep_cache: Dict[str, tuple] = {}
+        self._cache_snapshot = None
 
     # -- usage gathering (reference: utilization_util.go getNodeUsage) -----
     def _gather(self, pool: NodePool, snapshot: ClusterSnapshot,
@@ -129,19 +130,15 @@ class LowNodeLoad(BalancePlugin):
         if self.args.paused:
             return
         self.last_proposals = []
-        # per-sweep pod cache: uid -> (static sort prefix, request
-        # vector). Pod specs are immutable within one sweep, so the
-        # static key parts and the request lowering are computed once
-        # per pod instead of once per comparator/filter call. Cleared
-        # again after the sweep so a finished (or never-again-invoked)
-        # plugin doesn't pin the last snapshot's per-pod data.
-        self._sweep_cache = {}
         try:
             processed: set = set()
             for pool in self.args.node_pools:
                 self._process_pool(pool, snapshot, evictor, processed)
         finally:
+            # release the per-snapshot cache so a finished (or
+            # never-again-invoked) plugin doesn't pin pod data
             self._sweep_cache = {}
+            self._cache_snapshot = None
 
     def _pod_cached(self, pod) -> tuple:
         """(pod_sort_static prefix, request vector) for this sweep."""
@@ -153,6 +150,14 @@ class LowNodeLoad(BalancePlugin):
 
     def _process_pool(self, pool: NodePool, snapshot: ClusterSnapshot,
                       evictor: Evictor, processed: set) -> None:
+        # pod cache: uid -> (static sort prefix, request vector). Pod
+        # specs are immutable for a given snapshot object, so the
+        # static key parts and the request lowering are computed once
+        # per pod instead of once per comparator/filter call; a NEW
+        # snapshot (direct _process_pool callers included) resets it.
+        if self._cache_snapshot is not snapshot:
+            self._sweep_cache = {}
+            self._cache_snapshot = snapshot
         nodes, usage, alloc, fresh, schedulable = self._gather(
             pool, snapshot, processed
         )
