@@ -26,6 +26,11 @@ reports ``{pods_per_sec, p99_s, identical_to_oracle}``:
 8. (extra) full-features flagship leg — quota + strict gangs + NUMA +
    reservations fused in one 5k x 10k solve, oracle-identical on every
    mutated carry;
+9. (extra) steady-state churn ticks — a 5k-node typed snapshot under a
+   50-dirty-row/tick mutation stream, scheduled through the
+   delta-staging path (ClusterDeltaTracker + StagedStateCache) vs full
+   restage, tick-for-tick identical, with lower/stage/solve walls
+   broken out (every other leg records the same breakdown);
 plus a ``sharded`` entry: multi-device solve throughput when >1 device
 is attached — the sharded PALLAS kernel (per-shard VMEM carry,
 in-kernel per-pod cross-shard winner merge) vs the GSPMD scan, winner
@@ -56,7 +61,9 @@ Env knobs: KTPU_BENCH_NODES, KTPU_BENCH_PODS, KTPU_BENCH_REPEATS,
 KTPU_BENCH_MATRIX=0 to skip the matrix (flagship only),
 KTPU_BENCH_SHARDED=0 to skip the sharded/dryrun entry,
 KTPU_BENCH_PALLAS=0 to disable the pallas kernel legs (scan only),
-KTPU_BENCH_ORACLE=0 to skip the full-shape oracle identity legs.
+KTPU_BENCH_ORACLE=0 to skip the full-shape oracle identity legs,
+KTPU_BENCH_CHURN_NODES / _CHURN_DIRTY / _CHURN_TICKS to reshape the
+churn-tick leg.
 """
 
 import json
@@ -108,10 +115,35 @@ def _p99(fn, args, rounds):
     return _lat_stats(fn, args, rounds)[1]
 
 
+#: host-build + staging walls of the most recent _problem call — every
+#: leg folds these into its JSON as lower_s/stage_s beside its solve_s,
+#: so staging-path wins are visible in the bench trajectory
+_LAST_PROBLEM_TIMES = {"lower_s": 0.0, "stage_s": 0.0}
+
+
 def _problem(n_nodes, n_pods, seed=1):
+    import jax
+
     from __graft_entry__ import _example_problem
 
-    return _example_problem(n_nodes, n_pods, seed=seed)
+    t0 = time.time()
+    state, pods, params = _example_problem(n_nodes, n_pods, seed=seed)
+    t1 = time.time()
+    jax.block_until_ready((state, pods, params))
+    _LAST_PROBLEM_TIMES["lower_s"] = t1 - t0
+    _LAST_PROBLEM_TIMES["stage_s"] = time.time() - t1
+    return state, pods, params
+
+
+def _leg_times(solve_s, lower_s=None, stage_s=None):
+    """The per-leg wall breakdown every matrix entry reports."""
+    return {
+        "lower_s": _LAST_PROBLEM_TIMES["lower_s"] if lower_s is None
+        else lower_s,
+        "stage_s": _LAST_PROBLEM_TIMES["stage_s"] if stage_s is None
+        else stage_s,
+        "solve_s": solve_s,
+    }
 
 
 def _oracle_args(state, pods, params):
@@ -192,6 +224,7 @@ def bench_flagship(repeats):
         "solver": solver_name,
         "p99_round_s": p99_s,
         "wall_s": best,
+        **_leg_times(best),
         "scheduled": scheduled,
         "n_nodes": n_nodes,
         "n_pods": n_pods,
@@ -267,6 +300,7 @@ def bench_fit_with_oracle(repeats, n_nodes=20, n_pods=100):
     return {
         "pods_per_sec": n_pods / routed_best,
         "p99_s": p99_s,
+        **_leg_times(routed_best),
         "identical_to_oracle": identical,
         "solver": "host" if routed_host else "device",
         "device_pods_per_sec": n_pods / best,
@@ -291,6 +325,7 @@ def bench_loadaware(repeats):
         "pods_per_sec": 2000 / best,
         "p99_s": p99_s,
         "wall_s": best,
+        **_leg_times(best),
     }
     if _oracle_enabled():
         # full-shape identity through the vectorized host oracle
@@ -402,6 +437,7 @@ def bench_quota(repeats):
         "solver": solver,
         "wall_s": best,
         "placed": placed,
+        **_leg_times(best),
     }
     if _oracle_enabled():
         # full-shape oracle identity (full quota semantics incl. admission);
@@ -460,6 +496,7 @@ def bench_gang(repeats):
         "wall_s": best,
         "committed": committed,
         "gangs": n_gangs,
+        **_leg_times(best),
     }
     if _oracle_enabled():
         from koordinator_tpu.oracle.vectorized import (
@@ -532,6 +569,7 @@ def bench_numa(repeats):
         "scan_pods_per_sec": n_pods / scan_best,
         "wall_s": best,
         "consumed": int(np.asarray(out[1]).sum()),
+        **_leg_times(best),
     }
     if _oracle_enabled():
         # reference-semantics check at full shape (VERDICT r4 #2): the
@@ -589,6 +627,7 @@ def bench_fit_16k(repeats):
         "kernel_vs_scan": kvs,  # "identical" | "DIVERGED" | "not_run"
         "n_nodes": n_nodes,
         "wall_s": best,
+        **_leg_times(best),
     }
     if _oracle_enabled():
         # reference-semantics identity at the full 16k-node shape
@@ -742,6 +781,7 @@ def bench_full_features(repeats):
         "wall_s": best,
         "placed": int((np.asarray(out[0]) >= 0).sum()),
         "features": "quota+gang+numa+reservation",
+        **_leg_times(best),
     }
     if _oracle_enabled():
         t0 = time.time()
@@ -768,6 +808,150 @@ def bench_full_features(repeats):
     return result
 
 
+def bench_churn_tick(repeats):
+    """Config #9 (PR 6): steady-state scheduling ticks over an EVOLVING
+    cluster — the workload the incremental staging layer exists for.
+
+    A 5k-node typed snapshot with ~2 assigned pods/node and full metric
+    coverage takes a small per-tick mutation stream (50 nodes' metrics
+    refreshed + the previous tick's binds) and schedules a 64-pod
+    pending queue each tick. Run twice from identical seeds: once
+    full-restage (no delta tracker: every tick re-lowers and re-uploads
+    the world — the pre-PR-6 behavior) and once through the
+    delta-staging path (ClusterDeltaTracker + StagedStateCache: dirty
+    rows re-lowered on host, donated device scatter). Assignments must
+    match tick-for-tick (``identical_to_full_restage``); the acceptance
+    bar is delta ticks >= 3x full-restage ticks on wall time with the
+    lower/stage/solve breakdown recorded for both paths."""
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot,
+        NodeMetric,
+        NodeSpec,
+        PodSpec,
+    )
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.state.cluster import ClusterDeltaTracker
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    n_nodes = int(os.environ.get("KTPU_BENCH_CHURN_NODES",
+                                 os.environ.get("KTPU_BENCH_NODES", 5000)))
+    dirty_per_tick = int(os.environ.get("KTPU_BENCH_CHURN_DIRTY", 50))
+    pending_per_tick = 64
+    # floor 3: ticks 0-1 are warmup-excluded, at least one must be timed
+    ticks = max(3, int(os.environ.get("KTPU_BENCH_CHURN_TICKS",
+                                      max(6, min(repeats * 4, 12)))))
+
+    def build(with_tracker):
+        rng = np.random.default_rng(42)
+        nodes = [
+            NodeSpec(name=f"n{i}", allocatable={CPU: 64000, MEM: 131072})
+            for i in range(n_nodes)
+        ]
+        pods = []
+        for j in range(2 * n_nodes):
+            node_i = int(rng.integers(0, n_nodes))
+            pods.append(PodSpec(
+                name=f"a{j}", node_name=f"n{node_i}", assign_time=5.0,
+                requests={CPU: int(rng.integers(200, 2000)),
+                          MEM: int(rng.integers(128, 2048))},
+            ))
+        metrics = {
+            f"n{i}": NodeMetric(
+                node_name=f"n{i}",
+                node_usage={CPU: int(rng.integers(500, 30000)),
+                            MEM: int(rng.integers(512, 65536))},
+                update_time=10.0,
+            )
+            for i in range(n_nodes)
+        }
+        tracker = ClusterDeltaTracker() if with_tracker else None
+        snap = ClusterSnapshot(
+            nodes=nodes, pods=pods, pending_pods=[],
+            node_metrics=metrics, now=20.0, delta_tracker=tracker,
+        )
+        return snap, tracker
+
+    def run(with_tracker):
+        snap, tracker = build(with_tracker)
+        model = PlacementModel(config=SolverConfig(unroll=BENCH_UNROLL))
+        rng = np.random.default_rng(7)
+        walls = []
+        sums = {"lower_s": 0.0, "stage_s": 0.0, "solve_s": 0.0}
+        log = []
+        for t in range(ticks):
+            now = 20.0 + t
+            for i in rng.choice(n_nodes, dirty_per_tick, replace=False):
+                name = f"n{int(i)}"
+                old = snap.node_metrics[name]
+                snap.node_metrics[name] = NodeMetric(
+                    node_name=name,
+                    node_usage={CPU: int(rng.integers(500, 30000)),
+                                MEM: int(rng.integers(512, 65536))},
+                    update_time=now,
+                    pod_usages=old.pod_usages,
+                )
+                if tracker is not None:
+                    tracker.mark_node(name)
+            snap.pending_pods = [
+                PodSpec(
+                    name=f"t{t}p{j}",
+                    requests={CPU: int(rng.integers(200, 1500)),
+                              MEM: int(rng.integers(128, 1024))},
+                )
+                for j in range(pending_per_tick)
+            ]
+            snap.now = now
+            by_uid = {p.uid: p for p in snap.pending_pods}
+            t0 = time.time()
+            result = model.schedule(snap)
+            wall = time.time() - t0
+            if t > 1:  # ticks 0-1 pay solve + scatter compiles and the
+                walls.append(wall)  # cold full stage: steady state only
+                for k in sums:
+                    sums[k] += model.last_timings[k]
+            log.append(sorted(result.items()))
+            for uid, node in result.items():
+                if node is not None:
+                    pod = by_uid[uid]
+                    pod.node_name = node
+                    pod.assign_time = now
+                    snap.pods.append(pod)
+                    if tracker is not None:
+                        tracker.mark_node(node)
+        n = max(1, len(walls))
+        return {
+            "tick_wall_s": sum(walls) / n,
+            "ticks_per_sec": n / sum(walls),
+            **{k: v / n for k, v in sums.items()},
+        }, log
+
+    full, full_log = run(False)
+    delta, delta_log = run(True)
+    return {
+        "ticks_per_sec": delta["ticks_per_sec"],
+        "full_restage_ticks_per_sec": full["ticks_per_sec"],
+        "speedup_vs_full_restage": (
+            full["tick_wall_s"] and delta["tick_wall_s"]
+            and full["tick_wall_s"] / delta["tick_wall_s"]
+        ),
+        "tick_wall_s": delta["tick_wall_s"],
+        "full_tick_wall_s": full["tick_wall_s"],
+        "lower_s": delta["lower_s"],
+        "stage_s": delta["stage_s"],
+        "solve_s": delta["solve_s"],
+        "full_lower_s": full["lower_s"],
+        "full_stage_s": full["stage_s"],
+        "full_solve_s": full["solve_s"],
+        "identical_to_full_restage": full_log == delta_log,
+        "n_nodes": n_nodes,
+        "dirty_per_tick": dirty_per_tick,
+        "pending_per_tick": pending_per_tick,
+        "ticks": ticks,
+    }
+
+
 def bench_rebalance(repeats):
     """Config #5: the COMPLETE descheduler LowNodeLoad Balance pass at
     5k nodes / 30k running pods — classification + debounce + node sort
@@ -792,6 +976,7 @@ def bench_rebalance(repeats):
 
     CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
     n_nodes, n_pods = 5000, 30000
+    t_build0 = time.time()
     rng = np.random.default_rng(5)
     # skewed pod placement (squared uniform) so a tail of nodes crosses
     # the high threshold; node usage = Σ pod usage + a system share
@@ -839,6 +1024,7 @@ def bench_rebalance(repeats):
         low_thresholds={CPU: 45, MEM: 60},
         high_thresholds={CPU: 65, MEM: 80},
     )])
+    build_s = time.time() - t_build0
 
     class RecordingEvictor(Evictor):
         def _do_evict(self, snapshot, pod, reason):
@@ -865,6 +1051,8 @@ def bench_rebalance(repeats):
         "pods": n_pods,
         "evictions": len(state["seq"]),
         "scope": "full sweep: classify+debounce+sort+victims+headroom",
+        # host-only sweep: lower = snapshot build, nothing stages
+        **_leg_times(best, lower_s=build_s, stage_s=0.0),
     }
     if _oracle_enabled():
         t0 = time.time()
@@ -896,8 +1084,12 @@ def bench_sharded(repeats):
         sstate = shard_node_state(state, mesh)
         scan = shard_solver(mesh, SolverConfig(unroll=BENCH_UNROLL))
         scan_fn = lambda s, p, pr: scan(s, p, pr)
+        from koordinator_tpu.parallel.mesh import (
+            distributed_kernel_supported,
+        )
+
         kern_fn = None
-        if devices[0].platform == "tpu":
+        if devices[0].platform == "tpu" and distributed_kernel_supported():
             # sharded pallas kernel: per-shard VMEM carry, in-kernel
             # per-pod cross-shard winner merge over remote DMAs
             ksolve = shard_kernel_solver(mesh, SolverConfig())
@@ -927,6 +1119,7 @@ def bench_sharded(repeats):
             "kernel_vs_scan": kvs,
             "p99_s": p99_s,
             "warmup_s": warmup,
+            **_leg_times(best),
         }
     t0 = time.time()
     try:
@@ -1086,6 +1279,7 @@ def main():
         matrix["6_numa_3kx1500"] = leg(bench_numa, repeats)
         matrix["7_fit_16k_nodes"] = leg(bench_fit_16k, repeats)
         matrix["8_full_features_5kx10k"] = leg(bench_full_features, repeats)
+        matrix["9_churn_tick_5k"] = leg(bench_churn_tick, repeats)
     if os.environ.get("KTPU_BENCH_SHARDED", "1") != "0":
         matrix["sharded"] = leg(bench_sharded, repeats)
     if os.environ.get("KTPU_BENCH_WARMPROBE", "1") != "0":

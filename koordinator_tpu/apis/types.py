@@ -379,3 +379,16 @@ class ClusterSnapshot:
     reservations: List[ReservationSpec] = dataclasses.field(default_factory=list)
     devices: Dict[str, NodeDevice] = dataclasses.field(default_factory=dict)
     now: float = 0.0
+    #: optional state.cluster.ClusterDeltaTracker the snapshot producer
+    #: maintains — lets the model's staging cache re-lower only the node
+    #: rows events touched instead of the world (None = full relower)
+    delta_tracker: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    #: the tracker's epoch AT SNAPSHOT TIME (captured under the
+    #: producer's lock): the staging cache syncs to this, not to the
+    #: live epoch, so a mutation racing between snapshot() and the
+    #: solve is re-lowered next tick instead of silently lost
+    delta_epoch: Optional[int] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )

@@ -36,6 +36,11 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--once", action="store_true",
                         help="start, report readiness, and exit (smoke)")
+    parser.add_argument(
+        "--debug-port", type=int, default=None,
+        help="serve /apis/v1/plugins/solver (routing + kernel-breaker "
+             "state) and /healthz on this port",
+    )
     args = parser.parse_args(argv)
 
     # before the first jit: a restarted sidecar deserializes its
@@ -54,6 +59,18 @@ def main(argv=None) -> int:
             secret = f.read().strip()
     service = PlacementService(parse_address(args.listen), secret=secret)
     service.start()
+    debug_server = None
+    if args.debug_port is not None:
+        from koordinator_tpu.scheduler.monitor import DebugServices
+        from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+        services = DebugServices()
+        # the solver's operational state — notably the kernel-routing
+        # breaker, so "why is this sidecar riding the scan?" is one GET
+        services.register("solver", service.status)
+        debug_server = DebugHTTPServer(
+            services=services, port=args.debug_port
+        ).start()
     print(f"koord-solver: serving on {args.listen}")
     try:
         if args.once:
@@ -64,6 +81,8 @@ def main(argv=None) -> int:
         return 0
     finally:
         service.stop()
+        if debug_server is not None:
+            debug_server.stop()
 
 
 if __name__ == "__main__":

@@ -166,6 +166,9 @@ class MigrationController:
             reservation.node_name = node
             reservation.state = ReservationState.AVAILABLE
             snapshot.reservations.append(reservation)
+            tracker = getattr(snapshot, "delta_tracker", None)
+            if tracker is not None:
+                tracker.mark_node(node)
             job.reservation_name = reservation.name
             job.phase = MigrationPhase.RUNNING
 

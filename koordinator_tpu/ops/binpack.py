@@ -176,6 +176,56 @@ class NumaAux(NamedTuple):
     node_policy: jnp.ndarray  # [N] bool — node declares a topology policy
 
 
+#: the NodeState columns a staged-state delta update rewrites (the
+#: numa inventories ride the fine-grained path, which always restages)
+STAGED_NODE_FIELDS = (
+    "alloc", "used_req", "usage", "prod_usage", "est_extra", "prod_base",
+    "metric_fresh", "schedulable",
+)
+
+
+def scatter_node_rows(state: NodeState, idx, rows) -> NodeState:
+    """Write the re-lowered rows of the dirty nodes into a staged
+    ``NodeState`` at ``idx`` — the device half of incremental staging
+    (state/cluster.lower_nodes_delta is the host half). ``rows`` maps
+    each :data:`STAGED_NODE_FIELDS` name to its ``[D, ...]`` update.
+
+    Callers jit this with ``donate_argnums=(0,)`` (see
+    :data:`scatter_node_rows_donated`) so XLA double-buffers: the old
+    staged arrays are donated to the scatter and steady-state ticks
+    never re-upload the ``[N, R]`` world."""
+    updates = {
+        f: getattr(state, f).at[idx].set(rows[f])
+        for f in STAGED_NODE_FIELDS
+    }
+    return state._replace(**updates)
+
+
+#: the jitted, input-donating form every staging cache shares (one
+#: compiled program per (N, D) shape pair)
+scatter_node_rows_donated = jax.jit(scatter_node_rows, donate_argnums=(0,))
+
+
+def bucket_row_update(idx, rows):
+    """Pad a dirty-row update to a power-of-two bucket by repeating the
+    last row — identical writes land on the same index, so the scatter
+    result is unchanged while drifting dirty counts reuse one compiled
+    scatter per bucket instead of retracing per count."""
+    import numpy as np
+
+    d = int(idx.shape[0])
+    target = max(8, 1 << (d - 1).bit_length())
+    if target == d:
+        return idx, rows
+    pad = target - d
+    idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
+    rows = {
+        f: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+        for f, a in rows.items()
+    }
+    return idx, rows
+
+
 class SolveResult(NamedTuple):
     """Everything one batched solve produces.
 

@@ -217,13 +217,20 @@ def _make_kernel(R: int, wsum: int, use_quota: bool, use_numa: bool,
                 rfree = rfree_ref[...]                    # [R,Vp]
                 mfree = jnp.where(mrow > 0, rfree, 0)
                 bhot = bhot_ref[...]                      # [Vp,N] f32 0/1
+                # precision pinned HIGHEST (ADVICE r5 high): the MXU's
+                # default f32 dot rounds operands toward bfloat16 (8-bit
+                # mantissa), which would corrupt the exact hi/lo integer
+                # partials on hardware — interpret-mode CI is exact f32
+                # and cannot catch it
                 hi_s = jnp.dot(
                     (mfree >> 16).astype(jnp.float32), bhot,
                     preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
                 ).astype(jnp.int32)
                 lo_s = jnp.dot(
                     (mfree & 0xFFFF).astype(jnp.float32), bhot,
                     preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
                 ).astype(jnp.int32)
                 used_fit = used - ((hi_s << 16) + lo_s)
             else:
@@ -443,7 +450,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
                   wsum: int, interpret: bool, quota=None, numa=None,
                   most_allocated: bool = False, n_shards: int = 1,
                   axis_name: Optional[str] = None, kernel_unroll: int = 1,
-                  resv=None):
+                  resv=None, resv_onehot=None):
     """quota = None | (min[Q,R], runtime[Q,R], used[Q,R], np_used[Q,R]);
     numa = None | (cap[N,R], free[N,R], node_policy[N]);
     resv = None | (node[V], free[V,R], allocate_once[V], match[P,V]) —
@@ -588,11 +595,23 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
             (match_in & ~pods.blocked[:, None]).astype(jnp.int32)
         )
         # static reservation -> node-lane one-hot for the credit matmul;
-        # lanes are GLOBAL node ids (shard offset under shard_map)
-        lane_ids = jax.lax.broadcasted_iota(jnp.int32, (Vp, N), 1)
-        if n_shards > 1:
-            lane_ids = lane_ids + jax.lax.axis_index(axis_name) * N
-        bhot = (rn[:, None] == lane_ids).astype(jnp.float32)
+        # lanes are GLOBAL node ids (shard offset under shard_map). A
+        # caller-cached one-hot (resv_node_onehot — ADVICE r5 low #3:
+        # it depends only on the static reservation table, so repeated
+        # solves must not rebuild the up-to-8MB [Vp,N] operand) is used
+        # verbatim; the sharded path always derives it locally because
+        # its lanes carry the per-shard offset.
+        if resv_onehot is not None and n_shards == 1:
+            if resv_onehot.shape != (Vp, N):
+                raise ValueError(
+                    f"resv_onehot shape {resv_onehot.shape} != {(Vp, N)}"
+                )
+            bhot = resv_onehot
+        else:
+            lane_ids = jax.lax.broadcasted_iota(jnp.int32, (Vp, N), 1)
+            if n_shards > 1:
+                lane_ids = lane_ids + jax.lax.axis_index(axis_name) * N
+            bhot = (rn[:, None] == lane_ids).astype(jnp.float32)
         args += [rn[None, :], aonce, bhot, rfree0, match_pad]
         in_specs += [full((1, Vp)), full((1, Vp)), full((Vp, N)),
                      full((r, Vp)),
@@ -666,7 +685,8 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
 )
 def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
                 wsum: int, interpret: bool, has_gang: bool,
-                most_allocated: bool, kernel_unroll: int = 1, resv=None):
+                most_allocated: bool, kernel_unroll: int = 1, resv=None,
+                resv_onehot=None):
     """Kernel scan + the scan solver's exact post-batch epilogue (gang
     resolution, rejected releases) — one jitted program."""
     from koordinator_tpu.ops.quota import quota_runtime
@@ -686,6 +706,7 @@ def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
     new_state, assign, qused, qnp, consumed, resv_out = _pallas_solve(
         state, pods, params, wsum, interpret, quota_in, numa_in,
         most_allocated, kernel_unroll=kernel_unroll, resv=resv_in,
+        resv_onehot=resv_onehot,
     )
     final_qstate = (
         None if quota_state is None
@@ -804,6 +825,7 @@ def pallas_solve_batch(
     resv=None,
     interpret: Optional[bool] = None,
     resv_score_checked: bool = False,
+    resv_onehot=None,
 ) -> SolveResult:
     """Drop-in for ``solve_batch`` on the kernel paths (plain, quota,
     gang, NUMA, reservation, and their combinations). Raises ValueError
@@ -812,7 +834,10 @@ def pallas_solve_batch(
     ``resv_score_checked=True`` skips the per-solve
     :func:`pallas_resv_score_safe` host check for callers that already
     validated the initial table (the verdict cannot change within a
-    solve — in-kernel rfree only decreases)."""
+    solve — in-kernel rfree only decreases). ``resv_onehot`` is an
+    optional cached :func:`resv_node_onehot` of ``resv.node`` — repeat
+    solves against a static reservation table then skip rebuilding the
+    [Vp,N] credit-matmul operand per solve."""
     if not pallas_supported(params, config):
         raise ValueError("configuration not supported by the pallas kernel")
     if state.alloc.shape[0] == 0 or pods.req.shape[0] == 0:
@@ -862,7 +887,24 @@ def pallas_solve_batch(
         state, pods, params, quota_state, gang_state, numa_aux, wsum,
         interpret, gang_state is not None, bool(config.numa_most_allocated),
         kernel_unroll=int(getattr(config, "kernel_unroll", 1)), resv=resv,
+        resv_onehot=resv_onehot,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes",))
+def resv_node_onehot(node, n_nodes: int):
+    """The [Vp, Np] reservation→node-lane one-hot the in-kernel credit
+    matmul contracts against — exactly the padding math `_pallas_solve`
+    applies (tile-aligned axes, -1 rows beyond the real table so padding
+    matches no lane). Depends only on the static reservation node table,
+    so callers cache it across solves (models/placement.py) instead of
+    rebuilding up to 8 MB per solve (ADVICE r5 low #3)."""
+    v = node.shape[0]
+    vp = ((v + 127) // 128) * 128
+    n_pad = ((n_nodes + 127) // 128) * 128
+    rn = jnp.full((vp,), -1, jnp.int32).at[:v].set(node.astype(jnp.int32))
+    lane_ids = jax.lax.broadcasted_iota(jnp.int32, (vp, n_pad), 1)
+    return (rn[:, None] == lane_ids).astype(jnp.float32)
 
 
 def pallas_resv_supported(n_resv: int, n_nodes: int) -> bool:

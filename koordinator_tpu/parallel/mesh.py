@@ -30,6 +30,44 @@ from koordinator_tpu.state.cluster import NodeArrays
 NODE_AXIS = "nodes"
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: newer releases promote it
+    to the top level (``check_vma``); older ones only ship
+    ``jax.experimental.shard_map`` (``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def distributed_kernel_supported() -> bool:
+    """Whether THIS jax build can run the distributed pallas kernel:
+    real remote DMAs need ``pltpu.CompilerParams`` (collective_id +
+    side effects), and the off-TPU path additionally needs the TPU
+    interpreter's emulated remote DMAs (``pltpu.InterpretParams``).
+    Older jax (e.g. 0.4.x) ships neither — callers must fall back to
+    the GSPMD scan path (``shard_solver``/``shard_full_solver``), which
+    carries the same bit-identity contract without in-kernel
+    collectives."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:
+        return False
+
+    if not hasattr(pltpu, "CompilerParams"):
+        return False
+    if jax.devices()[0].platform == "tpu":
+        return True
+    return hasattr(pltpu, "InterpretParams")
+
+
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """1-D mesh over all (or the given) devices, axis ``nodes``."""
     devices = list(devices) if devices is not None else jax.devices()
@@ -122,6 +160,12 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
     interpreter with emulated remote DMAs — the same program, same
     synchronization, slower clock.
     """
+    if not distributed_kernel_supported():
+        raise RuntimeError(
+            "distributed pallas kernel unavailable on this jax build "
+            "(needs pltpu.CompilerParams, and pltpu.InterpretParams "
+            "off-TPU) — use shard_solver/shard_full_solver (GSPMD scan)"
+        )
     from koordinator_tpu.ops.pallas_binpack import (
         _kernel_epilogue,
         _pallas_solve,
@@ -239,7 +283,7 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
                 consumed = jnp.zeros(assign.shape[0], bool)
             return new_state, assign, qused, qnp, consumed[None, :], resv_out
 
-        body_sharded = jax.shard_map(
+        body_sharded = _shard_map(
             body, mesh=mesh,
             in_specs=(state_specs, pods_specs,
                       jax.tree.map(lambda _: rep, params),

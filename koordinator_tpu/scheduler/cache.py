@@ -23,9 +23,17 @@ from koordinator_tpu.apis.types import (
     QuotaSpec,
     ReservationSpec,
 )
+from koordinator_tpu.state.cluster import ClusterDeltaTracker
 
 
 class SchedulerCache:
+    """Every mutation marks the delta tracker with the node rows it
+    touches (the informer/cache snapshot-diff idiom): snapshots carry
+    the tracker, so the model's staging cache re-lowers only what
+    actually changed between scheduling rounds. Gang/quota updates
+    don't mark — they never enter the node arrays (lowered per solve).
+    """
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self.nodes: Dict[str, NodeSpec] = {}
@@ -36,28 +44,38 @@ class SchedulerCache:
         self.gangs: Dict[str, GangSpec] = {}
         self.quotas: Dict[str, QuotaSpec] = {}
         self.reservations: Dict[str, ReservationSpec] = {}
+        self.delta_tracker = ClusterDeltaTracker()
 
     # -- informer-style updates --------------------------------------------
 
     def add_node(self, node: NodeSpec) -> None:
         with self._lock:
+            if node.name in self.nodes:
+                # spec update in place: same node set/order, one dirty row
+                self.delta_tracker.mark_node(node.name)
+            else:
+                self.delta_tracker.mark_structure()
             self.nodes[node.name] = node
 
     def remove_node(self, name: str) -> None:
         with self._lock:
-            self.nodes.pop(name, None)
+            if self.nodes.pop(name, None) is not None:
+                self.delta_tracker.mark_structure()
 
     def add_pod(self, pod: PodSpec) -> None:
         """A pod object appeared: pending if unassigned, else running."""
         with self._lock:
             if pod.node_name:
                 self.pods[pod.uid] = pod
+                self.delta_tracker.mark_node(pod.node_name)
             else:
                 self.pending[pod.uid] = pod
 
     def remove_pod(self, uid: str) -> None:
         with self._lock:
-            self.pods.pop(uid, None)
+            pod = self.pods.pop(uid, None)
+            if pod is not None:
+                self.delta_tracker.mark_node(pod.node_name)
             self.pending.pop(uid, None)
             self.assumed.pop(uid, None)
 
@@ -67,11 +85,16 @@ class SchedulerCache:
         from pending to assigned without touching assign bookkeeping."""
         with self._lock:
             self.pending.pop(pod.uid, None)
+            prev = self.pods.get(pod.uid)
+            if prev is not None and prev.node_name != pod.node_name:
+                self.delta_tracker.mark_node(prev.node_name)
             self.pods[pod.uid] = pod
+            self.delta_tracker.mark_node(pod.node_name)
 
     def update_node_metric(self, metric: NodeMetric) -> None:
         with self._lock:
             self.node_metrics[metric.node_name] = metric
+            self.delta_tracker.mark_node(metric.node_name)
 
     def update_gang(self, spec: GangSpec) -> None:
         with self._lock:
@@ -87,7 +110,11 @@ class SchedulerCache:
             # an unset create_time with a live TTL would expire immediately
             if spec.ttl and not spec.create_time:
                 spec.create_time = time.time()
+            prev = self.reservations.get(spec.name)
+            if prev is not None and prev.node_name != spec.node_name:
+                self.delta_tracker.mark_node(prev.node_name)
             self.reservations[spec.name] = spec
+            self.delta_tracker.mark_node(spec.node_name)
 
     # -- assume / forget (reference: scheduler cache AssumePod) -------------
 
@@ -100,6 +127,7 @@ class SchedulerCache:
             pod.assign_time = now if now is not None else time.time()
             self.pods[uid] = pod
             self.assumed[uid] = pod.assign_time
+            self.delta_tracker.mark_node(node_name)
 
     def forget_pod(self, uid: str) -> None:
         """Bind failed / gang rejected: back to pending."""
@@ -107,6 +135,7 @@ class SchedulerCache:
             pod = self.pods.pop(uid, None)
             self.assumed.pop(uid, None)
             if pod is not None:
+                self.delta_tracker.mark_node(pod.node_name)
                 pod.node_name = None
                 pod.waiting_permit = False
                 self.pending[pod.uid] = pod
@@ -131,4 +160,8 @@ class SchedulerCache:
                 quotas=dict(self.quotas),
                 reservations=list(self.reservations.values()),
                 now=now if now is not None else time.time(),
+                delta_tracker=self.delta_tracker,
+                # captured under the lock: marks landing after this
+                # point carry a later epoch and re-lower next tick
+                delta_epoch=self.delta_tracker.epoch,
             )

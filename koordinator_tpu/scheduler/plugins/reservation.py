@@ -116,6 +116,9 @@ class ReservationPlugin(Plugin):
         best.allocated_pod_uids.append(pod.uid)
         if best.allocate_once:
             best.state = ReservationState.SUCCEEDED
+        tracker = getattr(snapshot, "delta_tracker", None)
+        if tracker is not None:
+            tracker.mark_node(best.node_name)
         state["reservation_allocated"] = best.name
         # remember the clamped delta actually added — unreserve must subtract
         # exactly this, not the raw request
@@ -140,4 +143,7 @@ class ReservationPlugin(Plugin):
                     resv.allocated_pod_uids.remove(pod.uid)
                 if resv.state == ReservationState.SUCCEEDED and resv.allocate_once:
                     resv.state = ReservationState.AVAILABLE
+                tracker = getattr(snapshot, "delta_tracker", None)
+                if tracker is not None:
+                    tracker.mark_node(resv.node_name)
                 break

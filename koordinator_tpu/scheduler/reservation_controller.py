@@ -46,9 +46,13 @@ class ReservationController:
 
     def sync(self, now: float) -> None:
         """One reconcile pass: expire → sync status → GC."""
+        tracker = getattr(self.cache, "delta_tracker", None)
         for resv in list(self.cache.reservations.values()):
             if self._needs_expiration(resv, now):
                 resv.state = ReservationState.EXPIRED
+                if tracker is not None:
+                    # the node stops holding the remainder: re-lower it
+                    tracker.mark_node(resv.node_name)
             if resv.state == ReservationState.AVAILABLE:
                 self._sync_status(resv)
             if resv.state in (ReservationState.EXPIRED, ReservationState.FAILED,
@@ -100,6 +104,10 @@ class ReservationController:
         allocated = np.minimum(np.where(alloc_vec > 0, allocated, 0), alloc_vec)
         resv.allocated = vector_to_resources(allocated)
         resv.allocated_pod_uids = live
+        tracker = getattr(self.cache, "delta_tracker", None)
+        if tracker is not None:
+            # released capacity changes the node's lowered hold
+            tracker.mark_node(resv.node_name)
 
     # -- GC (garbage_collection.go:40-82) -----------------------------------
 

@@ -587,6 +587,9 @@ class Scheduler:
             resv.allocated_pod_uids.remove(uid)
         if resv.allocate_once and resv.state == ReservationState.SUCCEEDED:
             resv.state = ReservationState.AVAILABLE
+        tracker = getattr(self.cache, "delta_tracker", None)
+        if tracker is not None:
+            tracker.mark_node(resv.node_name)
 
     def _account_quota(self, pod: Optional[PodSpec], release: bool = False) -> None:
         if pod is None or not pod.quota:

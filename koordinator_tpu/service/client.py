@@ -98,6 +98,10 @@ class RemoteSolver:
     per shape bucket).
     """
 
+    #: PlacementModel probes this before passing ``staging=`` — the
+    #: sidecar protocol understands incremental node staging
+    supports_staging_delta = True
+
     def __init__(self, address, secret: Optional[bytes] = None,
                  timeout: float = 120.0, retries: int = 1):
         self.address = address
@@ -105,6 +109,12 @@ class RemoteSolver:
         self.timeout = timeout
         self.retries = retries
         self._client: Optional[PlacementClient] = None
+        #: the staged-state epoch the CONNECTED sidecar holds as its
+        #: delta base (None = none established / connection lost)
+        self._server_epoch: Optional[int] = None
+        #: which wire shape the last solve used — "full", "establish"
+        #: or "delta" (observability/tests)
+        self.last_request: Optional[str] = None
 
     def _connect(self) -> PlacementClient:
         if self._client is None:
@@ -120,19 +130,28 @@ class RemoteSolver:
             except OSError:
                 pass
             self._client = None
+        # a new connection lands on a handler with an empty delta base
+        self._server_epoch = None
 
     def close(self) -> None:
         self._drop()
 
     def solve_result(self, state, batch, params, config,
                      quota_state=None, gang_state=None, extras=None,
-                     resv=None, numa=None):
+                     resv=None, numa=None, staging=None):
         """The ``solve_batch`` call over the wire; returns a
-        ``SolveResult`` with host (numpy) arrays."""
+        ``SolveResult`` with host (numpy) arrays.
+
+        ``staging`` is the model's ``(epoch, NodeStagingDelta)`` pair:
+        when the connected sidecar already holds the delta's base epoch,
+        only the dirty node rows cross the wire; otherwise the full node
+        group is sent and establishes the base for subsequent ticks. A
+        sidecar that lost the base (restart, connection churn) answers
+        ``delta-base-mismatch`` and the solve transparently re-sends the
+        full state on the same connection."""
         from koordinator_tpu.ops.binpack import SolveResult
 
-        request = SolveRequest(
-            node=_group(state),
+        common = dict(
             pods=_group(batch),
             params=_group(params),
             quota=_group(quota_state),
@@ -144,14 +163,55 @@ class RemoteSolver:
                 f: np.asarray(v) for f, v in zip(config._fields, config)
             },
         )
+
+        def build_request():
+            delta = staging[1] if staging is not None else None
+            if (
+                delta is not None
+                and delta.base_epoch is not None
+                and self._server_epoch == delta.base_epoch
+            ):
+                node_delta = {
+                    "idx": np.asarray(
+                        delta.idx if delta.idx is not None else [],
+                        np.int32,
+                    ),
+                    "base_epoch": np.asarray(delta.base_epoch, np.int64),
+                    "epoch": np.asarray(delta.epoch, np.int64),
+                }
+                node_delta.update(delta.rows or {})
+                self.last_request = "delta"
+                return SolveRequest(
+                    node={}, node_delta=node_delta, **common
+                )
+            node_delta = None
+            if staging is not None:
+                node_delta = {"epoch": np.asarray(staging[0], np.int64)}
+            self.last_request = "establish" if node_delta else "full"
+            return SolveRequest(
+                node=_group(state), node_delta=node_delta, **common
+            )
+
         last_error: Optional[Exception] = None
-        for _attempt in range(self.retries + 1):
+        conn_attempts = 0
+        mismatch_retry = True
+        while conn_attempts <= self.retries:
             try:
-                response = self._connect().solve(request)
+                response = self._connect().solve(build_request())
                 break
             except (ConnectionError, OSError, EOFError) as e:
                 last_error = e
+                conn_attempts += 1
                 self._drop()
+            except RuntimeError as e:
+                if "delta-base-mismatch" in str(e) and mismatch_retry:
+                    # the response was read cleanly — the stream is in
+                    # sync; re-send the full state on this connection
+                    mismatch_retry = False
+                    self._server_epoch = None
+                    continue
+                self._drop()
+                raise
             except Exception:
                 # protocol-level failure (e.g. a solver error response):
                 # the stream may be desynced — never reuse it, or a
@@ -163,6 +223,8 @@ class RemoteSolver:
                 f"placement sidecar at {self.address!r} unreachable: "
                 f"{type(last_error).__name__}: {last_error}"
             )
+        if staging is not None:
+            self._server_epoch = int(staging[0])
         new_state = state
         if response.node_used_req is not None:
             new_state = state._replace(used_req=response.node_used_req)

@@ -48,6 +48,15 @@ class SolveRequest:
     resv: Optional[Dict[str, np.ndarray]] = None    # ResvArrays fields
     numa: Optional[Dict[str, np.ndarray]] = None    # NumaAux fields
     config: Optional[Dict[str, np.ndarray]] = None  # SolverConfig scalars
+    #: incremental node staging (the steady-state bandwidth win): with a
+    #: full ``node`` group, ``{"epoch": k}`` asks the server to cache the
+    #: staged state as delta base k; WITHOUT a ``node`` group it carries
+    #: ``idx [D]`` + a row update per node field + ``base_epoch``/
+    #: ``epoch``, patching the server's cached base instead of
+    #: re-shipping all eight [N,R] arrays. A server that lost the base
+    #: answers with a ``delta-base-mismatch`` error and the client
+    #: re-establishes with a full request.
+    node_delta: Optional[Dict[str, np.ndarray]] = None
 
 
 @dataclasses.dataclass
@@ -106,7 +115,7 @@ def _unpack(payload: bytes) -> Dict[str, np.ndarray]:
 _REQ_GROUPS = (
     ("node", "n."), ("pods", "p."), ("params", "s."), ("quota", "q."),
     ("gang", "g."), ("extras", "x."), ("resv", "r."), ("numa", "u."),
-    ("config", "c."),
+    ("config", "c."), ("node_delta", "d."),
 )
 
 _RESP_OPTIONAL = (

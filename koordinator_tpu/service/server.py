@@ -19,13 +19,15 @@ import hmac
 import socket
 import socketserver
 import threading
-from typing import Optional
+import time
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.ops.binpack import (
+    STAGED_NODE_FIELDS,
     Extras,
     NodeState,
     NumaAux,
@@ -33,6 +35,8 @@ from koordinator_tpu.ops.binpack import (
     ResvArrays,
     ScoreParams,
     SolverConfig,
+    bucket_row_update,
+    scatter_node_rows_donated,
     solve_batch,
 )
 from koordinator_tpu.ops.gang import GangState
@@ -46,10 +50,10 @@ from koordinator_tpu.service.codec import (
     write_frame,
 )
 
-NODE_FIELDS = (
-    "alloc", "used_req", "usage", "prod_usage", "est_extra", "prod_base",
-    "metric_fresh", "schedulable",
-)
+#: the wire NodeState columns — exactly the staged columns the delta
+#: protocol patches, one source of truth so full and delta requests can
+#: never drift
+NODE_FIELDS = STAGED_NODE_FIELDS
 POD_FIELDS = (
     "req", "est", "is_prod", "is_daemonset", "quota_id", "non_preemptible",
     "gang_id", "blocked", "has_numa_policy",
@@ -58,11 +62,11 @@ POD_FIELDS = (
 #: one jit cache for every connection (static config hashes per value)
 _jit_solve = jax.jit(solve_batch, static_argnames=("config",))
 
-#: kernel routing breaker, mirroring PlacementModel.use_pallas: None =
-#: decide at first solve (single TPU chip => on), False after any
-#: kernel error (visible via warning, never a silent slow path).
+#: kernel routing availability, mirroring PlacementModel.use_pallas:
+#: None = decide at first solve (single TPU chip => on).
 #: KTPU_SOLVER_PALLAS=1 forces it on (interpret mode off-TPU — tests),
-#: =0 disables it.
+#: =0 disables it. Kernel FAILURES no longer flip this flag — they feed
+#: the consecutive-failure breaker below.
 _pallas_enabled: list = [None]
 
 
@@ -81,6 +85,137 @@ def _pallas_routing_on() -> bool:
     return _pallas_enabled[0]
 
 
+class KernelBreaker:
+    """Kernel-routing circuit breaker (ADVICE r5 low #2).
+
+    The old breaker permanently disabled kernel routing for the whole
+    process on ANY single exception — one transient device hiccup cost
+    2x throughput until restart, with a single RuntimeWarning as the
+    only trace. This one:
+
+    - trips only after ``threshold`` CONSECUTIVE kernel failures (a
+      success resets the count);
+    - excludes clearly request-specific errors — ``ValueError`` /
+      ``TypeError`` are input/config validation, not kernel health, and
+      never count (the request still falls back to the scan);
+    - re-probes after ``cooldown_s``: one half-open solve is let
+      through per cooldown window; success closes the breaker;
+    - exposes its whole state via :meth:`status` (PlacementService
+      surfaces it in the debug/status output).
+    """
+
+    REQUEST_SPECIFIC = (ValueError, TypeError)
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 300.0,
+                 clock=time.monotonic):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.consecutive = 0
+        self.tripped_at: Optional[float] = None
+        self.last_probe_at: Optional[float] = None
+        self.total_failures = 0
+        self.total_trips = 0
+        self.last_error: Optional[str] = None
+
+    def allow(self) -> bool:
+        """Whether a kernel solve may run now (half-open probes ride
+        the cooldown clock)."""
+        with self._lock:
+            if self.tripped_at is None:
+                return True
+            now = self._clock()
+            since = now - (self.last_probe_at or self.tripped_at)
+            if since >= self.cooldown_s:
+                self.last_probe_at = now  # one probe per cooldown window
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive = 0
+            self.tripped_at = None
+            self.last_probe_at = None
+
+    def refund_probe(self) -> None:
+        """A consumed half-open probe never actually tested kernel
+        health (the solve failed on request-specific inputs): return
+        the slot so the next eligible request can probe immediately."""
+        with self._lock:
+            if self.tripped_at is not None:
+                self.last_probe_at = None
+
+    def record_failure(self, exc: BaseException) -> bool:
+        """Count a kernel-health failure; returns True when this one
+        tripped (or re-armed) the breaker."""
+        with self._lock:
+            self.consecutive += 1
+            self.total_failures += 1
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            if self.tripped_at is not None:
+                # a failed half-open probe re-arms the cooldown
+                self.last_probe_at = self._clock()
+                return True
+            if self.consecutive >= self.threshold:
+                self.tripped_at = self._clock()
+                self.total_trips += 1
+                return True
+            return False
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "tripped": self.tripped_at is not None,
+                "consecutive_failures": self.consecutive,
+                "failure_threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+                "seconds_since_trip": (
+                    None if self.tripped_at is None
+                    else self._clock() - self.tripped_at
+                ),
+                "total_failures": self.total_failures,
+                "total_trips": self.total_trips,
+                "last_error": self.last_error,
+            }
+
+
+#: the process-wide breaker guarding kernel routing
+_breaker = KernelBreaker()
+
+
+def kernel_breaker_status() -> Dict[str, object]:
+    """The sidecar's kernel-routing state for debug/status surfaces."""
+    status = _breaker.status()
+    status["routing_enabled"] = bool(_pallas_enabled[0]) \
+        if _pallas_enabled[0] is not None else None
+    return status
+
+
+#: cached [Vp,Np] reservation→node one-hots for the kernel's credit
+#: matmul, keyed by (node-table bytes, node count) — the sidecar serves
+#: repeated solves against a static reservation table without
+#: rebuilding the up-to-8MB operand per request
+_resv_onehots: Dict = {}
+
+
+def _resv_onehot_for(resv, n_nodes: int):
+    if resv is None:
+        return None
+    node_np = np.asarray(resv.node, np.int32)
+    key = (node_np.tobytes(), n_nodes)
+    cached = _resv_onehots.get(key)
+    if cached is None:
+        from koordinator_tpu.ops.pallas_binpack import resv_node_onehot
+
+        if len(_resv_onehots) > 8:  # drifting tables must not leak VMEM
+            _resv_onehots.clear()
+        cached = _resv_onehots[key] = resv_node_onehot(
+            jnp.asarray(node_np), n_nodes
+        )
+    return cached
+
+
 def _dispatch_solve(state, pods, params, config, quota, gang, extras,
                     resv, numa, resv_score_safe: bool, params_ok: bool):
     """Route eligible solves onto the pallas kernel (bit-identical,
@@ -90,30 +225,54 @@ def _dispatch_solve(state, pods, params, config, quota, gang, extras,
     numpy arrays so the hot path pays no device->host sync."""
     from koordinator_tpu.ops.pallas_binpack import pallas_routing_ok
 
+    # _breaker.allow() must come LAST: it consumes the half-open probe
+    # slot when tripped, so a request that was never kernel-eligible
+    # must not burn it (that would defer the real re-probe a cooldown)
     kernel_ok = (
         _pallas_routing_on()
         and params_ok
         and pallas_routing_ok(
             state, pods, extras, resv, resv_score_safe, numa
         )
+        and _breaker.allow()
     )
     if kernel_ok:
         from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
 
         try:
-            return pallas_solve_batch(
+            result = pallas_solve_batch(
                 state, pods, params, config, quota, gang, numa, resv,
                 resv_score_checked=True,
+                resv_onehot=_resv_onehot_for(
+                    resv, int(state.alloc.shape[0])
+                ),
+            )
+            _breaker.record_success()
+            return result
+        except KernelBreaker.REQUEST_SPECIFIC as e:
+            import warnings
+
+            # bad inputs for the kernel, not kernel ill-health: this
+            # request rides the scan, the breaker doesn't move — and if
+            # it was a half-open probe, the slot is returned so a bad
+            # request can't defer the real health re-probe
+            _breaker.refund_probe()
+            warnings.warn(
+                f"solver sidecar kernel rejected a request (scan "
+                f"fallback, breaker unchanged): {type(e).__name__}: {e}",
+                RuntimeWarning,
             )
         except Exception as e:
             import warnings
 
+            tripped = _breaker.record_failure(e)
             warnings.warn(
-                f"solver sidecar pallas kernel disabled after error: "
+                f"solver sidecar pallas kernel failure"
+                f"{' — breaker OPEN' if tripped else ''} "
+                f"({_breaker.consecutive}/{_breaker.threshold}): "
                 f"{type(e).__name__}: {e}",
                 RuntimeWarning,
             )
-            _pallas_enabled[0] = False
     return _cached_solve(
         state, pods, params, config, quota, gang, extras, resv, numa
     )
@@ -204,19 +363,90 @@ def _decode_config(group) -> SolverConfig:
     return SolverConfig(**kwargs)
 
 
+class NodeStateCache:
+    """Per-connection staged node state for the delta protocol.
+
+    A full request carrying a ``node_delta`` ``epoch`` establishes the
+    base: the server keeps BOTH the host arrays (kernel-eligibility
+    predicates read them) and the staged device :class:`NodeState`.
+    Subsequent delta requests patch both in place — the host rows by
+    numpy assignment, the device arrays by the same donated row scatter
+    the in-process staging cache uses — so steady-state solves through
+    the sidecar never re-upload the [N,R] world either."""
+
+    def __init__(self):
+        self.host: Optional[Dict[str, np.ndarray]] = None
+        self.state: Optional[NodeState] = None
+        self.epoch: Optional[int] = None
+
+    def establish(self, node_group, state: NodeState, epoch: int) -> None:
+        self.host = {
+            f: np.array(node_group[f], copy=True)
+            for f in STAGED_NODE_FIELDS
+        }
+        self.state = state
+        self.epoch = epoch
+
+    def apply(self, delta) -> NodeState:
+        idx = np.asarray(delta["idx"], np.int32)
+        if idx.size:
+            rows = {f: np.asarray(delta[f]) for f in STAGED_NODE_FIELDS}
+            for f in STAGED_NODE_FIELDS:
+                self.host[f][idx] = rows[f]
+            sidx, srows = bucket_row_update(idx, rows)
+            self.state = scatter_node_rows_donated(
+                self.state, jnp.asarray(sidx), srows
+            )
+        self.epoch = int(np.asarray(delta["epoch"]).item())
+        return self.state
+
+
 def solve_from_request(req: SolveRequest,
-                       config: SolverConfig = SolverConfig()) -> SolveResponse:
+                       config: SolverConfig = SolverConfig(),
+                       node_cache: Optional[NodeStateCache] = None,
+                       ) -> SolveResponse:
     """Run one batched solve from wire arrays (the RPC handler body).
 
     The request's optional groups map 1:1 onto ``solve_batch``'s feature
     states; a wire config overrides the server default so the control
-    plane's SolverConfig rides along."""
+    plane's SolverConfig rides along. ``node_cache`` (per connection)
+    serves the delta protocol: requests without a ``node`` group patch
+    the cached staged state instead of re-shipping it."""
     try:
-        state = NodeState(
-            **{f: jnp.asarray(req.node[f]) for f in NODE_FIELDS},
-            **{f: jnp.asarray(req.node[f])
-               for f in ("numa_cap", "numa_free") if f in req.node},
-        )
+        delta = req.node_delta
+        node_host = req.node
+        if delta is not None and "idx" in delta:
+            base = int(np.asarray(delta["base_epoch"]).item())
+            if (
+                node_cache is None
+                or node_cache.state is None
+                or node_cache.epoch != base
+            ):
+                have = None if node_cache is None else node_cache.epoch
+                return SolveResponse(
+                    assignments=np.empty(0, np.int32),
+                    error=(
+                        f"delta-base-mismatch: server holds epoch "
+                        f"{have}, request expects {base}"
+                    ),
+                )
+            state = node_cache.apply(delta)
+            node_host = node_cache.host
+        else:
+            state = NodeState(
+                **{f: jnp.asarray(req.node[f]) for f in NODE_FIELDS},
+                **{f: jnp.asarray(req.node[f])
+                   for f in ("numa_cap", "numa_free") if f in req.node},
+            )
+            if (
+                delta is not None
+                and "epoch" in delta
+                and node_cache is not None
+                and "numa_cap" not in req.node  # numa rides full restage
+            ):
+                node_cache.establish(
+                    req.node, state, int(np.asarray(delta["epoch"]).item())
+                )
         pods = PodBatch.build(
             **{f: jnp.asarray(req.pods[f])
                for f in POD_FIELDS if f in req.pods}
@@ -245,7 +475,7 @@ def solve_from_request(req: SolveRequest,
             )
             if req.resv is not None:
                 resv_score_safe = pallas_resv_score_safe(
-                    req.resv["node"], req.resv["free"], req.node["alloc"]
+                    req.resv["node"], req.resv["free"], node_host["alloc"]
                 )
         result = _dispatch_solve(
             state, pods, params, config,
@@ -278,6 +508,7 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         stream = self.request.makefile("rwb")
         self.server.active_connections.add(self.request)
+        node_cache = NodeStateCache()  # per-connection delta base
         try:
             secret = self.server.shared_secret
             if secret is not None:
@@ -303,7 +534,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     )
                 else:
                     response = solve_from_request(
-                        request, self.server.solver_config
+                        request, self.server.solver_config, node_cache
                     )
                 write_frame(stream, encode_response(response))
                 stream.flush()
@@ -355,6 +586,16 @@ class PlacementService:
             target=self._server.serve_forever, daemon=True
         )
         self._thread.start()
+
+    def status(self) -> dict:
+        """Debug/status snapshot: the address served, live connection
+        count, and the kernel-routing breaker state (so an operator can
+        see WHY solves ride the scan instead of the kernel)."""
+        return {
+            "address": self.address,
+            "active_connections": len(self._server.active_connections),
+            "kernel_breaker": kernel_breaker_status(),
+        }
 
     def stop(self) -> None:
         self._server.shutdown()

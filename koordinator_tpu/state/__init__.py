@@ -1,9 +1,11 @@
 """Array substrate: cluster state lowered to dense device arrays."""
 
 from koordinator_tpu.state.cluster import (  # noqa: F401
+    ClusterDeltaTracker,
     NodeArrays,
     PendingPodArrays,
     estimate_pod_used,
     lower_nodes,
+    lower_nodes_delta,
     lower_pending_pods,
 )

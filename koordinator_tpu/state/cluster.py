@@ -209,6 +209,10 @@ class NodeArrays:
     prod_base: np.ndarray      # [N,R] prod-mode score base (see lower_nodes)
     metric_fresh: np.ndarray   # [N] bool: NodeMetric exists and not expired
     schedulable: np.ndarray    # [N] bool
+    #: [N] float64 metric update times (-inf = no metric) — host-only,
+    #: never staged; lets the delta path recompute ``metric_fresh`` for
+    #: every node as ``snapshot.now`` advances without touching rows
+    metric_update_time: Optional[np.ndarray] = None
 
     @property
     def n(self) -> int:
@@ -216,6 +220,66 @@ class NodeArrays:
 
     def index(self) -> Dict[str, int]:
         return {name: i for i, name in enumerate(self.names)}
+
+
+class ClusterDeltaTracker:
+    """Event-driven dirty-node accounting for incremental lowering.
+
+    Producers of a :class:`ClusterSnapshot` (the scheduler cache, a
+    bench mutation driver) mark the node rows their mutations touch;
+    a staging cache (models/placement.StagedStateCache) then re-lowers
+    only those rows instead of the world. This is the snapshot-diff
+    idiom of the reference's informer/cache layer: the event stream,
+    not a full relist, drives what gets recomputed.
+
+    Marks are kept as ``name -> epoch`` so multiple consumers can each
+    diff against their own last-seen epoch; entries are bounded by the
+    number of distinct node names and reset on structure changes.
+    Anything that changes the node SET or its order (add/remove/rename)
+    must call :meth:`mark_structure` — consumers then fall back to a
+    full relower. Attach to a snapshot via ``snapshot.delta_tracker``.
+    """
+
+    def __init__(self) -> None:
+        import threading
+
+        self.epoch = 0            # monotonically increasing mark clock
+        self.structure_epoch = 0  # last epoch the node set/order changed
+        self._marks: Dict[str, int] = {}
+        # markers run on different threads (informers under the cache
+        # lock, plugin Reserve/Unreserve and the model epilogue without
+        # it); an unlocked `epoch += 1` could let two racing marks share
+        # one epoch and a mark land at an epoch <= the snapshot's
+        # captured sync point — lost forever to `dirty_since`
+        self._lock = threading.Lock()
+
+    def mark_node(self, name: Optional[str]) -> None:
+        """Node ``name``'s lowered row may have changed (pod assigned or
+        removed, metric update, reservation hold change, spec change)."""
+        if name is None:
+            return
+        with self._lock:
+            self.epoch += 1
+            self._marks[name] = self.epoch
+
+    def mark_nodes(self, names) -> None:
+        for name in names:
+            self.mark_node(name)
+
+    def mark_structure(self) -> None:
+        """The node set or its order changed: delta consumers must fall
+        back to a full relower (their row indices are stale)."""
+        with self._lock:
+            self.epoch += 1
+            self.structure_epoch = self.epoch
+            self._marks.clear()
+
+    def dirty_since(self, epoch: int) -> List[str]:
+        """Node names marked after ``epoch`` (consumer's last sync)."""
+        with self._lock:
+            return [
+                name for name, at in self._marks.items() if at > epoch
+            ]
 
 
 @dataclasses.dataclass
@@ -242,6 +306,121 @@ class PendingPodArrays:
 def _clip_i32(a: np.ndarray) -> np.ndarray:
     info = np.iinfo(np.int32)
     return np.clip(a, info.min, info.max).astype(np.int32)
+
+
+def _node_metric_row(
+    metric: NodeMetric,
+    assigned,
+    *,
+    now: float,
+    metric_expiration_seconds: float,
+    scaling_factors,
+    resource_weights,
+    aggregated: Optional[AggregatedArgs],
+):
+    """The metric-derived columns for ONE node: ``(usage, prod_usage,
+    est_extra, prod_base, metric_fresh)`` as int64 vectors + bool.
+
+    Shared by the full (:func:`lower_nodes`) and incremental
+    (:func:`lower_nodes_delta`) lowerings so the two are bit-identical
+    by construction — the delta path re-runs exactly this computation
+    for dirty rows. ``assigned`` is the node's assigned pods in snapshot
+    order (the order fixes the int64 accumulation sequence)."""
+    agg_filter = aggregated is not None and aggregated.filter_enabled
+    agg_score = aggregated is not None and aggregated.score_enabled
+    prod_usage = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    prod_base = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    avg_vec = resources_to_vector(metric.node_usage)
+    # Aggregated (percentile) mode folds into the array substrate at
+    # lowering: the filter reads ``usage`` directly, so ``usage``
+    # stores the filter-mode base (percentile when enabled; a missing
+    # percentile lowers to zeros == the reference's per-resource skip,
+    # load_aware.go:200-209); the score base is usage + est_extra, so
+    # the score-mode substitution rides est_extra (exact fold:
+    # est_extra += score_base - filter_base). Reference:
+    # load_aware.go:157-186 (filter), :310-311 (score).
+    filter_vec = avg_vec
+    score_vec = avg_vec
+    score_agg_nil = False
+    if agg_filter:
+        # a missing percentile lowers to zeros (resources_to_vector of
+        # None) == the reference's per-resource skip
+        filter_vec = resources_to_vector(target_aggregated_usage(
+            metric, aggregated.usage_duration_seconds, aggregated.usage_pct
+        ))
+    if agg_score:
+        agg = target_aggregated_usage(
+            metric, aggregated.score_duration_seconds, aggregated.score_pct
+        )
+        # nil aggregated score base lowers to zeros: node usage
+        # contributes nothing AND every assigned pod becomes
+        # estimated (the OR clause at load_aware.go:357-358)
+        score_vec = resources_to_vector(agg)
+        score_agg_nil = agg is None
+    fresh = (now - metric.update_time) < metric_expiration_seconds
+    est_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    reported_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
+    for pod in assigned:
+        is_prod = pod.priority_class == PriorityClass.PROD
+        reported = metric.pod_usages.get(pod.uid)
+        rep_vec = resources_to_vector(reported) if reported else None
+        if is_prod and rep_vec is not None:
+            prod_usage += rep_vec  # prod Filter base
+        should_estimate = (
+            not reported
+            or score_agg_nil
+            or pod.assign_time >= metric.update_time
+            or (metric.update_time - pod.assign_time) < metric.report_interval
+        )
+        if not should_estimate:
+            # prod score base: non-estimated prod pods contribute their
+            # reported usage (sumPodUsages' podUsages term)
+            if is_prod and rep_vec is not None:
+                prod_base += rep_vec
+            continue
+        est_vec = resources_to_vector(
+            estimate_pod_used(pod, scaling_factors, resource_weights)
+        )
+        if rep_vec is not None:
+            est_vec = np.maximum(est_vec, rep_vec)
+            reported_sum += rep_vec
+        est_sum += est_vec
+        if is_prod:
+            prod_base += est_vec
+    # subtract reported usage of estimated pods only where the score
+    # base covers it (load_aware.go:318-323 quantity.Cmp(q) >= 0
+    # guard — against the aggregated base in score-aggregated mode),
+    # then fold the score-base substitution into est_extra
+    sub = np.where(score_vec >= reported_sum, reported_sum, 0)
+    est_extra = (score_vec - filter_vec) + est_sum - sub
+    return filter_vec, prod_usage, est_extra, prod_base, fresh
+
+
+def _node_hold_rows(snapshot: ClusterSnapshot, index: Dict[str, int]):
+    """``used_req`` int64 rows + per-node assigned-pod groups for the
+    nodes in ``index`` (assigned pod requests + Available reservations'
+    unallocated remainder — the net view of the reference's fake
+    reserve pod + restore chain, scheduler/plugins/reservation.py).
+    Shared by the full and delta lowerings; iteration order over
+    ``snapshot.pods`` fixes the accumulation sequence for both."""
+    used_req = np.zeros((len(index), NUM_RESOURCES), dtype=np.int64)
+    assigned_by_node: Dict[str, List[PodSpec]] = {}
+    for pod in snapshot.pods:
+        if pod.node_name is None or pod.node_name not in index:
+            continue
+        used_req[index[pod.node_name]] += resources_to_vector(pod.requests)
+        assigned_by_node.setdefault(pod.node_name, []).append(pod)
+    for resv in snapshot.reservations:
+        if (
+            getattr(resv.state, "value", resv.state) == "Available"
+            and resv.node_name in index
+        ):
+            alloc_vec = resources_to_vector(resv.allocatable or resv.requests)
+            used_vec = resources_to_vector(resv.allocated)
+            used_req[index[resv.node_name]] += np.maximum(
+                alloc_vec - used_vec, 0
+            )
+    return used_req, assigned_by_node
 
 
 def lower_nodes(
@@ -278,113 +457,41 @@ def lower_nodes(
     n = len(snapshot.nodes)
     names = [node.name for node in snapshot.nodes]
     index = {name: i for i, name in enumerate(names)}
-    alloc = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
-    used_req = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
     usage = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
     prod_usage = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
     est_extra = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
     prod_base = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
     metric_fresh = np.zeros(n, dtype=bool)
     schedulable = np.ones(n, dtype=bool)
+    metric_update_time = np.full(n, -np.inf)
+    alloc = np.zeros((n, NUM_RESOURCES), dtype=np.int64)
 
     for i, node in enumerate(snapshot.nodes):
         alloc[i] = resources_to_vector(node.allocatable)
         schedulable[i] = not node.unschedulable
 
-    # assigned pod requests per node
-    assigned_by_node: Dict[str, List[PodSpec]] = {}
-    for pod in snapshot.pods:
-        if pod.node_name is None or pod.node_name not in index:
-            continue
-        used_req[index[pod.node_name]] += resources_to_vector(pod.requests)
-        assigned_by_node.setdefault(pod.node_name, []).append(pod)
+    # assigned pod requests + Available reservation holds per node
+    used_req, assigned_by_node = _node_hold_rows(snapshot, index)
 
-    # Available reservations hold their unallocated remainder on the node
-    # (the net view of the reference's fake reserve pod + restore chain;
-    # see scheduler/plugins/reservation.py). Matched pods get this credited
-    # back per cycle / per scan step.
-    for resv in snapshot.reservations:
-        if (
-            getattr(resv.state, "value", resv.state) == "Available"
-            and resv.node_name in index
-        ):
-            alloc_vec = resources_to_vector(resv.allocatable or resv.requests)
-            used_vec = resources_to_vector(resv.allocated)
-            used_req[index[resv.node_name]] += np.maximum(alloc_vec - used_vec, 0)
-
-    # metrics + estimation correction
-    agg_filter = aggregated is not None and aggregated.filter_enabled
-    agg_score = aggregated is not None and aggregated.score_enabled
+    # metrics + estimation correction (per-node helper shared with the
+    # delta lowering)
     for name, metric in snapshot.node_metrics.items():
         if name not in index:
             continue
         i = index[name]
-        avg_vec = resources_to_vector(metric.node_usage)
-        # Aggregated (percentile) mode folds into the array substrate at
-        # lowering: the filter reads ``usage`` directly, so ``usage``
-        # stores the filter-mode base (percentile when enabled; a missing
-        # percentile lowers to zeros == the reference's per-resource skip,
-        # load_aware.go:200-209); the score base is usage + est_extra, so
-        # the score-mode substitution rides est_extra (exact fold:
-        # est_extra += score_base - filter_base). Reference:
-        # load_aware.go:157-186 (filter), :310-311 (score).
-        filter_vec = avg_vec
-        score_vec = avg_vec
-        score_agg_nil = False
-        if agg_filter:
-            # a missing percentile lowers to zeros (resources_to_vector of
-            # None) == the reference's per-resource skip
-            filter_vec = resources_to_vector(target_aggregated_usage(
-                metric, aggregated.usage_duration_seconds, aggregated.usage_pct
-            ))
-        if agg_score:
-            agg = target_aggregated_usage(
-                metric, aggregated.score_duration_seconds, aggregated.score_pct
-            )
-            # nil aggregated score base lowers to zeros: node usage
-            # contributes nothing AND every assigned pod becomes
-            # estimated (the OR clause at load_aware.go:357-358)
-            score_vec = resources_to_vector(agg)
-            score_agg_nil = agg is None
-        usage[i] = filter_vec
-        metric_fresh[i] = (
-            snapshot.now - metric.update_time
-        ) < metric_expiration_seconds
-        est_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
-        reported_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
-        for pod in assigned_by_node.get(name, ()):
-            is_prod = pod.priority_class == PriorityClass.PROD
-            reported = metric.pod_usages.get(pod.uid)
-            rep_vec = resources_to_vector(reported) if reported else None
-            if is_prod and rep_vec is not None:
-                prod_usage[i] += rep_vec  # prod Filter base
-            should_estimate = (
-                not reported
-                or score_agg_nil
-                or pod.assign_time >= metric.update_time
-                or (metric.update_time - pod.assign_time) < metric.report_interval
-            )
-            if not should_estimate:
-                # prod score base: non-estimated prod pods contribute their
-                # reported usage (sumPodUsages' podUsages term)
-                if is_prod and rep_vec is not None:
-                    prod_base[i] += rep_vec
-                continue
-            est_vec = resources_to_vector(
-                estimate_pod_used(pod, scaling_factors, resource_weights)
-            )
-            if rep_vec is not None:
-                est_vec = np.maximum(est_vec, rep_vec)
-                reported_sum += rep_vec
-            est_sum += est_vec
-            if is_prod:
-                prod_base[i] += est_vec
-        # subtract reported usage of estimated pods only where the score
-        # base covers it (load_aware.go:318-323 quantity.Cmp(q) >= 0
-        # guard — against the aggregated base in score-aggregated mode),
-        # then fold the score-base substitution into est_extra
-        sub = np.where(score_vec >= reported_sum, reported_sum, 0)
-        est_extra[i] = (score_vec - filter_vec) + est_sum - sub
+        metric_update_time[i] = metric.update_time
+        (
+            usage[i], prod_usage[i], est_extra[i], prod_base[i],
+            metric_fresh[i],
+        ) = _node_metric_row(
+            metric,
+            assigned_by_node.get(name, ()),
+            now=snapshot.now,
+            metric_expiration_seconds=metric_expiration_seconds,
+            scaling_factors=scaling_factors,
+            resource_weights=resource_weights,
+            aggregated=aggregated,
+        )
 
     return NodeArrays(
         names=names,
@@ -396,7 +503,94 @@ def lower_nodes(
         prod_base=_clip_i32(prod_base),
         metric_fresh=metric_fresh,
         schedulable=schedulable,
+        metric_update_time=metric_update_time,
     )
+
+
+def lower_nodes_delta(
+    snapshot: ClusterSnapshot,
+    prev: NodeArrays,
+    dirty_names,
+    *,
+    metric_expiration_seconds: float = DEFAULT_NODE_METRIC_EXPIRATION_SECONDS,
+    scaling_factors: Optional[Mapping[ResourceName, int]] = None,
+    resource_weights: Optional[Mapping[ResourceName, int]] = None,
+    aggregated: Optional[AggregatedArgs] = None,
+) -> Optional[np.ndarray]:
+    """Incrementally re-lower ``prev``'s rows for ``dirty_names`` IN
+    PLACE against ``snapshot``, plus any rows whose ``metric_fresh``
+    flipped because ``snapshot.now`` advanced past (or back inside) the
+    metric expiration window.
+
+    Returns the sorted int32 row indices that were rewritten (possibly
+    empty), or ``None`` when the node set/order no longer matches
+    ``prev`` — the caller must then fall back to a full
+    :func:`lower_nodes`. Dirty rows run through exactly the same
+    per-node helpers as the full lowering, so the updated ``prev`` is
+    bit-identical to a from-scratch lowering of ``snapshot`` provided
+    every mutated node was marked (the :class:`ClusterDeltaTracker`
+    contract; property-tested in tests/test_state_delta.py)."""
+    if prev.metric_update_time is None:
+        return None
+    names = [node.name for node in snapshot.nodes]
+    if names != prev.names:
+        return None
+    index = prev.index()
+    dirty = {name for name in dirty_names if name in index}
+
+    # freshness drift: ``now`` moved, so recompute every node's
+    # expiration verdict from the cached update times (vectorized — no
+    # per-node python) and fold flips into the changed-row set
+    fresh_now = (
+        snapshot.now - prev.metric_update_time
+    ) < metric_expiration_seconds
+    flipped = np.nonzero(fresh_now != prev.metric_fresh)[0]
+
+    sub_index = {name: k for k, name in enumerate(sorted(dirty))}
+    rows = np.fromiter(
+        (index[name] for name in sorted(dirty)), dtype=np.int64,
+        count=len(sub_index),
+    )
+    if len(sub_index):
+        used_req, assigned_by_node = _node_hold_rows(snapshot, sub_index)
+        for name, k in sub_index.items():
+            i = index[name]
+            node = snapshot.nodes[i]
+            prev.alloc[i] = _clip_i32(resources_to_vector(node.allocatable))
+            prev.schedulable[i] = not node.unschedulable
+            prev.used_req[i] = _clip_i32(used_req[k])
+            metric = snapshot.node_metrics.get(name)
+            if metric is None:
+                prev.metric_update_time[i] = -np.inf
+                prev.usage[i] = 0
+                prev.prod_usage[i] = 0
+                prev.est_extra[i] = 0
+                prev.prod_base[i] = 0
+                prev.metric_fresh[i] = False
+                continue
+            prev.metric_update_time[i] = metric.update_time
+            u, pu, ee, pb, fresh = _node_metric_row(
+                metric,
+                assigned_by_node.get(name, ()),
+                now=snapshot.now,
+                metric_expiration_seconds=metric_expiration_seconds,
+                scaling_factors=scaling_factors,
+                resource_weights=resource_weights,
+                aggregated=aggregated,
+            )
+            prev.usage[i] = _clip_i32(u)
+            prev.prod_usage[i] = _clip_i32(pu)
+            prev.est_extra[i] = _clip_i32(ee)
+            prev.prod_base[i] = _clip_i32(pb)
+            prev.metric_fresh[i] = fresh
+
+    # flips on otherwise-clean rows only touch the freshness mask
+    dirty_rows = set(rows.tolist())
+    for i in flipped:
+        if int(i) not in dirty_rows:
+            prev.metric_fresh[i] = fresh_now[i]
+            dirty_rows.add(int(i))
+    return np.asarray(sorted(dirty_rows), dtype=np.int32)
 
 
 def schedule_order(pods: Sequence[PodSpec]) -> List[int]:

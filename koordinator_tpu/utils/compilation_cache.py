@@ -32,6 +32,45 @@ _DEFAULT_DIR = os.path.join(
 )
 
 
+def host_fingerprint() -> str:
+    """A short identity for THIS host's CPU: machine architecture + a
+    hash of the CPU feature flags.
+
+    The persistent/AOT caches replay compiled code, and XLA:CPU
+    executables are compiled FOR the build host's CPU features — a
+    cache directory shared across heterogeneous machines (network home
+    dirs, container images with baked caches) replays AOT results
+    compiled for a different feature set: SIGILL at best, multi-minute
+    stalls at worst (the MULTICHIP_r05 rc=124 dryrun hang). Scoping the
+    cache by this fingerprint makes cross-machine reuse structurally
+    impossible while same-machine restarts still warm-start."""
+    import hashlib
+    import platform
+
+    ident = platform.machine() or "unknown"
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 exposes "flags", arm64 "Features"
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    digest = hashlib.sha256(f"{ident}|{flags}".encode()).hexdigest()[:12]
+    return f"{ident}-{digest}"
+
+
+def _host_scoped(cache_dir: str) -> str:
+    """``cache_dir`` scoped to this host's CPU identity (see
+    :func:`host_fingerprint`). KTPU_CACHE_HOST_SCOPE=0 restores the
+    shared layout for fleets known to be homogeneous."""
+    if os.environ.get("KTPU_CACHE_HOST_SCOPE", "1") == "0":
+        return cache_dir
+    return os.path.join(cache_dir, f"host-{host_fingerprint()}")
+
+
 class ExecutableCache:
     """AOT warm-start cache: serialized COMPILED executables on disk.
 
@@ -50,7 +89,10 @@ class ExecutableCache:
             cache_dir = os.environ.get(
                 "KTPU_COMPILATION_CACHE_DIR", _DEFAULT_DIR
             )
-        self.dir = os.path.join(cache_dir, "executables") if cache_dir else None
+        self.dir = (
+            os.path.join(_host_scoped(cache_dir), "executables")
+            if cache_dir else None
+        )
 
     def _path(self, key: str) -> str | None:
         if not self.dir:
@@ -127,6 +169,10 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         cache_dir = os.environ.get("KTPU_COMPILATION_CACHE_DIR", _DEFAULT_DIR)
     if not cache_dir:
         return None
+    # host-CPU-scoped subdirectory: AOT results never replay across
+    # machines with different CPU feature sets (SIGILL / stall risk —
+    # the MULTICHIP_r05 dryrun timeout)
+    cache_dir = _host_scoped(cache_dir)
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -143,3 +143,52 @@ def test_cache_disabled_by_empty_env(tmp_path, monkeypatch):
 
     monkeypatch.setenv("KTPU_COMPILATION_CACHE_DIR", "")
     assert enable_persistent_cache() is None
+
+
+class TestHostScopedCache:
+    """The cache directory is keyed by a host CPU fingerprint so AOT
+    results never replay across machines with different feature sets
+    (SIGILL / 20-min-stall risk — the MULTICHIP_r05 rc=124 dryrun)."""
+
+    def test_fingerprint_stable_and_shaped(self):
+        from koordinator_tpu.utils.compilation_cache import host_fingerprint
+
+        fp = host_fingerprint()
+        assert fp == host_fingerprint()  # deterministic on one host
+        machine, _, digest = fp.rpartition("-")
+        assert machine and len(digest) == 12
+
+    def test_executable_cache_dir_is_host_scoped(self, tmp_path):
+        from koordinator_tpu.utils.compilation_cache import (
+            ExecutableCache,
+            host_fingerprint,
+        )
+
+        cache = ExecutableCache(str(tmp_path))
+        assert f"host-{host_fingerprint()}" in cache.dir
+
+    def test_enable_persistent_cache_scopes_dir(self, tmp_path, monkeypatch):
+        import jax
+
+        from koordinator_tpu.utils.compilation_cache import (
+            enable_persistent_cache,
+            host_fingerprint,
+        )
+
+        before = jax.config.jax_compilation_cache_dir
+        try:
+            out = enable_persistent_cache(str(tmp_path))
+            assert out is not None
+            assert f"host-{host_fingerprint()}" in out
+            import os
+
+            assert os.path.isdir(out)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", before)
+
+    def test_host_scope_opt_out(self, tmp_path, monkeypatch):
+        from koordinator_tpu.utils import compilation_cache as cc
+
+        monkeypatch.setenv("KTPU_CACHE_HOST_SCOPE", "0")
+        cache = cc.ExecutableCache(str(tmp_path))
+        assert "host-" not in cache.dir

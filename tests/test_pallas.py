@@ -586,3 +586,54 @@ def test_resv_score_budget_gate():
                            interpret=True)
     # the scan handles it fine (the contract the router falls back to)
     solve_batch(state, pods, params, SolverConfig(), resv=bad)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_resv_onehot_hoist_identical(seed):
+    """A caller-cached resv_node_onehot must be byte-for-byte the
+    operand the kernel derives itself: solves with and without the
+    hoisted one-hot are identical (the per-solve rebuild it replaces
+    was ADVICE r5 low #3)."""
+    from koordinator_tpu.ops.pallas_binpack import (
+        pallas_solve_batch,
+        resv_node_onehot,
+    )
+
+    state, pods, params = _problem(seed=seed)
+    config = SolverConfig()
+    resv = _resv_setup(state, pods, seed=seed + 8)
+    onehot = resv_node_onehot(resv.node, int(state.alloc.shape[0]))
+    want = pallas_solve_batch(state, pods, params, config, resv=resv,
+                              interpret=True)
+    got = pallas_solve_batch(state, pods, params, config, resv=resv,
+                             interpret=True, resv_onehot=onehot)
+    _assert_resv_identical(got, want)
+    assert int((np.asarray(want.resv_vstar) >= 0).sum()) > 0
+
+
+@pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu",
+    reason="hardware MXU precision semantics only exist on TPU",
+)
+def test_resv_credit_precision_on_hardware():
+    """TPU-gated (ADVICE r5 high): the reservation credit matmul runs
+    on the REAL MXU (interpret=False) and must still reproduce the scan
+    bit-for-bit. Without precision=HIGHEST the default f32 dot rounds
+    operands toward bfloat16 and the hi/lo integer partials corrupt —
+    interpret-mode CI (exact f32) can never catch that, so this is the
+    only test standing between the kernel and silent hardware
+    divergence."""
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.ops.pallas_binpack import pallas_solve_batch
+
+    state, pods, params = _problem(n_nodes=256, n_pods=192, seed=5)
+    config = SolverConfig()
+    # free remainders chosen to need all 16 low bits AND the high half:
+    # any mantissa rounding in the dot shifts the reconstructed credit
+    resv = _resv_setup(state, pods, n_resv=31, seed=13,
+                       match_frac=0.5)
+    want = solve_batch(state, pods, params, config, resv=resv)
+    got = pallas_solve_batch(state, pods, params, config, resv=resv,
+                             interpret=False)
+    _assert_resv_identical(got, want)
+    assert int((np.asarray(want.resv_vstar) >= 0).sum()) > 0

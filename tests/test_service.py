@@ -273,11 +273,14 @@ class TestKernelRouting:
 
     def test_kernel_error_trips_breaker_not_request(self, monkeypatch):
         """A kernel failure falls back to the scan for THAT request and
-        disables routing afterwards — never an error response."""
+        feeds the consecutive-failure breaker: one transient error does
+        NOT disable routing, K consecutive ones do — never an error
+        response either way."""
         import koordinator_tpu.service.server as server
 
         monkeypatch.setenv("KTPU_SOLVER_PALLAS", "1")
         monkeypatch.setattr(server, "_pallas_enabled", [None])
+        monkeypatch.setattr(server, "_breaker", server.KernelBreaker())
 
         def boom(*a, **kw):
             raise RuntimeError("kernel exploded")
@@ -285,7 +288,216 @@ class TestKernelRouting:
         import koordinator_tpu.ops.pallas_binpack as pb
 
         monkeypatch.setattr(pb, "pallas_solve_batch", boom)
-        with pytest.warns(RuntimeWarning, match="disabled after error"):
+        with pytest.warns(RuntimeWarning, match="kernel failure"):
             resp = solve_from_request(_problem())
         assert not resp.error
-        assert server._pallas_enabled[0] is False  # breaker tripped
+        # one failure: routing still on (the old breaker's any-error
+        # permanent trip was ADVICE r5 low #2)
+        assert server._pallas_enabled[0] is True
+        assert not server._breaker.status()["tripped"]
+        # two more consecutive failures open the breaker
+        for _ in range(2):
+            with pytest.warns(RuntimeWarning):
+                assert not solve_from_request(_problem()).error
+        assert server._breaker.status()["tripped"]
+        assert server.kernel_breaker_status()["routing_enabled"] is True
+        # tripped: the next request rides the scan silently, no warning
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            assert not solve_from_request(_problem()).error
+
+
+class TestKernelBreaker:
+    """Unit semantics of the consecutive-failure breaker."""
+
+    def test_threshold_and_reset(self):
+        from koordinator_tpu.service.server import KernelBreaker
+
+        b = KernelBreaker(threshold=3, cooldown_s=60.0, clock=lambda: 0.0)
+        assert b.allow()
+        b.record_failure(RuntimeError("x"))
+        b.record_failure(RuntimeError("x"))
+        assert not b.status()["tripped"]
+        b.record_success()  # a success resets the streak
+        b.record_failure(RuntimeError("x"))
+        b.record_failure(RuntimeError("x"))
+        assert not b.status()["tripped"]
+        b.record_failure(RuntimeError("boom"))
+        st = b.status()
+        assert st["tripped"] and st["total_trips"] == 1
+        assert "boom" in st["last_error"]
+        assert not b.allow()
+
+    def test_cooldown_half_open_probe(self):
+        from koordinator_tpu.service.server import KernelBreaker
+
+        now = [0.0]
+        b = KernelBreaker(threshold=1, cooldown_s=30.0,
+                          clock=lambda: now[0])
+        b.record_failure(RuntimeError("x"))
+        assert not b.allow()
+        now[0] = 31.0
+        assert b.allow()        # ONE half-open probe per window
+        assert not b.allow()    # a second caller inside the window waits
+        b.record_failure(RuntimeError("still broken"))
+        now[0] = 45.0
+        assert not b.allow()    # the failed probe re-armed the cooldown
+        now[0] = 62.0
+        assert b.allow()
+        b.record_success()      # the probe solved: breaker closes fully
+        assert b.allow() and not b.status()["tripped"]
+
+
+class TestNodeDeltaProtocol:
+    """The incremental staging wire path: establish → delta → mismatch
+    recovery, always bit-identical to full-state requests."""
+
+    def _establish(self, req, epoch):
+        import dataclasses
+
+        return dataclasses.replace(
+            req, node_delta={"epoch": np.asarray(epoch, np.int64)}
+        )
+
+    def test_establish_then_delta_matches_full(self):
+        from koordinator_tpu.service.server import NodeStateCache
+
+        cache = NodeStateCache()
+        req = _problem(n_nodes=6, n_pods=5)
+        first = solve_from_request(self._establish(req, 1), node_cache=cache)
+        assert not first.error and cache.epoch == 1
+
+        # mutate two node rows, solve via delta AND via a full request
+        import dataclasses
+
+        node2 = {k: np.array(v, copy=True) for k, v in req.node.items()}
+        node2["used_req"][1, R.CPU] = 9000
+        node2["schedulable"][4] = False
+        idx = np.asarray([1, 4], np.int32)
+        delta = {
+            "idx": idx,
+            "base_epoch": np.asarray(1, np.int64),
+            "epoch": np.asarray(2, np.int64),
+            **{f: node2[f][idx] for f in (
+                "alloc", "used_req", "usage", "prod_usage", "est_extra",
+                "prod_base", "metric_fresh", "schedulable",
+            )},
+        }
+        via_delta = solve_from_request(
+            dataclasses.replace(req, node={}, node_delta=delta),
+            node_cache=cache,
+        )
+        assert not via_delta.error and cache.epoch == 2
+        via_full = solve_from_request(
+            dataclasses.replace(req, node=node2)
+        )
+        np.testing.assert_array_equal(
+            via_delta.assignments, via_full.assignments
+        )
+        np.testing.assert_array_equal(
+            via_delta.node_used_req, via_full.node_used_req
+        )
+
+    def test_delta_base_mismatch_is_loud(self):
+        import dataclasses
+
+        from koordinator_tpu.service.server import NodeStateCache
+
+        cache = NodeStateCache()
+        req = _problem()
+        delta = {
+            "idx": np.asarray([0], np.int32),
+            "base_epoch": np.asarray(7, np.int64),
+            "epoch": np.asarray(8, np.int64),
+            **{f: req.node[f][:1] for f in req.node},
+        }
+        resp = solve_from_request(
+            dataclasses.replace(req, node={}, node_delta=delta),
+            node_cache=cache,
+        )
+        assert "delta-base-mismatch" in resp.error
+
+    def test_remote_solver_delta_roundtrip(self, tmp_path):
+        """RemoteSolver with a staging delta: establish, then ship only
+        dirty rows; a sidecar restart transparently re-establishes."""
+        import jax.numpy as jnp
+
+        from koordinator_tpu.models.placement import NodeStagingDelta
+        from koordinator_tpu.ops.binpack import (
+            NodeState,
+            PodBatch,
+            ScoreParams,
+            SolverConfig,
+        )
+        from koordinator_tpu.service.client import RemoteSolver
+
+        req = _problem(n_nodes=6, n_pods=5)
+        state = NodeState(**{k: jnp.asarray(v) for k, v in req.node.items()})
+        batch = PodBatch.build(**{k: jnp.asarray(v)
+                                  for k, v in req.pods.items()})
+        params = ScoreParams(**{k: jnp.asarray(v)
+                                for k, v in req.params.items()})
+        config = SolverConfig()
+
+        sock = str(tmp_path / "solver.sock")
+        service = PlacementService(sock)
+        service.start()
+        try:
+            solver = RemoteSolver(sock)
+            r1 = solver.solve_result(
+                state, batch, params, config,
+                staging=(1, NodeStagingDelta(1)),
+            )
+            assert solver.last_request == "establish"
+
+            host = {k: np.array(v, copy=True) for k, v in req.node.items()}
+            host["used_req"][2, R.CPU] = 12000
+            idx = np.asarray([2], np.int32)
+            rows = {f: host[f][idx] for f in host}
+            state2 = NodeState(**{k: jnp.asarray(v)
+                                  for k, v in host.items()})
+            r2 = solver.solve_result(
+                state2, batch, params, config,
+                staging=(2, NodeStagingDelta(2, 1, idx, rows)),
+            )
+            assert solver.last_request == "delta"
+            want = solve_from_request(
+                SolveRequest(node=host, pods=req.pods, params=req.params)
+            )
+            np.testing.assert_array_equal(r2.assign, want.assignments)
+            np.testing.assert_array_equal(
+                np.asarray(r2.node_state.used_req), want.node_used_req
+            )
+
+            # restart the sidecar: the client must fall back to full
+            service.stop()
+            service2 = PlacementService(sock)
+            service2.start()
+            try:
+                r3 = solver.solve_result(
+                    state2, batch, params, config,
+                    staging=(3, NodeStagingDelta(3, 2, idx, rows)),
+                )
+                assert solver.last_request == "establish"
+                np.testing.assert_array_equal(r3.assign, want.assignments)
+            finally:
+                service2.stop()
+        finally:
+            try:
+                service.stop()
+            except Exception:
+                pass
+
+    def test_request_specific_failure_refunds_probe(self):
+        from koordinator_tpu.service.server import KernelBreaker
+
+        now = [0.0]
+        b = KernelBreaker(threshold=1, cooldown_s=30.0,
+                          clock=lambda: now[0])
+        b.record_failure(RuntimeError("x"))
+        now[0] = 31.0
+        assert b.allow()        # probe slot consumed
+        b.refund_probe()        # ...but the solve never tested health
+        assert b.allow()        # slot returned: the NEXT request probes

@@ -25,7 +25,20 @@ import jax.numpy as jnp
 from __graft_entry__ import _example_problem
 from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
 from koordinator_tpu.ops.binpack import NumaAux, SolverConfig, solve_batch
-from koordinator_tpu.parallel.mesh import make_mesh, shard_kernel_solver
+from koordinator_tpu.parallel.mesh import (
+    distributed_kernel_supported,
+    make_mesh,
+    shard_kernel_solver,
+)
+
+#: the distributed kernel needs pltpu.CompilerParams + the TPU
+#: interpreter's emulated remote DMAs (pltpu.InterpretParams off-TPU);
+#: jax 0.4.x ships neither — the GSPMD path (test_parallel.py /
+#: test_full_scale.py) carries the multichip identity contract there
+pytestmark = pytest.mark.skipif(
+    not distributed_kernel_supported(),
+    reason="distributed pallas kernel APIs unavailable on this jax build",
+)
 
 
 def _single(state, pods, params, *args, **kw):

@@ -1,0 +1,385 @@
+"""Delta staging correctness: any tracked event sequence must yield a
+staged NodeState bit-identical to a from-scratch lowering + staging of
+the final snapshot, and solves through the delta path must match the
+full-restage path and the host oracle.
+
+This is the property the whole incremental layer rests on (parity is
+asserted on the FINAL staged state, not per-delta — docs/PARITY.md):
+``lower_nodes_delta`` shares its per-row helpers with ``lower_nodes``,
+so equality here is by construction, and these tests guard the
+construction (dirty-set bookkeeping, freshness drift, structure
+fallbacks, the donated device scatter, bucket padding).
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import PriorityClass, ResourceName
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    ReservationSpec,
+    ReservationState,
+)
+from koordinator_tpu.models.placement import PlacementModel, StagedStateCache
+from koordinator_tpu.ops.binpack import STAGED_NODE_FIELDS
+from koordinator_tpu.state.cluster import (
+    ClusterDeltaTracker,
+    lower_nodes,
+    lower_nodes_delta,
+)
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+
+ARRAY_FIELDS = STAGED_NODE_FIELDS  # the staged columns
+
+
+def _node(i, rng):
+    return NodeSpec(
+        name=f"n{i}",
+        allocatable={CPU: int(rng.integers(8000, 64000)),
+                     MEM: int(rng.integers(8192, 131072))},
+        unschedulable=bool(rng.random() < 0.05),
+    )
+
+
+def _metric(name, now, rng, pods=()):
+    return NodeMetric(
+        node_name=name,
+        node_usage={CPU: int(rng.integers(0, 32000)),
+                    MEM: int(rng.integers(0, 65536))},
+        update_time=float(now - rng.integers(0, 300)),
+        pod_usages={
+            p.uid: {CPU: int(rng.integers(0, 2000)),
+                    MEM: int(rng.integers(0, 2048))}
+            for p in pods if rng.random() < 0.7
+        },
+    )
+
+
+def _pod(j, rng, node_name=None):
+    prod = rng.random() < 0.4
+    return PodSpec(
+        name=f"p{j}",
+        node_name=node_name,
+        requests={CPU: int(rng.integers(100, 4000)),
+                  MEM: int(rng.integers(64, 4096))},
+        limits={CPU: int(rng.integers(100, 5000))} if rng.random() < 0.3
+        else {},
+        priority_class=PriorityClass.PROD if prod else PriorityClass.NONE,
+        assign_time=float(rng.integers(0, 400)) if node_name else 0.0,
+    )
+
+
+def _build(rng, n_nodes=24):
+    nodes = [_node(i, rng) for i in range(n_nodes)]
+    pods = []
+    for j in range(3 * n_nodes):
+        node = nodes[int(rng.integers(0, n_nodes))]
+        pods.append(_pod(j, rng, node.name))
+    metrics = {}
+    for node in nodes:
+        if rng.random() < 0.8:
+            on_node = [p for p in pods if p.node_name == node.name]
+            metrics[node.name] = _metric(node.name, 400.0, rng, on_node)
+    resvs = []
+    for k in range(6):
+        node = nodes[int(rng.integers(0, n_nodes))]
+        resvs.append(ReservationSpec(
+            name=f"r{k}", node_name=node.name,
+            requests={CPU: int(rng.integers(500, 4000)),
+                      MEM: int(rng.integers(256, 4096))},
+            state=ReservationState.AVAILABLE,
+        ))
+    tracker = ClusterDeltaTracker()
+    return ClusterSnapshot(
+        nodes=nodes, pods=pods, pending_pods=[], node_metrics=metrics,
+        reservations=resvs, now=400.0, delta_tracker=tracker,
+    ), tracker
+
+
+def _mutate(snapshot, tracker, rng, counters):
+    """Apply one random tracked event; returns nothing. Every mutation
+    that can change a node row marks the tracker exactly as a correct
+    producer (SchedulerCache) would."""
+    kind = rng.choice([
+        "node_spec", "node_add", "node_remove", "pod_assign",
+        "pod_remove", "metric", "metric_drop", "resv_alloc",
+        "resv_expire", "advance_now",
+    ])
+    nodes = snapshot.nodes
+    if kind == "node_spec":
+        i = int(rng.integers(0, len(nodes)))
+        nodes[i] = _node_replacement(nodes[i], rng)
+        tracker.mark_node(nodes[i].name)
+    elif kind == "node_add":
+        counters["next_node"] += 1
+        nodes.append(_node(1000 + counters["next_node"], rng))
+        tracker.mark_structure()
+    elif kind == "node_remove" and len(nodes) > 4:
+        i = int(rng.integers(0, len(nodes)))
+        gone = nodes.pop(i)
+        snapshot.pods = [p for p in snapshot.pods
+                         if p.node_name != gone.name]
+        snapshot.node_metrics.pop(gone.name, None)
+        tracker.mark_structure()
+    elif kind == "pod_assign":
+        counters["next_pod"] += 1
+        node = nodes[int(rng.integers(0, len(nodes)))]
+        snapshot.pods.append(
+            _pod(2000 + counters["next_pod"], rng, node.name)
+        )
+        tracker.mark_node(node.name)
+    elif kind == "pod_remove" and snapshot.pods:
+        i = int(rng.integers(0, len(snapshot.pods)))
+        gone = snapshot.pods.pop(i)
+        tracker.mark_node(gone.node_name)
+    elif kind == "metric":
+        node = nodes[int(rng.integers(0, len(nodes)))]
+        on_node = [p for p in snapshot.pods if p.node_name == node.name]
+        snapshot.node_metrics[node.name] = _metric(
+            node.name, snapshot.now, rng, on_node
+        )
+        tracker.mark_node(node.name)
+    elif kind == "metric_drop" and snapshot.node_metrics:
+        name = list(snapshot.node_metrics)[
+            int(rng.integers(0, len(snapshot.node_metrics)))
+        ]
+        del snapshot.node_metrics[name]
+        tracker.mark_node(name)
+    elif kind == "resv_alloc" and snapshot.reservations:
+        resv = snapshot.reservations[
+            int(rng.integers(0, len(snapshot.reservations)))
+        ]
+        resv.allocated = {CPU: int(rng.integers(0, 2000))}
+        tracker.mark_node(resv.node_name)
+    elif kind == "resv_expire" and snapshot.reservations:
+        resv = snapshot.reservations[
+            int(rng.integers(0, len(snapshot.reservations)))
+        ]
+        resv.state = ReservationState.EXPIRED
+        tracker.mark_node(resv.node_name)
+    elif kind == "advance_now":
+        # freshness drift: NO mark — the delta path must catch expired
+        # (and re-freshened) metrics from the cached update times alone
+        snapshot.now += float(rng.integers(1, 120))
+
+
+def _node_replacement(node, rng):
+    return NodeSpec(
+        name=node.name,
+        allocatable={CPU: int(rng.integers(8000, 64000)),
+                     MEM: int(rng.integers(8192, 131072))},
+        unschedulable=bool(rng.random() < 0.2),
+    )
+
+
+def _assert_arrays_equal(got, want, context):
+    assert got.names == want.names, context
+    for f in ARRAY_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got, f), getattr(want, f), err_msg=f"{context}: {f}"
+        )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_delta_lowering_matches_full_property(seed):
+    """Any tracked event sequence: patching the previous NodeArrays with
+    lower_nodes_delta == a from-scratch lower_nodes, bit for bit."""
+    rng = np.random.default_rng(seed)
+    snapshot, tracker = _build(rng)
+    counters = {"next_node": 0, "next_pod": 0}
+    arrays = lower_nodes(snapshot)
+    seen_epoch = tracker.epoch
+    for round_i in range(30):
+        for _ in range(int(rng.integers(1, 6))):
+            _mutate(snapshot, tracker, rng, counters)
+        dirty = tracker.dirty_since(seen_epoch)
+        structure_changed = tracker.structure_epoch > seen_epoch
+        idx = lower_nodes_delta(snapshot, arrays, dirty)
+        if structure_changed:
+            # the node set/order moved: the delta path must refuse
+            assert idx is None, f"round {round_i}"
+        if idx is None:
+            arrays = lower_nodes(snapshot)
+        seen_epoch = tracker.epoch
+        _assert_arrays_equal(
+            arrays, lower_nodes(snapshot), f"seed {seed} round {round_i}"
+        )
+
+
+def test_delta_refuses_stale_node_order():
+    rng = np.random.default_rng(9)
+    snapshot, tracker = _build(rng, n_nodes=6)
+    arrays = lower_nodes(snapshot)
+    snapshot.nodes.reverse()  # same set, different order
+    assert lower_nodes_delta(snapshot, arrays, []) is None
+
+
+def test_freshness_drift_without_marks():
+    """now advancing past the expiration window must flip metric_fresh
+    on UNMARKED rows (the tracker never sees time passing)."""
+    rng = np.random.default_rng(4)
+    snapshot, tracker = _build(rng, n_nodes=10)
+    arrays = lower_nodes(snapshot)
+    snapshot.now += 10_000.0  # everything expires
+    idx = lower_nodes_delta(snapshot, arrays, [])
+    assert idx is not None and idx.size > 0
+    _assert_arrays_equal(arrays, lower_nodes(snapshot), "expired")
+    assert not arrays.metric_fresh.any()
+    snapshot.now -= 10_000.0  # ...and back inside the window
+    idx = lower_nodes_delta(snapshot, arrays, [])
+    assert idx is not None and idx.size > 0
+    _assert_arrays_equal(arrays, lower_nodes(snapshot), "refreshed")
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_staged_cache_device_state_property(seed):
+    """The STAGED device state after any tracked event sequence equals
+    a from-scratch stage_nodes(lower_nodes(snapshot)) — the donated
+    scatter (bucket padding included) is exact."""
+    rng = np.random.default_rng(seed)
+    snapshot, tracker = _build(rng)
+    counters = {"next_node": 0, "next_pod": 0}
+    model = PlacementModel(use_pallas=False)
+    cache = StagedStateCache(model)
+    paths = set()
+    for round_i in range(12):
+        for _ in range(int(rng.integers(1, 5))):
+            _mutate(snapshot, tracker, rng, counters)
+        arrays, state, _times = cache.ensure(snapshot)
+        paths.add(cache.last_path)
+        want = model.stage_nodes(lower_nodes(snapshot))
+        for f in ARRAY_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, f)),
+                np.asarray(getattr(want, f)),
+                err_msg=f"seed {seed} round {round_i}: {f}",
+            )
+    assert "delta" in paths  # the incremental path actually ran
+
+
+def test_schedule_delta_matches_full_and_oracle():
+    """Solves THROUGH the delta path == the full-restage path == the
+    sequential host oracle, over several churn rounds."""
+    from koordinator_tpu.oracle.vectorized import (
+        oracle_args,
+        schedule_vectorized,
+    )
+    from koordinator_tpu.state.cluster import lower_pending_pods
+
+    rng = np.random.default_rng(21)
+    snapshot, tracker = _build(rng, n_nodes=16)
+    counters = {"next_node": 0, "next_pod": 0}
+    delta_model = PlacementModel(use_pallas=False)
+    for round_i in range(6):
+        for _ in range(3):
+            _mutate(snapshot, tracker, rng, counters)
+        snapshot.pending_pods = [
+            _pod(5000 + 100 * round_i + j, rng) for j in range(12)
+        ]
+        got = delta_model.schedule(snapshot)
+
+        fresh_snapshot = ClusterSnapshot(
+            nodes=snapshot.nodes, pods=snapshot.pods,
+            pending_pods=snapshot.pending_pods,
+            node_metrics=snapshot.node_metrics,
+            reservations=snapshot.reservations, now=snapshot.now,
+        )
+        full_model = PlacementModel(use_pallas=False)
+        want = full_model.schedule(fresh_snapshot)
+        assert dict(got) == dict(want), f"round {round_i}"
+        assert got.waiting == want.waiting
+
+        if not snapshot.reservations or all(
+            getattr(r.state, "value", r.state) != "Available"
+            for r in snapshot.reservations
+        ):
+            # plain shape: also pin against the sequential oracle
+            arrays = lower_nodes(fresh_snapshot)
+            pod_arrays = lower_pending_pods(fresh_snapshot.pending_pods)
+            state = full_model.stage_nodes(arrays)
+            batch = full_model.stage_pods(pod_arrays)
+            assign = schedule_vectorized(
+                *oracle_args(state, batch, full_model.params)
+            )
+            oracle_map = {
+                uid: (arrays.names[a] if a >= 0 else None)
+                for uid, a in zip(pod_arrays.uids, assign)
+            }
+            assert dict(got) == oracle_map, f"oracle round {round_i}"
+
+        # bind this round's placements (tracked), as a scheduler would
+        by_uid = {p.uid: p for p in snapshot.pending_pods}
+        for uid, node in got.items():
+            if node is not None:
+                pod = by_uid[uid]
+                pod.node_name = node
+                pod.assign_time = snapshot.now
+                snapshot.pods.append(pod)
+                tracker.mark_node(node)
+        snapshot.pending_pods = []
+        snapshot.now += 30.0
+    assert delta_model.staged_cache.last_path is not None
+
+
+def test_tracker_semantics():
+    t = ClusterDeltaTracker()
+    e0 = t.epoch
+    t.mark_node("a")
+    t.mark_nodes(["b", "c"])
+    assert set(t.dirty_since(e0)) == {"a", "b", "c"}
+    mid = t.epoch
+    t.mark_node("d")
+    assert set(t.dirty_since(mid)) == {"d"}
+    t.mark_structure()
+    assert t.structure_epoch == t.epoch
+    assert t.dirty_since(mid) == []  # structure reset the marks
+    t.mark_node(None)  # no-op, never raises
+
+
+def test_staged_cache_device_half_skip_and_reestablish():
+    """want_device=False keeps only the host half fresh (NUMA callers
+    restage anyway); the device half comes back bit-identical from the
+    current host arrays when next wanted."""
+    rng = np.random.default_rng(33)
+    snapshot, tracker = _build(rng, n_nodes=8)
+    model = PlacementModel(use_pallas=False)
+    cache = StagedStateCache(model)
+    arrays, state, _ = cache.ensure(snapshot, want_device=False)
+    assert state is None and cache.last_path == "full"
+    tracker.mark_node(snapshot.nodes[0].name)
+    snapshot.nodes[0] = _node_replacement(snapshot.nodes[0], rng)
+    arrays, state, _ = cache.ensure(snapshot, want_device=False)
+    assert state is None and cache.last_path == "delta"
+    # now the device half is wanted again: rebuilt from host arrays
+    arrays, state, _ = cache.ensure(snapshot)
+    assert state is not None
+    want = model.stage_nodes(lower_nodes(snapshot))
+    for f in ARRAY_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(state, f)), np.asarray(getattr(want, f)),
+            err_msg=f,
+        )
+
+
+def test_snapshot_epoch_sync_point():
+    """ensure() syncs to the snapshot-time epoch, so a mark landing
+    AFTER the snapshot was taken (racing informer) is re-lowered next
+    tick instead of silently lost."""
+    rng = np.random.default_rng(55)
+    snapshot, tracker = _build(rng, n_nodes=8)
+    model = PlacementModel(use_pallas=False)
+    cache = StagedStateCache(model)
+    snapshot.delta_epoch = tracker.epoch
+    cache.ensure(snapshot)
+    # a mutation + mark races in after the snapshot's epoch capture
+    snapshot.nodes[2] = _node_replacement(snapshot.nodes[2], rng)
+    tracker.mark_node(snapshot.nodes[2].name)
+    # the next tick's snapshot carries the new epoch: the row re-lowers
+    snapshot.delta_epoch = tracker.epoch
+    arrays, state, _ = cache.ensure(snapshot)
+    assert cache.last_path == "delta"
+    _assert_arrays_equal(arrays, lower_nodes(snapshot), "post-race")
