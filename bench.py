@@ -1233,6 +1233,36 @@ def bench_warm_start():
         return {"warm_warmup_s": None, "error": "timeout"}
 
 
+def graftcheck_violation_count():
+    """Repo-wide graftcheck violation count (docs/DESIGN.md §11) — 0 on
+    a healthy tree, -1 if the checker itself fails. Recorded in every
+    bench record so the trajectory files double as lint history."""
+    try:
+        from pathlib import Path
+
+        from koordinator_tpu.analysis.graftcheck import (
+            default_rules,
+            load_allowlist,
+            run_checks,
+        )
+        from koordinator_tpu.analysis.graftcheck.engine import (
+            iter_repo_modules,
+        )
+
+        root = Path(__file__).resolve().parent
+        violations, _ = run_checks(
+            iter_repo_modules(root), default_rules(),
+            load_allowlist(root / "graftcheck.toml"),
+        )
+        for v in violations:
+            print(f"graftcheck: {v.format()}", file=sys.stderr)
+        return len(violations)
+    except Exception as e:
+        print(f"graftcheck failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return -1
+
+
 def main():
     # persist compiled programs: every solver start after the first
     # warms from disk (measured by the warm_start entry below)
@@ -1308,6 +1338,7 @@ def main():
         "scan_pods_per_sec": round(flagship["scan_pods_per_sec"], 1),
         "p99_round_s": round(flagship["p99_round_s"], 4),
         "matrix": _round(matrix),
+        "graftcheck_violations": graftcheck_violation_count(),
     }
     if "identical_to_oracle" in flagship:
         result["identical_to_oracle"] = flagship["identical_to_oracle"]

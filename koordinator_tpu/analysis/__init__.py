@@ -1,0 +1,7 @@
+"""In-repo static analysis for the jax_graft invariants.
+
+The reference Koordinator leans on Go's race detector and ``go vet`` to
+keep its informer/cache concurrency honest; the TPU port's equivalents
+live here. ``graftcheck`` is the AST invariant checker for the solve hot
+path (see ``koordinator_tpu/analysis/graftcheck/``).
+"""

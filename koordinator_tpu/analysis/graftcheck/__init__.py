@@ -1,0 +1,53 @@
+"""graftcheck: AST invariant checker for the solve hot path.
+
+PR 6 made steady-state scheduling ticks incremental and device-resident.
+Every invariant that perf win rests on is structural, not local — a
+single ``float(device_value)`` inside the solve loop, one cache
+attribute touched off-lock, one inline row computation that drifts from
+the shared per-row helpers, or one jit callsite without explicit
+static/donate declarations silently costs correctness or a recompile
+per tick. Convention and code review don't scale to that; this package
+machine-checks them, the way the reference Koordinator leans on Go's
+race detector and ``go vet``.
+
+Rules (each self-tested against seeded-violation fixtures in
+``tests/fixtures/graftcheck/``; see docs/DESIGN.md §11):
+
+- ``host-sync``      no host synchronization on device values inside
+                     hot-path modules (local taint analysis).
+- ``lock-discipline`` mapped mutable attributes of the concurrency-
+                     critical classes only touched under their lock.
+- ``delta-parity``   the full and delta lowerings reach row values only
+                     through the shared per-row helper registry.
+- ``jit-hygiene``    every ``jax.jit``/``pjit`` in hot-path modules
+                     declares static/donate intent explicitly; jitted
+                     callables never fed per-call-varying Python scalars.
+- ``dead-import``    no unused imports in hot-path modules.
+
+Intentional exceptions live in ``graftcheck.toml`` at the repo root;
+every entry must carry a written justification and match at least one
+current violation (stale entries are themselves violations).
+
+CLI: ``python -m koordinator_tpu.analysis.graftcheck [--format=json]
+[--rule=NAME ...]`` — exits non-zero on any unsuppressed violation.
+"""
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    AllowEntry,
+    ModuleFile,
+    Violation,
+    load_allowlist,
+    load_module,
+    run_checks,
+)
+from koordinator_tpu.analysis.graftcheck.rules import default_rules
+
+__all__ = [
+    "AllowEntry",
+    "ModuleFile",
+    "Violation",
+    "default_rules",
+    "load_allowlist",
+    "load_module",
+    "run_checks",
+]

@@ -1,0 +1,76 @@
+"""CLI: ``python -m koordinator_tpu.analysis.graftcheck``.
+
+Runs every rule repo-wide against the allowlist at
+``<repo-root>/graftcheck.toml`` and exits non-zero on any unsuppressed
+violation. ``--rule`` narrows to named rules (repeatable);
+``--format=json`` emits machine-readable output (bench.py folds the
+violation count into every bench record).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    iter_repo_modules,
+    load_allowlist,
+    render,
+    run_checks,
+)
+from koordinator_tpu.analysis.graftcheck.rules import default_rules
+
+
+def find_repo_root(start: Path) -> Path:
+    """The directory holding the ``koordinator_tpu`` package (and the
+    allowlist) — walked up from this file so the CLI works from any
+    cwd."""
+    for candidate in (start, *start.parents):
+        if (candidate / "koordinator_tpu" / "__init__.py").exists():
+            return candidate
+    raise SystemExit("graftcheck: cannot locate repo root")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="graftcheck")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument(
+        "--rule", action="append", default=None,
+        help="run only the named rule(s); repeatable",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: auto-detected from the package path)",
+    )
+    args = parser.parse_args(argv)
+
+    root = (
+        Path(args.root).resolve() if args.root
+        else find_repo_root(Path(__file__).resolve())
+    )
+    rules = default_rules()
+    if args.rule:
+        known = {r.name for r in rules}
+        unknown = set(args.rule) - known
+        if unknown:
+            parser.error(
+                f"unknown rule(s) {sorted(unknown)}; known: {sorted(known)}"
+            )
+        rules = tuple(r for r in rules if r.name in args.rule)
+    allowlist = load_allowlist(root / "graftcheck.toml")
+    if args.rule:
+        # a narrowed run must not report entries for skipped rules as
+        # stale — they simply were not exercised
+        names = set(args.rule)
+        allowlist = [e for e in allowlist if e.rule in names]
+    violations, suppressed = run_checks(
+        iter_repo_modules(root), rules, allowlist
+    )
+    print(render(violations, suppressed, args.format))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,264 @@
+"""The graftcheck rule engine: file model, allowlist, runner.
+
+Everything here is stdlib-only (``ast`` + ``re``): the checker must run
+on any box the repo runs on, with no dependency the container doesn't
+already have (Python 3.10 has no ``tomllib``, hence the strict-subset
+TOML reader below).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule hit, addressable by (rule, path, func, symbol).
+
+    ``line`` is reporting detail only — allowlist entries deliberately
+    match on the enclosing function, not line numbers, so entries
+    survive unrelated edits above them.
+    """
+
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    func: str          # enclosing qualname ("Class.method" | "<module>")
+    symbol: str        # the offending construct ("jax.device_get", ...)
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: [{self.rule}] "
+            f"{self.message} (in {self.func})"
+        )
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AllowEntry:
+    """One justified exception from ``graftcheck.toml``.
+
+    Matches a violation when rule and path are equal, ``func`` is equal
+    or ``"*"``, ``symbol`` (when set) is equal, and ``detail`` (when
+    set) is a substring of the violation message. ``reason`` is
+    mandatory: an allowlist entry without a written justification is
+    itself reported as a violation.
+    """
+
+    rule: str
+    path: str
+    func: str = "*"
+    symbol: str = ""
+    detail: str = ""
+    reason: str = ""
+    lineno: int = 0
+    used: bool = dataclasses.field(default=False, compare=False)
+
+    def matches(self, v: Violation) -> bool:
+        return (
+            self.rule == v.rule
+            and self.path == v.path
+            and self.func in ("*", v.func)
+            and (not self.symbol or self.symbol == v.symbol)
+            and (not self.detail or self.detail in v.message)
+        )
+
+
+_ALLOW_KEYS = {"rule", "path", "func", "symbol", "detail", "reason"}
+_KV_RE = re.compile(r'^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"([^"]*)"\s*$')
+
+
+def load_allowlist(path: Path) -> List[AllowEntry]:
+    """Read the ``[[allow]]`` entries from a strict TOML subset.
+
+    Supported syntax: comments, blank lines, ``[[allow]]`` headers, and
+    ``key = "double-quoted string"`` pairs (no escapes). Anything else
+    is an error — the allowlist is an audited artifact, not a config
+    playground.
+    """
+    entries: List[AllowEntry] = []
+    current: Optional[Dict[str, object]] = None
+    if not path.exists():
+        return entries
+    for lineno, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[allow]]":
+            current = {"lineno": lineno}
+            entries.append(current)  # type: ignore[arg-type]
+            continue
+        m = _KV_RE.match(line)
+        if m is None or current is None:
+            raise ValueError(
+                f"{path.name}:{lineno}: unsupported allowlist syntax: {raw!r}"
+            )
+        key = m.group(1)
+        if key not in _ALLOW_KEYS:
+            raise ValueError(
+                f"{path.name}:{lineno}: unknown allowlist key {key!r}"
+            )
+        current[key] = m.group(2)
+    out = []
+    for e in entries:
+        missing = {"rule", "path"} - set(e)
+        if missing:
+            raise ValueError(
+                f"{path.name}:{e['lineno']}: allowlist entry missing "
+                f"{sorted(missing)}"
+            )
+        out.append(AllowEntry(**e))  # type: ignore[arg-type]
+    return out
+
+
+@dataclasses.dataclass
+class ModuleFile:
+    """One parsed source file handed to every rule."""
+
+    path: str                  # repo-relative, posix separators
+    tree: ast.Module
+    source: str
+
+    def matches(self, globs: Sequence[str]) -> bool:
+        return any(fnmatch.fnmatch(self.path, g) for g in globs)
+
+
+def load_module(file_path: Path, rel_path: str) -> ModuleFile:
+    source = file_path.read_text()
+    return ModuleFile(
+        path=rel_path, tree=ast.parse(source, filename=rel_path),
+        source=source,
+    )
+
+
+def iter_repo_modules(root: Path, package: str = "koordinator_tpu"
+                      ) -> Iterable[ModuleFile]:
+    """Every ``.py`` file under ``root/package`` (the checker's
+    universe; rules narrow by glob). Syntax errors propagate — a file
+    the checker can't parse is a finding, not a skip."""
+    pkg = root / package
+    for file_path in sorted(pkg.rglob("*.py")):
+        rel = file_path.relative_to(root).as_posix()
+        yield load_module(file_path, rel)
+
+
+def qualname_map(tree: ast.Module) -> Dict[int, str]:
+    """``id(node) -> enclosing scope qualname`` for every node, so rules
+    that walk with ``ast.walk`` still report allowlist-stable ``func``
+    fields."""
+    mapping: Dict[int, str] = {}
+
+    def visit(node: ast.AST, scopes: List[str]) -> None:
+        label = ".".join(scopes) if scopes else "<module>"
+        for child in ast.iter_child_nodes(node):
+            mapping[id(child)] = label
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                visit(child, scopes + [child.name])
+            else:
+                visit(child, scopes)
+
+    visit(tree, [])
+    return mapping
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` as a dotted string for simple Name/Attribute chains,
+    else None (calls, subscripts and literals break the chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def run_checks(
+    modules: Iterable[ModuleFile],
+    rules: Sequence,
+    allowlist: Sequence[AllowEntry] = (),
+) -> Tuple[List[Violation], List[Violation]]:
+    """Run ``rules`` over ``modules``; returns ``(violations,
+    suppressed)``. Engine-level findings ride the same stream: an
+    allowlist entry with no written reason, and a stale entry that no
+    current violation needs, are violations too (the allowlist must not
+    rot into a blanket mute)."""
+    raw: List[Violation] = []
+    seen = set()
+    for module in modules:
+        for rule in rules:
+            for v in rule.check(module):
+                key = (v.rule, v.path, v.line, v.col, v.symbol)
+                if key not in seen:
+                    seen.add(key)
+                    raw.append(v)
+    violations: List[Violation] = []
+    suppressed: List[Violation] = []
+    for v in raw:
+        hit = None
+        for entry in allowlist:
+            if entry.matches(v):
+                hit = entry
+                break
+        if hit is not None:
+            hit.used = True
+            suppressed.append(v)
+        else:
+            violations.append(v)
+    for entry in allowlist:
+        if not entry.reason.strip():
+            violations.append(Violation(
+                rule="allowlist-justification", path="graftcheck.toml",
+                line=entry.lineno, col=0, func="<allowlist>",
+                symbol=entry.rule,
+                message=(
+                    f"allowlist entry for {entry.rule} at {entry.path} "
+                    f"carries no written justification"
+                ),
+            ))
+        if not entry.used:
+            violations.append(Violation(
+                rule="stale-allowlist", path="graftcheck.toml",
+                line=entry.lineno, col=0, func="<allowlist>",
+                symbol=entry.rule,
+                message=(
+                    f"allowlist entry for {entry.rule} at {entry.path} "
+                    f"(func={entry.func!r}, symbol={entry.symbol!r}) "
+                    f"matches no current violation — delete it"
+                ),
+            ))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations, suppressed
+
+
+def render(violations: Sequence[Violation], suppressed: Sequence[Violation],
+           fmt: str = "text") -> str:
+    if fmt == "json":
+        return json.dumps(
+            {
+                "violations": [v.as_dict() for v in violations],
+                "suppressed": [v.as_dict() for v in suppressed],
+                "violation_count": len(violations),
+            },
+            indent=2,
+        )
+    lines = [v.format() for v in violations]
+    lines.append(
+        f"graftcheck: {len(violations)} violation(s), "
+        f"{len(suppressed)} allowlisted"
+    )
+    return "\n".join(lines)

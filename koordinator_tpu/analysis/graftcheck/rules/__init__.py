@@ -1,0 +1,103 @@
+"""The graftcheck rule set and its production configuration.
+
+``default_rules()`` returns every rule wired to the repo's hot-path
+scope and invariant registries; tests construct the same rule classes
+with narrowed scopes/registries to self-test against seeded-violation
+fixtures.
+"""
+
+from __future__ import annotations
+
+from koordinator_tpu.analysis.graftcheck.rules.dead_import import (
+    DeadImportRule,
+)
+from koordinator_tpu.analysis.graftcheck.rules.host_sync import HostSyncRule
+from koordinator_tpu.analysis.graftcheck.rules.jit_hygiene import (
+    JitHygieneRule,
+)
+from koordinator_tpu.analysis.graftcheck.rules.lock_discipline import (
+    LockDisciplineRule,
+    LockSpec,
+)
+from koordinator_tpu.analysis.graftcheck.rules.parity import (
+    DeltaParityRule,
+    ParitySpec,
+)
+
+#: the solve hot path: modules where a stray host sync, implicit jit
+#: declaration, or dead import is a per-tick cost, not a style nit
+HOT_MODULES = (
+    "koordinator_tpu/models/placement.py",
+    "koordinator_tpu/ops/*.py",
+    "koordinator_tpu/state/cluster.py",
+    "koordinator_tpu/service/server.py",
+    "koordinator_tpu/parallel/mesh.py",
+)
+
+#: attribute -> lock maps for the concurrency-critical classes the
+#: incremental staging path relies on (docs/DESIGN.md §11)
+LOCK_SPECS = (
+    LockSpec(
+        path="koordinator_tpu/scheduler/cache.py",
+        class_name="SchedulerCache",
+        lock="_lock",
+        attrs=(
+            "nodes", "pods", "pending", "assumed", "node_metrics",
+            "gangs", "quotas", "reservations",
+        ),
+    ),
+    LockSpec(
+        path="koordinator_tpu/state/cluster.py",
+        class_name="ClusterDeltaTracker",
+        lock="_lock",
+        attrs=("epoch", "structure_epoch", "_marks"),
+    ),
+    LockSpec(
+        path="koordinator_tpu/models/placement.py",
+        class_name="StagedStateCache",
+        lock="_lock",
+        attrs=(
+            "arrays", "state", "tracker", "seen_epoch", "epoch",
+            "last_delta", "last_path",
+        ),
+    ),
+)
+
+#: the delta/full lowering pair and the shared per-row helper registry
+#: both paths must route row values through
+PARITY_SPECS = (
+    ParitySpec(
+        path="koordinator_tpu/state/cluster.py",
+        funcs=("lower_nodes", "lower_nodes_delta"),
+        required_helpers=(
+            "_node_metric_row", "_node_hold_rows", "_clip_i32",
+            "resources_to_vector",
+        ),
+        allowed_helpers=("_metric_fresh",),
+    ),
+)
+
+
+def default_rules():
+    return (
+        HostSyncRule(scope=HOT_MODULES),
+        LockDisciplineRule(specs=LOCK_SPECS),
+        DeltaParityRule(specs=PARITY_SPECS),
+        JitHygieneRule(scope=HOT_MODULES),
+        DeadImportRule(scope=HOT_MODULES),
+    )
+
+
+__all__ = [
+    "HOT_MODULES",
+    "LOCK_SPECS",
+    "PARITY_SPECS",
+    "DeadImportRule",
+    "DeltaParityRule",
+    "HostSyncRule",
+    "JitHygieneRule",
+    "LockDisciplineRule",
+    "LockSpec",
+    "ParitySpec",
+    "default_rules",
+]

@@ -1,0 +1,381 @@
+"""host-sync: no host synchronization on device values in the hot path.
+
+A ``jax.device_get``, ``.block_until_ready()``, ``float()/int()/bool()``
+coercion, or ``np.asarray`` on a device value blocks the Python thread
+on the accelerator stream — inside the solve loop that turns an async
+dispatch pipeline into a lock-step one and costs a round trip per tick.
+
+The rule runs a local (per-function) taint analysis: names assigned
+from device-producing expressions — ``jnp.*`` calls, ``jax.device_put``,
+``jax.jit(...)``-wrapped callables (configured or discovered from
+``X = jax.jit(...)`` bindings), method calls on tainted receivers,
+NamedTuple-style wrappers over tainted arguments — are device values;
+coercing one to host is a violation. Function parameters start
+untainted (a caller that hands host arrays in is fine), so the analysis
+under-reports rather than false-positives. ``jax.device_get`` and any
+``block_until_ready`` are flagged unconditionally: there is no
+legitimate anonymous use of either in the hot path (the intentional
+staging barriers are allowlisted by name in graftcheck.toml).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    attr_chain,
+)
+
+#: callables whose results are device-resident in this codebase —
+#: matched on the last dotted segment (``self._solve`` -> ``_solve``)
+DEFAULT_PRODUCERS = frozenset({
+    "solve_batch", "schedule_batch", "pallas_solve_batch",
+    "scatter_node_rows_donated", "device_put", "_dispatch_solve",
+    "_cached_solve", "_jit_solve", "stage_nodes", "stage_pods",
+})
+
+_COERCIONS = ("float", "int", "bool")
+_NP_SYNCS = ("np.asarray", "np.array", "numpy.asarray", "numpy.array")
+
+
+def _last_segment(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class HostSyncRule:
+    name = "host-sync"
+    description = (
+        "no jax.device_get / block_until_ready / float-int-bool coercion "
+        "/ np.asarray on device values in hot-path modules"
+    )
+
+    def __init__(self, scope: Sequence[str],
+                 producers: frozenset = DEFAULT_PRODUCERS):
+        self.scope = tuple(scope)
+        self.producers = producers
+
+    # -- taint ---------------------------------------------------------------
+
+    def _is_jit_factory(self, node: ast.expr) -> bool:
+        """``jax.jit(...)`` / ``pjit(...)`` / ``partial(jax.jit, ...)`` —
+        an expression whose value is a jit-compiled callable."""
+        if not isinstance(node, ast.Call):
+            return False
+        chain = attr_chain(node.func) or ""
+        if chain.split(".")[-1] in ("jit", "pjit"):
+            return True
+        if chain.split(".")[-1] == "partial" and node.args:
+            inner = attr_chain(node.args[0]) or ""
+            return inner.split(".")[-1] in ("jit", "pjit")
+        return False
+
+    def _tainted(self, node: ast.AST, tainted: Set[str],
+                 producers: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if chain is not None and chain in tainted:
+                return True
+            return self._tainted(node.value, tainted, producers)
+        if isinstance(node, ast.Subscript):
+            return self._tainted(node.value, tainted, producers)
+        if isinstance(node, ast.Call):
+            func = node.func
+            chain = attr_chain(func) or ""
+            root = chain.split(".")[0] if chain else None
+            if root == "jnp":
+                return True
+            # jax.jit(...)(args): calling the factory's result
+            if isinstance(func, ast.Call) and self._is_jit_factory(func):
+                return True
+            seg = _last_segment(func)
+            if seg is not None and (seg in producers or chain in producers):
+                return True
+            # a method on a device value returns a device value
+            # (x._replace, x.astype, x.sum, ...)
+            if isinstance(func, ast.Attribute) and self._tainted(
+                func.value, tainted, producers
+            ):
+                return True
+            # NamedTuple-ish wrapper over device members stays a device
+            # value (NodeState(...), PodBatch.build(...))
+            func_root = _root_name(func)
+            if func_root is not None and func_root[:1].isupper():
+                return any(
+                    self._tainted(a, tainted, producers)
+                    for a in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                )
+            return False
+        if isinstance(node, (ast.BinOp,)):
+            return self._tainted(node.left, tainted, producers) or \
+                self._tainted(node.right, tainted, producers)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand, tainted, producers)
+        if isinstance(node, ast.Compare):
+            return self._tainted(node.left, tainted, producers) or any(
+                self._tainted(c, tainted, producers)
+                for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(
+                self._tainted(v, tainted, producers) for v in node.values
+            )
+        if isinstance(node, ast.IfExp):
+            return self._tainted(node.body, tainted, producers) or \
+                self._tainted(node.orelse, tainted, producers)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(
+                self._tainted(e, tainted, producers) for e in node.elts
+            )
+        if isinstance(node, ast.Starred):
+            return self._tainted(node.value, tainted, producers)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self._tainted(node.elt, tainted, producers)
+        if isinstance(node, ast.DictComp):
+            return self._tainted(node.value, tainted, producers)
+        if isinstance(node, ast.NamedExpr):
+            return self._tainted(node.value, tainted, producers)
+        return False
+
+    # -- violations ----------------------------------------------------------
+
+    def _check_expr(self, node: ast.AST, tainted: Set[str],
+                    producers: Set[str], qualname: str, path: str,
+                    out: List[Violation]) -> None:
+        # ast.walk descends into Lambda bodies too, so closures see the
+        # enclosing taint (the probe's ``lambda: np.asarray(solve(...))``
+        # pattern) without a separate pass
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            chain = attr_chain(func) or ""
+            if chain == "jax.device_get":
+                out.append(self._v(
+                    path, sub, qualname, "jax.device_get",
+                    "jax.device_get forces a device->host transfer",
+                ))
+            elif chain == "jax.block_until_ready" or (
+                isinstance(func, ast.Attribute)
+                and func.attr == "block_until_ready"
+            ):
+                symbol = (
+                    "jax.block_until_ready" if chain ==
+                    "jax.block_until_ready" else ".block_until_ready()"
+                )
+                out.append(self._v(
+                    path, sub, qualname, symbol,
+                    f"{symbol} stalls the dispatch pipeline",
+                ))
+            elif chain in _NP_SYNCS and sub.args and self._tainted(
+                sub.args[0], tainted, producers
+            ):
+                out.append(self._v(
+                    path, sub, qualname, chain,
+                    f"{chain}({ast.unparse(sub.args[0])}) copies a "
+                    f"device value to host",
+                ))
+            elif (
+                isinstance(func, ast.Name)
+                and func.id in _COERCIONS
+                and len(sub.args) == 1
+                and not sub.keywords
+                and self._tainted(sub.args[0], tainted, producers)
+            ):
+                out.append(self._v(
+                    path, sub, qualname, f"{func.id}()",
+                    f"{func.id}({ast.unparse(sub.args[0])}) synchronously "
+                    f"reads a device value",
+                ))
+
+    def _v(self, path: str, node: ast.AST, qualname: str, symbol: str,
+           message: str) -> Violation:
+        return Violation(
+            rule=self.name, path=path, line=node.lineno,
+            col=node.col_offset, func=qualname, symbol=symbol,
+            message=message,
+        )
+
+    # -- statement walk ------------------------------------------------------
+
+    def _assign_target(self, target: ast.AST, is_tainted: bool,
+                       tainted: Set[str]) -> None:
+        if isinstance(target, ast.Name):
+            (tainted.add if is_tainted else tainted.discard)(target.id)
+        elif isinstance(target, ast.Attribute):
+            chain = attr_chain(target)
+            if chain is not None:
+                (tainted.add if is_tainted else tainted.discard)(chain)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_target(elt, is_tainted, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, is_tainted, tainted)
+        # Subscript targets (container element writes) carry no name
+
+    def _scan(self, stmts, tainted: Set[str], producers: Set[str],
+              scopes: List[str], path: str, out: List[Violation]) -> None:
+        qualname = ".".join(scopes) if scopes else "<module>"
+        check = lambda e: e is not None and self._check_expr(
+            e, tainted, producers, qualname, path, out
+        )
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in stmt.decorator_list:
+                    check(dec)
+                for d in stmt.args.defaults + [
+                    d for d in stmt.args.kw_defaults if d is not None
+                ]:
+                    check(d)
+                self._scan(
+                    stmt.body, set(tainted), set(producers),
+                    scopes + [stmt.name], path, out,
+                )
+            elif isinstance(stmt, ast.ClassDef):
+                for dec in stmt.decorator_list:
+                    check(dec)
+                self._scan(
+                    stmt.body, set(tainted), set(producers),
+                    scopes + [stmt.name], path, out,
+                )
+            elif isinstance(stmt, ast.Assign):
+                check(stmt.value)
+                if self._is_jit_factory(stmt.value):
+                    # X = jax.jit(...): X is a device-producing callable
+                    for t in stmt.targets:
+                        seg = _last_segment(t)
+                        if seg is not None:
+                            producers.add(seg)
+                    continue
+                is_t = self._tainted(stmt.value, tainted, producers)
+                for t in stmt.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)) and isinstance(
+                        stmt.value, (ast.Tuple, ast.List)
+                    ) and len(t.elts) == len(stmt.value.elts):
+                        for te, ve in zip(t.elts, stmt.value.elts):
+                            self._assign_target(
+                                te,
+                                self._tainted(ve, tainted, producers),
+                                tainted,
+                            )
+                    else:
+                        self._assign_target(t, is_t, tainted)
+            elif isinstance(stmt, ast.AnnAssign):
+                check(stmt.value)
+                if stmt.value is not None:
+                    if self._is_jit_factory(stmt.value):
+                        seg = _last_segment(stmt.target)
+                        if seg is not None:
+                            producers.add(seg)
+                    else:
+                        self._assign_target(
+                            stmt.target,
+                            self._tainted(stmt.value, tainted, producers),
+                            tainted,
+                        )
+            elif isinstance(stmt, ast.AugAssign):
+                check(stmt.value)
+                if self._tainted(stmt.value, tainted, producers):
+                    self._assign_target(stmt.target, True, tainted)
+            elif isinstance(stmt, ast.Expr):
+                check(stmt.value)
+            elif isinstance(stmt, ast.Return):
+                check(stmt.value)
+            elif isinstance(stmt, ast.If):
+                check(stmt.test)
+                self._scan(stmt.body, tainted, producers, scopes, path, out)
+                self._scan(
+                    stmt.orelse, tainted, producers, scopes, path, out
+                )
+            elif isinstance(stmt, ast.Match):
+                check(stmt.subject)
+                # case patterns bind names from the (possibly tainted)
+                # subject; taint them all — over-tainting a match arm
+                # beats going blind inside it
+                subject_tainted = self._tainted(
+                    stmt.subject, tainted, producers
+                )
+                for case in stmt.cases:
+                    for pname in ast.walk(case.pattern):
+                        if isinstance(pname, (ast.MatchAs, ast.MatchStar)) \
+                                and pname.name is not None:
+                            if subject_tainted:
+                                tainted.add(pname.name)
+                    if case.guard is not None:
+                        check(case.guard)
+                    self._scan(
+                        case.body, tainted, producers, scopes, path, out
+                    )
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                check(stmt.iter)
+                self._assign_target(
+                    stmt.target,
+                    self._tainted(stmt.iter, tainted, producers),
+                    tainted,
+                )
+                self._scan(stmt.body, tainted, producers, scopes, path, out)
+                self._scan(
+                    stmt.orelse, tainted, producers, scopes, path, out
+                )
+            elif isinstance(stmt, ast.While):
+                check(stmt.test)
+                self._scan(stmt.body, tainted, producers, scopes, path, out)
+                self._scan(
+                    stmt.orelse, tainted, producers, scopes, path, out
+                )
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    check(item.context_expr)
+                    if item.optional_vars is not None:
+                        self._assign_target(
+                            item.optional_vars,
+                            self._tainted(
+                                item.context_expr, tainted, producers
+                            ),
+                            tainted,
+                        )
+                self._scan(stmt.body, tainted, producers, scopes, path, out)
+            elif isinstance(stmt, ast.Try):
+                self._scan(stmt.body, tainted, producers, scopes, path, out)
+                for handler in stmt.handlers:
+                    self._scan(
+                        handler.body, tainted, producers, scopes, path, out
+                    )
+                self._scan(
+                    stmt.orelse, tainted, producers, scopes, path, out
+                )
+                self._scan(
+                    stmt.finalbody, tainted, producers, scopes, path, out
+                )
+            elif isinstance(stmt, (ast.Raise, ast.Assert)):
+                for field in ast.iter_child_nodes(stmt):
+                    check(field)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    self._assign_target(t, False, tainted)
+            # Import/Global/Nonlocal/Pass/Break/Continue: nothing to do
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        if not module.matches(self.scope):
+            return []
+        out: List[Violation] = []
+        self._scan(
+            module.tree.body, set(), set(self.producers), [],
+            module.path, out,
+        )
+        return out

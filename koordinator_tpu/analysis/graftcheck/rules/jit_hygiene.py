@@ -1,0 +1,152 @@
+"""jit-hygiene: explicit static/donate declarations, no per-call scalars.
+
+Every ``jax.jit``/``pjit`` in a hot-path module must declare BOTH its
+static surface (``static_argnums``/``static_argnames``) and its
+donation surface (``donate_argnums``/``donate_argnames``) — an empty
+tuple is a declaration ("nothing static", "nothing donated"); absence
+is not. The implicit defaults are where recompile churn and missed
+double-buffering hide: a reader (and this checker) can't tell an
+audited callsite from an unconsidered one.
+
+Second check: callables bound from ``X = jax.jit(...)`` must not be
+invoked with per-call-varying Python scalars (``len(...)``,
+``int(...)``, ``time.*()`` results as positional args) — each distinct
+value hashes into the jit cache key only if marked static, and if it
+is NOT static it becomes a traced 0-d array; either way a value that
+changes every tick means a recompile or a retrace per tick.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    attr_chain,
+    qualname_map,
+)
+
+_STATIC_KWS = {"static_argnums", "static_argnames"}
+_DONATE_KWS = {"donate_argnums", "donate_argnames"}
+#: host-scalar producers that vary per call when fed to a jitted callable
+_VARYING_CALLS = {"len", "int", "float", "round"}
+_VARYING_CHAINS = ("time.time", "time.perf_counter", "time.monotonic")
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.Call]:
+    """The Call whose keywords carry the jit declaration, if ``call``
+    is ``jax.jit(...)``/``pjit(...)`` or ``partial(jax.jit, ...)``."""
+    chain = attr_chain(call.func) or ""
+    seg = chain.split(".")[-1] if chain else ""
+    if seg in ("jit", "pjit"):
+        return call
+    if seg == "partial" and call.args:
+        inner = attr_chain(call.args[0]) or ""
+        if inner.split(".")[-1] in ("jit", "pjit"):
+            return call
+    return None
+
+
+class JitHygieneRule:
+    name = "jit-hygiene"
+    description = (
+        "hot-path jax.jit/pjit callsites declare static_arg* and "
+        "donate_arg* explicitly; jitted callables never take per-call-"
+        "varying Python scalars"
+    )
+
+    def __init__(self, scope: Sequence[str]):
+        self.scope = tuple(scope)
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        if not module.matches(self.scope):
+            return []
+        out: List[Violation] = []
+        jitted_names: Set[str] = set()
+        qmap = qualname_map(module.tree)
+
+        # pass 1: declaration completeness + collect jitted bindings
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    chain = attr_chain(dec) or ""
+                    if chain.split(".")[-1] in ("jit", "pjit"):
+                        out.append(Violation(
+                            rule=self.name, path=module.path,
+                            line=dec.lineno, col=dec.col_offset,
+                            func=qmap.get(id(dec), node.name),
+                            symbol=chain,
+                            message=(
+                                f"bare @{chain} on {node.name} declares "
+                                f"neither static_arg* nor donate_arg*"
+                            ),
+                        ))
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _jit_target(node.value) is not None:
+                    for t in node.targets:
+                        seg = (
+                            t.attr if isinstance(t, ast.Attribute)
+                            else t.id if isinstance(t, ast.Name) else None
+                        )
+                        if seg is not None:
+                            jitted_names.add(seg)
+            if not isinstance(node, ast.Call):
+                continue
+            target = _jit_target(node)
+            if target is None:
+                continue
+            kws = {kw.arg for kw in target.keywords if kw.arg is not None}
+            missing = []
+            if not kws & _STATIC_KWS:
+                missing.append("static_argnums/static_argnames")
+            if not kws & _DONATE_KWS:
+                missing.append("donate_argnums/donate_argnames")
+            if missing:
+                chain = attr_chain(node.func) or "jit"
+                out.append(Violation(
+                    rule=self.name, path=module.path, line=node.lineno,
+                    col=node.col_offset,
+                    func=qmap.get(id(node), "<module>"),
+                    symbol=chain,
+                    message=(
+                        f"{chain}(...) does not declare "
+                        f"{' or '.join(missing)} — implicit jit "
+                        f"surfaces hide recompile churn and missed "
+                        f"donation"
+                    ),
+                ))
+
+        # pass 2: per-call-varying scalars into jitted callables
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = None
+            if isinstance(node.func, ast.Attribute):
+                seg = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                seg = node.func.id
+            if seg not in jitted_names:
+                continue
+            for arg in node.args:
+                if not isinstance(arg, ast.Call):
+                    continue
+                achain = attr_chain(arg.func) or ""
+                aseg = achain.split(".")[-1] if achain else ""
+                if aseg in _VARYING_CALLS or achain in _VARYING_CHAINS:
+                    out.append(Violation(
+                        rule=self.name, path=module.path,
+                        line=arg.lineno, col=arg.col_offset,
+                        func=qmap.get(id(node), "<module>"),
+                        symbol=achain or aseg,
+                        message=(
+                            f"jitted callable {seg}(...) fed per-call-"
+                            f"varying Python scalar "
+                            f"`{ast.unparse(arg)}` — recompile/retrace "
+                            f"churn per invocation"
+                        ),
+                    ))
+        return out

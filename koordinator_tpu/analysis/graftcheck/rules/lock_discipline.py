@@ -1,0 +1,118 @@
+"""lock-discipline: mapped mutable attributes only touched under their lock.
+
+The incremental staging path (PR 6) hinges on three classes staying
+race-free: ``SchedulerCache`` (informer mutations vs snapshot capture),
+``ClusterDeltaTracker`` (mark epochs vs ``dirty_since``), and
+``StagedStateCache`` (host/device halves patched between solves). Each
+declares an attribute→lock map here; any read or write of a mapped
+attribute outside a ``with self.<lock>:`` block — in the class's own
+methods — is a violation. ``__init__`` is exempt (no concurrent aliases
+exist during construction). The map is deliberately class-internal:
+state callers need atomically is returned from inside the lock hold
+that produced it (``StagedStateCache.ensure``'s trailing (epoch, delta)
+pair), and keeping mapped attributes out of other modules' code paths
+remains a review duty (not machine-checked — see docs/DESIGN.md §11).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import List, Sequence, Tuple
+
+from koordinator_tpu.analysis.graftcheck.engine import ModuleFile, Violation
+
+
+@dataclasses.dataclass(frozen=True)
+class LockSpec:
+    path: str                  # repo-relative module path (exact)
+    class_name: str
+    lock: str                  # e.g. "_lock"
+    attrs: Tuple[str, ...]     # mutable attributes guarded by the lock
+    exempt_methods: Tuple[str, ...] = ("__init__",)
+
+
+class LockDisciplineRule:
+    name = "lock-discipline"
+    description = (
+        "mapped mutable attributes of concurrency-critical classes are "
+        "only read/written inside `with self.<lock>` blocks"
+    )
+
+    def __init__(self, specs: Sequence[LockSpec]):
+        self.specs = tuple(specs)
+
+    def _is_lock_ctx(self, expr: ast.expr, lock: str) -> bool:
+        return (
+            isinstance(expr, ast.Attribute)
+            and expr.attr == lock
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        )
+
+    def _walk(self, node: ast.AST, spec: LockSpec, locked: bool,
+              method: str, path: str, out: List[Violation]) -> None:
+        if isinstance(node, ast.With):
+            holds = locked or any(
+                self._is_lock_ctx(item.context_expr, spec.lock)
+                for item in node.items
+            )
+            for item in node.items:
+                self._walk(
+                    item.context_expr, spec, locked, method, path, out
+                )
+            for stmt in node.body:
+                self._walk(stmt, spec, holds, method, path, out)
+            return
+        if isinstance(node, ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in spec.attrs
+                and not locked
+            ):
+                out.append(Violation(
+                    rule=self.name, path=path, line=node.lineno,
+                    col=node.col_offset,
+                    func=f"{spec.class_name}.{method}",
+                    symbol=f"self.{node.attr}",
+                    message=(
+                        f"self.{node.attr} touched outside "
+                        f"`with self.{spec.lock}` (maps to "
+                        f"{spec.class_name}.{spec.lock})"
+                    ),
+                ))
+        # nested defs run later, possibly without the lock held — treat
+        # their bodies as unlocked unless they re-acquire
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                self._walk(child, spec, False, method, path, out)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, spec, locked, method, path, out)
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        out: List[Violation] = []
+        for spec in self.specs:
+            if module.path != spec.path:
+                continue
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.ClassDef)
+                    and node.name == spec.class_name
+                ):
+                    continue
+                for item in node.body:
+                    if not isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    if item.name in spec.exempt_methods:
+                        continue
+                    for stmt in item.body:
+                        self._walk(
+                            stmt, spec, False, item.name, module.path, out
+                        )
+        return out

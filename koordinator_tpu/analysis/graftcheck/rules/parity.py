@@ -1,0 +1,146 @@
+"""delta-parity: full and delta lowerings share the per-row helpers.
+
+``lower_nodes_delta`` is bit-identical to ``lower_nodes`` *by
+construction* only while both reach row values exclusively through the
+shared per-row helper registry (``_node_metric_row``,
+``_node_hold_rows``, ``_clip_i32``, ``resources_to_vector``). The
+moment either path computes a row value inline — an arithmetic
+expression, an ``np.array`` literal, an ``np.maximum``/``np.where``
+fold — the two can drift without any test noticing until a churn tick
+disagrees with a full relower. This rule bans inline value math in the
+paired functions' bodies and requires every registered helper to be
+called from both paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import List, Sequence, Set, Tuple
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    attr_chain,
+)
+
+_ARITH_OPS = (
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+    ast.MatMult,
+)
+#: numpy value-construction/folding calls that belong in helpers, never
+#: inline in a parity-coupled path
+_BANNED_NP = ("array", "maximum", "minimum", "where", "clip", "stack")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParitySpec:
+    path: str                         # repo-relative module path (exact)
+    funcs: Tuple[str, str]            # (full, delta) lowering pair
+    required_helpers: Tuple[str, ...]  # must be called from BOTH paths
+    allowed_helpers: Tuple[str, ...] = ()
+
+
+class DeltaParityRule:
+    name = "delta-parity"
+    description = (
+        "the delta/full lowering pair reaches row values only through "
+        "the shared per-row helper registry"
+    )
+
+    def __init__(self, specs: Sequence[ParitySpec]):
+        self.specs = tuple(specs)
+
+    def _check_func(self, fn: ast.FunctionDef, spec: ParitySpec,
+                    path: str, out: List[Violation]) -> Set[str]:
+        called: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func) or ""
+                seg = chain.split(".")[-1] if chain else None
+                if seg in spec.required_helpers or \
+                        seg in spec.allowed_helpers:
+                    called.add(seg)
+                root = chain.split(".")[0] if chain else ""
+                if root in ("np", "numpy") and seg in _BANNED_NP:
+                    out.append(Violation(
+                        rule=self.name, path=path, line=node.lineno,
+                        col=node.col_offset, func=fn.name,
+                        symbol=chain,
+                        message=(
+                            f"inline {chain}() in parity-coupled "
+                            f"{fn.name} — row construction/folding must "
+                            f"live in a shared per-row helper"
+                        ),
+                    ))
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, _ARITH_OPS
+            ):
+                out.append(Violation(
+                    rule=self.name, path=path, line=node.lineno,
+                    col=node.col_offset, func=fn.name,
+                    symbol=type(node.op).__name__,
+                    message=(
+                        f"inline arithmetic "
+                        f"`{ast.unparse(node)}` in parity-coupled "
+                        f"{fn.name} — value math must live in a shared "
+                        f"per-row helper"
+                    ),
+                ))
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.op, _ARITH_OPS
+            ):
+                out.append(Violation(
+                    rule=self.name, path=path, line=node.lineno,
+                    col=node.col_offset, func=fn.name,
+                    symbol=type(node.op).__name__,
+                    message=(
+                        f"inline augmented arithmetic "
+                        f"`{ast.unparse(node)}` in parity-coupled "
+                        f"{fn.name} — value math must live in a shared "
+                        f"per-row helper"
+                    ),
+                ))
+        return called
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        out: List[Violation] = []
+        for spec in self.specs:
+            if module.path != spec.path:
+                continue
+            found = {}
+            for node in module.tree.body:
+                if isinstance(node, ast.FunctionDef) and \
+                        node.name in spec.funcs:
+                    found[node.name] = node
+            for name in spec.funcs:
+                if name not in found:
+                    out.append(Violation(
+                        rule=self.name, path=module.path, line=1, col=0,
+                        func="<module>", symbol=name,
+                        message=(
+                            f"parity-coupled function {name} not found "
+                            f"at module top level"
+                        ),
+                    ))
+            if len(found) != len(spec.funcs):
+                continue
+            called = {
+                name: self._check_func(found[name], spec, module.path, out)
+                for name in spec.funcs
+            }
+            for helper in spec.required_helpers:
+                for name in spec.funcs:
+                    if helper not in called[name]:
+                        out.append(Violation(
+                            rule=self.name, path=module.path,
+                            line=found[name].lineno, col=0, func=name,
+                            symbol=helper,
+                            message=(
+                                f"{name} does not call shared per-row "
+                                f"helper {helper} — the delta/full pair "
+                                f"must route rows through the same "
+                                f"registry"
+                            ),
+                        ))
+        return out

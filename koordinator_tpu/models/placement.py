@@ -12,14 +12,15 @@ argmax reduction rides ICI collectives inserted by GSPMD.
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.extension import NUM_RESOURCES
 from koordinator_tpu.apis.types import ClusterSnapshot, GangMode
 from koordinator_tpu.models.finegrained import FineGrained
 from koordinator_tpu.ops.binpack import (
@@ -102,7 +103,8 @@ def measure_host_fallback_cells(
 
     probe_config = config._replace(unroll=1)
     solve = jax.jit(
-        lambda s, p_, pr: schedule_batch(s, p_, pr, probe_config)
+        lambda s, p_, pr: schedule_batch(s, p_, pr, probe_config),
+        static_argnums=(), donate_argnums=(),
     )
     run = lambda: np.asarray(solve(state, pods, params)[1])
     run()  # compile outside the timed rounds
@@ -208,94 +210,116 @@ class StagedStateCache:
         self.epoch = 0
         self.last_delta: Optional[NodeStagingDelta] = None
         self.last_path: Optional[str] = None       # "full" | "delta"
+        # schedule() is NOT reentrant — drive one model from one
+        # scheduler loop. What this lock guarantees is narrower and
+        # unconditional: ensure()'s compound mutation (in-place host
+        # patch, donated device scatter, epoch/delta bookkeeping) is
+        # atomic, and the (epoch, delta) pair it returns is captured
+        # under the same hold — so a racing caller sees a consistent
+        # cache and a loud donation error, never silently corrupted
+        # rows or a mispaired sidecar delta. Every mutable attribute
+        # above is mapped to this lock in graftcheck's lock-discipline
+        # registry.
+        self._lock = threading.Lock()
 
     def ensure(self, snapshot: ClusterSnapshot, want_device: bool = True
-               ) -> Tuple[NodeArrays, Optional[NodeState], Dict[str, float]]:
-        """(host arrays, staged state, {"lower_s", "stage_s"}) for this
-        snapshot — incrementally when the snapshot's tracker allows.
+               ) -> Tuple[NodeArrays, Optional[NodeState],
+                          Dict[str, float],
+                          Tuple[int, Optional[NodeStagingDelta]]]:
+        """(host arrays, staged state, {"lower_s", "stage_s"},
+        (epoch, delta)) for this snapshot — incrementally when the
+        snapshot's tracker allows. The trailing (epoch, delta) pair is
+        the sidecar wire protocol's sync point, captured under the same
+        lock hold that produced it: reading it from the cache after
+        ensure() returns could pair this call's epoch with a racing
+        call's rows.
 
         ``want_device=False`` keeps only the host half fresh (the delta
         bookkeeping and sidecar rows still advance): callers that will
         restage anyway — a NodeState carrying NUMA inventories — skip
         the device scatter entirely; the device half is re-established
         from the current host arrays the next time it is wanted."""
-        tracker = getattr(snapshot, "delta_tracker", None)
-        # sync point: the epoch captured when the snapshot was TAKEN
-        # (under the producer's lock) when available — a mark racing in
-        # after that carries a later epoch and re-lowers next tick. The
-        # live epoch is only a fallback for single-threaded producers
-        # that mutate their snapshot in place.
-        epoch_now = getattr(snapshot, "delta_epoch", None)
-        if epoch_now is None and tracker is not None:
-            epoch_now = tracker.epoch
-        t0 = time.perf_counter()
-        if (
-            tracker is not None
-            and tracker is self.tracker
-            and self.arrays is not None
-            and tracker.structure_epoch <= self.seen_epoch
-        ):
-            dirty = tracker.dirty_since(self.seen_epoch)
-            idx = lower_nodes_delta(
-                snapshot, self.arrays, dirty,
-                **self.model.lowering_kwargs(),
-            )
-            if idx is not None:
-                self.seen_epoch = epoch_now
-                t1 = time.perf_counter()
-                base = self.epoch
-                if idx.size:
-                    rows = {
-                        f: np.ascontiguousarray(getattr(self.arrays, f)[idx])
-                        for f in STAGED_NODE_FIELDS
-                    }
-                    if want_device and self.state is not None:
-                        sidx, srows = bucket_row_update(idx, rows)
-                        self.state = scatter_node_rows_donated(
-                            self.state, jnp.asarray(sidx), srows
+        with self._lock:
+            tracker = getattr(snapshot, "delta_tracker", None)
+            # sync point: the epoch captured when the snapshot was TAKEN
+            # (under the producer's lock) when available — a mark racing
+            # in after that carries a later epoch and re-lowers next
+            # tick. The live epoch is only a fallback for
+            # single-threaded producers that mutate their snapshot in
+            # place.
+            epoch_now = getattr(snapshot, "delta_epoch", None)
+            if epoch_now is None and tracker is not None:
+                epoch_now = tracker.epoch
+            t0 = time.perf_counter()
+            if (
+                tracker is not None
+                and tracker is self.tracker
+                and self.arrays is not None
+                and tracker.structure_epoch <= self.seen_epoch
+            ):
+                dirty = tracker.dirty_since(self.seen_epoch)
+                idx = lower_nodes_delta(
+                    snapshot, self.arrays, dirty,
+                    **self.model.lowering_kwargs(),
+                )
+                if idx is not None:
+                    self.seen_epoch = epoch_now
+                    t1 = time.perf_counter()
+                    base = self.epoch
+                    if idx.size:
+                        rows = {
+                            f: np.ascontiguousarray(
+                                getattr(self.arrays, f)[idx]
+                            )
+                            for f in STAGED_NODE_FIELDS
+                        }
+                        if want_device and self.state is not None:
+                            sidx, srows = bucket_row_update(idx, rows)
+                            self.state = scatter_node_rows_donated(
+                                self.state, jnp.asarray(sidx), srows
+                            )
+                            jax.block_until_ready(self.state)
+                        else:
+                            self.state = None  # device half stale
+                        self.epoch += 1
+                        self.last_delta = NodeStagingDelta(
+                            self.epoch, base, idx, rows
                         )
-                        jax.block_until_ready(self.state)
                     else:
-                        self.state = None  # device half stale
-                    self.epoch += 1
-                    self.last_delta = NodeStagingDelta(
-                        self.epoch, base, idx, rows
-                    )
-                else:
-                    self.last_delta = NodeStagingDelta(
-                        self.epoch, base, idx, {}
-                    )
-                if want_device and self.state is None:
-                    # re-establish the device half from the current
-                    # host arrays (content unchanged — the sidecar
-                    # epoch does not move)
-                    self.state = self.model.stage_nodes(self.arrays)
-                    jax.block_until_ready(self.state)
-                self.last_path = "delta"
-                return self.arrays, self.state, {
-                    "lower_s": t1 - t0,
-                    "stage_s": time.perf_counter() - t1,
-                }
-        # full (re)lower + (re)stage — the cold path and every fallback
-        if epoch_now is None:
-            epoch_now = -1
-        arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
-        t1 = time.perf_counter()
-        state = None
-        if want_device:
-            state = self.model.stage_nodes(arrays)
-            jax.block_until_ready(state)
-        self.arrays = arrays
-        self.state = state
-        self.tracker = tracker
-        self.seen_epoch = epoch_now
-        self.epoch += 1
-        self.last_delta = NodeStagingDelta(self.epoch)
-        self.last_path = "full"
-        return arrays, state, {
-            "lower_s": t1 - t0,
-            "stage_s": time.perf_counter() - t1,
-        }
+                        self.last_delta = NodeStagingDelta(
+                            self.epoch, base, idx, {}
+                        )
+                    if want_device and self.state is None:
+                        # re-establish the device half from the current
+                        # host arrays (content unchanged — the sidecar
+                        # epoch does not move)
+                        self.state = self.model.stage_nodes(self.arrays)
+                        jax.block_until_ready(self.state)
+                    self.last_path = "delta"
+                    return self.arrays, self.state, {
+                        "lower_s": t1 - t0,
+                        "stage_s": time.perf_counter() - t1,
+                    }, (self.epoch, self.last_delta)
+            # full (re)lower + (re)stage — cold path and every fallback
+            if epoch_now is None:
+                epoch_now = -1
+            arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
+            t1 = time.perf_counter()
+            state = None
+            if want_device:
+                state = self.model.stage_nodes(arrays)
+                jax.block_until_ready(state)
+            self.arrays = arrays
+            self.state = state
+            self.tracker = tracker
+            self.seen_epoch = epoch_now
+            self.epoch += 1
+            self.last_delta = NodeStagingDelta(self.epoch)
+            self.last_path = "full"
+            return arrays, state, {
+                "lower_s": t1 - t0,
+                "stage_s": time.perf_counter() - t1,
+            }, (self.epoch, self.last_delta)
 
 
 class PlacementModel:
@@ -393,7 +417,9 @@ class PlacementModel:
         from koordinator_tpu.ops.pallas_binpack import pallas_supported
 
         self._pallas_eligible = pallas_supported(self.params, self.config)
-        self._solve = jax.jit(solve_batch, static_argnames=("config",))
+        self._solve = jax.jit(
+            solve_batch, static_argnames=("config",), donate_argnums=()
+        )
         #: device-resident staging reused across schedule() calls when
         #: the snapshot carries a ClusterDeltaTracker (steady-state
         #: ticks re-lower + re-upload only the dirty node rows)
@@ -492,7 +518,7 @@ class PlacementModel:
         cache_times = None
         self._staging_delta = None
         if getattr(snapshot, "delta_tracker", None) is not None:
-            node_arrays, staged_state, cache_times = (
+            node_arrays, staged_state, cache_times, self._staging_delta = (
                 self.staged_cache.ensure(
                     snapshot,
                     # a NUMA-carrying NodeState restages below anyway —
@@ -501,9 +527,6 @@ class PlacementModel:
                     # on a topology flip, none in steady state)
                     want_device=not self._numa_staging,
                 )
-            )
-            self._staging_delta = (
-                self.staged_cache.epoch, self.staged_cache.last_delta
             )
         else:
             node_arrays = lower_nodes(

@@ -203,7 +203,9 @@ def scatter_node_rows(state: NodeState, idx, rows) -> NodeState:
 
 #: the jitted, input-donating form every staging cache shares (one
 #: compiled program per (N, D) shape pair)
-scatter_node_rows_donated = jax.jit(scatter_node_rows, donate_argnums=(0,))
+scatter_node_rows_donated = jax.jit(
+    scatter_node_rows, donate_argnums=(0,), static_argnums=()
+)
 
 
 def bucket_row_update(idx, rows):

@@ -445,6 +445,7 @@ def pallas_supported(params: ScoreParams, config) -> bool:
     jax.jit,
     static_argnames=("wsum", "interpret", "most_allocated", "n_shards",
                      "axis_name", "kernel_unroll"),
+    donate_argnums=(),
 )
 def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
                   wsum: int, interpret: bool, quota=None, numa=None,
@@ -682,6 +683,7 @@ def _pallas_solve(state: NodeState, pods: PodBatch, params: ScoreParams,
     jax.jit,
     static_argnames=("wsum", "interpret", "has_gang", "most_allocated",
                      "kernel_unroll"),
+    donate_argnums=(),
 )
 def _solve_full(state, pods, params, quota_state, gang_state, numa_aux,
                 wsum: int, interpret: bool, has_gang: bool,
@@ -891,7 +893,7 @@ def pallas_solve_batch(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("n_nodes",))
+@functools.partial(jax.jit, static_argnames=("n_nodes",), donate_argnums=())
 def resv_node_onehot(node, n_nodes: int):
     """The [Vp, Np] reservation→node-lane one-hot the in-kernel credit
     matmul contracts against — exactly the padding math `_pallas_solve`

@@ -36,7 +36,7 @@ from typing import NamedTuple, Tuple
 
 import numpy as np
 
-from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.extension import ResourceName
 
 
 class RebalanceVerdict(NamedTuple):

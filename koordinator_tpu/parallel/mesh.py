@@ -136,6 +136,7 @@ def shard_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
         partial(schedule_batch, config=config),
         in_shardings=(state_sh, pods_sh, params_sh),
         out_shardings=(state_sh, rep),
+        static_argnums=(), donate_argnums=(),
     )
 
 
@@ -297,7 +298,7 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
             check_vma=False,
         )
 
-        @jax.jit
+        @partial(jax.jit, static_argnums=(), donate_argnums=())
         def run(state, pods, params, quota_in, npol, resv_in, quota_state,
                 gang_state):
             new_state, assign, qused, qnp, consumed_k, resv_out = (
@@ -352,7 +353,8 @@ def shard_full_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
     jit_full = jax.jit(
         lambda s, p, pr, q, g, x, r, n: solve_batch(
             s, p, pr, config, q, g, extras=x, resv=r, numa=n
-        )
+        ),
+        static_argnums=(), donate_argnums=(),
     )
 
     def solve(state, pods, params, quota_state=None, gang_state=None,

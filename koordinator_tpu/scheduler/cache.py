@@ -32,6 +32,10 @@ class SchedulerCache:
     the tracker, so the model's staging cache re-lowers only what
     actually changed between scheduling rounds. Gang/quota updates
     don't mark — they never enter the node arrays (lowered per solve).
+
+    Concurrency: every mutable mapping below is mapped to ``_lock`` in
+    graftcheck's lock-discipline registry (docs/DESIGN.md §11) — any
+    access outside ``with self._lock`` fails tier-1 statically.
     """
 
     def __init__(self) -> None:

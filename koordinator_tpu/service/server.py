@@ -60,7 +60,9 @@ POD_FIELDS = (
 )
 
 #: one jit cache for every connection (static config hashes per value)
-_jit_solve = jax.jit(solve_batch, static_argnames=("config",))
+_jit_solve = jax.jit(
+    solve_batch, static_argnames=("config",), donate_argnums=()
+)
 
 #: kernel routing availability, mirroring PlacementModel.use_pallas:
 #: None = decide at first solve (single TPU chip => on).
@@ -318,7 +320,8 @@ def _cached_solve(state, pods, params, config, quota, gang, extras, resv,
         jit_fn = jax.jit(
             lambda s, p, pr, q, g, x, r, n: solve_batch(
                 s, p, pr, config, q, g, x, r, n
-            )
+            ),
+            static_argnums=(), donate_argnums=(),
         )
         try:
             fn = _exec_cache().get_or_compile(

@@ -32,7 +32,6 @@ from koordinator_tpu.apis.extension import (
 from koordinator_tpu.apis.types import (
     ClusterSnapshot,
     NodeMetric,
-    NodeSpec,
     PodSpec,
     resources_to_vector,
 )
@@ -308,6 +307,17 @@ def _clip_i32(a: np.ndarray) -> np.ndarray:
     return np.clip(a, info.min, info.max).astype(np.int32)
 
 
+def _metric_fresh(now, update_time, metric_expiration_seconds):
+    """The metric-expiration verdict, shared per-row helper style:
+    scalar in :func:`_node_metric_row`, vectorized (numpy broadcasting
+    over the cached ``metric_update_time`` column) in
+    :func:`lower_nodes_delta`'s freshness-drift recompute. One
+    definition means the full and delta paths can never disagree on
+    what "fresh" means (graftcheck's delta-parity rule pins both paths
+    to this registry)."""
+    return (now - update_time) < metric_expiration_seconds
+
+
 def _node_metric_row(
     metric: NodeMetric,
     assigned,
@@ -357,7 +367,7 @@ def _node_metric_row(
         # estimated (the OR clause at load_aware.go:357-358)
         score_vec = resources_to_vector(agg)
         score_agg_nil = agg is None
-    fresh = (now - metric.update_time) < metric_expiration_seconds
+    fresh = _metric_fresh(now, metric.update_time, metric_expiration_seconds)
     est_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
     reported_sum = np.zeros(NUM_RESOURCES, dtype=np.int64)
     for pod in assigned:
@@ -541,9 +551,9 @@ def lower_nodes_delta(
     # freshness drift: ``now`` moved, so recompute every node's
     # expiration verdict from the cached update times (vectorized — no
     # per-node python) and fold flips into the changed-row set
-    fresh_now = (
-        snapshot.now - prev.metric_update_time
-    ) < metric_expiration_seconds
+    fresh_now = _metric_fresh(
+        snapshot.now, prev.metric_update_time, metric_expiration_seconds
+    )
     flipped = np.nonzero(fresh_now != prev.metric_fresh)[0]
 
     sub_index = {name: k for k, name in enumerate(sorted(dirty))}

@@ -1,0 +1,8 @@
+"""Seeded dead-import violations — parsed by graftcheck's self-test,
+never imported or executed."""
+
+import json                     # VIOLATION: never used
+import os.path                  # VIOLATION: binds `os`, never used
+from collections import OrderedDict, defaultdict  # OrderedDict VIOLATION
+
+live = defaultdict(list)

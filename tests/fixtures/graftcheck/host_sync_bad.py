@@ -1,0 +1,37 @@
+"""Seeded host-sync violations — parsed by graftcheck's self-test,
+never imported or executed. Each marked line must be detected."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+solve = jax.jit(lambda s: s * 2, static_argnums=(), donate_argnums=())
+
+
+def hot_loop(state):
+    scores = jnp.asarray(state)
+    staged = jax.device_put(scores)
+    result = solve(staged)
+    host = jax.device_get(result)            # VIOLATION: device_get
+    result.block_until_ready()               # VIOLATION: method barrier
+    jax.block_until_ready(result)            # VIOLATION: free-fn barrier
+    best = float(result[0])                  # VIOLATION: float() coercion
+    count = int(scores.sum())                # VIOLATION: int() coercion
+    flag = bool(result.any())                # VIOLATION: bool() coercion
+    copied = np.asarray(result)              # VIOLATION: np.asarray
+    return host, best, count, flag, copied
+
+
+def match_hot(state, mode):
+    result = solve(jnp.asarray(state))
+    match mode:
+        case "strict":
+            return float(result[0])          # VIOLATION: inside match
+        case _:
+            return jax.device_get(result)    # VIOLATION: inside match
+
+
+def cold_path(host_rows):
+    # untainted: parameters start as host values, so none of these flag
+    total = int(np.asarray(host_rows).sum())
+    return float(total), bool(total)

@@ -1,0 +1,29 @@
+"""Seeded jit-hygiene violations — parsed by graftcheck's self-test,
+never imported or executed."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit                                   # VIOLATION: bare decorator
+def undeclared_step(x):
+    return x + 1
+
+
+# VIOLATION: declares neither static_arg* nor donate_arg*
+naked = jax.jit(lambda x: x * 2)
+
+# VIOLATION: partial form still needs both declarations
+partial_naked = functools.partial(jax.jit)(lambda x: x - 1)
+
+# ok: both surfaces declared (empty tuple IS a declaration)
+declared = jax.jit(
+    lambda x, n: x[:n], static_argnums=(1,), donate_argnums=()
+)
+
+
+def churn(xs):
+    # VIOLATION: per-call-varying Python scalar into a jitted callable
+    return declared(jnp.asarray(xs), len(xs))

@@ -1,0 +1,30 @@
+"""Seeded lock-discipline violations — parsed by graftcheck's
+self-test, never imported or executed."""
+
+import threading
+
+
+class RacyCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.epoch = 0          # exempt: constructor
+        self.rows = {}
+
+    def good_mark(self, name):
+        with self._lock:
+            self.epoch += 1
+            self.rows[name] = self.epoch
+
+    def bad_mark(self, name):
+        self.epoch += 1         # VIOLATION: write outside lock
+        self.rows[name] = self.epoch  # VIOLATION x2: read + write outside
+
+    def bad_read(self):
+        return self.epoch       # VIOLATION: read outside lock
+
+    def escaping_closure(self):
+        with self._lock:
+            # nested defs run later, after the lock is released
+            def later():
+                return self.rows  # VIOLATION: closure escapes the lock
+            return later

@@ -1,0 +1,25 @@
+"""Seeded delta-parity violations — parsed by graftcheck's self-test,
+never imported or executed. The pair must route row values through
+``_row_helper``; the delta path here inlines the math instead."""
+
+import numpy as np
+
+
+def _row_helper(metric, scale):
+    return metric * scale
+
+
+def lower_full(snapshot):
+    out = np.zeros((len(snapshot), 4))
+    for i, metric in enumerate(snapshot):
+        out[i] = _row_helper(metric, 2)
+    return out
+
+
+def lower_delta(snapshot, prev, dirty):
+    for i in dirty:
+        prev[i] = snapshot[i] * 2          # VIOLATION: inline arithmetic
+        prev[i] += 1                       # VIOLATION: inline aug-arith
+        prev[i] = np.maximum(prev[i], 0)   # VIOLATION: inline np.maximum
+    # VIOLATION (coupling): lower_delta never calls _row_helper
+    return prev

@@ -79,6 +79,52 @@ class TestBusUnderContention:
             assert set(mirror) == set(store)
 
 
+class TestDeltaTrackerUnderContention:
+    def test_concurrent_marks_never_lost_and_epochs_monotone(self):
+        """Regression net for the PR-6 snapshot-epoch race fix:
+        concurrent ``mark_node`` calls racing snapshot-style epoch
+        captures. Invariants: a mark is visible to ``dirty_since(e)``
+        for ANY epoch e captured before the mark (no lost dirty rows),
+        every mark gets a distinct epoch, and each thread's own marks
+        carry strictly increasing epochs (the unlocked ``epoch += 1``
+        this guards against would let two racing marks share one)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from koordinator_tpu.state.cluster import ClusterDeltaTracker
+
+        tracker = ClusterDeltaTracker()
+        e0 = tracker.epoch
+        threads, per = 8, 150
+
+        def worker(i):
+            observed, names = [], []
+            for k in range(per):
+                name = f"t{i}-m{k}"
+                before = tracker.epoch  # a consumer's sync-point capture
+                tracker.mark_node(name)
+                # the mark must land at an epoch AFTER any previously
+                # captured sync point — a consumer synced at `before`
+                # can never lose it
+                assert name in tracker.dirty_since(before), name
+                # only this thread ever writes this key; reading it
+                # races nothing
+                observed.append(tracker._marks[name])
+                names.append(name)
+            return observed, names
+
+        with ThreadPoolExecutor(max_workers=threads) as ex:
+            results = list(ex.map(worker, range(threads)))
+        all_epochs = [e for obs, _ in results for e in obs]
+        assert len(set(all_epochs)) == len(all_epochs), (
+            "two marks shared an epoch"
+        )
+        for obs, _ in results:
+            assert obs == sorted(obs), "a thread saw non-monotone epochs"
+        marked = {n for _, names in results for n in names}
+        assert set(tracker.dirty_since(e0)) == marked, "lost dirty rows"
+        assert tracker.epoch == e0 + threads * per
+
+
 class TestElectionUnderContention:
     def test_fenced_writes_serialize_across_leaders(self):
         """16 electors ticking concurrently across expiring leases.

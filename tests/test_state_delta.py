@@ -249,7 +249,7 @@ def test_staged_cache_device_state_property(seed):
     for round_i in range(12):
         for _ in range(int(rng.integers(1, 5))):
             _mutate(snapshot, tracker, rng, counters)
-        arrays, state, _times = cache.ensure(snapshot)
+        arrays, state, _times, _staging = cache.ensure(snapshot)
         paths.add(cache.last_path)
         want = model.stage_nodes(lower_nodes(snapshot))
         for f in ARRAY_FIELDS:
@@ -348,14 +348,14 @@ def test_staged_cache_device_half_skip_and_reestablish():
     snapshot, tracker = _build(rng, n_nodes=8)
     model = PlacementModel(use_pallas=False)
     cache = StagedStateCache(model)
-    arrays, state, _ = cache.ensure(snapshot, want_device=False)
+    arrays, state, _, _ = cache.ensure(snapshot, want_device=False)
     assert state is None and cache.last_path == "full"
     tracker.mark_node(snapshot.nodes[0].name)
     snapshot.nodes[0] = _node_replacement(snapshot.nodes[0], rng)
-    arrays, state, _ = cache.ensure(snapshot, want_device=False)
+    arrays, state, _, _ = cache.ensure(snapshot, want_device=False)
     assert state is None and cache.last_path == "delta"
     # now the device half is wanted again: rebuilt from host arrays
-    arrays, state, _ = cache.ensure(snapshot)
+    arrays, state, _, _ = cache.ensure(snapshot)
     assert state is not None
     want = model.stage_nodes(lower_nodes(snapshot))
     for f in ARRAY_FIELDS:
@@ -380,6 +380,6 @@ def test_snapshot_epoch_sync_point():
     tracker.mark_node(snapshot.nodes[2].name)
     # the next tick's snapshot carries the new epoch: the row re-lowers
     snapshot.delta_epoch = tracker.epoch
-    arrays, state, _ = cache.ensure(snapshot)
+    arrays, state, _, _ = cache.ensure(snapshot)
     assert cache.last_path == "delta"
     _assert_arrays_equal(arrays, lower_nodes(snapshot), "post-race")

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# The one-command gate: graftcheck (hot-path AST invariants) + the
+# tier-1 test suite. Exits non-zero if either fails. CI and pre-commit
+# both call this; bench.py additionally records the graftcheck
+# violation count in every bench record (docs/DESIGN.md §11).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftcheck =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m koordinator_tpu.analysis.graftcheck "$@"
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
