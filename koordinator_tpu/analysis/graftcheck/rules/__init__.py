@@ -31,6 +31,7 @@ HOT_MODULES = (
     "koordinator_tpu/ops/*.py",
     "koordinator_tpu/state/cluster.py",
     "koordinator_tpu/service/server.py",
+    "koordinator_tpu/service/admission.py",
     "koordinator_tpu/parallel/mesh.py",
 )
 
@@ -60,6 +61,12 @@ LOCK_SPECS = (
             "arrays", "state", "tracker", "seen_epoch", "epoch",
             "last_delta", "last_path",
         ),
+    ),
+    LockSpec(
+        path="koordinator_tpu/service/admission.py",
+        class_name="AdmissionGate",
+        lock="_lock",
+        attrs=("_lanes", "_closed", "_stats", "_undelivered"),
     ),
 )
 

@@ -39,7 +39,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--debug-port", type=int, default=None,
         help="serve /apis/v1/plugins/solver (routing + kernel-breaker "
-             "state) and /healthz on this port",
+             "+ admission-gate state), /metrics (admission queue/shed/"
+             "latency series) and /healthz on this port",
     )
     args = parser.parse_args(argv)
 
@@ -61,15 +62,19 @@ def main(argv=None) -> int:
     service.start()
     debug_server = None
     if args.debug_port is not None:
+        from koordinator_tpu.metrics.components import SOLVER_METRICS
         from koordinator_tpu.scheduler.monitor import DebugServices
         from koordinator_tpu.utils.debug_http import DebugHTTPServer
 
         services = DebugServices()
-        # the solver's operational state — notably the kernel-routing
-        # breaker, so "why is this sidecar riding the scan?" is one GET
+        # the solver's operational state — the kernel-routing breaker
+        # ("why is this sidecar riding the scan?") and the admission
+        # gate (lane depths, coalesce ratio, shed counts) in one GET;
+        # /metrics serves the same gate as prometheus series
         services.register("solver", service.status)
         debug_server = DebugHTTPServer(
-            services=services, port=args.debug_port
+            services=services, metrics=SOLVER_METRICS,
+            port=args.debug_port
         ).start()
     print(f"koord-solver: serving on {args.listen}")
     try:

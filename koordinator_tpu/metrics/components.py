@@ -76,6 +76,38 @@ CONTAINER_CPI_METRIC = KOORDLET_EXTERNAL_METRICS.gauge(
     label_names=("pod", "container"),
 )
 
+# -- koord-solver sidecar (service/admission.py gate) -----------------------
+
+SOLVER_METRICS = Registry("koord-solver")
+SOLVER_ADMISSION_WAIT = SOLVER_METRICS.histogram(
+    "solver_admission_wait_seconds",
+    "Queue wait from enqueue to dispatch, per QoS lane",
+    label_names=("lane",),
+)
+SOLVER_SOLVE_DURATION = SOLVER_METRICS.histogram(
+    "solver_batch_solve_seconds",
+    "Device solve wall-clock per dispatched admission batch",
+)
+SOLVER_ADMISSION_SHED = SOLVER_METRICS.counter(
+    "solver_admission_shed_total",
+    "Requests shed by the admission gate",
+    label_names=("lane", "reason"),  # overloaded | deadline | shutdown
+)
+SOLVER_QUEUE_DEPTH = SOLVER_METRICS.gauge(
+    "solver_admission_queue_depth",
+    "Currently queued requests per QoS lane",
+    label_names=("lane",),
+)
+SOLVER_ADMISSION_REQUESTS = SOLVER_METRICS.counter(
+    "solver_admission_requests_total",
+    "Requests dispatched to the device, by batch mode",
+    label_names=("mode",),  # coalesced | solo
+)
+SOLVER_ADMISSION_BATCHES = SOLVER_METRICS.counter(
+    "solver_admission_batches_total",
+    "Device dispatches (coalesce ratio = requests_total / this)",
+)
+
 # -- koord-descheduler (pkg/descheduler/metrics) ----------------------------
 
 DESCHEDULER_METRICS = Registry("koord-descheduler")

@@ -383,6 +383,11 @@ def solve_batch(
     and gang-group all-or-nothing admission resolves at batch end with
     rejected Strict gangs' resources (including reservation consumption
     and NUMA holds) released.
+
+    Every step is integer arithmetic end to end (scores included), so
+    ``jax.vmap`` over a leading request axis is bit-identical to
+    running each lane alone — the admission gate's coalescing
+    (service/admission.py) leans on exactly this property.
     """
     n_pods = pods.req.shape[0]
     use_q = quota_state is not None

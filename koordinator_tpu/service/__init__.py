@@ -18,5 +18,16 @@ from koordinator_tpu.service.codec import (  # noqa: F401
     read_frame,
     write_frame,
 )
+from koordinator_tpu.service.admission import (  # noqa: F401
+    AdmissionConfig,
+    AdmissionGate,
+    solve_coalesced,
+)
 from koordinator_tpu.service.server import PlacementService  # noqa: F401
-from koordinator_tpu.service.client import PlacementClient  # noqa: F401
+from koordinator_tpu.service.client import (  # noqa: F401
+    PlacementClient,
+    SolverDeadlineExceeded,
+    SolverOverloaded,
+    SolverShuttingDown,
+    SolverUnavailable,
+)

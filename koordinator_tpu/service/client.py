@@ -14,7 +14,9 @@ plane can skip the round and retry.
 
 from __future__ import annotations
 
+import random
 import socket
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -22,6 +24,26 @@ import numpy as np
 
 class SolverUnavailable(ConnectionError):
     """The sidecar cannot be reached (after reconnect attempts)."""
+
+
+class SolverOverloaded(RuntimeError):
+    """The sidecar's admission gate shed this request (typed
+    ``overloaded`` response). The stream stays in sync — the request
+    frame got a clean error frame — so the connection is reusable and
+    the right reaction is jittered backoff, not reconnect churn."""
+
+
+class SolverDeadlineExceeded(RuntimeError):
+    """The request expired in the sidecar's admission queue (typed
+    ``deadline-exceeded`` response) or its client-side budget ran out
+    before a response arrived. Not retried: the caller's latency
+    budget is gone by definition."""
+
+
+class SolverShuttingDown(ConnectionError):
+    """The sidecar is draining for shutdown (typed ``shutting-down``
+    response): reconnect-and-retry territory, like a restart."""
+
 
 from koordinator_tpu.service.codec import (
     SolveRequest,
@@ -55,6 +77,14 @@ class PlacementClient:
             raise ConnectionError("solver closed the connection")
         response = decode_response(payload)
         if response.error:
+            # admission-gate typed errors (the frame was read cleanly,
+            # so the stream stays usable for overloaded retries)
+            if response.error.startswith("overloaded"):
+                raise SolverOverloaded(response.error)
+            if response.error.startswith("deadline-exceeded"):
+                raise SolverDeadlineExceeded(response.error)
+            if response.error.startswith("shutting-down"):
+                raise SolverShuttingDown(response.error)
             raise RuntimeError(f"solver error: {response.error}")
         return response
 
@@ -65,6 +95,11 @@ class PlacementClient:
         params: Dict[str, np.ndarray],
     ) -> SolveResponse:
         return self.solve(SolveRequest(node=node, pods=pods, params=params))
+
+    def set_timeout(self, timeout: float) -> None:
+        """Rebind the socket timeout (RemoteSolver caps each attempt's
+        wait by the caller's remaining deadline budget)."""
+        self._sock.settimeout(timeout)
 
     def close(self) -> None:
         self._stream.close()
@@ -103,11 +138,49 @@ class RemoteSolver:
     supports_staging_delta = True
 
     def __init__(self, address, secret: Optional[bytes] = None,
-                 timeout: float = 120.0, retries: int = 1):
+                 timeout: float = 120.0, retries: int = 1,
+                 deadline_s: Optional[float] = None,
+                 lane=None,
+                 retry_total_s: float = 2.0,
+                 backoff_base_s: float = 0.025,
+                 backoff_cap_s: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        """``deadline_s`` is the per-solve latency budget: propagated on
+        the wire (the sidecar's admission gate sheds the request once
+        the budget is spent instead of solving abandoned work), capping
+        each attempt's socket wait, and bounding retries. ``lane`` is
+        the QoS lane (``"system"``/``"ls"``/``"be"``, a lane code, or a
+        :class:`~koordinator_tpu.apis.extension.QoSClass`). Transient
+        failures — reconnects AND typed ``overloaded`` sheds — retry
+        with jittered exponential backoff (``backoff_base_s`` doubling
+        up to ``backoff_cap_s``) under a total-deadline cap of
+        ``deadline_s`` (when set) or ``retry_total_s``: a slow or
+        shedding sidecar can no longer hang a scheduler tick for the
+        full socket timeout. ``retries`` keeps its old meaning as the
+        guaranteed minimum retry count even when the budget is tiny."""
+        from koordinator_tpu.apis.extension import QoSClass
+        from koordinator_tpu.service.admission import (
+            LANE_BY_NAME,
+            lane_for_qos,
+        )
+
         self.address = address
         self.secret = secret
         self.timeout = timeout
         self.retries = retries
+        self.deadline_s = deadline_s
+        self.retry_total_s = retry_total_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._rng = rng if rng is not None else random.Random()
+        if lane is None:
+            self.lane: Optional[int] = None
+        elif isinstance(lane, QoSClass):
+            self.lane = lane_for_qos(lane)
+        elif isinstance(lane, str):
+            self.lane = LANE_BY_NAME[lane]
+        else:
+            self.lane = int(lane)
         self._client: Optional[PlacementClient] = None
         #: the staged-state epoch the CONNECTED sidecar holds as its
         #: delta base (None = none established / connection lost)
@@ -116,11 +189,17 @@ class RemoteSolver:
         #: or "delta" (observability/tests)
         self.last_request: Optional[str] = None
 
-    def _connect(self) -> PlacementClient:
+    def _connect(self, remaining: Optional[float] = None) -> PlacementClient:
+        timeout = self.timeout
+        if remaining is not None:
+            # never park on the socket past the caller's budget
+            timeout = max(0.05, min(self.timeout, remaining))
         if self._client is None:
             self._client = PlacementClient(
-                self.address, timeout=self.timeout, secret=self.secret
+                self.address, timeout=timeout, secret=self.secret
             )
+        else:
+            self._client.set_timeout(timeout)
         return self._client
 
     def _drop(self) -> None:
@@ -164,7 +243,16 @@ class RemoteSolver:
             },
         )
 
-        def build_request():
+        def build_request(remaining: Optional[float]):
+            admission = None
+            if remaining is not None or self.lane is not None:
+                admission = {}
+                if remaining is not None:
+                    admission["deadline_s"] = np.asarray(
+                        max(0.0, remaining), np.float64
+                    )
+                if self.lane is not None:
+                    admission["lane"] = np.asarray(self.lane, np.int64)
             delta = staging[1] if staging is not None else None
             if (
                 delta is not None
@@ -182,26 +270,55 @@ class RemoteSolver:
                 node_delta.update(delta.rows or {})
                 self.last_request = "delta"
                 return SolveRequest(
-                    node={}, node_delta=node_delta, **common
+                    node={}, node_delta=node_delta, admission=admission,
+                    **common
                 )
             node_delta = None
             if staging is not None:
                 node_delta = {"epoch": np.asarray(staging[0], np.int64)}
             self.last_request = "establish" if node_delta else "full"
             return SolveRequest(
-                node=_group(state), node_delta=node_delta, **common
+                node=_group(state), node_delta=node_delta,
+                admission=admission, **common
             )
 
+        # transient failures (reconnects, typed overloaded sheds) retry
+        # with jittered exponential backoff under one total-deadline
+        # cap: deadline_s when the caller set a budget, retry_total_s
+        # otherwise. Per-ATTEMPT socket waits shrink to the remaining
+        # budget only when deadline_s is set — that is opt-in by
+        # design, because an un-deadlined first solve may legitimately
+        # sit behind a multi-second cold-start compile
+        start = time.monotonic()
+        budget = (self.deadline_s if self.deadline_s is not None
+                  else self.retry_total_s)
         last_error: Optional[Exception] = None
-        conn_attempts = 0
+        attempt = 0
         mismatch_retry = True
-        while conn_attempts <= self.retries:
+        while True:
+            remaining = None
+            if self.deadline_s is not None:
+                remaining = self.deadline_s - (time.monotonic() - start)
+                if remaining <= 0:
+                    raise SolverDeadlineExceeded(
+                        f"deadline-exceeded: {self.deadline_s}s budget "
+                        f"spent client-side (last: "
+                        f"{type(last_error).__name__ if last_error else None})"
+                    )
             try:
-                response = self._connect().solve(build_request())
+                response = self._connect(remaining).solve(
+                    build_request(remaining)
+                )
                 break
+            except SolverDeadlineExceeded:
+                # the budget is gone by definition: retrying is pointless
+                raise
+            except SolverOverloaded as e:
+                # clean typed error frame — stream in sync, connection
+                # kept; back off below instead of reconnect churn
+                last_error = e
             except (ConnectionError, OSError, EOFError) as e:
                 last_error = e
-                conn_attempts += 1
                 self._drop()
             except RuntimeError as e:
                 if "delta-base-mismatch" in str(e) and mismatch_retry:
@@ -218,11 +335,19 @@ class RemoteSolver:
                 # retry would read the previous round's assignments
                 self._drop()
                 raise
-        else:
-            raise SolverUnavailable(
-                f"placement sidecar at {self.address!r} unreachable: "
-                f"{type(last_error).__name__}: {last_error}"
-            )
+            delay = min(
+                self.backoff_cap_s, self.backoff_base_s * (2 ** attempt)
+            ) * (0.5 + 0.5 * self._rng.random())
+            attempt += 1
+            elapsed = time.monotonic() - start
+            if attempt > self.retries and elapsed + delay >= budget:
+                if isinstance(last_error, SolverOverloaded):
+                    raise last_error
+                raise SolverUnavailable(
+                    f"placement sidecar at {self.address!r} unreachable: "
+                    f"{type(last_error).__name__}: {last_error}"
+                )
+            time.sleep(delay)
         if staging is not None:
             self._server_epoch = int(staging[0])
         new_state = state

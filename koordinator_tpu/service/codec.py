@@ -28,6 +28,9 @@ import numpy as np
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 30
+#: wire protocol revision: 2 added the optional ``admission`` group
+#: (deadline + QoS lane) and unknown-prefix-tolerant request decoding.
+PROTOCOL_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -57,6 +60,16 @@ class SolveRequest:
     #: answers with a ``delta-base-mismatch`` error and the client
     #: re-establishes with a full request.
     node_delta: Optional[Dict[str, np.ndarray]] = None
+    #: admission-gate metadata (wire v2): ``deadline_s`` (float64 scalar,
+    #: the caller's remaining latency budget — the server sheds the
+    #: request with a typed ``deadline-exceeded`` error instead of
+    #: solving work the caller already abandoned) and ``lane`` (int64
+    #: QoS-lane code, service/admission.py LANE_*). Absent means "no
+    #: deadline, latency-sensitive lane", so v1 clients ride through
+    #: unchanged; from v2 on, decode skips unknown prefixes so future
+    #: groups degrade the same way (a v2 client against a v1 server
+    #: gets that server's typed "decode failed" error, not a hang).
+    admission: Optional[Dict[str, np.ndarray]] = None
 
 
 @dataclasses.dataclass
@@ -115,7 +128,7 @@ def _unpack(payload: bytes) -> Dict[str, np.ndarray]:
 _REQ_GROUPS = (
     ("node", "n."), ("pods", "p."), ("params", "s."), ("quota", "q."),
     ("gang", "g."), ("extras", "x."), ("resv", "r."), ("numa", "u."),
-    ("config", "c."), ("node_delta", "d."),
+    ("config", "c."), ("node_delta", "d."), ("admission", "a."),
 )
 
 _RESP_OPTIONAL = (
@@ -140,7 +153,10 @@ def decode_request(payload: bytes) -> SolveRequest:
     groups: Dict[str, Dict[str, np.ndarray]] = {}
     for key, value in _unpack(payload).items():
         prefix, name = key[:2], key[2:]
-        groups.setdefault(by_prefix[prefix], {})[name] = value
+        field = by_prefix.get(prefix)
+        if field is None:
+            continue  # newer-protocol group this server doesn't speak
+        groups.setdefault(field, {})[name] = value
     return SolveRequest(
         node=groups.get("node", {}),
         pods=groups.get("pods", {}),

@@ -1,10 +1,16 @@
 """The solver sidecar: hosts the batched placement solve behind the wire
 boundary.
 
-One thread per connection, one solve per request frame. The solver keeps
-its jit cache across requests (the first solve pays compilation; repeat
-shapes are cached), which is the point of the sidecar: the control plane
-restarts freely while the compiled solver stays warm.
+One thread per connection reads request frames, but solves no longer
+run inline: every request passes through the admission gate
+(service/admission.py) — a bounded, QoS-laned queue drained by a single
+executor that coalesces same-base plain requests into one device
+dispatch, enforces deadlines, and sheds best-effort work first under
+overload (``PlacementService(admission=False)`` restores the inline
+path). The solver keeps its jit cache across requests (the first solve
+pays compilation; repeat shapes are cached), which is the point of the
+sidecar: the control plane restarts freely while the compiled solver
+stays warm.
 
 Security: the UDS default inherits filesystem permissions. The TCP mode
 is for trusted networks (the control-plane↔solver link of the north
@@ -41,6 +47,7 @@ from koordinator_tpu.ops.binpack import (
 )
 from koordinator_tpu.ops.gang import GangState
 from koordinator_tpu.ops.quota import QuotaState
+from koordinator_tpu.service.admission import AdmissionConfig, AdmissionGate
 from koordinator_tpu.service.codec import (
     SolveRequest,
     SolveResponse,
@@ -527,6 +534,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 payload = read_frame(stream)
                 if payload is None:
                     return
+                entry = None
                 try:
                     request = decode_request(payload)
                 except Exception as e:
@@ -536,11 +544,25 @@ class _Handler(socketserver.BaseRequestHandler):
                         error=f"decode failed: {type(e).__name__}: {e}",
                     )
                 else:
-                    response = solve_from_request(
-                        request, self.server.solver_config, node_cache
-                    )
-                write_frame(stream, encode_response(response))
-                stream.flush()
+                    gate = self.server.admission_gate
+                    if gate is None:
+                        response = solve_from_request(
+                            request, self.server.solver_config, node_cache
+                        )
+                    else:
+                        entry = gate.submit(
+                            request, self.server.solver_config, node_cache
+                        )
+                        response = entry.wait()
+                try:
+                    write_frame(stream, encode_response(response))
+                    stream.flush()
+                finally:
+                    # count the delivery attempt even when the peer is
+                    # gone, or stop()'s bounded delivery wait would
+                    # burn its full timeout on a dead client
+                    if entry is not None:
+                        entry.delivered()
         finally:
             self.server.active_connections.discard(self.request)
             stream.close()
@@ -548,10 +570,18 @@ class _Handler(socketserver.BaseRequestHandler):
 
 class PlacementService:
     """The sidecar server (UDS by default; TCP for cross-host —
-    trusted-network-only unless ``secret`` is set)."""
+    trusted-network-only unless ``secret`` is set).
+
+    ``admission`` selects the front-end: ``True`` (default) runs every
+    solve through an :class:`AdmissionGate` with default sizing, an
+    :class:`AdmissionConfig` customizes it, and ``False``/``None``
+    restores the legacy inline per-connection solve (no queueing, no
+    deadlines, no coalescing — the pre-gate behavior, kept as the
+    bench baseline and an escape hatch)."""
 
     def __init__(self, address, config: SolverConfig = SolverConfig(),
-                 secret: Optional[bytes] = None):
+                 secret: Optional[bytes] = None,
+                 admission=True):
         self.address = address
         if isinstance(address, str):
             # a dead predecessor leaves its socket file behind; unlink it
@@ -582,6 +612,17 @@ class PlacementService:
         self._server.solver_config = config
         self._server.shared_secret = secret
         self._server.active_connections = set()
+        if admission:
+            gate_cfg = (admission if isinstance(admission, AdmissionConfig)
+                        else AdmissionConfig())
+            self.gate: Optional[AdmissionGate] = AdmissionGate(
+                solve_from_request, gate_cfg,
+                # a lone connected client never pays the coalesce window
+                peer_count=self._server.active_connections.__len__,
+            )
+        else:
+            self.gate = None
+        self._server.admission_gate = self.gate
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
@@ -592,15 +633,25 @@ class PlacementService:
 
     def status(self) -> dict:
         """Debug/status snapshot: the address served, live connection
-        count, and the kernel-routing breaker state (so an operator can
-        see WHY solves ride the scan instead of the kernel)."""
+        count, the kernel-routing breaker state (so an operator can
+        see WHY solves ride the scan instead of the kernel), and the
+        admission gate's lane depths / coalesce ratio / shed counts."""
         return {
             "address": self.address,
             "active_connections": len(self._server.active_connections),
             "kernel_breaker": kernel_breaker_status(),
+            "admission": None if self.gate is None else self.gate.stats(),
         }
 
     def stop(self) -> None:
+        # drain the admission gate FIRST: queued requests are answered
+        # with a typed shutting-down error frame, and the bounded
+        # delivery wait lets handler threads flush those frames before
+        # connections are severed — in-flight clients see an error,
+        # not a reset
+        if self.gate is not None:
+            self.gate.shutdown()
+            self.gate.wait_delivered(timeout=2.0)
         self._server.shutdown()
         # sever live connections too — a stopped sidecar must look like
         # a dead process to its clients, not a half-open socket

@@ -1,0 +1,520 @@
+"""Admission gate (ISSUE 3 / DESIGN §12): QoS lanes, deadlines,
+best-effort-first shedding, and same-base coalescing that is
+bit-identical to sequential solves."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, QoSClass
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.service.admission import (
+    LANE_BE,
+    LANE_LS,
+    LANE_SYSTEM,
+    AdmissionConfig,
+    AdmissionGate,
+    coalesce_key,
+    lane_for_qos,
+    solve_coalesced,
+)
+from koordinator_tpu.service.client import (
+    PlacementClient,
+    SolverDeadlineExceeded,
+    SolverShuttingDown,
+)
+from koordinator_tpu.service.codec import SolveRequest, SolveResponse
+from koordinator_tpu.service.server import PlacementService, solve_from_request
+
+
+def _base(n_nodes=6, seed=0):
+    """Shared node/params groups (the coalescing base)."""
+    rng = np.random.default_rng(seed)
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+    alloc[:, R.CPU] = 16000
+    alloc[:, R.MEMORY] = 32768
+    used = np.zeros_like(alloc)
+    used[:, R.CPU] = rng.integers(0, 4000, n_nodes)
+    node = {
+        "alloc": alloc,
+        "used_req": used,
+        "usage": np.zeros_like(alloc),
+        "prod_usage": np.zeros_like(alloc),
+        "est_extra": np.zeros_like(alloc),
+        "prod_base": np.zeros_like(alloc),
+        "metric_fresh": np.ones(n_nodes, bool),
+        "schedulable": np.ones(n_nodes, bool),
+    }
+    weights = np.zeros(NUM_RESOURCES, np.int32)
+    weights[R.CPU] = 1
+    weights[R.MEMORY] = 1
+    thresholds = np.zeros(NUM_RESOURCES, np.int32)
+    thresholds[R.CPU] = 65
+    thresholds[R.MEMORY] = 95
+    params = {
+        "weights": weights,
+        "thresholds": thresholds,
+        "prod_thresholds": np.zeros(NUM_RESOURCES, np.int32),
+    }
+    return node, params
+
+
+def _pods(n_pods, seed):
+    rng = np.random.default_rng(seed)
+    req = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+    req[:, R.CPU] = rng.choice([500, 1000, 2000, 3000], n_pods)
+    req[:, R.MEMORY] = rng.choice([256, 1024, 2048], n_pods)
+    return {
+        "req": req,
+        "est": (req * 85) // 100,
+        "is_prod": rng.uniform(size=n_pods) < 0.4,
+        "is_daemonset": np.zeros(n_pods, bool),
+    }
+
+
+def _request(n_nodes=6, n_pods=5, seed=0, pod_seed=None, **over):
+    node, params = _base(n_nodes, seed)
+    req = SolveRequest(
+        node=node, params=params,
+        pods=_pods(n_pods, seed if pod_seed is None else pod_seed),
+    )
+    for k, v in over.items():
+        setattr(req, k, v)
+    return req
+
+
+def _stub_response(request):
+    n = int(np.asarray(request.pods["req"]).shape[0])
+    return SolveResponse(assignments=np.zeros(n, np.int32))
+
+
+class TestCoalescedBitIdentity:
+    def test_property_coalesced_equals_sequential(self):
+        """THE coalescing contract: K same-base requests merged into one
+        device dispatch split back bit-identical to K solves run one by
+        one against the same staged state — across random node counts,
+        segment counts, and segment lengths."""
+        rng = np.random.default_rng(42)
+        for trial in range(6):
+            n_nodes = int(rng.integers(3, 25))
+            k = int(rng.integers(2, 6))
+            requests = [
+                _request(
+                    n_nodes=n_nodes, seed=trial,
+                    n_pods=int(rng.integers(1, 14)),
+                    pod_seed=int(rng.integers(0, 2**31)),
+                )
+                for _ in range(k)
+            ]
+            keys = {coalesce_key(r) for r in requests}
+            assert len(keys) == 1 and None not in keys
+            sequential = [solve_from_request(r) for r in requests]
+            coalesced = solve_coalesced(requests)
+            for i, (want, got) in enumerate(zip(sequential, coalesced)):
+                assert want.error == "" and got.error == ""
+                for field in ("assignments", "node_used_req", "commit",
+                              "waiting", "rejected", "raw_assign"):
+                    np.testing.assert_array_equal(
+                        getattr(want, field), getattr(got, field),
+                        err_msg=f"trial {trial} segment {i} field {field}",
+                    )
+
+class TestCoalesceKey:
+    def test_same_base_same_key_different_pods(self):
+        a = _request(n_pods=3, pod_seed=1)
+        b = _request(n_pods=9, pod_seed=2)
+        assert coalesce_key(a) == coalesce_key(b) is not None
+
+    def test_node_bytes_differ_key_differs(self):
+        a = _request(seed=0)
+        b = _request(seed=0)
+        b.node["used_req"] = np.array(b.node["used_req"], copy=True)
+        b.node["used_req"][0, R.CPU] += 1
+        assert coalesce_key(a) != coalesce_key(b)
+
+    def test_feature_groups_ride_solo(self):
+        assert coalesce_key(
+            _request(quota={"used": np.zeros((1, NUM_RESOURCES))})
+        ) is None
+        assert coalesce_key(
+            _request(node_delta={"epoch": np.asarray(1, np.int64)})
+        ) is None
+
+    def test_pod_dtype_schema_in_key(self):
+        a = _request(pod_seed=1)
+        b = _request(pod_seed=2)
+        b.pods["req"] = b.pods["req"].astype(np.int64)
+        assert coalesce_key(a) != coalesce_key(b)
+
+    def test_lane_mapping(self):
+        assert lane_for_qos(QoSClass.SYSTEM) == LANE_SYSTEM
+        assert lane_for_qos(QoSClass.BE) == LANE_BE
+        for q in (QoSClass.LS, QoSClass.LSR, QoSClass.LSE, QoSClass.NONE):
+            assert lane_for_qos(q) == LANE_LS
+
+
+class _BlockingSolve:
+    """A solve_fn the test can hold closed to pin the executor."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.order = []
+
+    def __call__(self, request, config, node_cache):
+        self.order.append(request)
+        self.entered.set()
+        assert self.release.wait(10), "test forgot to release the solve"
+        return _stub_response(request)
+
+
+def _solo_request(tag: int, **over):
+    """A request that can never coalesce (unique quota group) with a
+    distinguishable pod count."""
+    req = _request(n_pods=2 + tag % 3, pod_seed=tag)
+    req.quota = {"tag": np.asarray([tag])}
+    for k, v in over.items():
+        setattr(req, k, v)
+    return req
+
+
+def _lane_group(lane, deadline_s=None):
+    adm = {"lane": np.asarray(lane, np.int64)}
+    if deadline_s is not None:
+        adm["deadline_s"] = np.asarray(deadline_s, np.float64)
+    return adm
+
+
+class TestGateSemantics:
+    def _gate(self, solve_fn, **cfg):
+        return AdmissionGate(solve_fn, AdmissionConfig(**cfg))
+
+    def test_lanes_drain_in_priority_order(self):
+        solve = _BlockingSolve()
+        gate = self._gate(solve, capacity=16)
+        try:
+            blocker = gate.submit(_solo_request(0), None)
+            assert solve.entered.wait(5)
+            entries = [
+                gate.submit(
+                    _solo_request(1, admission=_lane_group(LANE_BE)), None
+                ),
+                gate.submit(
+                    _solo_request(2, admission=_lane_group(LANE_LS)), None
+                ),
+                gate.submit(
+                    _solo_request(3, admission=_lane_group(LANE_SYSTEM)),
+                    None,
+                ),
+            ]
+            solve.release.set()
+            for e in [blocker] + entries:
+                assert e.wait(10).error == ""
+            # order: blocker, then system > ls > be regardless of arrival
+            tags = [
+                int(np.asarray(r.quota["tag"]).item())
+                for r in solve.order
+            ]
+            assert tags == [0, 3, 2, 1]
+        finally:
+            gate.shutdown(timeout=1)
+
+    def test_deadline_expired_in_queue_typed_error(self):
+        solve = _BlockingSolve()
+        gate = self._gate(solve)
+        try:
+            blocker = gate.submit(_solo_request(0), None)
+            assert solve.entered.wait(5)
+            doomed = gate.submit(
+                _solo_request(1, admission=_lane_group(LANE_LS, 0.02)),
+                None,
+            )
+            time.sleep(0.05)  # expire while the executor is pinned
+            solve.release.set()
+            assert blocker.wait(10).error == ""
+            resp = doomed.wait(10)
+            assert resp.error.startswith("deadline-exceeded")
+            assert gate.stats()["shed"]["deadline-exceeded"] == 1
+        finally:
+            gate.shutdown(timeout=1)
+
+    def test_shed_best_effort_first(self):
+        solve = _BlockingSolve()
+        gate = self._gate(solve, capacity=2)
+        try:
+            blocker = gate.submit(_solo_request(0), None)
+            assert solve.entered.wait(5)
+            be_old = gate.submit(
+                _solo_request(1, admission=_lane_group(LANE_BE)), None
+            )
+            be_new = gate.submit(
+                _solo_request(2, admission=_lane_group(LANE_BE)), None
+            )
+            # queue full: an LS arrival evicts the NEWEST BE entry
+            ls = gate.submit(
+                _solo_request(3, admission=_lane_group(LANE_LS)), None
+            )
+            shed = be_new.wait(5)
+            assert shed is not None and shed.error.startswith("overloaded")
+            # ...but a BE arrival with nothing below it is itself refused
+            be_refused = gate.submit(
+                _solo_request(4, admission=_lane_group(LANE_BE)), None
+            )
+            # (be lane still has be_old; an equal-lane arrival outranks
+            # nothing — shedding only reaches STRICTLY lower lanes)
+            refused = be_refused.wait(5)
+            assert refused.error.startswith("overloaded")
+            solve.release.set()
+            assert blocker.wait(10).error == ""
+            assert ls.wait(10).error == ""
+            assert be_old.wait(10).error == ""
+            assert gate.stats()["shed"]["overloaded"] == 2
+        finally:
+            gate.shutdown(timeout=1)
+
+    def test_shutdown_fails_queued_typed(self):
+        solve = _BlockingSolve()
+        gate = self._gate(solve)
+        blocker = gate.submit(_solo_request(0), None)
+        assert solve.entered.wait(5)
+        queued = gate.submit(_solo_request(1), None)
+        solve.release.set()
+        gate.shutdown(timeout=5)
+        assert blocker.wait(5).error == ""  # in-flight still answered
+        assert queued.wait(5).error.startswith("shutting-down")
+        late = gate.submit(_solo_request(2), None)
+        assert late.wait(5).error.startswith("shutting-down")
+
+    def test_coalesced_batch_one_dispatch(self):
+        """K same-base requests queued behind a blocker drain as ONE
+        batch: requests_total jumps by K while batches_total +1."""
+        solve = _BlockingSolve()
+        gate = self._gate(solve, capacity=32, max_coalesce=8)
+        try:
+            blocker = gate.submit(_solo_request(0), None)
+            assert solve.entered.wait(5)
+            same = [
+                _request(n_nodes=5, seed=9, n_pods=3 + i, pod_seed=50 + i)
+                for i in range(4)
+            ]
+            entries = [gate.submit(r, None) for r in same]
+            solve.release.set()
+            responses = [e.wait(20) for e in entries]
+            for r, req in zip(responses, same):
+                assert r.error == ""
+                np.testing.assert_array_equal(
+                    r.assignments, solve_from_request(req).assignments
+                )
+            st = gate.stats()
+            assert st["requests_total"] == 5
+            assert st["batches_total"] == 2  # blocker + one fused batch
+            assert st["coalesced_requests_total"] == 4
+            assert st["coalesce_ratio"] == pytest.approx(2.5)
+        finally:
+            gate.shutdown(timeout=1)
+
+    def test_lone_client_skips_coalesce_window(self):
+        """With <= 1 peer connected nobody can coalesce, so a solo
+        coalescible request must dispatch immediately instead of
+        lingering out the micro-batching window."""
+        def instant(request, config, node_cache):
+            return _stub_response(request)
+
+        gate = AdmissionGate(
+            instant,
+            AdmissionConfig(coalesce_window_s=0.5),
+            peer_count=lambda: 1,
+        )
+        try:
+            t0 = time.monotonic()
+            entry = gate.submit(_request(), None)
+            resp = entry.wait(5)
+            assert resp is not None and resp.error == ""
+            assert time.monotonic() - t0 < 0.3  # no 0.5s window linger
+        finally:
+            gate.shutdown(timeout=1)
+
+    def test_multi_peer_waits_the_window(self):
+        def instant(request, config, node_cache):
+            return _stub_response(request)
+
+        gate = AdmissionGate(
+            instant,
+            AdmissionConfig(coalesce_window_s=0.3),
+            peer_count=lambda: 2,
+        )
+        try:
+            t0 = time.monotonic()
+            entry = gate.submit(_request(), None)
+            resp = entry.wait(5)
+            assert resp is not None and resp.error == ""
+            assert time.monotonic() - t0 >= 0.25  # window honored
+        finally:
+            gate.shutdown(timeout=1)
+
+    def test_internal_error_is_typed_not_silence(self):
+        def boom(request, config, node_cache):
+            raise RuntimeError("staging exploded")
+
+        gate = AdmissionGate(boom, AdmissionConfig())
+        try:
+            entry = gate.submit(_solo_request(0), None)
+            resp = entry.wait(5)
+            assert resp.error.startswith("internal")
+            assert "staging exploded" in resp.error
+        finally:
+            gate.shutdown(timeout=1)
+
+
+class TestServiceIntegration:
+    def test_concurrent_identical_clients_bit_identical(self, tmp_path):
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        service.start()
+        want = solve_from_request(_request(n_pods=6, pod_seed=3))
+        results, errors = {}, []
+        barrier = threading.Barrier(6)
+
+        def worker(i):
+            try:
+                with PlacementClient(addr, timeout=120.0) as client:
+                    barrier.wait(timeout=30)
+                    results[i] = client.solve(
+                        _request(n_pods=6, pod_seed=3)
+                    ).assignments
+            except Exception as e:  # noqa: BLE001 — surface in main thread
+                errors.append(e)
+
+        try:
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors
+            assert len(results) == 6
+            for got in results.values():
+                np.testing.assert_array_equal(got, want.assignments)
+            st = service.status()["admission"]
+            assert st["requests_total"] == 6
+            assert st["batches_total"] >= 1
+            assert st["coalesce_ratio"] >= 1.0
+        finally:
+            service.stop()
+
+    def test_deadline_exceeded_over_wire(self, tmp_path):
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        service.start()
+        inner = service.gate._solve_fn
+        hold = threading.Event()
+
+        def slow(request, config, node_cache):
+            hold.wait(5)
+            return inner(request, config, node_cache)
+
+        service.gate._solve_fn = slow
+        try:
+            with PlacementClient(addr, timeout=60.0) as busy:
+                t = threading.Thread(
+                    target=busy.solve, args=(_request(seed=11),)
+                )
+                t.start()
+                time.sleep(0.2)  # the slow solve now pins the executor
+                with PlacementClient(addr, timeout=60.0) as client:
+                    with pytest.raises(SolverDeadlineExceeded):
+                        client.solve(_request(
+                            admission=_lane_group(LANE_LS, 0.05)
+                        ))
+                hold.set()
+                t.join(timeout=30)
+        finally:
+            hold.set()
+            service.stop()
+
+    def test_stop_delivers_shutting_down_frame(self, tmp_path):
+        """Satellite 6: stop() drains queued requests into typed
+        shutting-down error FRAMES before severing — a waiting client
+        sees an error response, never a bare reset."""
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        service.start()
+        inner = service.gate._solve_fn
+        hold = threading.Event()
+
+        def slow(request, config, node_cache):
+            hold.wait(10)
+            return inner(request, config, node_cache)
+
+        service.gate._solve_fn = slow
+        outcome = {}
+
+        def busy_worker():
+            with PlacementClient(addr, timeout=60.0) as c:
+                outcome["busy"] = c.solve(_request(seed=21))
+
+        def queued_worker():
+            try:
+                with PlacementClient(addr, timeout=60.0) as c:
+                    c.solve(_request(seed=22))
+            except Exception as e:  # noqa: BLE001
+                outcome["queued"] = e
+
+        t1 = threading.Thread(target=busy_worker)
+        t1.start()
+        time.sleep(0.2)
+        t2 = threading.Thread(target=queued_worker)
+        t2.start()
+        time.sleep(0.2)
+
+        def release_soon():
+            time.sleep(0.3)
+            hold.set()  # let the in-flight solve finish during stop()
+
+        threading.Thread(target=release_soon).start()
+        service.stop()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        assert isinstance(outcome.get("queued"), SolverShuttingDown)
+        # the in-flight request was still answered with a real solve
+        assert outcome["busy"].error == ""
+
+    def test_admission_metrics_on_debug_http(self, tmp_path):
+        """Satellite 1: the gate's series ride the same /metrics
+        surface as everything else, next to the kernel-breaker status
+        in /apis/v1/plugins/solver."""
+        import json
+        import urllib.request
+
+        from koordinator_tpu.metrics.components import SOLVER_METRICS
+        from koordinator_tpu.scheduler.monitor import DebugServices
+        from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        service.start()
+        services = DebugServices()
+        services.register("solver", service.status)
+        debug = DebugHTTPServer(
+            services=services, metrics=SOLVER_METRICS
+        ).start()
+        try:
+            with PlacementClient(addr) as client:
+                client.solve(_request())
+            base = f"http://127.0.0.1:{debug.port}"
+            text = urllib.request.urlopen(base + "/metrics").read().decode()
+            assert "solver_admission_batches_total" in text
+            assert "solver_admission_queue_depth" in text
+            assert "solver_admission_wait_seconds_bucket" in text
+            payload = json.loads(urllib.request.urlopen(
+                base + "/apis/v1/plugins/solver"
+            ).read().decode())
+            assert payload["kernel_breaker"] is not None
+            assert payload["admission"]["requests_total"] >= 1
+        finally:
+            debug.stop()
+            service.stop()

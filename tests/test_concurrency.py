@@ -125,6 +125,72 @@ class TestDeltaTrackerUnderContention:
         assert tracker.epoch == e0 + threads * per
 
 
+class TestAdmissionGateUnderContention:
+    def test_every_submit_answered_and_accounted(self):
+        """8 threads hammer the bounded gate with mixed lanes and some
+        already-expired deadlines: EVERY submit must come back with a
+        real response or a typed error (never silence / a hang), and
+        the gate's books must balance — dispatched + shed == submitted,
+        queues empty, all frames marked delivered."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from koordinator_tpu.service.admission import (
+            AdmissionConfig,
+            AdmissionGate,
+        )
+        from koordinator_tpu.service.codec import SolveRequest, SolveResponse
+
+        def stub(request, config, node_cache):
+            n = int(np.asarray(request.pods["req"]).shape[0])
+            return SolveResponse(assignments=np.zeros(n, np.int32))
+
+        gate = AdmissionGate(
+            stub, AdmissionConfig(capacity=16, max_coalesce=1)
+        )
+        n_threads, per = 8, 40
+
+        def worker(i):
+            rng = np.random.default_rng(i)
+            outcomes = []
+            for k in range(per):
+                adm = {"lane": np.asarray(int(rng.integers(0, 3)), np.int64)}
+                if k % 7 == 0:
+                    adm["deadline_s"] = np.asarray(0.0, np.float64)
+                req = SolveRequest(
+                    node={"x": np.asarray([i, k])},
+                    pods={"req": np.zeros((2, 4), np.int32)},
+                    params={},
+                    admission=adm,
+                )
+                entry = gate.submit(req, None)
+                resp = entry.wait(timeout=30)
+                entry.delivered()
+                assert resp is not None, "a submit was never answered"
+                outcomes.append(resp.error)
+            return outcomes
+
+        try:
+            with ThreadPoolExecutor(max_workers=n_threads) as ex:
+                results = list(ex.map(worker, range(n_threads)))
+            errors = [e for out in results for e in out]
+            assert len(errors) == n_threads * per
+            allowed = ("", "overloaded", "deadline-exceeded")
+            assert all(e.startswith(allowed) for e in errors)
+            # every submit is accounted exactly once: dispatched or shed
+            st = gate.stats()
+            shed = st["shed"]
+            assert (
+                st["requests_total"]
+                + shed["overloaded"]
+                + shed["deadline-exceeded"]
+                + shed["shutting-down"]
+            ) == n_threads * per
+            assert all(d == 0 for d in st["queue_depth"].values())
+            assert gate.wait_delivered(timeout=2.0)
+        finally:
+            gate.shutdown(timeout=2)
+
+
 class TestElectionUnderContention:
     def test_fenced_writes_serialize_across_leaders(self):
         """16 electors ticking concurrently across expiring leases.

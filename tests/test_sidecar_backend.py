@@ -161,3 +161,204 @@ class TestAddressParsing:
         assert parse_address("/tmp/x.sock") == "/tmp/x.sock"
         assert parse_address("127.0.0.1:9999") == ("127.0.0.1", 9999)
         assert parse_address(":9999") == ("127.0.0.1", 9999)
+
+
+class _ScriptedSidecar:
+    """A minimal frame server for retry tests: answers the first
+    ``shed_first`` requests with a typed ``overloaded`` error, then
+    real solves; records every decoded request."""
+
+    def __init__(self, addr: str, shed_first: int = 0):
+        import socket
+        import threading
+
+        from koordinator_tpu.service.admission import error_response
+        from koordinator_tpu.service.codec import (
+            decode_request,
+            encode_response,
+            read_frame,
+            write_frame,
+        )
+        from koordinator_tpu.service.server import solve_from_request
+
+        self.requests = []
+        self._shed_first = shed_first
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(addr)
+        self._sock.listen(4)
+        self._sock.settimeout(0.2)
+
+        def serve_conn(conn):
+            stream = conn.makefile("rwb")
+            try:
+                while True:
+                    payload = read_frame(stream)
+                    if payload is None:
+                        return
+                    req = decode_request(payload)
+                    self.requests.append(req)
+                    if len(self.requests) <= self._shed_first:
+                        resp = error_response(
+                            "overloaded", "scripted shed"
+                        )
+                    else:
+                        resp = solve_from_request(req)
+                    write_frame(stream, encode_response(resp))
+                    stream.flush()
+            except (OSError, EOFError, ValueError):
+                pass
+            finally:
+                stream.close()
+                conn.close()
+
+        def accept_loop():
+            import socket as _socket
+
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except (_socket.timeout, OSError):
+                    continue
+                threading.Thread(
+                    target=serve_conn, args=(conn,), daemon=True
+                ).start()
+
+        self._thread = threading.Thread(target=accept_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._sock.close()
+
+
+def _wire_problem(n_nodes=4, n_pods=5):
+    """(state, batch, params, config) device inputs for solve_result."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from koordinator_tpu.apis.extension import NUM_RESOURCES
+    from koordinator_tpu.ops.binpack import (
+        NodeState,
+        PodBatch,
+        ScoreParams,
+        SolverConfig,
+    )
+
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+    alloc[:, R.CPU] = 16000
+    alloc[:, R.MEMORY] = 32768
+    state = NodeState(
+        alloc=jnp.asarray(alloc),
+        used_req=jnp.zeros_like(jnp.asarray(alloc)),
+        usage=jnp.zeros_like(jnp.asarray(alloc)),
+        prod_usage=jnp.zeros_like(jnp.asarray(alloc)),
+        est_extra=jnp.zeros_like(jnp.asarray(alloc)),
+        prod_base=jnp.zeros_like(jnp.asarray(alloc)),
+        metric_fresh=jnp.ones(n_nodes, bool),
+        schedulable=jnp.ones(n_nodes, bool),
+    )
+    req = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+    req[:, R.CPU] = 1000
+    batch = PodBatch.build(
+        req=jnp.asarray(req), est=jnp.asarray((req * 85) // 100),
+        is_prod=jnp.zeros(n_pods, bool),
+        is_daemonset=jnp.zeros(n_pods, bool),
+    )
+    weights = np.zeros(NUM_RESOURCES, np.int32)
+    weights[R.CPU] = 1
+    thresholds = np.zeros(NUM_RESOURCES, np.int32)
+    thresholds[R.CPU] = 65
+    params = ScoreParams(
+        weights=jnp.asarray(weights),
+        thresholds=jnp.asarray(thresholds),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, np.int32),
+    )
+    return state, batch, params, SolverConfig()
+
+
+class TestRemoteSolverBackoff:
+    """Satellite 2: jittered exponential backoff with a total-deadline
+    cap for overloaded sheds AND unreachable sidecars — a slow/shedding
+    sidecar can no longer hang a scheduler tick for the socket timeout."""
+
+    def test_overloaded_retries_then_succeeds(self, tmp_path):
+        import numpy as np
+
+        addr = str(tmp_path / "scripted.sock")
+        sidecar = _ScriptedSidecar(addr, shed_first=2)
+        try:
+            solver = RemoteSolver(
+                addr, backoff_base_s=0.01, backoff_cap_s=0.05,
+                retry_total_s=10.0,
+            )
+            result = solver.solve_result(*_wire_problem())
+            assert (np.asarray(result.assign) >= 0).all()
+            # two sheds + the success all rode ONE connection
+            assert len(sidecar.requests) == 3
+            solver.close()
+        finally:
+            sidecar.stop()
+
+    def test_overloaded_exhausts_total_deadline_cap(self, tmp_path):
+        import time as _time
+
+        from koordinator_tpu.service.client import SolverOverloaded
+
+        addr = str(tmp_path / "scripted.sock")
+        sidecar = _ScriptedSidecar(addr, shed_first=10**6)
+        try:
+            solver = RemoteSolver(
+                addr, backoff_base_s=0.02, backoff_cap_s=0.1,
+                retry_total_s=0.3,
+            )
+            t0 = _time.monotonic()
+            with pytest.raises(SolverOverloaded):
+                solver.solve_result(*_wire_problem())
+            assert _time.monotonic() - t0 < 2.0
+            assert len(sidecar.requests) >= 2  # it did retry
+            solver.close()
+        finally:
+            sidecar.stop()
+
+    def test_deadline_and_lane_ride_the_wire(self, tmp_path):
+        import numpy as np
+
+        from koordinator_tpu.service.admission import LANE_BE
+
+        addr = str(tmp_path / "scripted.sock")
+        sidecar = _ScriptedSidecar(addr)
+        try:
+            solver = RemoteSolver(addr, deadline_s=5.0, lane="be")
+            solver.solve_result(*_wire_problem())
+            adm = sidecar.requests[0].admission
+            assert adm is not None
+            sent = float(np.asarray(adm["deadline_s"]).item())
+            assert 0.0 < sent <= 5.0  # the REMAINING budget crossed
+            assert int(np.asarray(adm["lane"]).item()) == LANE_BE
+            solver.close()
+        finally:
+            sidecar.stop()
+
+    def test_unreachable_bounded_by_total_deadline(self, tmp_path):
+        import time as _time
+
+        t0 = _time.monotonic()
+        solver = RemoteSolver(
+            str(tmp_path / "nowhere.sock"),
+            backoff_base_s=0.01, retry_total_s=0.3,
+        )
+        with pytest.raises(SolverUnavailable):
+            solver.solve_result(*_wire_problem())
+        assert _time.monotonic() - t0 < 2.0
+
+    def test_client_side_deadline_trumps_retries(self, tmp_path):
+        from koordinator_tpu.service.client import SolverDeadlineExceeded
+
+        solver = RemoteSolver(
+            str(tmp_path / "nowhere.sock"),
+            deadline_s=0.2, backoff_base_s=0.05,
+        )
+        with pytest.raises((SolverDeadlineExceeded, SolverUnavailable)):
+            solver.solve_result(*_wire_problem())
