@@ -31,6 +31,12 @@ reports ``{pods_per_sec, p99_s, identical_to_oracle}``:
    delta-staging path (ClusterDeltaTracker + StagedStateCache) vs full
    restage, tick-for-tick identical, with lower/stage/solve walls
    broken out (every other leg records the same breakdown);
+11. (extra) outage-failover churn — a sidecar-backed churn run with the
+   sidecar SIGKILLed mid-run under the supervised-restart + failover
+   stack: ticks-to-first-degraded-solve, degraded-tick count, recovery
+   wall from kill to the first post-restart remote solve, and
+   tick-identical final state vs the in-process fault-free run
+   (KTPU_BENCH_OUTAGE_NODES / _DIRTY / _TICKS reshape it);
 plus a ``sharded`` entry: multi-device solve throughput when >1 device
 is attached — the sharded PALLAS kernel (per-shard VMEM carry,
 in-kernel per-pod cross-shard winner merge) vs the GSPMD scan, winner
@@ -952,6 +958,216 @@ def bench_churn_tick(repeats):
     }
 
 
+def bench_outage_failover_churn(repeats):
+    """Config #11 (failure-domain hardening): a sidecar-backed churn
+    run with the sidecar SIGKILLed mid-churn, under the supervised
+    restart + degraded-mode failover stack (service/supervisor.py +
+    service/failover.py).
+
+    Reports the outage anatomy: ticks from the kill to the first
+    degraded (in-process) solve, ticks spent in degraded mode, wall
+    time from the kill to the first post-recovery remote solve, the
+    supervisor/failover counters — and ``tick_identical_to_inprocess``,
+    the whole point: every tick under the outage must match the
+    fault-free in-process run bit for bit."""
+    import tempfile
+
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot,
+        NodeMetric,
+        NodeSpec,
+        PodSpec,
+    )
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.service.client import RemoteSolver
+    from koordinator_tpu.service.failover import FailoverSolver
+    from koordinator_tpu.service.supervisor import SolverSupervisor
+    from koordinator_tpu.state.cluster import ClusterDeltaTracker
+    from koordinator_tpu.testing.chaos import InProcessSidecar
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    n_nodes = int(os.environ.get("KTPU_BENCH_OUTAGE_NODES", 512))
+    dirty_per_tick = int(os.environ.get("KTPU_BENCH_OUTAGE_DIRTY", 16))
+    pending_per_tick = 32
+    ticks = max(20, int(os.environ.get("KTPU_BENCH_OUTAGE_TICKS", 40)))
+    kill_tick = ticks // 3
+
+    def build():
+        rng = np.random.default_rng(42)
+        nodes = [
+            NodeSpec(name=f"n{i}", allocatable={CPU: 64000, MEM: 131072})
+            for i in range(n_nodes)
+        ]
+        metrics = {
+            f"n{i}": NodeMetric(
+                node_name=f"n{i}",
+                node_usage={CPU: int(rng.integers(500, 30000)),
+                            MEM: int(rng.integers(512, 65536))},
+                update_time=10.0,
+            )
+            for i in range(n_nodes)
+        }
+        tracker = ClusterDeltaTracker()
+        return ClusterSnapshot(
+            nodes=nodes, pods=[], pending_pods=[], node_metrics=metrics,
+            now=20.0, delta_tracker=tracker,
+        ), tracker
+
+    def run(model, on_tick=None, warm=None):
+        snap, tracker = build()
+        rng = np.random.default_rng(7)
+        snap.pending_pods = []
+        model.schedule(snap)  # compile warmup, identical in both runs
+        if warm is not None:
+            warm()
+        log, walls, modes, done_at = [], [], [], []
+        for t in range(ticks):
+            now = 20.0 + t
+            for i in rng.choice(n_nodes, dirty_per_tick, replace=False):
+                name = f"n{int(i)}"
+                snap.node_metrics[name] = NodeMetric(
+                    node_name=name,
+                    node_usage={CPU: int(rng.integers(500, 30000)),
+                                MEM: int(rng.integers(512, 65536))},
+                    update_time=now,
+                )
+                tracker.mark_node(name)
+            snap.pending_pods = [
+                PodSpec(
+                    name=f"t{t}p{j}",
+                    requests={CPU: int(rng.integers(200, 1500)),
+                              MEM: int(rng.integers(128, 1024))},
+                )
+                for j in range(pending_per_tick)
+            ]
+            snap.now = now
+            if on_tick is not None:
+                on_tick(t)
+            by_uid = {p.uid: p for p in snap.pending_pods}
+            t0 = time.time()
+            result = model.schedule(snap)
+            walls.append(time.time() - t0)
+            done_at.append(time.time())
+            modes.append(model.last_solver)
+            log.append(sorted(result.items()))
+            for uid, node in result.items():
+                if node is not None:
+                    pod = by_uid[uid]
+                    pod.node_name = node
+                    pod.assign_time = now
+                    snap.pods.append(pod)
+                    tracker.mark_node(node)
+            snap.pending_pods = []
+        return log, walls, modes, done_at
+
+    tmp = tempfile.mkdtemp(prefix="ktpu-outage-")
+    addr = os.path.join(tmp, "solver.sock")
+    handles = []
+
+    def spawn():
+        handle = InProcessSidecar(addr)
+        handles.append(handle)
+        return handle
+
+    # respawn backoff deliberately exceeds the failover threshold's
+    # worth of tick budgets PLUS the local path's cold compile (~2s on
+    # CPU): a faster restart heals inside the client's own retries and
+    # the leg measures nothing
+    supervisor = SolverSupervisor(
+        addr, spawn_fn=spawn, probe_interval_s=0.2,
+        backoff_base_s=8.0, backoff_cap_s=8.0, ready_timeout_s=60.0,
+    ).start()
+    remote = RemoteSolver(addr, timeout=60.0, backoff_base_s=0.01,
+                          backoff_cap_s=0.05)
+    backend = FailoverSolver(remote, failure_threshold=2,
+                             recovery_probes=2)
+    model = PlacementModel(config=SolverConfig(unroll=BENCH_UNROLL),
+                           backend=backend, use_pallas=False)
+    backend.on_flip_back = model.reset_staging
+    kill_at = {"wall": None}
+
+    recovery_wait_tick = kill_tick + max(4, (ticks - kill_tick) // 2)
+
+    def on_tick(t):
+        if t == kill_tick:
+            kill_at["wall"] = time.time()
+            handles[-1].kill()
+        elif t == recovery_wait_tick:
+            # deterministic recovery point: block until the supervised
+            # respawn is serving so the remaining ticks measure the
+            # flip-back (hysteresis probes + full-restage establish)
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                if supervisor.status()["state"] == "running":
+                    return
+                time.sleep(0.05)
+        elif t > kill_tick and backend.status()["degraded"]:
+            # pace degraded ticks at a scheduler-loop-like cadence so
+            # the leg measures recovery against wall time instead of
+            # racing every remaining tick through the local solver
+            # before the supervised restart lands (the sleep runs
+            # OUTSIDE the timed tick wall)
+            time.sleep(0.15)
+
+    try:
+        log, walls, modes, done_at = run(
+            model, on_tick=on_tick,
+            # churn ticks carry a deadline so a dead sidecar costs a
+            # bounded budget per tick, not a socket timeout; the warmup
+            # above ran without one (cold compile)
+            warm=lambda: setattr(remote, "deadline_s", 0.5),
+        )
+        ref_model = PlacementModel(
+            config=SolverConfig(unroll=BENCH_UNROLL), use_pallas=False
+        )
+        ref_log, ref_walls, _ref_modes, _ref_done = run(ref_model)
+
+        degraded_ticks = [
+            i for i, m in enumerate(modes)
+            if m in ("local-fallback", "local-degraded")
+        ]
+        recovered_ticks = [
+            i for i, m in enumerate(modes)
+            if i > kill_tick and m == "remote"
+        ]
+        healthy_walls = [w for i, w in enumerate(walls)
+                        if modes[i] == "remote"]
+        status = backend.status()
+        return {
+            "tick_identical_to_inprocess": log == ref_log,
+            "ticks": ticks,
+            "kill_tick": kill_tick,
+            "ticks_to_first_degraded_solve": (
+                degraded_ticks[0] - kill_tick if degraded_ticks else None
+            ),
+            "ticks_in_degraded_mode": len(degraded_ticks),
+            "recovery_s": (
+                None if not recovered_ticks or kill_at["wall"] is None
+                else done_at[recovered_ticks[0]] - kill_at["wall"]
+            ),
+            "first_remote_tick_after_outage": (
+                recovered_ticks[0] if recovered_ticks else None
+            ),
+            "supervisor_restarts": supervisor.restarts_total,
+            "failovers_to_degraded": status["flips_to_degraded"],
+            "failovers_to_remote": status["flips_to_remote"],
+            "local_solves": status["local_solves"],
+            "tick_wall_s": sum(walls) / len(walls),
+            "healthy_tick_wall_s": (
+                sum(healthy_walls) / len(healthy_walls)
+                if healthy_walls else None
+            ),
+            "inprocess_tick_wall_s": sum(ref_walls) / len(ref_walls),
+            "n_nodes": n_nodes,
+            "pending_per_tick": pending_per_tick,
+        }
+    finally:
+        supervisor.stop()
+        backend.close()
+
+
 def bench_concurrent_solve(repeats):
     """Config #10 (PR 8): 8 concurrent sidecar clients hammering one
     solver — the admission gate's coalescing vs the per-connection
@@ -1472,6 +1688,9 @@ def main():
         matrix["9_churn_tick_5k"] = leg(bench_churn_tick, repeats)
         matrix["10_concurrent_solve_8way"] = leg(
             bench_concurrent_solve, repeats
+        )
+        matrix["11_outage_failover_churn"] = leg(
+            bench_outage_failover_churn, repeats
         )
     if os.environ.get("KTPU_BENCH_SHARDED", "1") != "0":
         matrix["sharded"] = leg(bench_sharded, repeats)

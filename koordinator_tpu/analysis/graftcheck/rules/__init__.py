@@ -32,6 +32,7 @@ HOT_MODULES = (
     "koordinator_tpu/state/cluster.py",
     "koordinator_tpu/service/server.py",
     "koordinator_tpu/service/admission.py",
+    "koordinator_tpu/service/failover.py",
     "koordinator_tpu/parallel/mesh.py",
 )
 
@@ -67,6 +68,30 @@ LOCK_SPECS = (
         class_name="AdmissionGate",
         lock="_lock",
         attrs=("_lanes", "_closed", "_stats", "_undelivered"),
+    ),
+    # the failover state machine: scheduler ticks, recovery probes, and
+    # status() readers all cross it (docs/DESIGN.md §13)
+    LockSpec(
+        path="koordinator_tpu/service/failover.py",
+        class_name="FailoverSolver",
+        lock="_lock",
+        attrs=(
+            "degraded", "degraded_since", "consecutive_failures",
+            "healthy_probes", "flips_to_degraded", "flips_to_remote",
+            "local_solves", "last_error", "last_mode",
+        ),
+    ),
+    # the supervisor: the monitor thread, start()/stop() callers, and
+    # status() readers share the child handle and restart bookkeeping
+    LockSpec(
+        path="koordinator_tpu/service/supervisor.py",
+        class_name="SolverSupervisor",
+        lock="_lock",
+        attrs=(
+            "_proc", "state", "restarts_total",
+            "consecutive_probe_failures", "last_exit_code",
+            "_backoff_attempt", "_spawned_at", "_ready_since_spawn",
+        ),
     ),
 )
 

@@ -42,6 +42,14 @@ class SchedulerConfig:
     placement_backend: str = "inprocess"
     solver_address: str = "/tmp/koord-solver.sock"
     solver_secret: Optional[bytes] = None
+    #: degraded-mode failover (service/failover.py): wrap the sidecar
+    #: backend so a solver outage is answered by the in-process solve
+    #: instead of a skipped round. K consecutive failures flip to
+    #: degraded; M consecutive healthy probes (hysteresis) flip back
+    #: with a full-restage epoch reset.
+    solver_failover: bool = False
+    solver_failover_threshold: int = 3
+    solver_failover_recovery_probes: int = 2
     #: plain solves with pods*nodes under this run on the host sequential
     #: path — a device round trip costs more than the whole solve there.
     #: -1 = MEASURE at startup (models/placement.py
@@ -70,6 +78,14 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
         backend = RemoteSolver(
             parse_address(config.solver_address), secret=config.solver_secret
         )
+        if config.solver_failover:
+            from koordinator_tpu.service.failover import FailoverSolver
+
+            backend = FailoverSolver(
+                backend,
+                failure_threshold=config.solver_failover_threshold,
+                recovery_probes=config.solver_failover_recovery_probes,
+            )
     elif config.placement_backend != "inprocess":
         raise ValueError(
             f"unknown placement backend: {config.placement_backend!r}"
@@ -112,6 +128,10 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
         backend=backend,
         host_fallback_cells=fallback_cells,
     )
+    if backend is not None and hasattr(backend, "on_flip_back"):
+        # failover flip-back forces a full relower+restage so the
+        # recovered sidecar's delta base is re-established from scratch
+        backend.on_flip_back = model.reset_staging
     scheduler = Scheduler(
         model=model,
         cluster_total=config.cluster_total,
@@ -124,16 +144,28 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
 
 
 def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
-             log=print, elector=None, now_fn=time.time) -> int:
+             log=print, elector=None, now_fn=time.time,
+             max_rounds: Optional[int] = None) -> int:
     """The scheduling loop over a wired bus: solve the pending queue
-    every interval; a sidecar outage skips the round (the control plane
-    retries — Run at cmd/koord-scheduler/app/server.go:159). With
-    ``elector``, rounds run only while holding the lease (the reference
-    gates sched.Run on OnStartedLeading, server.go:226-252); losing the
-    lease mid-round surfaces as FencingError and demotes to standby."""
+    every interval. A sidecar outage without failover skips the round —
+    COUNTED and logged, never silent (``scheduler_rounds_skipped_total``
+    carries the running total; with the failover backend wired,
+    ``--solver-failover``, outages are solved in-process and no round
+    skips). With ``elector``, rounds run only while holding the lease
+    (the reference gates sched.Run on OnStartedLeading,
+    server.go:226-252); losing the lease mid-round surfaces as
+    FencingError and demotes to standby. ``max_rounds`` bounds the loop
+    for regression tests: after that many attempted rounds the loop
+    returns the number of skipped rounds (0 = every round placed)."""
     from koordinator_tpu.client.leaderelection import FencingError
-    from koordinator_tpu.service.client import SolverUnavailable
+    from koordinator_tpu.metrics.components import ROUNDS_SKIPPED
+    from koordinator_tpu.service.client import (
+        SolverOverloaded,
+        SolverUnavailable,
+    )
 
+    skipped = 0
+    rounds = 0
     while True:
         if elector is not None and not elector.tick(now_fn()):
             log("standby: lease held elsewhere")
@@ -141,10 +173,18 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
                 return 3  # distinct from success: no round ran
             time.sleep(elector.retry_period)
             continue
+        rounds += 1
         try:
             out = scheduler.schedule_pending()
-        except SolverUnavailable as e:
-            log(f"round skipped: {e}")
+        except (SolverUnavailable, SolverOverloaded) as e:
+            # overloaded past the client's retry budget is an outage
+            # from this seat: skip (counted), retry next round
+            skipped += 1
+            reason = ("solver-overloaded"
+                      if isinstance(e, SolverOverloaded)
+                      else "solver-unavailable")
+            ROUNDS_SKIPPED.inc({"reason": reason})
+            log(f"round skipped ({skipped} skipped so far): {e}")
             if once:
                 return 1
         except FencingError as e:
@@ -157,6 +197,8 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
                 f"{len(out.waiting)} waiting")
             if once:
                 return 0
+        if max_rounds is not None and rounds >= max_rounds:
+            return skipped
         time.sleep(config.schedule_interval_seconds)
 
 
@@ -212,6 +254,18 @@ def main(argv=None) -> int:
     parser.add_argument("--solver-address", default="/tmp/koord-solver.sock")
     parser.add_argument("--solver-secret-file", default=None)
     parser.add_argument(
+        "--solver-failover", action="store_true",
+        help="degraded-mode failover: a sidecar outage is answered by "
+             "the in-process solver (bit-identical, cold compile) "
+             "instead of skipping rounds; flips back with hysteresis",
+    )
+    parser.add_argument(
+        "--solver-supervise", action="store_true",
+        help="spawn the koord-solver sidecar at --solver-address and "
+             "supervise it (liveness probes, backoff restarts, a "
+             "restart-storm circuit breaker)",
+    )
+    parser.add_argument(
         "--cluster-json", default=None,
         help="seed the bus from a cluster-spec JSON file",
     )
@@ -245,40 +299,72 @@ def main(argv=None) -> int:
         placement_backend=args.placement_backend,
         solver_address=args.solver_address,
         solver_secret=secret,
+        solver_failover=args.solver_failover,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler
 
-    scheduler = build_scheduler(config)
-    bus = APIServer()
-    elector = None
-    if args.leader_elect:
-        import os
-
-        from koordinator_tpu.client.leaderelection import LeaderElector
-
-        elector = LeaderElector(
-            bus, "koord-scheduler",
-            args.leader_elect_identity or f"koord-scheduler-{os.getpid()}",
-        )
-    wire_scheduler(bus, scheduler, elector=elector)
-    if args.cluster_json:
-        seed_bus_from_json(bus, args.cluster_json)
+    supervisor = None
     http_server = None
-    if args.debug_port is not None:
-        from koordinator_tpu.metrics.components import SCHEDULER_METRICS
-        from koordinator_tpu.utils.debug_http import DebugHTTPServer
-
-        http_server = DebugHTTPServer(
-            services=scheduler.services, debug=scheduler.debug,
-            metrics=SCHEDULER_METRICS, port=args.debug_port,
-        ).start()
-        print(f"debug http on 127.0.0.1:{http_server.port}")
+    # everything after the supervisor spawn runs under its finally: a
+    # wiring/readiness failure must never strand an orphaned solver
+    # child holding the solve socket
     try:
+        if args.solver_supervise and args.placement_backend == "sidecar":
+            from koordinator_tpu.cmd.solver import parse_address
+            from koordinator_tpu.service.supervisor import SolverSupervisor
+
+            extra = ()
+            if args.solver_secret_file:
+                extra = ("--secret-file", args.solver_secret_file)
+            supervisor = SolverSupervisor(
+                parse_address(args.solver_address),
+                listen_spec=args.solver_address,
+                extra_argv=extra,
+            )
+            supervisor.start()
+        scheduler = build_scheduler(config)
+        bus = APIServer()
+        elector = None
+        if args.leader_elect:
+            import os
+
+            from koordinator_tpu.client.leaderelection import LeaderElector
+
+            elector = LeaderElector(
+                bus, "koord-scheduler",
+                args.leader_elect_identity
+                or f"koord-scheduler-{os.getpid()}",
+            )
+        wire_scheduler(bus, scheduler, elector=elector)
+        if args.cluster_json:
+            seed_bus_from_json(bus, args.cluster_json)
+        if args.debug_port is not None:
+            from koordinator_tpu.metrics.components import SCHEDULER_METRICS
+            from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+            if supervisor is not None:
+                # the supervisor's state machine beside the scheduler's
+                # own debug surfaces: one GET answers "why is my solver
+                # down?"
+                scheduler.services.register(
+                    "solver-supervisor", supervisor.status
+                )
+            if hasattr(scheduler.model.backend, "status"):
+                scheduler.services.register(
+                    "solver-failover", scheduler.model.backend.status
+                )
+            http_server = DebugHTTPServer(
+                services=scheduler.services, debug=scheduler.debug,
+                metrics=SCHEDULER_METRICS, port=args.debug_port,
+            ).start()
+            print(f"debug http on 127.0.0.1:{http_server.port}")
         return run_loop(scheduler, config, once=args.once, elector=elector)
     finally:
         if http_server is not None:
             http_server.stop()
+        if supervisor is not None:
+            supervisor.stop()
 
 
 if __name__ == "__main__":

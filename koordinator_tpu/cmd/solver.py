@@ -37,6 +37,12 @@ def main(argv=None) -> int:
     parser.add_argument("--once", action="store_true",
                         help="start, report readiness, and exit (smoke)")
     parser.add_argument(
+        "--ready-file", default=None,
+        help="write this file (containing the pid) once the solve "
+             "socket is accepting — a race-free readiness signal for "
+             "supervisors that don't want to poll the socket",
+    )
+    parser.add_argument(
         "--debug-port", type=int, default=None,
         help="serve /apis/v1/plugins/solver (routing + kernel-breaker "
              "+ admission-gate state), /metrics (admission queue/shed/"
@@ -60,6 +66,11 @@ def main(argv=None) -> int:
             secret = f.read().strip()
     service = PlacementService(parse_address(args.listen), secret=secret)
     service.start()
+    if args.ready_file:
+        import os
+
+        with open(args.ready_file, "w") as f:
+            f.write(str(os.getpid()))
     debug_server = None
     if args.debug_port is not None:
         from koordinator_tpu.metrics.components import SOLVER_METRICS

@@ -37,6 +37,45 @@ GANG_REJECTIONS = SCHEDULER_METRICS.counter(
     "Gang-group rejections (strict failures + WaitTime expiry)",
 )
 
+# -- failure domains (service/failover.py + service/supervisor.py) ----------
+# These live in the SCHEDULER registry: the failover state machine and
+# the sidecar supervisor both run in the control-plane process, and the
+# operator watching "is my scheduler placing pods?" needs them on the
+# same scrape as the round metrics (docs/DESIGN.md §13).
+
+ROUNDS_SKIPPED = SCHEDULER_METRICS.counter(
+    "scheduler_rounds_skipped_total",
+    "Scheduling rounds skipped outright (solver outage, no failover)",
+    label_names=("reason",),  # solver-unavailable
+)
+SOLVER_DEGRADED = SCHEDULER_METRICS.gauge(
+    "scheduler_solver_degraded",
+    "1 while the failover backend answers solves in-process",
+)
+SOLVER_FAILOVERS = SCHEDULER_METRICS.counter(
+    "scheduler_solver_failovers_total",
+    "Failover state-machine flips",
+    label_names=("direction",),  # to-degraded | to-remote
+)
+SOLVER_LOCAL_SOLVES = SCHEDULER_METRICS.counter(
+    "scheduler_solver_local_solves_total",
+    "Solves answered by the in-process fallback instead of the sidecar",
+    label_names=("mode",),  # local-fallback | local-degraded
+)
+SUPERVISOR_RESTARTS = SCHEDULER_METRICS.counter(
+    "solver_supervisor_restarts_total",
+    "Sidecar restarts performed by the supervisor",
+    label_names=("reason",),  # crashed | hung | down
+)
+SUPERVISOR_UP = SCHEDULER_METRICS.gauge(
+    "solver_supervisor_child_up",
+    "1 while the supervised sidecar passes liveness probes",
+)
+SUPERVISOR_BREAKER_OPEN = SCHEDULER_METRICS.gauge(
+    "solver_supervisor_breaker_open",
+    "1 while the restart-storm circuit breaker refuses respawns",
+)
+
 # -- koordlet (pkg/koordlet/metrics: internal + external sets) --------------
 
 KOORDLET_INTERNAL_METRICS = Registry("koordlet-internal")

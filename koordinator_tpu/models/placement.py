@@ -321,6 +321,20 @@ class StagedStateCache:
                 "stage_s": time.perf_counter() - t1,
             }, (self.epoch, self.last_delta)
 
+    def invalidate(self) -> None:
+        """Forget the staged world: the next ensure() takes the full
+        relower+restage path regardless of tracker state. The epoch is
+        deliberately NOT reset — it must stay monotone so a sidecar
+        re-establishing its delta base after the flip-back can never
+        confuse a pre-outage base with a post-outage one."""
+        with self._lock:
+            self.arrays = None
+            self.state = None
+            self.tracker = None
+            self.seen_epoch = -1
+            self.last_delta = None
+            self.last_path = None
+
 
 class PlacementModel:
     """Compiled batched placement over a (possibly sharded) node axis."""
@@ -434,6 +448,16 @@ class PlacementModel:
         #: whether the last schedule() staged NUMA inventories — the
         #: staging cache skips its device half while this holds
         self._numa_staging = False
+
+    def reset_staging(self) -> None:
+        """Drop the staged device state so the next ``schedule()`` runs
+        a full relower+restage. The failover layer
+        (service/failover.py) calls this through its ``on_flip_back``
+        hook: a recovered sidecar re-establishes its delta base from a
+        from-scratch staging instead of a chain of deltas the outage
+        may have partially delivered — recovery stays bit-identical by
+        construction, just one full restage slower."""
+        self.staged_cache.invalidate()
 
     def lowering_kwargs(self) -> dict:
         """The lower_nodes configuration this model schedules with —
@@ -877,10 +901,17 @@ class PlacementModel:
                 self.backend, "supports_staging_delta", False
             ):
                 kwargs["staging"] = staging
-            return self.backend.solve_result(
+            result = self.backend.solve_result(
                 state, batch, self.params, self.config, quota_state,
                 gang_state, extras, resv_arrays, numa_aux, **kwargs,
             )
+            # a failover backend reports which side answered ("remote",
+            # "local-fallback", "local-degraded") — surface it as the
+            # model's solver tag so operators/tests see degraded solves
+            self.last_solver = getattr(
+                self.backend, "last_mode", None
+            ) or "remote"
+            return result
         n, p = int(state.alloc.shape[0]), int(batch.req.shape[0])
         plain = (
             quota_state is None

@@ -9,8 +9,11 @@ over a unix or TCP socket.
 """
 
 from koordinator_tpu.service.codec import (  # noqa: F401
+    CodecError,
+    FrameTooLarge,
     SolveRequest,
     SolveResponse,
+    TruncatedFrame,
     decode_request,
     decode_response,
     encode_request,
@@ -30,4 +33,10 @@ from koordinator_tpu.service.client import (  # noqa: F401
     SolverOverloaded,
     SolverShuttingDown,
     SolverUnavailable,
+)
+from koordinator_tpu.service.failover import FailoverSolver  # noqa: F401
+from koordinator_tpu.service.supervisor import (  # noqa: F401
+    RestartBreaker,
+    SolverSupervisor,
+    connection_probe,
 )

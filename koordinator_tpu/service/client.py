@@ -46,6 +46,7 @@ class SolverShuttingDown(ConnectionError):
 
 
 from koordinator_tpu.service.codec import (
+    CodecError,
     SolveRequest,
     SolveResponse,
     decode_response,
@@ -53,6 +54,15 @@ from koordinator_tpu.service.codec import (
     read_frame,
     write_frame,
 )
+
+
+def jittered_backoff(base_s: float, cap_s: float, attempt: int,
+                     rng: random.Random) -> float:
+    """The retry/restart delay both this module and the supervisor
+    use: exponential from ``base_s`` capped at ``cap_s``, scaled by a
+    uniform [0.5, 1.0) jitter so a fleet of clients (or supervisors)
+    doesn't reconverge on the same instant."""
+    return min(cap_s, base_s * (2 ** attempt)) * (0.5 + 0.5 * rng.random())
 
 
 class PlacementClient:
@@ -70,11 +80,27 @@ class PlacementClient:
             self._stream.flush()
 
     def solve(self, request: SolveRequest) -> SolveResponse:
-        write_frame(self._stream, encode_request(request))
-        self._stream.flush()
-        payload = read_frame(self._stream)
+        # serialization failures are LOCAL bugs, not transport faults:
+        # encode outside the net below or a bad array would masquerade
+        # as an unreachable solver and be retried forever
+        encoded = encode_request(request)
+        # a peer dying mid-frame (restart, SIGKILL, cut network) must
+        # surface as the ONE typed transport error — SolverUnavailable —
+        # never a bare EOFError/struct.error/BrokenPipeError the caller
+        # has to pattern-match
+        try:
+            write_frame(self._stream, encoded)
+            self._stream.flush()
+            payload = read_frame(self._stream)
+        except (EOFError, OSError, ValueError) as e:
+            # ValueError covers FrameTooLarge: a garbage length prefix
+            # means the stream is desynced — connection-level failure
+            raise SolverUnavailable(
+                f"solver connection failed mid-frame: "
+                f"{type(e).__name__}: {e}"
+            ) from e
         if payload is None:
-            raise ConnectionError("solver closed the connection")
+            raise SolverUnavailable("solver closed the connection")
         response = decode_response(payload)
         if response.error:
             # admission-gate typed errors (the frame was read cleanly,
@@ -102,7 +128,12 @@ class PlacementClient:
         self._sock.settimeout(timeout)
 
     def close(self) -> None:
-        self._stream.close()
+        try:
+            # closing flushes buffered bytes: a dead peer turns that
+            # into EPIPE, which must not mask the close itself
+            self._stream.close()
+        except OSError:
+            pass
         self._sock.close()
 
     def __enter__(self):
@@ -215,6 +246,14 @@ class RemoteSolver:
     def close(self) -> None:
         self._drop()
 
+    def reset_base(self) -> None:
+        """Drop the connection AND the delta base the connected sidecar
+        was believed to hold: the next solve re-establishes with a full
+        request. The failover layer calls this on flip-back so a solver
+        that was restarted (or replaced) behind a proxy can never be
+        handed a delta against a base it doesn't have."""
+        self._drop()
+
     def solve_result(self, state, batch, params, config,
                      quota_state=None, gang_state=None, extras=None,
                      resv=None, numa=None, staging=None):
@@ -320,6 +359,13 @@ class RemoteSolver:
             except (ConnectionError, OSError, EOFError) as e:
                 last_error = e
                 self._drop()
+            except CodecError as e:
+                # garbage ON the wire (bit corruption, a desynced peer):
+                # the framing held but the payload didn't decode. The
+                # only safe recovery is a fresh connection — reconnect
+                # and re-send, same as a dead peer
+                last_error = e
+                self._drop()
             except RuntimeError as e:
                 if "delta-base-mismatch" in str(e) and mismatch_retry:
                     # the response was read cleanly — the stream is in
@@ -335,9 +381,10 @@ class RemoteSolver:
                 # retry would read the previous round's assignments
                 self._drop()
                 raise
-            delay = min(
-                self.backoff_cap_s, self.backoff_base_s * (2 ** attempt)
-            ) * (0.5 + 0.5 * self._rng.random())
+            delay = jittered_backoff(
+                self.backoff_base_s, self.backoff_cap_s, attempt,
+                self._rng,
+            )
             attempt += 1
             elapsed = time.monotonic() - start
             if attempt > self.retries and elapsed + delay >= budget:

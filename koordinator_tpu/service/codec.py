@@ -33,6 +33,27 @@ MAX_FRAME = 1 << 30
 PROTOCOL_VERSION = 2
 
 
+class CodecError(ValueError):
+    """The payload is not a well-formed wire message (not an npz
+    archive, wrong member types, required arrays missing). The FRAME
+    boundary was still read cleanly, so the caller knows exactly how
+    many bytes the bad message occupied — a server keeps the
+    connection, a client may retry after reconnecting."""
+
+
+class FrameTooLarge(CodecError):
+    """The length prefix exceeds the frame cap. Raised BEFORE any
+    payload allocation: a hostile or corrupted 4-byte header can never
+    make the peer buffer gigabytes."""
+
+
+class TruncatedFrame(EOFError):
+    """The peer died (or was cut) mid-frame: the length prefix promised
+    more bytes than the stream delivered. Subclasses ``EOFError`` so
+    pre-existing handlers keep working; new code should catch this and
+    treat the connection as lost, never the payload as data."""
+
+
 @dataclasses.dataclass
 class SolveRequest:
     """One batched solve: the scan's inputs as host arrays.
@@ -100,13 +121,16 @@ def read_frame(stream: BinaryIO,
         return None  # peer closed
     (length,) = _LEN.unpack(header)
     if length > max_frame:
-        raise ValueError(f"frame too large: {length}")
+        raise FrameTooLarge(f"frame too large: {length} > {max_frame}")
     chunks = []
     remaining = length
     while remaining:
         chunk = stream.read(remaining)
         if not chunk:
-            raise EOFError("truncated frame")
+            raise TruncatedFrame(
+                f"truncated frame: peer closed {remaining} bytes short "
+                f"of the {length}-byte payload"
+            )
         chunks.append(chunk)
         remaining -= len(chunk)
     return b"".join(chunks)
@@ -119,8 +143,16 @@ def _pack(arrays: Dict[str, np.ndarray]) -> bytes:
 
 
 def _unpack(payload: bytes) -> Dict[str, np.ndarray]:
-    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
-        return {k: z[k] for k in z.files}
+    # any payload defect — not a zip, bad npy headers, members whose
+    # declared shape outruns their data — must surface as ONE typed
+    # error, never a hang or a raw zipfile/numpy internal
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+    except Exception as e:
+        raise CodecError(
+            f"malformed npz payload: {type(e).__name__}: {e}"
+        ) from e
 
 
 #: request group -> wire prefix (single-char + "."); optional groups are
@@ -179,8 +211,14 @@ def encode_response(resp: SolveResponse) -> bytes:
 
 def decode_response(payload: bytes) -> SolveResponse:
     arrays = _unpack(payload)
+    if "assignments" not in arrays:
+        raise CodecError("response payload missing 'assignments'")
+    try:
+        error = bytes(arrays["error"]).decode() if "error" in arrays else ""
+    except UnicodeDecodeError as e:
+        raise CodecError(f"undecodable error string: {e}") from e
     return SolveResponse(
         assignments=arrays["assignments"],
-        error=bytes(arrays["error"]).decode() if "error" in arrays else "",
+        error=error,
         **{f: arrays.get(f) for f in _RESP_OPTIONAL},
     )

@@ -1,0 +1,251 @@
+"""FailoverSolver: degraded-mode placement through a solver outage.
+
+``--placement-backend=sidecar`` made the sidecar the only road to the
+device — and a single failure domain: ``run_loop`` skipped the round
+whenever :class:`~koordinator_tpu.service.client.RemoteSolver` gave up.
+This backend wraps the remote solver with the failure-domain state
+machine (docs/DESIGN.md §13):
+
+- **Per-solve fallback.** A remote attempt that ends in
+  ``SolverUnavailable`` / ``SolverDeadlineExceeded`` is answered by the
+  lazily-compiled in-process solve INSTEAD of raising — the control
+  plane places pods on every tick, outage or not. The local path is the
+  same ``solve_batch`` program the sidecar runs (integer arithmetic end
+  to end, DESIGN.md §2), so placements are bit-identical; the first
+  local solve pays the cold compile, by design.
+- **Degraded mode.** ``failure_threshold`` CONSECUTIVE remote failures
+  flip the machine to degraded: solves stop paying the remote timeout
+  at all and go straight to the local path, while each solve spends one
+  cheap liveness probe (:func:`~koordinator_tpu.service.supervisor.
+  connection_probe`) on the sidecar address.
+- **Hysteresis.** ``recovery_probes`` CONSECUTIVE healthy probes flip
+  back — one blip during recovery resets the count, so a flapping
+  sidecar cannot bounce the backend between modes.
+- **Epoch reset on flip-back.** Recovery calls
+  ``RemoteSolver.reset_base()`` (the restarted sidecar holds no delta
+  base) and the ``on_flip_back`` hook — the control plane wires it to
+  ``PlacementModel.reset_staging`` so the first post-recovery request
+  re-establishes the wire base from a full restage, and the existing
+  ``delta-base-mismatch`` machinery covers anything that slips through.
+
+The flip counters/gauge land in metrics/components.py; ``last_mode``
+("remote" | "local-fallback" | "local-degraded") is what the model
+surfaces as ``last_solver``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+
+from koordinator_tpu.metrics.components import (
+    SOLVER_DEGRADED,
+    SOLVER_FAILOVERS,
+    SOLVER_LOCAL_SOLVES,
+)
+from koordinator_tpu.ops.binpack import solve_batch
+from koordinator_tpu.service.client import (
+    SolverDeadlineExceeded,
+    SolverOverloaded,
+    SolverUnavailable,
+)
+from koordinator_tpu.service.supervisor import connection_probe
+
+#: the in-process fallback solve — the exact program the sidecar runs
+#: (service/server._jit_solve), compiled lazily on the first degraded
+#: solve so the healthy path never pays for it. Nothing is donated: the
+#: staged base is reused tick-to-tick by the staging cache.
+_local_solve = jax.jit(
+    solve_batch, static_argnames=("config",), donate_argnums=()
+)
+
+
+class FailoverSolver:
+    """A PlacementModel backend wrapping :class:`RemoteSolver` with
+    degraded-mode failover (ISSUE: a sidecar outage must not skip
+    rounds). Drop-in: same ``solve_result`` signature, same
+    ``supports_staging_delta`` advertisement."""
+
+    def __init__(self, remote,
+                 failure_threshold: int = 3,
+                 recovery_probes: int = 2,
+                 probe_fn: Optional[Callable[[], bool]] = None,
+                 probe_timeout_s: float = 0.5,
+                 on_flip_back: Optional[Callable[[], None]] = None,
+                 clock=time.monotonic):
+        self._remote = remote
+        self.failure_threshold = failure_threshold
+        self.recovery_probes = recovery_probes
+        self._probe_fn = probe_fn or (
+            lambda: connection_probe(remote.address, probe_timeout_s)
+        )
+        #: wired post-construction by the control plane (build_scheduler
+        #: points it at PlacementModel.reset_staging); set-once wiring,
+        #: read-only afterwards — deliberately outside the lock map
+        self.on_flip_back = on_flip_back
+        self._clock = clock
+        #: delta staging rides through to the remote solver; the local
+        #: path solves the full staged state it is handed anyway
+        self.supports_staging_delta = getattr(
+            remote, "supports_staging_delta", False
+        )
+        self._lock = threading.Lock()
+        self.degraded = False
+        self.degraded_since: Optional[float] = None
+        self.consecutive_failures = 0
+        self.healthy_probes = 0
+        self.flips_to_degraded = 0
+        self.flips_to_remote = 0
+        self.local_solves = 0
+        self.last_error: Optional[str] = None
+        #: which path answered the last solve: "remote" |
+        #: "local-fallback" (remote tried and failed this solve) |
+        #: "local-degraded" (machine flipped, remote not attempted)
+        self.last_mode: Optional[str] = None
+
+    # -- the backend call ----------------------------------------------------
+
+    def solve_result(self, state, batch, params, config,
+                     quota_state=None, gang_state=None, extras=None,
+                     resv=None, numa=None, staging=None):
+        with self._lock:
+            degraded = self.degraded
+        if degraded:
+            if self.maybe_recover():
+                return self._remote_solve(
+                    state, batch, params, config, quota_state,
+                    gang_state, extras, resv, numa, staging,
+                )
+            return self._local(
+                state, batch, params, config, quota_state, gang_state,
+                extras, resv, numa, mode="local-degraded",
+            )
+        return self._remote_solve(
+            state, batch, params, config, quota_state, gang_state,
+            extras, resv, numa, staging,
+        )
+
+    def _remote_solve(self, state, batch, params, config, quota_state,
+                      gang_state, extras, resv, numa, staging):
+        kwargs = {}
+        if staging is not None and getattr(
+            self._remote, "supports_staging_delta", False
+        ):
+            kwargs["staging"] = staging
+        try:
+            result = self._remote.solve_result(
+                state, batch, params, config, quota_state, gang_state,
+                extras, resv, numa, **kwargs,
+            )
+        except (SolverUnavailable, SolverDeadlineExceeded,
+                SolverOverloaded) as e:
+            # overloaded counts too: the sidecar is alive but SHEDDING
+            # this caller past its retry budget — from the scheduler's
+            # seat that is indistinguishable from an outage, and
+            # letting it escape would crash the round loop outright
+            flipped = False
+            with self._lock:
+                self.consecutive_failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                if (
+                    not self.degraded
+                    and self.consecutive_failures >= self.failure_threshold
+                ):
+                    self.degraded = True
+                    self.degraded_since = self._clock()
+                    self.healthy_probes = 0
+                    self.flips_to_degraded += 1
+                    flipped = True
+            if flipped:
+                SOLVER_FAILOVERS.inc({"direction": "to-degraded"})
+                SOLVER_DEGRADED.set(1)
+            return self._local(
+                state, batch, params, config, quota_state, gang_state,
+                extras, resv, numa, mode="local-fallback",
+            )
+        with self._lock:
+            self.consecutive_failures = 0
+            self.last_mode = "remote"
+        return result
+
+    def _local(self, state, batch, params, config, quota_state,
+               gang_state, extras, resv, numa, mode: str):
+        result = _local_solve(
+            state, batch, params, config, quota_state, gang_state,
+            extras, resv, numa,
+        )
+        with self._lock:
+            self.local_solves += 1
+            self.last_mode = mode
+        SOLVER_LOCAL_SOLVES.inc({"mode": mode})
+        return result
+
+    # -- recovery ------------------------------------------------------------
+
+    def maybe_recover(self) -> bool:
+        """One hysteresis step: spend a probe on the sidecar; after
+        ``recovery_probes`` consecutive healthy ones, flip back to
+        remote (with the epoch reset). Called automatically by every
+        degraded solve; idle loops may call it between ticks to recover
+        without waiting for traffic. Returns True iff this call flipped
+        the machine back."""
+        with self._lock:
+            if not self.degraded:
+                return False
+        ok = self._probe_fn()
+        recovered = False
+        with self._lock:
+            if not self.degraded:
+                return False
+            if ok:
+                self.healthy_probes += 1
+                if self.healthy_probes >= self.recovery_probes:
+                    self.degraded = False
+                    self.degraded_since = None
+                    self.healthy_probes = 0
+                    self.consecutive_failures = 0
+                    self.flips_to_remote += 1
+                    recovered = True
+            else:
+                self.healthy_probes = 0
+        if recovered:
+            # the restarted sidecar holds no delta base: drop ours, and
+            # let the model rebuild its staged world from scratch so the
+            # re-established base starts from a full restage
+            reset = getattr(self._remote, "reset_base", None)
+            if reset is not None:
+                reset()
+            if self.on_flip_back is not None:
+                self.on_flip_back()
+            SOLVER_FAILOVERS.inc({"direction": "to-remote"})
+            SOLVER_DEGRADED.set(0)
+        return recovered
+
+    # -- plumbing ------------------------------------------------------------
+
+    def close(self) -> None:
+        close = getattr(self._remote, "close", None)
+        if close is not None:
+            close()
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "degraded": self.degraded,
+                "degraded_for_s": (
+                    None if self.degraded_since is None
+                    else self._clock() - self.degraded_since
+                ),
+                "consecutive_failures": self.consecutive_failures,
+                "failure_threshold": self.failure_threshold,
+                "healthy_probes": self.healthy_probes,
+                "recovery_probes": self.recovery_probes,
+                "flips_to_degraded": self.flips_to_degraded,
+                "flips_to_remote": self.flips_to_remote,
+                "local_solves": self.local_solves,
+                "last_mode": self.last_mode,
+                "last_error": self.last_error,
+            }

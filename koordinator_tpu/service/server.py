@@ -526,12 +526,20 @@ class _Handler(socketserver.BaseRequestHandler):
                 # unauthenticated peer can't make us buffer MAX_FRAME
                 try:
                     hello = read_frame(stream, max_frame=4096)
-                except ValueError:
+                except (ValueError, EOFError, OSError):
                     return
                 if hello is None or not hmac.compare_digest(hello, secret):
                     return  # unauthenticated peer: drop before any solve
             while True:
-                payload = read_frame(stream)
+                # a peer dying mid-request-frame (TruncatedFrame), an
+                # insane length prefix (FrameTooLarge), or a reset
+                # socket is a dead/hostile peer, not a server fault:
+                # close quietly instead of leaking a handler traceback
+                # through socketserver.handle_error
+                try:
+                    payload = read_frame(stream)
+                except (EOFError, ValueError, OSError):
+                    return
                 if payload is None:
                     return
                 entry = None
@@ -555,8 +563,11 @@ class _Handler(socketserver.BaseRequestHandler):
                         )
                         response = entry.wait()
                 try:
-                    write_frame(stream, encode_response(response))
-                    stream.flush()
+                    try:
+                        write_frame(stream, encode_response(response))
+                        stream.flush()
+                    except OSError:
+                        return  # peer gone before the reply landed
                 finally:
                     # count the delivery attempt even when the peer is
                     # gone, or stop()'s bounded delivery wait would

@@ -1,0 +1,310 @@
+"""Deterministic fault injection for the solver wire boundary.
+
+The failure-domain layer (service/supervisor.py, service/failover.py,
+the typed codec errors) exists to survive exactly the failures no unit
+test used to produce: torn frames, bytes flipped on the wire, stalls
+past the deadline, connections reset mid-solve, the sidecar SIGKILLed
+mid-request, the per-connection delta base silently lost. This module
+produces them ON DEMAND and DETERMINISTICALLY:
+
+- :class:`FaultSchedule` maps request ordinals to fault kinds — either
+  scripted explicitly (the property tests pin specific scenarios to
+  specific ticks) or generated from a seed.
+- :class:`ChaosProxy` sits between a :class:`PlacementClient`/
+  :class:`RemoteSolver` and the sidecar, speaking the plain framed
+  protocol (no shared-secret mode), forwarding frames verbatim except
+  where the schedule names a fault.
+- :class:`InProcessSidecar` wraps a :class:`PlacementService` in a
+  subprocess-like handle (``poll``/``kill``/``pid``) so
+  :class:`SolverSupervisor` can supervise — and chaos tests can
+  SIGKILL-and-restart — a sidecar without paying a fresh JAX import
+  per respawn. The jit cache survives in-process restarts, which is
+  fine: the properties under test are protocol/state-machine
+  properties, not cold-start cost.
+
+The determinism contract is the SCHEDULE, not the interleaving: which
+retry hits which ordinal can shift with timing, but every injected
+fault leads to a typed, recoverable outcome, so the chaos property
+tests assert path-independent facts (every tick completed; final
+placements and node accounting bit-identical to a fault-free run).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from koordinator_tpu.service.codec import read_frame, write_frame
+
+#: every fault kind the proxy can inject
+FAULT_KINDS = (
+    "torn-response",     # half the response frame, then a hard close
+    "corrupt-response",  # response payload bytes flipped (frame intact)
+    "stall",             # response delayed past the client's deadline
+    "reset-request",     # client connection reset after the request
+    "kill-server",       # kill_fn() fired mid-request (sidecar SIGKILL)
+    "drop-base",         # upstream connection swapped: delta base lost
+)
+
+
+class FaultSchedule:
+    """Request ordinal (0-based, global across connections) → fault.
+
+    ``events`` pins faults explicitly; :meth:`generate` derives a
+    schedule from a seed. Ordinals are counted by the proxy in arrival
+    order, so a single-threaded scheduler loop sees a reproducible
+    mapping from schedule to wire behavior."""
+
+    def __init__(self, events: Optional[Dict[int, str]] = None):
+        self.events = dict(events or {})
+        for kind in self.events.values():
+            if kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind: {kind!r}")
+
+    @classmethod
+    def generate(cls, seed: int, n_requests: int, rate: float = 0.2,
+                 kinds=FAULT_KINDS, start: int = 0) -> "FaultSchedule":
+        """A seeded schedule over ``[start, start+n_requests)``: each
+        ordinal independently faulted with probability ``rate``, kind
+        drawn uniformly. Same seed → same schedule, forever."""
+        rng = random.Random(seed)
+        events: Dict[int, str] = {}
+        for i in range(start, start + n_requests):
+            if rng.random() < rate:
+                events[i] = kinds[rng.randrange(len(kinds))]
+        return cls(events)
+
+    def fault_for(self, ordinal: int) -> Optional[str]:
+        return self.events.get(ordinal)
+
+
+def _connect(address):
+    family = socket.AF_UNIX if isinstance(address, str) else socket.AF_INET
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    sock.connect(address)
+    return sock
+
+
+class ChaosProxy:
+    """A frame-level proxy injecting :class:`FaultSchedule` faults.
+
+    One thread per client connection; the upstream connection is opened
+    lazily (and re-opened after ``drop-base``/upstream death, so the
+    client keeps its connection while the server's per-connection delta
+    base vanishes — the forced-base-loss scenario). If the upstream is
+    unreachable when a client connects, the client connection is closed
+    immediately: :func:`~koordinator_tpu.service.supervisor.
+    connection_probe`'s hold-open check then correctly reports the
+    BACKEND dead even though the proxy itself still accepts."""
+
+    def __init__(self, listen_address, upstream_address,
+                 schedule: Optional[FaultSchedule] = None,
+                 kill_fn: Optional[Callable[[], None]] = None,
+                 stall_s: float = 1.0, corrupt_seed: int = 0):
+        self.listen_address = listen_address
+        self.upstream_address = upstream_address
+        self.schedule = schedule or FaultSchedule()
+        self.kill_fn = kill_fn
+        self.stall_s = stall_s
+        self._corrupt_rng = random.Random(corrupt_seed)
+        self._lock = threading.Lock()
+        self.requests_seen = 0
+        self.faults_injected: Dict[str, int] = {}
+        self._stop = threading.Event()
+        family = (socket.AF_UNIX if isinstance(listen_address, str)
+                  else socket.AF_INET)
+        self._sock = socket.socket(family, socket.SOCK_STREAM)
+        self._sock.bind(listen_address)
+        self._sock.listen(16)
+        self._sock.settimeout(0.2)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ChaosProxy":
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="chaos-proxy"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._sock.close()
+
+    # -- internals -----------------------------------------------------------
+
+    def _next_ordinal(self) -> int:
+        with self._lock:
+            ordinal = self.requests_seen
+            self.requests_seen += 1
+            return ordinal
+
+    def _record(self, kind: str) -> None:
+        with self._lock:
+            self.faults_injected[kind] = (
+                self.faults_injected.get(kind, 0) + 1
+            )
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # a dead backend must look dead THROUGH the proxy: refuse
+            # (close) the client connection when upstream won't accept
+            try:
+                upstream = _connect(self.upstream_address)
+            except OSError:
+                conn.close()
+                continue
+            threading.Thread(
+                target=self._serve, args=(conn, upstream), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket, upstream: socket.socket) -> None:
+        client_stream = conn.makefile("rwb")
+        up_stream = upstream.makefile("rwb")
+
+        def close_all():
+            for closeable in (client_stream, up_stream, conn, upstream):
+                try:
+                    closeable.close()
+                except OSError:
+                    pass
+
+        def hard_reset():
+            # RST instead of FIN where the platform allows: the client
+            # must handle an ABRUPT death, not a polite shutdown
+            try:
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    b"\x01\x00\x00\x00\x00\x00\x00\x00",
+                )
+            except OSError:
+                pass
+            close_all()
+
+        try:
+            while not self._stop.is_set():
+                try:
+                    payload = read_frame(client_stream)
+                except (EOFError, ValueError, OSError):
+                    return close_all()
+                if payload is None:
+                    return close_all()
+                fault = self.schedule.fault_for(self._next_ordinal())
+                if fault == "reset-request":
+                    self._record(fault)
+                    return hard_reset()
+                if fault == "kill-server":
+                    self._record(fault)
+                    if self.kill_fn is not None:
+                        self.kill_fn()
+                    return hard_reset()
+                if fault == "drop-base":
+                    # swap the upstream connection: the server's
+                    # per-connection NodeStateCache dies with it while
+                    # the CLIENT connection lives on — the next delta
+                    # request meets delta-base-mismatch
+                    self._record(fault)
+                    try:
+                        up_stream.close()
+                        upstream.close()
+                    except OSError:
+                        pass
+                    try:
+                        upstream = _connect(self.upstream_address)
+                        up_stream = upstream.makefile("rwb")
+                    except OSError:
+                        return hard_reset()
+                try:
+                    write_frame(up_stream, payload)
+                    up_stream.flush()
+                    response = read_frame(up_stream)
+                except (EOFError, ValueError, OSError):
+                    return hard_reset()  # backend died mid-solve
+                if response is None:
+                    return hard_reset()
+                if fault == "stall":
+                    self._record(fault)
+                    time.sleep(self.stall_s)
+                elif fault == "torn-response":
+                    self._record(fault)
+                    try:
+                        # length prefix + half the payload, then RST:
+                        # the client sees TruncatedFrame
+                        import struct
+
+                        client_stream.write(
+                            struct.pack(">I", len(response))
+                        )
+                        client_stream.write(response[: len(response) // 2])
+                        client_stream.flush()
+                    except OSError:
+                        pass
+                    return hard_reset()
+                elif fault == "corrupt-response":
+                    self._record(fault)
+                    corrupted = bytearray(response)
+                    for _ in range(max(1, len(corrupted) // 256)):
+                        i = self._corrupt_rng.randrange(len(corrupted))
+                        corrupted[i] ^= 0xFF
+                    response = bytes(corrupted)
+                try:
+                    write_frame(client_stream, response)
+                    client_stream.flush()
+                except OSError:
+                    return close_all()
+        finally:
+            close_all()
+
+
+class InProcessSidecar:
+    """A :class:`PlacementService` behind a subprocess-like handle.
+
+    ``SolverSupervisor``'s ``spawn_fn`` returns one of these in tests
+    and the bench outage leg: ``kill()`` severs every live connection
+    and stops serving (the observable behavior of SIGKILL at the wire),
+    ``poll()`` reports the exit code, and a respawn is a fresh
+    ``InProcessSidecar`` on the same address — milliseconds instead of
+    a subprocess's cold JAX import, with the solve jit cache shared
+    (restart cost is not what these tests measure)."""
+
+    _next_pid = [1000]
+
+    def __init__(self, address, **service_kwargs):
+        from koordinator_tpu.service.server import PlacementService
+
+        self._service = PlacementService(address, **service_kwargs)
+        self._service.start()
+        self._returncode: Optional[int] = None
+        self._lock = threading.Lock()
+        InProcessSidecar._next_pid[0] += 1
+        self.pid = InProcessSidecar._next_pid[0]
+
+    def poll(self) -> Optional[int]:
+        with self._lock:
+            return self._returncode
+
+    def kill(self) -> None:
+        with self._lock:
+            if self._returncode is not None:
+                return
+            self._returncode = -9
+        self._service.stop()
+
+    terminate = kill
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        return self.poll()
+
+    @property
+    def service(self):
+        return self._service

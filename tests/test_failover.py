@@ -1,0 +1,403 @@
+"""Failure-domain units: the FailoverSolver state machine, the
+SolverSupervisor restart/breaker logic, and run_loop's outage
+accounting (ISSUE 4 tentpole §1-2 + satellite 1)."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName as R
+from koordinator_tpu.cmd.scheduler import (
+    SchedulerConfig,
+    build_scheduler,
+    run_loop,
+)
+from koordinator_tpu.models.placement import PlacementModel
+from koordinator_tpu.service.client import RemoteSolver, SolverUnavailable
+from koordinator_tpu.service.failover import FailoverSolver
+from koordinator_tpu.service.server import PlacementService
+from koordinator_tpu.service.supervisor import (
+    RestartBreaker,
+    SolverSupervisor,
+    connection_probe,
+)
+
+
+def _wire_problem(n_nodes=4, n_pods=5):
+    import jax.numpy as jnp
+
+    from koordinator_tpu.ops.binpack import (
+        NodeState,
+        PodBatch,
+        ScoreParams,
+        SolverConfig,
+    )
+
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+    alloc[:, R.CPU] = 16000
+    alloc[:, R.MEMORY] = 32768
+    state = NodeState(
+        alloc=jnp.asarray(alloc),
+        used_req=jnp.zeros_like(jnp.asarray(alloc)),
+        usage=jnp.zeros_like(jnp.asarray(alloc)),
+        prod_usage=jnp.zeros_like(jnp.asarray(alloc)),
+        est_extra=jnp.zeros_like(jnp.asarray(alloc)),
+        prod_base=jnp.zeros_like(jnp.asarray(alloc)),
+        metric_fresh=jnp.ones(n_nodes, bool),
+        schedulable=jnp.ones(n_nodes, bool),
+    )
+    req = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+    req[:, R.CPU] = 1000
+    batch = PodBatch.build(
+        req=jnp.asarray(req), est=jnp.asarray((req * 85) // 100),
+        is_prod=jnp.zeros(n_pods, bool),
+        is_daemonset=jnp.zeros(n_pods, bool),
+    )
+    weights = np.zeros(NUM_RESOURCES, np.int32)
+    weights[R.CPU] = 1
+    thresholds = np.zeros(NUM_RESOURCES, np.int32)
+    thresholds[R.CPU] = 65
+    params = ScoreParams(
+        weights=jnp.asarray(weights),
+        thresholds=jnp.asarray(thresholds),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, np.int32),
+    )
+    return state, batch, params, SolverConfig()
+
+
+def _fast_remote(addr, **kw):
+    kw.setdefault("retry_total_s", 0.05)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.02)
+    return RemoteSolver(addr, **kw)
+
+
+class TestFailoverSolver:
+    def test_outage_falls_back_then_flips_degraded(self, tmp_path):
+        """No skipped solves: every call against a dead sidecar is
+        answered locally (bit-identical to the in-process scan), and
+        the K-th consecutive failure flips the machine to degraded so
+        later solves stop paying the remote timeout."""
+        from koordinator_tpu.ops.binpack import solve_batch
+
+        backend = FailoverSolver(
+            _fast_remote(str(tmp_path / "nowhere.sock")),
+            failure_threshold=2, recovery_probes=2,
+        )
+        args = _wire_problem()
+        want = solve_batch(*args)
+        r1 = backend.solve_result(*args)
+        assert backend.last_mode == "local-fallback"
+        assert not backend.status()["degraded"]  # 1 < threshold
+        r2 = backend.solve_result(*args)
+        assert backend.status()["degraded"]  # flipped on the 2nd
+        r3 = backend.solve_result(*args)
+        assert backend.last_mode == "local-degraded"
+        for r in (r1, r2, r3):
+            np.testing.assert_array_equal(
+                np.asarray(r.assign), np.asarray(want.assign)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(r.node_state.used_req),
+                np.asarray(want.node_state.used_req),
+            )
+        assert backend.status()["flips_to_degraded"] == 1
+        assert backend.status()["local_solves"] == 3
+
+    def test_hysteresis_and_epoch_reset_on_flip_back(self, tmp_path):
+        """M consecutive healthy probes flip back (one blip resets the
+        count); flip-back drops the remote delta base and fires the
+        on_flip_back hook so the next request re-establishes."""
+        addr = str(tmp_path / "solver.sock")
+        probes = {"ok": False}
+        flip_back_calls = []
+        remote = _fast_remote(addr)
+        backend = FailoverSolver(
+            remote, failure_threshold=1, recovery_probes=2,
+            probe_fn=lambda: probes["ok"],
+            on_flip_back=lambda: flip_back_calls.append(1),
+        )
+        args = _wire_problem()
+        backend.solve_result(*args)  # dead sidecar: flips immediately
+        assert backend.status()["degraded"]
+
+        # unhealthy probes keep it degraded
+        assert not backend.maybe_recover()
+        # one healthy, one blip: the count resets (hysteresis)
+        probes["ok"] = True
+        assert not backend.maybe_recover()
+        probes["ok"] = False
+        assert not backend.maybe_recover()
+        assert backend.status()["healthy_probes"] == 0
+        assert flip_back_calls == []
+
+        # now the sidecar really is back
+        service = PlacementService(addr)
+        service.start()
+        try:
+            # fake a stale established base: flip-back must clear it
+            remote._server_epoch = 7
+            probes["ok"] = True
+            assert not backend.maybe_recover()  # 1/2
+            assert backend.maybe_recover()      # 2/2: flips back
+            assert not backend.status()["degraded"]
+            assert flip_back_calls == [1]
+            assert remote._server_epoch is None  # epoch reset
+            result = backend.solve_result(*args)
+            assert backend.last_mode == "remote"
+            assert (np.asarray(result.assign) >= 0).all()
+            assert backend.status()["flips_to_remote"] == 1
+        finally:
+            service.stop()
+            backend.close()
+
+    def test_overloaded_past_budget_falls_back_local(self):
+        """A sidecar that sheds this caller past its retry budget is an
+        outage from the scheduler's seat: the terminal SolverOverloaded
+        must be answered locally, not escape and crash the round loop
+        (review finding on the first cut of this layer)."""
+        from koordinator_tpu.service.client import SolverOverloaded
+
+        class _SheddingRemote:
+            address = "/nowhere"
+            supports_staging_delta = False
+
+            def solve_result(self, *a, **kw):
+                raise SolverOverloaded("overloaded: scripted")
+
+        backend = FailoverSolver(
+            _SheddingRemote(), failure_threshold=1, recovery_probes=2,
+            probe_fn=lambda: False,
+        )
+        result = backend.solve_result(*_wire_problem())
+        assert backend.last_mode == "local-fallback"
+        assert backend.status()["degraded"]
+        assert (np.asarray(result.assign) >= 0).all()
+
+    def test_run_loop_skips_on_overloaded(self):
+        """Without failover, a terminal overloaded shed skips the round
+        (counted under its own reason) instead of killing the loop."""
+        from koordinator_tpu.metrics.components import ROUNDS_SKIPPED
+        from koordinator_tpu.service.client import SolverOverloaded
+
+        class _SheddedScheduler:
+            def schedule_pending(self):
+                raise SolverOverloaded("overloaded: queue full")
+
+        before = ROUNDS_SKIPPED.value({"reason": "solver-overloaded"})
+        rc = run_loop(
+            _SheddedScheduler(),
+            SchedulerConfig(schedule_interval_seconds=0.0),
+            log=lambda *_: None, max_rounds=2,
+        )
+        assert rc == 2
+        after = ROUNDS_SKIPPED.value({"reason": "solver-overloaded"})
+        assert after - before == 2
+
+    def test_build_scheduler_wires_failover(self, tmp_path):
+        cfg = SchedulerConfig(
+            placement_backend="sidecar",
+            solver_address=str(tmp_path / "none.sock"),
+            solver_failover=True,
+        )
+        scheduler = build_scheduler(cfg)
+        backend = scheduler.model.backend
+        assert isinstance(backend, FailoverSolver)
+        # flip-back is wired to the model's full-restage reset
+        assert backend.on_flip_back == scheduler.model.reset_staging
+
+
+class TestRunLoopOutageAccounting:
+    def test_skipped_rounds_counted_and_logged(self):
+        """Satellite 1: the skip is no longer silent — counted in the
+        metric and carried in the log line."""
+        from koordinator_tpu.metrics.components import ROUNDS_SKIPPED
+
+        class _DeadSolverScheduler:
+            def schedule_pending(self):
+                raise SolverUnavailable("sidecar gone")
+
+        lines = []
+        before = ROUNDS_SKIPPED.value({"reason": "solver-unavailable"})
+        rc = run_loop(
+            _DeadSolverScheduler(),
+            SchedulerConfig(schedule_interval_seconds=0.0),
+            log=lines.append, max_rounds=3,
+        )
+        assert rc == 3  # three attempted rounds, three skips
+        after = ROUNDS_SKIPPED.value({"reason": "solver-unavailable"})
+        assert after - before == 3
+        assert "3 skipped so far" in lines[-1]
+
+    def test_failover_means_zero_skipped_rounds(self, tmp_path):
+        """Satellite 1 regression: with failover enabled the loop never
+        skips a round even though the sidecar is down for its whole
+        life."""
+        from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+        from koordinator_tpu.metrics.components import ROUNDS_SKIPPED
+        from koordinator_tpu.scheduler import Scheduler
+
+        backend = FailoverSolver(
+            _fast_remote(str(tmp_path / "nowhere.sock")),
+            failure_threshold=1, recovery_probes=2,
+        )
+        model = PlacementModel(backend=backend, use_pallas=False)
+        backend.on_flip_back = model.reset_staging
+        scheduler = Scheduler(model=model)
+        scheduler.add_node(NodeSpec(
+            name="n0", allocatable={R.CPU: 16000, R.MEMORY: 32768}))
+        scheduler.update_node_metric(NodeMetric(
+            node_name="n0", node_usage={}, update_time=1.0))
+        pod = PodSpec(name="p0", requests={R.CPU: 1000})
+        scheduler.add_pod(pod)
+
+        before = ROUNDS_SKIPPED.value({"reason": "solver-unavailable"})
+        rc = run_loop(
+            scheduler, SchedulerConfig(schedule_interval_seconds=0.0),
+            log=lambda *_: None, max_rounds=3,
+        )
+        assert rc == 0  # zero skipped rounds
+        after = ROUNDS_SKIPPED.value({"reason": "solver-unavailable"})
+        assert after - before == 0
+        assert scheduler.cache.pods[pod.uid].node_name == "n0"
+        assert backend.status()["degraded"]  # it really was an outage
+
+
+class _FakeProc:
+    def __init__(self):
+        self.returncode = None
+        self.killed = 0
+        self.pid = 4242
+
+    def poll(self):
+        return self.returncode
+
+    def kill(self):
+        self.killed += 1
+        self.returncode = -9
+
+
+class TestSolverSupervisor:
+    def _supervisor(self, spawned, probe, clock=None, **kw):
+        def spawn():
+            proc = _FakeProc()
+            spawned.append(proc)
+            return proc
+
+        kw.setdefault("probe_interval_s", 0.01)
+        kw.setdefault("backoff_base_s", 0.0)
+        kw.setdefault("backoff_cap_s", 0.0)
+        sup = SolverSupervisor(
+            ("127.0.0.1", 1), spawn_fn=spawn, probe_fn=probe,
+            sleep=lambda _s: None,
+            **({"clock": clock} if clock else {}), **kw,
+        )
+        return sup
+
+    def test_crash_detected_and_restarted(self):
+        spawned = []
+        sup = self._supervisor(spawned, probe=lambda: True)
+        sup.start(wait_ready=True, monitor=False)
+        try:
+            assert sup.check_once() == "running"
+            spawned[-1].returncode = 1  # child crashed
+            assert sup.check_once() == "restarted"
+            assert sup.restarts_total == 1
+            assert sup.last_exit_code == 1
+            assert len(spawned) == 2
+            assert sup.check_once() == "running"
+        finally:
+            sup.stop()
+
+    def test_hung_child_killed_after_probe_threshold(self):
+        spawned = []
+        alive = {"ok": True}
+        sup = self._supervisor(
+            spawned, probe=lambda: alive["ok"],
+            probe_failure_threshold=3,
+        )
+        sup.start(wait_ready=True, monitor=False)
+        try:
+            alive["ok"] = False  # process alive, socket unreachable
+            assert sup.check_once() == "probe-failed"
+            assert sup.check_once() == "probe-failed"
+            assert sup.check_once() == "restarted"  # 3rd failure: hung
+            assert spawned[0].killed == 1
+            assert sup.restarts_total == 1
+        finally:
+            sup.stop()
+
+    def test_fresh_spawn_gets_ready_grace_not_hung(self):
+        """A respawned child paying its cold start (real koord-solver:
+        a multi-second JAX import) must not be declared hung by failed
+        probes — that was an infanticide loop where every respawn was
+        killed before it ever served. Failed probes only count once the
+        child has served, or its ready grace expired."""
+        now = [0.0]
+        spawned = []
+        alive = {"ok": True}
+        sup = self._supervisor(
+            spawned, probe=lambda: alive["ok"], clock=lambda: now[0],
+            probe_failure_threshold=3, ready_timeout_s=60.0,
+        )
+        sup.start(wait_ready=True, monitor=False)
+        try:
+            # crash -> respawn; the new child is "cold" (probe fails)
+            alive["ok"] = False
+            spawned[-1].returncode = 1
+            assert sup.check_once() == "restarted"
+            for _ in range(10):  # way past probe_failure_threshold
+                assert sup.check_once() == "starting"
+            assert len(spawned) == 2  # never killed while starting
+            # the child comes up: normal running state
+            alive["ok"] = True
+            assert sup.check_once() == "running"
+            # ...and from then on failures DO count toward hung
+            alive["ok"] = False
+            assert sup.check_once() == "probe-failed"
+            assert sup.check_once() == "probe-failed"
+            assert sup.check_once() == "restarted"
+            # a child that never comes up is hung once the grace ends
+            now[0] = 100.0  # past ready_timeout_s since the respawn
+            assert sup.check_once() == "probe-failed"
+        finally:
+            sup.stop()
+
+    def test_restart_storm_opens_breaker_then_half_open(self):
+        now = [0.0]
+        spawned = []
+        sup = self._supervisor(
+            spawned, probe=lambda: False, clock=lambda: now[0],
+            breaker=RestartBreaker(
+                threshold=3, window_s=60.0, cooldown_s=30.0,
+                clock=lambda: now[0],
+            ),
+        )
+        sup.start(wait_ready=False, monitor=False)
+        try:
+            # children that die on arrival: every check restarts
+            for i in range(3):
+                spawned[-1].returncode = 1
+                assert sup.check_once() == "restarted", i
+            # 3 restarts in the window: the breaker is open
+            spawned[-1].returncode = 1
+            assert sup.check_once() == "breaker-open"
+            assert sup.status()["breaker"]["open"]
+            assert len(spawned) == 4  # no respawn while open
+            # cooldown elapsed: ONE half-open respawn is allowed
+            now[0] = 31.0
+            assert sup.check_once() == "restarted"
+            spawned[-1].returncode = 1
+            assert sup.check_once() == "breaker-open"
+        finally:
+            sup.stop()
+
+    def test_connection_probe_against_real_service(self, tmp_path):
+        addr = str(tmp_path / "probe.sock")
+        assert not connection_probe(addr, timeout_s=0.2)
+        service = PlacementService(addr)
+        service.start()
+        try:
+            assert connection_probe(addr, timeout_s=0.5)
+        finally:
+            service.stop()
+        assert not connection_probe(addr, timeout_s=0.2)

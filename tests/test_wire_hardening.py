@@ -1,0 +1,374 @@
+"""Wire-boundary hardening (ISSUE 4 satellites 2-4): typed errors on
+half-dead peers, seeded codec fuzzing, and RemoteSolver backoff reset."""
+
+import io
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName as R
+from koordinator_tpu.service.codec import (
+    MAX_FRAME,
+    CodecError,
+    FrameTooLarge,
+    SolveRequest,
+    SolveResponse,
+    TruncatedFrame,
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+    read_frame,
+    write_frame,
+)
+from koordinator_tpu.service.client import (
+    PlacementClient,
+    SolverUnavailable,
+)
+from koordinator_tpu.service.server import PlacementService
+
+
+def _problem(n_nodes=4, n_pods=6):
+    rng = np.random.default_rng(0)
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+    alloc[:, R.CPU] = 16000
+    alloc[:, R.MEMORY] = 32768
+    node = {
+        "alloc": alloc,
+        "used_req": np.zeros_like(alloc),
+        "usage": np.zeros_like(alloc),
+        "prod_usage": np.zeros_like(alloc),
+        "est_extra": np.zeros_like(alloc),
+        "prod_base": np.zeros_like(alloc),
+        "metric_fresh": np.ones(n_nodes, bool),
+        "schedulable": np.ones(n_nodes, bool),
+    }
+    req = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+    req[:, R.CPU] = rng.choice([1000, 2000], n_pods)
+    pods = {
+        "req": req,
+        "est": (req * 85) // 100,
+        "is_prod": np.zeros(n_pods, bool),
+        "is_daemonset": np.zeros(n_pods, bool),
+    }
+    weights = np.zeros(NUM_RESOURCES, np.int32)
+    weights[R.CPU] = 1
+    thresholds = np.zeros(NUM_RESOURCES, np.int32)
+    thresholds[R.CPU] = 65
+    params = {
+        "weights": weights,
+        "thresholds": thresholds,
+        "prod_thresholds": np.zeros(NUM_RESOURCES, np.int32),
+    }
+    return SolveRequest(node=node, pods=pods, params=params)
+
+
+class _HalfDeadServer:
+    """Accepts one connection, reads the request frame, writes a length
+    prefix promising a full response — then delivers only half of it
+    and dies. The canonical mid-response-frame crash."""
+
+    def __init__(self, addr):
+        self.addr = addr
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(addr)
+        self._sock.listen(1)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        conn, _ = self._sock.accept()
+        stream = conn.makefile("rwb")
+        try:
+            read_frame(stream)
+            payload = encode_response(SolveResponse(
+                assignments=np.zeros(4, np.int32)
+            ))
+            stream.write(struct.pack(">I", len(payload)))
+            stream.write(payload[: len(payload) // 2])
+            stream.flush()
+        finally:
+            stream.close()
+            conn.close()
+
+    def stop(self):
+        self._sock.close()
+
+
+class TestHalfDeadPeer:
+    def test_client_mid_response_death_is_typed(self, tmp_path):
+        """Satellite 2: a server dying mid-response-frame surfaces as
+        SolverUnavailable — never struct.error or a bare EOFError."""
+        addr = str(tmp_path / "halfdead.sock")
+        server = _HalfDeadServer(addr)
+        try:
+            client = PlacementClient(addr, timeout=5.0)
+            with pytest.raises(SolverUnavailable):
+                client.solve(_problem())
+            client.close()
+        finally:
+            server.stop()
+
+    def test_client_immediate_close_is_typed(self, tmp_path):
+        """A peer closing cleanly before the response is the same typed
+        failure (it used to be a bare ConnectionError)."""
+        addr = str(tmp_path / "closer.sock")
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(addr)
+        sock.listen(1)
+
+        def close_on_accept():
+            conn, _ = sock.accept()
+            conn.close()
+
+        t = threading.Thread(target=close_on_accept, daemon=True)
+        t.start()
+        try:
+            client = PlacementClient(addr, timeout=5.0)
+            with pytest.raises(SolverUnavailable):
+                client.solve(_problem())
+            client.close()
+        finally:
+            sock.close()
+
+    def test_server_survives_truncated_request(self, tmp_path):
+        """Satellite 2, server side: a client dying mid-request-frame
+        (and one sending an insane length prefix) is dropped quietly —
+        no handler traceback, and the NEXT client solves normally."""
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        handler_errors = []
+        service._server.handle_error = (
+            lambda *a: handler_errors.append(a)
+        )
+        service.start()
+        try:
+            # truncated request: promise 4096 bytes, deliver 10, die
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(addr)
+            sock.sendall(struct.pack(">I", 4096) + b"x" * 10)
+            sock.close()
+            # oversized length prefix
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(addr)
+            sock.sendall(struct.pack(">I", MAX_FRAME + 1))
+            sock.close()
+            time.sleep(0.1)  # let the handler threads run their course
+            with PlacementClient(addr, timeout=30.0) as client:
+                resp = client.solve(_problem())
+                assert (resp.assignments >= 0).all()
+            assert handler_errors == []
+        finally:
+            service.stop()
+
+
+class TestCodecFuzz:
+    """Satellite 3: every malformed payload yields a TYPED error —
+    CodecError / TruncatedFrame / FrameTooLarge — never a hang, an
+    unbounded allocation, or a raw numpy/zipfile internal."""
+
+    DECODE_OK = (CodecError,)
+    FRAME_OK = (TruncatedFrame, FrameTooLarge)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_decoders_fuzz(self, seed):
+        rng = np.random.default_rng(seed)
+        req_payload = encode_request(_problem())
+        resp_payload = encode_response(SolveResponse(
+            assignments=np.array([0, 1, -1], np.int32),
+            node_used_req=np.ones((2, NUM_RESOURCES), np.int32),
+        ))
+        for trial in range(200):
+            base = req_payload if trial % 2 else resp_payload
+            decode = decode_request if trial % 2 else decode_response
+            buf = bytearray(base)
+            kind = trial % 4
+            if kind == 0:  # truncate at a random point
+                buf = buf[: int(rng.integers(0, len(buf)))]
+            elif kind == 1:  # flip random bytes
+                for _ in range(int(rng.integers(1, 16))):
+                    buf[int(rng.integers(0, len(buf)))] ^= int(
+                        rng.integers(1, 256)
+                    )
+            elif kind == 2:  # random garbage of random length
+                buf = bytes(rng.integers(0, 256, int(rng.integers(0, 512)),
+                                         dtype=np.uint8))
+            else:  # truncate AND corrupt
+                buf = buf[: int(rng.integers(1, len(buf)))]
+                if buf:
+                    buf[int(rng.integers(0, len(buf)))] ^= 0xFF
+            try:
+                decode(bytes(buf))
+            except self.DECODE_OK:
+                pass  # typed: the contract
+            # anything else (KeyError, zipfile.BadZipFile, struct.error,
+            # OverflowError, ...) propagates and fails the test
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_read_frame_fuzz(self, seed):
+        rng = np.random.default_rng(seed)
+        payload = encode_request(_problem(n_nodes=2, n_pods=2))
+        frame = struct.pack(">I", len(payload)) + payload
+        for trial in range(200):
+            buf = bytearray(frame)
+            kind = trial % 3
+            if kind == 0:  # truncate (header or payload)
+                buf = buf[: int(rng.integers(0, len(buf)))]
+            elif kind == 1:  # corrupt the length prefix
+                buf[int(rng.integers(0, 4))] ^= int(rng.integers(1, 256))
+            else:  # corrupt payload bytes (framing intact)
+                buf[int(rng.integers(4, len(buf)))] ^= 0xFF
+            stream = io.BytesIO(bytes(buf))
+            try:
+                out = read_frame(stream, max_frame=len(payload) * 4)
+                assert out is None or isinstance(out, bytes)
+            except self.FRAME_OK:
+                pass
+
+    def test_oversized_prefix_refused_before_allocation(self):
+        """The MAX_FRAME cap fires on the 4 header bytes alone: no
+        payload is read (or allocated) for a prefix past the cap."""
+        stream = io.BytesIO(struct.pack(">I", MAX_FRAME + 1))
+        with pytest.raises(FrameTooLarge):
+            read_frame(stream)
+        # nothing beyond the header was consumed
+        assert stream.tell() == 4
+
+        # a caller-narrowed cap fires the same way
+        stream = io.BytesIO(struct.pack(">I", 5000) + b"x" * 5000)
+        with pytest.raises(FrameTooLarge):
+            read_frame(stream, max_frame=4096)
+
+    def test_truncated_frame_is_typed(self):
+        stream = io.BytesIO(struct.pack(">I", 100) + b"x" * 10)
+        with pytest.raises(TruncatedFrame):
+            read_frame(stream)
+
+    def test_valid_roundtrip_still_works(self):
+        req = _problem()
+        buf = io.BytesIO()
+        write_frame(buf, encode_request(req))
+        buf.seek(0)
+        decoded = decode_request(read_frame(buf))
+        np.testing.assert_array_equal(
+            decoded.node["alloc"], req.node["alloc"]
+        )
+
+
+class _FlakySidecar:
+    """Real solves, except while ``shed`` is armed: then a typed
+    ``overloaded`` error per request, decrementing the counter."""
+
+    def __init__(self, addr):
+        from koordinator_tpu.service.admission import error_response
+        from koordinator_tpu.service.server import solve_from_request
+
+        self.shed = [0]
+        self.requests = 0
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(addr)
+        self._sock.listen(4)
+        self._sock.settimeout(0.2)
+
+        def serve_conn(conn):
+            stream = conn.makefile("rwb")
+            try:
+                while True:
+                    payload = read_frame(stream)
+                    if payload is None:
+                        return
+                    self.requests += 1
+                    if self.shed[0] > 0:
+                        self.shed[0] -= 1
+                        resp = error_response("overloaded", "scripted")
+                    else:
+                        resp = solve_from_request(decode_request(payload))
+                    write_frame(stream, encode_response(resp))
+                    stream.flush()
+            except (OSError, EOFError, ValueError):
+                pass
+            finally:
+                stream.close()
+                conn.close()
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except (socket.timeout, OSError):
+                    continue
+                threading.Thread(
+                    target=serve_conn, args=(conn,), daemon=True
+                ).start()
+
+        self._thread = threading.Thread(target=accept_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._sock.close()
+
+
+class TestBackoffReset:
+    def test_backoff_resets_after_successful_solve(self, tmp_path, monkeypatch):
+        """Satellite 4: the exponential backoff is per-solve state — a
+        fail→succeed→fail sequence starts the second failure's delays
+        back at the base, not where the first run left off."""
+        import jax.numpy as jnp
+
+        import koordinator_tpu.service.client as client_mod
+        from koordinator_tpu.ops.binpack import (
+            NodeState,
+            PodBatch,
+            ScoreParams,
+            SolverConfig,
+        )
+        from koordinator_tpu.service.client import RemoteSolver
+
+        req = _problem()
+        state = NodeState(**{k: jnp.asarray(v) for k, v in req.node.items()})
+        batch = PodBatch.build(
+            **{k: jnp.asarray(v) for k, v in req.pods.items()})
+        params = ScoreParams(
+            **{k: jnp.asarray(v) for k, v in req.params.items()})
+        args = (state, batch, params, SolverConfig())
+
+        sleeps = []
+
+        class _Time:
+            monotonic = staticmethod(time.monotonic)
+
+            @staticmethod
+            def sleep(s):
+                sleeps.append(s)
+
+        monkeypatch.setattr(client_mod, "time", _Time)
+
+        class _Rng:
+            def random(self):
+                return 1.0  # jitter factor 1: delay == base * 2**attempt
+
+        addr = str(tmp_path / "flaky.sock")
+        sidecar = _FlakySidecar(addr)
+        try:
+            solver = RemoteSolver(
+                addr, backoff_base_s=0.01, backoff_cap_s=10.0,
+                retry_total_s=60.0, rng=_Rng(),
+            )
+            sidecar.shed[0] = 2
+            solver.solve_result(*args)           # fail, fail, succeed
+            first = list(sleeps)
+            assert first == [0.01, 0.02]         # exponential from base
+            sleeps.clear()
+            sidecar.shed[0] = 2
+            solver.solve_result(*args)           # fail, fail, succeed
+            assert sleeps == first               # RESET: base again
+            solver.close()
+        finally:
+            sidecar.stop()

@@ -9,6 +9,14 @@ cd "$(dirname "$0")/.."
 echo "== graftcheck =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m koordinator_tpu.analysis.graftcheck "$@"
 
+echo "== chaos smoke =="
+# a fast seeded fault-injection pass through the failure-domain layer
+# (torn/corrupt/stalled frames + forced base loss): quick signal that
+# the wire boundary still survives hostile transport before paying for
+# the full suite
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
+    -q -m chaos -k smoke -p no:cacheprovider
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
