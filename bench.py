@@ -1168,6 +1168,131 @@ def bench_outage_failover_churn(repeats):
         backend.close()
 
 
+def bench_audit_overhead_churn(repeats):
+    """Config #12 (ISSUE 5): steady-state churn ticks over a wired bus
+    with the anti-entropy auditor on vs off.
+
+    The auditor's promise is "runtime proof, not runtime tax": a
+    healthy churn run must show ZERO repairs (no false positives) and
+    per-tick overhead under the documented bound
+    (docs/DESIGN.md §14 — ``overhead_bound`` below) at the default
+    cadence, with placements bit-identical to the auditor-less run.
+    Records the amortized sweep cost (``audit_s``), the sweep/detect/
+    repair counters, and the on/off tick walls."""
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+    from koordinator_tpu.client.bus import APIServer, Kind
+    from koordinator_tpu.client.wiring import wire_scheduler
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.scheduler import Scheduler
+    from koordinator_tpu.scheduler.auditor import StateAuditor
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    n_nodes = int(os.environ.get("KTPU_BENCH_AUDIT_NODES", 1000))
+    dirty_per_tick = 20
+    pending_per_tick = 64
+    ticks = max(6, min(repeats * 4, 12))
+    interval = 4
+    probe_rows = 64
+    bound = 0.15  # documented: docs/DESIGN.md §14 probe-budget math
+    # (measured ~0.05 at 1000 nodes / 64-row probe / every-4-rounds
+    # cadence on CPU — the bound holds a 3x margin)
+
+    def run(with_auditor):
+        bus = APIServer()
+        sched = Scheduler(
+            model=PlacementModel(config=SolverConfig(unroll=BENCH_UNROLL))
+        )
+        wire_scheduler(bus, sched)
+        auditor = None
+        if with_auditor:
+            auditor = StateAuditor(
+                sched, bus, interval_rounds=interval,
+                probe_rows=probe_rows,
+            )
+        rng = np.random.default_rng(42)
+        for i in range(n_nodes):
+            bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+                name=f"n{i}", allocatable={CPU: 64000, MEM: 131072}))
+            bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+                node_name=f"n{i}",
+                node_usage={CPU: int(rng.integers(500, 30000)),
+                            MEM: int(rng.integers(512, 65536))},
+                update_time=10.0))
+        walls = []
+        audit_s = 0.0
+        log = []
+        for t in range(ticks):
+            now = 20.0 + t
+            for i in rng.choice(n_nodes, dirty_per_tick, replace=False):
+                name = f"n{int(i)}"
+                bus.apply(Kind.NODE_METRIC, name, NodeMetric(
+                    node_name=name,
+                    node_usage={CPU: int(rng.integers(500, 30000)),
+                                MEM: int(rng.integers(512, 65536))},
+                    update_time=now))
+            for j in range(pending_per_tick):
+                pod = PodSpec(
+                    name=f"t{t}p{j}",
+                    requests={CPU: int(rng.integers(200, 1500)),
+                              MEM: int(rng.integers(128, 1024))})
+                bus.apply(Kind.POD, pod.uid, pod)
+            t0 = time.time()
+            if auditor is not None:
+                report = auditor.on_round(now=now)
+                if report is not None:
+                    audit_s += report["duration_s"]
+            out = sched.schedule_pending(now=now)
+            wall = time.time() - t0
+            if t > 1:  # ticks 0-1 pay compiles + the cold full stage
+                walls.append(wall)
+            elif t == 1 and auditor is not None:
+                # warm the probe's gather programs outside the timed
+                # window: the bound below is a STEADY-STATE promise
+                auditor.sweep("manual", now=now)
+            log.append(sorted(out.items()))
+        n = max(1, len(walls))
+        status = auditor.status() if auditor is not None else {}
+        return {
+            "tick_wall_s": sum(walls) / n,
+            "audit_s_per_tick": audit_s / ticks,
+            "sweeps": status.get("sweeps", {}),
+            "repairs": sum(status.get("repairs", {}).values()),
+            "detections": sum(status.get("detections", {}).values()),
+        }, log
+
+    off, off_log = run(False)
+    on, on_log = run(True)
+    # the honest tax: amortized sweep cost per tick over the baseline
+    # tick wall. (A raw on-vs-off wall diff is biased — the second run
+    # reuses the first's warm jit caches and reads FASTER.)
+    overhead = (
+        on["audit_s_per_tick"] / off["tick_wall_s"]
+        if off["tick_wall_s"] else 0.0
+    )
+    return {
+        "tick_wall_s": on["tick_wall_s"],
+        "tick_wall_off_s": off["tick_wall_s"],
+        "audit_s": on["audit_s_per_tick"],
+        "audit_sweeps": on["sweeps"],
+        # both MUST be 0 on a healthy run: a false-positive repair
+        # would mean the auditor itself perturbs correct state
+        "audit_detections": on["detections"],
+        "audit_repairs": on["repairs"],
+        "overhead_ratio": overhead,
+        "overhead_bound": bound,
+        "within_bound": overhead <= bound,
+        "identical_with_auditor": on_log == off_log,
+        "n_nodes": n_nodes,
+        "dirty_per_tick": dirty_per_tick,
+        "pending_per_tick": pending_per_tick,
+        "ticks": ticks,
+        "audit_interval_rounds": interval,
+        "audit_probe_rows": probe_rows,
+    }
+
+
 def bench_concurrent_solve(repeats):
     """Config #10 (PR 8): 8 concurrent sidecar clients hammering one
     solver — the admission gate's coalescing vs the per-connection
@@ -1691,6 +1816,9 @@ def main():
         )
         matrix["11_outage_failover_churn"] = leg(
             bench_outage_failover_churn, repeats
+        )
+        matrix["12_audit_overhead_churn"] = leg(
+            bench_audit_overhead_churn, repeats
         )
     if os.environ.get("KTPU_BENCH_SHARDED", "1") != "0":
         matrix["sharded"] = leg(bench_sharded, repeats)

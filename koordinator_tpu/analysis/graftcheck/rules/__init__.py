@@ -34,6 +34,10 @@ HOT_MODULES = (
     "koordinator_tpu/service/admission.py",
     "koordinator_tpu/service/failover.py",
     "koordinator_tpu/parallel/mesh.py",
+    # the auditor runs between scheduling rounds, not in the solve loop,
+    # but it handles staged device values: its ONE intentional read-back
+    # (the parity probe) is allowlisted by name; anything else is a bug
+    "koordinator_tpu/scheduler/auditor.py",
 )
 
 #: attribute -> lock maps for the concurrency-critical classes the
@@ -60,7 +64,19 @@ LOCK_SPECS = (
         lock="_lock",
         attrs=(
             "arrays", "state", "tracker", "seen_epoch", "epoch",
-            "last_delta", "last_path",
+            "last_delta", "last_path", "last_now",
+        ),
+    ),
+    # the anti-entropy auditor: sweeps run on the scheduling-loop
+    # thread, status() is read from debug-mux handler threads
+    LockSpec(
+        path="koordinator_tpu/scheduler/auditor.py",
+        class_name="StateAuditor",
+        lock="_lock",
+        attrs=(
+            "_promotion_pending", "_rounds_since", "_probe_cursor",
+            "_unrepairable", "sweeps", "detections", "repairs",
+            "last_report",
         ),
     ),
     LockSpec(
@@ -98,9 +114,13 @@ LOCK_SPECS = (
 #: the delta/full lowering pair and the shared per-row helper registry
 #: both paths must route row values through
 PARITY_SPECS = (
+    # lower_node_rows — the auditor's parity-probe lowering — is held
+    # to the same registry as the full/delta pair: a probe that
+    # computed rows its own way could cry drift (or miss it) purely
+    # from divergent arithmetic
     ParitySpec(
         path="koordinator_tpu/state/cluster.py",
-        funcs=("lower_nodes", "lower_nodes_delta"),
+        funcs=("lower_nodes", "lower_nodes_delta", "lower_node_rows"),
         required_helpers=(
             "_node_metric_row", "_node_hold_rows", "_clip_i32",
             "resources_to_vector",

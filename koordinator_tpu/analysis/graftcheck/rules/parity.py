@@ -36,8 +36,8 @@ _BANNED_NP = ("array", "maximum", "minimum", "where", "clip", "stack")
 @dataclasses.dataclass(frozen=True)
 class ParitySpec:
     path: str                         # repo-relative module path (exact)
-    funcs: Tuple[str, str]            # (full, delta) lowering pair
-    required_helpers: Tuple[str, ...]  # must be called from BOTH paths
+    funcs: Tuple[str, ...]            # parity-coupled lowering functions
+    required_helpers: Tuple[str, ...]  # must be called from EVERY path
     allowed_helpers: Tuple[str, ...] = ()
 
 

@@ -60,6 +60,15 @@ class SchedulerConfig:
     #: throughput optimum on v5e; the library default (8) favors compile
     #: time instead
     solver_unroll: int = 32
+    #: anti-entropy auditor (scheduler/auditor.py): run a budgeted sweep
+    #: every N scheduling rounds. 0 disables the auditor ENTIRELY —
+    #: including the promotion sweep on lease acquisition (main() wires
+    #: no auditor at 0)
+    audit_interval_rounds: int = 16
+    #: staged rows the device<->host parity probe re-lowers and compares
+    #: per sweep (round-robin: every row provably covered within
+    #: ceil(n/probe_rows) sweeps)
+    audit_probe_rows: int = 64
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -145,7 +154,7 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
 
 def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
              log=print, elector=None, now_fn=time.time,
-             max_rounds: Optional[int] = None) -> int:
+             max_rounds: Optional[int] = None, auditor=None) -> int:
     """The scheduling loop over a wired bus: solve the pending queue
     every interval. A sidecar outage without failover skips the round —
     COUNTED and logged, never silent (``scheduler_rounds_skipped_total``
@@ -154,9 +163,17 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
     skips). With ``elector``, rounds run only while holding the lease
     (the reference gates sched.Run on OnStartedLeading,
     server.go:226-252); losing the lease mid-round surfaces as
-    FencingError and demotes to standby. ``max_rounds`` bounds the loop
-    for regression tests: after that many attempted rounds the loop
-    returns the number of skipped rounds (0 = every round placed)."""
+    FencingError, demotes to standby, and immediately FORGETS the
+    aborted round's assumed-but-unbound pods — they were never
+    published, and left in place they would linger until assume expiry
+    and poison a later re-election's first snapshot. With ``auditor``
+    (a scheduler.auditor.StateAuditor), an anti-entropy sweep runs
+    before the round every ``audit_interval_rounds`` rounds, plus a
+    mandatory promotion sweep right after this instance acquires the
+    lease (wired through the elector's ``on_started_leading``).
+    ``max_rounds`` bounds the loop for regression tests: after that
+    many attempted rounds the loop returns the number of skipped rounds
+    (0 = every round placed)."""
     from koordinator_tpu.client.leaderelection import FencingError
     from koordinator_tpu.metrics.components import ROUNDS_SKIPPED
     from koordinator_tpu.service.client import (
@@ -174,6 +191,15 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
             time.sleep(elector.retry_period)
             continue
         rounds += 1
+        if auditor is not None:
+            # repairs land BEFORE the solve so a drifted cache never
+            # feeds a round (the promotion sweep especially: audit the
+            # deposed leader's leavings before the first decision)
+            report = auditor.on_round(now=now_fn())
+            if report is not None and report["detections"]:
+                log(f"audit[{report['kind']}]: "
+                    f"{sum(report['detections'].values())} drift(s) "
+                    f"detected, repairs: {report['repairs']}")
         try:
             out = scheduler.schedule_pending()
         except (SolverUnavailable, SolverOverloaded) as e:
@@ -188,7 +214,15 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
             if once:
                 return 1
         except FencingError as e:
-            log(f"leadership lost mid-round: {e}")
+            # an aborted round placed nothing: it counts as skipped in
+            # the metric AND in max_rounds' return value, consistently
+            # with the solver-outage path above
+            skipped += 1
+            ROUNDS_SKIPPED.inc({"reason": "leadership-lost"})
+            forgotten = scheduler.forget_assumed_unbound()
+            log(f"leadership lost mid-round ({skipped} skipped so "
+                f"far): {e}; forgot {len(forgotten)} "
+                f"assumed-but-unbound pod(s)")
             if once:
                 return 1
         else:
@@ -270,6 +304,17 @@ def main(argv=None) -> int:
         help="seed the bus from a cluster-spec JSON file",
     )
     parser.add_argument(
+        "--audit-interval-rounds", type=int, default=16,
+        help="anti-entropy sweep cadence in scheduling rounds (0 "
+             "disables the auditor entirely); a mandatory promotion "
+             "sweep also runs whenever this instance acquires the lease",
+    )
+    parser.add_argument(
+        "--audit-probe-rows", type=int, default=64,
+        help="staged rows the device<->host parity probe re-lowers and "
+             "compares bit-for-bit per sweep (round-robin coverage)",
+    )
+    parser.add_argument(
         "--leader-elect", action="store_true",
         help="gate scheduling rounds on holding the koord-scheduler "
              "lease (reference: --leader-elect on every binary)",
@@ -300,6 +345,8 @@ def main(argv=None) -> int:
         solver_address=args.solver_address,
         solver_secret=secret,
         solver_failover=args.solver_failover,
+        audit_interval_rounds=args.audit_interval_rounds,
+        audit_probe_rows=args.audit_probe_rows,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler
@@ -337,6 +384,27 @@ def main(argv=None) -> int:
                 or f"koord-scheduler-{os.getpid()}",
             )
         wire_scheduler(bus, scheduler, elector=elector)
+        auditor = None
+        if config.audit_interval_rounds > 0:
+            from koordinator_tpu.scheduler.auditor import StateAuditor
+
+            auditor = StateAuditor(
+                scheduler, bus,
+                interval_rounds=config.audit_interval_rounds,
+                probe_rows=config.audit_probe_rows,
+            )
+            scheduler.services.register("state-auditor", auditor.status)
+            if elector is not None:
+                # promotion sweep: audit the deposed leader's leavings
+                # exactly once per acquisition, before the first round
+                prev_started = elector.on_started_leading
+
+                def _on_started(prev=prev_started, aud=auditor):
+                    aud.note_promotion()
+                    if prev is not None:
+                        prev()
+
+                elector.on_started_leading = _on_started
         if args.cluster_json:
             seed_bus_from_json(bus, args.cluster_json)
         if args.debug_port is not None:
@@ -359,7 +427,8 @@ def main(argv=None) -> int:
                 metrics=SCHEDULER_METRICS, port=args.debug_port,
             ).start()
             print(f"debug http on 127.0.0.1:{http_server.port}")
-        return run_loop(scheduler, config, once=args.once, elector=elector)
+        return run_loop(scheduler, config, once=args.once, elector=elector,
+                        auditor=auditor)
     finally:
         if http_server is not None:
             http_server.stop()

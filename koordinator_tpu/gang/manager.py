@@ -251,6 +251,18 @@ class GangManager:
         if record is not None:
             record.waiting.add(pod_uid)
 
+    def on_pod_forgotten(self, pod_uid: str) -> None:
+        """An assumed pod was forgotten before its bind published (a
+        deposed leader's aborted round, an auditor repair): drop it from
+        waiting/bound without deregistering it from the gang — the pod
+        itself returns to pending and will re-attempt. ``once_satisfied``
+        deliberately stays sticky (the reference's semantics)."""
+        gang_name = self.pod_gang.get(pod_uid)
+        record = self.gangs.get(gang_name) if gang_name else None
+        if record is not None:
+            record.waiting.discard(pod_uid)
+            record.bound.discard(pod_uid)
+
     def on_pod_bound(self, pod_uid: str) -> None:
         gang_name = self.pod_gang.get(pod_uid)
         record = self.gangs.get(gang_name) if gang_name else None

@@ -76,6 +76,43 @@ SUPERVISOR_BREAKER_OPEN = SCHEDULER_METRICS.gauge(
     "1 while the restart-storm circuit breaker refuses respawns",
 )
 
+# -- anti-entropy auditor (scheduler/auditor.py) ----------------------------
+# Every drift detection and every repair the StateAuditor performs is
+# counted here — the repair ladder (targeted -> cache-rebuild ->
+# full-restage) never acts silently (docs/DESIGN.md §14).
+
+AUDIT_SWEEPS = SCHEDULER_METRICS.counter(
+    "scheduler_audit_sweeps_total",
+    "Anti-entropy sweeps run, by trigger",
+    label_names=("kind",),  # periodic | promotion | manual
+)
+AUDIT_DETECTIONS = SCHEDULER_METRICS.counter(
+    "scheduler_audit_detections_total",
+    "Drift/invariant detections, by trust boundary and drift kind",
+    label_names=("boundary", "kind"),  # cache-bus | accounting | device-parity
+)
+AUDIT_REPAIRS = SCHEDULER_METRICS.counter(
+    "scheduler_audit_repairs_total",
+    "Repairs applied, by ladder rung",
+    label_names=("action",),  # targeted | cache-rebuild | full-restage
+)
+AUDIT_SWEEP_DURATION = SCHEDULER_METRICS.histogram(
+    "scheduler_audit_sweep_seconds",
+    "Wall-clock per anti-entropy sweep",
+)
+AUDIT_LAST_DRIFT = SCHEDULER_METRICS.gauge(
+    "scheduler_audit_last_sweep_drift",
+    "Detections in the most recent sweep (0 on a healthy tick)",
+)
+AUDIT_PROBE_ROWS = SCHEDULER_METRICS.counter(
+    "scheduler_audit_probe_rows_total",
+    "Staged rows re-lowered and compared by the device-parity probe",
+)
+AUDIT_UNREPAIRED = SCHEDULER_METRICS.gauge(
+    "scheduler_audit_unrepaired",
+    "Invariant violations that survived the repair ladder (page on >0)",
+)
+
 # -- koordlet (pkg/koordlet/metrics: internal + external sets) --------------
 
 KOORDLET_INTERNAL_METRICS = Registry("koordlet-internal")

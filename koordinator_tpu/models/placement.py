@@ -140,7 +140,7 @@ class ScheduleResult(Dict[str, Optional[str]]):
     """
 
     def __init__(self, assignments, waiting=None, fine_states=None,
-                 resv_allocs=None):
+                 resv_allocs=None, resv_committed=None):
         super().__init__(assignments)
         self.waiting: Dict[str, str] = dict(waiting or {})
         #: uid -> (node name, CycleState) for fine-grained (NUMA/device)
@@ -150,6 +150,11 @@ class ScheduleResult(Dict[str, Optional[str]]):
         #: uid -> (reservation name, delta vector) for *waiting* pods'
         #: reservation consumption — rolled back if the wait expires.
         self.resv_allocs: Dict[str, tuple] = dict(resv_allocs or {})
+        #: uid -> (reservation name, delta vector) for COMMITTED pods'
+        #: consumption this round — the scheduler keeps these
+        #: rollback-able until the bind publishes (a deposed leader's
+        #: FencingError abort must restore the credit).
+        self.resv_committed: Dict[str, tuple] = dict(resv_committed or {})
         #: uid -> nominated node for pods that triggered preemption this
         #: round (victims evicted; the pod binds in a later round)
         self.nominations: Dict[str, str] = {}
@@ -210,6 +215,12 @@ class StagedStateCache:
         self.epoch = 0
         self.last_delta: Optional[NodeStagingDelta] = None
         self.last_path: Optional[str] = None       # "full" | "delta"
+        #: snapshot.now of the last ensure() — the time base the cached
+        #: arrays' metric_fresh column was computed with. The runtime
+        #: auditor's parity probe re-lowers sampled rows against THIS
+        #: now (not wall time), so a freshness flip between solves can
+        #: never read as staging drift.
+        self.last_now: Optional[float] = None
         # schedule() is NOT reentrant — drive one model from one
         # scheduler loop. What this lock guarantees is narrower and
         # unconditional: ensure()'s compound mutation (in-place host
@@ -264,6 +275,7 @@ class StagedStateCache:
                 )
                 if idx is not None:
                     self.seen_epoch = epoch_now
+                    self.last_now = snapshot.now
                     t1 = time.perf_counter()
                     base = self.epoch
                     if idx.size:
@@ -313,6 +325,7 @@ class StagedStateCache:
             self.state = state
             self.tracker = tracker
             self.seen_epoch = epoch_now
+            self.last_now = snapshot.now
             self.epoch += 1
             self.last_delta = NodeStagingDelta(self.epoch)
             self.last_path = "full"
@@ -334,6 +347,22 @@ class StagedStateCache:
             self.seen_epoch = -1
             self.last_delta = None
             self.last_path = None
+            self.last_now = None
+
+    def audit_view(self):
+        """A consistent view of the staged world for the runtime
+        auditor's parity probe: ``(arrays, state, tracker, seen_epoch,
+        last_now)`` captured under the cache lock — the probe then
+        re-lowers sampled rows from typed truth and compares against
+        exactly this staging generation (scheduler/auditor.py). The
+        host arrays are patched in place between solves only under the
+        same lock, so a sweep running between scheduling rounds sees a
+        settled generation, never a half-applied delta."""
+        with self._lock:
+            return (
+                self.arrays, self.state, self.tracker,
+                self.seen_epoch, self.last_now,
+            )
 
 
 class PlacementModel:
@@ -865,8 +894,9 @@ class PlacementModel:
         # reservation consumption bookkeeping (the incremental Reserve's
         # mutation of the matched ReservationSpec)
         resv_allocs: Dict[str, tuple] = {}
+        resv_committed: Dict[str, tuple] = {}
         if resv_arrays is not None:
-            resv_allocs = self._apply_reservations(
+            resv_allocs, resv_committed = self._apply_reservations(
                 snapshot, resv_specs, result, pods_in_order, commit, waiting
             )
 
@@ -882,6 +912,7 @@ class PlacementModel:
             },
             fine_states=fine_states,
             resv_allocs=resv_allocs,
+            resv_committed=resv_committed,
         )
 
     def _dispatch_solve(self, state, batch, quota_state, gang_state,
@@ -1158,6 +1189,7 @@ class PlacementModel:
         delta = np.asarray(result.resv_delta)
         keep = commit | waiting
         out: Dict[str, tuple] = {}
+        committed: Dict[str, tuple] = {}
         tracker = getattr(snapshot, "delta_tracker", None)
         for i, pod in enumerate(pods_in_order):
             v = int(vstar[i])
@@ -1169,13 +1201,19 @@ class PlacementModel:
             spec.allocated_pod_uids.append(pod.uid)
             if spec.allocate_once:
                 spec.state = ReservationState.SUCCEEDED
+            # committed AND waiting pods both record their consumption:
+            # the scheduler must be able to roll either back while the
+            # decision is still unpublished (WaitTime expiry for the
+            # waiting, a fencing abort for the committed)
             if waiting[i]:
                 out[pod.uid] = (spec.name, delta[i].copy())
+            else:
+                committed[pod.uid] = (spec.name, delta[i].copy())
             if tracker is not None:
                 # the mutated allocation changes the node's lowered
                 # reservation hold — the next delta must re-lower it
                 tracker.mark_node(spec.node_name)
-        return out
+        return out, committed
 
     def _build_quota_state(self, snapshot, quota_names, quota_index, node_arrays):
         """Lower the (possibly hierarchical) quota tree to a device

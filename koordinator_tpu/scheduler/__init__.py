@@ -15,5 +15,6 @@ from koordinator_tpu.scheduler.framework import (  # noqa: F401
     Plugin,
     SchedulingFramework,
 )
+from koordinator_tpu.scheduler.auditor import StateAuditor  # noqa: F401
 from koordinator_tpu.scheduler.cache import SchedulerCache  # noqa: F401
 from koordinator_tpu.scheduler.scheduler import Scheduler  # noqa: F401

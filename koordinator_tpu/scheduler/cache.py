@@ -144,6 +144,17 @@ class SchedulerCache:
                 pod.waiting_permit = False
                 self.pending[pod.uid] = pod
 
+    def open_permit(self, uid: str) -> None:
+        """The Permit barrier opened: the pod becomes bindable. The
+        assume entry is KEPT — only the publish confirmation
+        (:meth:`finish_binding`) closes it, so a round that aborts
+        after opening the barrier (FencingError) can still forget the
+        never-published decision."""
+        with self._lock:
+            pod = self.pods.get(uid)
+            if pod is not None:
+                pod.waiting_permit = False
+
     def finish_binding(self, uid: str) -> None:
         with self._lock:
             self.assumed.pop(uid, None)

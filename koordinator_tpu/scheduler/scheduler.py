@@ -101,6 +101,13 @@ class Scheduler:
         #: waiting pods' reservation consumption (uid -> (resv name,
         #: delta vector)) — rolled back if the wait expires
         self._resv_waiting: Dict[str, tuple] = {}
+        #: COMMITTED pods' reservation consumption for the current round
+        #: (uid -> (resv name, delta vector)), kept until the bind
+        #: publishes: a FencingError abort must roll these back too, or
+        #: the deposed leader leaves the reservation's credit consumed
+        #: (and an allocate_once reservation stuck SUCCEEDED) for a
+        #: decision that never became observable. Cleared at round start.
+        self._resv_inflight: Dict[str, tuple] = {}
         self.reservation_controller = ReservationController(self.cache)
 
         self._quota_plugin = ElasticQuotaPlugin(
@@ -348,21 +355,33 @@ class Scheduler:
         if pod.gang:
             self.gang_manager.on_pod_bound(pod.uid)
 
+    def _release_node_holds(self, pod: PodSpec) -> None:
+        """Release a pod's fine-grained node holds (NUMA cpuset +
+        devices) — shared by the informer delete path and the fencing
+        forget so the two release sequences cannot drift."""
+        if pod.node_name is None:
+            return
+        self.numa_manager.release(pod.node_name, pod.uid)
+        node_device = self.device_cache.get(pod.node_name)
+        if node_device is not None:
+            node_device.release(pod.uid)
+
     def remove_pod(self, pod: PodSpec) -> None:
         cached = self.cache.pods.get(pod.uid)
         was_assigned = cached is not None and cached.node_name is not None
         if was_assigned:
             # release any fine-grained holds (cpuset/NUMA + devices)
-            self.numa_manager.release(cached.node_name, pod.uid)
-            node_device = self.device_cache.get(cached.node_name)
-            if node_device is not None:
-                node_device.release(pod.uid)
+            self._release_node_holds(cached)
         self.cache.remove_pod(pod.uid)
         self.gang_manager.on_pod_delete(pod.uid)
         self._quota_plugin.on_pod_delete(pod)
         self._fine_waiting.pop(pod.uid, None)
         # a deleted waiting pod never ran: undo its reservation consumption
         self._rollback_reservation(pod.uid)
+        # a deleted COMMITTED pod ran: its published credit is the
+        # reservation controller's to reconcile — just drop the
+        # rollback window entry
+        self._resv_inflight.pop(pod.uid, None)
         if was_assigned and (
             not getattr(cached, "waiting_permit", False)
             or pod.uid in self._waiting
@@ -389,6 +408,9 @@ class Scheduler:
         )
 
         at0 = now if now is not None else time.time()
+        # the previous round's committed binds are published by now (or
+        # were forgotten on abort): their rollback window is over
+        self._resv_inflight = {}
         self.expire_waiting(at0)
         self.reservation_controller.sync(at0)
         if not self.batched_placement:
@@ -412,6 +434,10 @@ class Scheduler:
                 # device solve (the solve derives used from the snapshot;
                 # observers read the manager)
                 self._account_quota(pending.get(uid))
+                if uid in result.resv_committed:
+                    # committed consumption stays rollback-able until
+                    # the bind publishes (fencing-abort coverage)
+                    self._resv_inflight[uid] = result.resv_committed[uid]
         for uid, node in result.waiting.items():
             # waiting gang members hold their node (and their quota, as
             # the incremental Reserve does) but are not bound — flagged
@@ -436,6 +462,7 @@ class Scheduler:
         pending pod in schedule order (the reference's only mode)."""
         from koordinator_tpu.state.cluster import schedule_order
 
+        held_before = set(self._waiting)
         pending = list(self.cache.pending.values())
         order = schedule_order(pending)
         assignments: Dict[str, Optional[str]] = {}
@@ -449,6 +476,21 @@ class Scheduler:
                 waiting[pod.uid] = outcome.node
             else:
                 assignments[pod.uid] = None
+        # siblings released by a later member's Permit ALLOW — this
+        # round's entrants AND previously-held ones — are bound, not
+        # waiting: report them committed so the publish loop confirms
+        # their (still-open) assumes, exactly like the batched path's
+        # _resolve_waiting
+        for uid, node in list(waiting.items()):
+            pod = self.cache.pods.get(uid)
+            if pod is not None and not getattr(pod, "waiting_permit", False):
+                waiting.pop(uid)
+                assignments[uid] = node
+        for uid in held_before.difference(self._waiting, assignments):
+            pod = self.cache.pods.get(uid)
+            if pod is not None and pod.node_name is not None \
+                    and not getattr(pod, "waiting_permit", False):
+                assignments[uid] = pod.node_name
         return ScheduleResult(assignments, waiting=waiting)
 
     #: at most this many preemption scans per batched round
@@ -518,6 +560,46 @@ class Scheduler:
             else:
                 self.remove_pod(victim)
 
+    def forget_assumed_unbound(self) -> List[str]:
+        """Release every assumed-but-unbound pod back to pending,
+        undoing its quota/gang/fine-grained/reservation holds.
+
+        Called by ``run_loop`` when leadership is lost mid-round
+        (FencingError): the aborted round's assumes were never
+        published, so the deposed instance must not keep counting them
+        — they would linger until assume expiry and poison a later
+        re-election's first snapshot. Binds that DID publish are
+        confirmed out of ``cache.assumed`` by the wiring's post-publish
+        ``finish_binding``, so everything still in there is exactly the
+        aborted round's decisions. Returns the forgotten uids."""
+        forgotten: List[str] = []
+        for uid in list(self.cache.assumed):
+            pod = self.cache.pods.get(uid)
+            if pod is None:
+                self.cache.forget_pod(uid)  # orphan entry: just drop it
+                continue
+            if uid in self._waiting:
+                self._release_waiting(uid)
+            else:
+                # the batch's validate loop applied real NUMA/device
+                # holds for this placement — same release as remove_pod
+                self._release_node_holds(pod)
+                self._account_quota(pod, release=True)
+                self._fine_waiting.pop(uid, None)
+                # a committed pod's reservation consumption is recorded
+                # in _resv_inflight until its bind publishes — this one
+                # never will, so restore the credit (and an
+                # allocate_once reservation's AVAILABLE state).
+                # _resv_waiting cannot hold this uid: its entries exist
+                # only for pods in _waiting, handled above.
+                self._apply_resv_rollback(
+                    uid, self._resv_inflight.pop(uid, None)
+                )
+                self.cache.forget_pod(uid)
+            self.gang_manager.on_pod_forgotten(uid)
+            forgotten.append(uid)
+        return forgotten
+
     def expire_waiting(self, now: float) -> List[str]:
         """Reject waiting pods whose gang WaitTime has elapsed (reference:
         Permit wait timeout → unreserve → Strict group rejection,
@@ -567,7 +649,12 @@ class Scheduler:
     def _rollback_reservation(self, uid: str) -> None:
         """Undo a waiting pod's reservation consumption (the incremental
         Unreserve's reservation restore, plugins/reservation.py:114-132)."""
-        info = self._resv_waiting.pop(uid, None)
+        self._apply_resv_rollback(uid, self._resv_waiting.pop(uid, None))
+
+    def _apply_resv_rollback(self, uid: str, info) -> None:
+        """Restore one pod's recorded reservation consumption: shared by
+        the WaitTime-expiry path (``_resv_waiting``) and the fencing
+        abort's committed-but-unpublished path (``_resv_inflight``)."""
         if info is None:
             return
         from koordinator_tpu.apis.types import (
@@ -632,10 +719,17 @@ class Scheduler:
             if satisfied:
                 self._waiting.pop(uid)
                 self._waiting_since.pop(uid, None)
-                self._resv_waiting.pop(uid, None)  # consumption is final
+                info = self._resv_waiting.pop(uid, None)
+                if info is not None:
+                    # consumption becomes final once the bind PUBLISHES;
+                    # until then a fencing abort can still roll it back
+                    self._resv_inflight[uid] = info
                 result.waiting.pop(uid, None)
                 result[uid] = node
-                self.cache.finish_binding(uid)
+                # bindable, but the assume stays open until the publish
+                # confirms it (finish_binding in the wiring) — an
+                # aborted round must be able to forget this decision
+                self.cache.open_permit(uid)
                 self.gang_manager.on_pod_bound(uid)
                 self._fine_pre_bind(uid)
 
@@ -655,12 +749,17 @@ class Scheduler:
 
     def _on_gang_release(self, uids: List[str]) -> None:
         """Incremental path: the Permit barrier opened — waiting siblings
-        become bindable."""
+        become bindable. Same abort-safety contract as the batched
+        path's `_resolve_waiting`: the assume stays open (and the
+        reservation consumption rollback-able) until a publish confirms
+        the bind, so a fencing-aborted round forgets these too."""
         for uid in uids:
-            self.cache.finish_binding(uid)
+            self.cache.open_permit(uid)
             self._waiting.pop(uid, None)
             self._waiting_since.pop(uid, None)
-            self._resv_waiting.pop(uid, None)  # consumption is final
+            info = self._resv_waiting.pop(uid, None)
+            if info is not None:
+                self._resv_inflight[uid] = info
             self._fine_pre_bind(uid)
 
     def _on_gang_reject(self, uids: List[str]) -> None:

@@ -603,6 +603,70 @@ def lower_nodes_delta(
     return np.asarray(sorted(dirty_rows), dtype=np.int32)
 
 
+def lower_node_rows(
+    snapshot: ClusterSnapshot,
+    names: Sequence[str],
+    *,
+    metric_expiration_seconds: float = DEFAULT_NODE_METRIC_EXPIRATION_SECONDS,
+    scaling_factors: Optional[Mapping[ResourceName, int]] = None,
+    resource_weights: Optional[Mapping[ResourceName, int]] = None,
+    aggregated: Optional[AggregatedArgs] = None,
+) -> Dict[str, np.ndarray]:
+    """Freshly lower just ``names``'s rows from typed truth, into new
+    buffers: ``{staged field: [K, ...] array}`` aligned to ``names``.
+
+    This is the runtime auditor's parity-probe path
+    (scheduler/auditor.py): a bounded sample of rows is re-derived from
+    the snapshot each sweep and compared bit-for-bit against the staged
+    host/device arrays. Every row value routes through the SAME per-row
+    helper registry as :func:`lower_nodes` / :func:`lower_nodes_delta`
+    (graftcheck's delta-parity rule pins all three), so a mismatch is
+    evidence of staging drift — a missed tracker mark, a corrupted
+    staged row — never of the probe computing differently.
+
+    ``names`` must be a subset of the snapshot's node names."""
+    sub_index = {name: k for k, name in enumerate(names)}
+    k_count = len(sub_index)
+    node_by_name = {node.name: node for node in snapshot.nodes}
+    alloc = np.zeros((k_count, NUM_RESOURCES), dtype=np.int64)
+    usage = np.zeros((k_count, NUM_RESOURCES), dtype=np.int64)
+    prod_usage = np.zeros((k_count, NUM_RESOURCES), dtype=np.int64)
+    est_extra = np.zeros((k_count, NUM_RESOURCES), dtype=np.int64)
+    prod_base = np.zeros((k_count, NUM_RESOURCES), dtype=np.int64)
+    metric_fresh = np.zeros(k_count, dtype=bool)
+    schedulable = np.ones(k_count, dtype=bool)
+    used_req, assigned_by_node = _node_hold_rows(snapshot, sub_index)
+    for name, k in sub_index.items():
+        node = node_by_name[name]
+        alloc[k] = resources_to_vector(node.allocatable)
+        schedulable[k] = not node.unschedulable
+        metric = snapshot.node_metrics.get(name)
+        if metric is None:
+            continue
+        (
+            usage[k], prod_usage[k], est_extra[k], prod_base[k],
+            metric_fresh[k],
+        ) = _node_metric_row(
+            metric,
+            assigned_by_node.get(name, ()),
+            now=snapshot.now,
+            metric_expiration_seconds=metric_expiration_seconds,
+            scaling_factors=scaling_factors,
+            resource_weights=resource_weights,
+            aggregated=aggregated,
+        )
+    return {
+        "alloc": _clip_i32(alloc),
+        "used_req": _clip_i32(used_req),
+        "usage": _clip_i32(usage),
+        "prod_usage": _clip_i32(prod_usage),
+        "est_extra": _clip_i32(est_extra),
+        "prod_base": _clip_i32(prod_base),
+        "metric_fresh": metric_fresh,
+        "schedulable": schedulable,
+    }
+
+
 def schedule_order(pods: Sequence[PodSpec]) -> List[int]:
     """Order pending pods the way the scheduler queue would: numeric
     priority descending, then sub-priority descending, then FIFO."""

@@ -49,6 +49,16 @@ FAULT_KINDS = (
     "drop-base",         # upstream connection swapped: delta base lost
 )
 
+#: state-corruption fault kinds (injected by :class:`StateSaboteur`
+#: into a live scheduler's caches rather than onto the wire) — the
+#: drift classes the runtime auditor (scheduler/auditor.py) exists to
+#: detect and repair
+STATE_FAULT_KINDS = (
+    "corrupt-cache-cell",   # a cached pod's placement silently rewritten
+    "orphan-assume",        # an assume entry with no pod behind it
+    "desync-staged-row",    # truth mutated WITHOUT a delta-tracker mark
+)
+
 
 class FaultSchedule:
     """Request ordinal (0-based, global across connections) → fault.
@@ -56,12 +66,14 @@ class FaultSchedule:
     ``events`` pins faults explicitly; :meth:`generate` derives a
     schedule from a seed. Ordinals are counted by the proxy in arrival
     order, so a single-threaded scheduler loop sees a reproducible
-    mapping from schedule to wire behavior."""
+    mapping from schedule to wire behavior. State-corruption kinds
+    (:data:`STATE_FAULT_KINDS`) share the same schedule machinery but
+    are executed by :class:`StateSaboteur` against tick ordinals."""
 
     def __init__(self, events: Optional[Dict[int, str]] = None):
         self.events = dict(events or {})
         for kind in self.events.values():
-            if kind not in FAULT_KINDS:
+            if kind not in FAULT_KINDS and kind not in STATE_FAULT_KINDS:
                 raise ValueError(f"unknown fault kind: {kind!r}")
 
     @classmethod
@@ -264,6 +276,112 @@ class ChaosProxy:
                     return close_all()
         finally:
             close_all()
+
+
+class StateSaboteur:
+    """Deterministic *state* corruption: the drift classes the runtime
+    auditor (scheduler/auditor.py) detects and repairs, injected into a
+    live scheduler the same way :class:`ChaosProxy` injects wire faults
+    — a :class:`FaultSchedule` maps tick ordinals to
+    :data:`STATE_FAULT_KINDS`, ``inject(tick)`` executes the scheduled
+    fault (seeded target selection; same seed → same victims, forever):
+
+    - ``corrupt-cache-cell``: a cached assigned pod is silently replaced
+      by a copy claiming a different node — the cache now disagrees with
+      bus truth with no event to heal it (auditor: ``stale-pod``).
+    - ``orphan-assume``: an assume entry appears with no pod behind it —
+      the lingering-assume class a crashed round can leave (auditor:
+      ``orphan-assume``).
+    - ``desync-staged-row``: one staged node row (host arrays AND the
+      device half, when staged) is bumped away from typed truth with NO
+      delta-tracker mark — the missed-mark / corrupted-scatter class
+      only the device↔host parity probe can see (auditor:
+      ``staged-host-drift`` / ``staged-device-drift``). Typed truth is
+      NOT touched, so a corrupted-then-repaired run stays bit-identical
+      to a fault-free one.
+
+    ``inject`` returns the fault kind applied (None when nothing was
+    scheduled or its precondition — an assigned pod, a staged row —
+    does not hold yet); ``injected`` counts per kind and ``log`` keeps
+    ``(tick, kind, detail)`` for assertions."""
+
+    def __init__(self, schedule: FaultSchedule, scheduler, seed: int = 0):
+        self.schedule = schedule
+        self.scheduler = scheduler
+        self._rng = random.Random(seed)
+        self.injected: Dict[str, int] = {}
+        self.log: list = []
+
+    def inject(self, tick: int) -> Optional[str]:
+        kind = self.schedule.fault_for(tick)
+        if kind is None or kind not in STATE_FAULT_KINDS:
+            return None
+        detail = getattr(self, "_" + kind.replace("-", "_"))()
+        if detail is None:
+            return None  # precondition unmet — nothing corrupted
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        self.log.append((tick, kind, detail))
+        return kind
+
+    # -- fault implementations ----------------------------------------------
+
+    def _corrupt_cache_cell(self) -> Optional[str]:
+        import dataclasses
+
+        cache = self.scheduler.cache
+        nodes = sorted(cache.nodes)
+        if len(nodes) < 2:
+            return None
+        candidates = sorted(
+            uid for uid, pod in cache.pods.items()
+            if pod.node_name is not None
+            and not getattr(pod, "waiting_permit", False)
+        )
+        if not candidates:
+            return None
+        uid = candidates[self._rng.randrange(len(candidates))]
+        pod = cache.pods[uid]
+        others = [n for n in nodes if n != pod.node_name]
+        wrong = others[self._rng.randrange(len(others))]
+        # a COPY, so the shared bus object keeps the true placement:
+        # exactly the cache-forgot-an-event drift shape
+        cache.pods[uid] = dataclasses.replace(pod, node_name=wrong)
+        return f"{uid}:{pod.node_name}->{wrong}"
+
+    def _orphan_assume(self) -> Optional[str]:
+        cache = self.scheduler.cache
+        uid = f"__ghost__{self._rng.randrange(1 << 30)}"
+        cache.assumed[uid] = 0.0  # ancient: expired by any TTL
+        return uid
+
+    def _desync_staged_row(self) -> Optional[str]:
+        model = getattr(self.scheduler, "model", None)
+        staged = getattr(model, "staged_cache", None)
+        if staged is None:
+            return None
+        arrays, state, tracker, seen_epoch, _now = staged.audit_view()
+        if arrays is None or tracker is None:
+            return None
+        dirty = set(tracker.dirty_since(seen_epoch))
+        cache = self.scheduler.cache
+        candidates = [
+            name for name in arrays.names
+            if name not in dirty and name in cache.node_metrics
+        ]
+        if not candidates:
+            return None
+        name = candidates[self._rng.randrange(len(candidates))]
+        i = arrays.names.index(name)
+        # drift the staged row away from truth on BOTH halves, no
+        # tracker mark: typed truth stays intact (a fault-free run is
+        # still the reference), but nothing event-driven will ever
+        # re-lower this row — only the parity probe can see it
+        arrays.usage[i, 0] += 777
+        if state is not None:
+            staged.state = state._replace(
+                usage=state.usage.at[i, 0].add(777)
+            )
+        return name
 
 
 class InProcessSidecar:

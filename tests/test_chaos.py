@@ -25,8 +25,13 @@ from koordinator_tpu.apis.types import (
     NodeSpec,
     PodSpec,
 )
+from koordinator_tpu.client.bus import APIServer, EventType, Kind
+from koordinator_tpu.client.leaderelection import LeaderElector
+from koordinator_tpu.client.wiring import snapshot_from_bus, wire_scheduler
 from koordinator_tpu.models.placement import PlacementModel
 from koordinator_tpu.ops.binpack import STAGED_NODE_FIELDS
+from koordinator_tpu.scheduler import Scheduler
+from koordinator_tpu.scheduler.auditor import StateAuditor
 from koordinator_tpu.service.client import RemoteSolver
 from koordinator_tpu.service.failover import FailoverSolver
 from koordinator_tpu.service.supervisor import SolverSupervisor
@@ -35,6 +40,7 @@ from koordinator_tpu.testing.chaos import (
     ChaosProxy,
     FaultSchedule,
     InProcessSidecar,
+    StateSaboteur,
 )
 
 CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
@@ -290,3 +296,194 @@ def test_chaos_property_outage_failover_recovery(tmp_path):
         proxy.stop()
         supervisor.stop()
         backend.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 5: two-scheduler kill-the-leader chaos + the anti-entropy auditor
+# ---------------------------------------------------------------------------
+
+AUDIT_NODES = 8
+AUDIT_TICKS = 24
+KILL_TICK = 10       # the leader is SIGKILLed between rounds, mid-churn
+SWEEP_EVERY = 4      # auditor cadence in rounds
+
+
+def _drive_cluster(seed, *, kill_leader, sabotage):
+    """Seeded churn over a wired bus. ``kill_leader=True`` runs TWO
+    leader-elected schedulers, stops ticking the leader at KILL_TICK
+    (the observable behavior of SIGKILL from the bus's seat), and lets
+    the standby promote; corruptions from ``sabotage`` (a FaultSchedule
+    events dict over STATE_FAULT_KINDS) are injected into the STANDBY —
+    the state a newly promoted leader inherits. ``kill_leader=False``
+    is the crash-free single-scheduler reference. Lease timings are
+    chosen so failover costs zero rounds (tick gap 2.0 > lease 1.0):
+    bit-identity against the reference is then a hard assertion, not a
+    race. Returns (per-tick placement log, bus, info)."""
+    rng = np.random.default_rng(seed)
+    bus = APIServer()
+    binds = {}
+    prev_node = {}
+
+    def bind_watch(event, name, pod):
+        node = getattr(pod, "node_name", None)
+        if event is EventType.DELETED:
+            prev_node.pop(pod.uid, None)
+            return
+        if node is not None and prev_node.get(pod.uid) != node:
+            binds[pod.uid] = binds.get(pod.uid, 0) + 1
+        prev_node[pod.uid] = node
+
+    bus.watch(Kind.POD, bind_watch)
+    info = {"binds": binds}
+    if kill_leader:
+        sched_a = Scheduler(model=PlacementModel(use_pallas=False))
+        sched_b = Scheduler(model=PlacementModel(use_pallas=False))
+        ea = LeaderElector(bus, "koord-scheduler", "a", lease_duration=1.0)
+        eb = LeaderElector(bus, "koord-scheduler", "b", lease_duration=1.0)
+        aud_a = StateAuditor(sched_a, bus, interval_rounds=SWEEP_EVERY,
+                             probe_rows=AUDIT_NODES)
+        aud_b = StateAuditor(sched_b, bus, interval_rounds=SWEEP_EVERY,
+                             probe_rows=AUDIT_NODES)
+        ea.on_started_leading = aud_a.note_promotion
+        eb.on_started_leading = aud_b.note_promotion
+        wire_scheduler(bus, sched_a, elector=ea)
+        wire_scheduler(bus, sched_b, elector=eb)
+        saboteur = StateSaboteur(
+            FaultSchedule(sabotage), sched_b, seed=seed
+        )
+        seats = [(ea, sched_a, aud_a), (eb, sched_b, aud_b)]
+        info.update(aud_a=aud_a, aud_b=aud_b, saboteur=saboteur,
+                    sched_b=sched_b)
+    else:
+        sched = Scheduler(model=PlacementModel(use_pallas=False))
+        wire_scheduler(bus, sched)
+        saboteur = None
+        seats = [(None, sched, None)]
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    for i in range(AUDIT_NODES):
+        bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+            name=f"n{i}", allocatable={CPU: 64000, MEM: 131072}))
+        bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+            node_name=f"n{i}",
+            node_usage={CPU: int(rng.integers(0, 8000)),
+                        MEM: int(rng.integers(0, 16384))},
+            update_time=90.0))
+
+    log = []
+    for t in range(AUDIT_TICKS):
+        now = 100.0 + 2.0 * t
+        for i in rng.choice(AUDIT_NODES, 2, replace=False):
+            name = f"n{int(i)}"
+            bus.apply(Kind.NODE_METRIC, name, NodeMetric(
+                node_name=name,
+                node_usage={CPU: int(rng.integers(0, 12000)),
+                            MEM: int(rng.integers(0, 32768))},
+                update_time=now))
+        for j in range(4):
+            pod = PodSpec(
+                name=f"t{t}p{j}",
+                requests={CPU: int(rng.integers(200, 2000)),
+                          MEM: int(rng.integers(128, 2048))})
+            bus.apply(Kind.POD, pod.uid, pod)
+        if saboteur is not None:
+            saboteur.inject(t)
+        out = None
+        for elector, sched, auditor in seats:
+            if elector is None:
+                out = sched.schedule_pending(now=now)
+                continue
+            if kill_leader and elector is seats[0][0] and t >= KILL_TICK:
+                continue  # SIGKILLed: the deposed leader never ticks again
+            if elector.tick(now):
+                auditor.on_round(now=now)
+                out = sched.schedule_pending(now=now)
+        assert out is not None, f"no leader ran tick {t}"
+        log.append((t, sorted(out.items())))
+    return log, bus, info
+
+
+@pytest.mark.chaos
+def test_chaos_audit_kill_leader_promotion_sweep():
+    """The ISSUE 5 acceptance property: SIGKILL the leader mid-churn
+    with cache/staging corruptions planted in the standby; the standby
+    promotes, the promotion sweep audits and repairs BEFORE its first
+    solve, a later periodic sweep catches the staged-row desync through
+    the parity probe, and the run finishes with placements AND node
+    accounting bit-identical to a crash-free run, zero double-binds —
+    and every injected corruption detected AND repaired with the
+    matching scheduler_audit_* counter incremented."""
+    from koordinator_tpu.metrics.components import (
+        AUDIT_DETECTIONS,
+        AUDIT_REPAIRS,
+    )
+
+    sabotage = {
+        3: "corrupt-cache-cell",   # standby cache lies about a placement
+        5: "orphan-assume",        # ghost assume with no pod behind it
+        14: "desync-staged-row",   # staged row drifts, no tracker mark
+    }
+    watched = (
+        ("cache-bus", "stale-pod"),
+        ("cache-bus", "orphan-assume"),
+        ("device-parity", "staged-host-drift"),
+        ("device-parity", "staged-device-drift"),
+    )
+    det_before = {
+        (b, k): AUDIT_DETECTIONS.value({"boundary": b, "kind": k})
+        for b, k in watched
+    }
+    rep_before = {
+        a: AUDIT_REPAIRS.value({"action": a})
+        for a in ("targeted", "full-restage")
+    }
+
+    live_log, live_bus, info = _drive_cluster(
+        31, kill_leader=True, sabotage=sabotage)
+    ref_log, ref_bus, _ = _drive_cluster(
+        31, kill_leader=False, sabotage={})
+
+    # ---- bit-identical to the crash-free run, tick for tick ----------
+    assert live_log == ref_log
+    got = lower_nodes(snapshot_from_bus(live_bus, now=200.0))
+    want = lower_nodes(snapshot_from_bus(ref_bus, now=200.0))
+    assert got.names == want.names
+    for f in STAGED_NODE_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got, f), getattr(want, f),
+            err_msg=f"node accounting diverged: {f}")
+
+    # ---- zero double-binds (fencing + single-leader rounds) ----------
+    assert info["binds"], "churn never bound anything"
+    assert all(c == 1 for c in info["binds"].values()), info["binds"]
+
+    # ---- every corruption was injected, detected, and repaired -------
+    assert info["saboteur"].injected == {
+        "corrupt-cache-cell": 1, "orphan-assume": 1,
+        "desync-staged-row": 1,
+    }
+    status_b = info["aud_b"].status()
+    assert status_b["sweeps"]["promotion"] == 1  # once per acquisition
+    # the standby's detections are EXACTLY the injected drift — the
+    # healthy rounds around them produce zero false positives
+    assert status_b["detections"] == {
+        "cache-bus/stale-pod": 1,
+        "cache-bus/orphan-assume": 1,
+        "device-parity/staged-host-drift": 1,
+        "device-parity/staged-device-drift": 1,
+    }
+    assert status_b["repairs"]["targeted"] == 2
+    assert status_b["repairs"]["full-restage"] == 1
+    assert status_b["last"]["unrepaired"] == []
+    # the deposed leader's sweeps saw a healthy cache: no detections
+    assert info["aud_a"].status()["detections"] == {}
+    # no repair happened uncounted: the global metric series moved in
+    # lockstep with the per-instance counts
+    for b, k in watched:
+        delta = AUDIT_DETECTIONS.value(
+            {"boundary": b, "kind": k}) - det_before[(b, k)]
+        assert delta == status_b["detections"][f"{b}/{k}"]
+    assert AUDIT_REPAIRS.value(
+        {"action": "targeted"}) - rep_before["targeted"] == 2
+    assert AUDIT_REPAIRS.value(
+        {"action": "full-restage"}) - rep_before["full-restage"] == 1

@@ -17,6 +17,13 @@ echo "== chaos smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
     -q -m chaos -k smoke -p no:cacheprovider
 
+echo "== audit smoke =="
+# the anti-entropy slice: seeded cache/staging corruption -> the
+# auditor detects and repairs (counted) -> a kill-the-leader churn
+# still finishes tick-identical to a crash-free run
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
+    -q -m chaos -k audit -p no:cacheprovider
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
