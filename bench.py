@@ -73,6 +73,7 @@ churn-tick leg.
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -958,6 +959,213 @@ def bench_churn_tick(repeats):
     }
 
 
+def bench_pipelined_churn(repeats):
+    """Config #13 (ISSUE 6): serial vs pipelined ROUND time over the
+    bus-wired scheduler at 5k nodes.
+
+    What "round time" measures, precisely: the host critical path of
+    one scheduling round — everything the loop must finish before the
+    next round may begin. The serial loop serializes stage + solve
+    (blocking read-back) + epilogue + publish, so its round time is the
+    sum. The pipelined loop's round time is ``submit_round``'s wall:
+    retire-wait + catch-up staging + async dispatch — the solve
+    compute, read-back, epilogue, and bus publish retire on the
+    publisher worker during the cadence gap, and informer-dirty rows
+    are prestaged mid-flight (the scheduling-cycle/binding-cycle split
+    of the reference, done TPU-native). Both loops run the same seeded
+    arrival stream — the pipelined one applies tick t+1's arrivals
+    while tick t's solve is in flight, which is exactly the continuous
+    informer traffic a live control plane sees — and placements must
+    match tick for tick (``tick_identical_to_serial``), plus final
+    bus-level node accounting bit-for-bit.
+
+    Acceptance (ISSUE 6): pipelined p99 round < 10 ms at 5k nodes and
+    >= 5x better than the serial round in the same record, with the
+    per-stage lower/stage/solve/publish breakdown for both loops."""
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+    from koordinator_tpu.client.bus import APIServer, Kind
+    from koordinator_tpu.client.wiring import (
+        snapshot_from_bus,
+        wire_scheduler,
+    )
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import (
+        STAGED_NODE_FIELDS,
+        SolverConfig,
+    )
+    from koordinator_tpu.scheduler import Scheduler
+    from koordinator_tpu.scheduler.pipeline import TickPipeline
+    from koordinator_tpu.state.cluster import lower_nodes
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    n_nodes = int(os.environ.get("KTPU_BENCH_PIPE_NODES",
+                                 os.environ.get("KTPU_BENCH_NODES", 5000)))
+    dirty_per_tick = int(os.environ.get("KTPU_BENCH_PIPE_DIRTY", 50))
+    pending_per_tick = 64
+    #: tick cadence: the gap the retire pipeline drains into (a real
+    #: deployment runs 1s; 50ms is a 20x harder version of the same
+    #: loop)
+    interval_s = float(os.environ.get("KTPU_BENCH_PIPE_INTERVAL", 0.05))
+    ticks = max(6, min(repeats * 4, 12))
+    warmup = 2           # compile-warming empty rounds
+    settle = 2           # first timed ticks pay one-off scatter compiles
+
+    def build():
+        rng = np.random.default_rng(42)
+        bus = APIServer()
+        sched = Scheduler(model=PlacementModel(
+            config=SolverConfig(unroll=BENCH_UNROLL)))
+        wire_scheduler(bus, sched)
+        for i in range(n_nodes):
+            bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+                name=f"n{i}", allocatable={CPU: 64000, MEM: 131072}))
+        for i in range(n_nodes):
+            bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+                node_name=f"n{i}",
+                node_usage={CPU: int(rng.integers(500, 30000)),
+                            MEM: int(rng.integers(512, 65536))},
+                update_time=10.0))
+        for j in range(n_nodes):
+            node_i = int(rng.integers(0, n_nodes))
+            pod = PodSpec(
+                name=f"a{j}", node_name=f"n{node_i}", assign_time=5.0,
+                requests={CPU: int(rng.integers(200, 2000)),
+                          MEM: int(rng.integers(128, 2048))})
+            bus.apply(Kind.POD, pod.uid, pod)
+        return bus, sched
+
+    def mutations(rng, bus, t, now):
+        for i in rng.choice(n_nodes, dirty_per_tick, replace=False):
+            name = f"n{int(i)}"
+            bus.apply(Kind.NODE_METRIC, name, NodeMetric(
+                node_name=name,
+                node_usage={CPU: int(rng.integers(500, 30000)),
+                            MEM: int(rng.integers(512, 65536))},
+                update_time=now))
+        for j in range(pending_per_tick):
+            pod = PodSpec(
+                name=f"t{t}p{j}",
+                requests={CPU: int(rng.integers(200, 1500)),
+                          MEM: int(rng.integers(128, 1024))})
+            bus.apply(Kind.POD, pod.uid, pod)
+
+    def stats(samples):
+        xs = sorted(samples)
+        return {
+            "p50_s": xs[len(xs) // 2],
+            # ceil, not floor: at this leg's ~10 timed rounds a floored
+            # index is the 2nd-largest sample, and the sub_10ms_p99
+            # acceptance gate would silently exclude the worst round
+            "p99_s": xs[min(len(xs) - 1,
+                            math.ceil(0.99 * (len(xs) - 1)))],
+            "mean_s": sum(xs) / len(xs),
+        }
+
+    def run_serial():
+        bus, sched = build()
+        rng = np.random.default_rng(7)
+        rounds, log = [], []
+        sums = {"lower_s": 0.0, "stage_s": 0.0, "solve_s": 0.0}
+        for t in range(warmup):
+            sched.schedule_pending(now=15.0 + 0.1 * t)
+        for t in range(ticks):
+            now = 20.0 + t
+            mutations(rng, bus, t, now)
+            t0 = time.perf_counter()
+            out = sched.schedule_pending(now=now)
+            wall = time.perf_counter() - t0
+            log.append(sorted(out.items()))
+            if t >= settle:
+                rounds.append(wall)
+                for k in sums:
+                    sums[k] += sched.model.last_timings[k]
+        n = max(1, len(rounds))
+        return rounds, log, bus, {k: v / n for k, v in sums.items()}
+
+    def run_pipelined():
+        bus, sched = build()
+        rng = np.random.default_rng(7)
+        rounds, log, stage_rows = [], [], []
+        holder = {}
+
+        def on_result(out):
+            log.append(sorted(out.items()))
+            stage_rows.append(holder["p"].status()["last_round"])
+
+        pipeline = TickPipeline(sched, log=lambda *a: None,
+                                on_result=on_result)
+        holder["p"] = pipeline
+        for t in range(warmup):
+            pipeline.submit_round(now=15.0 + 0.1 * t)
+            pipeline.drain("warmup")
+        log.clear()
+        stage_rows.clear()
+        mutations(rng, bus, 0, 20.0)
+        next_fire = time.perf_counter()
+        for t in range(ticks):
+            now = 20.0 + t
+            lag = next_fire - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            t0 = time.perf_counter()
+            pipeline.submit_round(now=now)
+            wall = time.perf_counter() - t0
+            next_fire = t0 + interval_s
+            if t >= settle:
+                rounds.append(wall)
+            if t + 1 < ticks:
+                # the arrival stream lands MID-FLIGHT (while this
+                # tick's solve computes) — what prestage exists for
+                mutations(rng, bus, t + 1, now + 1.0)
+            pipeline.prestage(now=now)
+        pipeline.drain("bench")
+        pipeline.stop()
+        sums = {"lower_s": 0.0, "stage_s": 0.0, "solve_s": 0.0,
+                "publish_s": 0.0}
+        used = stage_rows[settle:]
+        for row in used:
+            for k in sums:
+                sums[k] += row.get(k, 0.0)
+        n = max(1, len(used))
+        return rounds, log, bus, {k: v / n for k, v in sums.items()}
+
+    s_rounds, s_log, s_bus, s_stages = run_serial()
+    p_rounds, p_log, p_bus, p_stages = run_pipelined()
+    identical = s_log == p_log
+    if identical:
+        got = lower_nodes(snapshot_from_bus(p_bus, now=100.0))
+        want = lower_nodes(snapshot_from_bus(s_bus, now=100.0))
+        identical = got.names == want.names and all(
+            np.array_equal(getattr(got, f), getattr(want, f))
+            for f in STAGED_NODE_FIELDS
+        )
+    s = stats(s_rounds)
+    p = stats(p_rounds)
+    return {
+        "round_p99_s": p["p99_s"],
+        "round_p50_s": p["p50_s"],
+        "serial_round_p99_s": s["p99_s"],
+        "serial_round_p50_s": s["p50_s"],
+        "speedup_p99": s["p99_s"] / p["p99_s"] if p["p99_s"] else 0.0,
+        "sub_10ms_p99": p["p99_s"] < 0.010,
+        "tick_identical_to_serial": identical,
+        # the pipelined round's critical path vs what retired off-path
+        "lower_s": p_stages["lower_s"],
+        "stage_s": p_stages["stage_s"],
+        "solve_s": p_stages["solve_s"],
+        "publish_s": p_stages["publish_s"],
+        "serial_lower_s": s_stages["lower_s"],
+        "serial_stage_s": s_stages["stage_s"],
+        "serial_solve_s": s_stages["solve_s"],
+        "n_nodes": n_nodes,
+        "dirty_per_tick": dirty_per_tick,
+        "pending_per_tick": pending_per_tick,
+        "ticks": ticks,
+        "interval_s": interval_s,
+    }
+
+
 def bench_outage_failover_churn(repeats):
     """Config #11 (failure-domain hardening): a sidecar-backed churn
     run with the sidecar SIGKILLed mid-churn, under the supervised
@@ -1819,6 +2027,9 @@ def main():
         )
         matrix["12_audit_overhead_churn"] = leg(
             bench_audit_overhead_churn, repeats
+        )
+        matrix["13_pipelined_churn_5k"] = leg(
+            bench_pipelined_churn, repeats
         )
     if os.environ.get("KTPU_BENCH_SHARDED", "1") != "0":
         matrix["sharded"] = leg(bench_sharded, repeats)

@@ -38,6 +38,12 @@ HOT_MODULES = (
     # but it handles staged device values: its ONE intentional read-back
     # (the parity probe) is allowlisted by name; anything else is a bug
     "koordinator_tpu/scheduler/auditor.py",
+    # the pipelined tick path: the coordinator half (submit/prestage)
+    # must stay taint-clean — the solve's read-back belongs to exactly
+    # one publish-side site (InFlightSchedule.finalize); a stray sync
+    # here would put the device compute back on the round's critical
+    # path
+    "koordinator_tpu/scheduler/pipeline.py",
 )
 
 #: attribute -> lock maps for the concurrency-critical classes the
@@ -64,7 +70,19 @@ LOCK_SPECS = (
         lock="_lock",
         attrs=(
             "arrays", "state", "tracker", "seen_epoch", "epoch",
-            "last_delta", "last_path", "last_now",
+            "last_delta", "last_path", "last_now", "_pinned",
+            "_wire_delta",
+        ),
+    ),
+    # the pipelined tick loop's state machine: the coordinator thread
+    # (submit/drain/status) and the publisher worker (retire) share it
+    LockSpec(
+        path="koordinator_tpu/scheduler/pipeline.py",
+        class_name="TickPipeline",
+        lock="_lock",
+        attrs=(
+            "_inflight", "_pending_error", "_rounds", "_last",
+            "_stopped",
         ),
     ),
     # the anti-entropy auditor: sweeps run on the scheduling-loop

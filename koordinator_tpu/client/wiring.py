@@ -131,8 +131,7 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
     # refreshes of assigned pods idempotently.
     inner_schedule = scheduler.schedule_pending
 
-    def schedule_and_publish(now=None):
-        out = inner_schedule(now=now)
+    def publish_result(out):
         for uid, node in out.items():
             if node is None:
                 continue
@@ -149,9 +148,17 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
                 # a skipped publish (the pod vanished or was replaced
                 # mid-round) must stay forgettable.
                 scheduler.cache.finish_binding(uid)
+
+    def schedule_and_publish(now=None):
+        out = inner_schedule(now=now)
+        publish_result(out)
         return out
 
     scheduler.schedule_pending = schedule_and_publish
+    # the pipelined loop bypasses the blocking wrapper above (it splits
+    # the round across threads) and publishes through this instead,
+    # from the publisher worker
+    scheduler.publish_result = publish_result
 
     # preemption victims must be evicted THROUGH the bus (the reference
     # deletes them via the API server) so koordlet/manager/descheduler

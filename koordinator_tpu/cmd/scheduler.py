@@ -69,6 +69,13 @@ class SchedulerConfig:
     #: per sweep (round-robin: every row provably covered within
     #: ceil(n/probe_rows) sweeps)
     audit_probe_rows: int = 64
+    #: pipelined tick path (scheduler/pipeline.py): overlap staging for
+    #: round N+1 with round N's in-flight solve and move the read-back +
+    #: epilogue + bus publish onto a bounded publisher worker.
+    #: Placements stay bit-identical to the serial loop; the round's
+    #: critical path drops to catch-up staging + dispatch
+    #: (docs/DESIGN.md §15)
+    pipelined_ticks: bool = False
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -154,7 +161,8 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
 
 def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
              log=print, elector=None, now_fn=time.time,
-             max_rounds: Optional[int] = None, auditor=None) -> int:
+             max_rounds: Optional[int] = None, auditor=None,
+             pipeline=None, sleep_fn=time.sleep) -> int:
     """The scheduling loop over a wired bus: solve the pending queue
     every interval. A sidecar outage without failover skips the round —
     COUNTED and logged, never silent (``scheduler_rounds_skipped_total``
@@ -173,7 +181,23 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
     lease (wired through the elector's ``on_started_leading``).
     ``max_rounds`` bounds the loop for regression tests: after that
     many attempted rounds the loop returns the number of skipped rounds
-    (0 = every round placed)."""
+    (0 = every round placed).
+
+    Cadence: rounds fire on an ABSOLUTE deadline grid — the sleep is
+    computed from round start, not from end-of-work — so a slow round
+    (or an overlapped one) does not push every later round back.
+
+    Pipelined mode (``config.pipelined_ticks`` or an explicit
+    ``pipeline``): rounds run through a
+    :class:`~koordinator_tpu.scheduler.pipeline.TickPipeline` — the
+    round's critical path is catch-up staging + async dispatch, while
+    the read-back, epilogue, and bus publish retire on the publisher
+    worker during the cadence gap. A publish-side failure surfaces at
+    the next round boundary and is handled by the SAME handlers below
+    (a deferred FencingError still triggers the fencing forget);
+    auditor sweeps drain the pipeline first so they never read a
+    half-retired round, and failover mode flips quiesce it through the
+    flip hooks wired here."""
     from koordinator_tpu.client.leaderelection import FencingError
     from koordinator_tpu.metrics.components import ROUNDS_SKIPPED
     from koordinator_tpu.service.client import (
@@ -181,59 +205,151 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
         SolverUnavailable,
     )
 
+    if pipeline is None and config.pipelined_ticks:
+        from koordinator_tpu.scheduler.pipeline import TickPipeline
+
+        pipeline = TickPipeline(scheduler, log=log)
+    hooked_backend = None
+    prev_flip = prev_degraded = None
+    if pipeline is not None:
+        scheduler.services.register("tick-pipeline", pipeline.status)
+        backend = getattr(scheduler.model, "backend", None)
+        if backend is not None and hasattr(backend, "on_flip_back"):
+            # degraded-mode flips quiesce the pipeline: the epoch reset
+            # (full restage) must never race an in-flight tick's retire.
+            # The originals are restored on exit — a re-invoked
+            # run_loop must not chain wrappers over stopped pipelines.
+            hooked_backend = backend
+            prev_flip = backend.on_flip_back
+
+            def _flip_back(prev=prev_flip, p=pipeline):
+                p.drain("failover-flip", raise_deferred=False)
+                if prev is not None:
+                    prev()
+
+            backend.on_flip_back = _flip_back
+            if hasattr(backend, "on_flip_degraded"):
+                prev_degraded = backend.on_flip_degraded
+
+                def _flip_degraded(prev=prev_degraded, p=pipeline):
+                    p.drain("failover-flip", raise_deferred=False)
+                    if prev is not None:
+                        prev()
+
+                backend.on_flip_degraded = _flip_degraded
+
     skipped = 0
     rounds = 0
-    while True:
-        if elector is not None and not elector.tick(now_fn()):
-            log("standby: lease held elsewhere")
-            if once:
-                return 3  # distinct from success: no round ran
-            time.sleep(elector.retry_period)
-            continue
-        rounds += 1
-        if auditor is not None:
-            # repairs land BEFORE the solve so a drifted cache never
-            # feeds a round (the promotion sweep especially: audit the
-            # deposed leader's leavings before the first decision)
-            report = auditor.on_round(now=now_fn())
-            if report is not None and report["detections"]:
-                log(f"audit[{report['kind']}]: "
-                    f"{sum(report['detections'].values())} drift(s) "
-                    f"detected, repairs: {report['repairs']}")
-        try:
-            out = scheduler.schedule_pending()
-        except (SolverUnavailable, SolverOverloaded) as e:
-            # overloaded past the client's retry budget is an outage
-            # from this seat: skip (counted), retry next round
-            skipped += 1
-            reason = ("solver-overloaded"
-                      if isinstance(e, SolverOverloaded)
-                      else "solver-unavailable")
-            ROUNDS_SKIPPED.inc({"reason": reason})
-            log(f"round skipped ({skipped} skipped so far): {e}")
-            if once:
-                return 1
-        except FencingError as e:
-            # an aborted round placed nothing: it counts as skipped in
-            # the metric AND in max_rounds' return value, consistently
-            # with the solver-outage path above
-            skipped += 1
+
+    def on_round_error(e):
+        """The one round-failure handler — shared by the main loop's
+        except blocks and the standby-branch drain so the skip count,
+        metric reasons, fencing forget, and log lines cannot drift
+        apart. A FencingError's aborted round placed nothing: it counts
+        as skipped (metric AND max_rounds' return value) exactly like a
+        solver outage, and the forget releases the aborted round's
+        assumed-but-unbound pods — they were never published, and left
+        in place they would linger until assume expiry."""
+        nonlocal skipped
+        skipped += 1
+        if isinstance(e, FencingError):
             ROUNDS_SKIPPED.inc({"reason": "leadership-lost"})
             forgotten = scheduler.forget_assumed_unbound()
             log(f"leadership lost mid-round ({skipped} skipped so "
                 f"far): {e}; forgot {len(forgotten)} "
                 f"assumed-but-unbound pod(s)")
-            if once:
-                return 1
         else:
-            placed = sum(1 for v in out.values() if v is not None)
-            log(f"round: {placed}/{len(out)} placed, "
-                f"{len(out.waiting)} waiting")
-            if once:
-                return 0
-        if max_rounds is not None and rounds >= max_rounds:
-            return skipped
-        time.sleep(config.schedule_interval_seconds)
+            # overloaded past the client's retry budget is an outage
+            # from this seat: skip (counted), retry next round
+            reason = ("solver-overloaded"
+                      if isinstance(e, SolverOverloaded)
+                      else "solver-unavailable")
+            ROUNDS_SKIPPED.inc({"reason": reason})
+            log(f"round skipped ({skipped} skipped so far): {e}")
+
+    try:
+        while True:
+            round_start = now_fn()
+            deadline = round_start + config.schedule_interval_seconds
+            if elector is not None and not elector.tick(round_start):
+                if pipeline is not None:
+                    # a deferred publish-side failure from the round
+                    # that deposed us must surface NOW, not after
+                    # re-election: until the fencing forget runs, the
+                    # aborted round's assumed-but-unbound pods hold
+                    # quota/gang/reservation credit that standby
+                    # metrics, status, and manual audits all read as
+                    # live state
+                    st = pipeline.status()
+                    if st["inflight"] or st["pending_error"]:
+                        try:
+                            pipeline.drain("standby")
+                        except (FencingError, SolverUnavailable,
+                                SolverOverloaded) as e:
+                            on_round_error(e)
+                log("standby: lease held elsewhere")
+                if once:
+                    return 3  # distinct from success: no round ran
+                sleep_fn(elector.retry_period)
+                continue
+            rounds += 1
+            last = max_rounds is not None and rounds >= max_rounds
+            try:
+                if auditor is not None:
+                    if pipeline is not None and auditor.sweep_due():
+                        # quiesce BEFORE the sweep: an unretired tick's
+                        # assumed-but-unpublished decisions would read
+                        # as drift (deferred errors surface here too,
+                        # into the handlers below)
+                        pipeline.drain("auditor-sweep")
+                    # repairs land BEFORE the solve so a drifted cache
+                    # never feeds a round (the promotion sweep
+                    # especially: audit the deposed leader's leavings
+                    # before the first decision)
+                    report = auditor.on_round(now=now_fn())
+                    if report is not None and report["detections"]:
+                        log(f"audit[{report['kind']}]: "
+                            f"{sum(report['detections'].values())} "
+                            f"drift(s) detected, "
+                            f"repairs: {report['repairs']}")
+                if pipeline is not None:
+                    pipeline.submit_round(now=now_fn())
+                    # the overlap window: warm next round's staging
+                    # while this round's solve is in flight
+                    pipeline.prestage(now=now_fn())
+                    if once or last:
+                        # surface this round's own publish-side fate
+                        # before returning/stopping
+                        pipeline.drain("once" if once else "shutdown")
+                    out = None
+                else:
+                    out = scheduler.schedule_pending()
+            except (FencingError, SolverUnavailable,
+                    SolverOverloaded) as e:
+                # in pipelined mode this may be a DEFERRED abort from
+                # the previous round's publish — the handler is the
+                # same safety net either way, and the already-staged
+                # next round re-lowers any forgotten rows from truth
+                on_round_error(e)
+                if once:
+                    return 1
+            else:
+                if out is not None:
+                    placed = sum(1 for v in out.values() if v is not None)
+                    log(f"round: {placed}/{len(out)} placed, "
+                        f"{len(out.waiting)} waiting")
+                if once:
+                    return 0
+            if last:
+                return skipped
+            sleep_fn(max(0.0, deadline - now_fn()))
+    finally:
+        if hooked_backend is not None:
+            hooked_backend.on_flip_back = prev_flip
+            if hasattr(hooked_backend, "on_flip_degraded"):
+                hooked_backend.on_flip_degraded = prev_degraded
+        if pipeline is not None:
+            pipeline.stop()
 
 
 def seed_bus_from_json(bus, path: str) -> None:
@@ -300,6 +416,12 @@ def main(argv=None) -> int:
              "restart-storm circuit breaker)",
     )
     parser.add_argument(
+        "--pipelined-ticks", action="store_true",
+        help="overlapped tick path: stage round N+1 while round N's "
+             "solve is in flight and publish off the critical path "
+             "(bit-identical placements; sub-10ms round critical path)",
+    )
+    parser.add_argument(
         "--cluster-json", default=None,
         help="seed the bus from a cluster-spec JSON file",
     )
@@ -347,6 +469,7 @@ def main(argv=None) -> int:
         solver_failover=args.solver_failover,
         audit_interval_rounds=args.audit_interval_rounds,
         audit_probe_rows=args.audit_probe_rows,
+        pipelined_ticks=args.pipelined_ticks,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler

@@ -113,6 +113,40 @@ AUDIT_UNREPAIRED = SCHEDULER_METRICS.gauge(
     "Invariant violations that survived the repair ladder (page on >0)",
 )
 
+# -- pipelined tick path (scheduler/pipeline.py) ----------------------------
+# The overlapped stage/solve/publish loop's observability: per-stage
+# wall-clock histograms (what the pipeline hides vs what stays on the
+# round's critical path), round critical-path latency, and the drain /
+# deferred-error bookkeeping (docs/DESIGN.md §15).
+
+TICK_STAGE_DURATION = SCHEDULER_METRICS.histogram(
+    "scheduler_tick_stage_seconds",
+    "Per-stage wall-clock of one scheduling tick",
+    label_names=("stage",),  # lower | stage | solve | publish
+)
+ROUND_CRITICAL_PATH = SCHEDULER_METRICS.histogram(
+    "scheduler_round_critical_path_seconds",
+    "Host critical path per round: retire-wait + stage + dispatch "
+    "(the solve compute and publish ride the pipeline off-path)",
+)
+PIPELINE_INFLIGHT = SCHEDULER_METRICS.gauge(
+    "scheduler_pipeline_inflight",
+    "1 while a dispatched tick has not retired (publish pending)",
+)
+PIPELINE_DRAINS = SCHEDULER_METRICS.counter(
+    "scheduler_pipeline_drains_total",
+    "Pipeline quiesce events, by reason",
+    # run_loop emits auditor-sweep | failover-flip | standby (the
+    # deferred-fence surfacing path) | shutdown | once; drain()'s
+    # reason is free-form, so benches/tests add their own
+    label_names=("reason",),
+)
+PIPELINE_DEFERRED_ERRORS = SCHEDULER_METRICS.counter(
+    "scheduler_pipeline_deferred_errors_total",
+    "Publish-side failures surfaced at the next round boundary",
+    label_names=("kind",),  # fencing | solver | other
+)
+
 # -- koordlet (pkg/koordlet/metrics: internal + external sets) --------------
 
 KOORDLET_INTERNAL_METRICS = Registry("koordlet-internal")

@@ -34,6 +34,7 @@ from koordinator_tpu.ops.binpack import (
     ScoreParams,
     SolverConfig,
     bucket_row_update,
+    scatter_node_rows_copied,
     scatter_node_rows_donated,
     schedule_batch,
     solve_batch,
@@ -160,6 +161,104 @@ class ScheduleResult(Dict[str, Optional[str]]):
         self.nominations: Dict[str, str] = {}
 
 
+class InFlightSchedule:
+    """A dispatched-but-unmaterialized batched solve.
+
+    Produced by :meth:`PlacementModel.schedule_async`: the device solve
+    is in flight (jax dispatch is asynchronous), the staged generation
+    it consumes is pinned against donation, and nothing has crossed
+    back to host. :meth:`finalize` is the ONE read-back point — the
+    serial loop calls it immediately (``schedule()``), the pipelined
+    loop calls it publish-side (scheduler/pipeline.py) so staging for
+    the next round overlaps this solve's device time."""
+
+    __slots__ = (
+        "model", "snapshot", "result", "node_names", "pod_uids",
+        "pods_in_order", "node_by_name", "applied", "resv_specs",
+        "n_real", "t_staged", "timings", "pinned", "_final",
+    )
+
+    def __init__(self, model, snapshot, result, node_names, pod_uids,
+                 pods_in_order, node_by_name, applied, resv_specs,
+                 n_real, t_staged, timings, pinned):
+        self.model = model
+        self.snapshot = snapshot
+        self.result = result
+        self.node_names = node_names
+        self.pod_uids = pod_uids
+        self.pods_in_order = pods_in_order
+        self.node_by_name = node_by_name
+        self.applied = applied
+        self.resv_specs = resv_specs
+        self.n_real = n_real
+        self.t_staged = t_staged
+        self.timings = timings
+        self.pinned = pinned
+        self._final: Optional["ScheduleResult"] = None
+
+    def finalize(self) -> "ScheduleResult":
+        """Materialize the solve and run the typed epilogue. Idempotent;
+        blocks until the device compute lands. The np.asarray calls
+        below are the pipeline's designated publish-side read-back."""
+        if self._final is not None:
+            return self._final
+        model = self.model
+        result = self.result
+        n_real = self.n_real
+        assignments = np.asarray(result.assign)[:n_real]
+        commit = np.asarray(result.commit)[:n_real]
+        waiting = np.asarray(result.waiting)[:n_real]
+        rejected = np.asarray(result.rejected)[:n_real]
+        # solve wall: dispatch -> materialized (includes any overlap
+        # window the pipelined loop spent elsewhere — by design, this
+        # is the stage the pipeline hides)
+        self.timings["solve_s"] = time.perf_counter() - self.t_staged
+
+        # fine-grained epilogue: release gang-rejected holds, annotate
+        # committed pods (PreBind), keep waiting pods' holds for the
+        # scheduler to annotate when the Permit barrier opens
+        fine = model.fine
+        fine_states: Dict[str, tuple] = {}
+        for i, node_name, cstate in self.applied:
+            pod = self.pods_in_order[i]
+            node = self.node_by_name[node_name]
+            if rejected[i]:
+                fine.rollback(self.snapshot, pod, node, cstate)
+            elif commit[i]:
+                fine.pre_bind(self.snapshot, pod, node, cstate)
+            else:  # waiting at the Permit barrier
+                fine_states[pod.uid] = (node_name, cstate)
+
+        # reservation consumption bookkeeping (the incremental Reserve's
+        # mutation of the matched ReservationSpec)
+        resv_allocs: Dict[str, tuple] = {}
+        resv_committed: Dict[str, tuple] = {}
+        if self.resv_specs is not None:
+            resv_allocs, resv_committed = model._apply_reservations(
+                self.snapshot, self.resv_specs, result,
+                self.pods_in_order, commit, waiting,
+            )
+
+        out = ScheduleResult(
+            assignments={
+                uid: (self.node_names[a] if c else None)
+                for uid, a, c in zip(self.pod_uids, assignments, commit)
+            },
+            waiting={
+                uid: self.node_names[a]
+                for uid, a, w in zip(self.pod_uids, assignments, waiting)
+                if w
+            },
+            fine_states=fine_states,
+            resv_allocs=resv_allocs,
+            resv_committed=resv_committed,
+        )
+        if self.pinned is not None:
+            model.staged_cache.unpin(self.pinned)
+        self._final = out
+        return out
+
+
 class NodeStagingDelta:
     """How the staged node state last changed — consumed by the sidecar
     backend (service/client.RemoteSolver) to ship only the dirty rows
@@ -179,6 +278,43 @@ class NodeStagingDelta:
         self.base_epoch = base_epoch
         self.idx = idx
         self.rows = rows
+
+
+def merge_staging_deltas(prev: Optional[NodeStagingDelta],
+                         new: NodeStagingDelta) -> NodeStagingDelta:
+    """Fold ``new`` onto an unshipped ``prev`` so the wire delta covers
+    every ensure() since the sidecar last advanced its base.
+
+    The pipelined tick path runs ensure() more than once per solve
+    (prestage while the previous solve is in flight, catch-up at round
+    start); shipping only the LAST ensure's delta would hand the
+    sidecar a base it never held and force a full re-establish every
+    tick. Rows are unioned with later writes winning; a full restage
+    (``base_epoch is None``) poisons the chain and re-establishes."""
+    if new.base_epoch is None or prev is None:
+        return new
+    if prev.base_epoch is None:
+        # a pending full restage is still unshipped: everything after
+        # it is already part of the from-scratch state
+        return NodeStagingDelta(new.epoch)
+    if new.idx is None or new.idx.size == 0:
+        return NodeStagingDelta(new.epoch, prev.base_epoch,
+                                prev.idx, prev.rows)
+    if prev.idx is None or prev.idx.size == 0:
+        return NodeStagingDelta(new.epoch, prev.base_epoch,
+                                new.idx, new.rows)
+    combined = np.concatenate([prev.idx, new.idx])
+    # last occurrence of each index wins (the later ensure re-lowered
+    # that row from newer truth)
+    _, first_in_rev = np.unique(combined[::-1], return_index=True)
+    sel = np.sort(combined.size - 1 - first_in_rev)
+    rows = {
+        f: np.concatenate([prev.rows[f], new.rows[f]])[sel]
+        for f in prev.rows
+    }
+    return NodeStagingDelta(
+        new.epoch, prev.base_epoch, combined[sel], rows
+    )
 
 
 class StagedStateCache:
@@ -215,6 +351,16 @@ class StagedStateCache:
         self.epoch = 0
         self.last_delta: Optional[NodeStagingDelta] = None
         self.last_path: Optional[str] = None       # "full" | "delta"
+        #: the staged generation a dispatched-but-unretired solve holds
+        #: (pipelined tick path): while set, ensure()'s device scatter
+        #: writes a FRESH generation (non-donating) instead of donating
+        #: the pinned buffers out from under the in-flight computation
+        self._pinned: Optional[NodeState] = None
+        #: accumulated unshipped wire delta (merge of every delta-path
+        #: ensure since take_wire_delta) — the pipelined loop runs
+        #: ensure() more than once per solve, and the sidecar needs the
+        #: whole base→current chain, not just the last link
+        self._wire_delta: Optional[NodeStagingDelta] = None
         #: snapshot.now of the last ensure() — the time base the cached
         #: arrays' metric_fresh column was computed with. The runtime
         #: auditor's parity probe re-lowers sampled rows against THIS
@@ -287,9 +433,18 @@ class StagedStateCache:
                         }
                         if want_device and self.state is not None:
                             sidx, srows = bucket_row_update(idx, rows)
-                            self.state = scatter_node_rows_donated(
-                                self.state, jnp.asarray(sidx), srows
-                            )
+                            if self.state is self._pinned:
+                                # double buffer: an in-flight solve holds
+                                # this generation — write the next one
+                                # beside it instead of donating its
+                                # buffers out from under the dispatch
+                                self.state = scatter_node_rows_copied(
+                                    self.state, jnp.asarray(sidx), srows
+                                )
+                            else:
+                                self.state = scatter_node_rows_donated(
+                                    self.state, jnp.asarray(sidx), srows
+                                )
                             jax.block_until_ready(self.state)
                         else:
                             self.state = None  # device half stale
@@ -301,6 +456,9 @@ class StagedStateCache:
                         self.last_delta = NodeStagingDelta(
                             self.epoch, base, idx, {}
                         )
+                    self._wire_delta = merge_staging_deltas(
+                        self._wire_delta, self.last_delta
+                    )
                     if want_device and self.state is None:
                         # re-establish the device half from the current
                         # host arrays (content unchanged — the sidecar
@@ -328,6 +486,7 @@ class StagedStateCache:
             self.last_now = snapshot.now
             self.epoch += 1
             self.last_delta = NodeStagingDelta(self.epoch)
+            self._wire_delta = self.last_delta  # re-establish: chain reset
             self.last_path = "full"
             return arrays, state, {
                 "lower_s": t1 - t0,
@@ -348,6 +507,38 @@ class StagedStateCache:
             self.last_delta = None
             self.last_path = None
             self.last_now = None
+            self._wire_delta = None
+
+    def take_wire_delta(self) -> Optional[Tuple[int, NodeStagingDelta]]:
+        """Pop the accumulated ``(epoch, delta)`` sync point covering
+        every ensure() since the last take — what one solve ships to the
+        sidecar. Taking is optimistic: if the ship fails, the sidecar's
+        ``delta-base-mismatch`` recovery re-establishes a full base at
+        the current epoch, which is exactly where the next accumulation
+        starts."""
+        with self._lock:
+            delta = self._wire_delta
+            self._wire_delta = None
+            if delta is None:
+                return None
+            return (self.epoch, delta)
+
+    def pin(self, state: Optional[NodeState]) -> None:
+        """Mark ``state`` as held by a dispatched, not-yet-retired solve
+        (the pipelined tick path). Until :meth:`unpin`, a delta ensure()
+        scatters into a fresh generation instead of donating the pinned
+        buffers — the double-buffered generations of docs/DESIGN.md §15.
+        The serial loop pins and unpins within one schedule() call, so
+        its steady-state scatter keeps the donating fast path."""
+        with self._lock:
+            self._pinned = state
+
+    def unpin(self, state: Optional[NodeState]) -> None:
+        """The solve holding ``state`` retired; donation is safe again
+        (identity-checked so a stale unpin cannot release a newer pin)."""
+        with self._lock:
+            if self._pinned is state:
+                self._pinned = None
 
     def audit_view(self):
         """A consistent view of the staged world for the runtime
@@ -553,7 +744,42 @@ class PlacementModel:
         (cpuset/device) placements are validated against the host
         allocators and the batch re-solved on conflict (propose →
         validate → refine, models/finegrained.py).
+
+        The serial composition of the split pipeline
+        (:meth:`schedule_async` + :meth:`InFlightSchedule.finalize`):
+        dispatch and materialize back to back, so every existing caller
+        keeps blocking semantics and bit-identical results.
         """
+        return self.schedule_async(snapshot).finalize()
+
+    def prestage(self, snapshot: ClusterSnapshot) -> Optional[Dict[str, float]]:
+        """Warm the staging cache for an upcoming solve — the overlap
+        half of the pipelined tick path (docs/DESIGN.md §15): re-lower
+        and scatter the rows dirtied so far while the previous solve is
+        still in flight, so the round-start catch-up ensure() touches
+        only what changed after this call. Bit-identity is free: rows
+        staged here from pre-epilogue truth are re-marked by the
+        epilogue's own tracker marks and re-lowered from settled truth
+        at catch-up. Taint-clean by design — no read-back, no blocking
+        on the in-flight solve (a pinned generation is never donated).
+        Returns the ensure() timing dict, or None when the snapshot
+        carries no delta tracker (nothing to warm)."""
+        if getattr(snapshot, "delta_tracker", None) is None:
+            return None
+        _, _, times, _ = self.staged_cache.ensure(
+            snapshot, want_device=not self._numa_staging
+        )
+        return times
+
+    def schedule_async(self, snapshot: ClusterSnapshot) -> "InFlightSchedule":
+        """Stage and dispatch one batched solve WITHOUT materializing
+        results: the returned :class:`InFlightSchedule` carries the
+        dispatched (device-future) solve; its :meth:`~InFlightSchedule.
+        finalize` is the one read-back point, run publish-side by the
+        pipelined loop (scheduler/pipeline.py). Fine-grained specials
+        still run the propose→validate→refine loop inline (it reads
+        proposals by design), so those rounds degrade to blocking —
+        the plain churn path stays fully asynchronous."""
         t_start = time.perf_counter()
         gang_names = sorted(snapshot.gangs)
         quota_names = sorted(snapshot.quotas)
@@ -571,7 +797,7 @@ class PlacementModel:
         cache_times = None
         self._staging_delta = None
         if getattr(snapshot, "delta_tracker", None) is not None:
-            node_arrays, staged_state, cache_times, self._staging_delta = (
+            node_arrays, staged_state, cache_times, _ = (
                 self.staged_cache.ensure(
                     snapshot,
                     # a NUMA-carrying NodeState restages below anyway —
@@ -581,6 +807,9 @@ class PlacementModel:
                     want_device=not self._numa_staging,
                 )
             )
+            # the wire sync point covers EVERY ensure since the last
+            # solve (pipelined prestages included), not just this one
+            self._staging_delta = self.staged_cache.take_wire_delta()
         else:
             node_arrays = lower_nodes(
                 snapshot,
@@ -634,6 +863,12 @@ class PlacementModel:
             self._staging_delta = None
         if staged_state is not None:
             state = staged_state
+            # the solve about to dispatch holds this cache generation:
+            # a concurrent prestage must double-buffer, not donate it.
+            # Unpinned at finalize; a dispatch that raises instead is
+            # released by the next schedule_async's pin (one extra
+            # copied scatter at worst).
+            self.staged_cache.pin(state)
         else:
             state = self.stage_nodes(node_arrays, numa_cap, numa_free)
         batch = self.stage_pods(pod_arrays)
@@ -871,48 +1106,20 @@ class PlacementModel:
             extras = _extras_device()
             iteration += 1
 
-        assignments = np.asarray(result.assign)[:n_real]
-        commit = np.asarray(result.commit)[:n_real]
-        waiting = np.asarray(result.waiting)[:n_real]
-        rejected = np.asarray(result.rejected)[:n_real]
-        self.last_timings["solve_s"] = time.perf_counter() - t_staged
-
-        # fine-grained epilogue: release gang-rejected holds, annotate
-        # committed pods (PreBind), keep waiting pods' holds for the
-        # scheduler to annotate when the Permit barrier opens
-        fine_states: Dict[str, tuple] = {}
-        for i, node_name, cstate in applied:
-            pod = pods_in_order[i]
-            node = node_by_name[node_name]
-            if rejected[i]:
-                fine.rollback(snapshot, pod, node, cstate)
-            elif commit[i]:
-                fine.pre_bind(snapshot, pod, node, cstate)
-            else:  # waiting at the Permit barrier
-                fine_states[pod.uid] = (node_name, cstate)
-
-        # reservation consumption bookkeeping (the incremental Reserve's
-        # mutation of the matched ReservationSpec)
-        resv_allocs: Dict[str, tuple] = {}
-        resv_committed: Dict[str, tuple] = {}
-        if resv_arrays is not None:
-            resv_allocs, resv_committed = self._apply_reservations(
-                snapshot, resv_specs, result, pods_in_order, commit, waiting
-            )
-
-        return ScheduleResult(
-            assignments={
-                uid: (node_arrays.names[a] if c else None)
-                for uid, a, c in zip(pod_arrays.uids, assignments, commit)
-            },
-            waiting={
-                uid: node_arrays.names[a]
-                for uid, a, w in zip(pod_arrays.uids, assignments, waiting)
-                if w
-            },
-            fine_states=fine_states,
-            resv_allocs=resv_allocs,
-            resv_committed=resv_committed,
+        return InFlightSchedule(
+            model=self,
+            snapshot=snapshot,
+            result=result,
+            node_names=node_arrays.names,
+            pod_uids=pod_arrays.uids,
+            pods_in_order=pods_in_order,
+            node_by_name=node_by_name,
+            applied=applied,
+            resv_specs=resv_specs if resv_arrays is not None else None,
+            n_real=n_real,
+            t_staged=t_staged,
+            timings=self.last_timings,
+            pinned=staged_state,
         )
 
     def _dispatch_solve(self, state, batch, quota_state, gang_state,

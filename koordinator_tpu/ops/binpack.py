@@ -207,6 +207,16 @@ scatter_node_rows_donated = jax.jit(
     scatter_node_rows, donate_argnums=(0,), static_argnums=()
 )
 
+#: the non-donating twin: used by the staging cache while a dispatched
+#: solve still holds the current staged generation (the pipelined tick
+#: path's double buffer, docs/DESIGN.md §15) — donating a buffer a
+#: live computation reads would hand XLA a license to clobber it, so
+#: the scatter writes a fresh generation instead and the pinned one
+#: stays immutable until the solve retires
+scatter_node_rows_copied = jax.jit(
+    scatter_node_rows, donate_argnums=(), static_argnums=()
+)
+
 
 def bucket_row_update(idx, rows):
     """Pad a dirty-row update to a power-of-two bucket by repeating the

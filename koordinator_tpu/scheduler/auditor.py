@@ -128,6 +128,20 @@ class StateAuditor:
         with self._lock:
             self._promotion_pending = True
 
+    def sweep_due(self) -> bool:
+        """Whether the NEXT :meth:`on_round` will run a sweep — consumed
+        by the pipelined tick loop to quiesce (drain) the pipeline
+        before a sweep ever reads the caches: an unretired tick's
+        assumed-but-unpublished decisions would read as drift. Pure
+        peek, consumes nothing."""
+        with self._lock:
+            if self._promotion_pending:
+                return True
+            return bool(
+                self.interval_rounds
+                and self._rounds_since + 1 >= self.interval_rounds
+            )
+
     def on_round(self, now: Optional[float] = None) -> Optional[dict]:
         """One scheduling round is about to run. Runs the promotion
         sweep if one is pending (once per acquisition, not per round),

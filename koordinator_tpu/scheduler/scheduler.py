@@ -56,6 +56,25 @@ from koordinator_tpu.scheduler.plugins import (
 )
 
 
+class PendingTick:
+    """One scheduling round between dispatch and retirement.
+
+    ``begin_tick`` produces it: either an in-flight async solve
+    (``inflight`` set) or an already-completed incremental round
+    (``result`` set — the BatchedPlacement=false fallback has no device
+    half to overlap). ``commit_tick`` consumes it exactly once."""
+
+    __slots__ = ("at", "pending", "inflight", "solve_started", "result")
+
+    def __init__(self, at, pending=None, inflight=None,
+                 solve_started=None, result=None):
+        self.at = at
+        self.pending = pending or {}
+        self.inflight = inflight
+        self.solve_started = solve_started
+        self.result = result
+
+
 class Scheduler:
     """Top-level scheduler with both backends.
 
@@ -95,6 +114,13 @@ class Scheduler:
         #: API server (defaultpreemption). None = local cache only
         #: (standalone scheduler, no bus).
         self.evict_pod_fn = None
+        #: bind publisher (set by client.wiring.wire_scheduler): applies
+        #: a round's committed placements back onto the bus. The serial
+        #: loop's schedule_and_publish wrapper calls it inline; the
+        #: pipelined loop (scheduler/pipeline.py) calls it from the
+        #: publisher worker, off the round's critical path. None =
+        #: standalone scheduler, nothing to publish to.
+        self.publish_result = None
         #: waiting pods' fine-grained allocation state, annotated at the
         #: barrier (uid -> (node name, CycleState))
         self._fine_waiting: Dict[str, tuple] = {}
@@ -400,27 +426,61 @@ class Scheduler:
     def schedule_pending(self, now: Optional[float] = None) -> ScheduleResult:
         """One batched round: expire stale state (gang WaitTime,
         reservations), solve the whole pending queue on device, and assume
-        committed placements (and waiting holds) into the cache."""
-        from koordinator_tpu.metrics.components import (
-            BATCH_SOLVE_DURATION,
-            PENDING_PODS,
-            SCHEDULING_ATTEMPTS,
-        )
+        committed placements (and waiting holds) into the cache.
+
+        The serial composition of the split tick: :meth:`begin_tick`
+        (round-start bookkeeping + snapshot + async dispatch) directly
+        followed by :meth:`commit_tick` (materialize + epilogue). The
+        pipelined loop (scheduler/pipeline.py) calls the halves from
+        different threads so the epilogue and publish ride the publisher
+        worker while the next round stages."""
+        return self.commit_tick(self.begin_tick(now))
+
+    def begin_tick(self, now: Optional[float] = None) -> "PendingTick":
+        """Round start through solve DISPATCH: expire stale state, take
+        the snapshot, and hand the pending queue to the model without
+        materializing results. Raises the same typed solver errors a
+        blocking round would (the dispatch is where a sidecar outage
+        surfaces)."""
+        from koordinator_tpu.metrics.components import PENDING_PODS
 
         at0 = now if now is not None else time.time()
         # the previous round's committed binds are published by now (or
-        # were forgotten on abort): their rollback window is over
+        # were forgotten on abort): their rollback window is over. The
+        # pipelined loop preserves this ordering — a tick begins only
+        # after the previous tick's publish retired.
         self._resv_inflight = {}
         self.expire_waiting(at0)
         self.reservation_controller.sync(at0)
         if not self.batched_placement:
-            return self._schedule_pending_incremental(now)
+            return PendingTick(
+                at=at0, result=self._schedule_pending_incremental(now)
+            )
         snapshot = self.cache.snapshot(now=now)
         pending = {pod.uid: pod for pod in snapshot.pending_pods}
         PENDING_PODS.set(len(pending))
         solve_started = time.monotonic()
-        result = self.model.schedule(snapshot)
-        BATCH_SOLVE_DURATION.observe(time.monotonic() - solve_started)
+        inflight = self.model.schedule_async(snapshot)
+        return PendingTick(
+            at=at0, pending=pending, inflight=inflight,
+            solve_started=solve_started,
+        )
+
+    def commit_tick(self, tick: "PendingTick") -> ScheduleResult:
+        """Materialize a :meth:`begin_tick` dispatch and run the typed
+        epilogue: assume committed placements (and waiting holds) into
+        the cache, resolve Permit barriers, run batched preemption."""
+        from koordinator_tpu.metrics.components import (
+            BATCH_SOLVE_DURATION,
+            SCHEDULING_ATTEMPTS,
+        )
+
+        if tick.result is not None:
+            return tick.result  # incremental fallback: epilogue ran inline
+        at0 = tick.at
+        pending = tick.pending
+        result = tick.inflight.finalize()
+        BATCH_SOLVE_DURATION.observe(time.monotonic() - tick.solve_started)
         for uid, node in result.items():
             SCHEDULING_ATTEMPTS.inc(
                 {"result": "scheduled" if node is not None else "unschedulable"}

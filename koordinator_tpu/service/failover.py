@@ -86,6 +86,12 @@ class FailoverSolver:
         #: points it at PlacementModel.reset_staging); set-once wiring,
         #: read-only afterwards — deliberately outside the lock map
         self.on_flip_back = on_flip_back
+        #: fired (outside the lock) right after the machine flips TO
+        #: degraded. The pipelined tick loop wires both flip hooks to a
+        #: pipeline drain so a mode transition never interleaves with an
+        #: in-flight tick's publish (docs/DESIGN.md §15); set-once
+        #: wiring like on_flip_back, deliberately outside the lock map
+        self.on_flip_degraded: Optional[Callable[[], None]] = None
         self._clock = clock
         #: delta staging rides through to the remote solver; the local
         #: path solves the full staged state it is handed anyway
@@ -162,6 +168,8 @@ class FailoverSolver:
             if flipped:
                 SOLVER_FAILOVERS.inc({"direction": "to-degraded"})
                 SOLVER_DEGRADED.set(1)
+                if self.on_flip_degraded is not None:
+                    self.on_flip_degraded()
             return self._local(
                 state, batch, params, config, quota_state, gang_state,
                 extras, resv, numa, mode="local-fallback",

@@ -44,3 +44,38 @@ def _clear_jax_caches_per_module():
     """
     yield
     jax.clear_caches()
+
+
+@pytest.fixture
+def xla_compiles():
+    """Counts actual backend compilations: with ``jax_log_compiles``
+    on, jax logs one ``Compiling <name> ...`` record per XLA
+    compilation (cache misses only — pjit cache hits don't log).
+    Yields the live list of compile log messages; ``.clear()`` it after
+    warmup. Shared by the graftcheck recompile guard
+    (tests/test_graftcheck.py) and the pipelined tick path's
+    steady-state guard (tests/test_pipeline.py)."""
+    import logging
+
+    logger = logging.getLogger("jax._src.interpreters.pxla")
+    records = []
+
+    class _Counter(logging.Handler):
+        def emit(self, record):
+            message = record.getMessage()
+            if message.startswith("Compiling "):
+                records.append(message)
+
+    handler = _Counter()
+    prev = jax.config.jax_log_compiles
+    prev_level = logger.level
+    jax.config.update("jax_log_compiles", True)
+    logger.addHandler(handler)
+    if logger.getEffectiveLevel() > logging.WARNING:
+        logger.setLevel(logging.WARNING)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(prev_level)
+        jax.config.update("jax_log_compiles", prev)

@@ -16,10 +16,8 @@ Three layers of teeth, per ISSUE 2:
 
 import ast
 import json
-import logging
 from pathlib import Path
 
-import jax
 import pytest
 
 from koordinator_tpu.analysis.graftcheck import (
@@ -164,7 +162,7 @@ def test_injected_device_get_fails():
     violations, _ = run_checks([module], default_rules(), allow)
     assert len(violations) == 1
     assert violations[0].symbol == "jax.device_get"
-    assert violations[0].func == "PlacementModel.schedule"
+    assert violations[0].func == "PlacementModel.schedule_async"
 
 
 # -- 3b. allowlist engine teeth ----------------------------------------------
@@ -229,36 +227,8 @@ def test_cli_rule_filter(capsys):
 
 # -- 3c. runtime teeth: zero XLA recompiles on a warmed churn tick -----------
 
-@pytest.fixture
-def xla_compiles():
-    """Counts actual backend compilations: with ``jax_log_compiles``
-    on, jax logs one ``Compiling <name> ...`` record per XLA
-    compilation (cache misses only — pjit cache hits don't log).
-    Yields the live list of compile log messages; ``.clear()`` it after
-    warmup."""
-    logger = logging.getLogger("jax._src.interpreters.pxla")
-    records = []
-
-    class _Counter(logging.Handler):
-        def emit(self, record):
-            message = record.getMessage()
-            if message.startswith("Compiling "):
-                records.append(message)
-
-    handler = _Counter()
-    prev = jax.config.jax_log_compiles
-    prev_level = logger.level
-    jax.config.update("jax_log_compiles", True)
-    logger.addHandler(handler)
-    if logger.getEffectiveLevel() > logging.WARNING:
-        logger.setLevel(logging.WARNING)
-    try:
-        yield records
-    finally:
-        logger.removeHandler(handler)
-        logger.setLevel(prev_level)
-        jax.config.update("jax_log_compiles", prev)
-
+# the xla_compiles fixture lives in conftest.py: the pipelined tick
+# path's recompile guard (tests/test_pipeline.py) shares it
 
 def _churn_cluster():
     from koordinator_tpu.apis.extension import ResourceName

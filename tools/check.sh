@@ -17,6 +17,13 @@ echo "== chaos smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
     -q -m chaos -k smoke -p no:cacheprovider
 
+echo "== pipeline smoke =="
+# the overlapped tick path: a few-tick pipelined churn must end
+# bit-identical to the serial loop (stage/solve/publish overlap is a
+# pure latency move, never a semantic one)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_pipeline.py \
+    -q -k smoke -p no:cacheprovider
+
 echo "== audit smoke =="
 # the anti-entropy slice: seeded cache/staging corruption -> the
 # auditor detects and repairs (counted) -> a kill-the-leader churn
