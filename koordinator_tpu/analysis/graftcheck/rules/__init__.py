@@ -44,6 +44,13 @@ HOT_MODULES = (
     # here would put the device compute back on the round's critical
     # path
     "koordinator_tpu/scheduler/pipeline.py",
+    # the trace fabric: span emission rides inside every hot module
+    # above, so the obs layer itself must be provably taint-clean — its
+    # ONE intentional read-back (the explain breakdown's host
+    # materialization, obs/explain.py) is allowlisted by name; any
+    # other device sync here would hide a per-tick stall inside
+    # "observability"
+    "koordinator_tpu/obs/*.py",
 )
 
 #: attribute -> lock maps for the concurrency-critical classes the
@@ -126,6 +133,33 @@ LOCK_SPECS = (
             "consecutive_probe_failures", "last_exit_code",
             "_backoff_attempt", "_spawned_at", "_ready_since_spawn",
         ),
+    ),
+    # the trace fabric (docs/DESIGN.md §16): every thread in the
+    # process — coordinator, publisher, gate executor, sidecar
+    # handlers, debug-mux readers — appends into one ring
+    LockSpec(
+        path="koordinator_tpu/obs/trace.py",
+        class_name="SpanTracer",
+        lock="_lock",
+        attrs=("_events", "_open", "_stuck", "_round", "_next_span",
+               "_emitted"),
+    ),
+    # per-pod timelines: informer intake, the tick path, and the
+    # publish side all stamp stages; debug-mux readers snapshot
+    LockSpec(
+        path="koordinator_tpu/obs/timeline.py",
+        class_name="PodTimelines",
+        lock="_lock",
+        attrs=("_active", "_completed", "_dropped"),
+    ),
+    # the flight recorder: tick paths record, anomaly paths trigger
+    # (possibly from other threads), the mux reads dumps
+    LockSpec(
+        path="koordinator_tpu/obs/flight.py",
+        class_name="FlightRecorder",
+        lock="_lock",
+        attrs=("_ring", "_dumps", "_last_dump", "_dump_dir",
+               "_min_interval_s", "_seq", "_files", "_max_files"),
     ),
 )
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 
 from koordinator_tpu.client.bus import APIServer, EventType, Kind
+from koordinator_tpu.obs.trace import TRACER
 
 
 def transform_node(node):
@@ -132,6 +133,8 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
     inner_schedule = scheduler.schedule_pending
 
     def publish_result(out):
+        t0 = TRACER.now()
+        published = 0
         for uid, node in out.items():
             if node is None:
                 continue
@@ -148,10 +151,28 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
                 # a skipped publish (the pod vanished or was replaced
                 # mid-round) must stay forgettable.
                 scheduler.cache.finish_binding(uid)
+                # the bind is observable: close the pod's timeline
+                # (observes scheduler_pod_e2e_seconds by QoS lane)
+                scheduler.timelines.published(uid)
+                published += 1
+        TRACER.emit("publish", cat="publish", t0=t0,
+                    args={"published": published})
 
     def schedule_and_publish(now=None):
         out = inner_schedule(now=now)
-        publish_result(out)
+        # watchdog mark: the serial loop publishes inline (the
+        # pipelined path opens its own mark from the publisher
+        # worker), so without this a publish wedged on a half-open
+        # connection wedges the loop with zero open marks and the
+        # stuck-publish watchdog never fires
+        rid = getattr(scheduler, "last_round_id", None)
+        if rid is None:
+            rid = TRACER.round_id
+        TRACER.mark_open(f"publish:{rid}", round_id=rid)
+        try:
+            publish_result(out)
+        finally:
+            TRACER.mark_closed(f"publish:{rid}")
         return out
 
     scheduler.schedule_pending = schedule_and_publish

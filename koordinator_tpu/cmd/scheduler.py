@@ -76,6 +76,22 @@ class SchedulerConfig:
     #: critical path drops to catch-up staging + dispatch
     #: (docs/DESIGN.md §15)
     pipelined_ticks: bool = False
+    #: scheduling trace fabric (obs/trace.py): span recording into the
+    #: bounded ring served at /debug/trace. On by default — the cost is
+    #: one lock+append per span (bench leg 13's trace_overhead_ratio
+    #: measures it every run); the stuck-cycle watchdog works even when
+    #: this is off (open marks are always tracked)
+    trace: bool = True
+    #: anomaly flight-recorder dump directory (obs/flight.py). None =
+    #: $KTPU_FLIGHT_DIR or <tmp>/koord-flight
+    flight_dir: Optional[str] = None
+    #: stuck-cycle watchdog threshold (scheduler/monitor.py): an open
+    #: round/publish mark older than this reads as stuck. The mark now
+    #: covers the WHOLE batched round — including a first-round
+    #: cold-start jit compile, which legitimately runs multi-second on
+    #: big clusters — so raise it on deployments where a false
+    #: scheduler_stuck_cycles_total alert is worse than slow detection
+    monitor_timeout_seconds: float = 10.0
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -156,6 +172,16 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
     #: gate off the batched device path: schedule_pending falls back to
     #: per-pod incremental cycles
     scheduler.batched_placement = gates.enabled("BatchedPlacement")
+    scheduler.monitor.timeout = config.monitor_timeout_seconds
+    # the observability knobs apply at THIS layer, not only in main():
+    # an embedder calling build_scheduler()+run_loop() with
+    # trace=False / flight_dir=... must get what the config says
+    from koordinator_tpu.obs.flight import FLIGHT
+    from koordinator_tpu.obs.trace import TRACER
+
+    TRACER.set_enabled(config.trace)
+    if config.flight_dir is not None:
+        FLIGHT.configure(dump_dir=config.flight_dir)
     return scheduler
 
 
@@ -200,6 +226,8 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
     flip hooks wired here."""
     from koordinator_tpu.client.leaderelection import FencingError
     from koordinator_tpu.metrics.components import ROUNDS_SKIPPED
+    from koordinator_tpu.obs.flight import FLIGHT
+    from koordinator_tpu.obs.trace import TRACER
     from koordinator_tpu.service.client import (
         SolverOverloaded,
         SolverUnavailable,
@@ -254,6 +282,10 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
         skipped += 1
         if isinstance(e, FencingError):
             ROUNDS_SKIPPED.inc({"reason": "leadership-lost"})
+            TRACER.instant("fencing-abort", cat="round")
+            # anomaly: preserve the rounds that led up to the aborted
+            # publish before the forget rewrites the cache
+            FLIGHT.trigger("fencing-abort", detail=str(e))
             forgotten = scheduler.forget_assumed_unbound()
             log(f"leadership lost mid-round ({skipped} skipped so "
                 f"far): {e}; forgot {len(forgotten)} "
@@ -267,10 +299,15 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
             ROUNDS_SKIPPED.inc({"reason": reason})
             log(f"round skipped ({skipped} skipped so far): {e}")
 
+    monitor = getattr(scheduler, "monitor", None)
     try:
         while True:
             round_start = now_fn()
             deadline = round_start + config.schedule_interval_seconds
+            if monitor is not None:
+                # span-fed watchdog: flags (and counts) rounds/publishes
+                # whose tracer mark is stuck open past the timeout
+                monitor.check_stuck()
             if elector is not None and not elector.tick(round_start):
                 if pipeline is not None:
                     # a deferred publish-side failure from the round
@@ -336,6 +373,21 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
             else:
                 if out is not None:
                     placed = sum(1 for v in out.values() if v is not None)
+                    # the serial loop's flight-recorder feed (the
+                    # pipelined loop records from the publisher worker)
+                    model = getattr(scheduler, "model", None)
+                    FLIGHT.record_round({
+                        # this scheduler's round, not the shared
+                        # process-global counter (leader + standby)
+                        "round": getattr(scheduler, "last_round_id",
+                                         None),
+                        "at": round_start,
+                        "placed": placed,
+                        "total": len(out),
+                        "waiting": len(out.waiting),
+                        "solver": getattr(model, "last_solver", None),
+                        **(getattr(model, "last_timings", None) or {}),
+                    })
                     log(f"round: {placed}/{len(out)} placed, "
                         f"{len(out.waiting)} waiting")
                 if once:
@@ -437,6 +489,23 @@ def main(argv=None) -> int:
              "compares bit-for-bit per sweep (round-robin coverage)",
     )
     parser.add_argument(
+        "--no-trace", action="store_true",
+        help="disable span recording (obs/trace.py); the stuck-cycle "
+             "watchdog keeps working, /debug/trace serves an empty ring",
+    )
+    parser.add_argument(
+        "--flight-dir", default=None,
+        help="anomaly flight-recorder dump directory (default: "
+             "$KTPU_FLIGHT_DIR or <tmp>/koord-flight)",
+    )
+    parser.add_argument(
+        "--monitor-timeout", type=float, default=10.0,
+        help="stuck-cycle watchdog threshold in seconds: an open "
+             "round/publish mark older than this counts into "
+             "scheduler_stuck_cycles_total; raise it where a cold-start "
+             "compile legitimately holds a round open for longer",
+    )
+    parser.add_argument(
         "--leader-elect", action="store_true",
         help="gate scheduling rounds on holding the koord-scheduler "
              "lease (reference: --leader-elect on every binary)",
@@ -470,9 +539,14 @@ def main(argv=None) -> int:
         audit_interval_rounds=args.audit_interval_rounds,
         audit_probe_rows=args.audit_probe_rows,
         pipelined_ticks=args.pipelined_ticks,
+        trace=not args.no_trace,
+        flight_dir=args.flight_dir,
+        monitor_timeout_seconds=args.monitor_timeout,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler
+    from koordinator_tpu.obs.flight import FLIGHT
+    from koordinator_tpu.obs.trace import TRACER
 
     supervisor = None
     http_server = None
@@ -545,9 +619,15 @@ def main(argv=None) -> int:
                 scheduler.services.register(
                     "solver-failover", scheduler.model.backend.status
                 )
+            from koordinator_tpu.obs.explain import PlacementExplainer
+
+            scheduler.services.register("flight-recorder", FLIGHT.status)
+            scheduler.services.register("trace", TRACER.status)
             http_server = DebugHTTPServer(
                 services=scheduler.services, debug=scheduler.debug,
                 metrics=SCHEDULER_METRICS, port=args.debug_port,
+                tracer=TRACER,
+                explain=PlacementExplainer(scheduler).explain,
             ).start()
             print(f"debug http on 127.0.0.1:{http_server.port}")
         return run_loop(scheduler, config, once=args.once, elector=elector,

@@ -46,7 +46,9 @@ def main(argv=None) -> int:
         "--debug-port", type=int, default=None,
         help="serve /apis/v1/plugins/solver (routing + kernel-breaker "
              "+ admission-gate state), /metrics (admission queue/shed/"
-             "latency series) and /healthz on this port",
+             "latency series), /debug/trace (the sidecar's span ring — "
+             "queue-wait + solve spans tagged with the scheduler's "
+             "wire trace context) and /healthz on this port",
     )
     args = parser.parse_args(argv)
 
@@ -74,6 +76,7 @@ def main(argv=None) -> int:
     debug_server = None
     if args.debug_port is not None:
         from koordinator_tpu.metrics.components import SOLVER_METRICS
+        from koordinator_tpu.obs.trace import TRACER
         from koordinator_tpu.scheduler.monitor import DebugServices
         from koordinator_tpu.utils.debug_http import DebugHTTPServer
 
@@ -81,11 +84,14 @@ def main(argv=None) -> int:
         # the solver's operational state — the kernel-routing breaker
         # ("why is this sidecar riding the scan?") and the admission
         # gate (lane depths, coalesce ratio, shed counts) in one GET;
-        # /metrics serves the same gate as prometheus series
+        # /metrics serves the same gate as prometheus series, and
+        # /debug/trace the sidecar-side spans (queue wait + solve,
+        # joined to the scheduler's trace via the wire trace context)
         services.register("solver", service.status)
+        services.register("trace", TRACER.status)
         debug_server = DebugHTTPServer(
             services=services, metrics=SOLVER_METRICS,
-            port=args.debug_port
+            tracer=TRACER, port=args.debug_port
         ).start()
     print(f"koord-solver: serving on {args.listen}")
     try:

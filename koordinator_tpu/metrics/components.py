@@ -147,6 +147,33 @@ PIPELINE_DEFERRED_ERRORS = SCHEDULER_METRICS.counter(
     label_names=("kind",),  # fencing | solver | other
 )
 
+# -- scheduling trace fabric (koordinator_tpu/obs/) -------------------------
+# Per-pod latency, the span-fed stuck watchdog, and the anomaly flight
+# recorder all land beside the round metrics: the operator asking "is
+# my scheduler placing pods, and how fast per pod?" reads one scrape
+# (docs/DESIGN.md §16).
+
+POD_E2E = SCHEDULER_METRICS.histogram(
+    "scheduler_pod_e2e_seconds",
+    "Per-pod submit→bind end-to-end latency, by QoS lane "
+    "(obs/timeline.py: submit at pending intake, closed when the bind "
+    "publishes on the bus)",
+    label_names=("lane",),  # system | ls | be
+)
+STUCK_CYCLES = SCHEDULER_METRICS.counter(
+    "scheduler_stuck_cycles_total",
+    "Rounds/publishes whose tracer mark stayed open past the watchdog "
+    "timeout (scheduler/monitor.py — counted once per stuck mark)",
+    label_names=("kind",),  # round | publish
+)
+FLIGHT_DUMPS = SCHEDULER_METRICS.counter(
+    "scheduler_flight_dumps_total",
+    "Anomaly flight-recorder dumps written, by trigger",
+    # auditor-detection | failover-flip | fencing-abort |
+    # pipeline-deferred-error | deadline-exceeded | manual
+    label_names=("trigger",),
+)
+
 # -- koordlet (pkg/koordlet/metrics: internal + external sets) --------------
 
 KOORDLET_INTERNAL_METRICS = Registry("koordlet-internal")

@@ -23,6 +23,7 @@ import numpy as np
 from koordinator_tpu.apis.extension import NUM_RESOURCES
 from koordinator_tpu.apis.types import ClusterSnapshot, GangMode
 from koordinator_tpu.models.finegrained import FineGrained
+from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.ops.binpack import (
     STAGED_NODE_FIELDS,
     Extras,
@@ -205,14 +206,24 @@ class InFlightSchedule:
         model = self.model
         result = self.result
         n_real = self.n_real
+        t_readback = time.perf_counter()
         assignments = np.asarray(result.assign)[:n_real]
         commit = np.asarray(result.commit)[:n_real]
         waiting = np.asarray(result.waiting)[:n_real]
         rejected = np.asarray(result.rejected)[:n_real]
+        t_done = time.perf_counter()
         # solve wall: dispatch -> materialized (includes any overlap
         # window the pipelined loop spent elsewhere — by design, this
         # is the stage the pipeline hides)
-        self.timings["solve_s"] = time.perf_counter() - self.t_staged
+        self.timings["solve_s"] = t_done - self.t_staged
+        # retro spans from the timestamps already taken: the device
+        # span covers dispatch->materialized (in a pipelined run it
+        # overlaps the coordinator's next-round staging — that overlap
+        # IS the pipeline, visible as crossing tracks in Perfetto); the
+        # read-back span is the publish-side host transfer alone
+        TRACER.emit("device_solve", cat="device", t0=self.t_staged,
+                    t1=t_done)
+        TRACER.emit("read_back", cat="device", t0=t_readback, t1=t_done)
 
         # fine-grained epilogue: release gang-rejected holds, annotate
         # committed pods (PreBind), keep waiting pods' holds for the
@@ -766,9 +777,14 @@ class PlacementModel:
         carries no delta tracker (nothing to warm)."""
         if getattr(snapshot, "delta_tracker", None) is None:
             return None
+        t0 = time.perf_counter()
         _, _, times, _ = self.staged_cache.ensure(
             snapshot, want_device=not self._numa_staging
         )
+        # the overlap window's signature span: in a pipelined run this
+        # slice visibly crosses the publisher track's device_solve span
+        TRACER.emit("prestage", cat="stage", t0=t0,
+                    args={"for_round": TRACER.round_id + 1})
         return times
 
     def schedule_async(self, snapshot: ClusterSnapshot) -> "InFlightSchedule":
@@ -873,6 +889,10 @@ class PlacementModel:
             state = self.stage_nodes(node_arrays, numa_cap, numa_free)
         batch = self.stage_pods(pod_arrays)
         t_staged = time.perf_counter()
+        # exact retro spans from the timestamps this function already
+        # takes: host lowering, then host->device staging
+        TRACER.emit("lower", cat="stage", t0=t_start, t1=t_host_done)
+        TRACER.emit("stage", cat="stage", t0=t_host_done, t1=t_staged)
         cache_stage_s = cache_times["stage_s"] if cache_times else 0.0
         self.last_timings = {
             # host lowering work (node delta/full + pods + host rows),
@@ -1106,6 +1126,8 @@ class PlacementModel:
             extras = _extras_device()
             iteration += 1
 
+        TRACER.emit("dispatch", cat="device", t0=t_staged,
+                    args={"solver": self.last_solver})
         return InFlightSchedule(
             model=self,
             snapshot=snapshot,

@@ -1,0 +1,32 @@
+"""Observability: the scheduling trace fabric (docs/DESIGN.md §16).
+
+Four surfaces, all off the solve's device path:
+
+- ``obs.trace``    — thread-safe span tracer (bounded ring, monotonic
+  clocks, Chrome-trace-event export: load the JSON in Perfetto and the
+  pipelined stage(N+1)/solve(N) overlap is visible as overlapping
+  tracks).
+- ``obs.timeline`` — per-pod submit→staged→solved→published timelines
+  feeding the ``scheduler_pod_e2e_seconds`` histograms by QoS lane.
+- ``obs.flight``   — anomaly flight recorder: a bounded ring of recent
+  round records dumped to JSON when an anomaly trigger fires (auditor
+  detection, failover flip, fencing abort, deferred pipeline error,
+  deadline-exceeded).
+- ``obs.explain``  — placement explainability: an off-hot-path jitted
+  score breakdown (per-node, per-feature-column scores + filter
+  verdicts, oracle-parity-checked) answering "why did pod X land on
+  node Y / why is it unschedulable" from the debug mux.
+"""
+
+from koordinator_tpu.obs.flight import FLIGHT, FlightRecorder
+from koordinator_tpu.obs.timeline import PodTimelines, lane_of
+from koordinator_tpu.obs.trace import TRACER, SpanTracer
+
+__all__ = [
+    "FLIGHT",
+    "FlightRecorder",
+    "PodTimelines",
+    "SpanTracer",
+    "TRACER",
+    "lane_of",
+]

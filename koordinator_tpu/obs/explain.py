@@ -1,0 +1,225 @@
+"""Placement explainability: per-node, per-feature-column score
+breakdowns for one pod.
+
+The batched solver answers "where does the whole queue go" in one
+program; when an operator asks "why did pod X land on node Y" or "why
+is pod X unschedulable", the fused scan's argmax is opaque. This
+module runs an OFF-hot-path breakdown solve: the same filter/score
+primitives the scan composes (ops/fit.py, ops/loadaware.py — the
+device twins of the oracle's per-node decision functions), jitted once
+and evaluated for a single pod against the full node set, returning
+every column separately:
+
+- filter verdicts: ``schedulable``, ``fit_feasible``,
+  ``loadaware_feasible`` (+ the host-side ``selector`` row)
+- score columns: ``fit_score`` (NodeResourcesFit/LeastAllocated),
+  ``loadaware_score`` (LoadAwareScheduling), each UNWEIGHTED — exactly
+  what the incremental plugin chain's per-plugin ``score`` returns —
+  plus the ``weighted_total`` the argmax ranks by.
+
+Parity contract (docs/DESIGN.md §16, tested in tests/test_obs.py):
+each column is bit-identical to the oracle's scalar transliteration
+(``least_allocated_score_node`` / ``loadaware_score_node`` /
+``fit_filter_node`` / ``loadaware_filter_node``) on the same lowered
+arrays — explain never computes scores "its own way", so a breakdown
+that disagrees with a placement is a bug, not a rounding story.
+
+This is the ONE new intentional read-back of the observability layer:
+``explain_scores`` materializes the breakdown columns to host
+(allowlisted in graftcheck.toml). It runs on debug-mux demand, never
+inside the solve loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.ops.common import reciprocal_for
+from koordinator_tpu.ops.fit import fit_filter, least_allocated_score
+from koordinator_tpu.ops.loadaware import loadaware_filter, loadaware_score
+
+
+def _breakdown(state, req, est, is_prod, is_ds, params, config):
+    """Per-column (never argmax-fused) single-pod scoring — the same
+    primitives score_one_pod composes, returned unreduced."""
+    recip = reciprocal_for(state.alloc)
+    fit_ok = fit_filter(req, state.alloc, state.used_req)
+    load_ok = loadaware_filter(
+        state.alloc, state.usage, state.prod_usage, state.metric_fresh,
+        params.thresholds, params.prod_thresholds, is_ds, is_prod,
+    )
+    fit_sc = least_allocated_score(
+        req, state.alloc, state.used_req, params.weights, recip
+    )
+    load_sc = loadaware_score(
+        est, state.alloc, state.usage, state.est_extra, state.prod_base,
+        state.metric_fresh, params.weights, is_prod,
+        config.score_according_prod, recip,
+    )
+    total = config.fit_weight * fit_sc + config.loadaware_weight * load_sc
+    return {
+        "schedulable": state.schedulable,
+        "fit_feasible": fit_ok,
+        "loadaware_feasible": load_ok,
+        "fit_score": fit_sc,
+        "loadaware_score": load_sc,
+        "weighted_total": total,
+    }
+
+
+#: one compiled breakdown per (N, config) — explain is on-demand, so
+#: the compile amortizes across debug queries against a stable cluster
+_jit_breakdown = jax.jit(
+    _breakdown, static_argnames=("config",), donate_argnums=()
+)
+
+
+def explain_scores(model, snapshot, pod) -> Tuple[object, Dict[str, np.ndarray]]:
+    """(lowered NodeArrays, {column: host array}) for one pod against
+    the snapshot's full node set, lowered and scored exactly as a solve
+    would (same lowering kwargs, same params/config)."""
+    from koordinator_tpu.state.cluster import (
+        lower_nodes,
+        lower_pending_pods,
+    )
+
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    pod_arrays = lower_pending_pods(
+        [pod],
+        scaling_factors=model.scaling_factors,
+        resource_weights=model.resource_weights,
+    )
+    state = model.stage_nodes(arrays)
+    out = _jit_breakdown(
+        state,
+        jnp.asarray(pod_arrays.req[0]),
+        jnp.asarray(pod_arrays.est[0]),
+        jnp.asarray(bool(pod_arrays.is_prod[0])),
+        jnp.asarray(bool(pod_arrays.is_daemonset[0])),
+        model.params,
+        config=model.config,
+    )
+    cols: Dict[str, np.ndarray] = {}
+    for name, col in out.items():
+        # the observability layer's one designated read-back: breakdown
+        # columns land on host for the debug payload / parity check
+        cols[name] = np.asarray(col)
+    return arrays, cols
+
+
+class PlacementExplainer:
+    """Debug-mux front end over :func:`explain_scores` for a wired
+    Scheduler: device columns plus the host-side verdicts the batched
+    epilogue enforces (node selector, quota admission, gang blocking,
+    reservation matches), recorded into the seed ``DebugRecorder``."""
+
+    #: nodes listed in full detail per payload (the rest summarized)
+    TOP_K = 10
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def explain(self, pod_uid: str, node: Optional[str] = None,
+                now: Optional[float] = None) -> dict:
+        sched = self.scheduler
+        pod = sched.cache.pending.get(pod_uid) or sched.cache.pods.get(pod_uid)
+        if pod is None:
+            return {"error": f"unknown pod {pod_uid!r}"}
+        snapshot = sched.cache.snapshot(now=now)
+        arrays, cols = explain_scores(sched.model, snapshot, pod)
+        n = arrays.n
+        names = list(arrays.names)
+        mask = (
+            cols["schedulable"]
+            & cols["fit_feasible"]
+            & cols["loadaware_feasible"]
+        )
+        selector_row = None
+        if pod.node_selector:
+            from koordinator_tpu.apis.types import selector_matches
+
+            selector_row = np.fromiter(
+                (
+                    selector_matches(pod.node_selector, nd.labels)
+                    for nd in snapshot.nodes
+                ),
+                dtype=bool, count=n,
+            )
+            mask = mask & selector_row
+
+        verdicts: Dict[str, object] = {}
+        if pod.gang:
+            verdicts["gang_known"] = pod.gang in snapshot.gangs
+        if pod.quota:
+            from koordinator_tpu.scheduler.framework import CycleState
+
+            status = sched._quota_plugin.pre_filter(
+                CycleState(sched.framework.cycle_seed), snapshot, pod
+            )
+            verdicts["quota_admitted"] = status.ok
+            if not status.ok:
+                verdicts["quota_reason"] = status.reason
+        if snapshot.reservations:
+            from koordinator_tpu.scheduler.plugins.reservation import (
+                reservation_matches_pod,
+            )
+
+            verdicts["reservation_matches"] = [
+                r.name for r in snapshot.reservations
+                if reservation_matches_pod(r, pod)
+            ]
+
+        total = cols["weighted_total"]
+        ranked = np.where(mask, total, -1)
+        best = int(np.argmax(ranked)) if n else -1
+        winner = names[best] if n and ranked[best] >= 0 else None
+
+        def node_detail(i: int) -> dict:
+            d = {
+                "node": names[i],
+                "feasible": bool(mask[i]),
+                "filters": {
+                    "schedulable": bool(cols["schedulable"][i]),
+                    "fit": bool(cols["fit_feasible"][i]),
+                    "loadaware": bool(cols["loadaware_feasible"][i]),
+                },
+                "scores": {
+                    "NodeResourcesFit": int(cols["fit_score"][i]),
+                    "LoadAwareScheduling": int(cols["loadaware_score"][i]),
+                    "weighted_total": int(total[i]),
+                },
+            }
+            if selector_row is not None:
+                d["filters"]["selector"] = bool(selector_row[i])
+            return d
+
+        order = np.argsort(-ranked, kind="stable")[: self.TOP_K]
+        payload = {
+            "pod": pod_uid,
+            "assigned": pod.node_name,
+            "winner": winner,
+            "node_count": n,
+            "feasible_count": int(mask.sum()),
+            "filter_rejections": {
+                "unschedulable": int((~cols["schedulable"]).sum()),
+                "fit": int((~cols["fit_feasible"]).sum()),
+                "loadaware": int((~cols["loadaware_feasible"]).sum()),
+                **(
+                    {"selector": int((~selector_row).sum())}
+                    if selector_row is not None else {}
+                ),
+            },
+            "verdicts": verdicts,
+            "top_nodes": [node_detail(int(i)) for i in order],
+        }
+        if node is not None:
+            if node in names:
+                payload["queried_node"] = node_detail(names.index(node))
+            else:
+                payload["queried_node"] = {"error": f"unknown node {node!r}"}
+        sched.debug.record_explain(payload)
+        return payload

@@ -1,0 +1,209 @@
+"""Per-pod timelines: submit → staged → solved → published.
+
+The round-level histograms (PR 11's per-stage tick breakdown) say how
+fast ROUNDS are; a latency-SLO serving mode (ROADMAP item 2) needs to
+know how fast PODS are — the wall time from a pod entering the pending
+queue to its bind publishing on the bus, per QoS lane. This module
+keeps a bounded registry of in-flight pod timelines, stamped at the
+four scheduler-side lifecycle points:
+
+- **submit**    — the pod entered the pending queue (``Scheduler.
+  add_pod``; the in-process bus has no separate intake hop, so submit
+  and enqueue collapse to one stamp here).
+- **staged**    — a round's snapshot picked the pod up
+  (``begin_tick``).
+- **solved**    — the device solve placed it (``commit_tick`` — the
+  epilogue's assume).
+- **published** — the bind landed on the bus (the wiring's
+  ``publish_result``). This closes the timeline: the e2e wall is
+  observed into ``scheduler_pod_e2e_seconds{lane}`` and the record
+  moves to a bounded completed ring the bench legs read p50/p99 from.
+
+A pod deleted or evicted while pending is ``forget``-ten without
+observing — an abandoned submit is not a latency sample.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from koordinator_tpu.apis.extension import QoSClass
+
+#: lane names, mirroring service/admission.LANE_NAMES (not imported:
+#: the admission module pulls in jax; the timeline layer stays stdlib)
+LANES = ("system", "ls", "be")
+
+
+def lane_of(pod) -> str:
+    """QoS lane label for a pod (system > latency-sensitive > BE) —
+    the same mapping as service/admission.lane_for_qos."""
+    qos = getattr(pod, "qos", None)
+    if qos == QoSClass.SYSTEM:
+        return "system"
+    if qos == QoSClass.BE:
+        return "be"
+    return "ls"
+
+
+class PodTimelines:
+    """Bounded per-pod stage-stamp registry + completed-latency ring.
+
+    ``histogram`` defaults to the global ``scheduler_pod_e2e_seconds``;
+    tests inject their own (and a fake ``clock``) to check the observed
+    buckets exactly. Every mutable attribute below is mapped to
+    ``_lock`` in graftcheck's lock-discipline registry."""
+
+    STAGES = ("submit", "staged", "solved", "published")
+
+    def __init__(self, capacity: int = 8192,
+                 completed_capacity: int = 4096,
+                 clock=time.perf_counter, histogram=None):
+        if histogram is None:
+            from koordinator_tpu.metrics.components import POD_E2E
+
+            histogram = POD_E2E
+        self._histogram = histogram
+        self._clock = clock
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        #: uid -> (lane, {stage: t}) — at capacity new submits are
+        #: refused (counted in ``_dropped``), the waiting tail is kept
+        self._active: Dict[str, tuple] = {}
+        #: (lane, e2e_s, {stage: t}) for published pods
+        self._completed: deque = deque(maxlen=completed_capacity)
+        #: submits refused at capacity (the backlog cost samples)
+        self._dropped = 0
+
+    # -- stamps --------------------------------------------------------------
+
+    def submit(self, uid: str, lane: str = "ls") -> None:
+        """Open a timeline (idempotent: informer refreshes of a pending
+        pod must not reset its submit stamp)."""
+        t = self._clock()
+        with self._lock:
+            if uid in self._active:
+                return
+            if len(self._active) >= self._capacity:
+                # refuse the NEW timeline, never evict the oldest: the
+                # longest-waiting pods are exactly the p99 tail the
+                # histogram exists to observe, so a backlog past
+                # capacity must cost the newest samples, not the tail
+                # (and never memory) — counted so the gap is visible
+                self._dropped += 1
+                return
+            self._active[uid] = (lane, {"submit": t})
+
+    def mark(self, uid: str, stage: str) -> None:
+        t = self._clock()
+        with self._lock:
+            entry = self._active.get(uid)
+            if entry is not None:
+                entry[1].setdefault(stage, t)
+
+    def mark_many(self, uids, stage: str) -> None:
+        t = self._clock()
+        with self._lock:
+            for uid in uids:
+                entry = self._active.get(uid)
+                if entry is not None:
+                    entry[1].setdefault(stage, t)
+
+    def published(self, uid: str) -> Optional[float]:
+        """Close a timeline: observe submit→published into the lane
+        histogram, move the record to the completed ring. Returns the
+        e2e seconds (None for an unknown uid)."""
+        t = self._clock()
+        with self._lock:
+            entry = self._active.pop(uid, None)
+            if entry is None:
+                return None
+            lane, stamps = entry
+            stamps["published"] = t
+            e2e = t - stamps["submit"]
+            self._completed.append((lane, e2e, stamps))
+        self._histogram.observe(e2e, {"lane": lane})
+        return e2e
+
+    def forget(self, uid: str) -> None:
+        """Drop a timeline without observing (pod deleted/evicted while
+        pending — not a latency sample)."""
+        with self._lock:
+            self._active.pop(uid, None)
+
+    @contextmanager
+    def preserved(self, uid: str):
+        """Carry a timeline across a forget/submit round-trip. The
+        scheduler's accounted-field refresh of a PENDING pod re-runs
+        remove_pod + add_pod for the quota/gang side effects, but the
+        pod never left the queue — its original stamps (the submit
+        above all) must survive, or the e2e histogram reports only the
+        post-refresh tail of the wait. The refreshed pod's lane wins
+        (a QoS change relabels the sample); original stamps win over
+        the round-trip's fresh ones."""
+        with self._lock:
+            entry = self._active.get(uid)
+            kept = (entry[0], dict(entry[1])) if entry is not None else None
+        try:
+            yield
+        finally:
+            if kept is not None:
+                with self._lock:
+                    cur = self._active.get(uid)
+                    if cur is not None:
+                        stamps = dict(cur[1])
+                        stamps.update(kept[1])
+                        self._active[uid] = (cur[0], stamps)
+                    else:
+                        # the re-add was refused at capacity (or never
+                        # happened): the pre-existing sample keeps its
+                        # slot rather than being silently dropped
+                        self._active[uid] = kept
+
+    # -- read side -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        """p50/p99 submit→published over the completed ring, overall
+        and per lane — what bench legs 10/13 record."""
+        with self._lock:
+            samples = [(lane, e2e) for lane, e2e, _ in self._completed]
+
+        def pct(xs: List[float]) -> dict:
+            if not xs:
+                return {"count": 0, "p50_s": None, "p99_s": None}
+            xs = sorted(xs)
+            hi = min(len(xs) - 1, -(-99 * (len(xs) - 1) // 100))
+            return {
+                "count": len(xs),
+                "p50_s": xs[len(xs) // 2],
+                "p99_s": xs[hi],
+            }
+
+        out = {"all": pct([e for _, e in samples])}
+        for lane in LANES:
+            lane_samples = [e for l, e in samples if l == lane]
+            if lane_samples:
+                out[lane] = pct(lane_samples)
+        return out
+
+    def status(self) -> dict:
+        """Debug-mux payload: in-flight depth + latency percentiles."""
+        with self._lock:
+            inflight = len(self._active)
+            completed = len(self._completed)
+            dropped = self._dropped
+        return {
+            "inflight": inflight,
+            "completed": completed,
+            "dropped": dropped,
+            "latency": self.stats(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._completed.clear()
+            self._dropped = 0

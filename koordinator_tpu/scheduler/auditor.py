@@ -151,13 +151,20 @@ class StateAuditor:
             if self._promotion_pending:
                 self._promotion_pending = False
                 self._rounds_since = 0
-                return self.sweep("promotion", now=now)
-            self._rounds_since += 1
-            if self.interval_rounds and \
-                    self._rounds_since >= self.interval_rounds:
-                self._rounds_since = 0
-                return self.sweep("periodic", now=now)
-            return None
+                kind = "promotion"
+            else:
+                self._rounds_since += 1
+                if self.interval_rounds and \
+                        self._rounds_since >= self.interval_rounds:
+                    self._rounds_since = 0
+                    kind = "periodic"
+                else:
+                    return None
+        # outside the lock (sweep re-acquires it for its own body): a
+        # detection's flight dump does file I/O, and holding the RLock
+        # across it would block status() readers and the pipelined
+        # loop's sweep_due() quiesce check behind the disk
+        return self.sweep(kind, now=now)
 
     # -- the sweep -----------------------------------------------------------
 
@@ -281,7 +288,23 @@ class StateAuditor:
             report["duration_s"] = time.perf_counter() - t0
             AUDIT_SWEEP_DURATION.observe(report["duration_s"])
             self.last_report = report
-            return report
+        if total:
+            # anomaly: drift was detected — dump the flight recorder's
+            # recent rounds before the repaired state overwrites the
+            # evidence (outside the lock: the dump does file I/O)
+            from koordinator_tpu.obs.flight import FLIGHT
+            from koordinator_tpu.obs.trace import TRACER
+
+            TRACER.instant("auditor-detection", cat="audit",
+                           args={"detections": total, "kind": kind})
+            FLIGHT.trigger(
+                "auditor-detection",
+                detail=f"{total} detection(s) in {kind} sweep",
+                extra={"detections": report["detections"],
+                       "repairs": report["repairs"],
+                       "unrepaired": report["unrepaired"]},
+            )
+        return report
 
     def status(self) -> dict:
         """Debug-mux payload (registered as ``state-auditor`` beside

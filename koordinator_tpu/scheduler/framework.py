@@ -13,7 +13,6 @@ debug dumps); bulk scheduling goes through models/placement.py.
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from koordinator_tpu.apis.types import ClusterSnapshot, NodeSpec, PodSpec
@@ -134,10 +133,9 @@ class ScheduleOutcome:
 class SchedulingFramework:
     """Runs one pod through the full plugin chain (SURVEY.md §3.1)."""
 
-    def __init__(self, plugins: Sequence[Plugin], monitor=None, debug=None,
+    def __init__(self, plugins: Sequence[Plugin], debug=None,
                  cycle_seed=None):
         self.plugins = list(plugins)
-        self.monitor = monitor
         self.debug = debug
         #: entries copied into every fresh CycleState (per-scheduler
         #: configuration the shared lowering needs, e.g. the LoadAware
@@ -147,14 +145,10 @@ class SchedulingFramework:
     def schedule_one(
         self, snapshot: ClusterSnapshot, pod: PodSpec
     ) -> ScheduleOutcome:
-        started = time.monotonic()
-        if self.monitor is not None:
-            self.monitor.cycle_started(pod.uid, started)
-        try:
-            return self._schedule_one(snapshot, pod)
-        finally:
-            if self.monitor is not None:
-                self.monitor.cycle_finished(pod.uid, time.monotonic() - started)
+        # stuck-cycle detection moved to the span-fed watchdog
+        # (scheduler/monitor.py reads the trace fabric's open marks);
+        # the per-pod host recording the seed kept here is gone
+        return self._schedule_one(snapshot, pod)
 
     def _run_post_filter(self, state, snapshot, pod) -> Optional[ScheduleOutcome]:
         """PostFilter: side effects (gang rejection fan-out) run for every

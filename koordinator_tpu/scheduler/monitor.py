@@ -1,58 +1,118 @@
 """Scheduler monitor + debug services.
 
-- SchedulerMonitor: flags slow/stuck scheduling cycles (reference:
-  pkg/scheduler/frameworkext/scheduler_monitor.go:44-103).
+- SchedulerMonitor: a span-fed stuck-cycle watchdog (reference:
+  pkg/scheduler/frameworkext/scheduler_monitor.go:44-103). The seed
+  version kept its own per-pod start-time dict fed by host-side
+  ``cycle_started``/``cycle_finished`` calls — a recording path the
+  batched device solve never exercised (only the incremental fallback
+  fed it). That path is deleted: the watchdog now reads the trace
+  fabric's open marks (``round:<id>``/``publish:<id>``, opened by
+  ``begin_tick`` and the tick publisher — obs/trace.py), so "stuck"
+  means the thing that actually matters — a round that never retired
+  or a publish wedged on a half-open connection — and every detection
+  counts into ``scheduler_stuck_cycles_total{kind}``.
 - DebugRecorder: runtime-togglable score/filter dumps (reference:
-  pkg/scheduler/frameworkext/debug.go and the /debug/flags HTTP toggles).
+  pkg/scheduler/frameworkext/debug.go and the /debug/flags HTTP
+  toggles), extended with a bounded ring of placement-explain payloads
+  (obs/explain.py answers through it).
 - DebugServices: per-plugin debug endpoints as plain dict payloads
-  (reference: frameworkext/services/services.go — there gin HTTP, here an
-  in-process registry any HTTP layer can front).
+  (reference: frameworkext/services/services.go — there gin HTTP, here
+  an in-process registry any HTTP layer can front).
 """
 
 from __future__ import annotations
 
-import threading
-import time
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 
 class SchedulerMonitor:
-    def __init__(self, timeout_seconds: float = 10.0, log=print):
+    """Watchdog over the tracer's open round/publish marks.
+
+    ``check_stuck`` is cheap (one dict snapshot) and side-effect-safe
+    to call from anywhere: the scheduling loop calls it at round start,
+    and the debug mux's ``monitor`` service calls it on GET — which is
+    the path that still works when the loop itself is wedged behind a
+    stuck publish. Each stuck mark is counted ONCE
+    (``scheduler_stuck_cycles_total{kind}``) no matter how many
+    monitors watch the tracer — the counted-stuck flag lives with the
+    mark itself (``SpanTracer.flag_stuck``), so a leader + standby in
+    one process, or a mux status() reader racing the loop's check,
+    never double-count — and the flag clears when the mark closes."""
+
+    def __init__(self, tracer=None, timeout_seconds: float = 10.0,
+                 log=print):
+        if tracer is None:
+            from koordinator_tpu.obs.trace import TRACER
+
+            tracer = TRACER
+        self.tracer = tracer
         self.timeout = timeout_seconds
         self.log = log
-        self._lock = threading.Lock()
-        self._active: Dict[str, float] = {}
-        self.slow_cycles: List[Dict] = []
 
-    def cycle_started(self, pod_uid: str, at: Optional[float] = None) -> None:
-        with self._lock:
-            self._active[pod_uid] = at if at is not None else time.monotonic()
+    def check_stuck(self, now: Optional[float] = None) -> List[str]:
+        """Open marks older than the timeout right now. Newly-stuck
+        marks are logged and counted; a mark is never double-counted."""
+        stuck, _ = self._check(now)
+        return stuck
 
-    def cycle_finished(self, pod_uid: str, duration: float) -> None:
-        with self._lock:
-            self._active.pop(pod_uid, None)
-            if duration > self.timeout:
-                record = {"pod": pod_uid, "duration_s": duration}
-                self.slow_cycles.append(record)
-                self.log(f"scheduler monitor: slow cycle {record}")
+    def _check(self, now: Optional[float] = None):
+        """One pass over one open-marks snapshot: returns (stuck keys,
+        the snapshot) so status() reports ages consistent with the
+        verdict instead of re-snapshotting the tracer."""
+        from koordinator_tpu.metrics.components import STUCK_CYCLES
 
-    def check_stuck(self) -> List[str]:
-        """Pods whose cycle has been running past the timeout right now."""
-        now = time.monotonic()
-        with self._lock:
-            return [
-                uid for uid, t0 in self._active.items() if now - t0 > self.timeout
-            ]
+        if now is None:
+            now = self.tracer.now()
+        newly: List[tuple] = []
+        open_marks = self.tracer.open_marks()
+        stuck: List[str] = []
+        for key, (t0, track, _rid) in open_marks.items():
+            age = now - t0
+            if age <= self.timeout:
+                continue
+            stuck.append(key)
+            # flag_stuck is the tracer-level test-and-set: True only
+            # for the first flagging of a still-open mark, across ALL
+            # monitors sharing the tracer (a mark that closed since
+            # our snapshot is never flagged)
+            if self.tracer.flag_stuck(key):
+                newly.append((key, age, track))
+        for key, age, track in newly:
+            kind = key.split(":", 1)[0]
+            STUCK_CYCLES.inc({"kind": kind})
+            self.log(
+                f"scheduler monitor: {kind} stuck for {age:.1f}s "
+                f"(> {self.timeout}s): {key} on {track}"
+            )
+        return stuck, (now, open_marks)
+
+    def status(self) -> Dict[str, object]:
+        """Debug-mux payload — running the check on read is the point:
+        the mux thread observes a wedge the blocked loop cannot."""
+        stuck, (now, open_marks) = self._check()
+        return {
+            "timeout_s": self.timeout,
+            "stuck": stuck,
+            "open_marks": {
+                k: {"age_s": now - t0, "track": track, "round": rid}
+                for k, (t0, track, rid) in open_marks.items()
+            },
+        }
 
 
 class DebugRecorder:
-    """Score/filter dump collection, toggled at runtime."""
+    """Score/filter/explain dump collection, toggled at runtime."""
+
+    #: bounded explain history (every /explain answer lands here)
+    MAX_EXPLAINS = 64
 
     def __init__(self) -> None:
         self.dump_scores = False
         self.dump_filters = False
         self.scores: List[Dict] = []
         self.filters: List[Dict] = []
+        self.explains: deque = deque(maxlen=self.MAX_EXPLAINS)
 
     def record_scores(self, pod_uid: str, scores: Dict[str, int]) -> None:
         if self.dump_scores:
@@ -68,6 +128,12 @@ class DebugRecorder:
                     "reason": status.reason,
                 }
             )
+
+    def record_explain(self, payload: Dict) -> None:
+        """Explain answers are always kept (bounded): by the time an
+        operator asks "why", a toggle-first flow would have lost the
+        interesting one."""
+        self.explains.append(payload)
 
 
 class DebugServices:

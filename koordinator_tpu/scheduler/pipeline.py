@@ -48,6 +48,8 @@ from koordinator_tpu.metrics.components import (
     ROUND_CRITICAL_PATH,
     TICK_STAGE_DURATION,
 )
+from koordinator_tpu.obs.flight import FLIGHT
+from koordinator_tpu.obs.trace import TRACER
 
 #: publisher-queue shutdown sentinel
 _STOP = object()
@@ -111,6 +113,7 @@ class TickPipeline:
         drain in the background."""
         t0 = time.perf_counter()
         self._surface(wait=True)
+        TRACER.emit("retire_wait", cat="pipeline", t0=t0)
         with self._lock:
             if self._stopped:
                 raise RuntimeError("tick pipeline is stopped")
@@ -231,6 +234,27 @@ class TickPipeline:
                 elif isinstance(e, (SolverUnavailable, SolverOverloaded)):
                     kind = "solver"
                 PIPELINE_DEFERRED_ERRORS.inc({"kind": kind})
+                TRACER.instant("pipeline-deferred-error", cat="pipeline",
+                               args={"kind": kind})
+                # the round that FAILED never reached _retire's
+                # record_round — put it in the ring (error-flagged,
+                # whatever stage timings it got to) so the dump this
+                # very failure triggers contains the anomalous round,
+                # not just the rounds leading up to it
+                inflight = getattr(tick, "inflight", None)
+                FLIGHT.record_round({
+                    "round": getattr(tick, "round_id", None),
+                    "at": getattr(tick, "at", None),
+                    "error": f"{type(e).__name__}: {e}",
+                    **(dict(inflight.timings)
+                       if inflight is not None else {}),
+                })
+                # anomaly: the flight recorder preserves the rounds that
+                # led up to the deferred publish-side failure
+                FLIGHT.trigger(
+                    "pipeline-deferred-error",
+                    detail=f"{type(e).__name__}: {e}",
+                )
                 with self._lock:
                     self._pending_error = e
             finally:
@@ -274,10 +298,20 @@ class TickPipeline:
         result = self.scheduler.commit_tick(tick)
         if self._abandoned("epilogue"):
             return
+        rid = getattr(tick, "round_id", 0)
+        # watchdog mark: a publish wedged on a half-open connection is
+        # exactly what the span-fed monitor exists to flag
+        TRACER.mark_open(f"publish:{rid}", round_id=rid)
         t_pub = time.perf_counter()
-        if self._publish is not None:
-            self._publish(result)
-        publish_s = time.perf_counter() - t_pub
+        try:
+            if self._publish is not None:
+                self._publish(result)
+        finally:
+            # a FAILED publish (fenced, solver died) is not a STUCK
+            # publish: its error defers to the round boundary, so the
+            # mark must close or the watchdog flags a ghost forever
+            publish_s = time.perf_counter() - t_pub
+            TRACER.mark_closed(f"publish:{rid}")
         if self._abandoned("publish"):
             return
         timings = (
@@ -296,6 +330,21 @@ class TickPipeline:
                 "placed": placed, "total": len(result),
                 "waiting": len(result.waiting), **timings,
             }
+        model = getattr(self.scheduler, "model", None)
+        backend = getattr(model, "backend", None)
+        FLIGHT.record_round({
+            "round": rid,
+            "at": tick.at,
+            "placed": placed,
+            "total": len(result),
+            "waiting": len(result.waiting),
+            "staged_epoch": getattr(
+                getattr(model, "staged_cache", None), "epoch", None
+            ),
+            "solver": getattr(model, "last_solver", None),
+            "degraded": getattr(backend, "degraded", None),
+            **timings,
+        })
         if self._on_result is not None:
             self._on_result(result)
         self._log(f"round: {placed}/{len(result)} placed, "

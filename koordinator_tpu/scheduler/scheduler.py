@@ -39,6 +39,8 @@ from koordinator_tpu.scheduler.framework import (
 from koordinator_tpu.scheduler.reservation_controller import (
     ReservationController,
 )
+from koordinator_tpu.obs.timeline import PodTimelines, lane_of
+from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.scheduler.monitor import (
     DebugRecorder,
     DebugServices,
@@ -64,15 +66,20 @@ class PendingTick:
     (``result`` set — the BatchedPlacement=false fallback has no device
     half to overlap). ``commit_tick`` consumes it exactly once."""
 
-    __slots__ = ("at", "pending", "inflight", "solve_started", "result")
+    __slots__ = ("at", "pending", "inflight", "solve_started", "result",
+                 "round_id")
 
     def __init__(self, at, pending=None, inflight=None,
-                 solve_started=None, result=None):
+                 solve_started=None, result=None, round_id=0):
         self.at = at
         self.pending = pending or {}
         self.inflight = inflight
         self.solve_started = solve_started
         self.result = result
+        #: trace-fabric round number: spans the publisher emits while
+        #: retiring this tick carry the SAME id as the coordinator's
+        #: staging spans, so cross-thread work joins one trace round
+        self.round_id = round_id
 
 
 class Scheduler:
@@ -96,9 +103,17 @@ class Scheduler:
         self.gang_manager = GangManager()
         self.numa_manager = ResourceManager()
         self.device_cache = NodeDeviceCache()
-        self.monitor = SchedulerMonitor()
+        self.monitor = SchedulerMonitor(tracer=TRACER)
         self.debug = DebugRecorder()
         self.services = DebugServices()
+        #: per-pod submit→staged→solved→published timelines feeding the
+        #: scheduler_pod_e2e_seconds{lane} histograms (obs/timeline.py)
+        self.timelines = PodTimelines()
+        #: round id of the last commit_tick — THIS scheduler's round,
+        #: unlike the process-global TRACER counter two wired
+        #: schedulers share (the serial publish watchdog mark keys off
+        #: it)
+        self.last_round_id: Optional[int] = None
         #: pods placed at the Permit barrier: uid -> held node. They hold
         #: resources (assumed) but are not bound until their gang group
         #: completes.
@@ -191,7 +206,6 @@ class Scheduler:
                 ),
                 DefaultPreBind(),
             ],
-            monitor=self.monitor,
             debug=self.debug,
             cycle_seed={
                 LOWERING_KEY: model.lowering_kwargs(),
@@ -201,6 +215,8 @@ class Scheduler:
                 ),
             },
         )
+        self.services.register("pod-timelines", self.timelines.status)
+        self.services.register("monitor", self.monitor.status)
         self.services.register(
             "Coscheduling",
             lambda: {
@@ -306,8 +322,14 @@ class Scheduler:
         )
         assigned = old.node_name is not None
         if accounted_changed and not assigned:
-            self.remove_pod(old)
-            self.add_pod(pod)
+            # the remove/add round-trip re-runs the quota/gang side
+            # effects, but the pod never left the pending queue: its
+            # timeline (the submit stamp above all) must survive, or
+            # a mid-wait field refresh hides the queue-wait tail from
+            # scheduler_pod_e2e_seconds
+            with self.timelines.preserved(pod.uid):
+                self.remove_pod(old)
+                self.add_pod(pod)
             return
         # object refresh preserving placement state
         pod.node_name = old.node_name
@@ -360,6 +382,10 @@ class Scheduler:
             pod.node_name is not None
             and not getattr(pod, "waiting_permit", False)
         )
+        if not bound:
+            # the pod entered the pending queue: open its timeline
+            # (submit == enqueue on the in-process bus)
+            self.timelines.submit(pod.uid, lane_of(pod))
         if pod.gang:
             self.gang_manager.on_pod_add(pod.uid, pod.gang)
             if bound:
@@ -377,6 +403,11 @@ class Scheduler:
         pending -> assigned and mirror the accounting the deciding
         scheduler applied locally (quota used, gang bound)."""
         self.cache.promote_assigned(pod)
+        # a bind this scheduler did not make is not its latency sample:
+        # drop the timeline unobserved (a standby would otherwise leak
+        # one open timeline per leader-bound pod until the ring evicts
+        # genuine pending pods' stamps)
+        self.timelines.forget(pod.uid)
         self._account_quota(pod)
         if pod.gang:
             self.gang_manager.on_pod_bound(pod.uid)
@@ -420,6 +451,8 @@ class Scheduler:
             self._account_quota(cached, release=True)
         self._waiting.pop(pod.uid, None)
         self._waiting_since.pop(pod.uid, None)
+        # a deleted/evicted pod's open timeline is not a latency sample
+        self.timelines.forget(pod.uid)
 
     # -- scheduling ---------------------------------------------------------
 
@@ -445,25 +478,41 @@ class Scheduler:
         from koordinator_tpu.metrics.components import PENDING_PODS
 
         at0 = now if now is not None else time.time()
-        # the previous round's committed binds are published by now (or
-        # were forgotten on abort): their rollback window is over. The
-        # pipelined loop preserves this ordering — a tick begins only
-        # after the previous tick's publish retired.
-        self._resv_inflight = {}
-        self.expire_waiting(at0)
-        self.reservation_controller.sync(at0)
-        if not self.batched_placement:
-            return PendingTick(
-                at=at0, result=self._schedule_pending_incremental(now)
-            )
-        snapshot = self.cache.snapshot(now=now)
-        pending = {pod.uid: pod for pod in snapshot.pending_pods}
-        PENDING_PODS.set(len(pending))
-        solve_started = time.monotonic()
-        inflight = self.model.schedule_async(snapshot)
+        rid = TRACER.begin_round()
+        # watchdog mark: stays open until commit_tick retires the round
+        # (scheduler/monitor.py flags it if it never does)
+        TRACER.mark_open(f"round:{rid}", round_id=rid)
+        t_begin = TRACER.now()
+        try:
+            # the previous round's committed binds are published by now
+            # (or were forgotten on abort): their rollback window is
+            # over. The pipelined loop preserves this ordering — a tick
+            # begins only after the previous tick's publish retired.
+            self._resv_inflight = {}
+            self.expire_waiting(at0)
+            self.reservation_controller.sync(at0)
+            if not self.batched_placement:
+                return PendingTick(
+                    at=at0, result=self._schedule_pending_incremental(now),
+                    round_id=rid,
+                )
+            snapshot = self.cache.snapshot(now=now)
+            pending = {pod.uid: pod for pod in snapshot.pending_pods}
+            PENDING_PODS.set(len(pending))
+            self.timelines.mark_many(pending, "staged")
+            solve_started = time.monotonic()
+            inflight = self.model.schedule_async(snapshot)
+        except BaseException:
+            # a FAILED round (the dispatch is where a sidecar outage
+            # surfaces) is handled by run_loop's skip path — close the
+            # mark or the watchdog flags the skipped round forever
+            TRACER.mark_closed(f"round:{rid}")
+            raise
+        TRACER.emit("begin_tick", cat="tick", t0=t_begin,
+                    round_id=rid, args={"pending": len(pending)})
         return PendingTick(
             at=at0, pending=pending, inflight=inflight,
-            solve_started=solve_started,
+            solve_started=solve_started, round_id=rid,
         )
 
     def commit_tick(self, tick: "PendingTick") -> ScheduleResult:
@@ -475,46 +524,84 @@ class Scheduler:
             SCHEDULING_ATTEMPTS,
         )
 
+        # the round this scheduler just committed — keyed off the tick,
+        # not the process-global round counter, so two wired schedulers
+        # in one process (leader + standby) never collide on watchdog
+        # mark keys (wiring's serial publish wrapper reads this)
+        self.last_round_id = tick.round_id
         if tick.result is not None:
+            TRACER.mark_closed(f"round:{tick.round_id}", name="round",
+                               cat="tick")
             return tick.result  # incremental fallback: epilogue ran inline
         at0 = tick.at
         pending = tick.pending
-        result = tick.inflight.finalize()
-        BATCH_SOLVE_DURATION.observe(time.monotonic() - tick.solve_started)
-        for uid, node in result.items():
-            SCHEDULING_ATTEMPTS.inc(
-                {"result": "scheduled" if node is not None else "unschedulable"}
-            )
-        at = at0
-        for uid, node in result.items():
-            if node is not None:
+        try:
+            result = tick.inflight.finalize()
+        except BaseException:
+            # solver died mid-solve: the round failed (and defers /
+            # skips via the callers' typed handlers) — it is not STUCK
+            TRACER.mark_closed(f"round:{tick.round_id}")
+            raise
+        try:
+            t_epilogue = TRACER.now()
+            BATCH_SOLVE_DURATION.observe(
+                time.monotonic() - tick.solve_started)
+            for uid, node in result.items():
+                SCHEDULING_ATTEMPTS.inc(
+                    {"result": "scheduled" if node is not None
+                     else "unschedulable"}
+                )
+            at = at0
+            for uid, node in result.items():
+                if node is not None:
+                    self.cache.assume_pod(uid, node, now=at)
+                    self.gang_manager.on_pod_bound(uid)
+                    # keep the host quota manager's used in sync with the
+                    # device solve (the solve derives used from the
+                    # snapshot; observers read the manager)
+                    self._account_quota(pending.get(uid))
+                    if uid in result.resv_committed:
+                        # committed consumption stays rollback-able until
+                        # the bind publishes (fencing-abort coverage)
+                        self._resv_inflight[uid] = result.resv_committed[uid]
+            for uid, node in result.waiting.items():
+                # waiting gang members hold their node (and their quota,
+                # as the incremental Reserve does) but are not bound —
+                # flagged so bus observers (node agents) don't treat them
+                # as running
                 self.cache.assume_pod(uid, node, now=at)
-                self.gang_manager.on_pod_bound(uid)
-                # keep the host quota manager's used in sync with the
-                # device solve (the solve derives used from the snapshot;
-                # observers read the manager)
+                held = self.cache.pods.get(uid)
+                if held is not None:
+                    held.waiting_permit = True
                 self._account_quota(pending.get(uid))
-                if uid in result.resv_committed:
-                    # committed consumption stays rollback-able until
-                    # the bind publishes (fencing-abort coverage)
-                    self._resv_inflight[uid] = result.resv_committed[uid]
-        for uid, node in result.waiting.items():
-            # waiting gang members hold their node (and their quota, as
-            # the incremental Reserve does) but are not bound — flagged
-            # so bus observers (node agents) don't treat them as running
-            self.cache.assume_pod(uid, node, now=at)
-            held = self.cache.pods.get(uid)
-            if held is not None:
-                held.waiting_permit = True
-            self._account_quota(pending.get(uid))
-            self._waiting[uid] = node
-            self._waiting_since.setdefault(uid, at)
-            self.gang_manager.on_pod_waiting(uid)
-            if uid in result.resv_allocs:
-                self._resv_waiting[uid] = result.resv_allocs[uid]
-        self._fine_waiting.update(result.fine_states)
-        self._resolve_waiting(result)
-        self._preempt_unplaced(result, pending, at)
+                self._waiting[uid] = node
+                self._waiting_since.setdefault(uid, at)
+                self.gang_manager.on_pod_waiting(uid)
+                if uid in result.resv_allocs:
+                    self._resv_waiting[uid] = result.resv_allocs[uid]
+            self._fine_waiting.update(result.fine_states)
+            self._resolve_waiting(result)
+            self._preempt_unplaced(result, pending, at)
+            self.timelines.mark_many(
+                [uid for uid, node in result.items() if node is not None],
+                "solved",
+            )
+        except BaseException:
+            # a FAILED epilogue (a fenced preemption eviction raising
+            # FencingError mid-takeover) is handled by run_loop's
+            # skip/forget path — close the mark or the watchdog flags
+            # the already-retired round as a ghost forever
+            TRACER.mark_closed(f"round:{tick.round_id}")
+            raise
+        TRACER.emit("epilogue", cat="tick", t0=t_epilogue,
+                    round_id=tick.round_id)
+        TRACER.mark_closed(
+            f"round:{tick.round_id}", name="round", cat="tick",
+            args={
+                "placed": sum(1 for v in result.values() if v is not None),
+                "total": len(result),
+            },
+        )
         return result
 
     def _schedule_pending_incremental(self, now: Optional[float]) -> ScheduleResult:

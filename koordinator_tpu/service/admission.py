@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.metrics.components import (
     SOLVER_ADMISSION_BATCHES,
     SOLVER_ADMISSION_REQUESTS,
@@ -325,6 +326,7 @@ class AdmissionEntry:
     __slots__ = (
         "request", "config", "node_cache", "lane", "deadline",
         "enqueued_at", "key", "pods_n", "response", "_done", "_gate",
+        "trace_t0",
     )
 
     def __init__(self, request, config, node_cache, lane, deadline,
@@ -340,6 +342,10 @@ class AdmissionEntry:
         self.response: Optional[SolveResponse] = None
         self._done = threading.Event()
         self._gate = gate
+        #: tracer-clock enqueue stamp (the gate's own ``clock`` may be
+        #: a test fake; spans need the tracer base): queue-wait spans
+        #: are emitted retroactively from this at dispatch
+        self.trace_t0 = TRACER.now()
 
     def wait(self, timeout: Optional[float] = None) -> Optional[SolveResponse]:
         """Block until the gate answers (None only on timeout)."""
@@ -572,10 +578,23 @@ class AdmissionGate:
                 )
 
     def _dispatch(self, batch: List[AdmissionEntry]) -> None:
+        # function-level import like _decode_config's: server imports
+        # this module at top level, so the reverse edge stays lazy
+        from koordinator_tpu.service.server import _trace_args
+
         t0 = self._clock()
+        t_dispatch = TRACER.now()
         for e in batch:
             SOLVER_ADMISSION_WAIT.observe(
                 max(0.0, t0 - e.enqueued_at), {"lane": LANE_NAMES[e.lane]}
+            )
+            # retro queue-wait span per request, joined to the caller's
+            # trace via the wire context (codec v3 ``trace`` group)
+            TRACER.emit(
+                "queue_wait", cat="admission", t0=e.trace_t0,
+                t1=t_dispatch,
+                args={"lane": LANE_NAMES[e.lane],
+                      **(_trace_args(e.request) or {})},
             )
         try:
             if len(batch) == 1:
@@ -594,6 +613,11 @@ class AdmissionGate:
                 )
             ] * len(batch)
         SOLVER_SOLVE_DURATION.observe(max(0.0, self._clock() - t0))
+        TRACER.emit(
+            "admission_dispatch", cat="admission", t0=t_dispatch,
+            args={"coalesced": len(batch),
+                  **(_trace_args(batch[0].request) or {})},
+        )
         SOLVER_ADMISSION_BATCHES.inc()
         SOLVER_ADMISSION_REQUESTS.inc(
             {"mode": "coalesced" if len(batch) > 1 else "solo"},

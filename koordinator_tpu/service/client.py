@@ -45,6 +45,8 @@ class SolverShuttingDown(ConnectionError):
     response): reconnect-and-retry territory, like a restart."""
 
 
+from koordinator_tpu.obs.flight import FLIGHT
+from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.service.codec import (
     CodecError,
     SolveRequest,
@@ -282,6 +284,17 @@ class RemoteSolver:
             },
         )
 
+        # trace context rides the wire (codec v3): the sidecar tags its
+        # queue/solve spans with this (round, span) pair so both halves
+        # of the round trip land in ONE Perfetto trace
+        span_id = TRACER.next_span_id() if TRACER.enabled else None
+        trace_group = None
+        if span_id is not None:
+            trace_group = {
+                "round": np.asarray(TRACER.round_id, np.int64),
+                "span": np.asarray(span_id, np.int64),
+            }
+
         def build_request(remaining: Optional[float]):
             admission = None
             if remaining is not None or self.lane is not None:
@@ -310,7 +323,7 @@ class RemoteSolver:
                 self.last_request = "delta"
                 return SolveRequest(
                     node={}, node_delta=node_delta, admission=admission,
-                    **common
+                    trace=trace_group, **common
                 )
             node_delta = None
             if staging is not None:
@@ -318,7 +331,7 @@ class RemoteSolver:
             self.last_request = "establish" if node_delta else "full"
             return SolveRequest(
                 node=_group(state), node_delta=node_delta,
-                admission=admission, **common
+                admission=admission, trace=trace_group, **common
             )
 
         # transient failures (reconnects, typed overloaded sheds) retry
@@ -329,6 +342,7 @@ class RemoteSolver:
         # design, because an un-deadlined first solve may legitimately
         # sit behind a multi-second cold-start compile
         start = time.monotonic()
+        t_wire = TRACER.now()
         budget = (self.deadline_s if self.deadline_s is not None
                   else self.retry_total_s)
         last_error: Optional[Exception] = None
@@ -339,6 +353,12 @@ class RemoteSolver:
             if self.deadline_s is not None:
                 remaining = self.deadline_s - (time.monotonic() - start)
                 if remaining <= 0:
+                    FLIGHT.trigger(
+                        "deadline-exceeded",
+                        detail=f"client budget {self.deadline_s}s spent "
+                               f"(last: "
+                               f"{type(last_error).__name__ if last_error else None})",
+                    )
                     raise SolverDeadlineExceeded(
                         f"deadline-exceeded: {self.deadline_s}s budget "
                         f"spent client-side (last: "
@@ -349,8 +369,9 @@ class RemoteSolver:
                     build_request(remaining)
                 )
                 break
-            except SolverDeadlineExceeded:
+            except SolverDeadlineExceeded as e:
                 # the budget is gone by definition: retrying is pointless
+                FLIGHT.trigger("deadline-exceeded", detail=str(e))
                 raise
             except SolverOverloaded as e:
                 # clean typed error frame — stream in sync, connection
@@ -395,6 +416,10 @@ class RemoteSolver:
                     f"{type(last_error).__name__}: {last_error}"
                 )
             time.sleep(delay)
+        TRACER.emit("wire_solve", cat="wire", t0=t_wire, args={
+            "span": span_id, "request": self.last_request,
+            "retries": attempt,
+        })
         if staging is not None:
             self._server_epoch = int(staging[0])
         new_state = state

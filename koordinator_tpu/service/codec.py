@@ -29,8 +29,11 @@ import numpy as np
 _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 30
 #: wire protocol revision: 2 added the optional ``admission`` group
-#: (deadline + QoS lane) and unknown-prefix-tolerant request decoding.
-PROTOCOL_VERSION = 2
+#: (deadline + QoS lane) and unknown-prefix-tolerant request decoding;
+#: 3 added the optional ``trace`` group (round + span id) so
+#: sidecar-side spans join the scheduler's trace — tolerated as an
+#: unknown prefix by v2 servers exactly like ``admission`` was by v1.
+PROTOCOL_VERSION = 3
 
 
 class CodecError(ValueError):
@@ -91,6 +94,13 @@ class SolveRequest:
     #: groups degrade the same way (a v2 client against a v1 server
     #: gets that server's typed "decode failed" error, not a hang).
     admission: Optional[Dict[str, np.ndarray]] = None
+    #: trace context (wire v3): ``round`` (int64, the scheduler's trace
+    #: round number) and ``span`` (int64, a scheduler-unique span id).
+    #: The sidecar tags its queue-wait/solve spans with the pair so one
+    #: Perfetto load shows the scheduler round AND its sidecar half as
+    #: one trace (obs/trace.py). Absent means an untraced (or older)
+    #: client; like ``admission``, unknown to old servers and skipped.
+    trace: Optional[Dict[str, np.ndarray]] = None
 
 
 @dataclasses.dataclass
@@ -161,6 +171,7 @@ _REQ_GROUPS = (
     ("node", "n."), ("pods", "p."), ("params", "s."), ("quota", "q."),
     ("gang", "g."), ("extras", "x."), ("resv", "r."), ("numa", "u."),
     ("config", "c."), ("node_delta", "d."), ("admission", "a."),
+    ("trace", "t."),
 )
 
 _RESP_OPTIONAL = (

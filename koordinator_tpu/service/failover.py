@@ -46,6 +46,8 @@ from koordinator_tpu.metrics.components import (
     SOLVER_FAILOVERS,
     SOLVER_LOCAL_SOLVES,
 )
+from koordinator_tpu.obs.flight import FLIGHT
+from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.ops.binpack import solve_batch
 from koordinator_tpu.service.client import (
     SolverDeadlineExceeded,
@@ -168,6 +170,12 @@ class FailoverSolver:
             if flipped:
                 SOLVER_FAILOVERS.inc({"direction": "to-degraded"})
                 SOLVER_DEGRADED.set(1)
+                TRACER.instant("failover-flip", cat="failover",
+                               args={"direction": "to-degraded"})
+                FLIGHT.trigger(
+                    "failover-flip",
+                    detail=f"to-degraded: {type(e).__name__}: {e}",
+                )
                 if self.on_flip_degraded is not None:
                     self.on_flip_degraded()
             return self._local(
@@ -230,6 +238,9 @@ class FailoverSolver:
                 self.on_flip_back()
             SOLVER_FAILOVERS.inc({"direction": "to-remote"})
             SOLVER_DEGRADED.set(0)
+            TRACER.instant("failover-flip", cat="failover",
+                           args={"direction": "to-remote"})
+            FLIGHT.trigger("failover-flip", detail="to-remote: recovered")
         return recovered
 
     # -- plumbing ------------------------------------------------------------

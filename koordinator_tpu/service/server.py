@@ -45,6 +45,7 @@ from koordinator_tpu.ops.binpack import (
     scatter_node_rows_donated,
     solve_batch,
 )
+from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.ops.gang import GangState
 from koordinator_tpu.ops.quota import QuotaState
 from koordinator_tpu.service.admission import AdmissionConfig, AdmissionGate
@@ -143,9 +144,12 @@ class KernelBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            was_tripped = self.tripped_at is not None
             self.consecutive = 0
             self.tripped_at = None
             self.last_probe_at = None
+        if was_tripped:
+            TRACER.instant("kernel-breaker-close", cat="breaker")
 
     def refund_probe(self) -> None:
         """A consumed half-open probe never actually tested kernel
@@ -158,19 +162,25 @@ class KernelBreaker:
     def record_failure(self, exc: BaseException) -> bool:
         """Count a kernel-health failure; returns True when this one
         tripped (or re-armed) the breaker."""
+        err = f"{type(exc).__name__}: {exc}"
         with self._lock:
             self.consecutive += 1
             self.total_failures += 1
-            self.last_error = f"{type(exc).__name__}: {exc}"
+            self.last_error = err
             if self.tripped_at is not None:
                 # a failed half-open probe re-arms the cooldown
                 self.last_probe_at = self._clock()
-                return True
-            if self.consecutive >= self.threshold:
+                tripped = True
+            elif self.consecutive >= self.threshold:
                 self.tripped_at = self._clock()
                 self.total_trips += 1
-                return True
-            return False
+                tripped = True
+            else:
+                tripped = False
+        if tripped:
+            TRACER.instant("kernel-breaker-open", cat="breaker",
+                           args={"error": err})
+        return tripped
 
     def status(self) -> Dict[str, object]:
         with self._lock:
@@ -411,6 +421,22 @@ class NodeStateCache:
         return self.state
 
 
+def _trace_args(req: SolveRequest) -> Optional[Dict[str, int]]:
+    """The wire trace context as span args ({} when the client sent
+    none, None-safe against malformed scalars)."""
+    group = req.trace
+    if not group:
+        return None
+    out: Dict[str, int] = {}
+    for key in ("round", "span"):
+        if key in group:
+            try:
+                out[key] = int(np.asarray(group[key]).item())
+            except (TypeError, ValueError):
+                pass
+    return out or None
+
+
 def solve_from_request(req: SolveRequest,
                        config: SolverConfig = SolverConfig(),
                        node_cache: Optional[NodeStateCache] = None,
@@ -422,6 +448,7 @@ def solve_from_request(req: SolveRequest,
     plane's SolverConfig rides along. ``node_cache`` (per connection)
     serves the delta protocol: requests without a ``node`` group patch
     the cached staged state instead of re-shipping it."""
+    t_solve = TRACER.now()
     try:
         delta = req.node_delta
         node_host = req.node
@@ -497,6 +524,10 @@ def solve_from_request(req: SolveRequest,
             resv_score_safe,
             params_ok,
         )
+        # sidecar-side half of the round trip: tagged with the wire
+        # trace context so it joins the scheduler's trace in Perfetto
+        TRACER.emit("sidecar_solve", cat="sidecar", t0=t_solve,
+                    args=_trace_args(req))
         opt = lambda a: None if a is None else np.asarray(a)
         return SolveResponse(
             assignments=np.asarray(result.assign),

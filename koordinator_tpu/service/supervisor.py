@@ -47,6 +47,7 @@ from koordinator_tpu.metrics.components import (
     SUPERVISOR_RESTARTS,
     SUPERVISOR_UP,
 )
+from koordinator_tpu.obs.trace import TRACER
 
 
 def connection_probe(address, timeout_s: float = 1.0,
@@ -374,8 +375,14 @@ class SolverSupervisor:
         with self._lock:
             self.last_exit_code = exit_code
             if not self.breaker.allow():
+                was_open = self.state == "breaker-open"
                 self.state = "breaker-open"
                 SUPERVISOR_BREAKER_OPEN.set(1)
+                if not was_open:
+                    # transition only — a refused respawn repeats every
+                    # probe interval and must not spam the span ring
+                    TRACER.instant("supervisor-breaker-open",
+                                   cat="supervisor")
                 return "breaker-open"
             attempt = self._backoff_attempt
             self._backoff_attempt += 1
@@ -398,6 +405,8 @@ class SolverSupervisor:
             self._spawned_at = self._clock()
             self._ready_since_spawn = False
         SUPERVISOR_RESTARTS.inc({"reason": reason})
+        TRACER.instant("supervisor-restart", cat="supervisor",
+                       args={"reason": reason})
         # from live state, not the trip transition: a half-open respawn
         # leaves the breaker OPEN and the gauge must keep saying so
         SUPERVISOR_BREAKER_OPEN.set(

@@ -14,7 +14,15 @@ registries this framework already keeps:
 - ``GET /apis/v1/plugins``          -> registered debug service names
 - ``GET /apis/v1/plugins/<name>``   -> that service's JSON payload
 - ``PUT /debug/flags/s|f?value=1``  -> toggle score/filter dumps
-- ``GET /debug/dumps``              -> collected score/filter dumps
+- ``GET /debug/dumps``              -> collected score/filter/explain dumps
+- ``GET /debug/trace``              -> Chrome-trace-event JSON of the span
+                                       tracer's ring (load in Perfetto:
+                                       the pipelined stage/solve overlap
+                                       renders as crossing tracks)
+- ``GET /explain?pod=<uid>[&node=<name>]``
+                                    -> placement explanation for one pod
+                                       (obs/explain.py: per-node filter
+                                       verdicts + per-plugin score columns)
 - ``GET /audit?group=&subject=&operation=&since=&limit=``
                                     -> koordlet audit query
                                        (pkg/koordlet/audit HTTP endpoint)
@@ -34,11 +42,16 @@ class DebugHTTPServer:
     gatherer (anything with ``gather() -> str``) on one port."""
 
     def __init__(self, services=None, debug=None, metrics=None,
-                 auditor=None, host: str = "127.0.0.1", port: int = 0):
+                 auditor=None, tracer=None, explain=None,
+                 host: str = "127.0.0.1", port: int = 0):
         self.services = services
         self.debug = debug
         self.metrics = metrics
         self.auditor = auditor
+        #: a SpanTracer (obs/trace.py) served at /debug/trace
+        self.tracer = tracer
+        #: ``explain(pod_uid, node=None) -> dict`` served at /explain
+        self.explain = explain
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -113,7 +126,30 @@ class DebugHTTPServer:
                     return self._send(200, json.dumps({
                         "scores": outer.debug.scores,
                         "filters": outer.debug.filters,
+                        "explains": list(
+                            getattr(outer.debug, "explains", ())
+                        ),
                     }, default=str))
+                if path == "/debug/trace":
+                    if outer.tracer is None:
+                        return self._send(404, "no tracer", "text/plain")
+                    return self._send(
+                        200, json.dumps(outer.tracer.chrome_trace(),
+                                        default=str)
+                    )
+                if path == "/explain":
+                    if outer.explain is None:
+                        return self._send(404, "no explainer",
+                                          "text/plain")
+                    q = parse_qs(urlparse(self.path).query)
+                    uid = q.get("pod", [None])[0]
+                    if uid is None:
+                        return self._send(400, json.dumps(
+                            {"error": "missing ?pod=<uid>"}))
+                    payload = outer.explain(
+                        uid, node=q.get("node", [None])[0]
+                    )
+                    return self._send(200, json.dumps(payload, default=str))
                 return self._send(404, "not found", "text/plain")
 
             def do_PUT(self):

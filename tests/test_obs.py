@@ -1,0 +1,974 @@
+"""Scheduling trace fabric tests (ISSUE 7).
+
+- Chrome-trace export golden properties: valid JSON, spans nest, a
+  pipelined run shows stage(N+1)/prestage overlapping solve(N) across
+  tracks while a serial run stays strictly sequential.
+- Per-pod timeline histogram correctness under a fake clock, and the
+  wired end-to-end path (submit at intake, closed at publish).
+- Flight-recorder trigger matrix: one test per trigger, driving the
+  REAL code path that fires it (auditor sweep over sabotaged state,
+  failover flip, fencing abort through run_loop, deferred pipelined
+  publish error, client-side deadline exhaustion).
+- Explain oracle parity: per-node, per-feature-column scores and
+  filter verdicts bit-identical to the oracle's scalar decision
+  functions on a full-feature scenario, and the explain winner equal
+  to the incremental plugin chain's pick.
+- The span-fed stuck watchdog, the codec v3 trace group, and the
+  debug-mux endpoints.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import PriorityClass, QoSClass, ResourceName
+from koordinator_tpu.apis.types import (
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    QuotaSpec,
+    ReservationSpec,
+    ReservationState,
+)
+from koordinator_tpu.client.bus import APIServer, Kind
+from koordinator_tpu.client.wiring import wire_scheduler
+from koordinator_tpu.obs.flight import FLIGHT, _default_dump_dir
+from koordinator_tpu.obs.timeline import PodTimelines
+from koordinator_tpu.obs.trace import TRACER, SpanTracer
+from koordinator_tpu.scheduler import Scheduler
+from koordinator_tpu.scheduler.pipeline import TickPipeline
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace():
+    TRACER.clear()
+    TRACER.set_enabled(True)
+    yield
+    TRACER.clear()
+    TRACER.set_enabled(True)
+
+
+@pytest.fixture
+def flight_dir(tmp_path):
+    FLIGHT.reset()
+    FLIGHT.configure(dump_dir=str(tmp_path), min_interval_s=0.0)
+    yield tmp_path
+    FLIGHT.reset()
+    FLIGHT.configure(dump_dir=_default_dump_dir(), min_interval_s=1.0)
+
+
+def _wired(n_nodes=8, cpu=64000, mem=131072):
+    bus = APIServer()
+    sched = Scheduler()
+    wire_scheduler(bus, sched)
+    for i in range(n_nodes):
+        bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+            name=f"n{i}", allocatable={CPU: cpu, MEM: mem}))
+        bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+            node_name=f"n{i}", node_usage={CPU: 1000 * (i % 4)},
+            update_time=10.0))
+    return bus, sched
+
+
+def _arrive(bus, rng, t, n=12):
+    for j in range(n):
+        pod = PodSpec(name=f"t{t}p{j}",
+                      requests={CPU: int(rng.integers(200, 1200)),
+                                MEM: int(rng.integers(128, 1024))})
+        bus.apply(Kind.POD, pod.uid, pod)
+
+
+def _interval(ev):
+    return ev["t0"], ev["t0"] + (ev["dur"] or 0.0)
+
+
+def _overlaps(a, b):
+    a0, a1 = _interval(a)
+    b0, b1 = _interval(b)
+    return a0 < b1 and b0 < a1
+
+
+class _SlowFlight:
+    """Stretches a dispatched solve's publisher-side finalize so the
+    coordinator's overlap window is deterministic on any box."""
+
+    def __init__(self, inner, delay_s):
+        self.inner = inner
+        self.delay_s = delay_s
+
+    @property
+    def timings(self):
+        return self.inner.timings
+
+    def finalize(self):
+        time.sleep(self.delay_s)
+        return self.inner.finalize()
+
+
+# -- chrome export + overlap -------------------------------------------------
+
+def test_smoke_trace_export_pipelined_overlap_serial_sequential():
+    """The golden-property slice check.sh runs: the exported trace is
+    valid Chrome-trace JSON with nesting intact; a pipelined run shows
+    the overlap window crossing the publisher's solve span; a serial
+    run is strictly sequential across rounds."""
+    # pipelined half -------------------------------------------------------
+    bus, sched = _wired()
+    rng = np.random.default_rng(3)
+    orig_async = sched.model.schedule_async
+    sched.model.schedule_async = (
+        lambda snapshot: _SlowFlight(orig_async(snapshot), 0.05)
+    )
+    pipeline = TickPipeline(sched, log=lambda *a: None)
+    _arrive(bus, rng, 0)
+    for t in range(3):
+        pipeline.submit_round(now=20.0 + t)
+        # arrivals land mid-flight, then the overlap window warms them
+        _arrive(bus, rng, t + 1)
+        pipeline.prestage(now=20.0 + t)
+    pipeline.drain("test")
+    pipeline.stop()
+
+    exported = TRACER.chrome_trace()
+    blob = json.dumps(exported)
+    parsed = json.loads(blob)
+    events = parsed["traceEvents"]
+    assert any(e.get("ph") == "M" and e["name"] == "thread_name"
+               for e in events)
+    for e in events:
+        if e.get("ph") == "X":
+            assert e["dur"] >= 1 and "ts" in e and "round" in e["args"]
+
+    spans = TRACER.events()
+    by_name = lambda n: [e for e in spans if e["name"] == n]
+    # nesting: the read-back sits inside its device_solve span, and the
+    # lower/stage slices sit inside begin_tick
+    for rb in by_name("read_back"):
+        assert any(
+            ds["round"] == rb["round"]
+            and ds["t0"] <= rb["t0"]
+            and _interval(rb)[1] <= _interval(ds)[1] + 1e-6
+            for ds in by_name("device_solve")
+        )
+    for low in by_name("lower"):
+        assert any(
+            bt["round"] == low["round"]
+            and bt["t0"] <= low["t0"] + 1e-6
+            and _interval(low)[1] <= _interval(bt)[1] + 1e-6
+            for bt in by_name("begin_tick")
+        )
+    # the pipeline's signature: an overlap-window prestage crossing an
+    # in-flight solve on ANOTHER track
+    assert any(
+        _overlaps(ps, ds) and ps["track"] != ds["track"]
+        for ps in by_name("prestage")
+        for ds in by_name("device_solve")
+    ), "pipelined run must show prestage overlapping the device solve"
+
+    # serial half ----------------------------------------------------------
+    TRACER.clear()
+    bus2, sched2 = _wired()
+    rng2 = np.random.default_rng(3)
+    for t in range(3):
+        _arrive(bus2, rng2, t)
+        sched2.schedule_pending(now=20.0 + t)
+    serial = TRACER.events()
+    assert not [e for e in serial if e["name"] == "prestage"]
+    solves = {e["round"]: e for e in serial if e["name"] == "device_solve"}
+    for e in serial:
+        prev = solves.get(e["round"] - 1)
+        if prev is not None:
+            assert e["t0"] >= _interval(prev)[1] - 1e-6, (
+                "serial run must not overlap a prior round's solve"
+            )
+
+
+def test_pipelined_tracing_on_off_tick_identical():
+    """Tracing is observation only: the same seeded pipelined churn
+    places identically with the tracer on and off."""
+
+    def drive(enabled):
+        TRACER.clear()
+        TRACER.set_enabled(enabled)
+        bus, sched = _wired()
+        rng = np.random.default_rng(11)
+        log = []
+        pipeline = TickPipeline(
+            sched, log=lambda *a: None,
+            on_result=lambda out: log.append(sorted(out.items())),
+        )
+        _arrive(bus, rng, 0)
+        for t in range(4):
+            pipeline.submit_round(now=20.0 + t)
+            _arrive(bus, rng, t + 1)
+            pipeline.prestage(now=20.0 + t)
+        pipeline.drain("test")
+        pipeline.stop()
+        return log
+
+    on = drive(True)
+    off = drive(False)
+    assert on == off and len(on) == 4
+    TRACER.set_enabled(True)
+
+
+def test_tracer_ring_bounded_and_disabled_noop():
+    t = SpanTracer(capacity=4)
+    for i in range(10):
+        t.emit(f"s{i}", t0=float(i), t1=float(i) + 1.0)
+    assert len(t.events()) == 4
+    assert t.span_count == 10
+    t.set_enabled(False)
+    t.emit("dropped", t0=0.0, t1=1.0)
+    assert len(t.events()) == 4
+    # open marks keep working with recording off: the watchdog's food
+    t.mark_open("round:1")
+    assert "round:1" in t.open_marks()
+    assert t.mark_closed("round:1") is not None
+
+
+# -- per-pod timelines -------------------------------------------------------
+
+def test_pod_timeline_histogram_fake_clock():
+    from koordinator_tpu.metrics.registry import Histogram
+
+    clock = [100.0]
+    hist = Histogram("test_pod_e2e_seconds", label_names=("lane",))
+    tl = PodTimelines(clock=lambda: clock[0], histogram=hist)
+    tl.submit("a", lane="ls")
+    tl.submit("b", lane="be")
+    clock[0] = 101.0
+    tl.mark_many(["a", "b"], "staged")
+    clock[0] = 102.0
+    tl.mark("a", "solved")
+    clock[0] = 103.5
+    assert tl.published("a") == pytest.approx(3.5)
+    assert hist.count({"lane": "ls"}) == 1
+    assert hist.sum({"lane": "ls"}) == pytest.approx(3.5)
+    # a forgotten pod is not a latency sample
+    tl.forget("b")
+    assert hist.count({"lane": "be"}) == 0
+    # re-submitting an active uid must not reset its stamps
+    tl.submit("c", lane="system")
+    clock[0] = 110.0
+    tl.submit("c", lane="system")
+    clock[0] = 112.0
+    assert tl.published("c") == pytest.approx(8.5)
+    stats = tl.stats()
+    assert stats["all"]["count"] == 2
+    assert stats["ls"]["p50_s"] == pytest.approx(3.5)
+
+
+def test_pod_timeline_capacity_refuses_newest_keeps_tail():
+    """At capacity the NEW submit is refused and counted: evicting the
+    oldest would silently drop exactly the longest-waiting pods — the
+    p99 tail the histogram exists to observe."""
+    from koordinator_tpu.metrics.registry import Histogram
+
+    clock = [100.0]
+    hist = Histogram("test_pod_e2e_cap_seconds", label_names=("lane",))
+    tl = PodTimelines(capacity=2, clock=lambda: clock[0], histogram=hist)
+    tl.submit("old", lane="ls")
+    tl.submit("mid", lane="ls")
+    clock[0] = 150.0
+    tl.submit("new", lane="ls")
+    st = tl.status()
+    assert st["inflight"] == 2
+    assert st["dropped"] == 1
+    assert tl.published("new") is None               # never admitted
+    assert tl.published("old") == pytest.approx(50.0)  # tail survives
+    # capacity freed: the next submit is admitted again
+    tl.submit("late", lane="ls")
+    assert tl.status()["dropped"] == 1
+    clock[0] = 151.0
+    assert tl.published("late") == pytest.approx(1.0)
+
+
+def test_pod_timeline_preserved_carries_stamps():
+    """preserved(): original stamps (submit above all) win over the
+    round-trip's fresh ones, the refreshed pod's lane wins, and a
+    capacity-refused re-add restores the pre-existing sample."""
+    from koordinator_tpu.metrics.registry import Histogram
+
+    clock = [100.0]
+    hist = Histogram("test_pod_e2e_pres_seconds", label_names=("lane",))
+    tl = PodTimelines(clock=lambda: clock[0], histogram=hist)
+    tl.submit("a", lane="ls")
+    clock[0] = 105.0
+    tl.mark("a", "staged")
+    with tl.preserved("a"):
+        tl.forget("a")
+        clock[0] = 110.0
+        tl.submit("a", lane="be")
+    clock[0] = 112.0
+    assert tl.published("a") == pytest.approx(12.0)  # submit=100 kept
+    assert hist.count({"lane": "be"}) == 1           # new lane kept
+    # unknown uid: a no-op carry
+    with tl.preserved("ghost"):
+        pass
+    assert tl.status()["inflight"] == 0
+    # re-add refused at capacity: the pre-existing sample survives
+    small = PodTimelines(capacity=1, clock=lambda: clock[0],
+                         histogram=hist)
+    small.submit("x", lane="ls")
+    with small.preserved("x"):
+        small.forget("x")
+        small.submit("filler", lane="ls")
+        small.submit("x", lane="ls")        # refused (at capacity)
+    assert small.status()["dropped"] == 1
+    clock[0] = 120.0
+    assert small.published("x") == pytest.approx(8.0)
+
+
+def test_pod_timeline_survives_accounted_refresh():
+    """An informer MODIFIED refresh of a PENDING pod's accounted fields
+    re-runs remove_pod+add_pod for the quota/gang side effects — the
+    submit stamp must ride through, or a mid-wait field refresh hides
+    the queue-wait tail from scheduler_pod_e2e_seconds (regression:
+    the round-trip forgot + freshly re-submitted the timeline)."""
+    from koordinator_tpu.metrics.registry import Histogram
+
+    clock = [100.0]
+    hist = Histogram("test_pod_e2e_refresh_seconds",
+                     label_names=("lane",))
+    bus, sched = _wired()
+    sched.timelines = PodTimelines(clock=lambda: clock[0],
+                                   histogram=hist)
+    pod = PodSpec(name="w", requests={CPU: 1000, MEM: 1024})
+    bus.apply(Kind.POD, pod.uid, pod)
+    clock[0] = 130.0
+    refreshed = PodSpec(name="w", requests={CPU: 1200, MEM: 1024})
+    assert refreshed.uid == pod.uid and refreshed is not pod
+    bus.apply(Kind.POD, refreshed.uid, refreshed)
+    assert sched.timelines.status()["inflight"] == 1
+    clock[0] = 131.0
+    out = sched.schedule_pending(now=20.0)
+    assert out[refreshed.uid] is not None
+    assert hist.count({"lane": "ls"}) == 1
+    # 31s of pending wall, not the 1s since the refresh
+    assert hist.sum({"lane": "ls"}) == pytest.approx(31.0)
+
+
+def test_serial_loop_opens_publish_watchdog_mark():
+    """The default (non-pipelined) loop publishes inline; its publish
+    must still feed the stuck-publish watchdog (regression: only the
+    pipelined publisher opened publish:<id> marks, so a serial publish
+    wedged on a half-open connection showed zero open marks and
+    check_stuck reported healthy)."""
+    bus, sched = _wired()
+    rng = np.random.default_rng(11)
+    _arrive(bus, rng, 0, n=4)
+    seen = []
+
+    def watch(event, name, pod):
+        if getattr(pod, "node_name", None):
+            seen.append(dict(TRACER.open_marks()))
+
+    bus.watch(Kind.POD, watch)
+    sched.schedule_pending(now=20.0)
+    assert seen, "no binding published"
+    # mid-publish (observed from inside the bus apply) the mark is
+    # open, keyed by THIS scheduler's committed round — not the shared
+    # process-global counter a second wired scheduler would bump
+    assert any(f"publish:{sched.last_round_id}" in marks
+               for marks in seen)
+    # and it closes with the round — a finished publish is not stuck
+    assert not any(k.startswith("publish:") for k in TRACER.open_marks())
+
+
+def test_failed_epilogue_closes_round_mark():
+    """A FencingError raised from the commit_tick EPILOGUE (a fenced
+    preemption eviction mid-takeover) — not just from finalize — must
+    close round:<id> (regression: the guard only covered finalize, so
+    the already-retired round ghosted the watchdog and every flight
+    dump's open_spans forever)."""
+    from koordinator_tpu.client.leaderelection import FencingError
+
+    bus, sched = _wired()
+    rng = np.random.default_rng(7)
+    _arrive(bus, rng, 0)
+
+    def boom(result, pending, at):
+        raise FencingError("deposed")
+
+    sched._preempt_unplaced = boom
+    with pytest.raises(FencingError):
+        sched.schedule_pending(now=20.0)
+    assert not any(k.startswith("round:") for k in TRACER.open_marks())
+
+
+def test_build_scheduler_applies_obs_config(flight_dir, tmp_path):
+    """SchedulerConfig.trace / flight_dir must take effect for
+    embedders calling build_scheduler()+run_loop(), not only via the
+    CLI main() (regression: the knobs were applied in main() alone)."""
+    from koordinator_tpu.cmd.scheduler import (
+        SchedulerConfig,
+        build_scheduler,
+    )
+
+    other = tmp_path / "elsewhere"
+    build_scheduler(SchedulerConfig(
+        trace=False, flight_dir=str(other), host_fallback_cells=0))
+    assert not TRACER.enabled
+    assert FLIGHT.status()["dump_dir"] == str(other)
+    build_scheduler(SchedulerConfig(host_fallback_cells=0))
+    assert TRACER.enabled
+
+
+def test_pod_e2e_wired_submit_to_publish():
+    from koordinator_tpu.metrics.components import POD_E2E
+
+    before = POD_E2E.count({"lane": "ls"})
+    bus, sched = _wired()
+    rng = np.random.default_rng(5)
+    _arrive(bus, rng, 0, n=6)
+    out = sched.schedule_pending(now=20.0)
+    placed = sum(1 for v in out.values() if v is not None)
+    assert placed == 6
+    assert POD_E2E.count({"lane": "ls"}) == before + 6
+    assert sched.timelines.stats()["all"]["count"] >= 6
+
+
+# -- flight recorder trigger matrix ------------------------------------------
+
+def _dumps_for(flight_dir, trigger):
+    return [p for p in os.listdir(flight_dir)
+            if p.startswith(f"flight-{trigger}-")]
+
+
+def test_flight_trigger_auditor_detection(flight_dir):
+    from koordinator_tpu.scheduler.auditor import StateAuditor
+    from koordinator_tpu.testing.chaos import FaultSchedule, StateSaboteur
+
+    bus, sched = _wired()
+    auditor = StateAuditor(sched, bus, interval_rounds=4, probe_rows=8)
+    rng = np.random.default_rng(1)
+    _arrive(bus, rng, 0, n=8)
+    sched.schedule_pending(now=20.0)
+    saboteur = StateSaboteur(
+        FaultSchedule({0: "corrupt-cache-cell"}), sched, seed=0
+    )
+    assert saboteur.inject(0) == "corrupt-cache-cell"
+    report = auditor.sweep("manual", now=21.0)
+    assert report["detections"]
+    paths = _dumps_for(flight_dir, "auditor-detection")
+    assert len(paths) == 1
+    payload = json.loads((flight_dir / paths[0]).read_text())
+    assert payload["trigger"] == "auditor-detection"
+    assert payload["extra"]["detections"]
+
+
+def test_flight_trigger_failover_flip(flight_dir):
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.service.client import SolverUnavailable
+    from koordinator_tpu.service.failover import FailoverSolver
+    from koordinator_tpu.testing import example_problem
+
+    class DeadRemote:
+        address = ("127.0.0.1", 1)
+
+        def solve_result(self, *a, **kw):
+            raise SolverUnavailable("down")
+
+    fo = FailoverSolver(DeadRemote(), failure_threshold=1,
+                        probe_fn=lambda: False)
+    state, pods, params = example_problem(6, 4, seed=2)
+    result = fo.solve_result(state, pods, params, SolverConfig())
+    assert result.assign.shape[0] == 4  # answered in-process
+    paths = _dumps_for(flight_dir, "failover-flip")
+    assert len(paths) == 1
+    payload = json.loads((flight_dir / paths[0]).read_text())
+    assert "to-degraded" in payload["detail"]
+    # rounds recorded before the flip ride along
+    assert isinstance(payload["rounds"], list)
+
+
+def test_flight_trigger_fencing_abort(flight_dir):
+    from koordinator_tpu.client.leaderelection import FencingError
+    from koordinator_tpu.cmd.scheduler import SchedulerConfig, run_loop
+
+    sched = Scheduler()
+
+    def boom(now=None):
+        raise FencingError("deposed")
+
+    sched.schedule_pending = boom
+    rc = run_loop(sched, SchedulerConfig(schedule_interval_seconds=0.01),
+                  once=True, log=lambda *a: None)
+    assert rc == 1
+    paths = _dumps_for(flight_dir, "fencing-abort")
+    assert len(paths) == 1
+
+
+def test_flight_trigger_deferred_pipeline_error(flight_dir):
+    bus, sched = _wired(n_nodes=2)
+
+    def bad_publish(out):
+        raise RuntimeError("publish wedge")
+
+    pipeline = TickPipeline(sched, publish=bad_publish,
+                            log=lambda *a: None)
+    rng = np.random.default_rng(7)
+    _arrive(bus, rng, 0, n=2)
+    pipeline.submit_round(now=20.0)
+    with pytest.raises(RuntimeError, match="publish wedge"):
+        pipeline.drain("test")
+    pipeline.stop()
+    paths = _dumps_for(flight_dir, "pipeline-deferred-error")
+    assert len(paths) == 1
+    payload = json.loads((flight_dir / paths[0]).read_text())
+    assert "RuntimeError" in payload["detail"]
+    # the dump must contain the round that FAILED (error-flagged), not
+    # only the rounds leading up to it — _retire bailed before its
+    # record_round, so the error path records it
+    failed = [r for r in payload["rounds"] if r.get("error")]
+    assert failed and "RuntimeError" in failed[-1]["error"]
+
+
+def test_flight_trigger_deadline_exceeded(flight_dir):
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.service.client import (
+        RemoteSolver,
+        SolverDeadlineExceeded,
+    )
+    from koordinator_tpu.testing import example_problem
+
+    # a black-hole server: accepts connections, never answers — each
+    # attempt parks on the budget-capped socket wait until the
+    # client-side deadline drains
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    held = []
+
+    def accept_and_hold():
+        while True:
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            held.append(conn)
+
+    t = threading.Thread(target=accept_and_hold, daemon=True)
+    t.start()
+    try:
+        # retries > the attempts the budget can hold: the guaranteed-
+        # minimum-retries clause defers the transport raise, so the
+        # budget check at the loop top is what fires — the client-side
+        # deadline-exceeded path
+        solver = RemoteSolver(listener.getsockname(), deadline_s=0.15,
+                              retries=3, backoff_base_s=0.005)
+        state, pods, params = example_problem(4, 3, seed=1)
+        with pytest.raises(SolverDeadlineExceeded):
+            solver.solve_result(state, pods, params, SolverConfig())
+        solver.close()
+    finally:
+        listener.close()
+        for conn in held:
+            conn.close()
+    paths = _dumps_for(flight_dir, "deadline-exceeded")
+    assert len(paths) == 1
+
+
+def test_flight_rate_limit(flight_dir):
+    FLIGHT.configure(min_interval_s=60.0)
+    assert FLIGHT.trigger("manual", detail="first") is not None
+    assert FLIGHT.trigger("manual", detail="suppressed") is None
+    assert len(_dumps_for(flight_dir, "manual")) == 1
+
+
+# -- explain parity ----------------------------------------------------------
+
+def _full_feature_scheduler():
+    """Quota + reservation + stale/overloaded metrics + prod pods +
+    selector pods on one typed scheduler — the full-feature explain
+    scenario."""
+    s = Scheduler()
+    for i in range(8):
+        s.add_node(NodeSpec(
+            name=f"n{i}",
+            allocatable={CPU: 16000, MEM: 32768},
+            labels={"zone": "a" if i < 4 else "b"},
+        ))
+        # n6 stale metric (old update_time), n7 overloaded
+        s.update_node_metric(NodeMetric(
+            node_name=f"n{i}",
+            node_usage={CPU: 15000 if i == 7 else 1000 * i,
+                        MEM: 2048 * i},
+            update_time=1.0 if i == 6 else 90.0,
+        ))
+    s.update_quota(QuotaSpec(name="q", min={CPU: 2000, MEM: 1024},
+                             max={CPU: 6000, MEM: 4096}))
+    s.update_reservation(ReservationSpec(
+        name="resv-a", node_name="n2", requests={CPU: 2000},
+        allocatable={CPU: 2000},
+        state=ReservationState.AVAILABLE,
+        owner_pod_uids=["default/owned"],
+    ))
+    s.add_pod(PodSpec(name="plain", requests={CPU: 1500, MEM: 512}))
+    s.add_pod(PodSpec(name="prod",
+                      requests={CPU: 2000, MEM: 1024},
+                      priority_class=PriorityClass.PROD,
+                      qos=QoSClass.LS))
+    s.add_pod(PodSpec(name="quota-pod", quota="q",
+                      requests={CPU: 1000, MEM: 256}))
+    s.add_pod(PodSpec(name="picky",
+                      requests={CPU: 500, MEM: 128},
+                      node_selector={"zone": "b"}))
+    s.add_pod(PodSpec(name="owned", requests={CPU: 800, MEM: 128}))
+    s.add_pod(PodSpec(name="be-pod", qos=QoSClass.BE,
+                      requests={CPU: 400, MEM: 64}))
+    return s
+
+
+def test_explain_oracle_parity_full_features():
+    """Acceptance: explain's per-column scores/verdicts match the
+    oracle's plugin decision functions bit-for-bit on the full-feature
+    scenario."""
+    from koordinator_tpu.obs.explain import explain_scores
+    from koordinator_tpu.oracle.scheduler import (
+        fit_filter_node,
+        least_allocated_score_node,
+        loadaware_filter_node,
+        loadaware_score_node,
+    )
+    from koordinator_tpu.state.cluster import lower_pending_pods
+
+    s = _full_feature_scheduler()
+    snapshot = s.cache.snapshot(now=100.0)
+    assert snapshot.pending_pods
+    weights = np.asarray(s.model.params.weights)
+    thresholds = np.asarray(s.model.params.thresholds)
+    prod_thresholds = np.asarray(s.model.params.prod_thresholds)
+    for pod in snapshot.pending_pods:
+        arrays, cols = explain_scores(s.model, snapshot, pod)
+        pa = lower_pending_pods(
+            [pod],
+            scaling_factors=s.model.scaling_factors,
+            resource_weights=s.model.resource_weights,
+        )
+        req, est = pa.req[0], pa.est[0]
+        is_prod = bool(pa.is_prod[0])
+        is_ds = bool(pa.is_daemonset[0])
+        for i in range(arrays.n):
+            assert cols["fit_score"][i] == least_allocated_score_node(
+                req, arrays.alloc[i], arrays.used_req[i], weights
+            ), (pod.name, i)
+            assert cols["loadaware_score"][i] == loadaware_score_node(
+                est, arrays.alloc[i], arrays.usage[i],
+                arrays.est_extra[i], arrays.prod_base[i],
+                bool(arrays.metric_fresh[i]), weights, is_prod,
+                s.model.config.score_according_prod,
+            ), (pod.name, i)
+            assert bool(cols["fit_feasible"][i]) == fit_filter_node(
+                req, arrays.alloc[i], arrays.used_req[i]
+            )
+            assert bool(cols["loadaware_feasible"][i]) == \
+                loadaware_filter_node(
+                    arrays.alloc[i], arrays.usage[i],
+                    arrays.prod_usage[i], bool(arrays.metric_fresh[i]),
+                    thresholds, prod_thresholds, is_ds, is_prod,
+                )
+
+
+def test_explain_winner_matches_incremental_chain():
+    from koordinator_tpu.obs.explain import PlacementExplainer
+
+    s = _full_feature_scheduler()
+    explainer = PlacementExplainer(s)
+    s.debug.dump_scores = True
+    payload = explainer.explain("default/plain", now=100.0)
+    outcome = s.schedule_one("default/plain", now=100.0)
+    assert outcome.status == "bound"
+    assert payload["winner"] == outcome.node
+    # the weighted totals equal the plugin chain's recorded scores
+    chain_scores = s.debug.scores[0]["scores"]
+    for detail in payload["top_nodes"]:
+        if detail["feasible"]:
+            assert (detail["scores"]["weighted_total"]
+                    == chain_scores[detail["node"]]), detail["node"]
+    # explain answers are kept on the debug recorder (bounded)
+    assert list(s.debug.explains)[-1] is payload
+
+
+def test_explain_unschedulable_and_selector():
+    from koordinator_tpu.obs.explain import PlacementExplainer
+
+    s = _full_feature_scheduler()
+    payload = PlacementExplainer(s).explain(
+        "default/picky", node="n0", now=100.0
+    )
+    # zone-a nodes fail the selector; the queried node shows why
+    assert payload["filter_rejections"]["selector"] == 4
+    assert payload["queried_node"]["filters"]["selector"] is False
+    assert payload["winner"] is not None  # zone b has room
+    s.add_pod(PodSpec(name="impossible",
+                      requests={CPU: 10 ** 8}))
+    impossible = PlacementExplainer(s).explain(
+        "default/impossible", now=100.0
+    )
+    assert impossible["winner"] is None
+    assert impossible["feasible_count"] == 0
+    assert impossible["filter_rejections"]["fit"] == 8
+
+
+# -- watchdog ----------------------------------------------------------------
+
+def test_monitor_stuck_counts_once_and_clears():
+    from koordinator_tpu.metrics.components import STUCK_CYCLES
+    from koordinator_tpu.scheduler.monitor import SchedulerMonitor
+
+    tracer = SpanTracer()
+    mon = SchedulerMonitor(tracer=tracer, timeout_seconds=5.0,
+                           log=lambda *a: None)
+    before_round = STUCK_CYCLES.value({"kind": "round"})
+    before_pub = STUCK_CYCLES.value({"kind": "publish"})
+    tracer.mark_open("round:7")
+    tracer.mark_open("publish:6")
+    later = tracer.now() + 30.0
+    assert sorted(mon.check_stuck(now=later)) == ["publish:6", "round:7"]
+    # counted exactly once, not per check
+    mon.check_stuck(now=later + 1.0)
+    assert STUCK_CYCLES.value({"kind": "round"}) == before_round + 1
+    assert STUCK_CYCLES.value({"kind": "publish"}) == before_pub + 1
+    tracer.mark_closed("round:7")
+    tracer.mark_closed("publish:6")
+    assert mon.check_stuck(now=later) == []
+    # a fresh wedge on a NEW mark counts again
+    tracer.mark_open("round:8")
+    mon.check_stuck(now=later + 60.0)
+    assert STUCK_CYCLES.value({"kind": "round"}) == before_round + 2
+
+
+def test_monitor_stuck_counts_once_across_monitors():
+    """The counted-stuck flag lives with the MARK, not the monitor: a
+    leader + standby in one process (run_loop checks before the elector
+    gate, so both monitors run) plus a debug-mux status() reader must
+    count one stuck round once, not once per watcher."""
+    from koordinator_tpu.metrics.components import STUCK_CYCLES
+    from koordinator_tpu.scheduler.monitor import SchedulerMonitor
+
+    tracer = SpanTracer()
+    leader = SchedulerMonitor(tracer=tracer, timeout_seconds=5.0,
+                              log=lambda *a: None)
+    standby = SchedulerMonitor(tracer=tracer, timeout_seconds=5.0,
+                               log=lambda *a: None)
+    before = STUCK_CYCLES.value({"kind": "round"})
+    tracer.mark_open("round:9")
+    later = tracer.now() + 30.0
+    # both report it stuck (the VERDICT is per-caller)...
+    assert leader.check_stuck(now=later) == ["round:9"]
+    assert standby.check_stuck(now=later) == ["round:9"]
+    standby.status()
+    # ...but the metric counts the mark exactly once
+    assert STUCK_CYCLES.value({"kind": "round"}) == before + 1
+    # reusing the key (mark closed, later reopened) re-arms the flag
+    tracer.mark_closed("round:9")
+    tracer.mark_open("round:9")
+    leader.check_stuck(now=tracer.now() + 30.0)
+    assert STUCK_CYCLES.value({"kind": "round"}) == before + 2
+
+
+def test_standby_observed_binding_forgets_timeline():
+    """A standby watching the leader bind pods must not leak open
+    timelines: the observed binding is not this scheduler's latency
+    sample, so the entry is dropped unobserved."""
+    bus, standby = _wired()
+    pod = PodSpec(name="w", requests={CPU: 1000, MEM: 1024})
+    bus.apply(Kind.POD, pod.uid, pod)
+    assert standby.timelines.status()["inflight"] == 1
+    # the leader's bind arrives as a fresh bound object on the bus
+    bound = PodSpec(name="w", node_name="n0", assign_time=20.0,
+                    requests={CPU: 1000, MEM: 1024})
+    bus.apply(Kind.POD, bound.uid, bound)
+    assert pod.uid in standby.cache.pods
+    assert pod.uid not in standby.cache.pending
+    st = standby.timelines.status()
+    assert st["inflight"] == 0
+    assert st["latency"]["all"]["count"] == 0  # forgotten, not observed
+
+
+def test_flight_dump_files_capped_on_disk(flight_dir):
+    """The per-trigger rate limit bounds the dump RATE; the file cap
+    bounds the TOTAL — a flapping trigger must not fill the disk."""
+    from koordinator_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(dump_dir=str(flight_dir), min_interval_s=0.0,
+                         max_files=3)
+    for i in range(8):
+        rec.record_round({"round": i})
+        assert rec.trigger("manual", detail=f"flap {i}") is not None
+    files = sorted(p.name for p in flight_dir.glob("flight-manual-*.json"))
+    assert len(files) == 3
+    assert files == ["flight-manual-0006.json", "flight-manual-0007.json",
+                     "flight-manual-0008.json"]
+
+
+def test_failed_round_and_publish_close_their_marks():
+    """A FAILED round/publish is handled (skipped, deferred) — not
+    STUCK: its watchdog mark must close, or check_stuck flags a ghost
+    forever (regression: fenced publishes leaked publish:<id> marks
+    across the whole process lifetime)."""
+    rng = np.random.default_rng(5)
+
+    # publish raises (the fenced-publish shape) inside the pipeline
+    bus, sched = _wired()
+    _arrive(bus, rng, 0)
+    boom = RuntimeError("fenced")
+
+    def bad_publish(result):
+        raise boom
+
+    pipeline = TickPipeline(sched, publish=bad_publish,
+                            log=lambda *a: None)
+    pipeline.submit_round(now=100.0)
+    with pytest.raises(RuntimeError):
+        pipeline.drain("test")  # the deferred error surfaces here
+    pipeline.stop()
+    assert not any(k.startswith("publish:") for k in TRACER.open_marks())
+
+    # solve dispatch raises (the sidecar-outage shape) in begin_tick
+    bus2, sched2 = _wired()
+    _arrive(bus2, rng, 1)
+
+    def bad_dispatch(snapshot):
+        raise RuntimeError("solver gone")
+
+    sched2.model.schedule_async = bad_dispatch
+    with pytest.raises(RuntimeError):
+        sched2.begin_tick(now=100.0)
+    assert not any(k.startswith("round:") for k in TRACER.open_marks())
+
+
+# -- wire trace context ------------------------------------------------------
+
+def test_codec_trace_group_roundtrip_and_unknown_prefix():
+    import io
+
+    from koordinator_tpu.service.codec import (
+        SolveRequest,
+        decode_request,
+        encode_request,
+    )
+
+    node = {"alloc": np.ones((2, 3), np.int32)}
+    req = SolveRequest(
+        node=node, pods={"req": np.ones((1, 3), np.int32)},
+        params={"weights": np.ones(3, np.int32)},
+        trace={"round": np.asarray(7, np.int64),
+               "span": np.asarray(42, np.int64)},
+    )
+    decoded = decode_request(encode_request(req))
+    assert int(decoded.trace["round"]) == 7
+    assert int(decoded.trace["span"]) == 42
+    # an unknown future prefix is skipped, exactly like trace is by a
+    # v2 server
+    buf = io.BytesIO()
+    np.savez(buf, **{"z.mystery": np.zeros(1), "n.alloc": node["alloc"]})
+    tolerant = decode_request(buf.getvalue())
+    assert "alloc" in tolerant.node and tolerant.trace is None
+
+
+def test_sidecar_spans_join_scheduler_trace(tmp_path):
+    """A RemoteSolver round trip tags the in-process sidecar's solve
+    span with the scheduler's (round, span) trace context."""
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.service.client import RemoteSolver
+    from koordinator_tpu.service.server import PlacementService
+    from koordinator_tpu.testing import example_problem
+
+    addr = str(tmp_path / "solver.sock")
+    service = PlacementService(addr, admission=False)
+    service.start()
+    try:
+        solver = RemoteSolver(addr)
+        state, pods, params = example_problem(6, 4, seed=3)
+        result = solver.solve_result(state, pods, params, SolverConfig())
+        assert result.assign.shape[0] == 4
+        spans = TRACER.events()
+        wire = [e for e in spans if e["name"] == "wire_solve"]
+        sidecar = [e for e in spans if e["name"] == "sidecar_solve"]
+        assert wire and sidecar
+        assert sidecar[-1]["args"]["span"] == wire[-1]["args"]["span"]
+        solver.close()
+    finally:
+        service.stop()
+
+
+def test_admission_gate_emits_queue_wait_spans(tmp_path):
+    from koordinator_tpu.service.admission import AdmissionGate
+    from koordinator_tpu.service.codec import SolveRequest
+    from koordinator_tpu.service.server import solve_from_request
+    from koordinator_tpu.testing import example_problem
+
+    state, pods, params = example_problem(4, 3, seed=5)
+    req = SolveRequest(
+        node={f: np.asarray(getattr(state, f))
+              for f in ("alloc", "used_req", "usage", "prod_usage",
+                        "est_extra", "prod_base", "metric_fresh",
+                        "schedulable")},
+        pods={f: np.asarray(getattr(pods, f))
+              for f in ("req", "est", "is_prod", "is_daemonset")},
+        params={f: np.asarray(getattr(params, f))
+                for f in ("weights", "thresholds", "prod_thresholds")},
+        trace={"round": np.asarray(3, np.int64),
+               "span": np.asarray(9, np.int64)},
+    )
+    from koordinator_tpu.ops.binpack import SolverConfig
+
+    gate = AdmissionGate(solve_from_request)
+    try:
+        entry = gate.submit(req, SolverConfig())
+        resp = entry.wait(timeout=30.0)
+        assert resp is not None and resp.error == ""
+    finally:
+        gate.shutdown()
+    waits = [e for e in TRACER.events() if e["name"] == "queue_wait"]
+    assert waits and waits[-1]["args"]["round"] == 3
+    assert waits[-1]["args"]["lane"] == "ls"
+
+
+# -- debug mux ---------------------------------------------------------------
+
+def test_debug_http_trace_and_explain_endpoints():
+    from koordinator_tpu.obs.explain import PlacementExplainer
+    from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+    s = _full_feature_scheduler()
+    server = DebugHTTPServer(
+        services=s.services, debug=s.debug, tracer=TRACER,
+        explain=PlacementExplainer(s).explain,
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/debug/trace") as resp:
+            trace = json.loads(resp.read())
+        assert "traceEvents" in trace
+        with urllib.request.urlopen(
+            f"{base}/explain?pod=default/plain&node=n0"
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert payload["winner"] is not None
+        assert payload["queried_node"]["node"] == "n0"
+        with urllib.request.urlopen(f"{base}/debug/dumps") as resp:
+            dumps = json.loads(resp.read())
+        assert dumps["explains"]  # the explain above was recorded
+        # the monitor + timeline services ride the standard registry
+        with urllib.request.urlopen(
+            f"{base}/apis/v1/plugins/pod-timelines"
+        ) as resp:
+            assert "latency" in json.loads(resp.read())
+        with urllib.request.urlopen(
+            f"{base}/apis/v1/plugins/monitor"
+        ) as resp:
+            assert json.loads(resp.read())["stuck"] == []
+    finally:
+        server.stop()

@@ -117,8 +117,20 @@ def test_monitor_and_debug_services():
     assert "Coscheduling" in s.services.names()
     # only the implicit root exists before any quota is registered
     assert list(s.services.query("ElasticQuota")) == ["root"]
-    s.monitor.cycle_finished("x", duration=99.0)
-    assert s.monitor.slow_cycles[0]["pod"] == "x"
+    # the monitor is a span-fed watchdog now: an open tracer mark older
+    # than the timeout reads as a stuck cycle. A FRESH tracer, not the
+    # process-global one: marks leaked by unrelated earlier tests (or
+    # left behind here) must not couple test outcomes
+    from koordinator_tpu.obs.trace import SpanTracer
+    from koordinator_tpu.scheduler.monitor import SchedulerMonitor
+
+    tracer = SpanTracer()
+    mon = SchedulerMonitor(tracer=tracer, log=lambda *a: None)
+    tracer.mark_open("round:999")
+    stuck = mon.check_stuck(now=tracer.now() + 99.0)
+    assert "round:999" in stuck
+    tracer.mark_closed("round:999")
+    assert mon.check_stuck() == []
 
 
 def test_batch_and_incremental_agree():

@@ -24,6 +24,13 @@ echo "== pipeline smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_pipeline.py \
     -q -k smoke -p no:cacheprovider
 
+echo "== trace smoke =="
+# the observability fabric: a pipelined run must export a valid
+# Chrome-trace with stage(N+1)/solve(N) overlap visible while a serial
+# run shows none, and tracing on vs off must stay tick-identical
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_obs.py \
+    -q -k "smoke or tick_identical" -p no:cacheprovider
+
 echo "== audit smoke =="
 # the anti-entropy slice: seeded cache/staging corruption -> the
 # auditor detects and repairs (counted) -> a kill-the-leader churn
