@@ -161,6 +161,26 @@ LOCK_SPECS = (
         attrs=("_ring", "_dumps", "_last_dump", "_dump_dir",
                "_min_interval_s", "_seq", "_files", "_max_files"),
     ),
+    # the device-cost observatory (docs/DESIGN.md §17): instrumented
+    # jit calls record from solve threads, the monitoring listener
+    # fires from whichever thread compiles, analyze()/status() run from
+    # debug-mux handlers and bench harnesses. ``enabled`` and
+    # ``_profile_hot`` are plain fast-path flags read without the lock
+    # (same contract as SpanTracer.enabled); everything else is mapped.
+    LockSpec(
+        path="koordinator_tpu/obs/device.py",
+        class_name="DeviceObservatory",
+        lock="_lock",
+        attrs=(
+            "_seen", "_fn_cache_sizes", "_ring", "_pending", "_analyses",
+            "_analysis_order",
+            "_padding", "_owners", "_seq", "_compiles_total",
+            "_xla_compiles", "_xla_compile_s", "_profile_dir",
+            "_profile_min_interval_s", "_profile_max_windows",
+            "_profile_armed", "_profile_remaining", "_profile_path",
+            "_profile_last_at", "_profile_windows", "_profile_error",
+        ),
+    ),
 )
 
 #: the delta/full lowering pair and the shared per-row helper registry

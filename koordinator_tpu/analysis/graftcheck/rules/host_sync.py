@@ -70,8 +70,15 @@ class HostSyncRule:
     # -- taint ---------------------------------------------------------------
 
     def _is_jit_factory(self, node: ast.expr) -> bool:
-        """``jax.jit(...)`` / ``pjit(...)`` / ``partial(jax.jit, ...)`` —
-        an expression whose value is a jit-compiled callable."""
+        """``jax.jit(...)`` / ``pjit(...)`` / ``partial(jax.jit, ...)``
+        — an expression whose value is a jit-compiled callable. The
+        last-segment name rule deliberately also matches
+        instrumentation wrappers whose factory method is NAMED ``jit``
+        (``DEVICE_OBS.jit("name", jax.jit(f, ...))``, obs/device.py):
+        the wrapper is call-transparent, so its binding produces device
+        values exactly like the bare jit. Arbitrary calls that merely
+        TAKE a jit factory as an argument (registries, spawners) are
+        not factories — over-tainting them would erode the lint."""
         if not isinstance(node, ast.Call):
             return False
         chain = attr_chain(node.func) or ""
@@ -79,7 +86,8 @@ class HostSyncRule:
             return True
         if chain.split(".")[-1] == "partial" and node.args:
             inner = attr_chain(node.args[0]) or ""
-            return inner.split(".")[-1] in ("jit", "pjit")
+            if inner.split(".")[-1] in ("jit", "pjit"):
+                return True
         return False
 
     def _tainted(self, node: ast.AST, tainted: Set[str],

@@ -37,10 +37,24 @@ _VARYING_CHAINS = ("time.time", "time.perf_counter", "time.monotonic")
 
 def _jit_target(call: ast.Call) -> Optional[ast.Call]:
     """The Call whose keywords carry the jit declaration, if ``call``
-    is ``jax.jit(...)``/``pjit(...)`` or ``partial(jax.jit, ...)``."""
+    is ``jax.jit(...)``/``pjit(...)`` or ``partial(jax.jit, ...)``.
+
+    An instrumentation wrapper whose factory method is NAMED ``jit``
+    and carries a jit factory as an argument (``DEVICE_OBS.jit("name",
+    jax.jit(f, ...))``, obs/device.py) delegates its declaration to
+    the INNER factory call — the wrapper is call-transparent, so its
+    binding is a jitted callable (pass 2 still applies) while
+    static/donate completeness is checked where the declaration
+    actually lives. Calls that merely take a jit factory as an
+    argument without being jit-named are untouched."""
     chain = attr_chain(call.func) or ""
     seg = chain.split(".")[-1] if chain else ""
     if seg in ("jit", "pjit"):
+        for a in call.args:
+            if isinstance(a, ast.Call):
+                inner = _jit_target(a)
+                if inner is not None:
+                    return inner
         return call
     if seg == "partial" and call.args:
         inner = attr_chain(call.args[0]) or ""
@@ -66,6 +80,9 @@ class JitHygieneRule:
         out: List[Violation] = []
         jitted_names: Set[str] = set()
         qmap = qualname_map(module.tree)
+        #: declaration carriers already judged — a wrapper call and its
+        #: inner factory both resolve to the same target; report once
+        judged: Set[int] = set()
 
         # pass 1: declaration completeness + collect jitted bindings
         for node in ast.walk(module.tree):
@@ -97,8 +114,9 @@ class JitHygieneRule:
             if not isinstance(node, ast.Call):
                 continue
             target = _jit_target(node)
-            if target is None:
+            if target is None or id(target) in judged:
                 continue
+            judged.add(id(target))
             kws = {kw.arg for kw in target.keywords if kw.arg is not None}
             missing = []
             if not kws & _STATIC_KWS:

@@ -85,6 +85,10 @@ class SchedulerConfig:
     #: anomaly flight-recorder dump directory (obs/flight.py). None =
     #: $KTPU_FLIGHT_DIR or <tmp>/koord-flight
     flight_dir: Optional[str] = None
+    #: device-cost observatory (obs/device.py): directory for on-demand
+    #: jax profiler windows (/debug/profile?rounds=K). None =
+    #: $KTPU_PROFILE_DIR or <tmp>/koord-profile
+    profile_dir: Optional[str] = None
     #: stuck-cycle watchdog threshold (scheduler/monitor.py): an open
     #: round/publish mark older than this reads as stuck. The mark now
     #: covers the WHOLE batched round — including a first-round
@@ -176,12 +180,15 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
     # the observability knobs apply at THIS layer, not only in main():
     # an embedder calling build_scheduler()+run_loop() with
     # trace=False / flight_dir=... must get what the config says
+    from koordinator_tpu.obs.device import DEVICE_OBS
     from koordinator_tpu.obs.flight import FLIGHT
     from koordinator_tpu.obs.trace import TRACER
 
     TRACER.set_enabled(config.trace)
     if config.flight_dir is not None:
         FLIGHT.configure(dump_dir=config.flight_dir)
+    if config.profile_dir is not None:
+        DEVICE_OBS.configure(profile_dir=config.profile_dir)
     return scheduler
 
 
@@ -499,6 +506,12 @@ def main(argv=None) -> int:
              "$KTPU_FLIGHT_DIR or <tmp>/koord-flight)",
     )
     parser.add_argument(
+        "--profile-dir", default=None,
+        help="on-demand jax profiler window directory "
+             "(/debug/profile?rounds=K arms a window over the next K "
+             "rounds; default: $KTPU_PROFILE_DIR or <tmp>/koord-profile)",
+    )
+    parser.add_argument(
         "--monitor-timeout", type=float, default=10.0,
         help="stuck-cycle watchdog threshold in seconds: an open "
              "round/publish mark older than this counts into "
@@ -541,6 +554,7 @@ def main(argv=None) -> int:
         pipelined_ticks=args.pipelined_ticks,
         trace=not args.no_trace,
         flight_dir=args.flight_dir,
+        profile_dir=args.profile_dir,
         monitor_timeout_seconds=args.monitor_timeout,
     )
     from koordinator_tpu.client.bus import APIServer
@@ -619,15 +633,29 @@ def main(argv=None) -> int:
                 scheduler.services.register(
                     "solver-failover", scheduler.model.backend.status
                 )
+            from koordinator_tpu.metrics.registry import MergedGatherer
+            from koordinator_tpu.obs.device import DEVICE_OBS
+            from koordinator_tpu.metrics.components import DEVICE_METRICS
             from koordinator_tpu.obs.explain import PlacementExplainer
 
             scheduler.services.register("flight-recorder", FLIGHT.status)
             scheduler.services.register("trace", TRACER.status)
+            # the device observatory rides the same mux: its registry
+            # merges into /metrics, its ring at /debug/device, and
+            # /debug/profile arms profiler windows over coming rounds
+            scheduler.services.register(
+                "device-observatory", DEVICE_OBS.status
+            )
             http_server = DebugHTTPServer(
                 services=scheduler.services, debug=scheduler.debug,
-                metrics=SCHEDULER_METRICS, port=args.debug_port,
+                metrics=MergedGatherer(
+                    [SCHEDULER_METRICS, DEVICE_METRICS]
+                ),
+                port=args.debug_port,
                 tracer=TRACER,
                 explain=PlacementExplainer(scheduler).explain,
+                device=DEVICE_OBS.debug_payload,
+                profile=DEVICE_OBS.request_profile,
             ).start()
             print(f"debug http on 127.0.0.1:{http_server.port}")
         return run_loop(scheduler, config, once=args.once, elector=elector,

@@ -46,9 +46,17 @@ def main(argv=None) -> int:
         "--debug-port", type=int, default=None,
         help="serve /apis/v1/plugins/solver (routing + kernel-breaker "
              "+ admission-gate state), /metrics (admission queue/shed/"
-             "latency series), /debug/trace (the sidecar's span ring — "
-             "queue-wait + solve spans tagged with the scheduler's "
-             "wire trace context) and /healthz on this port",
+             "latency series + device-observatory compile/padding/"
+             "live-buffer series), /debug/trace (the sidecar's span "
+             "ring — queue-wait + solve spans tagged with the "
+             "scheduler's wire trace context), /debug/device, "
+             "/debug/profile?rounds=K (a profiler window over the next "
+             "K solves) and /healthz on this port",
+    )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="on-demand jax profiler window directory (default: "
+             "$KTPU_PROFILE_DIR or <tmp>/koord-profile)",
     )
     args = parser.parse_args(argv)
 
@@ -73,9 +81,17 @@ def main(argv=None) -> int:
 
         with open(args.ready_file, "w") as f:
             f.write(str(os.getpid()))
+    from koordinator_tpu.obs.device import DEVICE_OBS
+
+    if args.profile_dir:
+        DEVICE_OBS.configure(profile_dir=args.profile_dir)
     debug_server = None
     if args.debug_port is not None:
-        from koordinator_tpu.metrics.components import SOLVER_METRICS
+        from koordinator_tpu.metrics.components import (
+            DEVICE_METRICS,
+            SOLVER_METRICS,
+        )
+        from koordinator_tpu.metrics.registry import MergedGatherer
         from koordinator_tpu.obs.trace import TRACER
         from koordinator_tpu.scheduler.monitor import DebugServices
         from koordinator_tpu.utils.debug_http import DebugHTTPServer
@@ -84,14 +100,19 @@ def main(argv=None) -> int:
         # the solver's operational state — the kernel-routing breaker
         # ("why is this sidecar riding the scan?") and the admission
         # gate (lane depths, coalesce ratio, shed counts) in one GET;
-        # /metrics serves the same gate as prometheus series, and
+        # /metrics serves the same gate as prometheus series (plus the
+        # device observatory's compile/padding/live-buffer series), and
         # /debug/trace the sidecar-side spans (queue wait + solve,
         # joined to the scheduler's trace via the wire trace context)
         services.register("solver", service.status)
         services.register("trace", TRACER.status)
+        services.register("device-observatory", DEVICE_OBS.status)
         debug_server = DebugHTTPServer(
-            services=services, metrics=SOLVER_METRICS,
-            tracer=TRACER, port=args.debug_port
+            services=services,
+            metrics=MergedGatherer([SOLVER_METRICS, DEVICE_METRICS]),
+            tracer=TRACER, port=args.debug_port,
+            device=DEVICE_OBS.debug_payload,
+            profile=DEVICE_OBS.request_profile,
         ).start()
     print(f"koord-solver: serving on {args.listen}")
     try:

@@ -174,6 +174,63 @@ FLIGHT_DUMPS = SCHEDULER_METRICS.counter(
     label_names=("trigger",),
 )
 
+# -- device-cost observatory (koordinator_tpu/obs/device.py) ----------------
+# The device-side twin of the trace fabric: compile telemetry, padding
+# waste, and live-buffer accounting. These live in their OWN registry
+# because BOTH long-lived processes compile — the scheduler's debug mux
+# and the solver sidecar's --debug-port each merge this registry into
+# their /metrics (utils/debug_http via MergedGatherer), so whichever
+# process an operator scrapes answers "did we just recompile / how much
+# HBM is staged state holding" (docs/DESIGN.md §17).
+
+DEVICE_METRICS = Registry("device-observatory")
+DEVICE_COMPILES = DEVICE_METRICS.counter(
+    "solver_device_compile_total",
+    "XLA compilations observed at instrumented jit callsites, by "
+    "function — the quantitative, always-on form of graftcheck's "
+    "boolean zero-recompile guard (a warmed steady-state tick adds 0)",
+    label_names=("fn",),
+)
+DEVICE_COMPILE_SECONDS = DEVICE_METRICS.histogram(
+    "solver_device_compile_seconds",
+    "Wall-clock of the signature-miss call that triggered each "
+    "observed compilation (trace + lower + XLA compile + dispatch)",
+    label_names=("fn",),
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+)
+DEVICE_XLA_COMPILES = DEVICE_METRICS.counter(
+    "solver_device_xla_compiles_total",
+    "ALL backend compilations in this process (jax.monitoring events; "
+    "includes helper programs and on-demand analysis lowerings the "
+    "per-fn counter does not attribute)",
+)
+DEVICE_XLA_COMPILE_SECONDS = DEVICE_METRICS.histogram(
+    "solver_device_xla_compile_seconds",
+    "Backend compile wall-clock per XLA compilation (jax.monitoring)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+)
+DEVICE_PADDING_WASTE = DEVICE_METRICS.gauge(
+    "solver_device_padding_waste_ratio",
+    "1 - real rows / padded rows per shape-bucketed buffer (pod_batch, "
+    "resv_table, dirty_rows, coalesced_pods) — the device time burned "
+    "on bucket padding, updated at stage time",
+    label_names=("buffer",),
+)
+DEVICE_LIVE_BUFFERS = DEVICE_METRICS.gauge(
+    "solver_device_live_buffers",
+    "Live jax arrays in this process (jax.live_arrays(), sampled on "
+    "status/debug reads — never on the solve path)",
+)
+DEVICE_LIVE_BYTES = DEVICE_METRICS.gauge(
+    "solver_device_live_bytes",
+    "Total bytes of live jax arrays (metadata sum; no device sync)",
+)
+DEVICE_PROFILE_WINDOWS = DEVICE_METRICS.counter(
+    "solver_device_profile_windows_total",
+    "On-demand jax profiler windows, by outcome",
+    label_names=("result",),  # written | error | rate-limited | refused
+)
+
 # -- koordlet (pkg/koordlet/metrics: internal + external sets) --------------
 
 KOORDLET_INTERNAL_METRICS = Registry("koordlet-internal")

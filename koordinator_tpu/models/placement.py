@@ -23,6 +23,7 @@ import numpy as np
 from koordinator_tpu.apis.extension import NUM_RESOURCES
 from koordinator_tpu.apis.types import ClusterSnapshot, GangMode
 from koordinator_tpu.models.finegrained import FineGrained
+from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.ops.binpack import (
     STAGED_NODE_FIELDS,
@@ -207,10 +208,14 @@ class InFlightSchedule:
         result = self.result
         n_real = self.n_real
         t_readback = time.perf_counter()
-        assignments = np.asarray(result.assign)[:n_real]
-        commit = np.asarray(result.commit)[:n_real]
-        waiting = np.asarray(result.waiting)[:n_real]
-        rejected = np.asarray(result.rejected)[:n_real]
+        # the annotate scope names this transfer in an active profiler
+        # window with the same label the span below carries — host
+        # trace and device profile line up in Perfetto (§17)
+        with DEVICE_OBS.annotate("read_back"):
+            assignments = np.asarray(result.assign)[:n_real]
+            commit = np.asarray(result.commit)[:n_real]
+            waiting = np.asarray(result.waiting)[:n_real]
+            rejected = np.asarray(result.rejected)[:n_real]
         t_done = time.perf_counter()
         # solve wall: dispatch -> materialized (includes any overlap
         # window the pipelined loop spent elsewhere — by design, this
@@ -551,6 +556,24 @@ class StagedStateCache:
             if self._pinned is state:
                 self._pinned = None
 
+    def device_bytes(self) -> int:
+        """Metadata-summed bytes of the staged device generations this
+        cache currently holds (current + a pinned in-flight one) — the
+        observatory's per-owner live-buffer attribution. No sync:
+        ``nbytes`` is shape metadata."""
+        with self._lock:
+            generations = [self.state]
+            if self._pinned is not None and self._pinned is not self.state:
+                generations.append(self._pinned)
+        total = 0
+        for gen in generations:
+            if gen is None:
+                continue
+            total += sum(
+                getattr(a, "nbytes", 0) for a in gen if a is not None
+            )
+        return total
+
     def audit_view(self):
         """A consistent view of the staged world for the runtime
         auditor's parity probe: ``(arrays, state, tracker, seen_epoch,
@@ -662,13 +685,29 @@ class PlacementModel:
         from koordinator_tpu.ops.pallas_binpack import pallas_supported
 
         self._pallas_eligible = pallas_supported(self.params, self.config)
-        self._solve = jax.jit(
+        #: the DEVICE_OBS wrapper records compile count/wall/signature
+        #: per solve variant (docs/DESIGN.md §17) — call-transparent,
+        #: and graftcheck still treats the binding as a jit factory
+        self._solve = DEVICE_OBS.jit("solve_batch", jax.jit(
             solve_batch, static_argnames=("config",), donate_argnums=()
-        )
+        ))
         #: device-resident staging reused across schedule() calls when
         #: the snapshot carries a ClusterDeltaTracker (steady-state
         #: ticks re-lower + re-upload only the dirty node rows)
         self.staged_cache = StagedStateCache(self)
+        # live-buffer attribution: the observatory's snapshot reports
+        # how much of the process's device memory IS the staged world.
+        # Registered through a weakref: the process-global observatory
+        # must never pin a torn-down model's staged generations alive
+        import weakref
+
+        cache_ref = weakref.ref(self.staged_cache)
+
+        def _staged_bytes():
+            cache = cache_ref()
+            return 0 if cache is None else cache.device_bytes()
+
+        DEVICE_OBS.register_owner("staged_cache", _staged_bytes)
         #: cached [Vp,Np] reservation→node one-hot for the kernel's
         #: credit matmul — depends only on the (padded) reservation node
         #: table, so repeat solves against a static table reuse it
@@ -778,9 +817,10 @@ class PlacementModel:
         if getattr(snapshot, "delta_tracker", None) is None:
             return None
         t0 = time.perf_counter()
-        _, _, times, _ = self.staged_cache.ensure(
-            snapshot, want_device=not self._numa_staging
-        )
+        with DEVICE_OBS.annotate("prestage"):
+            _, _, times, _ = self.staged_cache.ensure(
+                snapshot, want_device=not self._numa_staging
+            )
         # the overlap window's signature span: in a pipelined run this
         # slice visibly crosses the publisher track's device_solve span
         TRACER.emit("prestage", cat="stage", t0=t0,
@@ -1077,17 +1117,18 @@ class PlacementModel:
         applied: List[tuple] = []  # (idx, node_name, CycleState)
         iteration = 0
         while True:
-            result = self._dispatch_solve(
-                state,
-                batch,
-                quota_state,
-                gang_state,
-                extras,
-                resv_arrays,
-                numa_aux,
-                resv_kernel_safe=resv_kernel_safe,
-                resv_onehot=resv_onehot,
-            )
+            with DEVICE_OBS.annotate("device_solve"):
+                result = self._dispatch_solve(
+                    state,
+                    batch,
+                    quota_state,
+                    gang_state,
+                    extras,
+                    resv_arrays,
+                    numa_aux,
+                    resv_kernel_safe=resv_kernel_safe,
+                    resv_onehot=resv_onehot,
+                )
             if not specials:
                 break
             raw = np.asarray(result.raw_assign)
@@ -1283,6 +1324,7 @@ class PlacementModel:
         dummies (assignment -1, no accounting) — identical semantics, one
         compiled program per bucket."""
         target = self.pod_bucket(n_real)
+        DEVICE_OBS.note_padding("pod_batch", n_real, target)
         if target == n_real:
             return batch, extras, resv
         pad = target - n_real
@@ -1320,6 +1362,7 @@ class PlacementModel:
         compiled program per bucket."""
         v = int(resv.node.shape[0])
         target = self.resv_bucket(v)
+        DEVICE_OBS.note_padding("resv_table", v, target)
         if target == v:
             return resv
         pad = target - v

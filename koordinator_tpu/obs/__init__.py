@@ -16,17 +16,29 @@ Four surfaces, all off the solve's device path:
   score breakdown (per-node, per-feature-column scores + filter
   verdicts, oracle-parity-checked) answering "why did pod X land on
   node Y / why is it unschedulable" from the debug mux.
+- ``obs.device``   — the device-cost observatory (docs/DESIGN.md §17):
+  compile telemetry at the hot jit callsites, lazy XLA cost/memory
+  analysis per solve variant, padding-waste and live-buffer gauges,
+  and on-demand ``jax.profiler`` windows served from the debug mux.
 """
 
+from koordinator_tpu.obs.device import (
+    DEVICE_OBS,
+    DeviceObservatory,
+    device_observatory_supported,
+)
 from koordinator_tpu.obs.flight import FLIGHT, FlightRecorder
 from koordinator_tpu.obs.timeline import PodTimelines, lane_of
 from koordinator_tpu.obs.trace import TRACER, SpanTracer
 
 __all__ = [
+    "DEVICE_OBS",
+    "DeviceObservatory",
     "FLIGHT",
     "FlightRecorder",
     "PodTimelines",
     "SpanTracer",
     "TRACER",
+    "device_observatory_supported",
     "lane_of",
 ]

@@ -1,0 +1,830 @@
+"""DeviceObservatory: the device-side twin of the span tracer.
+
+The trace fabric (docs/DESIGN.md §16) made the *host* side of every
+round observable; the device solve stayed a black box — we knew
+``solve_s``, not why. This module closes that gap with four surfaces,
+all capability-gated and all off the solve's critical path:
+
+- **Compile telemetry.** The hot jit callsites (models/placement.py,
+  ops/binpack.py, service/server.py, service/admission.py,
+  service/failover.py, parallel/mesh.py) wrap their callables in
+  :meth:`DeviceObservatory.jit`: a signature-miss call is timed and
+  recorded — count, wall, and the triggering shape signature — into
+  ``solver_device_compile_total{fn}`` / ``solver_device_compile_seconds``
+  and a bounded ring served at ``/debug/device``. A process-wide
+  ``jax.monitoring`` listener additionally counts EVERY backend
+  compilation (``solver_device_xla_compiles_total``), attributed or
+  not. Together they turn graftcheck's boolean zero-recompile guard
+  into a quantitative, always-on counter.
+- **Cost & memory analysis.** Each observed compile registers its
+  abstract signature (``jax.ShapeDtypeStruct`` pytree, statics by
+  value). :meth:`analyze` later re-lowers FROM THOSE AVALS —
+  ``fn.lower(*avals).compile().cost_analysis()`` / ``memory_analysis()``
+  — so FLOPs, bytes accessed, and argument/output/temp/peak bytes per
+  jitted solve variant come without ever touching live (possibly
+  donated) buffers. Analysis is lazy and memoized: it runs on debug
+  reads, bench fingerprints, and flight dumps — never per tick — and
+  each analysis costs one extra backend compile, counted like any
+  other.
+- **Padding waste + live buffers.** The pow2/bucket shape paddings
+  (pod batches, reservation tables, dirty-row scatters, admission
+  coalescing) report real vs padded rows at stage time into
+  ``solver_device_padding_waste_ratio{buffer}`` — the number that says
+  when bucketing is burning device time. ``jax.live_arrays()``
+  count/bytes (plus registered per-owner accounting, e.g. the staged
+  state cache) are sampled on status/debug reads only.
+- **On-demand profiler windows.** :meth:`request_profile` arms a
+  window; the next K scheduling rounds (``on_round`` is called by
+  ``Scheduler.begin_tick`` and the sidecar's ``solve_from_request``)
+  run under ``jax.profiler.start_trace``/``stop_trace`` with
+  :meth:`annotate` scopes matching the span tracer's stage names, so
+  the Perfetto host trace and the device profile line up. Windows are
+  rate-limited and disk-capped like the flight recorder.
+
+The tick contract mirrors the tracer's: the observatory enabled vs
+disabled is observation only — same placements, bit for bit (bench leg
+13 proves it every run, paired, with the measured overhead <= 0.02).
+Old-jax boxes degrade to loud skips through
+:func:`device_observatory_supported`, the same shape as
+``parallel.mesh.distributed_kernel_supported``.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+from koordinator_tpu.metrics.components import (
+    DEVICE_COMPILES,
+    DEVICE_COMPILE_SECONDS,
+    DEVICE_LIVE_BUFFERS,
+    DEVICE_LIVE_BYTES,
+    DEVICE_PADDING_WASTE,
+    DEVICE_PROFILE_WINDOWS,
+    DEVICE_XLA_COMPILES,
+    DEVICE_XLA_COMPILE_SECONDS,
+)
+from koordinator_tpu.obs.trace import TRACER
+
+#: compile records kept for /debug/device and flight dumps
+_RING_CAPACITY = 256
+#: analyses memoized per (fn, signature); oldest evicted beyond this
+_MAX_ANALYSES = 64
+#: un-analyzed signatures queued for the next analyze() pass
+_MAX_PENDING = 64
+
+_NULL_CTX = nullcontext()
+
+#: process-wide guard: the jax.monitoring listener is registered at
+#: most once (jax exposes no public unregister)
+_MONITOR_INSTALLED = [False]
+
+
+# -- capability gates --------------------------------------------------------
+
+def _analysis_supported() -> bool:
+    """Whether this jax build exposes AOT cost/memory analysis
+    (``jax.stages.Compiled.cost_analysis``/``memory_analysis``) and
+    aval lowering — jax 0.4.3x does; older builds degrade loudly."""
+    compiled = getattr(getattr(jax, "stages", None), "Compiled", None)
+    return (
+        compiled is not None
+        and hasattr(compiled, "cost_analysis")
+        and hasattr(compiled, "memory_analysis")
+        and hasattr(jax, "ShapeDtypeStruct")
+    )
+
+
+def _monitoring_supported() -> bool:
+    return hasattr(
+        getattr(jax, "monitoring", None),
+        "register_event_duration_secs_listener",
+    )
+
+
+def _profiler_supported() -> bool:
+    prof = getattr(jax, "profiler", None)
+    return (
+        prof is not None
+        and hasattr(prof, "start_trace")
+        and hasattr(prof, "stop_trace")
+    )
+
+
+def device_observatory_supported() -> bool:
+    """Whether the analysis half of the observatory can run on this jax
+    build. Compile COUNTING and padding gauges are pure python and work
+    everywhere; cost/memory analysis needs the AOT stages API. Callers
+    (and tests) treat False as a loud skip, exactly like
+    ``distributed_kernel_supported()``."""
+    return _analysis_supported()
+
+
+def _default_profile_dir() -> str:
+    return os.environ.get(
+        "KTPU_PROFILE_DIR",
+        os.path.join(tempfile.gettempdir(), "koord-profile"),
+    )
+
+
+# -- signatures --------------------------------------------------------------
+
+def _leaf_aval(x):
+    """An array leaf becomes its abstract signature; static scalars and
+    None pass through by value (they ARE part of the program identity
+    for static args). The aval branch matters for donated arguments:
+    a donated buffer is deleted by the time the post-call recording
+    runs, but its aval metadata survives."""
+    aval = getattr(x, "aval", None)
+    if aval is not None and hasattr(aval, "shape"):
+        return jax.ShapeDtypeStruct(tuple(aval.shape), aval.dtype)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+    return x
+
+
+def _leaf_sig(x):
+    # the aval fast path matters: str(dtype) on a jax Array costs ~3µs
+    # a leaf and this runs per instrumented call — dtype OBJECTS are
+    # hashable and compare equal across numpy/jax, so keep them raw
+    aval = getattr(x, "aval", None)
+    if aval is not None:
+        return (aval.shape, aval.dtype)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), dtype)
+    return x
+
+
+def _signature(args, kwargs) -> Tuple:
+    """Hashable shape signature of one call: pytree structure + per-leaf
+    (shape, dtype), statics by value. One tree_flatten (~µs at solve
+    arity) — the only per-call cost of compile telemetry."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (treedef, tuple(_leaf_sig(x) for x in leaves))
+
+
+def _sig_str(sig) -> str:
+    """Compact human form of a signature for the debug ring."""
+    parts = []
+    for leaf in sig[1]:
+        if isinstance(leaf, tuple) and len(leaf) == 2 and isinstance(
+            leaf[0], tuple
+        ):
+            shape, dtype = leaf
+            parts.append("x".join(map(str, shape)) + ":" + str(dtype))
+    return ",".join(parts[:12]) + ("..." if len(parts) > 12 else "")
+
+
+def _cost_dict(ca) -> Dict[str, float]:
+    """Normalize ``cost_analysis()`` across jax versions (list-of-dict
+    in 0.4.x, dict later) to the two headline numbers."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+    }
+
+
+def _memory_dict(ma) -> Dict[str, object]:
+    """Normalize ``memory_analysis()`` (CompiledMemoryStats; None on
+    backends that don't report). ``peak_bytes`` uses the backend's
+    peak-buffer stat when present, else the argument+output+temp+alias
+    footprint — the staged-residency proxy the bench gate regresses."""
+    if ma is None:
+        return {"available": False, "argument_bytes": 0, "output_bytes": 0,
+                "temp_bytes": 0, "peak_bytes": 0}
+    arg = int(getattr(ma, "argument_size_in_bytes", 0))
+    out = int(getattr(ma, "output_size_in_bytes", 0))
+    temp = int(getattr(ma, "temp_size_in_bytes", 0))
+    alias = int(getattr(ma, "alias_size_in_bytes", 0))
+    peak = getattr(ma, "peak_buffer_size_in_bytes", None)
+    return {
+        "available": True,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "peak_bytes": int(peak) if peak else arg + out + temp + alias,
+    }
+
+
+class ObservedJit:
+    """A jit-compiled callable with compile telemetry.
+
+    The steady-state cost is two reads of the jit's own C++ cache size
+    (~0.1 µs each) and two clock reads: a call that did not grow the
+    cache touched nothing else. When the cache DID grow, the call is
+    recorded as a compile — count, wall (trace + lower + XLA compile +
+    dispatch; no blocking read-back is added to measure it), and the
+    triggering shape signature, computed AFTER the fact from the
+    arguments' avals (aval metadata survives donation, so donated
+    buffers are safe to sign post-call). Callables without a cache-size
+    API fall back to a per-call signature probe. The wrapper holds no
+    mutable state of its own — everything lives in the observatory
+    under its lock."""
+
+    __slots__ = ("fn_name", "_fn", "_obs", "_size_fn")
+
+    def __init__(self, fn_name: str, fn, obs: "DeviceObservatory"):
+        self.fn_name = fn_name
+        self._fn = fn
+        self._obs = obs
+        self._size_fn = getattr(fn, "_cache_size", None)
+
+    def __call__(self, *args, **kwargs):
+        obs = self._obs
+        if not obs.enabled:
+            return self._fn(*args, **kwargs)
+        size_fn = self._size_fn
+        if size_fn is None:
+            # fallback probe: dedup by signature alone (no cross-check
+            # available — a warm program re-probed counts once)
+            sig = _signature(args, kwargs)
+            if obs._seen_signature(self.fn_name, sig):
+                return self._fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            obs._record_compile(self.fn_name, self._fn, args, kwargs,
+                                time.perf_counter() - t0, sig=sig)
+            return out
+        before = size_fn()
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        after = size_fn()
+        if after != before:
+            obs._record_compile(self.fn_name, self._fn, args, kwargs,
+                                wall, cache_size=after,
+                                cache_size_before=before)
+        return out
+
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+
+class DeviceObservatory:
+    """Process-global device telemetry (one per process, like the span
+    tracer and the metric registries).
+
+    ``enabled`` is a plain flag read without the lock (a torn read
+    costs at most one unrecorded compile), and ``_profile_hot`` is the
+    matching fast-path flag for :meth:`on_round`; every other mutable
+    attribute below is mapped to ``_lock`` in graftcheck's
+    lock-discipline registry. Slow work — XLA compiles for analysis,
+    profiler start/stop I/O — always runs OUTSIDE the lock."""
+
+    def __init__(self, clock=time.monotonic,
+                 install_monitoring: bool = False):
+        self.enabled = True
+        #: fast-path gate for on_round(): True only while a profile
+        #: window is armed or active (plain flag, same contract as
+        #: ``enabled``)
+        self._profile_hot = False
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: serializes profiler window transitions END TO END (decision
+        #: + jax.profiler I/O): round boundaries land concurrently on
+        #: sidecar handler threads, and without this a preempted
+        #: starter could run start_trace AFTER another thread already
+        #: took (and failed) the matching stop — an open trace no
+        #: on_round would ever close. Lock order: _profile_io_lock
+        #: OUTER, _lock inner; never the reverse.
+        self._profile_io_lock = threading.Lock()
+        #: (fn_name, signature) pairs already probed
+        self._seen: set = set()
+        #: id(jit fn) -> high-water cache size at the last recorded
+        #: compile — dedups the concurrent-cold-call race (two threads
+        #: both see the one shared compile grow the cache; only one
+        #: records). A pre-call size BELOW the mark means the cache was
+        #: cleared since (jax.clear_caches), which resets the mark so
+        #: the real recompile still counts.
+        self._fn_cache_sizes: Dict[int, int] = {}
+        #: newest-last compile records {seq, fn, at, compile_s, shape}
+        self._ring: deque = deque(maxlen=_RING_CAPACITY)
+        #: (fn_name, sig) -> (fn, aval_args, aval_kwargs) awaiting
+        #: analysis; bounded — beyond _MAX_PENDING new variants are
+        #: counted but not queued
+        self._pending: Dict = {}
+        #: (fn_name, sig) -> {"cost": ..., "memory": ...} | {"error": ...}
+        self._analyses: Dict = {}
+        self._analysis_order: deque = deque()
+        #: buffer -> {"real", "padded", "waste"} (stage-time updates)
+        self._padding: Dict[str, Dict] = {}
+        #: owner name -> callable() -> bytes (live-buffer attribution)
+        self._owners: Dict[str, object] = {}
+        self._seq = 0
+        self._compiles_total = 0
+        self._xla_compiles = 0
+        self._xla_compile_s = 0.0
+        #: profiler window state machine
+        self._profile_dir: Optional[str] = None
+        self._profile_min_interval_s = 30.0
+        self._profile_max_windows = 8
+        self._profile_armed = 0       # rounds requested, not yet started
+        self._profile_remaining = 0   # rounds left in the active window
+        self._profile_path: Optional[str] = None
+        self._profile_last_at: Optional[float] = None
+        self._profile_windows: List[str] = []
+        self._profile_error: Optional[str] = None
+        if install_monitoring and _monitoring_supported() \
+                and not _MONITOR_INSTALLED[0]:
+            # every backend compilation in the process, attributed or
+            # not — the listener is a counter bump. Installed ONCE per
+            # process, for the DEVICE_OBS singleton only: jax offers no
+            # public unregister, so a listener pins its observatory for
+            # the process lifetime and a second one would double-count
+            # the shared DEVICE_XLA_* metrics (ad-hoc instances in
+            # tests keep wrapper-based counting, not the listener)
+            _MONITOR_INSTALLED[0] = True
+            jax.monitoring.register_event_duration_secs_listener(
+                self._on_monitoring_event
+            )
+
+    # -- configuration -------------------------------------------------------
+
+    def set_enabled(self, on: bool) -> None:
+        self.enabled = bool(on)
+
+    def configure(self, profile_dir: Optional[str] = None,
+                  profile_min_interval_s: Optional[float] = None,
+                  profile_max_windows: Optional[int] = None) -> None:
+        """Runtime configuration (cmd flags / tests)."""
+        with self._lock:
+            if profile_dir is not None:
+                self._profile_dir = profile_dir
+            if profile_min_interval_s is not None:
+                self._profile_min_interval_s = profile_min_interval_s
+            if profile_max_windows is not None:
+                self._profile_max_windows = profile_max_windows
+
+    def jit(self, fn_name: str, fn) -> ObservedJit:
+        """Wrap a jit-compiled callable with compile telemetry. The
+        binding idiom is ``X = DEVICE_OBS.jit("name", jax.jit(f, ...))``
+        — graftcheck recognizes an instrumentation wrapper over a jit
+        factory as a jit factory, so ``X`` stays a device-value
+        producer for the host-sync taint analysis."""
+        return ObservedJit(fn_name, fn, self)
+
+    def register_owner(self, name: str, nbytes_fn) -> None:
+        """Attribute live-buffer bytes to a named owner (e.g. the
+        staged state cache registers a callable summing its device
+        arrays' nbytes — metadata only, no sync). Last registration
+        per name wins."""
+        with self._lock:
+            self._owners[name] = nbytes_fn
+
+    # -- compile telemetry ---------------------------------------------------
+
+    def _on_monitoring_event(self, name: str, dur: float, **kw) -> None:
+        if not name.endswith("backend_compile_duration") and \
+                not name.endswith("backend_compile_time_sec"):
+            return
+        with self._lock:
+            self._xla_compiles += 1
+            self._xla_compile_s += dur
+        DEVICE_XLA_COMPILES.inc()
+        DEVICE_XLA_COMPILE_SECONDS.observe(dur)
+
+    def _seen_signature(self, fn_name: str, sig) -> bool:
+        with self._lock:
+            return (fn_name, sig) in self._seen
+
+    def _record_compile(self, fn_name: str, fn, args, kwargs,
+                        wall: float, sig=None,
+                        cache_size: Optional[int] = None,
+                        cache_size_before: Optional[int] = None) -> None:
+        """A call grew its jit cache (or missed the fallback probe):
+        record the compile. The signature is computed HERE, off the
+        steady-state path, from aval metadata (safe after donation).
+        Every cache-growth event counts — a post-``jax.clear_caches``
+        recompile of a known shape is a real compile (the pre-call size
+        dropping below the high-water mark resets the mark) — but
+        analysis is registered once per distinct signature. The
+        high-water dedup handles concurrent cold callers: two threads
+        racing ONE shared compile both observe the same post-call
+        size, and only the first records (the loser's wall was lock
+        wait, not compile time). Two DISTINCT signatures compiling
+        truly simultaneously may dedup to one per-fn record — a
+        documented undercount; the process-wide monitoring counter
+        stays exact."""
+        if sig is None:
+            sig = _signature(args, kwargs)
+        avals = None
+        if _analysis_supported():
+            try:
+                avals = jax.tree_util.tree_map(_leaf_aval, (args, kwargs))
+            except Exception:
+                avals = None
+        with self._lock:
+            if cache_size is not None:
+                mark = self._fn_cache_sizes.get(id(fn))
+                if mark is not None and cache_size_before is not None \
+                        and cache_size_before < mark:
+                    mark = cache_size_before  # cache cleared since
+                if mark is not None and cache_size <= mark:
+                    return  # the racing winner already recorded this
+                self._fn_cache_sizes[id(fn)] = cache_size
+            unseen = (fn_name, sig) not in self._seen
+            self._seen.add((fn_name, sig))
+            if unseen and avals is not None \
+                    and len(self._pending) < _MAX_PENDING:
+                self._pending[(fn_name, sig)] = (fn, avals[0], avals[1])
+            self._seq += 1
+            self._compiles_total += 1
+            self._ring.append({
+                "seq": self._seq,
+                "fn": fn_name,
+                "at": time.time(),
+                "compile_s": wall,
+                "shape": _sig_str(sig),
+                "key": (fn_name, sig),
+            })
+        DEVICE_COMPILES.inc({"fn": fn_name})
+        DEVICE_COMPILE_SECONDS.observe(wall, {"fn": fn_name})
+        TRACER.instant("device-compile", cat="device",
+                       args={"fn": fn_name, "compile_s": round(wall, 4)})
+
+    # -- cost & memory analysis ----------------------------------------------
+
+    def analyze(self, max_variants: Optional[int] = None) -> List[dict]:
+        """Run the pending cost/memory analyses (lazy, memoized): each
+        un-analyzed compile signature is re-lowered from its recorded
+        avals and AOT-compiled once — one extra backend compile per
+        variant, on demand (debug reads, bench fingerprints), never on
+        the tick path. Returns the analyses produced by THIS call;
+        loud no-op (``[]``) on jax builds without the AOT stages API."""
+        if not _analysis_supported():
+            return []
+        with self._lock:
+            items = list(self._pending.items())
+            if max_variants is not None:
+                items = items[:max_variants]
+            for key, _ in items:
+                self._pending.pop(key, None)
+        produced = []
+        for (fn_name, sig), (fn, aval_args, aval_kwargs) in items:
+            try:
+                compiled = fn.lower(*aval_args, **aval_kwargs).compile()
+                entry = {
+                    "fn": fn_name,
+                    "shape": _sig_str(sig),
+                    "cost": _cost_dict(compiled.cost_analysis()),
+                    "memory": _memory_dict(compiled.memory_analysis()),
+                }
+            except Exception as e:
+                entry = {
+                    "fn": fn_name,
+                    "shape": _sig_str(sig),
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            produced.append(entry)
+            with self._lock:
+                self._analyses[(fn_name, sig)] = entry
+                self._analysis_order.append((fn_name, sig))
+                while len(self._analysis_order) > _MAX_ANALYSES:
+                    self._analyses.pop(self._analysis_order.popleft(),
+                                       None)
+        return produced
+
+    # -- padding waste -------------------------------------------------------
+
+    def note_padding(self, buffer: str, real: int, padded: int) -> None:
+        """A shape-bucketed staging just padded ``real`` rows up to
+        ``padded`` — update the per-buffer waste gauge (called at stage
+        time by _pad_pods/_pad_resv/bucket_row_update/solve_coalesced;
+        cost is one lock + one gauge set)."""
+        if not self.enabled:
+            return
+        real = int(real)
+        padded = max(int(padded), 1)
+        with self._lock:
+            prev = self._padding.get(buffer)
+            if prev is not None and prev["real"] == real \
+                    and prev["padded"] == padded:
+                return  # steady state: same bucket fill, nothing to move
+            waste = 1.0 - min(real, padded) / padded
+            self._padding[buffer] = {
+                "real": real, "padded": padded, "waste": waste,
+            }
+        DEVICE_PADDING_WASTE.set(waste, {"buffer": buffer})
+
+    # -- live buffers --------------------------------------------------------
+
+    def live_snapshot(self) -> dict:
+        """Live jax arrays right now: count and metadata-summed bytes,
+        plus registered per-owner attribution. Sampled on status/debug
+        reads only — iterating the live set is O(arrays) and has no
+        business on the tick path."""
+        try:
+            arrays = jax.live_arrays()
+            count = len(arrays)
+            total = int(sum(getattr(a, "nbytes", 0) for a in arrays))
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        with self._lock:
+            owners = dict(self._owners)
+        by_owner = {}
+        for name, fn in owners.items():
+            try:
+                by_owner[name] = int(fn())
+            except Exception as e:
+                by_owner[name] = f"{type(e).__name__}: {e}"
+        DEVICE_LIVE_BUFFERS.set(count)
+        DEVICE_LIVE_BYTES.set(total)
+        return {"count": count, "bytes": total, "owners": by_owner}
+
+    # -- profiler windows ----------------------------------------------------
+
+    def request_profile(self, rounds: int = 8) -> dict:
+        """Arm a profiler window over the next ``rounds`` scheduling
+        rounds (the debug-mux ``/debug/profile?rounds=K`` handler).
+        Refused while a window is armed/active; rate-limited between
+        windows; window directories are disk-capped (oldest pruned)
+        like the flight recorder's dumps."""
+        if not _profiler_supported():
+            DEVICE_PROFILE_WINDOWS.inc({"result": "refused"})
+            # ``unsupported`` distinguishes a permanent refusal from
+            # rate-limiting: the mux answers 501, not a retryable 429
+            return {"error": "jax.profiler unavailable on this build",
+                    "unsupported": True}
+        rounds = max(1, int(rounds))
+        now = self._clock()
+        with self._lock:
+            if self._profile_armed or self._profile_remaining:
+                DEVICE_PROFILE_WINDOWS.inc({"result": "refused"})
+                return {"error": "profile window already armed/active"}
+            last = self._profile_last_at
+            if last is not None and \
+                    now - last < self._profile_min_interval_s:
+                DEVICE_PROFILE_WINDOWS.inc({"result": "rate-limited"})
+                return {
+                    "error": "rate-limited",
+                    "retry_in_s": self._profile_min_interval_s
+                    - (now - last),
+                }
+            self._profile_last_at = now
+            self._profile_armed = rounds
+            self._profile_error = None
+            target = self._profile_dir or _default_profile_dir()
+        self._profile_hot = True
+        return {"armed": True, "rounds": rounds, "dir": target}
+
+    def on_round(self) -> None:
+        """Round boundary hook (Scheduler.begin_tick; the sidecar calls
+        it per solve): drives the armed→active→closed profile window.
+        One plain-flag read when no window is in play."""
+        if not self._profile_hot:
+            return
+        # window transitions are serialized end to end (decision + the
+        # profiler I/O) so concurrent round boundaries (sidecar handler
+        # threads) can never run a stop before its matching start lands
+        with self._profile_io_lock:
+            self._window_transition()
+
+    def _window_transition(self) -> None:
+        action = None
+        with self._lock:
+            if self._profile_armed:
+                self._seq += 1
+                path = os.path.join(
+                    self._profile_dir or _default_profile_dir(),
+                    f"window-{self._seq:04d}",
+                )
+                self._profile_remaining = self._profile_armed
+                self._profile_armed = 0
+                self._profile_path = path
+                action = ("start", path)
+            elif self._profile_remaining > 1:
+                self._profile_remaining -= 1
+            elif self._profile_remaining == 1:
+                self._profile_remaining = 0
+                path = self._profile_path
+                self._profile_path = None
+                self._profile_hot = False
+                action = ("stop", path)
+        if action is None:
+            return
+        kind, arg = action
+        try:
+            if kind == "start":
+                os.makedirs(arg, exist_ok=True)
+                jax.profiler.start_trace(arg)
+                TRACER.instant("profile-window-open", cat="device",
+                               args={"dir": arg})
+            else:
+                jax.profiler.stop_trace()
+                TRACER.instant("profile-window-closed", cat="device")
+                DEVICE_PROFILE_WINDOWS.inc({"result": "written"})
+                # track + disk-cap ONLY after a successful stop: a
+                # failed stop must neither list a broken window as
+                # written nor pop an old path it never got to prune
+                pruned = None
+                with self._lock:
+                    self._profile_windows.append(arg)
+                    if len(self._profile_windows) > \
+                            self._profile_max_windows:
+                        pruned = self._profile_windows.pop(0)
+                if pruned is not None:
+                    import shutil
+
+                    shutil.rmtree(pruned, ignore_errors=True)
+        except Exception as e:  # observability must never crash a round
+            DEVICE_PROFILE_WINDOWS.inc({"result": "error"})
+            with self._lock:
+                self._profile_error = f"{type(e).__name__}: {e}"
+                self._profile_armed = 0
+                self._profile_remaining = 0
+                self._profile_path = None
+            self._profile_hot = False
+
+    def annotate(self, name: str):
+        """A ``jax.profiler.TraceAnnotation`` scope while a profile
+        window is active (so device events line up with the span
+        tracer's stage names in Perfetto) — a shared null context
+        otherwise: one flag read on the hot path."""
+        if self._profile_hot:
+            ann = getattr(jax.profiler, "TraceAnnotation", None) \
+                if _profiler_supported() else None
+            if ann is not None:
+                return ann(f"ktpu:{name}")
+        return _NULL_CTX
+
+    # -- read side -----------------------------------------------------------
+
+    def status(self) -> dict:
+        """Cheap snapshot for plugin/status surfaces: counters, recent
+        compiles, padding, CACHED analyses only — no compiles, no
+        live-array walk beyond one pass."""
+        with self._lock:
+            ring = [
+                {k: v for k, v in r.items() if k != "key"}
+                for r in self._ring
+            ]
+            analyses = [
+                dict(self._analyses[k]) for k in self._analysis_order
+                if k in self._analyses
+            ]
+            payload = {
+                "enabled": self.enabled,
+                "supported": device_observatory_supported(),
+                "compiles_total": self._compiles_total,
+                "xla_compiles_total": self._xla_compiles,
+                "xla_compile_seconds_total": self._xla_compile_s,
+                "pending_analyses": len(self._pending),
+                "recent_compiles": ring,
+                "analyses": analyses,
+                "padding": {k: dict(v) for k, v in self._padding.items()},
+                "profile": {
+                    "dir": self._profile_dir or _default_profile_dir(),
+                    "armed_rounds": self._profile_armed,
+                    "active_rounds_left": self._profile_remaining,
+                    "windows": list(self._profile_windows),
+                    "min_interval_s": self._profile_min_interval_s,
+                    "last_error": self._profile_error,
+                },
+            }
+        payload["live"] = self.live_snapshot()
+        return payload
+
+    def debug_payload(self) -> dict:
+        """The ``/debug/device`` body: :meth:`status` with pending
+        analyses materialized first (a debug GET may pay the on-demand
+        analysis compiles; the tick path never does)."""
+        self.analyze()
+        return self.status()
+
+    def flight_payload(self) -> dict:
+        """The flight recorder's ``device`` section: cached-only (a
+        dump must not compile anything) — did we just recompile, what
+        did the last variants cost, how much is live."""
+        with self._lock:
+            ring = [
+                {k: v for k, v in r.items() if k != "key"}
+                for r in list(self._ring)[-16:]
+            ]
+            analyses = [
+                dict(self._analyses[k])
+                for k in list(self._analysis_order)[-8:]
+                if k in self._analyses
+            ]
+            payload = {
+                "compiles_total": self._compiles_total,
+                "xla_compiles_total": self._xla_compiles,
+                "recent_compiles": ring,
+                "analyses": analyses,
+                "padding": {k: dict(v) for k, v in self._padding.items()},
+            }
+        payload["live"] = self.live_snapshot()
+        return payload
+
+    # -- bench fingerprinting ------------------------------------------------
+
+    def mark(self) -> dict:
+        """A point-in-time marker for :meth:`fingerprint` deltas."""
+        with self._lock:
+            return {
+                "seq": self._seq,
+                "compiles": self._compiles_total,
+                "xla_compiles": self._xla_compiles,
+                "xla_compile_s": self._xla_compile_s,
+            }
+
+    def fingerprint(self, mark: Optional[dict] = None) -> dict:
+        """The device fingerprint a bench leg records next to its
+        timings: compile counts/wall since ``mark``, the summed
+        FLOPs/bytes and max peak bytes of the variants compiled in that
+        window, the worst current padding-waste ratio, and a live-buffer
+        sample. Compile deltas are snapshotted BEFORE the on-demand
+        analysis pass so the analysis's own compiles never pollute the
+        leg they describe."""
+        mark = mark or {"seq": 0, "compiles": 0, "xla_compiles": 0,
+                        "xla_compile_s": 0.0}
+        with self._lock:
+            compiles = self._compiles_total - mark["compiles"]
+            xla = self._xla_compiles - mark["xla_compiles"]
+            xla_s = self._xla_compile_s - mark["xla_compile_s"]
+            keys = [
+                r["key"] for r in self._ring if r["seq"] > mark["seq"]
+            ]
+        self.analyze()
+        flops = 0.0
+        bytes_accessed = 0.0
+        peak = 0
+        with self._lock:
+            for key in keys:
+                entry = self._analyses.get(key)
+                if entry is None or "cost" not in entry:
+                    continue
+                flops += entry["cost"]["flops"]
+                bytes_accessed += entry["cost"]["bytes_accessed"]
+                peak = max(peak, entry["memory"]["peak_bytes"])
+            waste = max(
+                (v["waste"] for v in self._padding.values()), default=0.0
+            )
+        live = self.live_snapshot()
+        return {
+            "supported": device_observatory_supported(),
+            "compiles": compiles,
+            "xla_compiles": xla,
+            "xla_compile_s": xla_s,
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+            "peak_bytes": peak,
+            "padding_waste_ratio": waste,
+            "live_buffers": live.get("count", 0),
+            "live_bytes": live.get("bytes", 0),
+        }
+
+    def reset(self) -> None:
+        """Forget telemetry (tests). Counters restart; an ACTIVE
+        profiler window is stopped here — its state is being erased,
+        so the on_round stop path could never close it, and a trace
+        left open would make every later start_trace fail for the
+        process lifetime."""
+        with self._lock:
+            active = self._profile_path is not None
+            self._seen.clear()
+            self._fn_cache_sizes.clear()
+            self._ring.clear()
+            self._pending.clear()
+            self._analyses.clear()
+            self._analysis_order.clear()
+            self._padding.clear()
+            self._owners.clear()
+            self._seq = 0
+            self._compiles_total = 0
+            self._xla_compiles = 0
+            self._xla_compile_s = 0.0
+            self._profile_armed = 0
+            self._profile_remaining = 0
+            self._profile_path = None
+            self._profile_last_at = None
+            self._profile_windows.clear()
+            self._profile_error = None
+        self._profile_hot = False
+        if active and _profiler_supported():
+            # _lock released above: the io lock is only ever taken
+            # without _lock held (on_round nests them the other way)
+            with self._profile_io_lock:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+
+
+#: the process observatory every component records into (one per
+#: process, like the tracer and the flight recorder); only the
+#: singleton installs the process-wide compile listener
+DEVICE_OBS = DeviceObservatory(install_monitoring=True)

@@ -121,12 +121,24 @@ class FlightRecorder:
             dump_dir = self._dump_dir or _default_dump_dir()
         TRACER.instant("flight-dump", cat="flight",
                        args={"trigger": reason})
+        # the device-cost observatory's cached snapshot: "did we just
+        # recompile / run out of headroom" answered from the dump alone
+        # (cached analyses only — a dump never compiles; imported here
+        # rather than at module top to keep obs.device free to import
+        # the flight recorder in the future without a cycle)
+        from koordinator_tpu.obs.device import DEVICE_OBS
+
+        try:
+            device = DEVICE_OBS.flight_payload()
+        except Exception as e:  # a dump must land even if jax is upset
+            device = {"error": f"{type(e).__name__}: {e}"}
         payload = {
             "trigger": reason,
             "at": at,
             "detail": detail,
             "extra": extra,
             "rounds": rounds,
+            "device": device,
             "open_spans": TRACER.status()["open_marks"],
             "trace_tail": TRACER.events(tail=_TRACE_TAIL),
         }

@@ -45,6 +45,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.ops.common import reciprocal_for
 from koordinator_tpu.ops.fit import fit_filter, least_allocated_score
 from koordinator_tpu.ops.loadaware import loadaware_filter, loadaware_score
@@ -202,10 +203,11 @@ def scatter_node_rows(state: NodeState, idx, rows) -> NodeState:
 
 
 #: the jitted, input-donating form every staging cache shares (one
-#: compiled program per (N, D) shape pair)
-scatter_node_rows_donated = jax.jit(
+#: compiled program per (N, D) shape pair); the DEVICE_OBS wrapper adds
+#: compile telemetry (docs/DESIGN.md §17) and is call-transparent
+scatter_node_rows_donated = DEVICE_OBS.jit("scatter_node_rows_donated", jax.jit(
     scatter_node_rows, donate_argnums=(0,), static_argnums=()
-)
+))
 
 #: the non-donating twin: used by the staging cache while a dispatched
 #: solve still holds the current staged generation (the pipelined tick
@@ -213,9 +215,9 @@ scatter_node_rows_donated = jax.jit(
 #: live computation reads would hand XLA a license to clobber it, so
 #: the scatter writes a fresh generation instead and the pinned one
 #: stays immutable until the solve retires
-scatter_node_rows_copied = jax.jit(
+scatter_node_rows_copied = DEVICE_OBS.jit("scatter_node_rows_copied", jax.jit(
     scatter_node_rows, donate_argnums=(), static_argnums=()
-)
+))
 
 
 def bucket_row_update(idx, rows):
@@ -227,6 +229,7 @@ def bucket_row_update(idx, rows):
 
     d = int(idx.shape[0])
     target = max(8, 1 << (d - 1).bit_length())
+    DEVICE_OBS.note_padding("dirty_rows", d, target)
     if target == d:
         return idx, rows
     pad = target - d

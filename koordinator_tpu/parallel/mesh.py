@@ -18,6 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.ops.binpack import (
     NodeState,
     PodBatch,
@@ -132,12 +133,12 @@ def shard_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
     state_sh = NodeState(*([ns] * len(NodeState._fields)))
     pods_sh = PodBatch(*([rep] * len(PodBatch._fields)))
     params_sh = ScoreParams(*([rep] * len(ScoreParams._fields)))
-    return jax.jit(
+    return DEVICE_OBS.jit("shard_solver", jax.jit(
         partial(schedule_batch, config=config),
         in_shardings=(state_sh, pods_sh, params_sh),
         out_shardings=(state_sh, rep),
         static_argnums=(), donate_argnums=(),
-    )
+    ))
 
 
 def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
@@ -350,12 +351,12 @@ def shard_full_solver(mesh: Mesh, config: SolverConfig = SolverConfig()):
 
     ns = node_sharding(mesh)
     rep = replicated(mesh)
-    jit_full = jax.jit(
+    jit_full = DEVICE_OBS.jit("shard_full_solver", jax.jit(
         lambda s, p, pr, q, g, x, r, n: solve_batch(
             s, p, pr, config, q, g, extras=x, resv=r, numa=n
         ),
         static_argnums=(), donate_argnums=(),
-    )
+    ))
 
     def solve(state, pods, params, quota_state=None, gang_state=None,
               numa_aux=None, extras=None, resv=None):

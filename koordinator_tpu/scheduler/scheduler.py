@@ -39,6 +39,7 @@ from koordinator_tpu.scheduler.framework import (
 from koordinator_tpu.scheduler.reservation_controller import (
     ReservationController,
 )
+from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.obs.timeline import PodTimelines, lane_of
 from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.scheduler.monitor import (
@@ -478,6 +479,9 @@ class Scheduler:
         from koordinator_tpu.metrics.components import PENDING_PODS
 
         at0 = now if now is not None else time.time()
+        # device observatory round boundary: drives an armed profiler
+        # window over the next K rounds (one flag read when none is)
+        DEVICE_OBS.on_round()
         rid = TRACER.begin_round()
         # watchdog mark: stays open until commit_tick retires the round
         # (scheduler/monitor.py flags it if it never does)

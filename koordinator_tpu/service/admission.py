@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.apis.extension import QoSClass
+from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.metrics.components import (
     SOLVER_ADMISSION_BATCHES,
@@ -211,9 +212,9 @@ def _vmapped_plain_solve(state, pods, params, config):
 #: shape, shared by every gate in the process (static config hashes per
 #: value; nothing donated — the base is reused lane-to-lane and by
 #: later batches)
-_jit_coalesced = jax.jit(
+_jit_coalesced = DEVICE_OBS.jit("coalesced_solve", jax.jit(
     _vmapped_plain_solve, static_argnames=("config",), donate_argnums=()
-)
+))
 
 
 def solve_coalesced(
@@ -247,6 +248,11 @@ def solve_coalesced(
     )
     counts = [int(np.asarray(r.pods["req"]).shape[0]) for r in requests]
     bucket = max(8, 1 << max(0, max(counts) - 1).bit_length())
+    # the coalesced lane stack's bucket padding, reported like every
+    # other pow2 staging buffer (docs/DESIGN.md §17)
+    DEVICE_OBS.note_padding(
+        "coalesced_pods", sum(counts), len(requests) * bucket
+    )
     fields = sorted(set(head.pods) - {"blocked"})
     cols: Dict[str, np.ndarray] = {}
     for f in fields:

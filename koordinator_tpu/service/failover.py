@@ -46,6 +46,7 @@ from koordinator_tpu.metrics.components import (
     SOLVER_FAILOVERS,
     SOLVER_LOCAL_SOLVES,
 )
+from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.obs.flight import FLIGHT
 from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.ops.binpack import solve_batch
@@ -60,9 +61,9 @@ from koordinator_tpu.service.supervisor import connection_probe
 #: (service/server._jit_solve), compiled lazily on the first degraded
 #: solve so the healthy path never pays for it. Nothing is donated: the
 #: staged base is reused tick-to-tick by the staging cache.
-_local_solve = jax.jit(
+_local_solve = DEVICE_OBS.jit("failover_local_solve", jax.jit(
     solve_batch, static_argnames=("config",), donate_argnums=()
-)
+))
 
 
 class FailoverSolver:

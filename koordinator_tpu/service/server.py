@@ -45,6 +45,7 @@ from koordinator_tpu.ops.binpack import (
     scatter_node_rows_donated,
     solve_batch,
 )
+from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.ops.gang import GangState
 from koordinator_tpu.ops.quota import QuotaState
@@ -67,10 +68,11 @@ POD_FIELDS = (
     "gang_id", "blocked", "has_numa_policy",
 )
 
-#: one jit cache for every connection (static config hashes per value)
-_jit_solve = jax.jit(
+#: one jit cache for every connection (static config hashes per value);
+#: the DEVICE_OBS wrapper adds compile telemetry (docs/DESIGN.md §17)
+_jit_solve = DEVICE_OBS.jit("sidecar_solve_batch", jax.jit(
     solve_batch, static_argnames=("config",), donate_argnums=()
-)
+))
 
 #: kernel routing availability, mirroring PlacementModel.use_pallas:
 #: None = decide at first solve (single TPU chip => on).
@@ -448,6 +450,9 @@ def solve_from_request(req: SolveRequest,
     plane's SolverConfig rides along. ``node_cache`` (per connection)
     serves the delta protocol: requests without a ``node`` group patch
     the cached staged state instead of re-shipping it."""
+    # the sidecar's "round" is a solve: an armed profiler window wraps
+    # the next K requests (one flag read when no window is in play)
+    DEVICE_OBS.on_round()
     t_solve = TRACER.now()
     try:
         delta = req.node_delta
@@ -683,6 +688,10 @@ class PlacementService:
             "active_connections": len(self._server.active_connections),
             "kernel_breaker": kernel_breaker_status(),
             "admission": None if self.gate is None else self.gate.stats(),
+            # padding-waste / live-buffer / compile counters beside the
+            # lane-depth and coalesce stats (cached analyses only — a
+            # status read never compiles)
+            "device": DEVICE_OBS.status(),
         }
 
     def stop(self) -> None:

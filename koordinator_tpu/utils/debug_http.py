@@ -19,6 +19,18 @@ registries this framework already keeps:
                                        tracer's ring (load in Perfetto:
                                        the pipelined stage/solve overlap
                                        renders as crossing tracks)
+- ``GET /debug/device``             -> the device-cost observatory
+                                       (obs/device.py): compile ring,
+                                       per-variant cost/memory analyses
+                                       (materialized on this read),
+                                       padding-waste and live-buffer
+                                       accounting
+- ``GET /debug/profile?rounds=K``   -> arm a jax profiler window over
+                                       the next K scheduling rounds
+                                       (429 when rate-limited or a
+                                       window is already in play; 501
+                                       when this jax build has no
+                                       profiler)
 - ``GET /explain?pod=<uid>[&node=<name>]``
                                     -> placement explanation for one pod
                                        (obs/explain.py: per-node filter
@@ -43,6 +55,7 @@ class DebugHTTPServer:
 
     def __init__(self, services=None, debug=None, metrics=None,
                  auditor=None, tracer=None, explain=None,
+                 device=None, profile=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.services = services
         self.debug = debug
@@ -52,6 +65,12 @@ class DebugHTTPServer:
         self.tracer = tracer
         #: ``explain(pod_uid, node=None) -> dict`` served at /explain
         self.explain = explain
+        #: ``device() -> dict`` served at /debug/device (obs/device.py
+        #: DEVICE_OBS.debug_payload)
+        self.device = device
+        #: ``profile(rounds) -> dict`` served at /debug/profile
+        #: (DEVICE_OBS.request_profile)
+        self.profile = profile
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -137,6 +156,34 @@ class DebugHTTPServer:
                         200, json.dumps(outer.tracer.chrome_trace(),
                                         default=str)
                     )
+                if path == "/debug/device":
+                    if outer.device is None:
+                        return self._send(404, "no device observatory",
+                                          "text/plain")
+                    return self._send(
+                        200, json.dumps(outer.device(), default=str)
+                    )
+                if path == "/debug/profile":
+                    if outer.profile is None:
+                        return self._send(404, "no device observatory",
+                                          "text/plain")
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        rounds = int(q.get("rounds", ["8"])[0])
+                    except ValueError:
+                        return self._send(400, json.dumps(
+                            {"error": "rounds must be an integer"}))
+                    payload = outer.profile(rounds)
+                    # a permanent incapacity (old jax) is 501 — a
+                    # retry loop honoring 429 must not spin on it
+                    if payload.get("unsupported"):
+                        code = 501
+                    elif "error" in payload:
+                        code = 429
+                    else:
+                        code = 200
+                    return self._send(code, json.dumps(payload,
+                                                       default=str))
                 if path == "/explain":
                     if outer.explain is None:
                         return self._send(404, "no explainer",

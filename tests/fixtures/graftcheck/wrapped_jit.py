@@ -1,0 +1,31 @@
+"""Seeded fixtures for the instrumentation-wrapper jit-factory rules
+(obs/device.py idiom: ``X = DEVICE_OBS.jit("name", jax.jit(f, ...))``)
+— parsed by graftcheck's self-test, never imported or executed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OBS = object()
+
+# a wrapped binding IS a jit factory: declarations checked on the inner
+# call (declared — no jit-hygiene violation), and the binding stays a
+# device-value producer for the host-sync taint analysis
+wrapped = OBS.jit("solve", jax.jit(
+    lambda s: s * 2, static_argnums=(), donate_argnums=()
+))
+
+# VIOLATION (jit-hygiene): the INNER factory declares nothing — the
+# wrapper must not launder an undeclared jit surface
+bad_wrapped = OBS.jit("naked", jax.jit(lambda x: x + 1))
+
+
+def hot(state):
+    result = wrapped(jnp.asarray(state))
+    return np.asarray(result)                # VIOLATION: host-sync
+
+
+def churn(xs):
+    # VIOLATION (jit-hygiene pass 2): per-call-varying scalar into a
+    # WRAPPED jitted callable
+    return wrapped(jnp.asarray(xs), len(xs))

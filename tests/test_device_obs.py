@@ -1,0 +1,505 @@
+"""Device-cost observatory tests (ISSUE 8, docs/DESIGN.md §17).
+
+- Compile telemetry: instrumented jit callsites count real XLA
+  compilations (cross-checked against the jit cache), a warmed churn
+  tick counts ZERO — in agreement with the existing ``xla_compiles``
+  log fixture — and the recorded wall/signature land in the ring and
+  the prometheus series.
+- Cost/memory analysis: present and finite on the CPU backend,
+  produced lazily from recorded avals (no live buffers touched).
+- Padding-waste gauges: exact against hand-computed bucket math for
+  the pod, reservation, dirty-row, and coalesced buffers.
+- Profiler windows: ``/debug/profile?rounds=K`` arms exactly one
+  window (one trace directory written), the second request
+  rate-limits, and the window closes after K rounds.
+- Capability gate: an old-jax box degrades to loud skips — analysis
+  reports unsupported, profile requests refuse, counters keep working.
+- The observatory on vs off is tick-identical (observation only).
+- ``tools/bench_diff.py``: a record diffed against itself is clean,
+  seeded regressions (throughput drop, lost identity flag, compile
+  leak, budget bust) exit nonzero.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import koordinator_tpu.obs.device as device_mod
+from koordinator_tpu.apis.extension import ResourceName
+from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+from koordinator_tpu.client.bus import APIServer, Kind
+from koordinator_tpu.client.wiring import wire_scheduler
+from koordinator_tpu.metrics.components import (
+    DEVICE_COMPILES,
+    DEVICE_PADDING_WASTE,
+)
+from koordinator_tpu.obs.device import (
+    DEVICE_OBS,
+    DeviceObservatory,
+    device_observatory_supported,
+)
+from koordinator_tpu.obs.flight import FLIGHT, _default_dump_dir
+from koordinator_tpu.scheduler import Scheduler
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    DEVICE_OBS.reset()
+    DEVICE_OBS.set_enabled(True)
+    yield
+    DEVICE_OBS.reset()
+    DEVICE_OBS.set_enabled(True)
+
+
+def _wired(n_nodes=8):
+    bus = APIServer()
+    sched = Scheduler()
+    wire_scheduler(bus, sched)
+    for i in range(n_nodes):
+        bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+            name=f"n{i}", allocatable={CPU: 64000, MEM: 131072}))
+        bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+            node_name=f"n{i}", node_usage={CPU: 1000 * (i % 4)},
+            update_time=10.0))
+    return bus, sched
+
+
+def _arrive(bus, rng, t, n=12):
+    for j in range(n):
+        pod = PodSpec(name=f"t{t}p{j}",
+                      requests={CPU: int(rng.integers(200, 1200)),
+                                MEM: int(rng.integers(128, 1024))})
+        bus.apply(Kind.POD, pod.uid, pod)
+
+
+# -- compile telemetry + analysis --------------------------------------------
+
+def test_smoke_compile_telemetry_and_analysis():
+    """The zero→observed path: an instrumented jit records its compile
+    (count, wall, shape signature) and the lazy analysis produces
+    finite cost/memory numbers on the CPU backend."""
+    if not device_observatory_supported():
+        pytest.skip("jax build lacks AOT cost/memory analysis")
+    import jax
+    import jax.numpy as jnp
+
+    before = DEVICE_COMPILES.value({"fn": "obs_probe"})
+    probe = DEVICE_OBS.jit("obs_probe", jax.jit(
+        lambda x, y: (x @ y).sum(), static_argnums=(), donate_argnums=()
+    ))
+    x = jnp.ones((32, 16), jnp.float32)
+    y = jnp.ones((16, 8), jnp.float32)
+    np.asarray(probe(x, y))
+    np.asarray(probe(x, y))  # warm call must not count
+    st = DEVICE_OBS.status()
+    mine = [r for r in st["recent_compiles"] if r["fn"] == "obs_probe"]
+    assert len(mine) == 1
+    assert mine[0]["compile_s"] > 0
+    assert "32x16" in mine[0]["shape"]
+    assert DEVICE_COMPILES.value({"fn": "obs_probe"}) == before + 1
+    # a distinct shape is a new variant
+    np.asarray(probe(jnp.ones((64, 16)), jnp.ones((16, 8))))
+    assert DEVICE_COMPILES.value({"fn": "obs_probe"}) == before + 2
+
+    produced = DEVICE_OBS.analyze()
+    ours = [a for a in produced if a["fn"] == "obs_probe"]
+    assert len(ours) == 2 and all("error" not in a for a in ours)
+    for a in ours:
+        assert np.isfinite(a["cost"]["flops"]) and a["cost"]["flops"] > 0
+        assert np.isfinite(a["cost"]["bytes_accessed"])
+        mem = a["memory"]
+        for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                    "peak_bytes"):
+            assert isinstance(mem[key], int) and mem[key] >= 0
+        assert mem["peak_bytes"] >= mem["argument_bytes"]
+    # memoized: a second analyze() pass has nothing to do
+    assert DEVICE_OBS.analyze() == []
+
+
+def test_solve_variant_analysis_through_scheduler():
+    """The real solve path: one scheduled round registers the
+    solve_batch variant; its analysis is finite and its avals never
+    touched live buffers (the solve already retired)."""
+    if not device_observatory_supported():
+        pytest.skip("jax build lacks AOT cost/memory analysis")
+    bus, sched = _wired()
+    _arrive(bus, np.random.default_rng(3), 0)
+    sched.schedule_pending(now=20.0)
+    produced = DEVICE_OBS.analyze()
+    solves = [a for a in produced if a["fn"] == "solve_batch"]
+    assert solves, "the model's solve variant must register for analysis"
+    assert all("error" not in a for a in solves)
+    assert all(a["cost"]["flops"] > 0 for a in solves)
+
+
+def test_compile_counter_agrees_with_xla_compiles_fixture(xla_compiles):
+    """The quantitative form of graftcheck's boolean guard: a WARMED
+    churn tick performs zero XLA compiles by the log fixture — and the
+    observatory's counters (per-fn AND the process-wide monitoring
+    listener) must say exactly the same thing."""
+    bus, sched = _wired()
+    rng = np.random.default_rng(7)
+    for t in range(3):  # warm: staging cache established, scatter built
+        _arrive(bus, rng, t)
+        sched.schedule_pending(now=20.0 + t)
+    xla_compiles.clear()
+    mark = DEVICE_OBS.mark()
+    _arrive(bus, rng, 99)
+    sched.schedule_pending(now=30.0)
+    fp = DEVICE_OBS.fingerprint(mark)
+    assert xla_compiles == [], "warmed tick recompiled (log fixture)"
+    assert fp["compiles"] == 0, "per-fn counter disagrees with fixture"
+    assert fp["xla_compiles"] == 0, "monitoring counter disagrees"
+
+
+def test_compile_counter_survives_cache_clear():
+    """A post-``jax.clear_caches`` recompile of a known shape is a real
+    compile and must count — the high-water dedup resets when the
+    pre-call cache size drops below the mark (review regression)."""
+    import jax
+    import jax.numpy as jnp
+
+    before = DEVICE_COMPILES.value({"fn": "clear_probe"})
+    probe = DEVICE_OBS.jit("clear_probe", jax.jit(
+        lambda x: x - 2, static_argnums=(), donate_argnums=()
+    ))
+    np.asarray(probe(jnp.ones((9,))))
+    assert DEVICE_COMPILES.value({"fn": "clear_probe"}) == before + 1
+    np.asarray(probe(jnp.ones((9,))))  # warm: no count
+    assert DEVICE_COMPILES.value({"fn": "clear_probe"}) == before + 1
+    jax.clear_caches()
+    np.asarray(probe(jnp.ones((9,))))  # real recompile: counts again
+    assert DEVICE_COMPILES.value({"fn": "clear_probe"}) == before + 2
+
+
+def test_fingerprint_carries_device_costs():
+    if not device_observatory_supported():
+        pytest.skip("jax build lacks AOT cost/memory analysis")
+    mark = DEVICE_OBS.mark()
+    # a node count no other test uses: jax shares compiled executables
+    # across identical jit instances, so only a genuinely new shape is
+    # guaranteed to compile (which is exactly what the counter reports)
+    bus, sched = _wired(n_nodes=13)
+    _arrive(bus, np.random.default_rng(5), 0)
+    sched.schedule_pending(now=20.0)
+    fp = DEVICE_OBS.fingerprint(mark)
+    assert fp["compiles"] >= 1
+    assert fp["flops"] > 0 and fp["peak_bytes"] > 0
+    assert 0.0 <= fp["padding_waste_ratio"] < 1.0
+    assert fp["live_buffers"] > 0 and fp["live_bytes"] > 0
+
+
+# -- padding waste -----------------------------------------------------------
+
+def test_padding_waste_gauge_exact_bucket_math():
+    """Gauges must equal the hand-computed bucket arithmetic exactly:
+    pod bucket (quarter-steps between powers of two, floor 64), resv
+    bucket (pow2, floor 8), dirty-row bucket (pow2, floor 8)."""
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import bucket_row_update
+
+    # pod bucket: 70 pods -> power 128, step 16 -> target 80
+    assert PlacementModel.pod_bucket(70) == 80
+    bus, sched = _wired()
+    for j in range(70):
+        bus.apply(Kind.POD, f"p{j}", PodSpec(
+            name=f"p{j}", requests={CPU: 100, MEM: 64}))
+    sched.schedule_pending(now=20.0)
+    pad = DEVICE_OBS.status()["padding"]
+    assert pad["pod_batch"] == {
+        "real": 70, "padded": 80, "waste": 1.0 - 70 / 80,
+    }
+    assert DEVICE_PADDING_WASTE.value(
+        {"buffer": "pod_batch"}) == pytest.approx(1.0 - 70 / 80)
+
+    # dirty rows: 5 dirty -> bucket 8 -> waste 3/8
+    idx = np.arange(5, dtype=np.int32)
+    rows = {"a": np.ones((5, 2), np.int32)}
+    sidx, _ = bucket_row_update(idx, rows)
+    assert sidx.shape[0] == 8
+    assert DEVICE_OBS.status()["padding"]["dirty_rows"] == {
+        "real": 5, "padded": 8, "waste": 1.0 - 5 / 8,
+    }
+
+    # resv bucket: 3 reservations -> 8
+    DEVICE_OBS.note_padding("resv_table", 3, 8)
+    assert DEVICE_PADDING_WASTE.value(
+        {"buffer": "resv_table"}) == pytest.approx(5 / 8)
+
+
+def test_padding_note_disabled_is_noop():
+    DEVICE_OBS.set_enabled(False)
+    DEVICE_OBS.note_padding("pod_batch", 1, 64)
+    assert "pod_batch" not in DEVICE_OBS.status()["padding"]
+
+
+# -- live buffers ------------------------------------------------------------
+
+def test_live_snapshot_counts_and_owner_attribution():
+    import jax.numpy as jnp
+
+    held = jnp.ones((128, 4), jnp.int32)  # noqa: F841 — must stay live
+    DEVICE_OBS.register_owner("probe", lambda: 512)
+    snap = DEVICE_OBS.live_snapshot()
+    assert snap["count"] >= 1
+    assert snap["bytes"] >= held.nbytes
+    assert snap["owners"]["probe"] == 512
+
+
+# -- profiler windows --------------------------------------------------------
+
+def test_profile_endpoint_one_dir_and_rate_limit(tmp_path, monkeypatch):
+    """ISSUE 8: the profile endpoint writes exactly ONE trace dir for
+    one request and rate-limits the second."""
+    from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+    clock = [100.0]
+    monkeypatch.setattr(DEVICE_OBS, "_clock", lambda: clock[0])
+    DEVICE_OBS.configure(profile_dir=str(tmp_path),
+                         profile_min_interval_s=30.0)
+    server = DebugHTTPServer(
+        device=DEVICE_OBS.debug_payload,
+        profile=DEVICE_OBS.request_profile,
+    ).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/profile?rounds=2"
+        with urllib.request.urlopen(url) as resp:
+            armed = json.loads(resp.read())
+        assert armed["armed"] is True and armed["rounds"] == 2
+        # second request while armed: refused (429)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url)
+        assert err.value.code == 429
+
+        import jax.numpy as jnp
+
+        for _ in range(3):  # K=2 rounds + the closing boundary
+            DEVICE_OBS.on_round()
+            _ = (jnp.ones((8, 8)) * 2).sum()
+        windows = [d for d in os.listdir(tmp_path)
+                   if d.startswith("window-")]
+        assert len(windows) == 1, "exactly one trace dir per request"
+        assert os.listdir(tmp_path / windows[0]), "window dir is empty"
+        st = DEVICE_OBS.status()["profile"]
+        assert st["armed_rounds"] == 0 and st["active_rounds_left"] == 0
+
+        # window closed, but the rate limit still holds within the
+        # interval...
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url)
+        assert err.value.code == 429
+        # ...and a request past the interval arms again
+        clock[0] += 31.0
+        with urllib.request.urlopen(url) as resp:
+            assert json.loads(resp.read())["armed"] is True
+    finally:
+        server.stop()
+        # drive the second window shut so no trace leaks into later
+        # tests
+        for _ in range(4):
+            DEVICE_OBS.on_round()
+
+
+def test_profile_windows_disk_capped(tmp_path, monkeypatch):
+    clock = [0.0]
+    monkeypatch.setattr(DEVICE_OBS, "_clock", lambda: clock[0])
+    DEVICE_OBS.configure(profile_dir=str(tmp_path),
+                         profile_min_interval_s=0.0,
+                         profile_max_windows=2)
+    for i in range(4):
+        clock[0] += 1.0
+        assert DEVICE_OBS.request_profile(rounds=1).get("armed")
+        DEVICE_OBS.on_round()  # start
+        DEVICE_OBS.on_round()  # close (rounds=1)
+    windows = [d for d in os.listdir(tmp_path) if d.startswith("window-")]
+    assert len(windows) == 2, "oldest window dirs must be pruned"
+
+
+# -- capability gate ---------------------------------------------------------
+
+def test_capability_gate_old_jax_degrades_loudly(monkeypatch):
+    """An old-jax box (no AOT stages API, no profiler): analysis is a
+    loud no-op, profile requests refuse with a reason, and compile
+    COUNTING — pure python — keeps working."""
+    import jax
+
+    monkeypatch.setattr(device_mod, "_analysis_supported", lambda: False)
+    monkeypatch.setattr(device_mod, "_profiler_supported", lambda: False)
+    assert not device_observatory_supported()
+
+    obs = DeviceObservatory()
+    probe = obs.jit("gated", jax.jit(
+        lambda x: x + 1, static_argnums=(), donate_argnums=()
+    ))
+    import jax.numpy as jnp
+
+    np.asarray(probe(jnp.ones((4,))))
+    st = obs.status()
+    assert st["supported"] is False
+    assert st["compiles_total"] == 1, "counting must survive the gate"
+    assert obs.analyze() == []
+    refusal = obs.request_profile(rounds=2)
+    assert "error" in refusal and "unavailable" in refusal["error"]
+
+
+# -- observation only: tick identity -----------------------------------------
+
+def test_observatory_on_off_tick_identical():
+    """The observatory enabled vs disabled is observation only: the
+    same seeded churn places identically, bit for bit."""
+
+    def drive(enabled):
+        DEVICE_OBS.reset()
+        DEVICE_OBS.set_enabled(enabled)
+        bus, sched = _wired()
+        rng = np.random.default_rng(11)
+        log = []
+        for t in range(4):
+            _arrive(bus, rng, t)
+            out = sched.schedule_pending(now=20.0 + t)
+            log.append(sorted(out.items()))
+        return log
+
+    on = drive(True)
+    off = drive(False)
+    assert on == off and len(on) == 4
+
+
+# -- surfaces ----------------------------------------------------------------
+
+def test_placement_service_status_carries_device_section(tmp_path):
+    from koordinator_tpu.service.server import PlacementService
+
+    service = PlacementService(str(tmp_path / "solver.sock"))
+    service.start()  # stop() joins serve_forever — never stop unstarted
+    try:
+        st = service.status()
+        assert "device" in st
+        assert "compiles_total" in st["device"]
+        assert "padding" in st["device"]
+        assert "live" in st["device"]
+    finally:
+        service.stop()
+
+
+def test_flight_dump_carries_device_section(tmp_path):
+    FLIGHT.reset()
+    FLIGHT.configure(dump_dir=str(tmp_path), min_interval_s=0.0)
+    try:
+        DEVICE_OBS.note_padding("pod_batch", 60, 64)
+        path = FLIGHT.trigger("manual", detail="device-obs test")
+        assert path is not None
+        with open(path) as f:
+            dump = json.load(f)
+        dev = dump["device"]
+        assert "compiles_total" in dev and "xla_compiles_total" in dev
+        assert dev["padding"]["pod_batch"]["real"] == 60
+        assert "live" in dev
+    finally:
+        FLIGHT.reset()
+        FLIGHT.configure(dump_dir=_default_dump_dir(), min_interval_s=1.0)
+
+
+def test_debug_device_endpoint_serves_ring():
+    from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+    import jax
+    import jax.numpy as jnp
+
+    probe = DEVICE_OBS.jit("mux_probe", jax.jit(
+        lambda x: x * 3, static_argnums=(), donate_argnums=()
+    ))
+    np.asarray(probe(jnp.ones((6,))))
+    server = DebugHTTPServer(device=DEVICE_OBS.debug_payload).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/device"
+        with urllib.request.urlopen(url) as resp:
+            payload = json.loads(resp.read())
+        assert any(r["fn"] == "mux_probe"
+                   for r in payload["recent_compiles"])
+        if device_observatory_supported():
+            assert any(a["fn"] == "mux_probe"
+                       for a in payload["analyses"]), (
+                "/debug/device materializes pending analyses"
+            )
+    finally:
+        server.stop()
+
+
+# -- bench_diff --------------------------------------------------------------
+
+def _bench_diff(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"),
+         *argv],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def _record(**overrides):
+    leg = {
+        "pods_per_sec": 50000.0, "p99_s": 0.01,
+        "identical_to_oracle": True,
+        "device": {"compiles": 6, "xla_compiles": 12, "flops": 1e9,
+                   "bytes_accessed": 2e8, "peak_bytes": 4 << 20,
+                   "padding_waste_ratio": 0.2},
+    }
+    leg.update(overrides)
+    return {"metric": "test", "value": 50000.0, "unit": "pods/s",
+            "matrix": {"9_churn": leg}, "graftcheck_violations": 0}
+
+
+def test_bench_diff_smoke_self_clean(tmp_path):
+    """The check.sh gate's contract: a record diffed against itself is
+    clean (synthetic AND the committed r05 with its truncated tail)."""
+    p = tmp_path / "r.json"
+    p.write_text(json.dumps(_record()))
+    out = _bench_diff(str(p), str(p))
+    assert out.returncode == 0, out.stdout + out.stderr
+    out = _bench_diff(os.path.join(REPO, "BENCH_r05.json"),
+                      os.path.join(REPO, "BENCH_r05.json"))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+@pytest.mark.parametrize("mutation, metric", [
+    ({"pods_per_sec": 20000.0}, "pods_per_sec"),       # throughput cliff
+    ({"p99_s": 0.05}, "p99_s"),                        # latency blow-up
+    ({"identical_to_oracle": False}, "identical"),     # identity lost
+    ({"device": {"compiles": 40, "xla_compiles": 12,
+                 "flops": 1e9, "bytes_accessed": 2e8,
+                 "peak_bytes": 4 << 20,
+                 "padding_waste_ratio": 0.2}}, "compiles"),  # recompile leak
+])
+def test_bench_diff_catches_regressions(tmp_path, mutation, metric):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_record()))
+    new.write_text(json.dumps(_record(**mutation)))
+    out = _bench_diff(str(old), str(new))
+    assert out.returncode == 1, out.stdout
+    assert "REGRESSION" in out.stdout and metric in out.stdout
+
+
+def test_bench_diff_budget_mode(tmp_path):
+    rec = tmp_path / "r.json"
+    rec.write_text(json.dumps(_record()))
+    budget = tmp_path / "budget.json"
+    budget.write_text(json.dumps({
+        "9_churn": {"p99_s": {"max": 0.02},
+                    "device.padding_waste_ratio": {"max": 0.5}},
+    }))
+    out = _bench_diff("--budget", str(budget), str(rec))
+    assert out.returncode == 0, out.stdout + out.stderr
+    budget.write_text(json.dumps({
+        "9_churn": {"p99_s": {"max": 0.005}},
+    }))
+    out = _bench_diff("--budget", str(budget), str(rec))
+    assert out.returncode == 1, out.stdout

@@ -131,6 +131,28 @@ def test_jit_hygiene_fixture_detected():
     assert all("declared(" not in m or "len(xs)" in m for m in messages)
 
 
+def test_wrapped_jit_factory_recognized():
+    """The obs/device.py instrumentation idiom — ``X = DEVICE_OBS.jit(
+    "name", jax.jit(f, ...))`` — must behave exactly like a bare jit
+    binding under both rules: the binding stays a device-value producer
+    (host-sync), declaration completeness is checked on the INNER
+    factory, and pass 2's varying-scalar check still covers the
+    wrapped callable."""
+    module = _fixture("wrapped_jit.py")
+    sync = HostSyncRule(scope=("*",)).check(module)
+    assert {(v.func, v.symbol) for v in sync} == {("hot", "np.asarray")}, (
+        "wrapped binding lost (or over-gained) producer taint"
+    )
+    hygiene = JitHygieneRule(scope=("*",)).check(module)
+    undeclared = [v for v in hygiene if "does not declare" in v.message]
+    assert len(undeclared) == 1 and undeclared[0].line == 20, (
+        "declaration completeness must be judged on the inner factory: "
+        "exactly the naked inner jit flags"
+    )
+    varying = [v for v in hygiene if "per-call-varying" in v.message]
+    assert len(varying) == 1 and varying[0].func == "churn"
+
+
 def test_dead_import_fixture_detected():
     violations = DeadImportRule(scope=("*",)).check(
         _fixture("dead_import_bad.py")

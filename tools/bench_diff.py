@@ -1,0 +1,343 @@
+#!/usr/bin/env python3
+"""bench_diff: machine-compare two bench records (or one record against
+a committed budget file) and exit nonzero on regressions.
+
+The bench trajectory (``BENCH_r*.json``) has never been
+machine-compared — a throughput cliff, a recompile leak, or a padding
+blow-up between two records was only visible to a human reading JSON.
+This tool closes that gap and gates ``tools/check.sh``:
+
+    python tools/bench_diff.py OLD.json NEW.json
+    python tools/bench_diff.py --budget budgets.json NEW.json
+
+Per matrix leg it diffs, with per-class thresholds (all overridable):
+
+- throughput   (``*pods_per_sec``, ``speedup*``, top-level ``value``):
+  regression when new < old x (1 - --throughput-drop)
+- latency      (``*p99*_s``): regression when new > old x
+  (1 + --p99-rise); sub-0.1 ms olds are ignored as noise
+- device fingerprint (the ``device`` section every leg records since
+  ISSUE 8): ``compiles``/``xla_compiles`` regress past
+  max(old + --compiles-rise, old x 1.5); ``flops``/``bytes_accessed``/
+  ``peak_bytes``/``live_bytes`` past old x (1 + --device-rise);
+  ``padding_waste_ratio`` past old + --waste-rise (absolute)
+- booleans: any flag that was true in OLD and is false in NEW
+  (``identical_to_oracle``, ``tick_identical_*``, ``sub_10ms_p99``,
+  ``ok``, ...) is a regression — identity and acceptance flags never
+  silently flip off
+- a leg erroring in NEW but not in OLD is a regression
+
+Records load from (a) a bare bench JSON line, (b) a driver wrapper
+with ``parsed``, (c) a wrapper whose ``tail`` holds the JSON line, or
+(d) — salvage mode — a wrapper whose tail is front-truncated: every
+balanced ``"leg": {...}`` object still present is recovered, so old
+records remain diffable. Budget files map legs to dotted metric paths
+with ``min``/``max`` bounds::
+
+    {"13_pipelined_churn_5k": {"round_p99_s": {"max": 0.02},
+                               "device.padding_waste_ratio": {"max": 0.95}}}
+
+Exit codes: 0 clean, 1 regressions, 2 usage/load errors.
+Stdlib-only by design — the gate must run anywhere, jax or not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: metric keys that identify a salvaged object as a bench leg
+_LEG_MARKERS = (
+    "pods_per_sec", "p99_s", "wall_s", "mode", "warm_warmup_s",
+    "round_p99_s", "sweeps_per_sec", "recovery_s",
+)
+
+
+# -- record loading ----------------------------------------------------------
+
+def _salvage_legs(text: str) -> Dict[str, dict]:
+    """Recover every balanced ``"name": {...}`` object whose body looks
+    like a bench leg from (possibly front-truncated) record text."""
+    legs: Dict[str, dict] = {}
+    for m in re.finditer(r'"([A-Za-z0-9_]+)":\s*\{', text):
+        start = m.end() - 1
+        try:
+            obj, _ = json.JSONDecoder().raw_decode(text[start:])
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and any(k in obj for k in _LEG_MARKERS):
+            legs[m.group(1)] = obj
+    return legs
+
+
+def load_record(path: str) -> dict:
+    """A bench record as ``{"matrix": {leg: {...}}, ...top-level}``."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "matrix" in doc:
+        return doc
+    if isinstance(doc, dict):
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "matrix" in parsed:
+            return parsed
+        text = doc.get("tail", text) or text
+    # the JSON line inside a driver tail
+    idx = text.rfind('{"metric"')
+    if idx >= 0:
+        try:
+            rec, _ = json.JSONDecoder().raw_decode(text[idx:])
+            if isinstance(rec, dict) and "matrix" in rec:
+                return rec
+        except ValueError:
+            pass
+    legs = _salvage_legs(text)
+    if not legs:
+        raise ValueError(
+            f"{path}: no bench record found (not a bench JSON line, "
+            f"driver wrapper, or salvageable tail)"
+        )
+    # top-level scalars that survived truncation ride along when present
+    top: dict = {"matrix": legs}
+    for key in ("value", "p99_round_s", "graftcheck_violations"):
+        m = list(re.finditer(rf'"{key}": ([-0-9.eE]+)', text))
+        if m:
+            top[key] = json.loads(m[-1].group(1))
+    return top
+
+
+# -- comparison --------------------------------------------------------------
+
+class Thresholds:
+    def __init__(self, throughput_drop=0.30, p99_rise=0.75,
+                 compiles_rise=4, device_rise=0.50, waste_rise=0.15):
+        self.throughput_drop = throughput_drop
+        self.p99_rise = p99_rise
+        self.compiles_rise = compiles_rise
+        self.device_rise = device_rise
+        self.waste_rise = waste_rise
+
+
+def _flatten(d: dict, prefix: str = "") -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def _classify(key: str) -> Optional[str]:
+    """Which comparison class a flattened metric key belongs to."""
+    leaf = key.rsplit(".", 1)[-1]
+    if leaf == "error":
+        return "error"
+    if leaf.endswith("pods_per_sec") or leaf.startswith("speedup") \
+            or key == "value":
+        return "throughput"
+    if "p99" in leaf and leaf.endswith("_s"):
+        return "p99"
+    if key.startswith("device.") or ".device." in key:
+        if leaf in ("compiles", "xla_compiles"):
+            return "compiles"
+        if leaf in ("flops", "bytes_accessed", "peak_bytes",
+                    "live_bytes"):
+            return "device-cost"
+        if leaf == "padding_waste_ratio":
+            return "waste"
+    if key == "graftcheck_violations":
+        return "compiles"  # same shape: small count that must not grow
+    return None
+
+
+def compare_records(old: dict, new: dict, thr: Thresholds
+                    ) -> Tuple[List[dict], List[str]]:
+    """(rows, notes): every compared metric with its verdict."""
+    rows: List[dict] = []
+    notes: List[str] = []
+
+    def compare_flat(leg: str, o: Dict[str, object],
+                     n: Dict[str, object]) -> None:
+        for key in sorted(set(o) & set(n)):
+            ov, nv = o[key], n[key]
+            verdict = None
+            if isinstance(ov, bool) or isinstance(nv, bool):
+                if ov is True and nv is False:
+                    verdict = "REGRESSION"
+                elif ov == nv:
+                    verdict = "ok"
+                else:
+                    verdict = "improved"
+                rows.append({"leg": leg, "metric": key, "old": ov,
+                             "new": nv, "verdict": verdict})
+                continue
+            cls = _classify(key)
+            if cls is None or not isinstance(ov, (int, float)) \
+                    or not isinstance(nv, (int, float)):
+                continue
+            if cls == "throughput":
+                bad = ov > 0 and nv < ov * (1 - thr.throughput_drop)
+            elif cls == "p99":
+                bad = ov >= 1e-4 and nv > ov * (1 + thr.p99_rise)
+            elif cls == "compiles":
+                bad = nv > max(ov + thr.compiles_rise, ov * 1.5)
+            elif cls == "device-cost":
+                bad = ov > 0 and nv > ov * (1 + thr.device_rise)
+            else:  # waste
+                bad = nv > ov + thr.waste_rise
+            rows.append({
+                "leg": leg, "metric": key, "old": ov, "new": nv,
+                "verdict": "REGRESSION" if bad else "ok",
+            })
+        for key in sorted(set(n) - set(o)):
+            if key.rsplit(".", 1)[-1] == "error":
+                rows.append({"leg": leg, "metric": key, "old": None,
+                             "new": n[key], "verdict": "REGRESSION"})
+
+    old_m, new_m = old.get("matrix", {}), new.get("matrix", {})
+    top_old = {k: v for k, v in old.items() if k != "matrix"
+               and not isinstance(v, (dict, str))}
+    top_new = {k: v for k, v in new.items() if k != "matrix"
+               and not isinstance(v, (dict, str))}
+    compare_flat("<top>", top_old, top_new)
+    for leg in sorted(set(old_m) & set(new_m)):
+        if not isinstance(old_m[leg], dict) or \
+                not isinstance(new_m[leg], dict):
+            continue
+        compare_flat(leg, _flatten(old_m[leg]), _flatten(new_m[leg]))
+    for leg in sorted(set(old_m) - set(new_m)):
+        notes.append(f"leg {leg} present in OLD only (not compared)")
+    for leg in sorted(set(new_m) - set(old_m)):
+        notes.append(f"leg {leg} new in NEW (not compared)")
+    return rows, notes
+
+
+def compare_budget(budget: dict, new: dict) -> List[dict]:
+    rows: List[dict] = []
+    matrix = new.get("matrix", {})
+    for leg, metrics in budget.items():
+        source = new if leg == "<top>" else matrix.get(leg)
+        if not isinstance(source, dict):
+            rows.append({"leg": leg, "metric": "<leg>", "old": "budget",
+                         "new": "missing", "verdict": "REGRESSION"})
+            continue
+        flat = _flatten(source)
+        for key, bound in metrics.items():
+            val = flat.get(key)
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                rows.append({"leg": leg, "metric": key, "old": bound,
+                             "new": val, "verdict": "REGRESSION"})
+                continue
+            bad = (
+                ("max" in bound and val > bound["max"])
+                or ("min" in bound and val < bound["min"])
+            )
+            rows.append({
+                "leg": leg, "metric": key, "old": bound, "new": val,
+                "verdict": "REGRESSION" if bad else "ok",
+            })
+    return rows
+
+
+# -- output ------------------------------------------------------------------
+
+def _fmt(v) -> str:
+    if isinstance(v, bool) or v is None:
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def print_table(rows: List[dict], show_all: bool) -> int:
+    regressions = [r for r in rows if r["verdict"] == "REGRESSION"]
+    shown = rows if show_all else regressions
+    if shown:
+        widths = [
+            max(len(str(r[c])) if c != "old" and c != "new"
+                else len(_fmt(r[c])) for r in shown + [
+                    {"leg": "leg", "metric": "metric", "old": "old",
+                     "new": "new", "verdict": "verdict"}])
+            for c in ("leg", "metric", "old", "new", "verdict")
+        ]
+        header = ("leg", "metric", "old", "new", "verdict")
+        print("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+        for r in shown:
+            cells = (str(r["leg"]), str(r["metric"]), _fmt(r["old"]),
+                     _fmt(r["new"]), str(r["verdict"]))
+            print("  ".join(c.ljust(w) for c, w in zip(cells, widths)))
+    by_leg: Dict[str, int] = {}
+    for r in rows:
+        by_leg.setdefault(str(r["leg"]), 0)
+        if r["verdict"] == "REGRESSION":
+            by_leg[str(r["leg"])] += 1
+    clean = [leg for leg, n in sorted(by_leg.items()) if n == 0]
+    print(
+        f"bench_diff: {len(rows)} metrics compared across "
+        f"{len(by_leg)} legs — {len(regressions)} regression(s)"
+        + (f"; clean: {', '.join(clean)}" if clean and not show_all
+           else "")
+    )
+    return 1 if regressions else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "bench_diff", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("records", nargs="+",
+                        help="OLD.json NEW.json, or NEW.json with --budget")
+    parser.add_argument("--budget", default=None,
+                        help="budget JSON: {leg: {dotted.key: {max|min}}}")
+    parser.add_argument("--all", action="store_true",
+                        help="print every compared metric, not only "
+                             "regressions")
+    parser.add_argument("--json", action="store_true",
+                        help="machine output: the row list as JSON")
+    parser.add_argument("--throughput-drop", type=float, default=0.30)
+    parser.add_argument("--p99-rise", type=float, default=0.75)
+    parser.add_argument("--compiles-rise", type=float, default=4)
+    parser.add_argument("--device-rise", type=float, default=0.50)
+    parser.add_argument("--waste-rise", type=float, default=0.15)
+    args = parser.parse_args(argv)
+
+    try:
+        if args.budget is not None:
+            if len(args.records) != 1:
+                parser.error("--budget takes exactly one record")
+            with open(args.budget) as f:
+                budget = json.load(f)
+            rows = compare_budget(budget, load_record(args.records[0]))
+            notes: List[str] = []
+        else:
+            if len(args.records) != 2:
+                parser.error("expected OLD.json NEW.json")
+            thr = Thresholds(args.throughput_drop, args.p99_rise,
+                             args.compiles_rise, args.device_rise,
+                             args.waste_rise)
+            rows, notes = compare_records(
+                load_record(args.records[0]),
+                load_record(args.records[1]), thr,
+            )
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"rows": rows, "notes": notes}))
+        return 1 if any(r["verdict"] == "REGRESSION" for r in rows) else 0
+    for note in notes:
+        print(f"note: {note}")
+    return print_table(rows, args.all)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

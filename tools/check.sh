@@ -38,6 +38,19 @@ echo "== audit smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
     -q -m chaos -k audit -p no:cacheprovider
 
+echo "== bench diff smoke =="
+# the perf regression gate's own health check: a record diffed against
+# itself must pass clean (exit 0) — proves the loader handles the
+# committed record format (including salvage of truncated tails) and
+# that no comparator fires on identical inputs
+python tools/bench_diff.py BENCH_r05.json BENCH_r05.json
+
+echo "== device observatory smoke =="
+# the device-cost layer: compile telemetry + padding gauges must be
+# exact, and the observatory on vs off must stay tick-identical
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_device_obs.py \
+    -q -k "smoke or identical" -p no:cacheprovider
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
