@@ -2110,34 +2110,46 @@ def bench_warm_start():
         return {"warm_warmup_s": None, "error": "timeout"}
 
 
-def graftcheck_violation_count():
-    """Repo-wide graftcheck violation count (docs/DESIGN.md §11) — 0 on
-    a healthy tree, -1 if the checker itself fails. Recorded in every
-    bench record so the trajectory files double as lint history."""
+def graftcheck_report():
+    """Repo-wide graftcheck results (docs/DESIGN.md §11/§18): the total
+    violation count (0 on a healthy tree, -1 if the checker itself
+    fails) plus per-rule counts — all 0 on a healthy tree. Recorded in
+    every bench record so the trajectory files double as lint history,
+    and gated by tools/bench_diff.py: any nonzero per-rule count is an
+    identity-flag regression."""
     try:
         from pathlib import Path
 
         from koordinator_tpu.analysis.graftcheck import (
             default_rules,
             load_allowlist,
-            run_checks,
         )
         from koordinator_tpu.analysis.graftcheck.engine import (
             iter_repo_modules,
+            run_checks_timed,
         )
 
         root = Path(__file__).resolve().parent
-        violations, _ = run_checks(
+        violations, _, stats = run_checks_timed(
             iter_repo_modules(root), default_rules(),
             load_allowlist(root / "graftcheck.toml"),
         )
         for v in violations:
             print(f"graftcheck: {v.format()}", file=sys.stderr)
-        return len(violations)
+        per_rule = {
+            name: s["violations"] for name, s in sorted(stats.items())
+        }
+        # engine-level findings (stale allowlist entries, missing
+        # justifications) count under their own pseudo-rule keys —
+        # accumulated, so two stale entries record as 2, not 1
+        for v in violations:
+            if v.rule not in stats:
+                per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+        return len(violations), per_rule
     except Exception as e:
         print(f"graftcheck failed: {type(e).__name__}: {e}",
               file=sys.stderr)
-        return -1
+        return -1, {}
 
 
 def main():
@@ -2273,8 +2285,10 @@ def main():
         "scan_pods_per_sec": round(flagship["scan_pods_per_sec"], 1),
         "p99_round_s": round(flagship["p99_round_s"], 4),
         "matrix": _round(matrix),
-        "graftcheck_violations": graftcheck_violation_count(),
     }
+    gc_total, gc_rules = graftcheck_report()
+    result["graftcheck_violations"] = gc_total
+    result["graftcheck_rules"] = gc_rules
     if "identical_to_oracle" in flagship:
         result["identical_to_oracle"] = flagship["identical_to_oracle"]
         result["oracle_wall_s"] = round(flagship["oracle_wall_s"], 2)

@@ -10,7 +10,7 @@ per tick. Convention and code review don't scale to that; this package
 machine-checks them, the way the reference Koordinator leans on Go's
 race detector and ``go vet``.
 
-Rules (each self-tested against seeded-violation fixtures in
+Local rules (each self-tested against seeded-violation fixtures in
 ``tests/fixtures/graftcheck/``; see docs/DESIGN.md §11):
 
 - ``host-sync``      no host synchronization on device values inside
@@ -24,12 +24,33 @@ Rules (each self-tested against seeded-violation fixtures in
                      callables never fed per-call-varying Python scalars.
 - ``dead-import``    no unused imports in hot-path modules.
 
+Whole-program rules (ISSUE 9; a resolved cross-module call graph,
+``callgraph.Program`` — docs/DESIGN.md §18):
+
+- ``sync-reach``     interprocedural host-sync taint: a ``device_get``
+                     buried N calls below a hot-path function is caught
+                     in any module, scoped or not.
+- ``lock-order``     the mapped locks' acquisition graph (nested-with +
+                     call-under-lock edges) must be acyclic; a cycle is
+                     a potential deadlock. Runtime twin:
+                     ``koordinator_tpu/testing/lockorder.py``.
+- ``donation-safety`` anything passed to a ``donate_argnums`` jit must
+                     be provably dead afterwards — no later read, no
+                     donation of a possibly-pinned staged generation.
+- ``determinism-taint`` wall clock, unseeded RNGs, and set iteration
+                     order never flow into device values or wire frames
+                     (the oracle bit-parity inputs).
+
 Intentional exceptions live in ``graftcheck.toml`` at the repo root;
 every entry must carry a written justification and match at least one
 current violation (stale entries are themselves violations).
 
 CLI: ``python -m koordinator_tpu.analysis.graftcheck [--format=json]
-[--rule=NAME ...]`` — exits non-zero on any unsuppressed violation.
+[--rule=NAME ...] [--changed-files=PATHS|auto]`` — exits non-zero on
+any unsuppressed violation; JSON output carries per-rule wall time and
+violation counts. ``--changed-files`` scans only the named files with
+the local rules while the whole-program passes always analyze the full
+call graph.
 """
 
 from koordinator_tpu.analysis.graftcheck.engine import (
@@ -39,6 +60,7 @@ from koordinator_tpu.analysis.graftcheck.engine import (
     load_allowlist,
     load_module,
     run_checks,
+    run_checks_timed,
 )
 from koordinator_tpu.analysis.graftcheck.rules import default_rules
 
@@ -50,4 +72,5 @@ __all__ = [
     "load_allowlist",
     "load_module",
     "run_checks",
+    "run_checks_timed",
 ]

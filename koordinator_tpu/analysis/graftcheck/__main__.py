@@ -3,13 +3,25 @@
 Runs every rule repo-wide against the allowlist at
 ``<repo-root>/graftcheck.toml`` and exits non-zero on any unsuppressed
 violation. ``--rule`` narrows to named rules (repeatable);
-``--format=json`` emits machine-readable output (bench.py folds the
-violation count into every bench record).
+``--format=json`` emits machine-readable output including per-rule
+wall time and violation counts (bench.py folds both into every bench
+record).
+
+``--changed-files`` is the incremental mode that keeps the check.sh
+gate fast as the repo grows: local rules scan only the named files
+(comma-separated repo-relative paths, or ``auto`` to take the set from
+``git diff --name-only HEAD`` plus untracked files), while the
+whole-program passes — sync-reach, lock-order, donation-safety — still
+load the FULL call graph: their properties span files a diff never
+names. ``auto`` with a clean tree falls back to the full scan, so a
+post-commit CI run never silently checks nothing.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -17,7 +29,7 @@ from koordinator_tpu.analysis.graftcheck.engine import (
     iter_repo_modules,
     load_allowlist,
     render,
-    run_checks,
+    run_checks_timed,
 )
 from koordinator_tpu.analysis.graftcheck.rules import default_rules
 
@@ -32,6 +44,29 @@ def find_repo_root(start: Path) -> Path:
     raise SystemExit("graftcheck: cannot locate repo root")
 
 
+def git_changed_files(root: Path) -> list:
+    """Repo-relative paths touched since HEAD (diffed + untracked)."""
+    out = []
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if proc.returncode != 0:
+            return []
+        out.extend(
+            line.strip() for line in proc.stdout.splitlines()
+            if line.strip()
+        )
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="graftcheck")
     parser.add_argument("--format", choices=("text", "json"),
@@ -43,6 +78,13 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--root", default=None,
         help="repo root (default: auto-detected from the package path)",
+    )
+    parser.add_argument(
+        "--changed-files", default=None, metavar="PATHS|auto",
+        help="incremental mode: local rules scan only these comma-"
+             "separated repo-relative files ('auto' = git diff + "
+             "untracked; empty auto set falls back to a full scan); "
+             "whole-program rules always analyze the full call graph",
     )
     args = parser.parse_args(argv)
 
@@ -65,10 +107,40 @@ def main(argv=None) -> int:
         # stale — they simply were not exercised
         names = set(args.rule)
         allowlist = [e for e in allowlist if e.rule in names]
-    violations, suppressed = run_checks(
-        iter_repo_modules(root), rules, allowlist
+
+    changed = None
+    if args.changed_files is not None:
+        if args.changed_files.strip() == "auto":
+            changed = git_changed_files(root)
+            if not changed:
+                changed = None  # clean tree: full scan, never a no-op
+        else:
+            changed = [
+                p.strip() for p in args.changed_files.split(",")
+                if p.strip()
+            ]
+
+    violations, suppressed, stats = run_checks_timed(
+        iter_repo_modules(root), rules, allowlist, changed=changed,
     )
-    print(render(violations, suppressed, args.format))
+    if args.format == "json":
+        payload = json.loads(render(violations, suppressed, "json"))
+        payload["rules"] = {
+            name: {
+                "wall_s": round(s["wall_s"], 4),
+                "violations": s["violations"],
+            }
+            for name, s in sorted(stats.items())
+        }
+        payload["changed_files"] = sorted(changed) if changed else None
+        print(json.dumps(payload, indent=2))
+    else:
+        print(render(violations, suppressed, "text"))
+        if changed:
+            print(
+                f"graftcheck: incremental over {len(changed)} changed "
+                f"file(s); whole-program rules ran on the full graph"
+            )
     return 1 if violations else 0
 
 

@@ -1,0 +1,666 @@
+"""The whole-program substrate: module symbol tables + a call graph.
+
+PR 7's rules were per-function, per-module — and the bugs PRs 10-13
+actually fixed (a ``device_get`` buried in a helper module, a lock held
+across a call that re-acquires, a donated buffer read by a live
+dispatch) are whole-program properties. This module gives every rule
+the same cross-module view:
+
+- :class:`FunctionInfo` — one entry per function/method (nested defs
+  included), keyed ``"path::qualname"``.
+- :class:`Program` — the parsed module set, the function table, and the
+  resolved call graph (``calls[caller] -> [CallSite]``).
+
+Resolution is deliberately *under-approximate*: an edge exists only
+when the callee can be named with confidence — module-level functions,
+imported symbols (module-level or function-level imports),
+``self.method`` within a class, constructor calls, locally-typed
+instances (``x = ClassName(...)``), ``self.attr`` instances typed from
+``__init__`` assignments or parameter annotations, methods whose
+return statement is a bare constructor (``return ObservedJit(...)``),
+and — fallback — attribute calls whose method name is defined by
+exactly ONE class repo-wide and is not a generic name (``get``,
+``items``, ``close``, ...). Unresolvable calls produce no edge: the
+whole-program rules under-report rather than false-positive, exactly
+like the local taint pass.
+
+Stdlib-only (``ast``), like the rest of the engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from koordinator_tpu.analysis.graftcheck.engine import ModuleFile, attr_chain
+
+#: attribute-call method names too generic for the unique-method
+#: fallback — resolving these by name alone would invent edges
+#: (queue.get vs SchedulerCache.get, file.close vs proxy.close, ...)
+_GENERIC_METHODS = frozenset({
+    "get", "put", "pop", "add", "set", "close", "open", "start", "stop",
+    "run", "join", "wait", "send", "recv", "read", "write", "flush",
+    "items", "keys", "values", "append", "extend", "insert", "remove",
+    "clear", "copy", "update", "sort", "index", "count", "split",
+    "strip", "format", "encode", "decode", "acquire", "release",
+    "submit", "result", "cancel", "done", "poll", "kill", "terminate",
+    "tick", "reset", "build", "check", "apply", "match", "matches",
+    "name", "status", "snapshot", "emit", "observe", "inc", "dec",
+    "solve", "schedule", "lower", "replace", "_replace", "mark",
+    "register", "notify", "render", "load", "dump", "dumps", "loads",
+})
+
+
+def module_dotted(path: str) -> str:
+    """Repo-relative posix path -> importable dotted name
+    (``a/b/c.py`` -> ``a.b.c``; ``a/b/__init__.py`` -> ``a.b``)."""
+    dotted = path[:-3] if path.endswith(".py") else path
+    if dotted.endswith("/__init__"):
+        dotted = dotted[: -len("/__init__")]
+    return dotted.replace("/", ".")
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function or method in the program."""
+
+    key: str                      # "path::qualname"
+    path: str
+    qualname: str                 # "Class.method" | "func" | "func.inner"
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef
+    class_name: Optional[str]     # enclosing class, if any
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One resolved call edge occurrence."""
+
+    callee: str                   # FunctionInfo key
+    node: ast.Call
+    chain: str                    # the raw dotted callee text
+
+
+class _ModuleTable:
+    """Per-module symbol table used during resolution."""
+
+    def __init__(self, module: ModuleFile):
+        self.module = module
+        self.path = module.path
+        #: name -> ("func", key) | ("class", class name)
+        self.symbols: Dict[str, Tuple[str, str]] = {}
+        #: class name -> {method name -> key}
+        self.methods: Dict[str, Dict[str, str]] = {}
+        #: class name -> base class raw names
+        self.bases: Dict[str, List[str]] = {}
+        #: class name -> {self attr -> class name (possibly dotted import)}
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        #: imported alias -> ("module", dotted) | ("symbol", dotted, name)
+        self.imports: Dict[str, Tuple] = {}
+        #: module-level instance name -> class name (local or imported)
+        self.instances: Dict[str, str] = {}
+        #: method key -> returned class name (bare-constructor returns)
+        self.returns_class: Dict[str, str] = {}
+
+
+class Program:
+    """The parsed module universe plus its resolved call graph."""
+
+    def __init__(self, modules: Sequence[ModuleFile]):
+        self.modules: List[ModuleFile] = list(modules)
+        self.by_path: Dict[str, ModuleFile] = {
+            m.path: m for m in self.modules
+        }
+        self.by_dotted: Dict[str, ModuleFile] = {
+            module_dotted(m.path): m for m in self.modules
+        }
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.calls: Dict[str, List[CallSite]] = {}
+        #: method name -> class keys defining it (unique-method fallback)
+        self._method_owners: Dict[str, List[Tuple[str, str]]] = {}
+        self._tables: Dict[str, _ModuleTable] = {}
+        #: method key -> class name its bare-constructor returns build
+        self._returns_class: Dict[str, str] = {}
+        for m in self.modules:
+            self._tables[m.path] = self._build_table(m)
+        for table in self._tables.values():
+            self._returns_class.update(table.returns_class)
+        # phase 1.5: type module-level/instance-attr bindings whose
+        # value is a METHOD call returning a bare constructor
+        # (``X = DEVICE_OBS.jit("name", jax.jit(...))`` -> ObservedJit);
+        # two rounds let one inferred instance feed the next
+        for _ in range(2):
+            for m in self.modules:
+                self._infer_call_bindings(self._tables[m.path])
+        for m in self.modules:
+            self._resolve_module(m)
+
+    # -- pass 1: symbol tables -----------------------------------------------
+
+    def _build_table(self, module: ModuleFile) -> _ModuleTable:
+        table = _ModuleTable(module)
+        # all imports anywhere in the file (module- AND function-level:
+        # hot-path modules import helpers inside functions to defer jax
+        # deps; one merged table per module is a deliberate, benign
+        # over-share — names practically never collide within a file)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    table.imports[name] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module is None or node.level:
+                    continue  # relative imports unused in this repo
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    maybe_mod = f"{node.module}.{alias.name}"
+                    if maybe_mod in self.by_dotted:
+                        table.imports[name] = ("module", maybe_mod)
+                    else:
+                        table.imports[name] = (
+                            "symbol", node.module, alias.name
+                        )
+        self._collect_defs(module, table, module.tree.body, [], None)
+        return table
+
+    def _collect_defs(self, module: ModuleFile, table: _ModuleTable,
+                      body: List[ast.stmt], scopes: List[str],
+                      class_name: Optional[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(scopes + [stmt.name])
+                key = f"{module.path}::{qual}"
+                info = FunctionInfo(
+                    key=key, path=module.path, qualname=qual, node=stmt,
+                    class_name=class_name,
+                )
+                self.functions[key] = info
+                if class_name is not None and len(scopes) >= 1 \
+                        and scopes[-1] == class_name:
+                    table.methods.setdefault(class_name, {})[
+                        stmt.name] = key
+                    self._method_owners.setdefault(stmt.name, []).append(
+                        (module.path, class_name)
+                    )
+                    if stmt.name == "__init__":
+                        self._collect_attr_types(table, class_name, stmt)
+                    ret = self._bare_constructor_return(stmt)
+                    if ret is not None:
+                        table.returns_class[key] = ret
+                elif not scopes:
+                    table.symbols[stmt.name] = ("func", key)
+                self._collect_defs(
+                    module, table, stmt.body, scopes + [stmt.name],
+                    class_name,
+                )
+            elif isinstance(stmt, ast.ClassDef):
+                if not scopes:
+                    table.symbols[stmt.name] = ("class", stmt.name)
+                    table.bases[stmt.name] = [
+                        attr_chain(b) or "" for b in stmt.bases
+                    ]
+                self._collect_defs(
+                    module, table, stmt.body, scopes + [stmt.name],
+                    stmt.name if not scopes else class_name,
+                )
+            elif isinstance(stmt, ast.Assign) and not scopes:
+                cls = self._constructed_class(stmt.value)
+                if cls is not None:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            table.instances[t.id] = cls
+            elif isinstance(stmt, (ast.If, ast.Try)) and not scopes:
+                # module-level guards (capability gates) still define
+                self._collect_defs(module, table, stmt.body, scopes,
+                                   class_name)
+                for extra in getattr(stmt, "orelse", []) or []:
+                    self._collect_defs(module, table, [extra], scopes,
+                                       class_name)
+
+    @staticmethod
+    def _constructed_class(value: ast.AST) -> Optional[str]:
+        """``ClassName(...)`` (CamelCase heuristic) -> "ClassName";
+        ``obj.method(...)`` whose method returns a bare constructor is
+        resolved later, during the edge pass."""
+        if isinstance(value, ast.Call):
+            chain = attr_chain(value.func)
+            if chain is not None:
+                seg = chain.split(".")[-1]
+                if seg[:1].isupper():
+                    return seg
+        return None
+
+    def _collect_attr_types(self, table: _ModuleTable, class_name: str,
+                            init: ast.FunctionDef) -> None:
+        """``self.attr`` instance types from ``__init__``: direct
+        constructor assignments and parameter pass-throughs whose
+        parameter carries a class annotation (``Optional[T]``
+        included)."""
+        ann: Dict[str, str] = {}
+        args = init.args
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is not None:
+                cls = _annotation_class(a.annotation)
+                if cls is not None:
+                    ann[a.arg] = cls
+        out = table.attr_types.setdefault(class_name, {})
+        for stmt in ast.walk(init):
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            t = stmt.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            cls = self._constructed_class(stmt.value)
+            if cls is None and isinstance(stmt.value, ast.Name):
+                cls = ann.get(stmt.value.id)
+            if cls is not None:
+                out.setdefault(t.attr, cls)
+
+    @staticmethod
+    def _bare_constructor_return(fn: ast.AST) -> Optional[str]:
+        """A method whose only returns are ``return ClassName(...)``
+        types its callers' bindings (``DEVICE_OBS.jit`` ->
+        ``ObservedJit``)."""
+        found: Optional[str] = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                cls = Program._constructed_class(node.value)
+                if cls is None:
+                    return None
+                if found is not None and found != cls:
+                    return None
+                found = cls
+        return found
+
+    # -- pass 1.5: call-return instance typing -------------------------------
+
+    def _call_return_class(self, table: _ModuleTable, call: ast.Call
+                           ) -> Optional[str]:
+        """The class a call provably constructs: a constructor call, or
+        a method whose returns are all one bare constructor."""
+        site = self._resolve_call(table, call, None,
+                                  dict(table.instances))
+        if site is None:
+            return None
+        if site.callee.endswith(".__init__"):
+            return site.callee.rsplit("::", 1)[1].split(".")[0]
+        return self._returns_class.get(site.callee)
+
+    def _infer_call_bindings(self, table: _ModuleTable) -> None:
+        tree = table.module.tree
+        for stmt in ast.walk(tree):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.value, ast.Call):
+                t = stmt.targets[0]
+                cls = None
+                if isinstance(t, ast.Name):
+                    if t.id not in table.instances:
+                        cls = self._call_return_class(table, stmt.value)
+                        if cls is not None:
+                            table.instances[t.id] = cls
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    # which class this `self` belongs to: the enclosing
+                    # ClassDef (found via a parent scan per class)
+                    owner = self._enclosing_class(tree, stmt)
+                    if owner is not None and t.attr not in \
+                            table.attr_types.get(owner, {}):
+                        cls = self._call_return_class(table, stmt.value)
+                        if cls is not None:
+                            table.attr_types.setdefault(
+                                owner, {})[t.attr] = cls
+
+    @staticmethod
+    def _enclosing_class(tree: ast.Module, target: ast.stmt
+                         ) -> Optional[str]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        return node.name
+        return None
+
+    # -- pass 2: call-edge resolution ----------------------------------------
+
+    def _resolve_class(self, table: _ModuleTable, name: str
+                       ) -> Optional[Tuple[_ModuleTable, str]]:
+        """A raw class name in ``table``'s module -> (owning table,
+        class name)."""
+        sym = table.symbols.get(name)
+        if sym is not None and sym[0] == "class":
+            return table, sym[1]
+        imp = table.imports.get(name)
+        if imp is not None and imp[0] == "symbol":
+            target = self.by_dotted.get(imp[1])
+            if target is not None:
+                t2 = self._tables[target.path]
+                sym2 = t2.symbols.get(imp[2])
+                if sym2 is not None and sym2[0] == "class":
+                    return t2, sym2[1]
+        return None
+
+    def _method_key(self, table: _ModuleTable, class_name: str,
+                    method: str, _depth: int = 0
+                    ) -> Optional[str]:
+        """Resolve ``class.method`` in ``table``'s module, walking
+        resolvable base classes."""
+        key = table.methods.get(class_name, {}).get(method)
+        if key is not None:
+            return key
+        if _depth >= 4:
+            return None
+        for base in table.bases.get(class_name, []):
+            resolved = self._resolve_class(table, base.split(".")[-1])
+            if resolved is not None:
+                bt, bname = resolved
+                key = self._method_key(bt, bname, method, _depth + 1)
+                if key is not None:
+                    return key
+        return None
+
+    def _unique_method(self, method: str) -> Optional[Tuple[str, str]]:
+        if method in _GENERIC_METHODS or method.startswith("__"):
+            return None
+        owners = self._method_owners.get(method, [])
+        if len(owners) == 1:
+            return owners[0]
+        return None
+
+    def _resolve_module_table(self, dotted: str) -> Optional[_ModuleTable]:
+        mod = self.by_dotted.get(dotted)
+        return self._tables[mod.path] if mod is not None else None
+
+    def _resolve_module(self, module: ModuleFile) -> None:
+        table = self._tables[module.path]
+        self._resolve_body(
+            table, module.tree.body, [], None, dict(table.instances)
+        )
+
+    def _resolve_body(self, table: _ModuleTable, body: List[ast.stmt],
+                      scopes: List[str], class_name: Optional[str],
+                      local_types: Dict[str, str]) -> None:
+        """Walk one scope level: collect this scope's call edges and
+        recurse into nested defs with fresh local type maps. Compound
+        statements (``with``/``if``/``for``/``try``) are walked as
+        statement lists so local instance typing survives into their
+        bodies — the hot classes do nearly everything under ``with
+        self._lock:``."""
+        caller = ".".join(scopes) if scopes else "<module>"
+        caller_key = f"{table.path}::{caller}"
+
+        def emit_calls(expr: Optional[ast.AST]) -> None:
+            """Resolve every Call in an expression tree, pruned at
+            nested function defs (their own scope pass owns those);
+            lambda bodies stay attributed to this caller."""
+            if expr is None:
+                return
+            stack = [expr]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.Call):
+                    site = self._resolve_call(
+                        table, node, class_name, local_types
+                    )
+                    if site is not None:
+                        self.calls.setdefault(
+                            caller_key, []).append(site)
+                stack.extend(ast.iter_child_nodes(node))
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in stmt.decorator_list:
+                    emit_calls(dec)
+                self._resolve_body(
+                    table, stmt.body, scopes + [stmt.name], class_name,
+                    dict(local_types),
+                )
+                # defining a nested function gives the parent a
+                # may-invoke edge (closures run on the parent's behalf)
+                nested_key = (
+                    f"{table.path}::{'.'.join(scopes + [stmt.name])}"
+                )
+                if scopes and nested_key in self.functions:
+                    self.calls.setdefault(caller_key, []).append(CallSite(
+                        callee=nested_key, node=None, chain=stmt.name,
+                    ))
+            elif isinstance(stmt, ast.ClassDef):
+                for dec in stmt.decorator_list:
+                    emit_calls(dec)
+                self._resolve_body(
+                    table, stmt.body, scopes + [stmt.name],
+                    stmt.name if class_name is None else class_name,
+                    dict(local_types),
+                )
+            elif isinstance(stmt, ast.Assign):
+                # local instance typing: x = ClassName(...) / x = self.attr
+                if len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    name = stmt.targets[0].id
+                    cls = self._constructed_class(stmt.value)
+                    if cls is None \
+                            and isinstance(stmt.value, ast.Attribute) \
+                            and isinstance(stmt.value.value, ast.Name) \
+                            and stmt.value.value.id == "self" \
+                            and class_name is not None:
+                        cls = table.attr_types.get(class_name, {}).get(
+                            stmt.value.attr
+                        )
+                    if cls is not None:
+                        local_types[name] = cls
+                emit_calls(stmt.value)
+                for t in stmt.targets:
+                    emit_calls(t)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                emit_calls(stmt.test)
+                self._resolve_body(table, stmt.body, scopes, class_name,
+                                   local_types)
+                self._resolve_body(table, stmt.orelse, scopes,
+                                   class_name, local_types)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                emit_calls(stmt.iter)
+                emit_calls(stmt.target)
+                self._resolve_body(table, stmt.body, scopes, class_name,
+                                   local_types)
+                self._resolve_body(table, stmt.orelse, scopes,
+                                   class_name, local_types)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    emit_calls(item.context_expr)
+                self._resolve_body(table, stmt.body, scopes, class_name,
+                                   local_types)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._resolve_body(table, block, scopes, class_name,
+                                       local_types)
+                for handler in stmt.handlers:
+                    self._resolve_body(table, handler.body, scopes,
+                                       class_name, local_types)
+            elif isinstance(stmt, ast.Match):
+                emit_calls(stmt.subject)
+                for case in stmt.cases:
+                    emit_calls(case.guard)
+                    self._resolve_body(table, case.body, scopes,
+                                       class_name, local_types)
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    emit_calls(child)
+
+    def _resolve_call(self, table: _ModuleTable, call: ast.Call,
+                      class_name: Optional[str],
+                      local_types: Dict[str, str]) -> Optional[CallSite]:
+        func = call.func
+        chain = attr_chain(func) or ""
+        if isinstance(func, ast.Name):
+            return self._resolve_name_call(table, call, func.id, chain)
+        if not isinstance(func, ast.Attribute):
+            return None
+        method = func.attr
+        base = func.value
+        # self.method(...)
+        if isinstance(base, ast.Name) and base.id == "self" \
+                and class_name is not None:
+            key = self._method_key(table, class_name, method)
+            if key is not None:
+                return CallSite(callee=key, node=call, chain=chain)
+            return None
+        base_chain = attr_chain(base)
+        owner = None  # (table, class) of the receiver instance
+        if isinstance(base, ast.Name):
+            # imported module alias: mod.func(...)
+            imp = table.imports.get(base.id)
+            if imp is not None and imp[0] == "module":
+                t2 = self._resolve_module_table(imp[1])
+                if t2 is not None:
+                    return self._resolve_symbol_call(
+                        t2, call, method, chain
+                    )
+            # local / module-level instance, or a class name
+            cls = local_types.get(base.id) or table.instances.get(base.id)
+            if cls is None:
+                resolved = self._resolve_class(table, base.id)
+                if resolved is not None:
+                    owner = resolved
+            else:
+                owner = self._owner_for_class(table, cls)
+            if owner is None and cls is None and imp is not None \
+                    and imp[0] == "symbol":
+                # imported NAME that is a module-level instance there
+                t2 = self._resolve_module_table(imp[1])
+                if t2 is not None:
+                    cls2 = t2.instances.get(imp[2])
+                    if cls2 is not None:
+                        owner = self._owner_for_class(t2, cls2)
+        elif base_chain is not None and base_chain.startswith("self.") \
+                and base_chain.count(".") == 1 and class_name is not None:
+            attr = base_chain.split(".")[1]
+            cls = table.attr_types.get(class_name, {}).get(attr)
+            if cls is not None:
+                owner = self._owner_for_class(table, cls)
+        if owner is not None:
+            t2, cls_name = owner
+            key = self._method_key(t2, cls_name, method)
+            if key is not None:
+                return CallSite(callee=key, node=call, chain=chain)
+            return None
+        # unique-method fallback (distinctive names only)
+        unique = self._unique_method(method)
+        if unique is not None:
+            path, cls_name = unique
+            t2 = self._tables[path]
+            key = t2.methods.get(cls_name, {}).get(method)
+            if key is not None:
+                return CallSite(callee=key, node=call, chain=chain)
+        return None
+
+    def _owner_for_class(self, table: _ModuleTable, cls: str
+                         ) -> Optional[Tuple[_ModuleTable, str]]:
+        resolved = self._resolve_class(table, cls.split(".")[-1])
+        if resolved is not None:
+            return resolved
+        # class defined in SOME module, unique by name
+        owners = [
+            (p, c) for p, t in self._tables.items()
+            for c in t.methods if c == cls.split(".")[-1]
+        ]
+        if len(owners) == 1:
+            p, c = owners[0]
+            return self._tables[p], c
+        return None
+
+    def _resolve_name_call(self, table: _ModuleTable, call: ast.Call,
+                           name: str, chain: str) -> Optional[CallSite]:
+        sym = table.symbols.get(name)
+        if sym is not None:
+            if sym[0] == "func":
+                return CallSite(callee=sym[1], node=call, chain=chain)
+            key = self._method_key(table, sym[1], "__init__")
+            if key is not None:
+                return CallSite(callee=key, node=call, chain=chain)
+            return None
+        imp = table.imports.get(name)
+        if imp is not None and imp[0] == "symbol":
+            t2 = self._resolve_module_table(imp[1])
+            if t2 is not None:
+                return self._resolve_symbol_call(t2, call, imp[2], chain)
+        return None
+
+    def _resolve_symbol_call(self, table: _ModuleTable, call: ast.Call,
+                             name: str, chain: str) -> Optional[CallSite]:
+        sym = table.symbols.get(name)
+        if sym is not None:
+            if sym[0] == "func":
+                return CallSite(callee=sym[1], node=call, chain=chain)
+            key = self._method_key(table, sym[1], "__init__")
+            if key is not None:
+                return CallSite(callee=key, node=call, chain=chain)
+            return None
+        # a module-level instance: calling it dispatches to __call__;
+        # its methods resolve through the instance's class
+        cls = table.instances.get(name)
+        if cls is not None:
+            resolved = self._owner_for_class(table, cls)
+            if resolved is not None:
+                t2, cls_name = resolved
+                key = self._method_key(t2, cls_name, "__call__")
+                if key is not None:
+                    return CallSite(callee=key, node=call, chain=chain)
+        return None
+
+    # -- queries -------------------------------------------------------------
+
+    def callees(self, key: str) -> List[CallSite]:
+        return self.calls.get(key, [])
+
+    def module_table(self, path: str) -> Optional[_ModuleTable]:
+        return self._tables.get(path)
+
+    def instance_class(self, path: str, name: str) -> Optional[str]:
+        """Module-level instance name -> class name (for rule configs
+        that reference singletons)."""
+        t = self._tables.get(path)
+        return t.instances.get(name) if t is not None else None
+
+    def attr_type(self, path: str, class_name: str, attr: str
+                  ) -> Optional[str]:
+        t = self._tables.get(path)
+        if t is None:
+            return None
+        return t.attr_types.get(class_name, {}).get(attr)
+
+
+def _annotation_class(ann: ast.AST) -> Optional[str]:
+    """``T`` / ``Optional[T]`` / ``"T"`` annotation -> class name."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.split(".")[-1].strip()
+        return name if name[:1].isupper() else None
+    if isinstance(ann, ast.Subscript):
+        head = attr_chain(ann.value) or ""
+        if head.split(".")[-1] in ("Optional", "Union"):
+            inner = ann.slice
+            if isinstance(inner, ast.Tuple):
+                cands = [
+                    _annotation_class(e) for e in inner.elts
+                    if not (isinstance(e, ast.Constant)
+                            and e.value is None)
+                ]
+                cands = [c for c in cands if c is not None]
+                return cands[0] if len(cands) == 1 else None
+            return _annotation_class(inner)
+        return None
+    chain = attr_chain(ann)
+    if chain is not None:
+        name = chain.split(".")[-1]
+        return name if name[:1].isupper() else None
+    return None
+
+
+def build_program(modules: Sequence[ModuleFile]) -> Program:
+    return Program(modules)

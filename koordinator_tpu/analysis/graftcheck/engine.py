@@ -187,25 +187,76 @@ def attr_chain(node: ast.AST) -> Optional[str]:
     return None
 
 
-def run_checks(
+def run_checks_timed(
     modules: Iterable[ModuleFile],
     rules: Sequence,
     allowlist: Sequence[AllowEntry] = (),
-) -> Tuple[List[Violation], List[Violation]]:
+    changed: Optional[Sequence[str]] = None,
+) -> Tuple[List[Violation], List[Violation], Dict[str, Dict[str, float]]]:
     """Run ``rules`` over ``modules``; returns ``(violations,
-    suppressed)``. Engine-level findings ride the same stream: an
-    allowlist entry with no written reason, and a stale entry that no
-    current violation needs, are violations too (the allowlist must not
-    rot into a blanket mute)."""
+    suppressed, rule_stats)`` where ``rule_stats[name]`` carries the
+    rule's wall seconds and violation count. Engine-level findings ride
+    the same stream: an allowlist entry with no written reason, and a
+    stale entry that no current violation needs, are violations too
+    (the allowlist must not rot into a blanket mute).
+
+    Two rule shapes coexist: local rules expose ``check(module)``;
+    whole-program rules expose ``check_program(program)`` and receive a
+    :class:`~koordinator_tpu.analysis.graftcheck.callgraph.Program`
+    built once over the full module set.
+
+    ``changed`` (repo-relative paths) is the incremental mode: local
+    rules scan only the changed modules, while whole-program rules
+    still analyze the FULL program (their properties span files a diff
+    never names). Allowlist staleness is then only judged for entries
+    an incremental run could have re-validated — whole-program rules,
+    or local-rule entries on a changed path."""
+    import time as _time
+
+    module_list = list(modules)
+    changed_set = set(changed) if changed is not None else None
     raw: List[Violation] = []
     seen = set()
-    for module in modules:
-        for rule in rules:
-            for v in rule.check(module):
-                key = (v.rule, v.path, v.line, v.col, v.symbol)
-                if key not in seen:
-                    seen.add(key)
-                    raw.append(v)
+    stats: Dict[str, Dict[str, float]] = {}
+    program_rule_names = set()
+    program = None
+    if any(hasattr(r, "check_program") for r in rules):
+        # built once, lazily: a local-rules-only run (--rule=dead-import,
+        # legacy run_checks callers with rule subsets) never pays the
+        # cross-module resolution. The build is real work — reported
+        # under its own stats key so JSON wall times sum to the truth.
+        from koordinator_tpu.analysis.graftcheck.callgraph import (
+            build_program,
+        )
+
+        t0 = _time.perf_counter()
+        program = build_program(module_list)
+        stats["<call-graph>"] = {
+            "wall_s": _time.perf_counter() - t0, "found": 0,
+        }
+    for rule in rules:
+        t0 = _time.perf_counter()
+        found: List[Violation] = []
+        if hasattr(rule, "check_program"):
+            program_rule_names.add(rule.name)
+            found.extend(rule.check_program(program))
+        else:
+            for module in module_list:
+                if changed_set is not None \
+                        and module.path not in changed_set:
+                    continue
+                found.extend(rule.check(module))
+        kept = 0
+        for v in found:
+            key = (v.rule, v.path, v.line, v.col, v.symbol)
+            if key not in seen:
+                seen.add(key)
+                raw.append(v)
+                kept += 1
+        stats[rule.name] = {
+            "wall_s": _time.perf_counter() - t0,
+            "found": kept,
+        }
     violations: List[Violation] = []
     suppressed: List[Violation] = []
     for v in raw:
@@ -220,6 +271,14 @@ def run_checks(
         else:
             violations.append(v)
     for entry in allowlist:
+        skip_staleness = (
+            changed_set is not None
+            and entry.rule not in program_rule_names
+            and entry.path not in changed_set
+        )
+        # the justification check needs no rescan — it must hold even
+        # in incremental runs (check.sh's default), or an unjustified
+        # entry would sail through the very gate it's meant to face
         if not entry.reason.strip():
             violations.append(Violation(
                 rule="allowlist-justification", path="graftcheck.toml",
@@ -230,7 +289,10 @@ def run_checks(
                     f"carries no written justification"
                 ),
             ))
-        if not entry.used:
+        if not entry.used and not skip_staleness:
+            # staleness IS unknowable incrementally: this entry's rule
+            # never rescanned its file, so "matches no violation" would
+            # be an artifact of the narrowed scan, not a finding
             violations.append(Violation(
                 rule="stale-allowlist", path="graftcheck.toml",
                 line=entry.lineno, col=0, func="<allowlist>",
@@ -242,6 +304,25 @@ def run_checks(
                 ),
             ))
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    for v in violations:
+        if v.rule in stats:
+            stats[v.rule]["violations"] = \
+                stats[v.rule].get("violations", 0) + 1
+    for name in stats:
+        stats[name].setdefault("violations", 0)
+    return violations, suppressed, stats
+
+
+def run_checks(
+    modules: Iterable[ModuleFile],
+    rules: Sequence,
+    allowlist: Sequence[AllowEntry] = (),
+) -> Tuple[List[Violation], List[Violation]]:
+    """Compatibility wrapper over :func:`run_checks_timed` — the
+    original ``(violations, suppressed)`` pair, full scan."""
+    violations, suppressed, _ = run_checks_timed(
+        modules, rules, allowlist
+    )
     return violations, suppressed
 
 

@@ -11,6 +11,13 @@ from __future__ import annotations
 from koordinator_tpu.analysis.graftcheck.rules.dead_import import (
     DeadImportRule,
 )
+from koordinator_tpu.analysis.graftcheck.rules.determinism import (
+    DeterminismRule,
+)
+from koordinator_tpu.analysis.graftcheck.rules.donation import (
+    DonationRule,
+    PinSpec,
+)
 from koordinator_tpu.analysis.graftcheck.rules.host_sync import HostSyncRule
 from koordinator_tpu.analysis.graftcheck.rules.jit_hygiene import (
     JitHygieneRule,
@@ -19,9 +26,16 @@ from koordinator_tpu.analysis.graftcheck.rules.lock_discipline import (
     LockDisciplineRule,
     LockSpec,
 )
+from koordinator_tpu.analysis.graftcheck.rules.lock_order import (
+    LockNode,
+    LockOrderRule,
+)
 from koordinator_tpu.analysis.graftcheck.rules.parity import (
     DeltaParityRule,
     ParitySpec,
+)
+from koordinator_tpu.analysis.graftcheck.rules.sync_reach import (
+    SyncReachRule,
 )
 
 #: the solve hot path: modules where a stray host sync, implicit jit
@@ -202,6 +216,45 @@ PARITY_SPECS = (
 )
 
 
+#: every mapped lock as a node of the whole-program lock-order graph:
+#: the twelve LockSpec classes' primary locks plus the observatory's
+#: documented secondary lock (``_profile_io_lock`` OUTER, ``_lock``
+#: inner — obs/device.py) so the documented order is machine-checked
+#: RLock-backed classes: same-instance re-acquisition is legal, so the
+#: static pass suppresses their self-edges (scheduler/cache.py:42,
+#: scheduler/auditor.py:109)
+_REENTRANT_CLASSES = frozenset({"SchedulerCache", "StateAuditor"})
+
+LOCK_NODES = tuple(
+    LockNode(path=spec.path, class_name=spec.class_name, lock=spec.lock,
+             reentrant=spec.class_name in _REENTRANT_CLASSES)
+    for spec in LOCK_SPECS
+) + (
+    LockNode(path="koordinator_tpu/obs/device.py",
+             class_name="DeviceObservatory", lock="_profile_io_lock"),
+)
+
+#: pin protocols the donation-safety rule enforces: the staged device
+#: generation may only be donated when provably not held by an
+#: in-flight solve (the PR 11 scatter-clobber invariant)
+PIN_SPECS = (
+    PinSpec(
+        path="koordinator_tpu/models/placement.py",
+        class_name="StagedStateCache",
+        attr="state",
+        pin_attr="_pinned",
+    ),
+)
+
+#: determinism-taint scope: the hot modules plus the wire codec and its
+#: client/server callers — everything whose outputs the oracle parity
+#: and chaos bit-identity tests compare
+DETERMINISM_MODULES = HOT_MODULES + (
+    "koordinator_tpu/service/codec.py",
+    "koordinator_tpu/service/client.py",
+)
+
+
 def default_rules():
     return (
         HostSyncRule(scope=HOT_MODULES),
@@ -209,19 +262,34 @@ def default_rules():
         DeltaParityRule(specs=PARITY_SPECS),
         JitHygieneRule(scope=HOT_MODULES),
         DeadImportRule(scope=HOT_MODULES),
+        # whole-program passes (ISSUE 9): cross-module sync taint, the
+        # lock acquisition order, donation liveness, determinism taint
+        SyncReachRule(scope=HOT_MODULES),
+        LockOrderRule(locks=LOCK_NODES),
+        DonationRule(pin_specs=PIN_SPECS),
+        DeterminismRule(scope=DETERMINISM_MODULES),
     )
 
 
 __all__ = [
+    "DETERMINISM_MODULES",
     "HOT_MODULES",
+    "LOCK_NODES",
     "LOCK_SPECS",
     "PARITY_SPECS",
+    "PIN_SPECS",
     "DeadImportRule",
     "DeltaParityRule",
+    "DeterminismRule",
+    "DonationRule",
     "HostSyncRule",
     "JitHygieneRule",
     "LockDisciplineRule",
+    "LockNode",
+    "LockOrderRule",
     "LockSpec",
     "ParitySpec",
+    "PinSpec",
+    "SyncReachRule",
     "default_rules",
 ]

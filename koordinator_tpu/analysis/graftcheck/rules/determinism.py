@@ -1,0 +1,379 @@
+"""determinism-taint: nondeterminism must never reach device or wire.
+
+The whole verification story — oracle bit-parity, chaos runs ending
+bit-identical to fault-free runs, the sidecar solving byte-identically
+to in-process — rests on the solve being a pure function of its typed
+inputs. Wall clock (``time.time``), unseeded RNGs (``random.*``,
+``os.urandom``, unseeded ``random.Random()``/``np.random.default_rng()``),
+``uuid.uuid4``, and set iteration order (hash-seed dependent) are all
+fine in telemetry — and poison in anything the parity tests compare.
+
+This rule runs a local taint analysis (the host-sync rule's shape) over
+the scoped modules:
+
+- **sources**: wall-clock/monotonic reads, unseeded RNG draws,
+  ``os.urandom``/``uuid4``/``secrets``, and materializing a set's
+  iteration order (``list(s)``/``tuple(s)``/comprehension over a
+  set-typed value);
+- **launder**: ``sorted()``, ``min``/``max``/``len``/``sum``/``any``/
+  ``all`` (order-insensitive; device values here are integer
+  arithmetic end to end, DESIGN.md §2), and seeding (``random.Random(
+  seed)``, ``default_rng(seed)``);
+- **sinks**: device staging (``jnp.*``, ``jax.device_put``, jitted
+  producers discovered from ``X = jax.jit(...)`` bindings, the
+  configured producer set) and wire frames (``encode_request``/
+  ``encode_response``/``write_frame`` and the ``SolveRequest``/
+  ``SolveResponse`` constructors).
+
+A tainted value reaching a sink is a violation. Declared time inputs
+(``snapshot.now``) are parameters, never tainted — the rule flags the
+*introduction* of wall clock into the data plane, not its modeled use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    attr_chain,
+)
+
+#: dotted chains whose CALL yields a nondeterministic value
+_SOURCE_CHAINS = frozenset({
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "os.urandom", "uuid.uuid4", "uuid.uuid1",
+    "secrets.token_bytes", "secrets.token_hex", "secrets.randbits",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+})
+
+#: ``random.X(...)`` module-level draws (the shared, unseeded RNG)
+_RANDOM_FNS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "random_sample", "normal",
+    "getrandbits",
+})
+
+#: order-insensitive folds that launder set-iteration taint, and
+#: scalar launders for RNG/time taint where order is the only hazard
+_LAUNDER_FNS = frozenset({
+    "sorted", "len", "min", "max", "sum", "any", "all", "frozenset",
+})
+
+#: sequence constructors that MATERIALIZE iteration order
+_ORDER_MATERIALIZERS = frozenset({"list", "tuple"})
+
+#: wire-frame sinks (service/codec.py surface)
+_WIRE_SINKS = frozenset({
+    "encode_request", "encode_response", "write_frame", "_pack",
+})
+_WIRE_CTORS = frozenset({"SolveRequest", "SolveResponse"})
+
+#: device-staging producers (mirrors host_sync.DEFAULT_PRODUCERS plus
+#: the explicit staging entry points)
+_DEVICE_SINKS = frozenset({
+    "device_put",  # jnp.asarray/jnp.array ride the jnp-root check
+    "stage_nodes", "stage_pods", "solve_batch", "schedule_batch",
+    "pallas_solve_batch", "scatter_node_rows_donated",
+    "scatter_node_rows_copied", "_dispatch_solve", "_solve",
+})
+
+
+def _last_seg(chain: str) -> str:
+    return chain.split(".")[-1] if chain else ""
+
+
+class DeterminismRule:
+    name = "determinism-taint"
+    description = (
+        "wall clock, unseeded RNGs, and set iteration order never flow "
+        "into device values or wire frames (bit-parity inputs)"
+    )
+
+    def __init__(self, scope: Sequence[str]):
+        self.scope = tuple(scope)
+
+    # -- taint classification ------------------------------------------------
+
+    def _call_taint(self, call: ast.Call, tainted: Set[str],
+                    sets: Set[str]) -> Optional[str]:
+        """Taint label a call's RESULT carries, else None."""
+        chain = attr_chain(call.func) or ""
+        seg = _last_seg(chain)
+        if chain in _SOURCE_CHAINS:
+            return chain
+        root = chain.split(".")[0] if chain else ""
+        if root in ("random", "np.random", "numpy.random") or (
+            root == "np" and chain.startswith("np.random.")
+        ):
+            if seg in _RANDOM_FNS:
+                return chain
+            if seg == "default_rng" and not call.args:
+                return chain + "()"
+        if chain == "random.Random" and not call.args:
+            return "random.Random()"
+        if seg in _LAUNDER_FNS:
+            return None
+        if seg in _ORDER_MATERIALIZERS and call.args:
+            if self._is_set_valued(call.args[0], sets):
+                return f"{seg}(<set>)"
+        # propagate through arbitrary calls on tainted receivers/args
+        # (str(t), t.hex(), jnp.float32(t)...) — a transform of a
+        # nondeterministic value stays nondeterministic
+        for sub in list(call.args) + [kw.value for kw in call.keywords]:
+            if self._tainted(sub, tainted, sets):
+                return self._expr_taint_label(sub, tainted, sets)
+        if isinstance(call.func, ast.Attribute) and self._tainted(
+            call.func.value, tainted, sets
+        ):
+            return self._expr_taint_label(call.func.value, tainted, sets)
+        return None
+
+    def _is_set_valued(self, node: ast.AST, sets: Set[str]) -> bool:
+        if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+            return True
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func) or ""
+            if chain == "set" or _last_seg(chain) == "frozenset":
+                return True
+            # set operations keep set-ness (s.union(t), s & t)
+            if isinstance(node.func, ast.Attribute) and \
+                    self._is_set_valued(node.func.value, sets):
+                return True
+        if isinstance(node, ast.Name):
+            return node.id in sets
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitAnd, ast.BitOr, ast.BitXor, ast.Sub)
+        ):
+            return self._is_set_valued(node.left, sets) or \
+                self._is_set_valued(node.right, sets)
+        return False
+
+    def _expr_taint_label(self, node: ast.AST, tainted: Set[str],
+                          sets: Set[str]) -> str:
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return node.id
+        chain = attr_chain(node)
+        if chain is not None and chain in tainted:
+            return chain
+        if isinstance(node, ast.Call):
+            label = self._call_taint(node, tainted, sets)
+            if label is not None:
+                return label
+        return "<nondet>"
+
+    def _tainted(self, node: ast.AST, tainted: Set[str],
+                 sets: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if chain is not None and chain in tainted:
+                return True
+            return self._tainted(node.value, tainted, sets)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node, tainted, sets) is not None
+        if isinstance(node, ast.Subscript):
+            return self._tainted(node.value, tainted, sets)
+        if isinstance(node, ast.BinOp):
+            return self._tainted(node.left, tainted, sets) or \
+                self._tainted(node.right, tainted, sets)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand, tainted, sets)
+        if isinstance(node, ast.IfExp):
+            return self._tainted(node.body, tainted, sets) or \
+                self._tainted(node.orelse, tainted, sets)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._tainted(e, tainted, sets)
+                       for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(
+                self._tainted(v, tainted, sets)
+                for v in list(node.keys) + list(node.values)
+                if v is not None
+            )
+        if isinstance(node, ast.Starred):
+            return self._tainted(node.value, tainted, sets)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            # comprehension over a set-typed iterable materializes its
+            # order; a tainted element expression taints too
+            for gen in node.generators:
+                if self._is_set_valued(gen.iter, sets):
+                    return True
+            return self._tainted(node.elt, tainted, sets)
+        if isinstance(node, ast.NamedExpr):
+            return self._tainted(node.value, tainted, sets)
+        return False
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _sink_kind(self, call: ast.Call,
+                   producers: Set[str]) -> Optional[str]:
+        chain = attr_chain(call.func) or ""
+        seg = _last_seg(chain)
+        root = chain.split(".")[0] if chain else ""
+        if seg in _WIRE_SINKS or seg in _WIRE_CTORS:
+            return "wire frame"
+        if root == "jnp" or chain == "jax.device_put":
+            return "device value"
+        if seg in _DEVICE_SINKS or seg in producers:
+            return "device value"
+        return None
+
+    # -- statement walk ------------------------------------------------------
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        if not module.matches(self.scope):
+            return []
+        out: List[Violation] = []
+        producers: Set[str] = set()
+        # discover jitted bindings: X = jax.jit(...) makes X a device
+        # sink for this module
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                chain = attr_chain(node.value.func) or ""
+                if _last_seg(chain) in ("jit", "pjit"):
+                    for t in node.targets:
+                        seg = (
+                            t.attr if isinstance(t, ast.Attribute)
+                            else t.id if isinstance(t, ast.Name)
+                            else None
+                        )
+                        if seg is not None:
+                            producers.add(seg)
+        self._scan(module.tree.body, set(), set(), producers, [],
+                   module.path, out)
+        return out
+
+    def _scan(self, stmts, tainted: Set[str], sets: Set[str],
+              producers: Set[str], scopes: List[str], path: str,
+              out: List[Violation]) -> None:
+        qualname = ".".join(scopes) if scopes else "<module>"
+
+        def check_expr(expr: Optional[ast.AST]) -> None:
+            if expr is None:
+                return
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                kind = self._sink_kind(sub, producers)
+                if kind is None:
+                    continue
+                for a in list(sub.args) + [
+                    kw.value for kw in sub.keywords
+                ]:
+                    if self._tainted(a, tainted, sets):
+                        label = self._expr_taint_label(a, tainted, sets)
+                        chain = attr_chain(sub.func) or "?"
+                        out.append(Violation(
+                            rule=self.name, path=path,
+                            line=sub.lineno, col=sub.col_offset,
+                            func=qualname, symbol=label,
+                            message=(
+                                f"nondeterministic value ({label}) "
+                                f"flows into {kind} via {chain}(...) — "
+                                f"bit-parity poisoned"
+                            ),
+                        ))
+                        break
+
+        def assign(target: ast.AST, is_tainted: bool,
+                   is_set: bool) -> None:
+            if isinstance(target, ast.Name):
+                (tainted.add if is_tainted else
+                 tainted.discard)(target.id)
+                (sets.add if is_set else sets.discard)(target.id)
+            elif isinstance(target, ast.Attribute):
+                chain = attr_chain(target)
+                if chain is not None:
+                    (tainted.add if is_tainted else
+                     tainted.discard)(chain)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for e in target.elts:
+                    assign(e, is_tainted, is_set)
+            elif isinstance(target, ast.Starred):
+                assign(target.value, is_tainted, is_set)
+
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self._scan(stmt.body, set(tainted), set(sets),
+                           set(producers), scopes + [stmt.name], path,
+                           out)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                check_expr(value)
+                if value is None:
+                    continue
+                is_t = self._tainted(value, tainted, sets)
+                is_s = self._is_set_valued(value, sets)
+                targets = stmt.targets if isinstance(
+                    stmt, ast.Assign) else [stmt.target]
+                for t in targets:
+                    assign(t, is_t, is_s)
+            elif isinstance(stmt, ast.AugAssign):
+                check_expr(stmt.value)
+                if self._tainted(stmt.value, tainted, sets):
+                    assign(stmt.target, True, False)
+            elif isinstance(stmt, (ast.Expr, ast.Return)):
+                check_expr(stmt.value)
+            elif isinstance(stmt, ast.If):
+                check_expr(stmt.test)
+                self._scan(stmt.body, tainted, sets, producers, scopes,
+                           path, out)
+                self._scan(stmt.orelse, tainted, sets, producers,
+                           scopes, path, out)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                check_expr(stmt.iter)
+                # iterating a set binds loop vars in hash order; the
+                # VALUES are deterministic, the ORDER is not — the loop
+                # var itself is only order-tainted when its iteration
+                # order is materialized into a sequence, which the
+                # comprehension/list()/tuple() cases cover. A plain
+                # tainted iterable taints the loop var.
+                assign(stmt.target,
+                       self._tainted(stmt.iter, tainted, sets), False)
+                self._scan(stmt.body, tainted, sets, producers, scopes,
+                           path, out)
+                self._scan(stmt.orelse, tainted, sets, producers,
+                           scopes, path, out)
+            elif isinstance(stmt, ast.While):
+                check_expr(stmt.test)
+                self._scan(stmt.body, tainted, sets, producers, scopes,
+                           path, out)
+                self._scan(stmt.orelse, tainted, sets, producers,
+                           scopes, path, out)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    check_expr(item.context_expr)
+                    if item.optional_vars is not None:
+                        assign(
+                            item.optional_vars,
+                            self._tainted(item.context_expr, tainted,
+                                          sets),
+                            False,
+                        )
+                self._scan(stmt.body, tainted, sets, producers, scopes,
+                           path, out)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._scan(block, tainted, sets, producers, scopes,
+                               path, out)
+                for handler in stmt.handlers:
+                    self._scan(handler.body, tainted, sets, producers,
+                               scopes, path, out)
+            elif isinstance(stmt, ast.Match):
+                check_expr(stmt.subject)
+                for case in stmt.cases:
+                    check_expr(case.guard)
+                    self._scan(case.body, tainted, sets, producers,
+                               scopes, path, out)
+            elif isinstance(stmt, (ast.Raise, ast.Assert, ast.Delete)):
+                for child in ast.iter_child_nodes(stmt):
+                    check_expr(child)

@@ -1,0 +1,469 @@
+"""donation-safety: a donated buffer must be provably dead afterwards.
+
+``donate_argnums`` is the staging path's double-buffering lever (PR 6)
+— and its sharpest knife. XLA reuses the donated buffer for the
+output, so ANY later read of the donated value reads clobbered memory:
+exactly the PR 11 scatter-clobber (the pipelined prestage donated the
+staged generation a dispatched-but-unretired solve was still reading;
+fixed by hand with ``scatter_node_rows_copied`` + pin bookkeeping).
+This rule turns that fix into a machine-checked invariant:
+
+1. **Donating callables** are discovered repo-wide from the binding
+   idiom: ``X = jax.jit(f, donate_argnums=(...))`` (non-empty), bare or
+   wrapped (``DEVICE_OBS.jit("name", jax.jit(f, donate_argnums=...))``),
+   module-level or ``self.X = ...``, plus ``@partial(jax.jit,
+   donate_argnums=...)`` decorators.
+2. **Liveness**: at every call site of a donating callable, each
+   donated positional argument that names a value (``x`` /
+   ``self.attr``) must be dead after the call — the call's own
+   statement reassigns it, or no later statement (straight-line
+   suffix, enclosing blocks, loop wrap-around) reads it before a
+   reassignment.
+3. **Pin guards** (:class:`PinSpec`): an attribute that participates
+   in a pin protocol (``StagedStateCache.state`` vs ``_pinned``) may
+   only be donated inside a branch that proved ``attr is not pinned``
+   — the un-guarded donation IS the PR 11 bug shape, flagged even
+   though the attr is immediately reassigned.
+
+Complex-expression arguments (a temporary like ``donated(f(x), ...)``)
+are dead by construction and skipped. The analysis under-reports
+(reads hidden behind aliases or escapes into containers are not
+tracked); what it does flag is mechanically a use-after-free on
+device memory.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    attr_chain,
+)
+from koordinator_tpu.analysis.graftcheck.callgraph import Program
+
+
+@dataclasses.dataclass(frozen=True)
+class PinSpec:
+    """An attribute under a pin protocol: donating ``self.<attr>`` in
+    ``class_name`` requires an enclosing ``<attr> is/is not <pin_attr>``
+    guard proving the generation is not pinned."""
+
+    path: str
+    class_name: str
+    attr: str          # e.g. "state"
+    pin_attr: str      # e.g. "_pinned"
+
+
+def _jit_donate_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Non-empty donate_argnums of a (possibly wrapped) jit factory
+    call, else None."""
+    chain = attr_chain(call.func) or ""
+    seg = chain.split(".")[-1] if chain else ""
+    if seg in ("jit", "pjit"):
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                nums = _int_tuple(kw.value)
+                if nums:
+                    return nums
+        # wrapped: the declaration may live on an inner factory arg
+        for a in call.args:
+            if isinstance(a, ast.Call):
+                inner = _jit_donate_argnums(a)
+                if inner:
+                    return inner
+        return None
+    if seg == "partial" and call.args:
+        head = attr_chain(call.args[0]) or ""
+        if head.split(".")[-1] in ("jit", "pjit"):
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    return _int_tuple(kw.value)
+    return None
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    return None
+
+
+def _target_chain(node: ast.AST) -> Optional[str]:
+    """A donated argument worth tracking: a bare name or a self-attr
+    chain (``x``, ``self.state``). Anything else is a temporary."""
+    if isinstance(node, ast.Name):
+        return node.id
+    chain = attr_chain(node)
+    if chain is not None and chain.startswith("self."):
+        return chain
+    return None
+
+
+def _reads(node: ast.AST, chain: str) -> Optional[ast.AST]:
+    """First read of ``chain`` anywhere under ``node`` (load context;
+    an exact-store is not a read, but a read of a longer chain rooted
+    at it — ``self.state.alloc`` after donating ``self.state`` — is)."""
+    parts = chain.split(".")
+    for sub in ast.walk(node):
+        got = None
+        if isinstance(sub, ast.Name) and sub.id == parts[0] \
+                and len(parts) == 1:
+            got = sub
+        elif isinstance(sub, ast.Attribute):
+            sub_chain = attr_chain(sub)
+            if sub_chain == chain:
+                got = sub
+        if got is not None and not isinstance(
+            getattr(got, "ctx", None), ast.Store
+        ):
+            return got
+    return None
+
+
+def _kills(stmt: ast.stmt, chain: str) -> bool:
+    """Whether this statement unconditionally reassigns ``chain``."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target] if isinstance(stmt, ast.AnnAssign) \
+            else []  # aug-assign READS then writes — not a kill
+    elif isinstance(stmt, ast.Delete):
+        targets = stmt.targets
+    for t in targets:
+        if isinstance(t, ast.Name) and t.id == chain:
+            return True
+        if isinstance(t, ast.Attribute) and attr_chain(t) == chain:
+            return True
+    return False
+
+
+class DonationRule:
+    name = "donation-safety"
+    description = (
+        "a value passed to a donate_argnums jit is dead afterwards: no "
+        "later read, no donation of a possibly-pinned generation"
+    )
+
+    def __init__(self, pin_specs: Sequence[PinSpec] = ()):
+        self.pin_specs = tuple(pin_specs)
+
+    # -- discovery -----------------------------------------------------------
+
+    def _donating_names(self, program: Program) -> Dict[str, Tuple[int, ...]]:
+        """Binding name (last segment) -> donated argnums, repo-wide."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        for module in program.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    nums = _jit_donate_argnums(node.value)
+                    if not nums:
+                        continue
+                    for t in node.targets:
+                        seg = (
+                            t.attr if isinstance(t, ast.Attribute)
+                            else t.id if isinstance(t, ast.Name)
+                            else None
+                        )
+                        if seg is not None:
+                            out[seg] = nums
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if isinstance(dec, ast.Call):
+                            nums = _jit_donate_argnums(dec)
+                            if nums:
+                                out[node.name] = nums
+        return out
+
+    # -- per-call-site checks ------------------------------------------------
+
+    def check_program(self, program: Program) -> List[Violation]:
+        donating = self._donating_names(program)
+        if not donating:
+            return []
+        out: List[Violation] = []
+        for module in program.modules:
+            out.extend(self._check_module(module, donating))
+        return out
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        return self.check_program(Program([module]))
+
+    def _check_module(self, module: ModuleFile,
+                      donating: Dict[str, Tuple[int, ...]]
+                      ) -> List[Violation]:
+        out: List[Violation] = []
+
+        def visit_fn(fn: ast.AST, qualname: str,
+                     class_name: Optional[str]) -> None:
+            for stmt_path, stmt, call in _donation_calls(fn, donating):
+                nums = donating[_last_seg(call.func)]
+                for idx in nums:
+                    if idx >= len(call.args):
+                        continue
+                    arg = call.args[idx]
+                    chain = _target_chain(arg)
+                    if chain is None:
+                        continue
+                    self._check_liveness(
+                        module, qualname, fn, stmt_path, stmt, call,
+                        arg, chain, out,
+                    )
+                    self._check_pin_guard(
+                        module, qualname, class_name, fn, call, arg,
+                        chain, out,
+                    )
+
+        _walk_functions(module.tree, [], None, visit_fn)
+        return out
+
+    def _check_liveness(self, module: ModuleFile, qualname: str,
+                        fn: ast.AST, stmt_path: List[List[ast.stmt]],
+                        stmt: ast.stmt, call: ast.Call, arg: ast.AST,
+                        chain: str, out: List[Violation]) -> None:
+        if _kills(stmt, chain):
+            # `x = donated(x, ...)`: the canonical safe shape — the
+            # binding is reassigned by the very statement that donates,
+            # so every later read sees the fresh output buffer
+            return
+        read = None
+        kill_depth = None  # stmt_path index of the block a kill lives in
+        # 1. straight-line suffix: siblings after the call's statement,
+        #    then the statements after each enclosing block — in
+        #    program order, stopping at a reassignment (reads are
+        #    checked FIRST: `x = f(x)` both kills and reads, and the
+        #    read is of the clobbered buffer)
+        for depth in range(len(stmt_path) - 1, -1, -1):
+            block = stmt_path[depth]
+            anchor = block.index(_containing(block, stmt))
+            for later in block[anchor + 1:]:
+                read = _reads(later, chain)
+                if read is not None:
+                    break
+                if _kills(later, chain):
+                    kill_depth = depth
+                    break
+            if read is not None or kill_depth is not None:
+                break
+        if read is None:
+            # 2. loop wrap-around: the statements from the top of an
+            #    enclosing loop body down to the call re-run next
+            #    iteration with the donated buffer still bound. A
+            #    downstream kill only launders a loop's wrap-around if
+            #    it happens INSIDE that loop's body (a kill after the
+            #    loop exits never runs between iterations); a
+            #    reassignment at the top of the body launders what
+            #    follows it
+            for block, _loop in _enclosing_loops(fn, stmt):
+                loop_depth = next(
+                    (i for i, b in enumerate(stmt_path) if b is block),
+                    None,
+                )
+                if kill_depth is not None and loop_depth is not None \
+                        and kill_depth >= loop_depth:
+                    continue  # killed before this loop's body ends
+                anchor_stmt = _containing(block, stmt)
+                for earlier in block:
+                    # the anchor itself re-runs too: donating the same
+                    # un-reassigned binding next iteration reads (and
+                    # re-donates) an already-clobbered buffer
+                    read = _reads(earlier, chain)
+                    if read is not None:
+                        break
+                    if earlier is anchor_stmt or _kills(earlier, chain):
+                        break
+                if read is not None:
+                    break
+        if read is not None:
+            out.append(Violation(
+                rule=self.name, path=module.path,
+                line=read.lineno, col=read.col_offset, func=qualname,
+                symbol=chain,
+                message=(
+                    f"{chain} read after being donated to "
+                    f"{_last_seg(call.func)}() at line {call.lineno} — "
+                    f"the buffer is clobbered by XLA (use the copied "
+                    f"variant or reassign before reading)"
+                ),
+            ))
+
+    def _check_pin_guard(self, module: ModuleFile, qualname: str,
+                         class_name: Optional[str], fn: ast.AST,
+                         call: ast.Call, arg: ast.AST, chain: str,
+                         out: List[Violation]) -> None:
+        spec = None
+        for s in self.pin_specs:
+            if s.path == module.path and s.class_name == class_name \
+                    and chain == f"self.{s.attr}":
+                spec = s
+                break
+        if spec is None:
+            return
+        if self._pin_guarded(fn, call, chain, f"self.{spec.pin_attr}"):
+            return
+        out.append(Violation(
+            rule=self.name, path=module.path, line=call.lineno,
+            col=call.col_offset, func=qualname, symbol=chain,
+            message=(
+                f"{chain} donated without a `{chain} is not "
+                f"self.{spec.pin_attr}` guard — a pinned in-flight "
+                f"generation would be clobbered under the dispatch "
+                f"(the PR 11 scatter-clobber shape)"
+            ),
+        ))
+
+    @staticmethod
+    def _pin_guarded(fn: ast.AST, call: ast.Call, chain: str,
+                     pin_chain: str) -> bool:
+        """Whether ``call`` sits in the not-pinned branch of an
+        ``<chain> is/is not <pin_chain>`` test."""
+
+        def compare_matches(test: ast.expr) -> Optional[str]:
+            if not (isinstance(test, ast.Compare)
+                    and len(test.ops) == 1
+                    and len(test.comparators) == 1):
+                return None
+            sides = {attr_chain(test.left),
+                     attr_chain(test.comparators[0])}
+            if sides != {chain, pin_chain}:
+                return None
+            return "is" if isinstance(test.ops[0], ast.Is) else \
+                "is-not" if isinstance(test.ops[0], ast.IsNot) else None
+
+        def contains(node: ast.AST) -> bool:
+            return any(sub is call for sub in ast.walk(node))
+
+        def search(node: ast.AST) -> bool:
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.If) and contains(sub):
+                    op = compare_matches(sub.test)
+                    if op == "is" and any(
+                        contains(s) for s in sub.orelse
+                    ):
+                        return True
+                    if op == "is-not" and any(
+                        contains(s) for s in sub.body
+                    ):
+                        return True
+                    if search(sub):
+                        return True
+                elif contains(sub):
+                    return search(sub)
+            return False
+
+        return search(fn)
+
+
+def _last_seg(func: ast.AST) -> str:
+    chain = attr_chain(func) or ""
+    return chain.split(".")[-1] if chain else ""
+
+
+def _containing(block: List[ast.stmt], stmt: ast.stmt) -> ast.stmt:
+    """The statement in ``block`` that contains (or is) ``stmt``."""
+    for s in block:
+        if s is stmt or any(sub is stmt for sub in ast.walk(s)):
+            return s
+    return stmt
+
+
+def _donation_calls(fn: ast.AST, donating: Dict[str, Tuple[int, ...]]):
+    """(enclosing block chain, statement, call) for every donating-
+    callable call in ``fn``, nested defs excluded."""
+    results = []
+
+    def walk(body: List[ast.stmt], path: List[List[ast.stmt]]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) \
+                        and _last_seg(sub.func) in donating:
+                    results.append((path + [body], stmt, sub))
+            for child_body in _child_blocks(stmt):
+                walk(child_body, path + [body])
+
+    # dedupe: ast.walk above re-finds calls inside child blocks; keep
+    # the DEEPEST (most precise) block chain per call node
+    walk(fn.body, [])
+    best: Dict[int, Tuple] = {}
+    for path, stmt, call in results:
+        cur = best.get(id(call))
+        if cur is None or len(path) > len(cur[0]):
+            # prefer the entry whose statement list directly holds the
+            # statement (deepest path)
+            best[id(call)] = (path, stmt, call)
+    # re-anchor stmt to the directly-enclosing statement of the deepest
+    # block
+    out = []
+    for path, stmt, call in best.values():
+        block = path[-1]
+        out.append((path, _containing(block, call), call))
+    return out
+
+
+def _child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    blocks: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        val = getattr(stmt, field, None)
+        if isinstance(val, list) and val \
+                and isinstance(val[0], ast.stmt):
+            blocks.append(val)
+    for handler in getattr(stmt, "handlers", []) or []:
+        blocks.append(handler.body)
+    for case in getattr(stmt, "cases", []) or []:
+        blocks.append(case.body)
+    return blocks
+
+
+def _enclosing_loops(fn: ast.AST, stmt: ast.stmt):
+    """(loop body, loop node) for every loop enclosing ``stmt``."""
+    out = []
+
+    def walk(node: ast.AST) -> bool:
+        found = node is stmt
+        for child in ast.iter_child_nodes(node):
+            if walk(child):
+                found = True
+        if found and isinstance(node, (ast.For, ast.AsyncFor,
+                                       ast.While)):
+            out.append((node.body, node))
+        return found
+
+    walk(fn)
+    return out
+
+
+def _walk_functions(tree: ast.Module, scopes: List[str],
+                    class_name: Optional[str], visit) -> None:
+    _walk_fn_stmts(tree.body, scopes, class_name, visit)
+
+
+def _walk_fn_stmts(body: List[ast.stmt], scopes: List[str],
+                   class_name: Optional[str], visit) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = ".".join(scopes + [stmt.name])
+            visit(stmt, qual, class_name)
+            _walk_fn_stmts(stmt.body, scopes + [stmt.name], class_name,
+                           visit)
+        elif isinstance(stmt, ast.ClassDef):
+            _walk_fn_stmts(stmt.body, scopes + [stmt.name], stmt.name
+                           if class_name is None else class_name, visit)
+        else:
+            for child_body in _child_blocks(stmt):
+                _walk_fn_stmts(child_body, scopes, class_name, visit)

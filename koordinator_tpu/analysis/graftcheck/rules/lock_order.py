@@ -1,0 +1,273 @@
+"""lock-order: a whole-program lock acquisition graph, cycles = deadlock.
+
+The lock-discipline rule proves each mapped attribute is touched under
+its own lock; it says nothing about lock NESTING. With twelve mapped
+classes (SchedulerCache, StagedStateCache, TickPipeline, StateAuditor,
+SpanTracer, PodTimelines, FlightRecorder, DeviceObservatory,
+SolverSupervisor, FailoverSolver, AdmissionGate, ClusterDeltaTracker)
+sharing threads — coordinator, publisher, gate executor, sidecar
+handlers, debug mux — two code paths that nest the same pair of locks
+in opposite orders are a real deadlock waiting on a real interleaving
+(the class the reference's Go race detector + mutex profiling covers).
+
+The rule builds a directed graph over the mapped locks:
+
+- node: ``Class.lockattr`` (one per
+  :class:`~koordinator_tpu.analysis.graftcheck.rules.lock_discipline.
+  LockSpec` plus any extra declared lock, e.g. DeviceObservatory's
+  ``_profile_io_lock``);
+- edge A -> B when code holding A acquires B: a nested ``with
+  self.<other>`` in the same class, or a call under A's hold whose
+  callee (transitively, over the call graph) acquires B.
+
+Any cycle — including a self-edge: calling a method that re-acquires
+the non-reentrant lock you hold — is a violation. The acyclic graph is
+also the contract the runtime shim
+(:mod:`koordinator_tpu.testing.lockorder`) asserts under the chaos
+suite: every observed runtime acquisition must embed into this order.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+)
+from koordinator_tpu.analysis.graftcheck.callgraph import Program
+
+
+@dataclasses.dataclass(frozen=True)
+class LockNode:
+    """One mapped lock: ``class_name.lock`` in ``path``.
+
+    ``reentrant`` marks RLock-backed locks (SchedulerCache,
+    StateAuditor): a method calling a sibling that re-acquires the
+    SAME instance's lock is legal there, so self-edges are not emitted
+    for reentrant nodes — matching the runtime shim's per-instance
+    reentrancy allowance. Cross-class edges are unaffected."""
+
+    path: str
+    class_name: str
+    lock: str
+    reentrant: bool = False
+
+    @property
+    def label(self) -> str:
+        return f"{self.class_name}.{self.lock}"
+
+
+@dataclasses.dataclass
+class LockEdge:
+    """``held`` -> ``acquired``, with one witness site."""
+
+    held: str          # LockNode.label
+    acquired: str      # LockNode.label
+    path: str
+    line: int
+    func: str
+    via: str           # "nested-with" | "call:<chain>"
+
+
+def _is_self_lock(expr: ast.expr, lock_attrs: Set[str]) -> Optional[str]:
+    """``self.<lock>`` for a mapped lock attr -> the attr name."""
+    if isinstance(expr, ast.Attribute) and expr.attr in lock_attrs \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def build_lock_graph(program: Program, locks: Sequence[LockNode]
+                     ) -> Tuple[List[LockEdge], Dict[str, Set[str]]]:
+    """(edges with witnesses, transitive direct-acquire sets per
+    function key). Shared with the runtime shim and the rule tests."""
+    by_class: Dict[Tuple[str, str], List[LockNode]] = {}
+    for ln in locks:
+        by_class.setdefault((ln.path, ln.class_name), []).append(ln)
+
+    # direct acquisitions per function: `with self.<lock>` where the
+    # enclosing (path, class) maps that lock attr
+    direct: Dict[str, Set[str]] = {}
+    for key, info in program.functions.items():
+        if info.class_name is None:
+            continue
+        nodes = by_class.get((info.path, info.class_name))
+        if not nodes:
+            continue
+        attrs = {ln.lock: ln.label for ln in nodes}
+        acquired: Set[str] = set()
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _is_self_lock(item.context_expr, set(attrs))
+                    if attr is not None:
+                        acquired.add(attrs[attr])
+        if acquired:
+            direct[key] = acquired
+
+    # transitive: a function may acquire whatever its callees acquire
+    may: Dict[str, Set[str]] = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for caller, sites in program.calls.items():
+            have = may.get(caller)
+            for site in sites:
+                its = may.get(site.callee)
+                if not its:
+                    continue
+                if have is None:
+                    have = may.setdefault(caller, set())
+                before = len(have)
+                have |= its
+                if len(have) != before:
+                    changed = True
+
+    # edges: regions holding L, then nested withs and call sites
+    edges: List[LockEdge] = []
+    seen: Set[Tuple[str, str, str, int]] = set()
+    reentrant_labels = {ln.label for ln in locks if ln.reentrant}
+
+    def emit(held: str, acquired: str, path: str, line: int, func: str,
+             via: str) -> None:
+        if held == acquired and held in reentrant_labels:
+            # RLock-backed: same-instance re-acquisition is legal and
+            # the per-class graph can't tell instances apart, so
+            # reentrant self-edges are not reported statically; the
+            # runtime shim still flags cross-INSTANCE nesting of the
+            # same class when it actually happens
+            return
+        key = (held, acquired, path, line)
+        if key not in seen:
+            seen.add(key)
+            edges.append(LockEdge(held, acquired, path, line, func, via))
+
+    for key, info in program.functions.items():
+        if info.class_name is None:
+            continue
+        nodes = by_class.get((info.path, info.class_name))
+        if not nodes:
+            continue
+        attrs = {ln.lock: ln.label for ln in nodes}
+        call_sites = {
+            id(s.node): s for s in program.callees(key)
+            if s.node is not None
+        }
+
+        def walk(node: ast.AST, held: Optional[str]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    attr = _is_self_lock(item.context_expr, set(attrs))
+                    if attr is not None:
+                        label = attrs[attr]
+                        if inner is not None:
+                            emit(inner, label, info.path,
+                                 node.lineno, info.qualname,
+                                 "nested-with")
+                        inner = label
+                    else:
+                        walk(item.context_expr, held)
+                for stmt in node.body:
+                    walk(stmt, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # a nested def runs later, not under this hold — but a
+                # closure invoked by a callee while the lock is held
+                # would still be caught through the call graph's
+                # parent->nested may-invoke edge; keep the textual walk
+                # conservative and stop here
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                for child in body:
+                    walk(child, None)
+                return
+            if isinstance(node, ast.Call) and held is not None:
+                site = call_sites.get(id(node))
+                if site is not None:
+                    for label in sorted(may.get(site.callee, ())):
+                        emit(held, label, info.path, node.lineno,
+                             info.qualname, f"call:{site.chain}")
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        walk(info.node, None)
+    return edges, may
+
+
+def find_cycles(edges: Sequence[LockEdge]) -> List[List[str]]:
+    """Every elementary cycle reachable in the edge set (self-edges
+    included), deduped by node set — small graphs, plain DFS."""
+    adj: Dict[str, Set[str]] = {}
+    for e in edges:
+        adj.setdefault(e.held, set()).add(e.acquired)
+    cycles: List[List[str]] = []
+    seen_sets: Set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            visited: Set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(path + [start])
+            elif nxt not in visited and len(path) < 8:
+                dfs(start, nxt, path + [nxt], visited | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+class LockOrderRule:
+    name = "lock-order"
+    description = (
+        "the mapped locks form an acyclic acquisition graph "
+        "(nested-with + call-under-lock edges); any cycle is a "
+        "potential deadlock"
+    )
+
+    def __init__(self, locks: Sequence[LockNode]):
+        self.locks = tuple(locks)
+
+    def check_program(self, program: Program) -> List[Violation]:
+        edges, _ = build_lock_graph(program, self.locks)
+        out: List[Violation] = []
+        for cycle in find_cycles(edges):
+            # witness: the first edge of the cycle
+            pairs = list(zip(cycle, cycle[1:]))
+            witness = None
+            for e in edges:
+                if (e.held, e.acquired) == pairs[0]:
+                    witness = e
+                    break
+            sites = []
+            for a, b in pairs:
+                for e in edges:
+                    if (e.held, e.acquired) == (a, b):
+                        sites.append(
+                            f"{a}->{b} at {e.path}:{e.line} ({e.via})"
+                        )
+                        break
+            out.append(Violation(
+                rule=self.name,
+                path=witness.path if witness else "<lock-graph>",
+                line=witness.line if witness else 0,
+                col=0,
+                func=witness.func if witness else "<lock-graph>",
+                symbol="->".join(cycle),
+                message=(
+                    "lock-order cycle (potential deadlock): "
+                    + "; ".join(sites)
+                ),
+            ))
+        return out
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        return self.check_program(Program([module]))

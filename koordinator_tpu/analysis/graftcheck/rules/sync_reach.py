@@ -1,0 +1,164 @@
+"""sync-reach: interprocedural host-sync taint over the call graph.
+
+The local ``host-sync`` rule dies at the function boundary and only
+scans ``HOT_MODULES`` — a ``jax.device_get`` buried two calls below
+``schedule_async`` in an un-scoped helper module was invisible (the
+exact shape of the PR 10-13 bug class: the sync lives where nobody
+lints). This rule closes that hole:
+
+1. every function in the WHOLE repo is scanned for unconditional sync
+   sites — ``jax.device_get``, ``jax.block_until_ready``, any
+   ``.block_until_ready()`` method call;
+2. sync reachability propagates backward over the resolved call graph
+   (:class:`~koordinator_tpu.analysis.graftcheck.callgraph.Program`),
+   carrying a bounded witness path;
+3. a hot-module function whose call site reaches a sync site located
+   OUTSIDE the hot scope is a violation, reported AT THE CALL SITE in
+   the hot module (so allowlist entries stay function+symbol scoped,
+   like the local rule's).
+
+Sync sites inside hot modules are deliberately NOT re-reported here:
+they are the local rule's jurisdiction, already judged (or allowlisted
+by name) where they live — re-flagging every caller of an allowlisted
+barrier would turn one justified sync into a cascade of findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Sequence, Set, Tuple
+
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    attr_chain,
+)
+from koordinator_tpu.analysis.graftcheck.callgraph import Program
+
+#: witness sync sites carried per function (bounded so SCC propagation
+#: stays linear; one witness is enough to fix the finding)
+_MAX_WITNESSES = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class _SyncSite:
+    symbol: str        # "jax.device_get" | ".block_until_ready()" | ...
+    path: str
+    line: int
+
+
+def _module_matches(path: str, globs: Sequence[str]) -> bool:
+    import fnmatch
+
+    return any(fnmatch.fnmatch(path, g) for g in globs)
+
+
+def _direct_syncs(fn_node: ast.AST, path: str) -> List[_SyncSite]:
+    """Unconditional host syncs in one function body, nested defs
+    excluded (they carry their own entry in the function table)."""
+    out: List[_SyncSite] = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func) or ""
+            if chain == "jax.device_get":
+                out.append(_SyncSite("jax.device_get", path, node.lineno))
+            elif chain == "jax.block_until_ready":
+                out.append(_SyncSite(
+                    "jax.block_until_ready", path, node.lineno
+                ))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                out.append(_SyncSite(
+                    ".block_until_ready()", path, node.lineno
+                ))
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class SyncReachRule:
+    """Whole-program: hot-path functions must not reach a host sync
+    hidden in a helper, however many calls deep."""
+
+    name = "sync-reach"
+    description = (
+        "no hot-path function transitively reaches a jax.device_get / "
+        "block_until_ready outside the hot scope (interprocedural)"
+    )
+
+    def __init__(self, scope: Sequence[str]):
+        self.scope = tuple(scope)
+
+    def check_program(self, program: Program) -> List[Violation]:
+        # 1. direct sync sites per function, repo-wide — but only sites
+        #    OUTSIDE the hot scope (hot-module sites belong to the
+        #    local host-sync rule and its allowlist)
+        reach: Dict[str, Tuple[_SyncSite, ...]] = {}
+        for key, info in program.functions.items():
+            if _module_matches(info.path, self.scope):
+                continue
+            sites = _direct_syncs(info.node, info.path)
+            if sites:
+                reach[key] = tuple(sites[:_MAX_WITNESSES])
+
+        # 2. backward propagation to a fixpoint: a caller reaches every
+        #    sync its callees reach (witnesses bounded + deduped)
+        callers: Dict[str, Set[str]] = {}
+        for caller, sites in program.calls.items():
+            for site in sites:
+                callers.setdefault(site.callee, set()).add(caller)
+        work = list(reach)
+        while work:
+            callee = work.pop()
+            its = reach.get(callee, ())
+            for caller in callers.get(callee, ()):
+                info = program.functions.get(caller)
+                if info is not None \
+                        and _module_matches(info.path, self.scope):
+                    continue  # hot functions report at their call sites
+                have = reach.get(caller, ())
+                merged = list(have)
+                for s in its:
+                    if s not in merged:
+                        merged.append(s)
+                merged = merged[:_MAX_WITNESSES]
+                if tuple(merged) != have:
+                    reach[caller] = tuple(merged)
+                    work.append(caller)
+
+        # 3. hot-module call sites whose callee reaches a sync
+        out: List[Violation] = []
+        hot_paths = {
+            m.path for m in program.modules
+            if _module_matches(m.path, self.scope)
+        }
+        for key, info in program.functions.items():
+            if info.path not in hot_paths:
+                continue
+            for site in program.callees(key):
+                witnesses = reach.get(site.callee, ())
+                if not witnesses:
+                    continue
+                w = witnesses[0]
+                node = site.node
+                line = node.lineno if node is not None else \
+                    info.node.lineno
+                col = node.col_offset if node is not None else 0
+                out.append(Violation(
+                    rule=self.name, path=info.path, line=line, col=col,
+                    func=info.qualname, symbol=w.symbol,
+                    message=(
+                        f"call to {site.chain}() reaches {w.symbol} at "
+                        f"{w.path}:{w.line} — a host sync hidden "
+                        f"outside the hot scope"
+                    ),
+                ))
+        return out
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        """Single-module compatibility: build a one-module program."""
+        return self.check_program(Program([module]))

@@ -1,0 +1,285 @@
+"""Runtime lock-order assertion shim: the dynamic half of graftcheck's
+``lock-order`` rule.
+
+The static pass (analysis/graftcheck/rules/lock_order.py) proves the
+mapped locks' acquisition graph is acyclic over every path the call
+graph can name. This shim closes the gap static resolution can't: it
+instruments the SAME mapped locks at runtime and verifies every
+observed acquisition embeds into the statically-derived order — under
+the full chaos suite (six wire fault kinds, state sabotage,
+kill-the-leader) and the pipelined churn, where every thread the
+process owns (coordinator, publisher, gate executor, sidecar handlers,
+debug mux, supervisor monitor) runs concurrently.
+
+Mechanics:
+
+- :meth:`LockOrderShim.install` wraps each mapped class's ``__init__``
+  so new instances get an order-checking lock proxy, and wraps the
+  process singletons (TRACER, FLIGHT, DEVICE_OBS) that predate the
+  install. :meth:`uninstall` restores the constructors and disables
+  recording (already-wrapped instances keep working, silently).
+- each thread keeps a stack of held mapped locks. Acquiring lock B
+  while holding A records the edge A→B and checks that
+  ``static ∪ observed`` stays acyclic — an inversion of any known
+  order is recorded as a violation (with both lock names, the thread,
+  and the acquisition stack), never raised mid-test: the chaos
+  properties keep running and the fixture asserts ``violations == []``
+  at teardown.
+- reentrancy is per-INSTANCE: re-acquiring an RLock you already hold
+  (SchedulerCache, StateAuditor) is legal and records nothing; nesting
+  two different instances of the same class IS an edge (label→label, a
+  self-loop) and therefore a violation — non-reentrant cross-instance
+  nesting is a real deadlock shape.
+
+Zero third-party deps; safe to import without jax.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Dict, List, Sequence, Set, Tuple
+
+#: process singletons created at import time, before any install():
+#: (module, attribute, lock attr)
+_SINGLETON_LOCKS = (
+    ("koordinator_tpu.obs.trace", "TRACER", "_lock"),
+    ("koordinator_tpu.obs.flight", "FLIGHT", "_lock"),
+    ("koordinator_tpu.obs.device", "DEVICE_OBS", "_lock"),
+    ("koordinator_tpu.obs.device", "DEVICE_OBS", "_profile_io_lock"),
+)
+
+
+class _CheckedLock:
+    """A lock proxy recording acquisition order into the shim."""
+
+    __slots__ = ("_inner", "label", "_shim")
+
+    def __init__(self, inner, label: str, shim: "LockOrderShim"):
+        self._inner = inner
+        self.label = label
+        self._shim = shim
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._shim._note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._shim._note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # Condition-backed locks (AdmissionGate) reach wait/notify/
+        # notify_all through the proxy; a Condition's wait-side
+        # release+reacquire never acquires OTHER locks on this thread,
+        # so the held-stack bookkeeping stays sound
+        return getattr(self._inner, name)
+
+
+class LockOrderShim:
+    """Instrument the mapped locks; verify the static order holds."""
+
+    def __init__(self, static_edges: Sequence[Tuple[str, str]],
+                 lock_map: Sequence[Tuple[str, str, str]]):
+        """``static_edges``: (held label, acquired label) pairs from
+        the static pass. ``lock_map``: (module dotted path, class name,
+        lock attr) for every mapped lock."""
+        self.static_edges = set(static_edges)
+        self.lock_map = tuple(lock_map)
+        self.violations: List[dict] = []
+        self.observed_edges: Set[Tuple[str, str]] = set()
+        self.acquisitions = 0
+        self.enabled = False
+        self._adj: Dict[str, Set[str]] = {}
+        for a, b in self.static_edges:
+            if a != b:
+                self._adj.setdefault(a, set()).add(b)
+        self._tls = threading.local()
+        self._graph_lock = threading.Lock()
+        self._patched: List[Tuple[type, object]] = []
+        self._wrapped_singletons: List[Tuple[object, str, object]] = []
+
+    # -- instrumentation -----------------------------------------------------
+
+    @classmethod
+    def from_static_analysis(cls) -> "LockOrderShim":
+        """Build the shim from the SAME program analysis the static
+        rule runs — the declared order is derived, never hand-copied."""
+        from pathlib import Path
+
+        from koordinator_tpu.analysis.graftcheck.callgraph import (
+            build_program,
+            module_dotted,
+        )
+        from koordinator_tpu.analysis.graftcheck.engine import (
+            iter_repo_modules,
+        )
+        from koordinator_tpu.analysis.graftcheck.rules import LOCK_NODES
+        from koordinator_tpu.analysis.graftcheck.rules.lock_order import (
+            build_lock_graph,
+        )
+        from koordinator_tpu.analysis.graftcheck.__main__ import (
+            find_repo_root,
+        )
+
+        root = find_repo_root(Path(__file__).resolve())
+        program = build_program(list(iter_repo_modules(root)))
+        edges, _ = build_lock_graph(program, LOCK_NODES)
+        return cls(
+            static_edges=[(e.held, e.acquired) for e in edges],
+            lock_map=[
+                (module_dotted(ln.path), ln.class_name, ln.lock)
+                for ln in LOCK_NODES
+            ],
+        )
+
+    def install(self) -> "LockOrderShim":
+        self.enabled = True
+        by_class: Dict[Tuple[str, str], List[str]] = {}
+        for dotted, class_name, lock in self.lock_map:
+            by_class.setdefault((dotted, class_name), []).append(lock)
+        for (dotted, class_name), locks in by_class.items():
+            module = importlib.import_module(dotted)
+            cls = getattr(module, class_name)
+            orig_init = cls.__init__
+            shim = self
+
+            def make_init(orig, cname, lock_attrs):
+                def __init__(self_obj, *args, **kwargs):
+                    orig(self_obj, *args, **kwargs)
+                    for attr in lock_attrs:
+                        inner = getattr(self_obj, attr, None)
+                        if inner is not None and not isinstance(
+                            inner, _CheckedLock
+                        ):
+                            setattr(self_obj, attr, _CheckedLock(
+                                inner, f"{cname}.{attr}", shim
+                            ))
+                return __init__
+
+            cls.__init__ = make_init(orig_init, class_name, locks)
+            self._patched.append((cls, orig_init))
+        for dotted, name, attr in _SINGLETON_LOCKS:
+            try:
+                module = importlib.import_module(dotted)
+                obj = getattr(module, name)
+            except (ImportError, AttributeError):
+                continue
+            inner = getattr(obj, attr, None)
+            if inner is None or isinstance(inner, _CheckedLock):
+                continue
+            label = f"{type(obj).__name__}.{attr}"
+            setattr(obj, attr, _CheckedLock(inner, label, self))
+            self._wrapped_singletons.append((obj, attr, inner))
+        return self
+
+    def uninstall(self) -> None:
+        self.enabled = False
+        for cls, orig_init in self._patched:
+            cls.__init__ = orig_init
+        self._patched.clear()
+        for obj, attr, inner in self._wrapped_singletons:
+            current = getattr(obj, attr, None)
+            if isinstance(current, _CheckedLock):
+                setattr(obj, attr, inner)
+        self._wrapped_singletons.clear()
+
+    def __enter__(self) -> "LockOrderShim":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- order checking ------------------------------------------------------
+
+    def _held(self) -> List[_CheckedLock]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, lock: _CheckedLock) -> None:
+        stack = self._held()
+        if not self.enabled:
+            stack.append(lock)
+            return
+        self.acquisitions += 1
+        reentrant = any(held is lock for held in stack)
+        if not reentrant:
+            # RLock reentry on the same instance records no edge; the
+            # stack entry is still pushed so releases stay balanced
+            for held in stack:
+                self._check_edge(held.label, lock.label, stack)
+        stack.append(lock)
+
+    def _note_release(self, lock: _CheckedLock) -> None:
+        stack = self._held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    def _check_edge(self, held: str, acquired: str,
+                    stack: List[_CheckedLock]) -> None:
+        edge = (held, acquired)
+        with self._graph_lock:
+            if edge in self.observed_edges:
+                return
+            if held == acquired:
+                # two INSTANCES of the same class nested — a cross-
+                # instance deadlock shape the per-class graph models as
+                # a self-loop
+                self.violations.append({
+                    "held": held, "acquired": acquired,
+                    "thread": threading.current_thread().name,
+                    "kind": "same-class-nesting",
+                    "stack": [l.label for l in stack],
+                })
+                self.observed_edges.add(edge)
+                return
+            # would acquired -> ... -> held complete a cycle through
+            # the combined static+observed graph?
+            if self._reaches(acquired, held):
+                self.violations.append({
+                    "held": held, "acquired": acquired,
+                    "thread": threading.current_thread().name,
+                    "kind": "order-inversion",
+                    "stack": [l.label for l in stack],
+                })
+            self.observed_edges.add(edge)
+            self._adj.setdefault(held, set()).add(acquired)
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen: Set[str] = set()
+        work = [src]
+        while work:
+            node = work.pop()
+            if node == dst:
+                return True
+            if node in seen:
+                continue
+            seen.add(node)
+            work.extend(self._adj.get(node, ()))
+        return False
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._graph_lock:
+            return {
+                "acquisitions": self.acquisitions,
+                "observed_edges": sorted(self.observed_edges),
+                "violations": list(self.violations),
+            }

@@ -46,6 +46,34 @@ def _clear_jax_caches_per_module():
     jax.clear_caches()
 
 
+@pytest.fixture(scope="module")
+def lock_order_shim():
+    """The runtime lock-order assertion shim (ISSUE 9): instruments
+    every lock the static ``lock-order`` rule maps and verifies each
+    observed acquisition embeds into the statically-derived order.
+    Module-scoped: the chaos and pipeline suites opt in with an autouse
+    wrapper so ALL their threads — coordinator, publisher, supervisor
+    monitor, sidecar handlers, chaos proxies — run instrumented.
+    Teardown asserts zero order violations and a non-vacuous run (the
+    instrumented classes really were exercised)."""
+    from koordinator_tpu.testing.lockorder import LockOrderShim
+
+    shim = LockOrderShim.from_static_analysis().install()
+    try:
+        yield shim
+    finally:
+        report = shim.report()
+        shim.uninstall()
+        assert report["violations"] == [], (
+            "runtime lock-order violations:\n"
+            + "\n".join(map(str, report["violations"]))
+        )
+        assert report["acquisitions"] > 0, (
+            "lock-order shim observed no acquisitions — the "
+            "instrumentation no longer reaches the mapped locks"
+        )
+
+
 @pytest.fixture
 def xla_compiles():
     """Counts actual backend compilations: with ``jax_log_compiles``

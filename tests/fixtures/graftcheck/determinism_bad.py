@@ -1,0 +1,43 @@
+"""Seeded determinism-taint violations — parsed by graftcheck's
+self-test, never imported or executed. Wall clock / RNG / set order
+flowing into device values and wire frames."""
+
+import os
+import random
+import time
+
+import jax.numpy as jnp
+
+from koordinator_tpu.service.codec import SolveRequest, encode_request
+
+
+def clock_into_device():
+    stamp = time.time()
+    return jnp.asarray(stamp)              # VIOLATION: wall clock
+
+def clock_into_wire(req):
+    deadline = time.time() + 5.0
+    return encode_request(deadline)        # VIOLATION: wall clock
+
+def rng_into_wire():
+    nonce = os.urandom(8)
+    return SolveRequest(nonce)             # VIOLATION: urandom
+
+def unseeded_draw_into_device():
+    jitter = random.random()
+    return jnp.asarray(jitter)             # VIOLATION: unseeded RNG
+
+def set_order_into_device(names):
+    pending = {"a", "b", "c"}
+    return jnp.asarray([len(n) for n in pending])  # VIOLATION: set order
+
+def clean_sorted(names):
+    pending = {"a", "b", "c"}
+    return jnp.asarray([len(n) for n in sorted(pending)])  # laundered
+
+def clean_declared_input(now):
+    return jnp.asarray(now)                # a declared model input
+
+def clean_telemetry():
+    at = time.time()
+    return {"at": at}                      # telemetry, not a sink

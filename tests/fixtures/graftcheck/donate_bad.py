@@ -1,0 +1,48 @@
+"""Seeded donation-safety violations — parsed by graftcheck's
+self-test, never imported or executed. Modeled on the PR 11
+scatter-clobber: a donated buffer read after the donating dispatch."""
+
+import jax
+import jax.numpy as jnp
+
+scatter_rows = jax.jit(
+    lambda state, idx: state, donate_argnums=(0,), static_argnums=()
+)
+
+
+def read_after_donate(state, idx):
+    out = scatter_rows(state, idx)
+    return state + out                     # VIOLATION: clobbered read
+
+
+def loop_redonate(state, idx):
+    for i in range(4):
+        out = scatter_rows(state, i)       # VIOLATION: re-donates stale
+    return out
+
+
+def safe_reassign(state, idx):
+    state = scatter_rows(state, idx)       # killed at the call: safe
+    return state
+
+
+def safe_temporary(state, idx):
+    return scatter_rows(jnp.asarray(state), idx)  # temp: dead anyway
+
+
+class PinnedCache:
+    """The pin protocol half: donating the possibly-pinned generation
+    without the `is not pinned` guard is the exact PR 11 shape."""
+
+    def __init__(self):
+        self.state = None
+        self._pinned = None
+
+    def unguarded(self, idx):
+        self.state = scatter_rows(self.state, idx)   # VIOLATION: no guard
+
+    def guarded(self, idx, copied):
+        if self.state is self._pinned:
+            self.state = copied(self.state, idx)     # safe: copied path
+        else:
+            self.state = scatter_rows(self.state, idx)  # safe: guarded

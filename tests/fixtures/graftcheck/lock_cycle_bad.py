@@ -1,0 +1,36 @@
+"""Seeded two-lock order cycle — parsed by graftcheck's self-test,
+never imported or executed. ``CacheA`` acquires its lock then calls
+into ``CacheB`` (which locks); ``CacheB`` does the reverse — a classic
+AB/BA deadlock the per-class lock-discipline rule cannot see."""
+
+import threading
+
+
+class CacheB:
+    def __init__(self, peer):
+        self._lock = threading.Lock()
+        self.peer = peer
+        self.rows = {}
+
+    def read_through(self, key):
+        with self._lock:                       # B then (via peer) A
+            return self.peer.direct_get(key)
+
+    def direct_put(self, key, value):
+        with self._lock:
+            self.rows[key] = value
+
+
+class CacheA:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = CacheB(self)
+        self.rows = {}
+
+    def write_through(self, key, value):
+        with self._lock:                       # A then (via peer) B
+            self.peer.direct_put(key, value)   # VIOLATION edge A->B
+
+    def direct_get(self, key):
+        with self._lock:                       # VIOLATION edge B->A
+            return self.rows.get(key)

@@ -1,0 +1,20 @@
+"""Seeded cross-module sync leak, helper half — parsed by graftcheck's
+self-test, never imported or executed. The sync hides two calls deep in
+a module no local-rule scope ever names."""
+
+import jax
+
+
+def deep_helper(values):
+    # the buried sync: invisible to the per-module host-sync rule when
+    # this module is outside HOT_MODULES
+    return jax.device_get(values)          # VIOLATION target
+
+
+def middle_helper(values):
+    staged = [v for v in values]
+    return deep_helper(staged)
+
+
+def clean_helper(values):
+    return [v * 2 for v in values]

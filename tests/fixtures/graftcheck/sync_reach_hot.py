@@ -1,0 +1,17 @@
+"""Seeded cross-module sync leak, hot half — parsed by graftcheck's
+self-test, never imported or executed. ``hot_schedule`` never syncs
+locally; the leak is only visible interprocedurally."""
+
+from tests.fixtures.graftcheck.sync_reach_helper import (
+    clean_helper,
+    middle_helper,
+)
+
+
+def hot_schedule(batch):
+    staged = clean_helper(batch)
+    return middle_helper(staged)           # VIOLATION: reaches device_get
+
+
+def hot_clean(batch):
+    return clean_helper(batch)             # no sync anywhere below

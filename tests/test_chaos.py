@@ -45,6 +45,16 @@ from koordinator_tpu.testing.chaos import (
 
 CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
 
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_under_chaos(lock_order_shim):
+    """Every chaos scenario in this module — six wire fault kinds,
+    state sabotage, kill-the-leader — runs under the lock-order shim:
+    zero acquisitions may violate the statically-declared order
+    (asserted at module teardown by the shim fixture)."""
+    yield lock_order_shim
+
+
 N_NODES = 16
 PENDING_PER_TICK = 8
 DIRTY_PER_TICK = 3

@@ -1,0 +1,439 @@
+"""graftcheck v2: whole-program passes — per-rule self-tests + teeth.
+
+The ISSUE 9 layers, mirroring test_graftcheck.py's structure:
+
+1. each new rule detects its seeded-violation fixture
+   (``tests/fixtures/graftcheck/``) and stays quiet on the seeded
+   clean paths beside it;
+2. the real repo's lock graph is ACYCLIC and non-vacuous (the known
+   cross-class edges are present — an empty graph would pass an
+   acyclicity check for the wrong reason);
+3. injected violations in REAL source fail loudly: a cross-module
+   ``jax.device_get`` two calls below the hot path, a read-after-
+   donate in the staging cache, and the PR 11 shape itself — the
+   donated scatter with its pin guard stripped;
+4. the runtime lock-order shim detects a seeded inversion and stays
+   quiet on reentrant/ordered acquisitions (its chaos-suite teeth live
+   in test_chaos.py/test_pipeline.py as autouse fixtures);
+5. the CLI's ``--changed-files`` incremental mode still runs the
+   whole-program passes and reports per-rule wall time in JSON.
+"""
+
+import ast
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from koordinator_tpu.analysis.graftcheck import (
+    ModuleFile,
+    default_rules,
+    load_allowlist,
+    load_module,
+    run_checks,
+)
+from koordinator_tpu.analysis.graftcheck.callgraph import (
+    Program,
+    build_program,
+)
+from koordinator_tpu.analysis.graftcheck.engine import (
+    iter_repo_modules,
+    run_checks_timed,
+)
+from koordinator_tpu.analysis.graftcheck.rules import (
+    DeterminismRule,
+    DonationRule,
+    LOCK_NODES,
+    LockNode,
+    LockOrderRule,
+    PinSpec,
+    SyncReachRule,
+)
+from koordinator_tpu.analysis.graftcheck.rules.lock_order import (
+    build_lock_graph,
+    find_cycles,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "graftcheck"
+
+
+def _fixture(name: str) -> ModuleFile:
+    rel = f"tests/fixtures/graftcheck/{name}"
+    return load_module(FIXTURES / name, rel)
+
+
+@pytest.fixture(scope="module")
+def repo_program():
+    return build_program(list(iter_repo_modules(REPO)))
+
+
+# -- 1. the new rules detect their seeded fixtures ---------------------------
+
+def test_sync_reach_fixture_detected():
+    helper = _fixture("sync_reach_helper.py")
+    hot = _fixture("sync_reach_hot.py")
+    rule = SyncReachRule(
+        scope=("tests/fixtures/graftcheck/sync_reach_hot.py",)
+    )
+    violations = rule.check_program(Program([helper, hot]))
+    assert violations, "cross-module sync leak not detected"
+    assert {v.func for v in violations} == {"hot_schedule"}, (
+        "hot_clean must not flag; only the leaking call site does"
+    )
+    v = violations[0]
+    assert v.symbol == "jax.device_get"
+    assert "sync_reach_helper.py" in v.message
+    assert v.path.endswith("sync_reach_hot.py")
+
+
+def test_lock_cycle_fixture_detected():
+    module = _fixture("lock_cycle_bad.py")
+    path = "tests/fixtures/graftcheck/lock_cycle_bad.py"
+    rule = LockOrderRule(locks=(
+        LockNode(path=path, class_name="CacheA", lock="_lock"),
+        LockNode(path=path, class_name="CacheB", lock="_lock"),
+    ))
+    violations = rule.check_program(Program([module]))
+    assert len(violations) == 1, [v.format() for v in violations]
+    v = violations[0]
+    assert "CacheA._lock" in v.symbol and "CacheB._lock" in v.symbol
+    assert "potential deadlock" in v.message
+
+
+def test_donation_fixture_detected():
+    module = _fixture("donate_bad.py")
+    path = "tests/fixtures/graftcheck/donate_bad.py"
+    rule = DonationRule(pin_specs=(
+        PinSpec(path=path, class_name="PinnedCache", attr="state",
+                pin_attr="_pinned"),
+    ))
+    violations = rule.check_program(Program([module]))
+    by_func = {v.func for v in violations}
+    assert by_func == {
+        "read_after_donate", "loop_redonate", "PinnedCache.unguarded",
+    }, [v.format() for v in violations]
+    # the guard shapes stay quiet: reassign-at-call, temporary args,
+    # and the pin-guarded branch
+    for quiet in ("safe_reassign", "safe_temporary",
+                  "PinnedCache.guarded"):
+        assert quiet not in by_func
+
+
+def test_determinism_fixture_detected():
+    module = _fixture("determinism_bad.py")
+    rule = DeterminismRule(
+        scope=("tests/fixtures/graftcheck/determinism_bad.py",)
+    )
+    violations = rule.check(module)
+    by_func = {v.func for v in violations}
+    assert by_func == {
+        "clock_into_device", "clock_into_wire", "rng_into_wire",
+        "unseeded_draw_into_device", "set_order_into_device",
+    }, [v.format() for v in violations]
+    # direct source calls keep their chain as the label; values that
+    # flowed through a binding carry the binding name
+    assert all("bit-parity poisoned" in v.message for v in violations)
+    labels = {v.symbol for v in violations}
+    assert "stamp" in labels and "nonce" in labels
+
+
+# -- 2. the real repo's lock graph: acyclic AND non-vacuous ------------------
+
+def test_repo_lock_graph_acyclic_and_populated(repo_program):
+    edges, _ = build_lock_graph(repo_program, LOCK_NODES)
+    assert find_cycles(edges) == [], "lock-order cycle in the repo"
+    pairs = {(e.held, e.acquired) for e in edges}
+    # the load-bearing cross-class orders this PR documents (§18): an
+    # empty graph would be vacuously acyclic — pin the known edges
+    assert ("SchedulerCache._lock", "ClusterDeltaTracker._lock") in pairs
+    assert ("StagedStateCache._lock", "ClusterDeltaTracker._lock") in pairs
+    assert ("StateAuditor._lock", "StagedStateCache._lock") in pairs
+    assert ("DeviceObservatory._profile_io_lock",
+            "DeviceObservatory._lock") in pairs
+    assert len(pairs) >= 10, sorted(pairs)
+
+
+def test_repo_wide_clean_with_v2_rules(repo_program):
+    violations, _, stats = run_checks_timed(
+        repo_program.modules, default_rules(),
+        load_allowlist(REPO / "graftcheck.toml"),
+    )
+    assert violations == [], "\n".join(v.format() for v in violations)
+    # all nine rules ran and are individually clean
+    assert set(stats) >= {
+        "sync-reach", "lock-order", "donation-safety",
+        "determinism-taint",
+    }
+    assert all(s["violations"] == 0 for s in stats.values())
+
+
+# -- 3. injected violations in REAL source fail loudly -----------------------
+
+def _reparse(path: str, source: str) -> ModuleFile:
+    return ModuleFile(path=path, tree=ast.parse(source, filename=path),
+                      source=source)
+
+
+def _run_with_replacement(path: str, source: str):
+    mods = {
+        m.path: m for m in iter_repo_modules(REPO)
+    }
+    mods[path] = _reparse(path, source)
+    return run_checks(
+        list(mods.values()), default_rules(),
+        load_allowlist(REPO / "graftcheck.toml"),
+    )
+
+
+def test_injected_cross_module_device_get_fails():
+    """A ``jax.device_get`` seeded into the host oracle — a module NO
+    local rule scope names, two calls below the hot path
+    (``PlacementModel._host_solve`` → ``schedule_vectorized``) — must
+    fail the check interprocedurally."""
+    path = "koordinator_tpu/oracle/vectorized.py"
+    source = (REPO / path).read_text()
+    lines = source.split("\n")
+    for i, line in enumerate(lines):
+        if line.startswith("def schedule_vectorized("):
+            j = i
+            while not lines[j].rstrip().endswith(":"):
+                j += 1
+            lines.insert(j + 1, "    import jax; jax.device_get(alloc)")
+            break
+    else:
+        pytest.fail("schedule_vectorized anchor not found")
+    violations, _ = _run_with_replacement(path, "\n".join(lines))
+    reach = [v for v in violations if v.rule == "sync-reach"]
+    assert reach, "buried cross-module device_get not detected"
+    assert any(
+        v.func == "PlacementModel._host_solve"
+        and v.symbol == "jax.device_get" for v in reach
+    ), [v.format() for v in reach]
+
+
+_DONATED_ANCHOR = """\
+                                self.state = scatter_node_rows_donated(
+                                    self.state, jnp.asarray(sidx), srows
+                                )"""
+
+
+def test_injected_read_after_donate_fails():
+    """The PR 11 clobber class, liveness half: keep an alias to the
+    donated generation and read it after the dispatch."""
+    path = "koordinator_tpu/models/placement.py"
+    source = (REPO / path).read_text()
+    assert _DONATED_ANCHOR in source
+    injected = source.replace(_DONATED_ANCHOR, """\
+                                tmp = self.state
+                                self.state = scatter_node_rows_donated(
+                                    tmp, jnp.asarray(sidx), srows
+                                )
+                                _ = tmp.alloc""")
+    violations, _ = _run_with_replacement(path, injected)
+    hits = [v for v in violations if v.rule == "donation-safety"]
+    assert any(
+        v.func == "StagedStateCache.ensure" and v.symbol == "tmp"
+        for v in hits
+    ), [v.format() for v in hits]
+
+
+_PIN_GUARD_ANCHOR = """\
+                            if self.state is self._pinned:
+                                # double buffer: an in-flight solve holds
+                                # this generation — write the next one
+                                # beside it instead of donating its
+                                # buffers out from under the dispatch
+                                self.state = scatter_node_rows_copied(
+                                    self.state, jnp.asarray(sidx), srows
+                                )
+                            else:
+                                self.state = scatter_node_rows_donated(
+                                    self.state, jnp.asarray(sidx), srows
+                                )"""
+
+
+def test_injected_unguarded_donation_fails():
+    """The PR 11 clobber class, pin half: strip the pin guard so the
+    donated scatter can hit an in-flight generation — the exact
+    pre-fix shape, now machine-rejected."""
+    path = "koordinator_tpu/models/placement.py"
+    source = (REPO / path).read_text()
+    assert _PIN_GUARD_ANCHOR in source, (
+        "pin-guard anchor drifted — update the fixture"
+    )
+    injected = source.replace(_PIN_GUARD_ANCHOR, """\
+                            self.state = scatter_node_rows_donated(
+                                self.state, jnp.asarray(sidx), srows
+                            )""")
+    violations, _ = _run_with_replacement(path, injected)
+    hits = [v for v in violations if v.rule == "donation-safety"]
+    assert any(
+        v.func == "StagedStateCache.ensure"
+        and v.symbol == "self.state"
+        and "pinned" in v.message for v in hits
+    ), [v.format() for v in hits]
+
+
+# -- 4. the runtime shim -----------------------------------------------------
+
+def test_runtime_shim_detects_inversion():
+    from koordinator_tpu.testing.lockorder import (
+        LockOrderShim,
+        _CheckedLock,
+    )
+
+    shim = LockOrderShim(
+        static_edges=[("A._lock", "B._lock")], lock_map=[]
+    )
+    shim.enabled = True
+    a = _CheckedLock(threading.Lock(), "A._lock", shim)
+    b = _CheckedLock(threading.Lock(), "B._lock", shim)
+    with a:
+        with b:
+            pass  # consistent with the static order
+    assert shim.violations == []
+    with b:
+        with a:  # inversion: B held, A acquired, static says A before B
+            pass
+    assert len(shim.violations) == 1
+    v = shim.violations[0]
+    assert v["kind"] == "order-inversion"
+    assert (v["held"], v["acquired"]) == ("B._lock", "A._lock")
+
+
+def test_runtime_shim_reentrant_and_same_class():
+    from koordinator_tpu.testing.lockorder import (
+        LockOrderShim,
+        _CheckedLock,
+    )
+
+    shim = LockOrderShim(static_edges=[], lock_map=[])
+    shim.enabled = True
+    r = _CheckedLock(threading.RLock(), "C._lock", shim)
+    with r:
+        with r:  # same-instance reentry: legal, no edge
+            pass
+    assert shim.violations == []
+    d1 = _CheckedLock(threading.Lock(), "D._lock", shim)
+    d2 = _CheckedLock(threading.Lock(), "D._lock", shim)
+    with d1:
+        with d2:  # two instances of one class nested: deadlock shape
+            pass
+    assert [v["kind"] for v in shim.violations] == [
+        "same-class-nesting"
+    ]
+
+
+def test_runtime_shim_instruments_real_classes():
+    """install() wraps new instances of the mapped classes and the
+    obs singletons; acquisitions are observed and uninstall restores
+    the constructors."""
+    from koordinator_tpu.scheduler.cache import SchedulerCache
+    from koordinator_tpu.testing.lockorder import (
+        LockOrderShim,
+        _CheckedLock,
+    )
+
+    shim = LockOrderShim.from_static_analysis()
+    orig_init = SchedulerCache.__init__
+    with shim:
+        from koordinator_tpu.apis.types import NodeSpec
+
+        cache = SchedulerCache()
+        assert isinstance(cache._lock, _CheckedLock)
+        cache.add_node(NodeSpec(name="n0", allocatable={}))
+        assert shim.acquisitions > 0
+        assert shim.violations == []
+    assert SchedulerCache.__init__ is orig_init
+
+
+# -- 5. CLI: incremental mode + per-rule stats -------------------------------
+
+def test_cli_changed_files_json(capsys):
+    from koordinator_tpu.analysis.graftcheck.__main__ import main
+
+    rc = main([
+        "--changed-files=koordinator_tpu/models/placement.py",
+        "--format=json",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["violation_count"] == 0
+    assert payload["changed_files"] == [
+        "koordinator_tpu/models/placement.py"
+    ]
+    rules = payload["rules"]
+    # whole-program passes ran despite the narrowed local set
+    for name in ("sync-reach", "lock-order", "donation-safety"):
+        assert name in rules and rules[name]["violations"] == 0
+    assert all("wall_s" in s for s in rules.values())
+
+
+def test_lock_order_reentrant_self_edge_suppressed():
+    """An RLock-backed class legally re-acquires its own lock through
+    sibling-method calls; the static pass must not report that as a
+    self-edge deadlock — while a non-reentrant class with the same
+    shape still flags."""
+    import textwrap
+
+    src = textwrap.dedent('''
+        import threading
+
+        class Re:
+            def __init__(self):
+                self._lock = threading.RLock()
+            def outer(self):
+                with self._lock:
+                    return self.inner()
+            def inner(self):
+                with self._lock:
+                    return 1
+    ''')
+    path = "tests/fixtures/graftcheck/_reentrant_virtual.py"
+    module = ModuleFile(path=path, tree=ast.parse(src), source=src)
+    flagged = LockOrderRule(locks=(
+        LockNode(path=path, class_name="Re", lock="_lock"),
+    )).check_program(Program([module]))
+    assert len(flagged) == 1 and "Re._lock" in flagged[0].symbol
+    quiet = LockOrderRule(locks=(
+        LockNode(path=path, class_name="Re", lock="_lock",
+                 reentrant=True),
+    )).check_program(Program([module]))
+    assert quiet == []
+
+
+def test_changed_files_still_reports_missing_justification(tmp_path):
+    """The incremental mode may skip staleness for unscanned entries —
+    but a missing `reason` needs no rescan and must fail even when the
+    entry's file is outside the changed set (check.sh's default mode)."""
+    from koordinator_tpu.analysis.graftcheck.engine import (
+        load_allowlist as _load,
+    )
+
+    toml = tmp_path / "graftcheck.toml"
+    toml.write_text(
+        '[[allow]]\nrule = "host-sync"\n'
+        'path = "koordinator_tpu/models/placement.py"\n'
+    )
+    violations, _, _ = run_checks_timed(
+        iter_repo_modules(REPO), default_rules(), _load(toml),
+        changed=["koordinator_tpu/ops/binpack.py"],
+    )
+    rules = {v.rule for v in violations}
+    assert "allowlist-justification" in rules
+    # staleness for the same unscanned entry stays unknowable
+    assert "stale-allowlist" not in rules
+
+
+def test_changed_files_does_not_flag_unscanned_allowlist_stale():
+    """An incremental run over a file with no allowlisted syncs must
+    not report the OTHER files' entries as stale — their rules never
+    rescanned them."""
+    allowlist = load_allowlist(REPO / "graftcheck.toml")
+    violations, _, _ = run_checks_timed(
+        iter_repo_modules(REPO), default_rules(), allowlist,
+        changed=["koordinator_tpu/ops/binpack.py"],
+    )
+    assert [v for v in violations if v.rule == "stale-allowlist"] == []

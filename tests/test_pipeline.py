@@ -52,6 +52,15 @@ from koordinator_tpu.state.cluster import lower_nodes
 
 CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
 
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_under_pipeline(lock_order_shim):
+    """The pipelined churn — coordinator + publisher + prestage threads
+    crossing every mapped lock — runs under the runtime lock-order
+    shim; the fixture asserts zero order violations at teardown."""
+    yield lock_order_shim
+
+
 N_NODES = 12
 
 

@@ -208,6 +208,29 @@ def compare_records(old: dict, new: dict, thr: Thresholds
     top_new = {k: v for k, v in new.items() if k != "matrix"
                and not isinstance(v, (dict, str))}
     compare_flat("<top>", top_old, top_new)
+    # per-rule graftcheck counts (ISSUE 9): identity-flag semantics —
+    # ANY nonzero count in NEW is a regression, whether or not OLD
+    # recorded the rule (new rules must arrive clean, and a rule
+    # disappearing from NEW while OLD had it is flagged like a leg
+    # error). Not thresholded: lint findings never average out.
+    rules_old = old.get("graftcheck_rules") or {}
+    rules_new = new.get("graftcheck_rules") or {}
+    if isinstance(rules_new, dict):
+        for rule in sorted(rules_new):
+            nv = rules_new[rule]
+            bad = isinstance(nv, (int, float)) and nv > 0
+            rows.append({
+                "leg": "<graftcheck>", "metric": rule,
+                "old": rules_old.get(rule), "new": nv,
+                "verdict": "REGRESSION" if bad else "ok",
+            })
+        if isinstance(rules_old, dict):
+            for rule in sorted(set(rules_old) - set(rules_new)):
+                rows.append({
+                    "leg": "<graftcheck>", "metric": rule,
+                    "old": rules_old[rule], "new": None,
+                    "verdict": "REGRESSION",
+                })
     for leg in sorted(set(old_m) & set(new_m)):
         if not isinstance(old_m[leg], dict) or \
                 not isinstance(new_m[leg], dict):

@@ -7,7 +7,16 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== graftcheck =="
-JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m koordinator_tpu.analysis.graftcheck "$@"
+# incremental by default: local rules scan the git-diff-scoped file set
+# while the whole-program passes (sync-reach, lock-order,
+# donation-safety) always load the full call graph; a clean tree falls
+# back to the full scan automatically. GRAFTCHECK_FULL=1 forces a full
+# local scan too (CI / release gates).
+if [ "${GRAFTCHECK_FULL:-0}" = "1" ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m koordinator_tpu.analysis.graftcheck "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m koordinator_tpu.analysis.graftcheck --changed-files=auto "$@"
+fi
 
 echo "== chaos smoke =="
 # a fast seeded fault-injection pass through the failure-domain layer
