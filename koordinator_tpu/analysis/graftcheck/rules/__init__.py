@@ -213,6 +213,17 @@ PARITY_SPECS = (
         ),
         allowed_helpers=("_metric_fresh",),
     ),
+    # the sharded staging row path (ISSUE 10): pad_node_rows builds the
+    # inert padding rows every node-sharded device_put appends — held
+    # to the same registry discipline as the lowering pair, so a
+    # padding row is always "a permanently empty node" built by the
+    # shared helpers and never an inline per-caller fold that could
+    # drift from what an unschedulable zero node lowers to
+    ParitySpec(
+        path="koordinator_tpu/state/cluster.py",
+        funcs=("pad_node_rows",),
+        required_helpers=("_pad_width", "_pad_axis0", "_pad_names"),
+    ),
 )
 
 

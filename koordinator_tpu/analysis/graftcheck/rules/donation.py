@@ -329,9 +329,14 @@ class DonationRule:
     def _pin_guarded(fn: ast.AST, call: ast.Call, chain: str,
                      pin_chain: str) -> bool:
         """Whether ``call`` sits in the not-pinned branch of an
-        ``<chain> is/is not <pin_chain>`` test."""
+        ``<chain> is/is not <pin_chain>`` test. Boolean combinations
+        keep only the SOUND direction: the orelse of ``if (X is PIN)
+        or C`` proves ``X is not PIN`` (every disjunct is false
+        there), and the body of ``if (X is not PIN) and C`` proves it
+        too (every conjunct holds there) — the dual placements prove
+        nothing and stay unguarded."""
 
-        def compare_matches(test: ast.expr) -> Optional[str]:
+        def bare_compare(test: ast.expr) -> Optional[str]:
             if not (isinstance(test, ast.Compare)
                     and len(test.ops) == 1
                     and len(test.comparators) == 1):
@@ -342,6 +347,18 @@ class DonationRule:
                 return None
             return "is" if isinstance(test.ops[0], ast.Is) else \
                 "is-not" if isinstance(test.ops[0], ast.IsNot) else None
+
+        def compare_matches(test: ast.expr) -> Optional[str]:
+            direct = bare_compare(test)
+            if direct is not None:
+                return direct
+            if isinstance(test, ast.BoolOp):
+                kinds = [bare_compare(v) for v in test.values]
+                if isinstance(test.op, ast.Or) and "is" in kinds:
+                    return "is"
+                if isinstance(test.op, ast.And) and "is-not" in kinds:
+                    return "is-not"
+            return None
 
         def contains(node: ast.AST) -> bool:
             return any(sub is call for sub in ast.walk(node))

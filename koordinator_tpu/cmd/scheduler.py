@@ -96,6 +96,13 @@ class SchedulerConfig:
     #: big clusters — so raise it on deployments where a false
     #: scheduler_stuck_cycles_total alert is worse than slow detection
     monitor_timeout_seconds: float = 10.0
+    #: node-axis shard count (docs/DESIGN.md §19): >1 splits the staged
+    #: world over a ``nodes × pods`` mesh of that many devices and
+    #: turns on sharded delta staging (dirty rows scattered into their
+    #: owning shard of a live NamedSharding'd world). 1 = unsharded.
+    #: Requires >= node_shards attached devices; in-process backend
+    #: only (the sidecar stages its own world)
+    node_shards: int = 1
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -146,9 +153,28 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
         score_according_prod=config.score_according_prod,
         unroll=config.solver_unroll,
     )
-    if backend is not None or not gates.enabled("BatchedPlacement"):
+    sharding = None
+    if config.node_shards > 1:
+        if backend is not None:
+            raise ValueError(
+                "--node-shards applies to the in-process solver only — "
+                "the sidecar backend stages its own world"
+            )
+        from koordinator_tpu.parallel.mesh import (
+            make_mesh2d,
+            node_sharding,
+        )
+
+        # raises loudly when fewer devices are attached than shards
+        sharding = node_sharding(
+            make_mesh2d(node_shards=config.node_shards)
+        )
+    if backend is not None or not gates.enabled("BatchedPlacement") \
+            or sharding is not None:
         # the sidecar routes everything remote; gated-off batched
-        # placement never consults the cutoff — don't pay the probe
+        # placement never consults the cutoff; a sharded world must
+        # not fall back to the host sequential path (it would sync the
+        # whole mesh per tiny solve) — don't pay the probe
         fallback_cells = 0
     elif config.host_fallback_cells < 0:
         from koordinator_tpu.models.placement import (
@@ -163,6 +189,7 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
         aggregated=aggregated,
         backend=backend,
         host_fallback_cells=fallback_cells,
+        sharding=sharding,
     )
     if backend is not None and hasattr(backend, "on_flip_back"):
         # failover flip-back forces a full relower+restage so the
@@ -512,6 +539,13 @@ def main(argv=None) -> int:
              "rounds; default: $KTPU_PROFILE_DIR or <tmp>/koord-profile)",
     )
     parser.add_argument(
+        "--node-shards", type=int, default=1,
+        help="split the staged node axis over this many devices "
+             "(nodes x pods mesh, sharded delta staging — "
+             "docs/DESIGN.md §19); 1 = unsharded, requires that many "
+             "attached devices and the in-process backend",
+    )
+    parser.add_argument(
         "--monitor-timeout", type=float, default=10.0,
         help="stuck-cycle watchdog threshold in seconds: an open "
              "round/publish mark older than this counts into "
@@ -556,6 +590,7 @@ def main(argv=None) -> int:
         flight_dir=args.flight_dir,
         profile_dir=args.profile_dir,
         monitor_timeout_seconds=args.monitor_timeout,
+        node_shards=args.node_shards,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler

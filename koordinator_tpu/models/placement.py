@@ -355,6 +355,22 @@ class StagedStateCache:
     path). The dirty-row count is bucketed to powers of two (padding
     repeats the last row — same value, same result) so drifting dirty
     counts reuse one compiled scatter per bucket.
+
+    Sharded staging (docs/DESIGN.md §19): with a node-sharded model
+    (``PlacementModel(sharding=NamedSharding(mesh, P("nodes")))``), the
+    staged world lives as a live ``NamedSharding``'d generation —
+    ``stage_nodes`` pads the node axis to the per-shard bucket and
+    splits it over the mesh ONCE; every later delta tick runs the SAME
+    scatter program on the sharded generation, where GSPMD masks each
+    shard's write to the rows it owns — the dirty rows land in their
+    owning shard and the [N,R] world is never re-split. The host half,
+    the epoch/wire-delta bookkeeping, the dirty-row buckets, and the
+    pin double-buffer rules are all shard-agnostic and apply
+    unchanged. One deliberate difference: the sharded scatter always
+    takes the NON-donating twin — a persistent-cache replay of the
+    donated multi-device scatter mis-aliases same-shaped outputs on
+    this jax build (see the inline note in :meth:`ensure`); the
+    single-device fast path keeps donation.
     """
 
     def __init__(self, model: "PlacementModel"):
@@ -449,15 +465,30 @@ class StagedStateCache:
                         }
                         if want_device and self.state is not None:
                             sidx, srows = bucket_row_update(idx, rows)
-                            if self.state is self._pinned:
-                                # double buffer: an in-flight solve holds
-                                # this generation — write the next one
-                                # beside it instead of donating its
-                                # buffers out from under the dispatch
+                            if (self.state is self._pinned
+                                    or self.model._node_shards > 1):
+                                # non-donating twin, two reasons: (a)
+                                # double buffer — an in-flight solve
+                                # holds this generation, so write the
+                                # next one beside it instead of
+                                # donating its buffers out from under
+                                # the dispatch; (b) a SHARDED world
+                                # never donates — on this jax (0.4.x
+                                # CPU) a persistent-compilation-cache
+                                # replay of the donated MULTI-DEVICE
+                                # scatter mis-applies the input→output
+                                # alias map and hands back same-shaped
+                                # columns swapped (used_req↔prod_usage,
+                                # the bool masks); reproduced in ISSUE
+                                # 10, one generation-sized copy per
+                                # tick is the safe price until a fixed
+                                # jax lets sharded donation back in.
                                 self.state = scatter_node_rows_copied(
                                     self.state, jnp.asarray(sidx), srows
                                 )
                             else:
+                                # single-device, unpinned: the PR 6
+                                # donating fast path
                                 self.state = scatter_node_rows_donated(
                                     self.state, jnp.asarray(sidx), srows
                                 )
@@ -656,6 +687,15 @@ class PlacementModel:
             prod_thresholds=jnp.asarray(_vec(prod_usage_thresholds or {})),
         )
         self.sharding = sharding
+        #: how many ways the configured sharding splits the node axis
+        #: (1 = unsharded). >1 turns on sharded staging: the node axis
+        #: is padded to a per-shard bucket before every device_put so a
+        #: live NamedSharding'd world stays equal-width per shard, and
+        #: the staging cache's dirty-row scatter then lands each row in
+        #: its owning shard (docs/DESIGN.md §19).
+        from koordinator_tpu.parallel.mesh import node_shard_count
+
+        self._node_shards = node_shard_count(sharding)
         self.fine = fine
         self.pod_bucketing = pod_bucketing
         #: remote solve backend (service.client.RemoteSolver) — the
@@ -741,10 +781,40 @@ class PlacementModel:
 
     # -- staging ------------------------------------------------------------
 
+    def staged_node_count(self, n: int) -> int:
+        """The node-axis width the staged world will have for ``n`` real
+        nodes: the per-shard bucket target under sharded staging, ``n``
+        itself otherwise. Extras/NUMA columns built against the real
+        node set pad to this width so every device operand agrees."""
+        if self._node_shards <= 1:
+            return n
+        from koordinator_tpu.parallel.mesh import shard_node_bucket
+
+        return shard_node_bucket(n, self._node_shards)
+
     def stage_nodes(
         self, arrays: NodeArrays, numa_cap=None, numa_free=None
     ) -> NodeState:
-        """Stage host node arrays onto devices (sharded if configured)."""
+        """Stage host node arrays onto devices (sharded if configured).
+
+        Under a node-sharded ``NamedSharding`` the arrays are first
+        padded to the per-shard bucket (:func:`parallel.mesh.
+        shard_node_bucket`) with inert rows (``state.cluster.
+        pad_node_rows``): every shard is equal-width, the padded rows
+        can never win a placement, and the waste is gauged per stage
+        (``shard_nodes`` padding bucket)."""
+        if self._node_shards > 1:
+            from koordinator_tpu.state.cluster import pad_node_rows
+
+            target = self.staged_node_count(arrays.n)
+            DEVICE_OBS.note_padding("shard_nodes", arrays.n, target)
+            if target != arrays.n:
+                pad = target - arrays.n
+                arrays = pad_node_rows(arrays, target)
+                if numa_cap is not None:
+                    numa_cap = np.pad(numa_cap, ((0, pad), (0, 0)))
+                if numa_free is not None:
+                    numa_free = np.pad(numa_free, ((0, pad), (0, 0)))
         put = (
             (lambda x: jax.device_put(x, self.sharding))
             if self.sharding is not None
@@ -905,6 +975,14 @@ class PlacementModel:
         if use_numa:
             numa_cap, numa_free, node_policy = fine.numa_arrays(node_arrays.names)
             has_numa_policy_arr = jnp.asarray(pod_policy)
+            # sharded staging pads the staged node axis: the per-node
+            # policy column must match that width (padding rows carry
+            # no policy — they are never placeable anyway)
+            n_staged = self.staged_node_count(node_arrays.n)
+            if n_staged != node_arrays.n:
+                node_policy = np.pad(
+                    node_policy, (0, n_staged - node_arrays.n)
+                )
             numa_aux = NumaAux(node_policy=jnp.asarray(node_policy))
 
         t_host_done = time.perf_counter()
@@ -1101,11 +1179,14 @@ class PlacementModel:
         def _extras_device():
             """Extras from the (unpadded) host rows, padded to the batch
             length — the refine loop rebuilds through this so re-solves
-            keep matching scan dims."""
+            keep matching scan dims. Under sharded staging the node
+            columns additionally pad to the staged width (all-False
+            mask: a padding node is never feasible)."""
             pad = padded_p - mask_np.shape[0]
-            if pad:
-                mask = np.pad(mask_np, ((0, pad), (0, 0)))
-                score = np.pad(score_np, ((0, pad), (0, 0)))
+            col_pad = self.staged_node_count(node_arrays.n) - mask_np.shape[1]
+            if pad or col_pad:
+                mask = np.pad(mask_np, ((0, pad), (0, col_pad)))
+                score = np.pad(score_np, ((0, pad), (0, col_pad)))
             else:
                 mask, score = mask_np, score_np
             return Extras(mask=jnp.asarray(mask), score=jnp.asarray(score))

@@ -106,8 +106,12 @@ def explain_scores(model, snapshot, pod) -> Tuple[object, Dict[str, np.ndarray]]
     cols: Dict[str, np.ndarray] = {}
     for name, col in out.items():
         # the observability layer's one designated read-back: breakdown
-        # columns land on host for the debug payload / parity check
-        cols[name] = np.asarray(col)
+        # columns land on host for the debug payload / parity check.
+        # Trimmed to the REAL node count: a node-sharded model stages a
+        # bucket-padded world (DESIGN.md §19), and untrimmed columns
+        # would count padding rows as "unschedulable" rejections — and
+        # let a padding index reach names[i] in the top-K detail
+        cols[name] = np.asarray(col)[: arrays.n]
     return arrays, cols
 
 

@@ -11,8 +11,15 @@ reference's node-parallel Filter/Score fan-out
 
 from koordinator_tpu.parallel.mesh import (  # noqa: F401
     NODE_AXIS,
+    POD_AXIS,
+    lane_sharding,
     make_mesh,
+    make_mesh2d,
+    node_shard_count,
     node_sharding,
     pad_node_arrays,
+    shard_lane_solver,
+    shard_node_bucket,
     shard_solver,
+    stack_pod_lanes,
 )

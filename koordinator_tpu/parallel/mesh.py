@@ -1,16 +1,28 @@
-"""Mesh construction + node-axis sharding for the batched solver.
+"""Mesh construction + node/pod-batch sharding for the batched solver.
 
-Layout: a 1-D mesh over all available chips, axis ``nodes``. Every
-``[N, ...]`` node-side array is sharded on its leading axis; pod batches
-and scoring parameters are replicated. Under ``jax.jit`` with these
-shardings, GSPMD partitions the per-pod Filter/Score math over node shards
-and inserts the cross-chip argmax (an ``allreduce-max`` + index select)
-on ICI — no hand-written collectives needed.
+Layout: a ``nodes × pods`` 2-D mesh (DESIGN.md §19). The two axes shard
+the two independent scale dimensions of the workload:
+
+- ``nodes`` — every ``[N, ...]`` node-side array splits on its leading
+  axis. Under ``jax.jit`` with these shardings, GSPMD partitions the
+  per-pod Filter/Score math over node shards and inserts the cross-chip
+  argmax (an ``allreduce-max`` + index select) on ICI — no hand-written
+  collectives. This is the CAPACITY axis: it buys node count (the 50k+
+  node worlds of bench leg 14) at the price of one tiny per-pod-step
+  merge collective.
+- ``pods`` — stacked INDEPENDENT pod batches (the admission gate's
+  vmap lanes: separate callers' bursts against one shared base) split
+  on their leading lane axis. Lanes never interact, so this axis is
+  collective-free and scales throughput near-linearly (bench leg 15) —
+  the right home for giant pod bursts.
+
+The classic 1-D ``make_mesh`` remains the node-only special case; every
+sharding helper below works on either mesh (a ``PartitionSpec`` naming
+only one axis replicates over the other).
 """
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 from typing import Optional, Sequence
 
@@ -25,10 +37,12 @@ from koordinator_tpu.ops.binpack import (
     ScoreParams,
     SolverConfig,
     schedule_batch,
+    solve_batch,
 )
-from koordinator_tpu.state.cluster import NodeArrays
+from koordinator_tpu.state.cluster import NodeArrays, pad_node_rows
 
 NODE_AXIS = "nodes"
+POD_AXIS = "pods"
 
 
 def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
@@ -75,42 +89,99 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.asarray(devices), (NODE_AXIS,))
 
 
+def make_mesh2d(
+    devices: Optional[Sequence[jax.Device]] = None,
+    node_shards: Optional[int] = None,
+    pod_shards: int = 1,
+) -> Mesh:
+    """The ``nodes × pods`` 2-D mesh: ``node_shards`` splits the node
+    axis (capacity), ``pod_shards`` splits the stacked-lane axis of
+    independent pod batches (throughput). Defaults: all pod-axis-free
+    devices go to the node axis. ``make_mesh2d(pod_shards=k)`` with
+    ``node_shards=1`` is the pure burst-sharding mesh of bench leg 15;
+    ``make_mesh2d(node_shards=k)`` is the capacity mesh of leg 14."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if node_shards is None:
+        node_shards = max(1, len(devices) // pod_shards)
+    want = node_shards * pod_shards
+    if want > len(devices):
+        raise ValueError(
+            f"mesh {node_shards}x{pod_shards} needs {want} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:want]).reshape(node_shards, pod_shards)
+    return Mesh(grid, (NODE_AXIS, POD_AXIS))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    """Shard count of ``axis`` on ``mesh`` (1 when the mesh lacks it)."""
+    return int(mesh.shape.get(axis, 1))
+
+
 def node_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for node-major arrays: leading axis split over ``nodes``."""
+    """Sharding for node-major arrays: leading axis split over ``nodes``
+    (replicated over any other mesh axis)."""
     return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def lane_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for lane-stacked pod batches: leading (lane) axis split
+    over ``pods`` (replicated over the node axis)."""
+    return NamedSharding(mesh, P(POD_AXIS))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def node_shard_count(sharding) -> int:
+    """How many ways ``sharding`` splits a node-major array's LEADING
+    axis — 1 for None, replicated, or non-Named shardings. The staging
+    layer uses this to size the pow2-bucket node padding
+    (:func:`shard_node_bucket`) before a mesh ``device_put``."""
+    if not isinstance(sharding, NamedSharding):
+        return 1
+    spec = tuple(sharding.spec)
+    if not spec or spec[0] is None:
+        return 1
+    axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+    count = 1
+    for axis in axes:
+        count *= mesh_axis_size(sharding.mesh, axis)
+    return count
+
+
+def shard_node_bucket(n: int, shards: int) -> int:
+    """The padded GLOBAL node count for ``n`` real nodes over
+    ``shards`` shards: each shard's local width is the quarter-step
+    pow2 bucket of ``ceil(n / shards)`` (floor 8) — the same bucketing
+    family as ``StagedStateCache``'s pod/dirty-row buckets, so a
+    drifting node count re-uses one compiled sharded program per bucket
+    while bounding padding waste at ~12.5% (plus the divisibility
+    remainder). Every shard is equal-width, so a ``NamedSharding``
+    ``device_put`` never needs uneven layouts."""
+    if shards <= 1:
+        return n
+    local = -(-n // shards)  # ceil
+    if local <= 8:
+        local = 8
+    else:
+        power = 1 << (local - 1).bit_length()
+        step = max(1, power // 8)
+        local = ((local + step - 1) // step) * step
+    return local * shards
+
+
 def pad_node_arrays(arrays: NodeArrays, multiple: int) -> NodeArrays:
     """Pad the node axis up to a multiple of the shard count.
 
     Padding nodes are unschedulable with zero allocatable, so they can
-    never win a placement — semantics are unchanged.
-    """
-    n = arrays.n
-    target = ((n + multiple - 1) // multiple) * multiple
-    if target == n:
-        return arrays
-    pad = target - n
-
-    def pad2d(a):
-        return np.pad(a, ((0, pad), (0, 0)))
-
-    return dataclasses.replace(
-        arrays,
-        names=arrays.names + [f"__pad_{i}__" for i in range(pad)],
-        alloc=pad2d(arrays.alloc),
-        used_req=pad2d(arrays.used_req),
-        usage=pad2d(arrays.usage),
-        prod_usage=pad2d(arrays.prod_usage),
-        est_extra=pad2d(arrays.est_extra),
-        prod_base=pad2d(arrays.prod_base),
-        metric_fresh=np.pad(arrays.metric_fresh, (0, pad)),
-        schedulable=np.pad(arrays.schedulable, (0, pad)),  # False padding
-    )
+    never win a placement — semantics are unchanged. Row construction
+    lives in :func:`state.cluster.pad_node_rows` (the delta-parity
+    registry) so a padded row can never drift from "a permanently
+    empty node"."""
+    target = ((arrays.n + multiple - 1) // multiple) * multiple
+    return pad_node_rows(arrays, target)
 
 
 def shard_node_state(state: NodeState, mesh: Mesh) -> NodeState:
@@ -175,7 +246,19 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
     from koordinator_tpu.ops.quota import quota_runtime
 
     devices = list(mesh.devices.flat)
-    k = len(devices)
+    # the in-kernel merge is a NODE-axis collective: on a 2-D mesh the
+    # remote-DMA ring spans exactly the node axis. A pod-sharded lane
+    # axis would need per-lane rings the kernel does not build — route
+    # lane bursts through shard_lane_solver instead.
+    if mesh_axis_size(mesh, POD_AXIS) > 1:
+        raise ValueError(
+            "shard_kernel_solver shards the node axis only — use "
+            "shard_lane_solver for a pod-batch-sharded mesh"
+        )
+    k = (
+        mesh_axis_size(mesh, NODE_AXIS)
+        if NODE_AXIS in mesh.shape else len(devices)
+    )
 
     def solve(state, pods, params, quota_state=None, gang_state=None,
               numa_aux=None, resv=None):
@@ -328,6 +411,116 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
         return result._replace(
             node_state=NodeState(*(trim(x) for x in result.node_state))
         )
+
+    return solve
+
+
+def stack_pod_lanes(batches: Sequence[PodBatch]) -> PodBatch:
+    """Stack K independent same-shape pod batches into one ``[K, P,
+    ...]`` lane batch for :func:`shard_lane_solver`. Lanes must agree on
+    pod count and on whether ``has_numa_policy`` is carried (the stack
+    is a shape operation, not a semantic merge — every lane still
+    solves alone against the shared base, exactly like the admission
+    gate's coalesced vmap stack)."""
+    import jax.numpy as jnp
+
+    if not batches:
+        raise ValueError("stack_pod_lanes needs at least one batch")
+    cols = []
+    for field in range(len(PodBatch._fields)):
+        vals = [b[field] for b in batches]
+        if all(v is None for v in vals):
+            cols.append(None)
+        elif any(v is None for v in vals):
+            raise ValueError(
+                f"lanes disagree on PodBatch.{PodBatch._fields[field]} "
+                "presence — stack only uniform batches"
+            )
+        else:
+            cols.append(jnp.stack(vals))
+    return PodBatch(*cols)
+
+
+def shard_lane_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
+                      want_state: bool = True):
+    """The pod-batch axis of the 2-D mesh: K INDEPENDENT lanes (stacked
+    pod batches over one shared node base — the admission gate's
+    coalesce shape, or any giant burst split into independent waves)
+    solved as one vmapped program with the lane axis sharded over
+    ``pods``.
+
+    Returns ``solve(state, lanes, params) -> (node_states, assign)``
+    where ``lanes`` is a ``[L, P, ...]`` :class:`PodBatch` (build with
+    :func:`stack_pod_lanes`), ``node_states`` is the per-lane mutated
+    carry ``[L, N, ...]`` and ``assign`` is ``[L, P]``. Lanes never
+    communicate — no per-step collective exists on this axis, so
+    wall-clock scales with the shard count (bench leg 15) — and each
+    lane is bit-identical to solving it alone (the int-arithmetic vmap
+    property the admission gate already leans on). The node axis of the
+    base follows the mesh's ``nodes`` axis when it is >1 (a true 2-D
+    run); on a lane-only mesh the base replicates.
+
+    The lane count is padded up to a shard multiple with hard-blocked
+    duplicate lanes (placements discarded, outputs trimmed) so any L
+    works; the waste rides the ``pod_lanes`` padding gauge.
+
+    ``want_state=False`` compiles an assignments-only program
+    (``node_states`` comes back None): callers that only read
+    placements skip materializing the ``[L, N, ...]`` per-lane carries
+    — at 32 lanes x thousands of nodes those outputs are tens of MB a
+    call and (measured on the virtual-CPU mesh) their allocator churn
+    is the difference between a clean scaling curve and a noisy one."""
+    import jax.numpy as jnp
+
+    ns = node_sharding(mesh)
+    lane = lane_sharding(mesh)
+    rep = replicated(mesh)
+    k = mesh_axis_size(mesh, POD_AXIS)
+
+    if want_state:
+        body = lambda s, p, pr: (
+            lambda r: (r.node_state, r.assign)
+        )(solve_batch(s, p, pr, config))
+    else:
+        body = lambda s, p, pr: (
+            None, solve_batch(s, p, pr, config).assign
+        )
+    jit_lanes = DEVICE_OBS.jit("shard_lane_solver", jax.jit(
+        jax.vmap(body, in_axes=(None, 0, None)),
+        static_argnums=(), donate_argnums=(),
+    ))
+
+    def pad_lanes(lanes: PodBatch, pad: int) -> PodBatch:
+        def dup(a):
+            if a is None:
+                return None
+            return jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)])
+
+        padded = PodBatch(*(dup(x) for x in lanes))
+        # padding lanes are copies of the last real lane with every pod
+        # hard-blocked: they place nothing, mutate nothing that
+        # survives the trim, and keep every shard equal-width
+        return padded._replace(
+            blocked=padded.blocked.at[-pad:].set(True)
+        )
+
+    def solve(state: NodeState, lanes: PodBatch, params: ScoreParams):
+        l_real = int(lanes.req.shape[0])
+        target = -(-l_real // k) * k
+        DEVICE_OBS.note_padding("pod_lanes", l_real, target)
+        if target != l_real:
+            lanes = pad_lanes(lanes, target - l_real)
+        state = jax.device_put(state, jax.tree.map(lambda _: ns, state))
+        lanes = jax.device_put(lanes, jax.tree.map(lambda _: lane, lanes))
+        params = jax.device_put(params, jax.tree.map(lambda _: rep, params))
+        node_states, assign = jit_lanes(state, lanes, params)
+        if target != l_real:
+            if node_states is not None:
+                node_states = NodeState(*(
+                    None if x is None else x[:l_real] for x in node_states
+                ))
+            assign = assign[:l_real]
+        return node_states, assign
 
     return solve
 

@@ -667,6 +667,63 @@ def lower_node_rows(
     }
 
 
+def _pad_width(target: int, n: int) -> int:
+    """Rows to append to reach ``target`` (0 when already there) —
+    the one arithmetic step of the padding path, kept in a helper so
+    :func:`pad_node_rows` stays free of inline value math (the
+    delta-parity registry contract)."""
+    return max(0, target - n)
+
+
+def _pad_axis0(a: np.ndarray, pad: int, fill=0) -> np.ndarray:
+    """Append ``pad`` rows of ``fill`` along axis 0 (any trailing
+    shape). Shared by every padding consumer so a padded row is
+    all-``fill`` by construction, never an ad-hoc per-caller fold."""
+    widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, widths, constant_values=fill)
+
+
+def _pad_names(names: List[str], pad: int) -> List[str]:
+    """Names for appended padding rows — reserved, never a real node."""
+    return names + [f"__pad_{i}__" for i in range(pad)]
+
+
+def pad_node_rows(arrays: NodeArrays, target: int) -> NodeArrays:
+    """``arrays`` grown to ``target`` rows with inert padding nodes —
+    the sharded staging path's row source (parallel/mesh.py pads the
+    node axis to a per-shard bucket before a mesh ``device_put``).
+
+    Padding rows are unschedulable with zero allocatable and no metric
+    (``metric_update_time`` −inf), so they can never win a placement or
+    flip ``metric_fresh`` — semantics are unchanged, only the staged
+    shape grows. Routed through the same padding helpers graftcheck's
+    delta-parity rule pins (``_pad_width``/``_pad_axis0``/
+    ``_pad_names``): the padded world stays bit-identical to lowering
+    ``target − n`` permanently-empty nodes, and no caller can grow its
+    own drifting inline variant. Returns new buffers (``np.pad``
+    copies); the caller's in-place delta patching of the ORIGINAL
+    arrays is unaffected."""
+    pad = _pad_width(target, arrays.n)
+    if pad == 0:
+        return arrays
+    return dataclasses.replace(
+        arrays,
+        names=_pad_names(arrays.names, pad),
+        alloc=_pad_axis0(arrays.alloc, pad),
+        used_req=_pad_axis0(arrays.used_req, pad),
+        usage=_pad_axis0(arrays.usage, pad),
+        prod_usage=_pad_axis0(arrays.prod_usage, pad),
+        est_extra=_pad_axis0(arrays.est_extra, pad),
+        prod_base=_pad_axis0(arrays.prod_base, pad),
+        metric_fresh=_pad_axis0(arrays.metric_fresh, pad, fill=False),
+        schedulable=_pad_axis0(arrays.schedulable, pad, fill=False),
+        metric_update_time=(
+            _pad_axis0(arrays.metric_update_time, pad, fill=-np.inf)
+            if arrays.metric_update_time is not None else None
+        ),
+    )
+
+
 def schedule_order(pods: Sequence[PodSpec]) -> List[int]:
     """Order pending pods the way the scheduler queue would: numeric
     priority descending, then sub-priority descending, then FIFO."""

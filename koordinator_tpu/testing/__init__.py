@@ -163,3 +163,102 @@ def example_resv(n_resv, n_nodes, n_pods, seed=9):
         allocate_once=jnp.asarray(rng.uniform(size=n_resv) < 0.4),
         match=jnp.asarray(rng.uniform(size=(n_pods, n_resv)) < 0.3),
     )
+
+
+def churn_world(n_nodes, *, assigned_per_node=2, seed=42,
+                with_tracker=False):
+    """The seeded typed churn world shared by bench legs 9/14 and the
+    sharded-staging tests: ``n_nodes`` uniform nodes, ``assigned_per_node
+    * n_nodes`` randomly-bound pods, full metric coverage at t=10, a
+    snapshot at now=20 with an optional :class:`ClusterDeltaTracker`.
+    One definition so the three churn harnesses can never drift apart
+    in workload shape. Returns ``(snapshot, tracker)``."""
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot,
+        NodeMetric,
+        NodeSpec,
+        PodSpec,
+    )
+    from koordinator_tpu.state.cluster import ClusterDeltaTracker
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    rng = np.random.default_rng(seed)
+    nodes = [
+        NodeSpec(name=f"n{i}", allocatable={CPU: 64000, MEM: 131072})
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for j in range(assigned_per_node * n_nodes):
+        node_i = int(rng.integers(0, n_nodes))
+        pods.append(PodSpec(
+            name=f"a{j}", node_name=f"n{node_i}", assign_time=5.0,
+            requests={CPU: int(rng.integers(200, 2000)),
+                      MEM: int(rng.integers(128, 2048))},
+        ))
+    metrics = {
+        f"n{i}": NodeMetric(
+            node_name=f"n{i}",
+            node_usage={CPU: int(rng.integers(500, 30000)),
+                        MEM: int(rng.integers(512, 65536))},
+            update_time=10.0,
+        )
+        for i in range(n_nodes)
+    }
+    tracker = ClusterDeltaTracker() if with_tracker else None
+    snap = ClusterSnapshot(
+        nodes=nodes, pods=pods, pending_pods=[],
+        node_metrics=metrics, now=20.0, delta_tracker=tracker,
+    )
+    return snap, tracker
+
+
+def churn_tick_events(snap, tracker, rng, *, dirty, pending, t, now):
+    """One churn tick's mutation stream, applied in place: ``dirty``
+    random nodes get a fresh NodeMetric (pod_usages preserved, tracker
+    marked) and a ``pending``-pod wave lands in ``snap.pending_pods``;
+    ``snap.now`` advances to ``now``. Returns ``{uid: pod}`` of the
+    wave for :func:`fold_churn_binds`. The rng draw ORDER is the
+    contract — bench legs and tests replaying the same seed must see
+    identical worlds."""
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import NodeMetric, PodSpec
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    n_nodes = len(snap.nodes)
+    for i in rng.choice(n_nodes, dirty, replace=False):
+        name = snap.nodes[int(i)].name
+        old = snap.node_metrics[name]
+        snap.node_metrics[name] = NodeMetric(
+            node_name=name,
+            node_usage={CPU: int(rng.integers(500, 30000)),
+                        MEM: int(rng.integers(512, 65536))},
+            update_time=now,
+            pod_usages=old.pod_usages,
+        )
+        if tracker is not None:
+            tracker.mark_node(name)
+    snap.pending_pods = [
+        PodSpec(
+            name=f"t{t}p{j}",
+            requests={CPU: int(rng.integers(200, 1500)),
+                      MEM: int(rng.integers(128, 1024))},
+        )
+        for j in range(pending)
+    ]
+    snap.now = now
+    return {p.uid: p for p in snap.pending_pods}
+
+
+def fold_churn_binds(snap, tracker, result, by_uid, now):
+    """Fold one tick's committed placements back into the world: the
+    placed pods become assigned pods (tracker marked per node) so the
+    next tick's lowering sees them."""
+    for uid, node in result.items():
+        if node is not None:
+            pod = by_uid[uid]
+            pod.node_name = node
+            pod.assign_time = now
+            snap.pods.append(pod)
+            if tracker is not None:
+                tracker.mark_node(node)

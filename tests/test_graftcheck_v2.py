@@ -240,33 +240,24 @@ def test_injected_read_after_donate_fails():
 
 
 _PIN_GUARD_ANCHOR = """\
-                            if self.state is self._pinned:
-                                # double buffer: an in-flight solve holds
-                                # this generation — write the next one
-                                # beside it instead of donating its
-                                # buffers out from under the dispatch
-                                self.state = scatter_node_rows_copied(
-                                    self.state, jnp.asarray(sidx), srows
-                                )
-                            else:
-                                self.state = scatter_node_rows_donated(
-                                    self.state, jnp.asarray(sidx), srows
-                                )"""
+                            if (self.state is self._pinned
+                                    or self.model._node_shards > 1):"""
+
+_PIN_GUARD_REPLACEMENT = """\
+                            if self.model._node_shards > 1:"""
 
 
 def test_injected_unguarded_donation_fails():
-    """The PR 11 clobber class, pin half: strip the pin guard so the
-    donated scatter can hit an in-flight generation — the exact
-    pre-fix shape, now machine-rejected."""
+    """The PR 11 clobber class, pin half: drop the pin disjunct from
+    the copied/donated routing so the donated scatter's else-branch no
+    longer proves not-pinned — the exact pre-fix shape, now
+    machine-rejected."""
     path = "koordinator_tpu/models/placement.py"
     source = (REPO / path).read_text()
     assert _PIN_GUARD_ANCHOR in source, (
         "pin-guard anchor drifted — update the fixture"
     )
-    injected = source.replace(_PIN_GUARD_ANCHOR, """\
-                            self.state = scatter_node_rows_donated(
-                                self.state, jnp.asarray(sidx), srows
-                            )""")
+    injected = source.replace(_PIN_GUARD_ANCHOR, _PIN_GUARD_REPLACEMENT)
     violations, _ = _run_with_replacement(path, injected)
     hits = [v for v in violations if v.rule == "donation-safety"]
     assert any(

@@ -115,6 +115,61 @@ def test_sharded_solver_matches_unsharded():
     assert (np.asarray(got) < 50).all()
 
 
+def test_sharded_solver_matches_unsharded_on_2d_mesh():
+    """The same node-axis program on a ``nodes × pods`` 2-D mesh
+    (node_shards=4, pod_shards=2): node arrays split over ``nodes``
+    and replicate over ``pods`` — results stay bit-identical."""
+    from koordinator_tpu.parallel.mesh import make_mesh2d
+
+    snap = _snapshot(40, 24)
+    node_arrays = lower_nodes(snap)
+    pod_arrays = lower_pending_pods(snap.pending_pods)
+    mesh = make_mesh2d(node_shards=4, pod_shards=2)
+    padded = pad_node_arrays(node_arrays, 4)
+    pods = PodBatch.build(
+        req=jnp.asarray(pod_arrays.req),
+        est=jnp.asarray(pod_arrays.est),
+        is_prod=jnp.asarray(pod_arrays.is_prod),
+        is_daemonset=jnp.asarray(pod_arrays.is_daemonset),
+    )
+    params = ScoreParams(
+        weights=jnp.asarray(
+            np.array([1, 1] + [0] * (NUM_RESOURCES - 2), dtype=np.int32)
+        ),
+        thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+    _, want = schedule_batch(_stage(padded), pods, params, SolverConfig())
+    state = shard_node_state(_stage(padded), mesh)
+    _, got = shard_solver(mesh)(state, pods, params)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_dryrun_failure_protocol_json():
+    """The driver's machine protocol: parse_dryrun_json finds the last
+    dryrun object, and a classified failure maps to its typed exit
+    code."""
+    from __graft_entry__ import (
+        DRYRUN_EXIT_CODES,
+        DryrunFailure,
+        parse_dryrun_json,
+    )
+
+    out = (
+        'noise\n{"dryrun": {"ok": false, "reason": "stale"}}\n'
+        'more\n{"dryrun": {"ok": true, "reason": null, "kernel_leg": '
+        '"ok"}}\ndryrun ok\n'
+    )
+    info = parse_dryrun_json(out)
+    assert info == {"ok": True, "reason": None, "kernel_leg": "ok"}
+    assert parse_dryrun_json("nothing here") is None
+    err = DryrunFailure("identity-diverged", "assign[3] differs")
+    assert DRYRUN_EXIT_CODES[err.reason] == 11
+    # every typed reason has a distinct nonzero code
+    codes = list(DRYRUN_EXIT_CODES.values())
+    assert len(set(codes)) == len(codes) and all(c != 0 for c in codes)
+
+
 def test_padding_preserves_assignments():
     snap = _snapshot(13, 17)
     node_arrays = lower_nodes(snap)

@@ -1,0 +1,485 @@
+"""Sharded staging + the 2-D mesh (ISSUE 10, docs/DESIGN.md §19).
+
+The node axis of the staged world splits over the mesh's ``nodes``
+axis and stays resident as a live NamedSharding'd generation: a full
+stage pads to the per-shard bucket and splits ONCE, every later churn
+tick scatters only the dirty rows into their owning shard. The
+pod-batch (``pods``) axis shards stacked independent lanes. Both must
+be invisible in results: sharded delta churn == single-device full
+restage bit-for-bit, every lane == its solo single-device solve.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+)
+from koordinator_tpu.models.placement import PlacementModel
+from koordinator_tpu.obs.device import DEVICE_OBS
+from koordinator_tpu.ops.binpack import (
+    STAGED_NODE_FIELDS,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    schedule_batch,
+)
+from koordinator_tpu.parallel.mesh import (
+    POD_AXIS,
+    make_mesh2d,
+    mesh_axis_size,
+    node_shard_count,
+    node_sharding,
+    lane_sharding,
+    shard_lane_solver,
+    shard_node_bucket,
+    stack_pod_lanes,
+)
+from koordinator_tpu.state.cluster import (
+    ClusterDeltaTracker,
+    lower_nodes,
+    pad_node_rows,
+)
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+
+
+# -- harness -----------------------------------------------------------------
+# the world/tick generators are the shared ones bench legs 9/14 use
+# (koordinator_tpu.testing churn_world/churn_tick_events) — one churn
+# protocol, no bench-vs-test drift
+
+def build_world(n_nodes, with_tracker, seed=42, assigned_per_node=2):
+    from koordinator_tpu.testing import churn_world
+
+    return churn_world(
+        n_nodes, assigned_per_node=assigned_per_node, seed=seed,
+        with_tracker=with_tracker,
+    )
+
+
+def churn(model, snap, tracker, ticks, dirty=11, pending=16, seed=7,
+          structure_tick=None):
+    """Seeded churn: per tick, metric refreshes (+ an optional node-ADD
+    structure event), a pending wave, binds folded back. Returns the
+    per-tick placement logs and the final snapshot."""
+    from koordinator_tpu.testing import (
+        churn_tick_events,
+        fold_churn_binds,
+    )
+
+    rng = np.random.default_rng(seed)
+    log = []
+    for t in range(ticks):
+        now = 20.0 + t
+        if structure_tick is not None and t == structure_tick:
+            name = f"extra{t}"
+            snap.nodes.append(
+                NodeSpec(name=name, allocatable={CPU: 64000, MEM: 131072})
+            )
+            snap.node_metrics[name] = NodeMetric(
+                node_name=name,
+                node_usage={CPU: 1000, MEM: 1024}, update_time=now,
+            )
+            if tracker is not None:
+                tracker.mark_structure()
+        by_uid = churn_tick_events(
+            snap, tracker, rng, dirty=dirty, pending=pending, t=t,
+            now=now,
+        )
+        result = model.schedule(snap)
+        log.append(sorted(result.items()))
+        fold_churn_binds(snap, tracker, result, by_uid, now)
+    return log, snap
+
+
+def sharded_model(n_shards=8, **kw):
+    mesh = make_mesh2d(node_shards=n_shards, pod_shards=1)
+    return PlacementModel(sharding=node_sharding(mesh), **kw)
+
+
+def assert_worlds_identical(snap_a, snap_b):
+    got = lower_nodes(snap_a)
+    want = lower_nodes(snap_b)
+    assert got.names == want.names
+    for f in STAGED_NODE_FIELDS:
+        np.testing.assert_array_equal(getattr(got, f), getattr(want, f))
+
+
+# -- sharded delta staging == single-device full restage ---------------------
+
+def test_sharded_churn_smoke():
+    """check.sh slice: a short sharded delta churn must match the
+    single-device full-restage run tick for tick."""
+    model_s = sharded_model()
+    model_1 = PlacementModel()
+    snap_s, tracker_s = build_world(120, True)
+    snap_1, _ = build_world(120, False)
+    log_s, end_s = churn(model_s, snap_s, tracker_s, ticks=4)
+    log_1, end_1 = churn(model_1, snap_1, None, ticks=4)
+    assert log_s == log_1
+    assert_worlds_identical(end_s, end_1)
+    # the delta path actually ran sharded (not a silent full fallback)
+    assert model_s.staged_cache.last_path == "delta"
+    staged = model_s.staged_cache.state
+    assert staged.alloc.shape[0] == shard_node_bucket(120, 8)
+    assert node_shard_count(staged.alloc.sharding) == 8
+
+
+def test_sharded_churn_property_with_structure_change():
+    """Longer seeded churn including a node-ADD structure event: the
+    sharded world re-pads/re-splits on the structure fallback and stays
+    bit-identical to the unsharded full-restage run — placements AND
+    final node accounting."""
+    model_s = sharded_model()
+    model_1 = PlacementModel()
+    snap_s, tracker_s = build_world(250, True, seed=9)
+    snap_1, _ = build_world(250, False, seed=9)
+    log_s, end_s = churn(model_s, snap_s, tracker_s, ticks=9, dirty=17,
+                         pending=24, structure_tick=4)
+    log_1, end_1 = churn(model_1, snap_1, None, ticks=9, dirty=17,
+                         pending=24, structure_tick=4)
+    assert log_s == log_1
+    assert_worlds_identical(end_s, end_1)
+    # node accounting: every bind landed exactly once in both worlds
+    placed_s = sorted(
+        (p.uid, p.node_name) for p in end_s.pods if p.node_name
+    )
+    placed_1 = sorted(
+        (p.uid, p.node_name) for p in end_1.pods if p.node_name
+    )
+    assert placed_s == placed_1
+
+
+def test_sharded_delta_vs_sharded_full_restage():
+    """The delta path on the SAME sharded mesh equals a tracker-less
+    sharded run (full re-shard per tick) — the staging cache is a pure
+    latency move on the sharded axis too."""
+    model_d = sharded_model()
+    model_f = sharded_model()
+    snap_d, tracker_d = build_world(90, True, seed=3)
+    snap_f, _ = build_world(90, False, seed=3)
+    log_d, end_d = churn(model_d, snap_d, tracker_d, ticks=5)
+    log_f, end_f = churn(model_f, snap_f, None, ticks=5)
+    assert log_d == log_f
+    assert_worlds_identical(end_d, end_f)
+    assert model_d.staged_cache.last_path == "delta"
+    # the tracker-less model never engages the staging cache at all —
+    # every tick is a from-scratch lower + sharded stage
+    assert model_f.staged_cache.last_path is None
+
+
+def test_sharded_scatter_respects_pin():
+    """The donation double-buffer on the sharded world: while a staged
+    generation is pinned (an in-flight solve holds it), a delta
+    ensure() must write a FRESH generation and leave the pinned
+    buffers bit-identical — the PIN_SPECS clobber guard, sharded."""
+    model = sharded_model()
+    snap, tracker = build_world(64, True, seed=5)
+    snap.pending_pods = []
+    cache = model.staged_cache
+    cache.ensure(snap)
+    pinned = cache.state
+    before = {
+        f: np.asarray(getattr(pinned, f)) for f in STAGED_NODE_FIELDS
+    }
+    cache.pin(pinned)
+    try:
+        name = "n3"
+        old = snap.node_metrics[name]
+        snap.node_metrics[name] = NodeMetric(
+            node_name=name, node_usage={CPU: 31337, MEM: 4096},
+            update_time=21.0, pod_usages=old.pod_usages,
+        )
+        tracker.mark_node(name)
+        snap.now = 21.0
+        arrays, fresh, _times, _sync = cache.ensure(snap)
+        assert fresh is not pinned
+        # the pinned generation was not clobbered by the scatter
+        for f in STAGED_NODE_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(pinned, f)), before[f]
+            )
+        # the fresh generation carries the update, still sharded
+        idx = arrays.names.index(name)
+        assert int(np.asarray(fresh.usage)[idx, int(CPU)]) == 31337
+        assert node_shard_count(fresh.alloc.sharding) == 8
+    finally:
+        cache.unpin(pinned)
+
+
+def test_sharded_scatter_never_donates():
+    """The sharded delta scatter must take the NON-donating twin even
+    when unpinned: a persistent-cache replay of the donated
+    multi-device scatter mis-aliases same-shaped outputs on this jax
+    build (ISSUE 10 — staged used_req/prod_usage came back swapped on
+    the first warm-cache delta tick). Observable contract: the
+    previous sharded generation survives an ensure() (a donated one
+    would be deleted), while the single-device fast path still
+    donates."""
+    def one_delta_tick(model):
+        snap, tracker = build_world(40, True, seed=21)
+        snap.pending_pods = []
+        cache = model.staged_cache
+        cache.ensure(snap)
+        prev = cache.state
+        name = "n5"
+        old = snap.node_metrics[name]
+        snap.node_metrics[name] = NodeMetric(
+            node_name=name, node_usage={CPU: 11111, MEM: 2048},
+            update_time=21.0, pod_usages=old.pod_usages,
+        )
+        tracker.mark_node(name)
+        snap.now = 21.0
+        cache.ensure(snap)
+        return prev, cache.state
+
+    prev, fresh = one_delta_tick(sharded_model())
+    assert fresh is not prev
+    assert not prev.alloc.is_deleted(), (
+        "sharded delta scatter donated the previous generation — the "
+        "warm-cache alias bug is reachable again"
+    )
+    prev1, fresh1 = one_delta_tick(PlacementModel())
+    assert prev1.alloc.is_deleted(), (
+        "single-device delta scatter stopped donating — the PR 6 "
+        "steady-state fast path regressed"
+    )
+
+
+def test_sharded_churn_zero_recompiles_warmed(xla_compiles):
+    """The sharded churn tick's steady state performs ZERO XLA
+    recompiles: the per-shard node bucket, the pod bucket, and the
+    dirty-row bucket pin every shape once warmed (the xla_compiles
+    fixture extended to the sharded path — ISSUE 10 acceptance)."""
+    model = sharded_model()
+    snap, tracker = build_world(100, True, seed=13)
+    churn(model, snap, tracker, ticks=4, dirty=9, pending=16)
+    xla_compiles.clear()
+    churn(model, snap, tracker, ticks=2, dirty=9, pending=16, seed=77)
+    assert xla_compiles == [], (
+        "warmed sharded churn ticks recompiled: " + "\n".join(xla_compiles)
+    )
+
+
+# -- pod-batch (lane) axis ---------------------------------------------------
+
+def _params():
+    return ScoreParams(
+        weights=jnp.asarray(
+            np.array([1, 1] + [0] * (NUM_RESOURCES - 2), np.int32)
+        ),
+        thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+        prod_thresholds=jnp.zeros(NUM_RESOURCES, jnp.int32),
+    )
+
+
+def _lane_problem(n_nodes, n_pods, n_lanes, seed=17):
+    from koordinator_tpu.testing import example_problem
+
+    state, _, _ = example_problem(n_nodes, 1, seed=seed)
+    batches = [
+        example_problem(n_nodes, n_pods, seed=seed + 1 + l)[1]
+        for l in range(n_lanes)
+    ]
+    return state, batches, _params()
+
+
+@pytest.mark.parametrize("n_lanes,n_pods,pod_shards", [
+    (5, 37, 4),    # non-divisible lanes AND non-pow2 pod count
+    (3, 100, 8),   # fewer lanes than shards
+])
+def test_pod_axis_sharding_identity_non_pow2(n_lanes, n_pods, pod_shards):
+    """Every lane of the pod-batch-sharded solve is bit-identical to
+    solving that lane alone on a single device — at non-power-of-two
+    pod counts and lane counts that do not divide the shard count
+    (blocked-duplicate lane padding, trimmed outputs)."""
+    state, batches, params = _lane_problem(150, n_pods, n_lanes)
+    mesh = make_mesh2d(node_shards=1, pod_shards=pod_shards)
+    solve = shard_lane_solver(mesh, SolverConfig())
+    node_states, assign = solve(state, stack_pod_lanes(batches), params)
+    assign = np.asarray(assign)
+    assert assign.shape == (n_lanes, n_pods)
+    for l, batch in enumerate(batches):
+        want_state, want = schedule_batch(
+            state, batch, params, SolverConfig()
+        )
+        np.testing.assert_array_equal(assign[l], np.asarray(want))
+        np.testing.assert_array_equal(
+            np.asarray(node_states.used_req[l]),
+            np.asarray(want_state.used_req),
+        )
+
+
+def test_lane_solver_on_true_2d_mesh():
+    """nodes × pods both > 1: lanes split over ``pods`` while the base
+    splits over ``nodes`` — still bit-identical per lane."""
+    state, batches, params = _lane_problem(160, 24, 4, seed=23)
+    mesh = make_mesh2d(node_shards=2, pod_shards=4)
+    assert mesh_axis_size(mesh, POD_AXIS) == 4
+    solve = shard_lane_solver(mesh, SolverConfig())
+    _, assign = solve(state, stack_pod_lanes(batches), params)
+    assign = np.asarray(assign)
+    for l, batch in enumerate(batches):
+        _, want = schedule_batch(state, batch, params, SolverConfig())
+        np.testing.assert_array_equal(assign[l], np.asarray(want))
+
+
+def test_stack_pod_lanes_rejects_mixed_presence():
+    state, batches, params = _lane_problem(20, 4, 2)
+    withp = batches[0]._replace(
+        has_numa_policy=jnp.zeros(4, bool)
+    )
+    with pytest.raises(ValueError):
+        stack_pod_lanes([withp, batches[1]])
+
+
+# -- padding buckets + gauges ------------------------------------------------
+
+def test_shard_node_bucket_properties():
+    for n, k in [(1, 8), (50, 8), (120, 8), (5000, 8), (50000, 8),
+                 (16384, 4), (7, 2)]:
+        target = shard_node_bucket(n, k)
+        assert target >= n
+        assert target % k == 0
+        local = target // k
+        assert local >= 8
+        # quarter-step pow2 buckets bound the waste: the local width
+        # never exceeds one quarter-step above the true local need
+        # (floor 8) — a regression to full next-pow2 rounding would
+        # double the padded memory at 50k x 8 and fail here
+        need = -(-n // k)
+        if need > 8:
+            power = 1 << (need - 1).bit_length()
+            step = max(1, power // 8)
+            assert local <= need + step, (n, k, local, need, step)
+        else:
+            assert local == 8
+    assert shard_node_bucket(100, 1) == 100  # unsharded: no padding
+
+
+def test_pad_node_rows_inert_and_identity():
+    snap, _ = build_world(10, False)
+    arrays = lower_nodes(snap)
+    padded = pad_node_rows(arrays, 16)
+    assert padded.n == 16
+    assert list(padded.names[:10]) == list(arrays.names)
+    assert not padded.schedulable[10:].any()
+    assert not padded.metric_fresh[10:].any()
+    assert (padded.alloc[10:] == 0).all()
+    assert (padded.metric_update_time[10:] == -np.inf).all()
+    # no-op when already at target, and identical real rows
+    assert pad_node_rows(arrays, 10) is arrays
+    np.testing.assert_array_equal(padded.used_req[:10], arrays.used_req)
+    # padded world solves identically (padding rows never win)
+    from koordinator_tpu.state.cluster import lower_pending_pods
+
+    snap.pending_pods = [
+        PodSpec(name=f"p{j}", requests={CPU: 500, MEM: 512})
+        for j in range(6)
+    ]
+    pod_arrays = lower_pending_pods(snap.pending_pods)
+    pods = PodBatch.build(
+        req=jnp.asarray(pod_arrays.req),
+        est=jnp.asarray(pod_arrays.est),
+        is_prod=jnp.asarray(pod_arrays.is_prod),
+        is_daemonset=jnp.asarray(pod_arrays.is_daemonset),
+    )
+
+    def stage(a):
+        from koordinator_tpu.ops.binpack import NodeState
+
+        return NodeState(
+            alloc=jnp.asarray(a.alloc),
+            used_req=jnp.asarray(a.used_req),
+            usage=jnp.asarray(a.usage),
+            prod_usage=jnp.asarray(a.prod_usage),
+            est_extra=jnp.asarray(a.est_extra),
+            prod_base=jnp.asarray(a.prod_base),
+            metric_fresh=jnp.asarray(a.metric_fresh),
+            schedulable=jnp.asarray(a.schedulable),
+        )
+
+    _, want = schedule_batch(stage(arrays), pods, _params(), SolverConfig())
+    _, got = schedule_batch(stage(padded), pods, _params(), SolverConfig())
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert (np.asarray(got) < 10).all()
+
+
+def test_padding_waste_gauges_recorded():
+    """The sharded stage and the lane pad both feed the observatory's
+    padding gauges (``shard_nodes``, ``pod_lanes``)."""
+    model = sharded_model()
+    snap, tracker = build_world(100, True)
+    snap.pending_pods = []
+    model.staged_cache.ensure(snap)
+    padding = DEVICE_OBS.status()["padding"]
+    assert "shard_nodes" in padding
+    gauge = padding["shard_nodes"]
+    assert gauge["real"] == 100
+    assert gauge["padded"] == shard_node_bucket(100, 8)
+
+    state, batches, params = _lane_problem(30, 8, 3)
+    solve = shard_lane_solver(
+        make_mesh2d(node_shards=1, pod_shards=2), SolverConfig()
+    )
+    solve(state, stack_pod_lanes(batches), params)
+    padding = DEVICE_OBS.status()["padding"]
+    assert padding["pod_lanes"]["real"] == 3
+    assert padding["pod_lanes"]["padded"] == 4
+
+
+def test_explain_scores_trimmed_to_real_nodes_when_sharded():
+    """explain's breakdown columns must come back at the REAL node
+    count on a sharded model — untrimmed padded columns counted the
+    padding rows as rejections and could index names[] out of range
+    in the top-K detail (found driving /explain on the --node-shards
+    scheduler)."""
+    from koordinator_tpu.obs.explain import explain_scores
+
+    model = sharded_model()
+    snap, _ = build_world(10, False)
+    snap.pending_pods = [
+        PodSpec(name="big", requests={CPU: 10_000_000, MEM: 512})
+    ]
+    arrays, cols = explain_scores(model, snap, snap.pending_pods[0])
+    assert arrays.n == 10
+    for name, col in cols.items():
+        assert col.shape[0] == 10, (name, col.shape)
+    assert int((~cols["fit_feasible"]).sum()) <= 10
+
+
+def test_build_scheduler_node_shards_flag():
+    """--node-shards wires a node-sharded model (host fallback forced
+    off — a tiny solve must never sync the whole mesh) and refuses the
+    sidecar backend."""
+    from koordinator_tpu.cmd.scheduler import (
+        SchedulerConfig,
+        build_scheduler,
+    )
+
+    sched = build_scheduler(SchedulerConfig(node_shards=8))
+    assert sched.model._node_shards == 8
+    assert sched.model.host_fallback_cells == 0
+    with pytest.raises(ValueError):
+        build_scheduler(SchedulerConfig(
+            node_shards=8, placement_backend="sidecar",
+        ))
+
+
+def test_mesh2d_shapes_and_sharding_helpers():
+    mesh = make_mesh2d(node_shards=2, pod_shards=4)
+    assert dict(mesh.shape) == {"nodes": 2, "pods": 4}
+    assert node_shard_count(node_sharding(mesh)) == 2
+    # the helper counts LEADING-axis shards for any NamedSharding: a
+    # lane sharding's leading (lane) axis splits over ``pods``
+    assert node_shard_count(lane_sharding(mesh)) == 4
+    assert node_shard_count(None) == 1
+    with pytest.raises(ValueError):
+        make_mesh2d(node_shards=8, pod_shards=2)  # needs 16 devices

@@ -247,6 +247,8 @@ def compare_budget(budget: dict, new: dict) -> List[dict]:
     rows: List[dict] = []
     matrix = new.get("matrix", {})
     for leg, metrics in budget.items():
+        if leg.startswith("_"):
+            continue  # "_comment" and friends: annotations, not legs
         source = new if leg == "<top>" else matrix.get(leg)
         if not isinstance(source, dict):
             rows.append({"leg": leg, "metric": "<leg>", "old": "budget",

@@ -47,12 +47,28 @@ echo "== audit smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
     -q -m chaos -k audit -p no:cacheprovider
 
+echo "== sharded smoke =="
+# the sharded staging slice (ISSUE 10): a short sharded delta churn on
+# the 8-device virtual-CPU mesh must stay bit-identical to the
+# single-device full restage, and the lane axis must match per-lane
+# solo solves at non-pow2 shapes
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_shard_staging.py \
+    -q -k "smoke or non_pow2" -p no:cacheprovider
+
 echo "== bench diff smoke =="
 # the perf regression gate's own health check: a record diffed against
 # itself must pass clean (exit 0) — proves the loader handles the
 # committed record format (including salvage of truncated tails) and
 # that no comparator fires on identical inputs
 python tools/bench_diff.py BENCH_r05.json BENCH_r05.json
+
+echo "== sharded bench budgets =="
+# the measured sharded legs are budget-gated (ISSUE 10): a scaling or
+# merge-overhead regression in the committed record fails loudly.
+# (BENCH_vcpu_r06.json is the committed virtual-CPU-mesh record — legs
+# 14/15 always run on the forced 8-device virtual mesh, so these
+# budgets stay comparable whatever hardware records the r-series.)
+python tools/bench_diff.py --budget tools/bench_budgets.json BENCH_vcpu_r06.json
 
 echo "== device observatory smoke =="
 # the device-cost layer: compile telemetry + padding gauges must be
