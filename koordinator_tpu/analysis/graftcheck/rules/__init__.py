@@ -46,6 +46,9 @@ HOT_MODULES = (
     "koordinator_tpu/state/cluster.py",
     "koordinator_tpu/service/server.py",
     "koordinator_tpu/service/admission.py",
+    # the multi-tenant pool (DESIGN §20): its lane staging + dispatch
+    # run on the gate's executor thread — the serving hot path
+    "koordinator_tpu/service/tenancy.py",
     "koordinator_tpu/service/failover.py",
     "koordinator_tpu/parallel/mesh.py",
     # the auditor runs between scheduling rounds, not in the solve loop,
@@ -122,7 +125,17 @@ LOCK_SPECS = (
         path="koordinator_tpu/service/admission.py",
         class_name="AdmissionGate",
         lock="_lock",
-        attrs=("_lanes", "_closed", "_stats", "_undelivered"),
+        attrs=("_lanes", "_closed", "_stats", "_undelivered",
+               "_tenant_stats"),
+    ),
+    # the multi-tenant pool's weight registry (DESIGN §20): read on the
+    # gate's submit/claim paths (under the gate lock — a documented
+    # gate→registry order edge), written by operators/tests
+    LockSpec(
+        path="koordinator_tpu/service/tenancy.py",
+        class_name="TenantRegistry",
+        lock="_lock",
+        attrs=("_weights",),
     ),
     # the failover state machine: scheduler ticks, recovery probes, and
     # status() readers all cross it (docs/DESIGN.md §13)

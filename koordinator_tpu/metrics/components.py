@@ -273,10 +273,15 @@ CONTAINER_CPI_METRIC = KOORDLET_EXTERNAL_METRICS.gauge(
 # -- koord-solver sidecar (service/admission.py gate) -----------------------
 
 SOLVER_METRICS = Registry("koord-solver")
+# The wait/shed/depth series carry a ``tenant`` label (DESIGN §20):
+# the multi-tenant pool's whole point is K front-ends sharing one
+# sidecar, so "which tenant is overloaded / starving / flooding" must
+# be answerable from /metrics alone. Single-tenant deployments see one
+# constant label value ("default").
 SOLVER_ADMISSION_WAIT = SOLVER_METRICS.histogram(
     "solver_admission_wait_seconds",
-    "Queue wait from enqueue to dispatch, per QoS lane",
-    label_names=("lane",),
+    "Queue wait from enqueue to dispatch, per QoS lane and tenant",
+    label_names=("lane", "tenant"),
 )
 SOLVER_SOLVE_DURATION = SOLVER_METRICS.histogram(
     "solver_batch_solve_seconds",
@@ -285,17 +290,18 @@ SOLVER_SOLVE_DURATION = SOLVER_METRICS.histogram(
 SOLVER_ADMISSION_SHED = SOLVER_METRICS.counter(
     "solver_admission_shed_total",
     "Requests shed by the admission gate",
-    label_names=("lane", "reason"),  # overloaded | deadline | shutdown
+    # overloaded | deadline | shutdown
+    label_names=("lane", "reason", "tenant"),
 )
 SOLVER_QUEUE_DEPTH = SOLVER_METRICS.gauge(
     "solver_admission_queue_depth",
-    "Currently queued requests per QoS lane",
-    label_names=("lane",),
+    "Currently queued requests per QoS lane and tenant",
+    label_names=("lane", "tenant"),
 )
 SOLVER_ADMISSION_REQUESTS = SOLVER_METRICS.counter(
     "solver_admission_requests_total",
     "Requests dispatched to the device, by batch mode",
-    label_names=("mode",),  # coalesced | solo
+    label_names=("mode",),  # coalesced | lanes | solo
 )
 SOLVER_ADMISSION_BATCHES = SOLVER_METRICS.counter(
     "solver_admission_batches_total",

@@ -633,12 +633,13 @@ class PlacementModel:
         """Round the pod-batch length up to a shape bucket (quarter steps
         between powers of two, floor 64) so churn batches of nearby sizes
         reuse one compiled program instead of recompiling per queue
-        length. Padding pods are hard-blocked, so results are identical."""
-        if p <= 64:
-            return 64
-        power = 1 << (p - 1).bit_length()      # next power of two
-        step = power // 8                      # quarter steps of power/2
-        return ((p + step - 1) // step) * step
+        length. Padding pods are hard-blocked, so results are identical.
+        The step family is the shared :func:`parallel.mesh.
+        pow2_quarter_bucket` — the same buckets the sharded node widths
+        and the multi-tenant pool's base/lane staging use."""
+        from koordinator_tpu.parallel.mesh import pow2_quarter_bucket
+
+        return pow2_quarter_bucket(p, floor=64)
 
     @staticmethod
     def resv_bucket(v: int) -> int:

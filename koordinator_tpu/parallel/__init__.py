@@ -18,8 +18,11 @@ from koordinator_tpu.parallel.mesh import (  # noqa: F401
     node_shard_count,
     node_sharding,
     pad_node_arrays,
+    pow2_quarter_bucket,
     shard_lane_solver,
     shard_node_bucket,
     shard_solver,
+    shard_tenant_solver,
+    stack_node_states,
     stack_pod_lanes,
 )

@@ -151,6 +151,21 @@ def node_shard_count(sharding) -> int:
     return count
 
 
+def pow2_quarter_bucket(n: int, floor: int = 8) -> int:
+    """THE shape-bucket family of the repo: round ``n`` up to a quarter
+    step between powers of two (floor ``floor``). Shared by the staged
+    pod batches (``PlacementModel.pod_bucket``), the per-shard node
+    widths below, and the multi-tenant pool's node/pod/lane buckets
+    (service/tenancy.py) — one family, so "nearby sizes reuse one
+    compiled program at <= ~12.5% padding waste" means the same thing
+    on every axis."""
+    if n <= floor:
+        return floor
+    power = 1 << (n - 1).bit_length()
+    step = max(1, power // 8)
+    return ((n + step - 1) // step) * step
+
+
 def shard_node_bucket(n: int, shards: int) -> int:
     """The padded GLOBAL node count for ``n`` real nodes over
     ``shards`` shards: each shard's local width is the quarter-step
@@ -162,14 +177,7 @@ def shard_node_bucket(n: int, shards: int) -> int:
     ``device_put`` never needs uneven layouts."""
     if shards <= 1:
         return n
-    local = -(-n // shards)  # ceil
-    if local <= 8:
-        local = 8
-    else:
-        power = 1 << (local - 1).bit_length()
-        step = max(1, power // 8)
-        local = ((local + step - 1) // step) * step
-    return local * shards
+    return pow2_quarter_bucket(-(-n // shards)) * shards
 
 
 def pad_node_arrays(arrays: NodeArrays, multiple: int) -> NodeArrays:
@@ -521,6 +529,109 @@ def shard_lane_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
                 ))
             assign = assign[:l_real]
         return node_states, assign
+
+    return solve
+
+
+def stack_node_states(states: Sequence[NodeState]) -> NodeState:
+    """Stack K same-shape node worlds into one ``[K, N, ...]`` base
+    stack for :func:`shard_tenant_solver` — the multi-tenant twin of
+    :func:`stack_pod_lanes`. Worlds must agree on shape (the tenancy
+    layer pads every world to one node bucket first) and on optional
+    column presence; like the lane stack this is a shape operation,
+    never a semantic merge: lane k still solves against exactly its own
+    world."""
+    import jax.numpy as jnp
+
+    if not states:
+        raise ValueError("stack_node_states needs at least one world")
+    cols = []
+    for field in range(len(NodeState._fields)):
+        vals = [s[field] for s in states]
+        if all(v is None for v in vals):
+            cols.append(None)
+        elif any(v is None for v in vals):
+            raise ValueError(
+                f"worlds disagree on NodeState.{NodeState._fields[field]} "
+                "presence — stack only uniform worlds"
+            )
+        else:
+            cols.append(jnp.stack(vals))
+    return NodeState(*cols)
+
+
+def shard_tenant_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
+                        want_state: bool = False):
+    """The multi-tenant generalization of :func:`shard_lane_solver`:
+    K INDEPENDENT lanes where every lane carries its OWN node base and
+    its OWN params — K tenants' per-tick solves batched as one vmapped
+    program with the lane axis sharded over ``pods``.
+
+    Returns ``solve(states, lanes, params) -> (used_req, assign)``
+    where ``states`` is a ``[L, N, ...]`` :class:`NodeState` stack
+    (build with :func:`stack_node_states`), ``lanes`` a ``[L, P, ...]``
+    :class:`PodBatch` stack and ``params`` a ``[L, ...]``
+    :class:`ScoreParams` stack; ``assign`` is ``[L, P]`` and
+    ``used_req`` the per-lane mutated ``[L, N, R]`` accounting (None
+    under the default ``want_state=False`` — the multi-tenant gate path
+    reads placements only, and PR 15 measured the state carry's
+    allocator churn at 3-10x timing noise for small L).
+
+    Tenants never communicate — same collective-free scaling as the
+    single-base lane axis — and each lane is bit-identical to that
+    tenant solving alone (the int-arithmetic vmap property), which is
+    what makes the multi-tenant pool's isolation contract testable.
+    Lane-count padding mirrors :func:`shard_lane_solver`: duplicate
+    hard-blocked lanes up to a shard multiple, outputs trimmed."""
+    import jax.numpy as jnp
+
+    lane = lane_sharding(mesh)
+    k = mesh_axis_size(mesh, POD_AXIS)
+
+    if want_state:
+        body = lambda s, p, pr: (
+            lambda r: (r.node_state.used_req, r.assign)
+        )(solve_batch(s, p, pr, config))
+    else:
+        body = lambda s, p, pr: (
+            None, solve_batch(s, p, pr, config).assign
+        )
+    jit_lanes = DEVICE_OBS.jit("shard_tenant_solver", jax.jit(
+        jax.vmap(body, in_axes=(0, 0, 0)),
+        static_argnums=(), donate_argnums=(),
+    ))
+
+    def dup_pad(tree, pad):
+        def dup(a):
+            if a is None:
+                return None
+            return jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)])
+
+        return type(tree)(*(dup(x) for x in tree))
+
+    def solve(states: NodeState, lanes: PodBatch, params: ScoreParams):
+        l_real = int(lanes.req.shape[0])
+        target = -(-l_real // k) * k
+        DEVICE_OBS.note_padding("tenant_lanes", l_real, target)
+        if target != l_real:
+            pad = target - l_real
+            states = dup_pad(states, pad)
+            params = dup_pad(params, pad)
+            lanes = dup_pad(lanes, pad)
+            # padding lanes are copies of the last real lane with every
+            # pod hard-blocked: they place nothing and are trimmed off
+            lanes = lanes._replace(
+                blocked=lanes.blocked.at[-pad:].set(True)
+            )
+        put = lambda tree: jax.device_put(
+            tree, jax.tree.map(lambda _: lane, tree)
+        )
+        used_req, assign = jit_lanes(put(states), put(lanes), put(params))
+        if target != l_real:
+            assign = assign[:l_real]
+            if used_req is not None:
+                used_req = used_req[:l_real]
+        return used_req, assign
 
     return solve
 

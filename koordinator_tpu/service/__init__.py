@@ -26,6 +26,12 @@ from koordinator_tpu.service.admission import (  # noqa: F401
     AdmissionGate,
     solve_coalesced,
 )
+from koordinator_tpu.service.tenancy import (  # noqa: F401
+    DEFAULT_TENANT,
+    TenantRegistry,
+    solve_tenant_lanes,
+    tenant_wire_value,
+)
 from koordinator_tpu.service.server import PlacementService  # noqa: F401
 from koordinator_tpu.service.client import (  # noqa: F401
     PlacementClient,

@@ -26,16 +26,32 @@ onto Koordinator's own QoS-class hierarchy:
   jittered exponential backoff under a total-deadline cap).
 - **Coalescing.** Concurrent requests that share a node-state base —
   same full-state fingerprint over the staged node columns, params,
-  config, and pod schema — are merged into ONE device dispatch: each
-  caller's pod rows become one lane of a ``jax.vmap``-stacked batch
-  over the shared staged base, so every scan step's [N,R] work
-  vectorizes ACROSS callers instead of serializing them. The solver
-  is integer arithmetic end to end, so the split-back responses are
-  bit-identical to K solves run one at a time against the same staged
-  state — K waiting clients cost one device dispatch instead of K.
-  Only plain requests (no quota/gang/resv/numa/extras/delta groups)
-  coalesce; everything else rides the solo path through
-  ``solve_from_request`` unchanged.
+  config, and pod schema, salted with the TENANT identity so two
+  tenants' byte-identical worlds never merge — are merged into ONE
+  device dispatch: each caller's pod rows become one lane of a
+  ``jax.vmap``-stacked batch over the shared staged base, so every
+  scan step's [N,R] work vectorizes ACROSS callers instead of
+  serializing them. The solver is integer arithmetic end to end, so
+  the split-back responses are bit-identical to K solves run one at a
+  time against the same staged state — K waiting clients cost one
+  device dispatch instead of K. The dispatch is assignments-only
+  (``want_state=False``): the [K,N,R] per-lane state carry was
+  measured dead weight on the gate path (PR 15: its allocator churn is
+  3–10x timing noise at small K), so coalesced responses carry
+  placements, not ``node_used_req``. Only plain requests (no
+  quota/gang/resv/numa/extras/delta groups) coalesce; everything else
+  rides the solo path through ``solve_from_request`` unchanged.
+- **Cross-tenant lane batching** (the multi-tenant pool, DESIGN §20).
+  Plain requests from DIFFERENT tenants that share a *shape bucket*
+  (service/tenancy.shape_bucket_key: node/pod buckets + schema +
+  static config — no data) batch as lanes of ONE multi-base dispatch:
+  every lane carries its own staged world and params
+  (tenancy.solve_tenant_lanes). A weighted-fair allocator splits the
+  dispatch window's lane budget when tenants contend
+  (tenancy.allocate_fair_lanes), shedding respects per-tenant fair
+  shares (one tenant's burst can only evict tenants OVER their share,
+  or itself), and all shed/deadline/depth accounting is kept — and
+  exported — per tenant.
 
 The gate deliberately serializes solves on one thread: the device is a
 serial resource, and a single drainer turns N racing handler threads
@@ -77,6 +93,17 @@ from koordinator_tpu.ops.binpack import (
     solve_batch,
 )
 from koordinator_tpu.service.codec import SolveRequest, SolveResponse
+from koordinator_tpu.service.tenancy import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+    allocate_fair_lanes,
+    delta_shape_key,
+    fair_share,
+    plain_request,
+    request_tenant,
+    shape_bucket_key,
+    solve_entry_lanes,
+)
 
 # -- lanes ------------------------------------------------------------------
 
@@ -137,11 +164,11 @@ def request_deadline_s(req: SolveRequest) -> Optional[float]:
 
 # -- coalescing -------------------------------------------------------------
 
-#: params every solve must carry (ScoreParams schema)
+#: params every solve must carry (ScoreParams schema); the full
+#: request plainness predicate lives in service/tenancy.plain_request
+#: (shared with the shape-bucket key so the two batching tiers can
+#: never disagree on what may batch)
 _PARAM_FIELDS = ScoreParams._fields
-#: pod columns PodBatch.build accepts; the first four are required
-_POD_FIELDS = PodBatch._fields
-_POD_REQUIRED = ("req", "est", "is_prod", "is_daemonset")
 
 
 def coalesce_key(req: SolveRequest) -> Optional[bytes]:
@@ -149,28 +176,18 @@ def coalesce_key(req: SolveRequest) -> Optional[bytes]:
     request must ride the solo path.
 
     Two requests with equal keys see byte-identical staged bases
-    (node columns + params + config + pod schema/dtypes), which is the
-    same-base condition the segment-reset coalesced solve requires.
+    (node columns + params + config + pod schema/dtypes) AND belong to
+    the same tenant — the tenant identity salts the hash, so two
+    tenants shipping byte-identical worlds still never merge into one
+    base (the multi-tenant isolation contract, DESIGN §20; they may
+    still share a dispatch as separate lanes with separate bases).
     Delta-protocol requests never coalesce: they patch per-connection
     cached state, which is connection-ordered by construction."""
-    if (
-        req.quota is not None
-        or req.gang is not None
-        or req.extras is not None
-        or req.resv is not None
-        or req.numa is not None
-        or req.node_delta is not None
-    ):
-        return None
-    if set(req.node) != set(STAGED_NODE_FIELDS):
-        return None  # NUMA inventories (or a short node group) ride solo
-    if not set(_POD_REQUIRED) <= set(req.pods):
-        return None
-    if not set(req.pods) <= set(_POD_FIELDS):
-        return None
-    if not set(_PARAM_FIELDS) <= set(req.params):
+    if not plain_request(req):
         return None
     h = hashlib.blake2b(digest_size=16)
+    h.update(b"tenant:")
+    h.update(request_tenant(req).encode("utf-8"))
 
     def feed(tag: str, a: np.ndarray, data: bool = True) -> None:
         h.update(tag.encode())
@@ -208,18 +225,35 @@ def _vmapped_plain_solve(state, pods, params, config):
     )(pods)
 
 
-#: the coalesced dispatch: one jitted program per (K, pod-bucket, N)
+def _vmapped_plain_assign(state, pods, params, config):
+    """The assignments-only twin — the GATE's dispatch: plain solves
+    commit exactly their placed pods (``commit == assign >= 0``,
+    waiting/rejected all-False), so placements are the whole result and
+    the [K,N,R] per-lane state carry stays unmaterialized. PR 15
+    measured that carry's allocator churn at 3–10x timing noise for
+    small K — dead weight on the serving path."""
+    return jax.vmap(
+        lambda p: solve_batch(state, p, params, config).assign
+    )(pods)
+
+
+#: the coalesced dispatches: one jitted program per (K, pod-bucket, N)
 #: shape, shared by every gate in the process (static config hashes per
 #: value; nothing donated — the base is reused lane-to-lane and by
-#: later batches)
+#: later batches). The full-state variant serves ``want_state=True``
+#: callers (isolation property tests); the gate runs assignments-only.
 _jit_coalesced = DEVICE_OBS.jit("coalesced_solve", jax.jit(
     _vmapped_plain_solve, static_argnames=("config",), donate_argnums=()
+))
+_jit_coalesced_assign = DEVICE_OBS.jit("coalesced_solve_assign", jax.jit(
+    _vmapped_plain_assign, static_argnames=("config",), donate_argnums=()
 ))
 
 
 def solve_coalesced(
     requests: Sequence[SolveRequest],
     config: Optional[SolverConfig] = SolverConfig(),
+    want_state: bool = False,
 ) -> List[SolveResponse]:
     """Solve K same-base plain requests in ONE device dispatch and split
     the results back per caller.
@@ -230,9 +264,11 @@ def solve_coalesced(
     ``blocked`` — they place nothing and mutate no state). The solver
     is integer arithmetic end to end, so the vmapped lanes are
     bit-identical to K isolated solves: each returned
-    ``SolveResponse`` — assignments AND the per-lane final
-    ``node_used_req`` — matches what ``solve_from_request`` would have
-    produced for that request alone."""
+    ``SolveResponse`` matches what ``solve_from_request`` would have
+    produced for that request alone. The default dispatch is
+    assignments-only; ``want_state=True`` additionally materializes the
+    per-lane final ``node_used_req`` (the [K,N,R] carry the gate path
+    deliberately skips)."""
     head = requests[0]
     if config is None:
         config = SolverConfig()
@@ -275,17 +311,24 @@ def solve_coalesced(
         blocked=jnp.asarray(blocked),
         **{f: jnp.asarray(v) for f, v in cols.items()},
     )
-    result = _jit_coalesced(state, pods, params, config=config)
-    assign_all = np.asarray(result.assign)
-    used_all = np.asarray(result.node_state.used_req)
-    commit_all = np.asarray(result.commit)
+    if want_state:
+        result = _jit_coalesced(state, pods, params, config=config)
+        assign_all = np.asarray(result.assign)
+        used_all = np.asarray(result.node_state.used_req)
+    else:
+        assign_all = np.asarray(
+            _jit_coalesced_assign(state, pods, params, config=config)
+        )
+        used_all = None
     out: List[SolveResponse] = []
     for k, n in enumerate(counts):
         assign = np.asarray(assign_all[k, :n], np.int32)
         out.append(SolveResponse(
             assignments=assign,
-            node_used_req=used_all[k],
-            commit=np.asarray(commit_all[k, :n], bool),
+            node_used_req=None if used_all is None else used_all[k],
+            # plain solves commit exactly their placed pods (the gang
+            # epilogue that could hold/reject never runs on this path)
+            commit=assign >= 0,
             waiting=np.zeros(n, bool),
             rejected=np.zeros(n, bool),
             raw_assign=assign,
@@ -293,11 +336,17 @@ def solve_coalesced(
     return out
 
 
-def _publish_depth(depths: Sequence[int]) -> None:
-    """Per-lane depth gauges, from a snapshot taken under the gate
-    lock (the gauges themselves tolerate benign publish races)."""
-    for i, n in enumerate(depths):
-        SOLVER_QUEUE_DEPTH.set(n, {"lane": LANE_NAMES[i]})
+def _publish_depth(depths: Dict[str, List[int]]) -> None:
+    """Per-(lane, tenant) depth gauges, from a snapshot taken under the
+    gate lock (the gauges themselves tolerate benign publish races).
+    ``depths`` maps every tenant the gate has ever seen to its per-lane
+    counts — tenants with nothing queued publish zeros, so a drained
+    tenant's series falls back to 0 instead of freezing."""
+    for tenant, lanes in depths.items():
+        for i, n in enumerate(lanes):
+            SOLVER_QUEUE_DEPTH.set(
+                n, {"lane": LANE_NAMES[i], "tenant": tenant}
+            )
 
 
 # -- the gate ---------------------------------------------------------------
@@ -317,12 +366,21 @@ class AdmissionConfig:
     delta-protocol steady state and feature-group solves never wait.
     10ms is the measured knee of the 8-client bench leg (smaller
     windows miss stragglers still decoding their frames, larger ones
-    pay more than the fused dispatch saves)."""
+    pay more than the fused dispatch saves).
+
+    ``tenant_lanes`` enables cross-tenant lane batching (DESIGN §20):
+    plain requests from different tenants sharing a shape bucket join
+    one multi-base dispatch, the lane budget (``max_coalesce``)
+    arbitrated weighted-fair across tenants. Off, tenants still get
+    per-tenant accounting and fair-share shedding, but each tenant's
+    requests dispatch separately (the solo-sidecar-per-tenant
+    behavior, kept as the bench baseline)."""
 
     capacity: int = 128
     max_coalesce: int = 16
     max_coalesced_pods: int = 4096
     coalesce_window_s: float = 0.010
+    tenant_lanes: bool = True
 
 
 class AdmissionEntry:
@@ -332,11 +390,12 @@ class AdmissionEntry:
     __slots__ = (
         "request", "config", "node_cache", "lane", "deadline",
         "enqueued_at", "key", "pods_n", "response", "_done", "_gate",
-        "trace_t0",
+        "trace_t0", "tenant", "shape_key",
     )
 
     def __init__(self, request, config, node_cache, lane, deadline,
-                 key, pods_n, enqueued_at, gate):
+                 key, pods_n, enqueued_at, gate, tenant=DEFAULT_TENANT,
+                 shape_key=None):
         self.request = request
         self.config = config
         self.node_cache = node_cache
@@ -345,6 +404,10 @@ class AdmissionEntry:
         self.enqueued_at = enqueued_at
         self.key = key
         self.pods_n = pods_n
+        self.tenant = tenant
+        #: the cross-tenant batching bucket (tenancy.shape_bucket_key):
+        #: equal shape keys may share one multi-base lane dispatch
+        self.shape_key = shape_key
         self.response: Optional[SolveResponse] = None
         self._done = threading.Event()
         self._gate = gate
@@ -379,7 +442,8 @@ class AdmissionGate:
 
     def __init__(self, solve_fn: Callable, config: AdmissionConfig = AdmissionConfig(),
                  clock: Callable[[], float] = time.monotonic,
-                 peer_count: Optional[Callable[[], int]] = None):
+                 peer_count: Optional[Callable[[], int]] = None,
+                 tenants: Optional[TenantRegistry] = None):
         self.cfg = config
         self._solve_fn = solve_fn
         self._clock = clock
@@ -387,21 +451,79 @@ class AdmissionGate:
         #: connected nobody else CAN coalesce, so the micro-batching
         #: window is skipped and a lone client never pays it
         self._peer_count = peer_count
+        #: tenant weights for fair-share shedding and the weighted-fair
+        #: lane allocator; read-mostly, its own (inner) lock
+        self.tenants = tenants if tenants is not None else TenantRegistry()
         #: one Condition guards every mutable structure below
         #: (graftcheck lock-discipline maps _lanes/_closed/_stats/
-        #: _undelivered to it)
+        #: _undelivered/_tenant_stats to it)
         self._lock = threading.Condition()
         self._lanes = [deque(), deque(), deque()]
         self._closed = False
         self._undelivered = 0
         self._stats = {
             "requests": 0, "batches": 0, "coalesced_requests": 0,
+            "lane_batches": 0, "lane_requests": 0,
             "shed_overloaded": 0, "shed_deadline": 0, "shed_shutdown": 0,
         }
+        #: tenant -> its own copy of the overload/throughput counters —
+        #: one tenant's flood must be attributable from status alone
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="admission-gate"
         )
         self._thread.start()
+
+    def _fold_tenant(self, tenant: str) -> str:
+        """Bound the distinct tenants the gate tracks (call under
+        ``self._lock``): past :data:`tenancy.MAX_TRACKED_TENANTS`
+        distinct ids, UNREGISTERED newcomers fold into the overflow
+        bucket — a client cycling unique tenant strings cannot grow
+        stats rows, depth-gauge cardinality, or per-submit publishing
+        without bound. Operator-registered (weighted) tenants always
+        keep their own row."""
+        from koordinator_tpu.service.tenancy import (
+            MAX_TRACKED_TENANTS,
+            OVERFLOW_TENANT,
+        )
+
+        if tenant in self._tenant_stats:
+            return tenant
+        if len(self._tenant_stats) < MAX_TRACKED_TENANTS:
+            return tenant
+        if tenant in self.tenants.snapshot():
+            return tenant
+        return OVERFLOW_TENANT
+
+    def _tstat(self, tenant: str) -> Dict[str, int]:
+        """Per-tenant counter row (call under ``self._lock``)."""
+        row = self._tenant_stats.get(tenant)
+        if row is None:
+            row = self._tenant_stats[tenant] = {
+                "requests": 0, "dispatched": 0, "coalesced": 0,
+                "lane_batched": 0, "shed_overloaded": 0,
+                "shed_deadline": 0, "shed_shutdown": 0,
+            }
+        return row
+
+    def _depth_snapshot(self, only=None) -> Dict[str, List[int]]:
+        """Per-(tenant, lane) queue depths (call under ``self._lock``).
+        ``only`` restricts the snapshot to the named tenants — the
+        submit hot path publishes just the tenants a request touched
+        (arrival + victim), while the executor's per-batch snapshot
+        covers every tenant ever seen so a drained tenant's gauges
+        still fall back to 0."""
+        depths = {
+            t: [0] * len(LANE_NAMES)
+            for t in (self._tenant_stats if only is None else only)
+        }
+        for i, q in enumerate(self._lanes):
+            for e in q:
+                if only is not None and e.tenant not in depths:
+                    continue
+                depths.setdefault(e.tenant, [0] * len(LANE_NAMES))
+                depths[e.tenant][i] += 1
+        return depths
 
     # -- enqueue (handler threads) -----------------------------------------
 
@@ -412,7 +534,17 @@ class AdmissionGate:
         responses included, so clients see frames, never silence."""
         now = self._clock()
         d = request_deadline_s(request)
+        tenant = request_tenant(request)
         key = coalesce_key(request) if self.cfg.max_coalesce > 1 else None
+        shape_key = None
+        if self.cfg.max_coalesce > 1 and self.cfg.tenant_lanes:
+            # plain requests batch on their wire world's shape; pure
+            # delta requests batch on their STAGED base's shape (the
+            # per-tenant-connection cache) — the steady-state serving
+            # path. Anything else (mismatched base included) rides solo.
+            shape_key = shape_bucket_key(request)
+            if shape_key is None:
+                shape_key = delta_shape_key(request, node_cache)
         try:
             pods_n = int(np.asarray(request.pods["req"]).shape[0])
         except (KeyError, IndexError, AttributeError):
@@ -420,22 +552,27 @@ class AdmissionGate:
         entry = AdmissionEntry(
             request, solver_config, node_cache, request_lane(request),
             None if d is None else now + d, key, pods_n, now, self,
+            tenant=tenant, shape_key=shape_key,
         )
         victim: Optional[AdmissionEntry] = None
         rejected: Optional[str] = None
         with self._lock:
             self._undelivered += 1
+            # identity may fold into the overflow bucket past the
+            # tracked-tenant cap: accounting AND fairness then treat
+            # the folded tenants as one principal (a deliberate bound —
+            # per-tenant fair-share precision is promised for
+            # registered tenants and the first MAX_TRACKED_TENANTS
+            # ad-hoc ones, not for unbounded id churn). The COALESCE
+            # key above keeps the true wire id: staged BASES never
+            # merge across folded tenants.
+            tenant = entry.tenant = self._fold_tenant(tenant)
+            self._tstat(tenant)["requests"] += 1
             if self._closed:
                 rejected = ERR_SHUTDOWN
             else:
                 if sum(len(q) for q in self._lanes) >= self.cfg.capacity:
-                    # shed best-effort first: evict the NEWEST entry of
-                    # the lowest-priority non-empty lane strictly below
-                    # the arrival; else the arrival itself is refused
-                    for shed_lane in (LANE_BE, LANE_LS):
-                        if shed_lane > entry.lane and self._lanes[shed_lane]:
-                            victim = self._lanes[shed_lane].pop()
-                            break
+                    victim = self._pick_victim(entry)
                     if victim is None:
                         rejected = ERR_OVERLOADED
                 if rejected is None:
@@ -445,15 +582,24 @@ class AdmissionGate:
                     # wake one of those instead of the executor and
                     # strand the enqueued entry until the next event
                     self._lock.notify_all()
-            if victim is not None or rejected == ERR_OVERLOADED:
+            if victim is not None:
                 self._stats["shed_overloaded"] += 1
+                self._tstat(victim.tenant)["shed_overloaded"] += 1
+            elif rejected == ERR_OVERLOADED:
+                self._stats["shed_overloaded"] += 1
+                self._tstat(tenant)["shed_overloaded"] += 1
             elif rejected == ERR_SHUTDOWN:
                 self._stats["shed_shutdown"] += 1
-            depths = [len(q) for q in self._lanes]
+                self._tstat(tenant)["shed_shutdown"] += 1
+            touched = {tenant}
+            if victim is not None:
+                touched.add(victim.tenant)
+            depths = self._depth_snapshot(only=touched)
         _publish_depth(depths)
         if victim is not None:
             SOLVER_ADMISSION_SHED.inc(
-                {"lane": LANE_NAMES[victim.lane], "reason": "overloaded"}
+                {"lane": LANE_NAMES[victim.lane], "reason": "overloaded",
+                 "tenant": victim.tenant}
             )
             victim.finish(error_response(
                 ERR_OVERLOADED,
@@ -464,16 +610,49 @@ class AdmissionGate:
             reason = ("shutdown" if rejected == ERR_SHUTDOWN
                       else "overloaded")
             SOLVER_ADMISSION_SHED.inc(
-                {"lane": LANE_NAMES[entry.lane], "reason": reason}
+                {"lane": LANE_NAMES[entry.lane], "reason": reason,
+                 "tenant": tenant}
             )
             detail = (
                 "sidecar stopping; request not solved"
                 if rejected == ERR_SHUTDOWN
                 else f"queue full ({self.cfg.capacity}) and no "
-                     f"lower-priority lane to shed"
+                     f"sheddable lower-priority entry (fair shares "
+                     f"respected)"
             )
             entry.finish(error_response(rejected, detail))
         return entry
+
+    def _pick_victim(self, entry: AdmissionEntry
+                     ) -> Optional[AdmissionEntry]:
+        """The overload eviction choice (call under ``self._lock``):
+        newest entry of the lowest-priority non-empty lane strictly
+        below the arrival — RESTRICTED to victims whose tenant is over
+        its weighted fair share, or shares the arrival's tenant. A
+        tenant at/under its share can never lose queued work to another
+        tenant's burst (the multi-tenant isolation contract); with one
+        tenant this reduces exactly to the pre-tenancy policy. Removes
+        the chosen victim from its lane."""
+        queued: Dict[str, int] = {}
+        for q in self._lanes:
+            for e in q:
+                queued[e.tenant] = queued.get(e.tenant, 0) + 1
+        weights = self.tenants.weights_for(
+            set(queued) | {entry.tenant}
+        )
+        shares = fair_share(self.cfg.capacity, weights)
+        for shed_lane in (LANE_BE, LANE_LS):
+            if shed_lane <= entry.lane:
+                continue
+            for victim in reversed(self._lanes[shed_lane]):
+                if (
+                    victim.tenant == entry.tenant
+                    or queued.get(victim.tenant, 0)
+                    > shares.get(victim.tenant, 0)
+                ):
+                    self._lanes[shed_lane].remove(victim)
+                    return victim
+        return None
 
     # -- drain (the executor thread) ---------------------------------------
 
@@ -503,7 +682,8 @@ class AdmissionGate:
                 if q:
                     batch.append(q.popleft())
                     break
-            if batch and batch[0].key is not None:
+            if batch and (batch[0].key is not None
+                          or batch[0].shape_key is not None):
                 head = batch[0]
                 room = self.cfg.max_coalesced_pods - head.pods_n
                 window = self.cfg.coalesce_window_s
@@ -512,33 +692,31 @@ class AdmissionGate:
                 window_end = now + window
                 hard_end = now + 3 * window  # a trickle can't stall forever
                 while True:
-                    # claim every queued same-base entry, then linger
-                    # inside the micro-batching window for stragglers
-                    # while the batch can still grow
-                    grew = False
-                    for q in self._lanes:
-                        if len(batch) >= self.cfg.max_coalesce:
-                            break
-                        kept = deque()
-                        while q:
-                            e = q.popleft()
-                            if (
-                                len(batch) < self.cfg.max_coalesce
-                                and e.key == head.key
-                                and e.pods_n <= room
-                            ):
-                                batch.append(e)
-                                room -= e.pods_n
-                                grew = True
-                            else:
-                                kept.append(e)
-                        q.extend(kept)
+                    # claim every queued batchable entry — same-base
+                    # (coalesce key) or same shape bucket from another
+                    # tenant — then linger inside the micro-batching
+                    # window for stragglers while the batch can grow.
+                    # When tenants contend for the lane budget, the
+                    # weighted-fair allocator splits it (DESIGN §20).
+                    before = len(batch)
+                    room = self._claim_batch(head, batch, room)
                     if (
                         len(batch) >= self.cfg.max_coalesce
                         or self._closed
                     ):
                         break
-                    if grew:
+                    if (
+                        self._peer_count is not None
+                        and len(batch) >= self._peer_count()
+                    ):
+                        # every live connection already has an entry in
+                        # this batch, and a connection carries at most
+                        # one in-flight request — NOBODY can join, so
+                        # the window has nothing left to buy (the
+                        # N-peer generalization of the lone-client
+                        # skip)
+                        break
+                    if len(batch) > before:
                         # arrivals are trickling in: slide the window so
                         # one late decoder doesn't force a second
                         # dispatch, but never past the hard cap
@@ -551,9 +729,50 @@ class AdmissionGate:
                     self._lock.wait(remaining)
             if expired:
                 self._stats["shed_deadline"] += len(expired)
-            depths = [len(q) for q in self._lanes]
+                for e in expired:
+                    self._tstat(e.tenant)["shed_deadline"] += 1
+            depths = self._depth_snapshot()
         _publish_depth(depths)
         return expired, batch
+
+    def _claim_batch(self, head: AdmissionEntry,
+                     batch: List[AdmissionEntry], room: int) -> int:
+        """One claim pass (call under ``self._lock``): move every
+        queued entry that can join ``head``'s dispatch into ``batch``,
+        weighted-fair across tenants, and return the remaining pod
+        room. Joinable: same coalesce key (same tenant, byte-identical
+        base — the vmap-over-one-base shape) or, with ``tenant_lanes``,
+        same shape bucket (any tenant, own base — the multi-base lane
+        shape). Per-tenant claim order stays lane-priority-then-FIFO;
+        the allocator only arbitrates ACROSS tenants."""
+        budget = self.cfg.max_coalesce - len(batch)
+        if budget <= 0:
+            return room
+        candidates: Dict[str, List[AdmissionEntry]] = {}
+        for q in self._lanes:
+            for e in q:
+                same_base = e.key is not None and e.key == head.key
+                same_bucket = (
+                    self.cfg.tenant_lanes
+                    and e.shape_key is not None
+                    and e.shape_key == head.shape_key
+                )
+                if same_base or same_bucket:
+                    candidates.setdefault(e.tenant, []).append(e)
+        if not candidates:
+            return room
+        preloaded: Dict[str, int] = {}
+        for e in batch:
+            preloaded[e.tenant] = preloaded.get(e.tenant, 0) + 1
+        take = allocate_fair_lanes(
+            candidates, self.tenants.weight, budget, room,
+            lambda e: e.pods_n, preloaded,
+        )
+        for e in take:
+            self._lanes[e.lane].remove(e)
+            batch.append(e)
+            room -= e.pods_n
+        return room
 
     def _run(self) -> None:
         while True:
@@ -564,7 +783,8 @@ class AdmissionGate:
                 expired, batch = polled
                 for e in expired:
                     SOLVER_ADMISSION_SHED.inc(
-                        {"lane": LANE_NAMES[e.lane], "reason": "deadline"}
+                        {"lane": LANE_NAMES[e.lane], "reason": "deadline",
+                         "tenant": e.tenant}
                     )
                     e.finish(error_response(
                         ERR_DEADLINE,
@@ -592,7 +812,8 @@ class AdmissionGate:
         t_dispatch = TRACER.now()
         for e in batch:
             SOLVER_ADMISSION_WAIT.observe(
-                max(0.0, t0 - e.enqueued_at), {"lane": LANE_NAMES[e.lane]}
+                max(0.0, t0 - e.enqueued_at),
+                {"lane": LANE_NAMES[e.lane], "tenant": e.tenant},
             )
             # retro queue-wait span per request, joined to the caller's
             # trace via the wire context (codec v3 ``trace`` group)
@@ -602,16 +823,28 @@ class AdmissionGate:
                 args={"lane": LANE_NAMES[e.lane],
                       **(_trace_args(e.request) or {})},
             )
+        # three dispatch shapes: solo (one request, full feature set),
+        # coalesced (one tenant, one shared base, vmap lanes), tenant
+        # lanes (many tenants, one base PER lane — the pool, DESIGN §20)
+        if len(batch) == 1:
+            mode = "solo"
+        elif all(e.key is not None and e.key == batch[0].key
+                 for e in batch):
+            mode = "coalesced"
+        else:
+            mode = "lanes"
         try:
-            if len(batch) == 1:
+            if mode == "solo":
                 e = batch[0]
                 responses = [self._solve_fn(e.request, e.config, e.node_cache)]
-            else:
+            elif mode == "coalesced":
                 responses = solve_coalesced(
                     [e.request for e in batch], batch[0].config
                 )
+            else:
+                responses = solve_entry_lanes(batch, batch[0].config)
         except Exception as exc:  # solo path catches its own; this
-            # guards the coalesced staging/split — callers still get a
+            # guards the batched staging/split — callers still get a
             # typed frame, never silence
             responses = [
                 error_response(
@@ -621,19 +854,26 @@ class AdmissionGate:
         SOLVER_SOLVE_DURATION.observe(max(0.0, self._clock() - t0))
         TRACER.emit(
             "admission_dispatch", cat="admission", t0=t_dispatch,
-            args={"coalesced": len(batch),
+            args={"coalesced": len(batch), "mode": mode,
                   **(_trace_args(batch[0].request) or {})},
         )
         SOLVER_ADMISSION_BATCHES.inc()
-        SOLVER_ADMISSION_REQUESTS.inc(
-            {"mode": "coalesced" if len(batch) > 1 else "solo"},
-            amount=len(batch),
-        )
+        SOLVER_ADMISSION_REQUESTS.inc({"mode": mode}, amount=len(batch))
         with self._lock:
             self._stats["batches"] += 1
             self._stats["requests"] += len(batch)
-            if len(batch) > 1:
+            if mode == "coalesced":
                 self._stats["coalesced_requests"] += len(batch)
+            elif mode == "lanes":
+                self._stats["lane_batches"] += 1
+                self._stats["lane_requests"] += len(batch)
+            for e in batch:
+                row = self._tstat(e.tenant)
+                row["dispatched"] += 1
+                if mode == "coalesced":
+                    row["coalesced"] += 1
+                elif mode == "lanes":
+                    row["lane_batched"] += 1
         for e, r in zip(batch, responses):
             e.finish(r)
 
@@ -641,20 +881,33 @@ class AdmissionGate:
 
     def stats(self) -> Dict[str, object]:
         """Status snapshot for PlacementService.status(): per-lane
-        depth, coalesce ratio, shed counts."""
+        depth, coalesce ratio, shed counts — and the per-tenant rows
+        (queued depth, dispatch/batch/shed counters, weight) that make
+        one tenant's overload attributable without touching /metrics."""
         with self._lock:
             depth = {
                 LANE_NAMES[i]: len(q) for i, q in enumerate(self._lanes)
             }
             s = dict(self._stats)
             closed = self._closed
+            tenant_rows = {
+                t: dict(row) for t, row in self._tenant_stats.items()
+            }
+            depths = self._depth_snapshot()
+        weights = self.tenants.weights_for(tenant_rows)
+        for t, row in tenant_rows.items():
+            row["queued"] = sum(depths.get(t, ()))
+            row["weight"] = weights[t]
         return {
             "queue_depth": depth,
             "capacity": self.cfg.capacity,
             "max_coalesce": self.cfg.max_coalesce,
+            "tenant_lanes": self.cfg.tenant_lanes,
             "requests_total": s["requests"],
             "batches_total": s["batches"],
             "coalesced_requests_total": s["coalesced_requests"],
+            "lane_batches_total": s["lane_batches"],
+            "lane_requests_total": s["lane_requests"],
             "coalesce_ratio": (
                 s["requests"] / s["batches"] if s["batches"] else 0.0
             ),
@@ -663,6 +916,7 @@ class AdmissionGate:
                 "deadline-exceeded": s["shed_deadline"],
                 "shutting-down": s["shed_shutdown"],
             },
+            "tenants": tenant_rows,
             "closed": closed,
         }
 
@@ -679,12 +933,15 @@ class AdmissionGate:
             for q in self._lanes:
                 q.clear()
             self._stats["shed_shutdown"] += len(drained)
-            depths = [len(q) for q in self._lanes]
+            for e in drained:
+                self._tstat(e.tenant)["shed_shutdown"] += 1
+            depths = self._depth_snapshot()
             self._lock.notify_all()
         _publish_depth(depths)
         for e in drained:
             SOLVER_ADMISSION_SHED.inc(
-                {"lane": LANE_NAMES[e.lane], "reason": "shutdown"}
+                {"lane": LANE_NAMES[e.lane], "reason": "shutdown",
+                 "tenant": e.tenant}
             )
             e.finish(error_response(
                 ERR_SHUTDOWN, "sidecar stopping; request not solved"

@@ -174,6 +174,7 @@ class RemoteSolver:
                  timeout: float = 120.0, retries: int = 1,
                  deadline_s: Optional[float] = None,
                  lane=None,
+                 tenant: Optional[str] = None,
                  retry_total_s: float = 2.0,
                  backoff_base_s: float = 0.025,
                  backoff_cap_s: float = 0.5,
@@ -190,7 +191,13 @@ class RemoteSolver:
         ``deadline_s`` (when set) or ``retry_total_s``: a slow or
         shedding sidecar can no longer hang a scheduler tick for the
         full socket timeout. ``retries`` keeps its old meaning as the
-        guaranteed minimum retry count even when the budget is tiny."""
+        guaranteed minimum retry count even when the budget is tiny.
+
+        ``tenant`` names this front-end in a multi-tenant solver pool
+        (DESIGN §20): it rides the wire ``admission`` group on every
+        request, scoping the sidecar's coalescing, delta-base epoch
+        chain, fair-share shedding, and metric labels to this tenant.
+        None (the default) is the implicit single-tenant ``default``."""
         from koordinator_tpu.apis.extension import QoSClass
         from koordinator_tpu.service.admission import (
             LANE_BY_NAME,
@@ -214,6 +221,7 @@ class RemoteSolver:
             self.lane = LANE_BY_NAME[lane]
         else:
             self.lane = int(lane)
+        self.tenant = tenant
         self._client: Optional[PlacementClient] = None
         #: the staged-state epoch the CONNECTED sidecar holds as its
         #: delta base (None = none established / connection lost)
@@ -297,7 +305,8 @@ class RemoteSolver:
 
         def build_request(remaining: Optional[float]):
             admission = None
-            if remaining is not None or self.lane is not None:
+            if (remaining is not None or self.lane is not None
+                    or self.tenant is not None):
                 admission = {}
                 if remaining is not None:
                     admission["deadline_s"] = np.asarray(
@@ -305,6 +314,12 @@ class RemoteSolver:
                     )
                 if self.lane is not None:
                     admission["lane"] = np.asarray(self.lane, np.int64)
+                if self.tenant is not None:
+                    from koordinator_tpu.service.tenancy import (
+                        tenant_wire_value,
+                    )
+
+                    admission["tenant"] = tenant_wire_value(self.tenant)
             delta = staging[1] if staging is not None else None
             if (
                 delta is not None

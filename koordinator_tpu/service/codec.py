@@ -93,6 +93,13 @@ class SolveRequest:
     #: unchanged; from v2 on, decode skips unknown prefixes so future
     #: groups degrade the same way (a v2 client against a v1 server
     #: gets that server's typed "decode failed" error, not a hang).
+    #: The multi-tenant pool (DESIGN §20) adds ``tenant`` (utf-8 bytes
+    #: as a uint8 array, service/tenancy.tenant_wire_value): the
+    #: front-end's identity, scoping coalescing / delta bases /
+    #: fair-share accounting per tenant. Absent means the implicit
+    #: single-tenant ``default`` — an unknown key inside a known group
+    #: is simply extra npz members to old servers, so this needed no
+    #: protocol revision.
     admission: Optional[Dict[str, np.ndarray]] = None
     #: trace context (wire v3): ``round`` (int64, the scheduler's trace
     #: round number) and ``span`` (int64, a scheduler-unique span id).

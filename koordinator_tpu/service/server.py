@@ -42,6 +42,7 @@ from koordinator_tpu.ops.binpack import (
     ScoreParams,
     SolverConfig,
     bucket_row_update,
+    scatter_node_rows_copied,
     scatter_node_rows_donated,
     solve_batch,
 )
@@ -386,7 +387,8 @@ def _decode_config(group) -> SolverConfig:
 
 
 class NodeStateCache:
-    """Per-connection staged node state for the delta protocol.
+    """Per-(connection, tenant) staged node state for the delta
+    protocol.
 
     A full request carrying a ``node_delta`` ``epoch`` establishes the
     base: the server keeps BOTH the host arrays (kernel-eligibility
@@ -394,7 +396,14 @@ class NodeStateCache:
     Subsequent delta requests patch both in place — the host rows by
     numpy assignment, the device arrays by the same donated row scatter
     the in-process staging cache uses — so steady-state solves through
-    the sidecar never re-upload the [N,R] world either."""
+    the sidecar never re-upload the [N,R] world either.
+
+    The handler keys one cache per TENANT per connection (DESIGN §20):
+    epoch fencing is a per-tenant chain, so a multi-tenant proxy
+    multiplexing front-ends over one connection can never cross one
+    tenant's delta into another tenant's base — a base/epoch mismatch
+    stays a per-tenant ``delta-base-mismatch``, never silent
+    cross-tenant state bleed."""
 
     def __init__(self):
         self.host: Optional[Dict[str, np.ndarray]] = None
@@ -416,11 +425,23 @@ class NodeStateCache:
             for f in STAGED_NODE_FIELDS:
                 self.host[f][idx] = rows[f]
             sidx, srows = bucket_row_update(idx, rows)
-            self.state = scatter_node_rows_donated(
-                self.state, jnp.asarray(sidx), srows
+            # single-device sidecars (the production shape) donate the
+            # old generation; a MULTI-device process — the pool's lane
+            # mesh, the 8-virtual-device test/bench harness — takes the
+            # copying twin: jax 0.4.x donated jits in multi-device
+            # processes mis-apply alias maps (DESIGN §19.2), and under
+            # the pool's concurrency the donated replay corrupts the
+            # heap outright. One [N,R]x6 row-buffer copy per tick is
+            # the price of a staged world that is provably never
+            # clobbered while a stacked lane dispatch reads it.
+            scatter = (
+                scatter_node_rows_donated
+                if len(jax.devices()) == 1 else scatter_node_rows_copied
             )
+            self.state = scatter(self.state, jnp.asarray(sidx), srows)
         self.epoch = int(np.asarray(delta["epoch"]).item())
         return self.state
+
 
 
 def _trace_args(req: SolveRequest) -> Optional[Dict[str, int]]:
@@ -552,9 +573,21 @@ def solve_from_request(req: SolveRequest,
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
+        from koordinator_tpu.service.tenancy import request_tenant
+
         stream = self.request.makefile("rwb")
         self.server.active_connections.add(self.request)
-        node_cache = NodeStateCache()  # per-connection delta base
+        #: per-connection delta bases, one per tenant — each tenant's
+        #: epoch chain fences independently (DESIGN §20). LRU-bounded:
+        #: tenant ids are wire-controlled, and every established base
+        #: pins a full host+device world — without a cap one connection
+        #: cycling ids could grow sidecar memory without bound. An
+        #: evicted tenant's next delta gets the typed
+        #: ``delta-base-mismatch`` and re-establishes (the protocol's
+        #: existing self-heal), so the bound costs a re-send, never
+        #: correctness.
+        MAX_CONNECTION_TENANTS = 32
+        node_caches: Dict[str, NodeStateCache] = {}
         try:
             secret = self.server.shared_secret
             if secret is not None:
@@ -588,6 +621,15 @@ class _Handler(socketserver.BaseRequestHandler):
                         error=f"decode failed: {type(e).__name__}: {e}",
                     )
                 else:
+                    tenant = request_tenant(request)
+                    node_cache = node_caches.pop(tenant, None)
+                    if node_cache is None:
+                        node_cache = NodeStateCache()
+                        while len(node_caches) >= MAX_CONNECTION_TENANTS:
+                            # least-recently-used tenant's base evicted
+                            # (dict order IS recency: hits re-insert)
+                            node_caches.pop(next(iter(node_caches)))
+                    node_caches[tenant] = node_cache
                     gate = self.server.admission_gate
                     if gate is None:
                         response = solve_from_request(
@@ -624,11 +666,17 @@ class PlacementService:
     :class:`AdmissionConfig` customizes it, and ``False``/``None``
     restores the legacy inline per-connection solve (no queueing, no
     deadlines, no coalescing — the pre-gate behavior, kept as the
-    bench baseline and an escape hatch)."""
+    bench baseline and an escape hatch).
+
+    ``tenants`` is the multi-tenant pool's weight registry
+    (service/tenancy.TenantRegistry, DESIGN §20): it parameterizes the
+    gate's fair-share shedding and weighted-fair lane allocation.
+    Omitted, every tenant (including the implicit ``default``) weighs
+    1 — a single-tenant deployment behaves exactly as before."""
 
     def __init__(self, address, config: SolverConfig = SolverConfig(),
                  secret: Optional[bytes] = None,
-                 admission=True):
+                 admission=True, tenants=None):
         self.address = address
         if isinstance(address, str):
             # a dead predecessor leaves its socket file behind; unlink it
@@ -644,16 +692,20 @@ class PlacementService:
                 else:
                     probe.close()
                     raise OSError(f"address in use: {address}")
+            # a multi-tenant pool's front-ends (re)connect in gangs —
+            # leader failover, rolling restarts — so the accept backlog
+            # must hold a fleet, not the socketserver default of 5
             server_cls = type(
                 "_UnixServer",
                 (socketserver.ThreadingUnixStreamServer,),
-                {"daemon_threads": True},
+                {"daemon_threads": True, "request_queue_size": 64},
             )
         else:
             server_cls = type(
                 "_TCPServer",
                 (socketserver.ThreadingTCPServer,),
-                {"daemon_threads": True, "allow_reuse_address": True},
+                {"daemon_threads": True, "allow_reuse_address": True,
+                 "request_queue_size": 64},
             )
         self._server = server_cls(address, _Handler)
         self._server.solver_config = config
@@ -666,6 +718,7 @@ class PlacementService:
                 solve_from_request, gate_cfg,
                 # a lone connected client never pays the coalesce window
                 peer_count=self._server.active_connections.__len__,
+                tenants=tenants,
             )
         else:
             self.gate = None
@@ -682,7 +735,9 @@ class PlacementService:
         """Debug/status snapshot: the address served, live connection
         count, the kernel-routing breaker state (so an operator can
         see WHY solves ride the scan instead of the kernel), and the
-        admission gate's lane depths / coalesce ratio / shed counts."""
+        admission gate's lane depths / coalesce ratio / shed counts —
+        including the per-tenant rows (``admission.tenants``), so one
+        tenant's overload is attributable from this one endpoint."""
         return {
             "address": self.address,
             "active_connections": len(self._server.active_connections),

@@ -1,0 +1,665 @@
+"""Multi-tenant solver pool: K scheduler front-ends sharing ONE warm
+solver sidecar, their per-tick solves batched ACROSS tenants as lanes
+of a single device dispatch (docs/DESIGN.md §20).
+
+The north star is a fleet of clusters, not one scheduler per TPU pod:
+every tenant (one scheduler front-end / cluster) keeps its own staged
+world, its own wire-delta epoch chain, its own QoS budgets and deadline
+accounting — and the device still sees ONE program. The two measured
+halves this fuses:
+
+- the admission gate's same-base coalescing (DESIGN §12): K callers'
+  pod bursts against one shared base become vmap lanes of one dispatch;
+- the pod-lane axis of the 2-D mesh (DESIGN §19): K INDEPENDENT
+  stacked solves, collective-free, bit-identical per lane.
+
+Here each lane carries its OWN node base: per-tenant worlds are staged
+into one shared *node bucket* (the repo's quarter-step pow2 family,
+:func:`parallel.mesh.pow2_quarter_bucket`) and stacked ``[K, N*, ...]``;
+pod batches stack ``[K, P*, ...]`` in their own bucket; the lane count
+pads to a pow2 multiple of the lane-shard count. A dispatch therefore
+compiles per (lane bucket, node bucket, pod bucket, config) — tenants
+joining or leaving INSIDE a bucket reuse the warm program with zero XLA
+recompiles, which is what makes a pool of drifting front-ends cheap.
+
+**Isolation contract** (the hard requirement, tested in
+tests/test_tenancy.py):
+
+- *No cross-tenant base merge*: the gate's coalesce fingerprint
+  (service/admission.coalesce_key) feeds the tenant identity, so two
+  tenants shipping byte-identical worlds still never merge into one
+  base — they ride separate lanes with separate bases.
+- *Bit-identical placements*: the solver is integer arithmetic end to
+  end, so every tenant's lane output equals that tenant solving solo —
+  placements, per-lane node accounting, tie-breaks included.
+- *Per-tenant epochs*: the delta protocol's base/epoch fencing stays
+  per tenant-connection (service/server.py keys its NodeStateCache by
+  tenant); delta requests never join a cross-tenant batch.
+- *Per-tenant overload accounting*: shed/deadline counts are kept and
+  exported per tenant, and the gate's shed policy respects the
+  weighted fair share (:func:`fair_share`): one tenant's burst may only
+  evict queued work of tenants OVER their share (or its own).
+- *Weighted-fair lane budget*: when more same-bucket requests wait than
+  one dispatch can carry, :func:`allocate_fair_lanes` splits the lane
+  budget across tenants in proportion to their weights.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.obs.device import DEVICE_OBS
+from koordinator_tpu.ops.binpack import (
+    STAGED_NODE_FIELDS,
+    NodeState,
+    PodBatch,
+    ScoreParams,
+    SolverConfig,
+    solve_batch,
+)
+from koordinator_tpu.parallel.mesh import pow2_quarter_bucket
+from koordinator_tpu.service.codec import SolveRequest, SolveResponse
+
+#: requests without a wire tenant belong to the default tenant — a
+#: single-tenant deployment never has to name itself
+DEFAULT_TENANT = "default"
+
+#: wire tenant ids are bounded (they become metric label values and
+#: dict keys); longer ids are truncated, undecodable ones fall back
+MAX_TENANT_LEN = 64
+
+#: the tenant-id alphabet: ids become Prometheus label VALUES and the
+#: exposition format does no escaping in this repo's registry — a
+#: quote or newline in a wire-supplied id would corrupt the whole
+#: /metrics scrape for every tenant. Anything outside this set maps
+#: to ``_``.
+_TENANT_CHAR_RE = re.compile(r"[^A-Za-z0-9._\-]")
+
+#: per-tenant accounting (gate stats rows, depth gauges) is keyed by
+#: the WIRE tenant id: ids past this distinct-count cap fold into
+#: :data:`OVERFLOW_TENANT` so a client cycling unique tenant strings
+#: (or fleets embedding per-restart suffixes) cannot grow the sidecar's
+#: memory, metric cardinality, or per-submit gauge publishing without
+#: bound. Registered (weighted) tenants are always tracked.
+MAX_TRACKED_TENANTS = 256
+OVERFLOW_TENANT = "_overflow"
+
+
+def request_tenant(req: SolveRequest) -> str:
+    """The request's tenant identity from the wire ``admission`` group
+    (``tenant``: utf-8 bytes as a uint8 array, like the response error
+    string). Absent / undecodable means :data:`DEFAULT_TENANT` — v2
+    single-tenant clients ride through unchanged. Ids are truncated to
+    :data:`MAX_TENANT_LEN` and sanitized to the label-safe alphabet
+    (``[A-Za-z0-9._-]``): tenant names come off the WIRE and land in
+    metric label values, so a hostile id must never be able to break
+    the metrics exposition."""
+    adm = req.admission
+    if not adm or "tenant" not in adm:
+        return DEFAULT_TENANT
+    try:
+        raw = bytes(np.asarray(adm["tenant"], np.uint8))
+        name = raw.decode("utf-8")
+    except (TypeError, ValueError, UnicodeDecodeError):
+        return DEFAULT_TENANT
+    name = _TENANT_CHAR_RE.sub("_", name[:MAX_TENANT_LEN])
+    return name if name else DEFAULT_TENANT
+
+
+def tenant_wire_value(tenant: str) -> np.ndarray:
+    """Encode a tenant id for the ``admission`` group (client half)."""
+    return np.frombuffer(tenant.encode("utf-8"), dtype=np.uint8)
+
+
+# -- shape buckets -----------------------------------------------------------
+
+def node_bucket(n: int) -> int:
+    """The staged node-axis bucket for a tenant world of ``n`` nodes."""
+    return pow2_quarter_bucket(n, floor=8)
+
+
+def pod_bucket(p: int) -> int:
+    """The stacked pod-axis bucket for a lane of ``p`` pending pods."""
+    return pow2_quarter_bucket(p, floor=8)
+
+
+def lane_bucket(k: int, shards: int = 1) -> int:
+    """The lane-count bucket for ``k`` tenant lanes over ``shards``
+    lane shards: a power of two of per-shard lanes (so a tenant joining
+    or leaving inside the bucket reuses the compiled program) times the
+    shard count (so a ``NamedSharding`` split stays equal-width).
+    Padding lanes are hard-blocked duplicates — they place nothing."""
+    shards = max(1, shards)
+    per_shard = -(-max(1, k) // shards)
+    return shards * (1 << (per_shard - 1).bit_length())
+
+
+#: params every solve must carry (ScoreParams schema)
+_PARAM_FIELDS = ScoreParams._fields
+#: pod columns PodBatch.build accepts; the first four are required
+_POD_FIELDS = PodBatch._fields
+_POD_REQUIRED = ("req", "est", "is_prod", "is_daemonset")
+
+
+def plain_request(req: SolveRequest) -> bool:
+    """Whether ``req`` is a PLAIN full-state solve — no feature groups,
+    no delta protocol, full staged node schema, a complete pod/params
+    schema. Plain requests batch directly on their wire world's shape
+    (:func:`shape_bucket_key`); pure DELTA requests batch through
+    :func:`delta_shape_key` against their staged base; feature-group
+    solves always ride the solo path."""
+    if (
+        req.quota is not None
+        or req.gang is not None
+        or req.extras is not None
+        or req.resv is not None
+        or req.numa is not None
+        or req.node_delta is not None
+    ):
+        return False
+    if set(req.node) != set(STAGED_NODE_FIELDS):
+        return False  # NUMA inventories (or a short node group) ride solo
+    if not set(_POD_REQUIRED) <= set(req.pods):
+        return False
+    if not set(req.pods) <= set(_POD_FIELDS):
+        return False
+    if not set(_PARAM_FIELDS) <= set(req.params):
+        return False
+    return True
+
+
+def _schema_digest(req: SolveRequest, node_cols: Mapping[str, np.ndarray],
+                   n_nodes: int) -> bytes:
+    """The shared shape fingerprint body: node/pod/param schema with
+    bucketed leading axes + static config VALUES. ``node_cols`` is the
+    world's column source — the wire ``node`` group for a plain
+    request, the per-tenant cache's host arrays for a delta request —
+    so both batching tiers hash the same shape domain and a plain lane
+    and a delta lane can share one program."""
+    h = hashlib.blake2b(digest_size=16)
+
+    def feed_schema(tag: str, a: np.ndarray, lead_bucket=None) -> None:
+        h.update(tag.encode())
+        h.update(str(a.dtype).encode())
+        if lead_bucket is None:
+            h.update(repr(a.shape).encode())
+        else:
+            h.update(repr((lead_bucket,) + a.shape[1:]).encode())
+
+    p = int(np.asarray(req.pods["req"]).shape[0])
+    nb, pb = node_bucket(n_nodes), pod_bucket(p)
+    for f in STAGED_NODE_FIELDS:
+        feed_schema("n." + f, np.asarray(node_cols[f]), lead_bucket=nb)
+    for f in sorted(req.pods):
+        feed_schema("p." + f, np.asarray(req.pods[f]), lead_bucket=pb)
+    for f in sorted(req.params):
+        feed_schema("s." + f, np.asarray(req.params[f]))
+    if req.config is not None:
+        # config is a STATIC jit argument: values, not just schema
+        for f in sorted(req.config):
+            a = np.asarray(req.config[f])
+            feed_schema("c." + f, a)
+            h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+def shape_bucket_key(req: SolveRequest) -> Optional[bytes]:
+    """SHAPE-level fingerprint for cross-tenant lane batching, or None
+    when the request cannot batch.
+
+    Two requests with equal keys stage into the same (node bucket, pod
+    bucket) and run under the same static config — they can be lanes of
+    ONE compiled program even though every byte of their node/pod/param
+    DATA differs (that is the point: separate tenants, separate
+    worlds). Unlike :func:`~koordinator_tpu.service.admission.
+    coalesce_key` no array data is hashed — only dtypes, trailing dims,
+    the bucketed leading axes, and the static config values (a static
+    jit argument must be equal across lanes)."""
+    if not plain_request(req):
+        return None
+    n = int(np.asarray(req.node["alloc"]).shape[0])
+    return _schema_digest(req, req.node, n)
+
+
+def delta_request(req: SolveRequest) -> bool:
+    """Whether ``req`` is a pure DELTA solve — a ``node_delta`` row
+    patch against the per-tenant-connection cached base, no feature
+    groups, no inline node group, complete pod/params schema. The
+    steady-state serving shape: these may lane-batch across tenants
+    exactly like plain requests, each lane solving against its own
+    (patched) staged world."""
+    if (
+        req.quota is not None
+        or req.gang is not None
+        or req.extras is not None
+        or req.resv is not None
+        or req.numa is not None
+    ):
+        return False
+    if req.node:
+        return False  # an inline node group means full/establish, not delta
+    delta = req.node_delta
+    if not delta or "idx" not in delta or "base_epoch" not in delta:
+        return False
+    # a malformed patch (missing row columns, row/idx length mismatch)
+    # must ride SOLO: batched, its staging failure would poison every
+    # co-batched tenant's response with a typed internal error —
+    # exactly the cross-tenant blast radius the pool promises away
+    if "epoch" not in delta:
+        return False
+    idx = np.asarray(delta["idx"])
+    if idx.ndim != 1:
+        return False
+    for f in STAGED_NODE_FIELDS:
+        if f not in delta:
+            return False
+        if np.asarray(delta[f]).shape[:1] != idx.shape[:1]:
+            return False
+    if not set(_POD_REQUIRED) <= set(req.pods):
+        return False
+    if not set(req.pods) <= set(_POD_FIELDS):
+        return False
+    if not set(_PARAM_FIELDS) <= set(req.params):
+        return False
+    return True
+
+
+def delta_shape_key(req: SolveRequest, node_cache) -> Optional[bytes]:
+    """The shape-bucket key of a DELTA request against its tenant's
+    established base, or None when it must ride solo (not a pure delta,
+    no base, or a base/epoch mismatch — the solo path then answers the
+    typed ``delta-base-mismatch``).
+
+    Safe to compute at submit time: per-tenant-connection caches are
+    mutated only by the gate's single executor, and a connection has at
+    most one request in flight, so the cache's epoch cannot change
+    between this check and the dispatch that applies the patch."""
+    if not delta_request(req):
+        return None
+    if (
+        node_cache is None
+        or node_cache.state is None
+        or node_cache.host is None
+        or node_cache.epoch is None
+    ):
+        return None
+    try:
+        base = int(np.asarray(req.node_delta["base_epoch"]).item())
+    except (TypeError, ValueError):
+        return None
+    if node_cache.epoch != base:
+        return None  # mismatch: the solo path owns the typed error
+    n = int(node_cache.host["alloc"].shape[0])
+    return _schema_digest(req, node_cache.host, n)
+
+
+# -- weighted-fair arbitration ----------------------------------------------
+
+def fair_share(capacity: int, weights: Mapping[str, float]) -> Dict[str, int]:
+    """Per-tenant queue fair share: ``capacity`` split in proportion to
+    the tenants' weights (floor 1 — a registered tenant can always hold
+    at least one entry). Tenants at or under their share are protected
+    from cross-tenant eviction (the gate's shed policy)."""
+    total = sum(max(0.0, w) for w in weights.values()) or 1.0
+    return {
+        t: max(1, int(capacity * max(0.0, w) / total))
+        for t, w in weights.items()
+    }
+
+
+def allocate_fair_lanes(
+    candidates: Mapping[str, Sequence],
+    weight_of: Callable[[str], float],
+    budget: int,
+    room: int,
+    pods_of: Callable[[object], int],
+    preloaded: Optional[Mapping[str, int]] = None,
+) -> List[object]:
+    """Split one dispatch window's lane budget across contending
+    tenants in proportion to their weights.
+
+    ``candidates`` maps tenant -> its queued same-bucket entries in
+    FIFO order; ``budget`` is how many lanes remain, ``room`` how many
+    summed pod rows (the gate's ``max_coalesced_pods`` bound);
+    ``preloaded`` counts lanes already granted (the claimed batch
+    head). Classic weighted round-robin: repeatedly grant the next
+    entry of the tenant with the smallest granted/weight ratio —
+    deterministic (ties break on tenant name), starvation-free (every
+    positive-weight tenant with work gets a lane before any tenant gets
+    its k+1st at equal weights)."""
+    cursors = {t: 0 for t in candidates}
+    granted: Dict[str, int] = dict(preloaded or {})
+    out: List[object] = []
+    while budget > 0:
+        best: Optional[str] = None
+        best_ratio = None
+        for t in sorted(candidates):
+            q = candidates[t]
+            i = cursors[t]
+            while i < len(q) and pods_of(q[i]) > room:
+                i += 1  # oversized for the remaining room: skip, keep FIFO
+            cursors[t] = i
+            if i >= len(q):
+                continue
+            w = max(1e-9, weight_of(t))
+            ratio = granted.get(t, 0) / w
+            if best is None or ratio < best_ratio:
+                best, best_ratio = t, ratio
+        if best is None:
+            break
+        entry = candidates[best][cursors[best]]
+        cursors[best] += 1
+        granted[best] = granted.get(best, 0) + 1
+        room -= pods_of(entry)
+        budget -= 1
+        out.append(entry)
+    return out
+
+
+class TenantRegistry:
+    """Weights and membership for the pool's tenants.
+
+    Read-mostly: the gate consults it on every submit/dispatch, an
+    operator (or test) registers tenants up front. Unregistered tenants
+    are implicitly weight-1 — the pool serves unknown front-ends with
+    equal fairness rather than refusing them."""
+
+    DEFAULT_WEIGHT = 1.0
+
+    def __init__(self, weights: Optional[Mapping[str, float]] = None):
+        #: guards _weights (graftcheck lock map)
+        self._lock = threading.Lock()
+        self._weights: Dict[str, float] = dict(weights or {})
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {weight}")
+        with self._lock:
+            self._weights[tenant] = float(weight)
+
+    def weight(self, tenant: str) -> float:
+        with self._lock:
+            return self._weights.get(tenant, self.DEFAULT_WEIGHT)
+
+    def weights_for(self, tenants) -> Dict[str, float]:
+        """The weight map over ``tenants`` (implicit members included)."""
+        with self._lock:
+            return {
+                t: self._weights.get(t, self.DEFAULT_WEIGHT)
+                for t in tenants
+            }
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._weights)
+
+
+# -- the cross-tenant lane dispatch -----------------------------------------
+
+def _vmapped_tenant_solve(states, pods, params, config):
+    """K tenants' independent solves — each lane against its OWN base
+    and params — as ONE XLA program (assignments only: the [K,N,R]
+    state carry is dead weight on the serving path, PR 15's
+    ``want_state=False`` measurement)."""
+    return jax.vmap(
+        lambda s, p, pr: solve_batch(s, p, pr, config).assign
+    )(states, pods, params)
+
+
+def _vmapped_tenant_solve_full(states, pods, params, config):
+    """The ``want_state=True`` twin: per-lane mutated ``used_req``
+    rides back too (isolation property tests compare it to solo)."""
+    def body(s, p, pr):
+        r = solve_batch(s, p, pr, config)
+        return r.node_state.used_req, r.assign
+
+    return jax.vmap(body)(states, pods, params)
+
+
+#: one jitted multi-base program per (lane bucket, node bucket, pod
+#: bucket, config) shape, shared by every gate in the process
+_jit_tenant = DEVICE_OBS.jit("tenant_pool_solve", jax.jit(
+    _vmapped_tenant_solve, static_argnames=("config",), donate_argnums=()
+))
+_jit_tenant_full = DEVICE_OBS.jit("tenant_pool_solve_full", jax.jit(
+    _vmapped_tenant_solve_full, static_argnames=("config",),
+    donate_argnums=(),
+))
+
+#: lane-sharded dispatch (multi-device hosts): mesh + solver built
+#: lazily, cached per (config, want_state) — the virtual 8-device test
+#: mesh and a real pod slice both route here
+_lane_mesh = [False]  # False = unprobed, None = single device
+_tenant_solvers: Dict = {}
+_tenant_solver_lock = threading.Lock()
+
+
+def _sharded_tenant_solver(config: SolverConfig, want_state: bool):
+    """The lane-sharded dispatch for this process's devices, or None on
+    a single-device host (the plain vmap jit is the right program
+    there)."""
+    from koordinator_tpu.parallel.mesh import (
+        make_mesh2d,
+        shard_tenant_solver,
+    )
+
+    with _tenant_solver_lock:
+        if _lane_mesh[0] is False:
+            devices = jax.devices()
+            _lane_mesh[0] = (
+                make_mesh2d(devices, node_shards=1,
+                            pod_shards=len(devices))
+                if len(devices) > 1 else None
+            )
+        mesh = _lane_mesh[0]
+        if mesh is None:
+            return None
+        key = (tuple(config), want_state)
+        solver = _tenant_solvers.get(key)
+        if solver is None:
+            solver = _tenant_solvers[key] = shard_tenant_solver(
+                mesh, config, want_state=want_state
+            )
+        return solver
+
+
+def lane_shard_count() -> int:
+    """How many ways the pool's lane dispatch shards (1 = plain vmap)."""
+    if _lane_mesh[0] is False:
+        _sharded_tenant_solver(SolverConfig(), False)
+    mesh = _lane_mesh[0]
+    if mesh is None:
+        return 1
+    from koordinator_tpu.parallel.mesh import POD_AXIS, mesh_axis_size
+
+    return mesh_axis_size(mesh, POD_AXIS)
+
+
+def _stage_lanes(pairs, shards: int):
+    """Stack K lanes into the bucketed batch: ``(states [K*,N*,...],
+    pods [K*,P*,...], params [K*,...], counts, node_counts, K*)``.
+
+    ``pairs`` is ``[(request, lane_state_or_None), ...]`` — a lane's
+    world comes from its wire ``node`` group (plain request,
+    host-staged here) or from its tenant's already-staged device
+    :class:`NodeState` (delta request, patched by the caller). Every
+    axis rides its bucket — node and pod padding rows are inert
+    (unschedulable zero nodes / hard-blocked pods, the same
+    "permanently empty node" rows the sharded staging appends), lane
+    padding duplicates the last lane fully blocked — so outputs trim
+    back to exactly what each tenant solving solo would have
+    produced."""
+    head = pairs[0][0]
+    node_counts = [
+        int(state.alloc.shape[0]) if state is not None
+        else int(np.asarray(r.node["alloc"]).shape[0])
+        for r, state in pairs
+    ]
+    counts = [
+        int(np.asarray(r.pods["req"]).shape[0]) for r, _ in pairs
+    ]
+    nb = node_bucket(max(node_counts))
+    pb = pod_bucket(max(counts))
+    k = len(pairs)
+    kb = lane_bucket(k, shards)
+    DEVICE_OBS.note_padding("tenant_nodes", sum(node_counts), k * nb)
+    DEVICE_OBS.note_padding("tenant_pods", sum(counts), k * pb)
+    DEVICE_OBS.note_padding("tenant_lanes", k, kb)
+
+    def pad_rows(a: np.ndarray, target: int) -> np.ndarray:
+        if a.shape[0] == target:
+            return a
+        pw = [(0, target - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, pw)  # 0 == False: inert padding on every column
+
+    def pad_rows_dev(a, target: int):
+        if a is None or a.shape[0] == target:
+            return a
+        pw = [(0, target - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, pw)  # device pad, no host round-trip
+
+    lane_states: List[NodeState] = []
+    for r, state in pairs:
+        if state is not None:
+            lane_states.append(NodeState(
+                *(pad_rows_dev(x, nb) for x in state)
+            ))
+        else:
+            lane_states.append(NodeState(
+                **{f: pad_rows(np.asarray(r.node[f]), nb)
+                   for f in STAGED_NODE_FIELDS}
+            ))
+    lane_states += [lane_states[-1]] * (kb - k)  # dup lanes, blocked below
+    from koordinator_tpu.parallel.mesh import stack_node_states
+
+    states = stack_node_states(lane_states)
+
+    pod_fields = sorted(set(head.pods) - {"blocked"})
+    pod_cols: Dict[str, np.ndarray] = {}
+    for f in pod_fields:
+        lanes = [pad_rows(np.asarray(r.pods[f]), pb) for r, _ in pairs]
+        lanes += [lanes[-1]] * (kb - k)
+        pod_cols[f] = np.stack(lanes)
+    blocked = np.ones((kb, pb), bool)
+    for i, ((r, _), p) in enumerate(zip(pairs, counts)):
+        blocked[i, :p] = (
+            np.asarray(r.pods["blocked"]) if "blocked" in r.pods else False
+        )
+    pods = PodBatch.build(
+        blocked=jnp.asarray(blocked),
+        **{f: jnp.asarray(v) for f, v in pod_cols.items()},
+    )
+
+    param_cols = {}
+    for f in ScoreParams._fields:
+        lanes = [np.asarray(r.params[f]) for r, _ in pairs]
+        lanes += [lanes[-1]] * (kb - k)
+        param_cols[f] = np.stack(lanes)
+    params = ScoreParams(
+        **{f: jnp.asarray(v) for f, v in param_cols.items()}
+    )
+    return states, pods, params, counts, node_counts, kb
+
+
+def _solve_lanes(pairs, config, want_state: bool) -> List[SolveResponse]:
+    head = pairs[0][0]
+    if config is None:
+        config = SolverConfig()
+    if head.config is not None:
+        from koordinator_tpu.service.server import _decode_config
+
+        config = _decode_config(head.config)
+    shards = lane_shard_count()
+    states, pods, params, counts, node_counts, kb = _stage_lanes(
+        pairs, shards
+    )
+    solver = _sharded_tenant_solver(config, want_state) if shards > 1 \
+        else None
+    if solver is not None:
+        used_req, assign = solver(states, pods, params)
+    elif want_state:
+        used_req, assign = _jit_tenant_full(
+            states, pods, params, config=config
+        )
+    else:
+        used_req = None
+        assign = _jit_tenant(states, pods, params, config=config)
+    assign_all = np.asarray(assign)
+    used_all = None if used_req is None else np.asarray(used_req)
+    out: List[SolveResponse] = []
+    for i, (p, n) in enumerate(zip(counts, node_counts)):
+        a = np.asarray(assign_all[i, :p], np.int32)
+        out.append(SolveResponse(
+            assignments=a,
+            node_used_req=(
+                None if used_all is None else used_all[i, :n]
+            ),
+            # plain/delta solves: commit IS "placed"; gang/quota/numa
+            # requests never reach this path (the batchability
+            # predicates gate it)
+            commit=a >= 0,
+            waiting=np.zeros(p, bool),
+            rejected=np.zeros(p, bool),
+            raw_assign=a,
+        ))
+    return out
+
+
+def solve_tenant_lanes(
+    requests: Sequence[SolveRequest],
+    config: Optional[SolverConfig] = SolverConfig(),
+    want_state: bool = False,
+) -> List[SolveResponse]:
+    """Solve K tenants' plain requests — separate worlds, separate
+    params, one shape bucket — as lanes of ONE device dispatch and
+    split the results back per tenant.
+
+    The program is the multi-base vmap (``assignments`` only by
+    default); on a multi-device host the lane axis shards over the
+    ``pods`` mesh axis (:func:`parallel.mesh.shard_tenant_solver`), so
+    K front-ends' ticks cost one sharded dispatch. Each returned
+    :class:`SolveResponse` is bit-identical to what
+    ``solve_from_request`` would have produced for that tenant alone
+    (``want_state=True`` additionally carries the per-lane
+    ``node_used_req`` — the isolation property tests compare it; the
+    serving path leaves it off, the [K,N,R] carry being measured dead
+    weight)."""
+    return _solve_lanes(
+        [(r, None) for r in requests], config, want_state
+    )
+
+
+def solve_entry_lanes(entries, config=None) -> List[SolveResponse]:
+    """The gate's lane dispatch over admission entries: each entry is a
+    plain request (world staged from the wire) or a DELTA request
+    (its tenant-connection's staged base patched on the executor
+    thread, then joined to the stack ON DEVICE). This is the
+    steady-state serving shape of the pool: K tenants' per-tick deltas
+    cost kilobytes of wire and one fused dispatch, while every lane
+    stays bit-identical to that tenant solving solo.
+
+    (A fused scatter-inside-solve variant was measured and REJECTED:
+    adopting the patched [K,N,...] stack back into the caches leaves
+    mesh-resident bases whose every later eager staging op pays an
+    8-device sync barrier — the pool round ballooned 2-5x. The
+    two-step shape below — per-cache scatter, then stack — keeps the
+    staged bases single-device and measured fastest.)"""
+    pairs = []
+    for e in entries:
+        req = e.request
+        state = None
+        if delta_request(req):
+            # eligibility (base present, epoch match) was established
+            # at submit time and cannot have changed: only this
+            # executor thread mutates caches, one request per
+            # connection is in flight
+            state = e.node_cache.apply(req.node_delta)
+        pairs.append((req, state))
+    return _solve_lanes(pairs, config, want_state=False)

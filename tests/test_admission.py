@@ -111,15 +111,33 @@ class TestCoalescedBitIdentity:
             keys = {coalesce_key(r) for r in requests}
             assert len(keys) == 1 and None not in keys
             sequential = [solve_from_request(r) for r in requests]
+            # the gate's dispatch is assignments-only (satellite: the
+            # [K,N,R] state carry is dead weight on the serving path) —
+            # placements/commit must still match solo bit-for-bit, and
+            # node_used_req comes back None by contract
             coalesced = solve_coalesced(requests)
-            for i, (want, got) in enumerate(zip(sequential, coalesced)):
+            # want_state=True materializes the per-lane carries too
+            # (the isolation property the pool leans on)
+            full = solve_coalesced(requests, want_state=True)
+            for i, (want, got, gotf) in enumerate(
+                    zip(sequential, coalesced, full)):
                 assert want.error == "" and got.error == ""
-                for field in ("assignments", "node_used_req", "commit",
-                              "waiting", "rejected", "raw_assign"):
+                assert got.node_used_req is None
+                for field in ("assignments", "commit", "waiting",
+                              "rejected", "raw_assign"):
                     np.testing.assert_array_equal(
                         getattr(want, field), getattr(got, field),
                         err_msg=f"trial {trial} segment {i} field {field}",
                     )
+                    np.testing.assert_array_equal(
+                        getattr(want, field), getattr(gotf, field),
+                        err_msg=f"trial {trial} segment {i} field {field}"
+                                " (want_state)",
+                    )
+                np.testing.assert_array_equal(
+                    want.node_used_req, gotf.node_used_req,
+                    err_msg=f"trial {trial} segment {i} node_used_req",
+                )
 
 class TestCoalesceKey:
     def test_same_base_same_key_different_pods(self):

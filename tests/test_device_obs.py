@@ -503,3 +503,25 @@ def test_bench_diff_budget_mode(tmp_path):
     }))
     out = _bench_diff("--budget", str(budget), str(rec))
     assert out.returncode == 1, out.stdout
+
+
+def test_bench_diff_budget_equals_pins_flags(tmp_path):
+    """The ``equals`` bound (ISSUE 11 satellite): identity/acceptance
+    FLAGS can be pinned by a budget — a bit-identity boolean holding
+    true passes, flipping false (or going missing) fails."""
+    rec = tmp_path / "r.json"
+    rec.write_text(json.dumps(_record()))
+    budget = tmp_path / "budget.json"
+    budget.write_text(json.dumps({
+        "9_churn": {"identical_to_oracle": {"equals": True}},
+    }))
+    out = _bench_diff("--budget", str(budget), str(rec))
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec.write_text(json.dumps(_record(identical_to_oracle=False)))
+    out = _bench_diff("--budget", str(budget), str(rec))
+    assert out.returncode == 1, out.stdout
+    budget.write_text(json.dumps({
+        "9_churn": {"no_such_flag": {"equals": True}},
+    }))
+    out = _bench_diff("--budget", str(budget), str(rec))
+    assert out.returncode == 1, out.stdout

@@ -1,0 +1,881 @@
+"""Multi-tenant solver pool (ISSUE 11 / DESIGN §20): cross-tenant lane
+batching that is bit-identical to every tenant solving solo, zero XLA
+recompiles across tenant join/leave inside a shape bucket, per-tenant
+epoch fencing, weighted-fair lane allocation, and fair-share shedding
+isolation."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import NUM_RESOURCES
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.service.admission import (
+    LANE_BE,
+    LANE_LS,
+    AdmissionConfig,
+    AdmissionGate,
+    coalesce_key,
+    solve_coalesced,
+)
+from koordinator_tpu.service.codec import SolveRequest
+from koordinator_tpu.service.server import PlacementService, solve_from_request
+from koordinator_tpu.service.tenancy import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+    allocate_fair_lanes,
+    fair_share,
+    lane_bucket,
+    node_bucket,
+    pod_bucket,
+    request_tenant,
+    shape_bucket_key,
+    solve_tenant_lanes,
+    tenant_wire_value,
+)
+
+
+def _world(n_nodes, seed):
+    """One tenant's node/params groups — data differs per seed, schema
+    (and node bucket, for nearby n_nodes) is shared."""
+    rng = np.random.default_rng(seed)
+    alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+    alloc[:, R.CPU] = 16000
+    alloc[:, R.MEMORY] = 32768
+    used = np.zeros_like(alloc)
+    used[:, R.CPU] = rng.integers(0, 8000, n_nodes)
+    used[:, R.MEMORY] = rng.integers(0, 16384, n_nodes)
+    node = {
+        "alloc": alloc,
+        "used_req": used,
+        "usage": np.zeros_like(alloc),
+        "prod_usage": np.zeros_like(alloc),
+        "est_extra": np.zeros_like(alloc),
+        "prod_base": np.zeros_like(alloc),
+        "metric_fresh": np.ones(n_nodes, bool),
+        "schedulable": np.ones(n_nodes, bool),
+    }
+    weights = np.zeros(NUM_RESOURCES, np.int32)
+    weights[R.CPU] = 1
+    weights[R.MEMORY] = 1
+    thresholds = np.zeros(NUM_RESOURCES, np.int32)
+    thresholds[R.CPU] = 65
+    thresholds[R.MEMORY] = 95
+    params = {
+        "weights": weights,
+        "thresholds": thresholds,
+        "prod_thresholds": np.zeros(NUM_RESOURCES, np.int32),
+    }
+    return node, params
+
+
+def _pods(n_pods, seed):
+    rng = np.random.default_rng(seed)
+    req = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+    req[:, R.CPU] = rng.choice([500, 1000, 2000, 3000], n_pods)
+    req[:, R.MEMORY] = rng.choice([256, 1024, 2048], n_pods)
+    return {
+        "req": req,
+        "est": (req * 85) // 100,
+        "is_prod": rng.uniform(size=n_pods) < 0.4,
+        "is_daemonset": np.zeros(n_pods, bool),
+    }
+
+
+def _request(tenant=None, n_nodes=12, n_pods=5, seed=0, pod_seed=None,
+             **over):
+    node, params = _world(n_nodes, seed)
+    req = SolveRequest(
+        node=node, params=params,
+        pods=_pods(n_pods, seed if pod_seed is None else pod_seed),
+    )
+    if tenant is not None:
+        req.admission = dict(over.pop("admission", None) or {})
+        req.admission["tenant"] = tenant_wire_value(tenant)
+    for k, v in over.items():
+        setattr(req, k, v)
+    return req
+
+
+def _stub_response(request):
+    from koordinator_tpu.service.codec import SolveResponse
+
+    n = int(np.asarray(request.pods["req"]).shape[0])
+    return SolveResponse(assignments=np.zeros(n, np.int32))
+
+
+class _BlockingSolve:
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.order = []
+
+    def __call__(self, request, config, node_cache):
+        self.order.append(request)
+        self.entered.set()
+        assert self.release.wait(10), "test forgot to release the solve"
+        return _stub_response(request)
+
+
+def _solo_request(tag: int, tenant=None, **over):
+    req = _request(tenant=tenant, n_pods=2 + tag % 3, pod_seed=tag, **over)
+    req.quota = {"tag": np.asarray([tag])}
+    return req
+
+
+# -- identity / keys ---------------------------------------------------------
+
+class TestTenantIdentity:
+    def test_request_tenant_decode(self):
+        assert request_tenant(_request()) == DEFAULT_TENANT
+        assert request_tenant(_request(tenant="team-a")) == "team-a"
+        # undecodable bytes fall back instead of raising
+        req = _request()
+        req.admission = {"tenant": np.asarray([0xFF, 0xFE], np.uint8)}
+        assert request_tenant(req) == DEFAULT_TENANT
+        # over-long ids are truncated, not refused
+        long = _request(tenant="x" * 200)
+        assert len(request_tenant(long)) == 64
+
+    def test_tenant_id_sanitized_for_metric_labels(self):
+        """Wire tenant ids land in Prometheus label values, and the
+        exposition does no escaping — a quote/newline in a hostile id
+        must be neutralized, never break the whole /metrics scrape."""
+        evil = _request(tenant='a"} 1\nevil{x="y')
+        got = request_tenant(evil)
+        assert '"' not in got and "\n" not in got and "{" not in got
+        assert got.startswith("a_")
+
+    def test_tenant_cardinality_bounded(self):
+        """A client cycling unique tenant ids cannot grow the gate's
+        per-tenant accounting (stats rows, depth-gauge label sets)
+        without bound: past the cap, unregistered newcomers fold into
+        the overflow bucket."""
+        from koordinator_tpu.service.tenancy import (
+            MAX_TRACKED_TENANTS,
+            OVERFLOW_TENANT,
+        )
+
+        def instant(request, config, node_cache):
+            return _stub_response(request)
+
+        gate = AdmissionGate(instant, AdmissionConfig(),
+                             peer_count=lambda: 1)
+        try:
+            for i in range(MAX_TRACKED_TENANTS + 40):
+                e = gate.submit(_solo_request(i, tenant=f"churner-{i}"),
+                                None)
+                assert e.wait(10).error == ""
+            st = gate.stats()
+            assert len(st["tenants"]) <= MAX_TRACKED_TENANTS + 1
+            assert st["tenants"][OVERFLOW_TENANT]["requests"] >= 40
+        finally:
+            gate.shutdown(timeout=2)
+
+    def test_cross_tenant_never_merges_bases(self):
+        """THE isolation key property: byte-identical worlds from two
+        tenants must NOT share a coalesce key (no cross-tenant base
+        merge) while sharing a shape bucket (they may share a dispatch
+        as separate lanes)."""
+        a = _request(tenant="team-a", seed=3)
+        b = _request(tenant="team-b", seed=3)
+        assert coalesce_key(a) is not None
+        assert coalesce_key(a) != coalesce_key(b)
+        assert shape_bucket_key(a) == shape_bucket_key(b) is not None
+
+    def test_shape_bucket_key_data_blind(self):
+        # different data, same schema/buckets -> same key
+        a = _request(tenant="a", n_nodes=9, seed=1)
+        b = _request(tenant="b", n_nodes=10, seed=2)  # both in the 10-bucket
+        assert node_bucket(9) == node_bucket(10)
+        assert shape_bucket_key(a) == shape_bucket_key(b)
+        # a different node bucket -> different key
+        c = _request(tenant="c", n_nodes=200, seed=1)
+        assert shape_bucket_key(a) != shape_bucket_key(c)
+        # feature groups / delta never batch
+        assert shape_bucket_key(_solo_request(1)) is None
+        assert shape_bucket_key(
+            _request(node_delta={"epoch": np.asarray(1, np.int64)})
+        ) is None
+
+    def test_shape_bucket_key_config_values(self):
+        a = _request(seed=1)
+        b = _request(seed=1)
+        b.config = {"unroll": np.asarray(8, np.int64)}
+        assert shape_bucket_key(a) != shape_bucket_key(b)
+
+    def test_malformed_delta_rides_solo(self):
+        """A delta patch missing row columns (or with mismatched row
+        lengths) must never join a batch: batched, its staging failure
+        would poison co-batched tenants' responses."""
+        from koordinator_tpu.service.tenancy import delta_request
+
+        node, params = _world(8, seed=1)
+        good = {
+            "idx": np.asarray([0], np.int32),
+            "base_epoch": np.asarray(0, np.int64),
+            "epoch": np.asarray(1, np.int64),
+            **{f: np.asarray(node[f][:1]) for f in node},
+        }
+        req = SolveRequest(node={}, params=params, pods=_pods(3, 1),
+                           node_delta=dict(good))
+        assert delta_request(req)
+        missing = dict(good)
+        del missing["used_req"]
+        req.node_delta = missing
+        assert not delta_request(req)
+        short = dict(good)
+        short["alloc"] = np.asarray(node["alloc"][:0])
+        req.node_delta = short
+        assert not delta_request(req)
+
+
+# -- the lane dispatch -------------------------------------------------------
+
+class TestLaneDispatchIdentity:
+    def test_smoke_lanes_bit_identical_to_solo(self):
+        """THE pool contract: K tenants' plain requests — separate
+        worlds, separate params, one shape bucket — solved as lanes of
+        one dispatch split back bit-identical to each tenant solving
+        alone (mixed node counts inside the bucket included)."""
+        requests = [
+            _request(tenant=f"t{i}", n_nodes=9 + (i % 2), n_pods=3 + i,
+                     seed=10 + i, pod_seed=100 + i)
+            for i in range(3)
+        ]
+        keys = {shape_bucket_key(r) for r in requests}
+        assert len(keys) == 1 and None not in keys
+        solo = [solve_from_request(r) for r in requests]
+        lanes = solve_tenant_lanes(requests)
+        full = solve_tenant_lanes(requests, want_state=True)
+        for i, (want, got, gotf) in enumerate(zip(solo, lanes, full)):
+            assert want.error == "" and got.error == ""
+            assert got.node_used_req is None
+            for field in ("assignments", "commit", "waiting", "rejected",
+                          "raw_assign"):
+                np.testing.assert_array_equal(
+                    getattr(want, field), getattr(got, field),
+                    err_msg=f"lane {i} field {field}",
+                )
+            np.testing.assert_array_equal(
+                want.node_used_req, gotf.node_used_req,
+                err_msg=f"lane {i} node_used_req",
+            )
+
+    def test_property_lanes_identical_under_mixed_churn(self):
+        """Property sweep: random tenant counts, node counts (within
+        and across buckets handled by the caller grouping), pod
+        counts, and per-tick world mutation — every lane always equals
+        its solo twin, tick after tick."""
+        rng = np.random.default_rng(7)
+        n_base = int(rng.integers(8, 14))
+        worlds = {}
+        for t in range(4):
+            node, params = _world(n_base + int(rng.integers(0, 3)),
+                                  seed=40 + t)
+            worlds[f"t{t}"] = (node, params)
+        for tick in range(4):
+            requests = []
+            for t, (node, params) in sorted(worlds.items()):
+                # churn: mutate a couple of node rows in place, like a
+                # front-end folding binds between ticks
+                idx = rng.integers(0, node["alloc"].shape[0], 2)
+                node["used_req"][idx, R.CPU] += int(rng.integers(0, 500))
+                req = SolveRequest(
+                    node={k: v.copy() for k, v in node.items()},
+                    params=params,
+                    pods=_pods(int(rng.integers(1, 9)),
+                               seed=tick * 10 + int(t[1])),
+                )
+                req.admission = {"tenant": tenant_wire_value(t)}
+                requests.append(req)
+            want_state = tick % 2 == 0
+            got = solve_tenant_lanes(requests, want_state=want_state)
+            for i, r in enumerate(requests):
+                want = solve_from_request(r)
+                np.testing.assert_array_equal(
+                    want.assignments, got[i].assignments,
+                    err_msg=f"tick {tick} tenant {i}",
+                )
+                if want_state:
+                    np.testing.assert_array_equal(
+                        want.node_used_req, got[i].node_used_req,
+                        err_msg=f"tick {tick} tenant {i} used_req",
+                    )
+
+    def test_zero_recompiles_on_join_leave_within_bucket(self, xla_compiles):
+        """Satellite: a warmed multi-tenant dispatch performs ZERO XLA
+        recompiles across tenant join/leave within a shape bucket —
+        the lane count pads to its bucket, worlds to the node bucket,
+        pods to the pod bucket, so K drifting inside the bucket reuses
+        one compiled program."""
+        from koordinator_tpu.service.tenancy import lane_shard_count
+
+        shards = lane_shard_count()
+
+        def reqs(k):
+            return [
+                _request(tenant=f"t{i}", n_nodes=9 + (i % 2),
+                         n_pods=3 + (i % 4), seed=60 + i, pod_seed=i)
+                for i in range(k)
+            ]
+
+        # warm at k=2: the lane bucket covers every k up to its width
+        kb = lane_bucket(2, shards)
+        solve_tenant_lanes(reqs(2))
+        xla_compiles.clear()
+        for k in (3, min(kb, 4), 2, min(kb, 5)):
+            out = solve_tenant_lanes(reqs(k))
+            assert len(out) == k
+        assert xla_compiles == [], (
+            "tenant join/leave inside the bucket recompiled: "
+            + "; ".join(xla_compiles)
+        )
+
+    def test_lane_bucket_family(self):
+        assert lane_bucket(1, 1) == 1
+        assert lane_bucket(3, 1) == 4
+        assert lane_bucket(5, 8) == 8
+        assert lane_bucket(9, 8) == 16
+        assert pod_bucket(5) == 8
+        assert node_bucket(9) == 10
+
+
+# -- weighted-fair allocation ------------------------------------------------
+
+class TestFairness:
+    def test_fair_share_proportional(self):
+        shares = fair_share(100, {"a": 1.0, "b": 1.0, "c": 2.0})
+        assert shares == {"a": 25, "b": 25, "c": 50}
+        assert fair_share(2, {"a": 1.0, "b": 1.0, "c": 1.0})["a"] == 1
+
+    def test_allocate_fair_lanes_weighted(self):
+        cands = {
+            "a": [("a", i) for i in range(8)],
+            "b": [("b", i) for i in range(8)],
+            "c": [("c", i) for i in range(8)],
+        }
+        weights = {"a": 1.0, "b": 1.0, "c": 2.0}
+        take = allocate_fair_lanes(
+            cands, weights.__getitem__, budget=8, room=10**9,
+            pods_of=lambda e: 1,
+        )
+        by_tenant = {t: sum(1 for e in take if e[0] == t)
+                     for t in ("a", "b", "c")}
+        assert by_tenant == {"a": 2, "b": 2, "c": 4}
+        # FIFO preserved inside each tenant
+        assert [e[1] for e in take if e[0] == "c"] == [0, 1, 2, 3]
+
+    def test_allocate_fair_lanes_respects_room(self):
+        cands = {"a": [4, 4, 4], "b": [2, 2, 2]}
+        take = allocate_fair_lanes(
+            cands, lambda t: 1.0, budget=10, room=8,
+            pods_of=lambda e: e,
+        )
+        assert sum(take) <= 8
+
+    def test_allocate_preloaded_counts(self):
+        # a batch head already granted to "a" shifts the next grants
+        cands = {"a": ["a1"], "b": ["b1"]}
+        take = allocate_fair_lanes(
+            cands, lambda t: 1.0, budget=1, room=10,
+            pods_of=lambda e: 1, preloaded={"a": 1},
+        )
+        assert take == ["b1"]
+
+    def test_smoke_fair_share_shed_protects_other_tenant(self):
+        """Isolation under overload: tenant B's queued work, within its
+        fair share, can NOT be evicted by tenant A's higher-lane
+        arrival — A is refused instead (pre-tenancy policy would have
+        evicted B)."""
+        solve = _BlockingSolve()
+        gate = AdmissionGate(solve, AdmissionConfig(capacity=2))
+        try:
+            blocker = gate.submit(_solo_request(0, tenant="a"), None)
+            assert solve.entered.wait(5)
+            b_be = gate.submit(
+                _solo_request(1, tenant="b",
+                              admission={"lane": np.asarray(LANE_BE)}),
+                None,
+            )
+            a_ls = gate.submit(
+                _solo_request(2, tenant="a",
+                              admission={"lane": np.asarray(LANE_LS)}),
+                None,
+            )
+            # queue full (b_be + a_ls); A's LS arrival outranks B's BE
+            # entry, but B (queued 1 = its share of 2) is protected
+            a_more = gate.submit(
+                _solo_request(3, tenant="a",
+                              admission={"lane": np.asarray(LANE_LS)}),
+                None,
+            )
+            refused = a_more.wait(5)
+            assert refused.error.startswith("overloaded")
+            solve.release.set()
+            assert b_be.wait(10).error == ""
+            assert a_ls.wait(10).error == ""
+            st = gate.stats()
+            assert st["tenants"]["a"]["shed_overloaded"] == 1
+            assert st["tenants"]["b"]["shed_overloaded"] == 0
+        finally:
+            solve.release.set()
+            gate.shutdown(timeout=2)
+
+    def test_own_tenant_burst_sheds_itself(self):
+        """A tenant flooding BE work sheds its OWN newest entries when
+        a higher lane of the same tenant arrives — single-tenant
+        behavior is unchanged by the fair-share rule."""
+        solve = _BlockingSolve()
+        gate = AdmissionGate(solve, AdmissionConfig(capacity=2))
+        try:
+            blocker = gate.submit(_solo_request(0, tenant="a"), None)
+            assert solve.entered.wait(5)
+            old = gate.submit(
+                _solo_request(1, tenant="a",
+                              admission={"lane": np.asarray(LANE_BE)}),
+                None,
+            )
+            new = gate.submit(
+                _solo_request(2, tenant="a",
+                              admission={"lane": np.asarray(LANE_BE)}),
+                None,
+            )
+            ls = gate.submit(
+                _solo_request(3, tenant="a",
+                              admission={"lane": np.asarray(LANE_LS)}),
+                None,
+            )
+            shed = new.wait(5)
+            assert shed is not None and shed.error.startswith("overloaded")
+            solve.release.set()
+            assert old.wait(10).error == ""
+            assert ls.wait(10).error == ""
+        finally:
+            solve.release.set()
+            gate.shutdown(timeout=2)
+
+
+# -- the gate's cross-tenant batching ---------------------------------------
+
+class TestGateLaneBatching:
+    def test_smoke_cross_tenant_one_dispatch(self):
+        """K tenants' same-bucket plain requests queued behind a
+        blocker drain as ONE multi-base lane dispatch, each response
+        bit-identical to that tenant solving solo."""
+        solve = _BlockingSolve()
+        gate = AdmissionGate(
+            solve, AdmissionConfig(capacity=32, max_coalesce=8)
+        )
+        try:
+            blocker = gate.submit(_solo_request(0), None)
+            assert solve.entered.wait(5)
+            requests = [
+                _request(tenant=f"t{i}", n_nodes=9 + (i % 2), n_pods=3 + i,
+                         seed=20 + i, pod_seed=70 + i)
+                for i in range(4)
+            ]
+            entries = [gate.submit(r, None) for r in requests]
+            solve.release.set()
+            responses = [e.wait(30) for e in entries]
+            for r, req in zip(responses, requests):
+                assert r.error == ""
+                np.testing.assert_array_equal(
+                    r.assignments, solve_from_request(req).assignments
+                )
+            st = gate.stats()
+            assert st["requests_total"] == 5
+            assert st["batches_total"] == 2  # blocker + one lane batch
+            assert st["lane_batches_total"] == 1
+            assert st["lane_requests_total"] == 4
+            for i in range(4):
+                assert st["tenants"][f"t{i}"]["lane_batched"] == 1
+        finally:
+            solve.release.set()
+            gate.shutdown(timeout=2)
+
+    def test_tenant_lanes_off_no_cross_tenant_batch(self):
+        solve = _BlockingSolve()
+        gate = AdmissionGate(
+            solve,
+            AdmissionConfig(capacity=32, max_coalesce=8,
+                            tenant_lanes=False, coalesce_window_s=0.0),
+        )
+        try:
+            blocker = gate.submit(_solo_request(0), None)
+            assert solve.entered.wait(5)
+            entries = [
+                gate.submit(_request(tenant=f"t{i}", seed=30 + i), None)
+                for i in range(3)
+            ]
+            solve.release.set()
+            for e in entries:
+                assert e.wait(30).error == ""
+            st = gate.stats()
+            assert st["lane_batches_total"] == 0
+            # 3 different tenants -> 3 separate dispatches
+            assert st["batches_total"] == 4
+        finally:
+            solve.release.set()
+            gate.shutdown(timeout=2)
+
+    def test_same_tenant_still_coalesces_same_base(self):
+        """Within one tenant, byte-identical bases keep the cheaper
+        shared-base coalesce path (one staged world, K pod lanes)."""
+        solve = _BlockingSolve()
+        gate = AdmissionGate(
+            solve, AdmissionConfig(capacity=32, max_coalesce=8)
+        )
+        try:
+            blocker = gate.submit(_solo_request(0), None)
+            assert solve.entered.wait(5)
+            same = [
+                _request(tenant="team-a", n_nodes=8, seed=9,
+                         n_pods=3 + i, pod_seed=50 + i)
+                for i in range(3)
+            ]
+            entries = [gate.submit(r, None) for r in same]
+            solve.release.set()
+            for e, req in zip(entries, same):
+                got = e.wait(30)
+                assert got.error == ""
+                np.testing.assert_array_equal(
+                    got.assignments, solve_from_request(req).assignments
+                )
+            st = gate.stats()
+            assert st["coalesced_requests_total"] == 3
+            assert st["lane_batches_total"] == 0
+        finally:
+            solve.release.set()
+            gate.shutdown(timeout=2)
+
+
+# -- per-tenant epoch fencing over the wire ---------------------------------
+
+class TestPerTenantEpochs:
+    def _full_request(self, tenant, node, params, pods, epoch):
+        req = SolveRequest(
+            node=node, params=params, pods=pods,
+            node_delta={"epoch": np.asarray(epoch, np.int64)},
+        )
+        req.admission = {"tenant": tenant_wire_value(tenant)}
+        return req
+
+    def _delta_request(self, tenant, pods, idx, rows, base, epoch):
+        delta = {
+            "idx": np.asarray(idx, np.int32),
+            "base_epoch": np.asarray(base, np.int64),
+            "epoch": np.asarray(epoch, np.int64),
+        }
+        delta.update(rows)
+        req = SolveRequest(node={}, params=self._params, pods=pods,
+                           node_delta=delta)
+        req.admission = {"tenant": tenant_wire_value(tenant)}
+        return req
+
+    def test_epoch_chains_independent_per_tenant(self, tmp_path):
+        """Two tenants multiplexed over ONE connection keep independent
+        delta bases: establishing/advancing tenant A's epoch chain
+        neither advances nor invalidates tenant B's, mismatches are
+        per-tenant, and every delta solve equals the equivalent full
+        solve."""
+        from koordinator_tpu.service.client import PlacementClient
+
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        service.start()
+        try:
+            worlds = {
+                "a": _world(10, seed=1),
+                "b": _world(10, seed=2),
+            }
+            pods = _pods(4, seed=5)
+            self._params = worlds["a"][1]
+            with PlacementClient(addr, timeout=60.0) as client:
+                # establish both tenants' bases at different epochs
+                for tenant, epoch in (("a", 100), ("b", 200)):
+                    node, params = worlds[tenant]
+                    self._params = params
+                    resp = client.solve(self._full_request(
+                        tenant, node, params, pods, epoch
+                    ))
+                    assert resp.error == ""
+                # tenant a advances 100 -> 101 with a row patch; b's
+                # chain (still at 200) must be untouched
+                node_a, params_a = worlds["a"]
+                rows = {
+                    f: np.asarray(node_a[f][:1])
+                    for f in node_a
+                }
+                rows["used_req"] = rows["used_req"].copy()
+                rows["used_req"][0, R.CPU] += 1000
+                self._params = params_a
+                resp = client.solve(self._delta_request(
+                    "a", pods, [0], rows, base=100, epoch=101
+                ))
+                assert resp.error == ""
+                # the delta solve equals the full solve of the patched
+                # world (bit-identity of the per-tenant chain)
+                node_patched = {k: v.copy() for k, v in node_a.items()}
+                node_patched["used_req"][0, R.CPU] += 1000
+                want = solve_from_request(SolveRequest(
+                    node=node_patched, params=params_a, pods=pods
+                ))
+                np.testing.assert_array_equal(
+                    resp.assignments, want.assignments
+                )
+                # a delta against tenant b's OLD epoch under tenant a's
+                # id is a per-tenant mismatch (a holds 101, not 200)
+                with pytest.raises(RuntimeError, match="delta-base-mismatch"):
+                    client.solve(self._delta_request(
+                        "a", pods, [0], rows, base=200, epoch=201
+                    ))
+                # tenant b's chain is still alive at 200
+                node_b, params_b = worlds["b"]
+                self._params = params_b
+                resp_b = client.solve(self._delta_request(
+                    "b", pods, [], {
+                        f: np.asarray(node_b[f][:0]) for f in node_b
+                    }, base=200, epoch=201
+                ))
+                assert resp_b.error == ""
+        finally:
+            service.stop()
+
+
+class TestDeltaLaneBatching:
+    """The steady-state serving shape: per-tick DELTA requests from K
+    tenants — kilobytes of wire against per-tenant staged bases —
+    batched as lanes of one dispatch."""
+
+    def _establish(self, client, tenant, node, params, pods, epoch):
+        req = SolveRequest(
+            node=node, params=params, pods=pods,
+            node_delta={"epoch": np.asarray(epoch, np.int64)},
+        )
+        req.admission = {"tenant": tenant_wire_value(tenant)}
+        resp = client.solve(req)
+        assert resp.error == ""
+
+    def _delta(self, tenant, node, params, pods, idx, base, epoch):
+        rows = {f: np.asarray(node[f][idx]) for f in node}
+        delta = {
+            "idx": np.asarray(idx, np.int32),
+            "base_epoch": np.asarray(base, np.int64),
+            "epoch": np.asarray(epoch, np.int64),
+        }
+        delta.update(rows)
+        req = SolveRequest(node={}, params=params, pods=pods,
+                           node_delta=delta)
+        req.admission = {"tenant": tenant_wire_value(tenant)}
+        return req
+
+    def test_smoke_delta_ticks_batch_as_lanes(self, tmp_path, xla_compiles):
+        """Three tenants' concurrent delta ticks — separate
+        connections, separate staged bases, one shape bucket — drain as
+        ONE lane batch, each lane bit-identical to the equivalent full
+        solve of that tenant's patched world, with ZERO XLA recompiles
+        on the steady-state rounds."""
+        from koordinator_tpu.service.client import PlacementClient
+
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        service.start()
+        n_tenants = 3
+        try:
+            worlds = {i: _world(9 + (i % 2), seed=80 + i)
+                      for i in range(n_tenants)}
+            pods = _pods(4, seed=9)
+            clients = [
+                PlacementClient(addr, timeout=60.0)
+                for _ in range(n_tenants)
+            ]
+            for i, c in enumerate(clients):
+                node, params = worlds[i]
+                self._establish(c, f"t{i}", node, params, pods, epoch=0)
+
+            def tick(r):
+                """One concurrent delta round; returns per-tenant
+                responses (executor pinned so the ticks queue and
+                batch)."""
+                inner = service.gate._solve_fn
+                hold = threading.Event()
+
+                def slow(request, config, node_cache):
+                    hold.wait(10)
+                    return inner(request, config, node_cache)
+
+                service.gate._solve_fn = slow
+                try:
+                    with PlacementClient(addr, timeout=60.0) as blocker:
+                        result = {}
+
+                        def block():
+                            # an establish request rides solo (it is
+                            # not a pure delta) yet real-solves cleanly
+                            result["b"] = blocker.solve(_request(
+                                tenant="blocker", seed=123,
+                                node_delta={
+                                    "epoch": np.asarray(0, np.int64)
+                                },
+                            ))
+
+                        bt = threading.Thread(target=block)
+                        bt.start()
+                        time.sleep(0.2)  # the blocker pins the executor
+                        responses = {}
+                        errors = []
+
+                        def send(i):
+                            node, params = worlds[i]
+                            idx = np.asarray([r % node["alloc"].shape[0]])
+                            node["used_req"][idx, R.CPU] += 100 * (r + 1)
+                            try:
+                                responses[i] = clients[i].solve(self._delta(
+                                    f"t{i}", node, params, pods, idx,
+                                    base=r, epoch=r + 1,
+                                ))
+                            except Exception as e:  # noqa: BLE001
+                                errors.append(e)
+
+                        threads = [
+                            threading.Thread(target=send, args=(i,))
+                            for i in range(n_tenants)
+                        ]
+                        for t in threads:
+                            t.start()
+                        time.sleep(0.3)  # let every tick queue
+                        hold.set()
+                        for t in threads:
+                            t.join(timeout=30)
+                        bt.join(timeout=30)
+                        assert not errors, errors
+                        assert result["b"].error == ""
+                        return responses
+                finally:
+                    service.gate._solve_fn = inner
+                    hold.set()
+
+            before = service.gate.stats()["lane_batches_total"]
+            first = tick(0)
+            # round 1 solved from freshly-established single-device
+            # bases; its output hands every cache a mesh-resident lane
+            # slice, so round 2 compiles the staging ops once more for
+            # the settled sharding layout — rounds 3+ are the steady
+            # state the zero-recompile contract covers
+            tick(1)
+            xla_compiles.clear()
+            second = tick(2)  # steady state: zero recompiles
+            assert xla_compiles == [], xla_compiles
+            st = service.gate.stats()
+            assert st["lane_batches_total"] >= before + 3
+            # bit-identity: each batched delta tick equals the full
+            # solve of that tenant's patched world
+            for i in range(n_tenants):
+                node, params = worlds[i]
+                want = solve_from_request(SolveRequest(
+                    node=node, params=params, pods=pods
+                ))
+                got = second[i]
+                assert got.error == ""
+                np.testing.assert_array_equal(
+                    got.assignments, want.assignments, err_msg=f"tenant {i}"
+                )
+            # epochs advanced independently: a solo delta against the
+            # latest epoch succeeds per tenant
+            for i, c in enumerate(clients):
+                node, params = worlds[i]
+                resp = c.solve(self._delta(
+                    f"t{i}", node, params, pods,
+                    np.asarray([0]), base=3, epoch=4,
+                ))
+                assert resp.error == ""
+            for c in clients:
+                c.close()
+        finally:
+            service.stop()
+
+
+class TestConnectionCacheBound:
+    def test_connection_tenant_caches_lru_bounded(self, tmp_path):
+        """One connection cycling tenant ids cannot pin unbounded
+        staged worlds: past the per-connection cap the LRU tenant's
+        base is evicted, and its next delta self-heals through the
+        typed ``delta-base-mismatch`` re-establish path."""
+        from koordinator_tpu.service.client import PlacementClient
+
+        addr = str(tmp_path / "solver.sock")
+        service = PlacementService(addr)
+        service.start()
+        try:
+            node, params = _world(8, seed=5)
+            pods = _pods(3, seed=5)
+
+            def establish(client, tenant):
+                req = SolveRequest(
+                    node={k: v.copy() for k, v in node.items()},
+                    params=params, pods=pods,
+                    node_delta={"epoch": np.asarray(7, np.int64)},
+                )
+                req.admission = {"tenant": tenant_wire_value(tenant)}
+                assert client.solve(req).error == ""
+
+            def empty_delta(client, tenant):
+                delta = {
+                    "idx": np.asarray([], np.int32),
+                    "base_epoch": np.asarray(7, np.int64),
+                    "epoch": np.asarray(8, np.int64),
+                    **{f: np.asarray(node[f][:0]) for f in node},
+                }
+                req = SolveRequest(node={}, params=params, pods=pods,
+                                   node_delta=delta)
+                req.admission = {"tenant": tenant_wire_value(tenant)}
+                return client.solve(req)
+
+            with PlacementClient(addr, timeout=60.0) as c:
+                establish(c, "keeper")
+                # churn far past the 32-tenant per-connection cap
+                for i in range(40):
+                    establish(c, f"churn-{i}")
+                # the LRU victim ("keeper") lost its base: typed
+                # mismatch, not silence and not someone else's state
+                with pytest.raises(RuntimeError,
+                                   match="delta-base-mismatch"):
+                    empty_delta(c, "keeper")
+                # a recent tenant's chain is intact
+                assert empty_delta(c, "churn-39").error == ""
+                # and keeper re-establishes cleanly (the self-heal)
+                establish(c, "keeper")
+                assert empty_delta(c, "keeper").error == ""
+        finally:
+            service.stop()
+
+
+# -- status / metrics --------------------------------------------------------
+
+class TestObservability:
+    def test_status_and_metrics_keyed_by_tenant(self, tmp_path):
+        from koordinator_tpu.metrics.components import SOLVER_METRICS
+        from koordinator_tpu.service.client import PlacementClient
+
+        addr = str(tmp_path / "solver.sock")
+        registry = TenantRegistry({"team-a": 2.0})
+        service = PlacementService(addr, tenants=registry)
+        service.start()
+        try:
+            with PlacementClient(addr, timeout=60.0) as client:
+                for tenant in ("team-a", "team-b"):
+                    resp = client.solve(_request(tenant=tenant, seed=4))
+                    assert resp.error == ""
+            st = service.status()["admission"]
+            assert set(st["tenants"]) >= {"team-a", "team-b"}
+            assert st["tenants"]["team-a"]["dispatched"] == 1
+            assert st["tenants"]["team-a"]["weight"] == 2.0
+            assert st["tenants"]["team-b"]["weight"] == 1.0
+            text = SOLVER_METRICS.gather()
+            assert 'tenant="team-a"' in text
+            assert 'tenant="team-b"' in text
+        finally:
+            service.stop()

@@ -32,10 +32,13 @@ with ``parsed``, (c) a wrapper whose ``tail`` holds the JSON line, or
 (d) — salvage mode — a wrapper whose tail is front-truncated: every
 balanced ``"leg": {...}`` object still present is recovered, so old
 records remain diffable. Budget files map legs to dotted metric paths
-with ``min``/``max`` bounds::
+with ``min``/``max`` bounds, or ``equals`` for exact values —
+including booleans, so identity/acceptance flags can be pinned by a
+budget and not only by record-to-record flip detection::
 
     {"13_pipelined_churn_5k": {"round_p99_s": {"max": 0.02},
-                               "device.padding_waste_ratio": {"max": 0.95}}}
+                               "device.padding_waste_ratio": {"max": 0.95}},
+     "16_multi_tenant_pool": {"tenants_identical_to_solo": {"equals": true}}}
 
 Exit codes: 0 clean, 1 regressions, 2 usage/load errors.
 Stdlib-only by design — the gate must run anywhere, jax or not.
@@ -257,6 +260,16 @@ def compare_budget(budget: dict, new: dict) -> List[dict]:
         flat = _flatten(source)
         for key, bound in metrics.items():
             val = flat.get(key)
+            if "equals" in bound:
+                # exact-value bounds: identity/acceptance FLAGS a budget
+                # must hold (e.g. {"equals": true} on a bit-identity
+                # flag), beside the numeric min/max family
+                rows.append({
+                    "leg": leg, "metric": key, "old": bound, "new": val,
+                    "verdict": ("ok" if val == bound["equals"]
+                                else "REGRESSION"),
+                })
+                continue
             if not isinstance(val, (int, float)) or isinstance(val, bool):
                 rows.append({"leg": leg, "metric": key, "old": bound,
                              "new": val, "verdict": "REGRESSION"})

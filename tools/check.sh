@@ -55,6 +55,14 @@ echo "== sharded smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_shard_staging.py \
     -q -k "smoke or non_pow2" -p no:cacheprovider
 
+echo "== multi-tenant smoke =="
+# the multi-tenant pool slice (ISSUE 11): cross-tenant lane batches —
+# plain and wire-delta — must stay bit-identical to each tenant
+# solving solo, one gate dispatch per batch, and fair-share shedding
+# must protect a within-share tenant from another tenant's burst
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_tenancy.py \
+    -q -k smoke -p no:cacheprovider
+
 echo "== bench diff smoke =="
 # the perf regression gate's own health check: a record diffed against
 # itself must pass clean (exit 0) — proves the loader handles the
@@ -62,13 +70,15 @@ echo "== bench diff smoke =="
 # that no comparator fires on identical inputs
 python tools/bench_diff.py BENCH_r05.json BENCH_r05.json
 
-echo "== sharded bench budgets =="
-# the measured sharded legs are budget-gated (ISSUE 10): a scaling or
-# merge-overhead regression in the committed record fails loudly.
-# (BENCH_vcpu_r06.json is the committed virtual-CPU-mesh record — legs
-# 14/15 always run on the forced 8-device virtual mesh, so these
-# budgets stay comparable whatever hardware records the r-series.)
-python tools/bench_diff.py --budget tools/bench_budgets.json BENCH_vcpu_r06.json
+echo "== sharded + multi-tenant bench budgets =="
+# the measured sharded/multi-tenant legs are budget-gated (ISSUES
+# 10/11): a scaling, merge-overhead, pool-throughput, or per-tenant
+# p99 regression in the committed record fails loudly.
+# (BENCH_vcpu_r07.json is the committed virtual-CPU-mesh record — legs
+# 14/14b/15/16 always run on the forced 8-device virtual mesh, so
+# these budgets stay comparable whatever hardware records the
+# r-series; r06 remains for history.)
+python tools/bench_diff.py --budget tools/bench_budgets.json BENCH_vcpu_r07.json
 
 echo "== device observatory smoke =="
 # the device-cost layer: compile telemetry + padding gauges must be
