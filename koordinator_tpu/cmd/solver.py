@@ -58,6 +58,11 @@ def main(argv=None) -> int:
         help="on-demand jax profiler window directory (default: "
              "$KTPU_PROFILE_DIR or <tmp>/koord-profile)",
     )
+    parser.add_argument(
+        "--no-warm-pool", action="store_true",
+        help="disable the AOT warm pool: a respawned sidecar then "
+             "pays the cold trace + compile on its first solve again",
+    )
     args = parser.parse_args(argv)
 
     # before the first jit: a restarted sidecar deserializes its
@@ -67,6 +72,29 @@ def main(argv=None) -> int:
     )
 
     enable_persistent_cache()
+
+    warm_pool = None
+    if not args.no_warm_pool:
+        # the AOT warm pool (docs/DESIGN.md §21): a supervisor-respawned
+        # sidecar restores the manifest's executables SEQUENTIALLY,
+        # BEFORE the server stack imports and before the listen socket
+        # opens — (a) deserialization right after interpreter start
+        # measures ~2x cheaper than after the full stack is imported
+        # (cmd/scheduler.py main's ordering), and (b) a restore racing
+        # the first reconnecting client's solve would cold-compile the
+        # very request the warm respawn exists to answer. The
+        # supervisor's ready grace covers the extra boot second; the
+        # background persister then keeps the store covering newly
+        # observed signatures so the NEXT respawn (and the scheduler's
+        # failover twin, which shares the store) stays warm. Inert
+        # when the cache dir is disabled.
+        from koordinator_tpu.service.warmpool import WARM_POOL
+
+        WARM_POOL.configure()
+        if WARM_POOL.active:
+            warm_pool = WARM_POOL
+            WARM_POOL.restore(compile_missing=False)
+            WARM_POOL.start_background()
 
     from koordinator_tpu.service.server import PlacementService
 
@@ -107,6 +135,10 @@ def main(argv=None) -> int:
         services.register("solver", service.status)
         services.register("trace", TRACER.status)
         services.register("device-observatory", DEVICE_OBS.status)
+        if warm_pool is not None:
+            # warm-pool health beside the breaker/gate state: did this
+            # respawn skip its compiles, is the store clean (§21)
+            services.register("warm-pool", warm_pool.status)
         debug_server = DebugHTTPServer(
             services=services,
             metrics=MergedGatherer([SOLVER_METRICS, DEVICE_METRICS]),
@@ -126,6 +158,8 @@ def main(argv=None) -> int:
         service.stop()
         if debug_server is not None:
             debug_server.stop()
+        if warm_pool is not None:
+            warm_pool.stop_background()
 
 
 if __name__ == "__main__":

@@ -67,6 +67,12 @@ SUPERVISOR_RESTARTS = SCHEDULER_METRICS.counter(
     "Sidecar restarts performed by the supervisor",
     label_names=("reason",),  # crashed | hung | down
 )
+SUPERVISOR_RESPAWN_WARM = SCHEDULER_METRICS.counter(
+    "solver_supervisor_respawn_warm_total",
+    "Supervised child (re)spawns that warm-restored from the AOT pool "
+    "— probed on the tight warm ready grace instead of the "
+    "cold-compile allowance (docs/DESIGN.md §21)",
+)
 SUPERVISOR_UP = SCHEDULER_METRICS.gauge(
     "solver_supervisor_child_up",
     "1 while the supervised sidecar passes liveness probes",
@@ -229,6 +235,44 @@ DEVICE_PROFILE_WINDOWS = DEVICE_METRICS.counter(
     "solver_device_profile_windows_total",
     "On-demand jax profiler windows, by outcome",
     label_names=("result",),  # written | error | rate-limited | refused
+)
+
+# -- AOT warm pool (service/warmpool.py, docs/DESIGN.md §21) ----------------
+# The restart/promotion/failover warm path's health. These live in the
+# DEVICE registry because BOTH long-lived processes restore from the
+# pool — the scheduler (leader promotion, the failover twin) and the
+# solver sidecar (supervisor respawns) — and each already merges this
+# registry into its /metrics.
+
+WARM_POOL_HITS = DEVICE_METRICS.counter(
+    "scheduler_warm_pool_hits_total",
+    "Executable-store loads that served a deserialized AOT program "
+    "(a recovery path that skipped trace + compile)",
+)
+WARM_POOL_MISSES = DEVICE_METRICS.counter(
+    "scheduler_warm_pool_misses_total",
+    "Clean executable-store misses (no entry for the key) that fell "
+    "back to cold compile",
+)
+WARM_POOL_REJECTS = DEVICE_METRICS.counter(
+    "scheduler_warm_pool_rejects_total",
+    "Executable-store entries REFUSED by the rejection ladder, by "
+    "typed reason — every reject degrades that shape to a loud cold "
+    "compile, never a crash and never a stale-executable solve",
+    # truncated | corrupt | fingerprint | oversized | stale-host |
+    # version-skew
+    label_names=("reason",),
+)
+WARM_RESTORE_SECONDS = DEVICE_METRICS.histogram(
+    "scheduler_warm_restore_seconds",
+    "Wall-clock per warm-pool restore pass (boot, leader promotion, "
+    "failover prewarm): manifest read + executable deserialization",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0),
+)
+WARM_POOL_QUARANTINED = DEVICE_METRICS.counter(
+    "scheduler_warm_pool_quarantined_total",
+    "Store entries (or manifests) moved aside after a typed load "
+    "failure — never retried in a loop, never a crash",
 )
 
 # -- koordlet (pkg/koordlet/metrics: internal + external sets) --------------

@@ -732,6 +732,15 @@ class PlacementModel:
         self._solve = DEVICE_OBS.jit("solve_batch", jax.jit(
             solve_batch, static_argnames=("config",), donate_argnums=()
         ))
+        # AOT warm pool (docs/DESIGN.md §21): a promoted/restarted
+        # control plane restores this binding's hot signatures from
+        # disk instead of re-tracing + recompiling. Adoption is legal
+        # only because the binding never donates (§19.2: donated
+        # executables replayed from a store mis-alias their outputs);
+        # graftcheck's donation rule pins that at every adopt site.
+        from koordinator_tpu.service.warmpool import WARM_POOL
+
+        WARM_POOL.adopt(self._solve, solve_batch, config_argpos=3)
         #: device-resident staging reused across schedule() calls when
         #: the snapshot carries a ClusterDeltaTracker (steady-state
         #: ticks re-lower + re-upload only the dirty node rows)

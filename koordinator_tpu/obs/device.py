@@ -79,6 +79,16 @@ _RING_CAPACITY = 256
 _MAX_ANALYSES = 64
 #: un-analyzed signatures queued for the next analyze() pass
 _MAX_PENDING = 64
+#: (fn, signature) aval pairs retained for the warm-pool manifest
+#: (docs/DESIGN.md §21) — the "active shape-bucket set": which jit
+#: signatures are hot, with enough aval metadata to AOT-recompile them
+#: in a fresh process. Unlike _pending these are NOT consumed by
+#: analyze(); beyond the cap new variants are counted but not retained
+_MAX_WARM = 64
+
+#: sentinel the warm pool's serve() returns on a miss (any real solve
+#: result — including None-free pytrees — must be distinguishable)
+WARM_MISS = object()
 
 _NULL_CTX = nullcontext()
 
@@ -235,15 +245,32 @@ class ObservedJit:
     mutable state of its own — everything lives in the observatory
     under its lock."""
 
-    __slots__ = ("fn_name", "_fn", "_obs", "_size_fn")
+    __slots__ = ("fn_name", "_fn", "_obs", "_size_fn", "_warm")
 
     def __init__(self, fn_name: str, fn, obs: "DeviceObservatory"):
         self.fn_name = fn_name
         self._fn = fn
         self._obs = obs
         self._size_fn = getattr(fn, "_cache_size", None)
+        #: warm pool this binding is adopted into (service/warmpool.
+        #: WarmPool.adopt) — set-once wiring at construction time, read
+        #: per call without a lock like ``enabled``. None = not warm.
+        self._warm = None
 
     def __call__(self, *args, **kwargs):
+        warm = self._warm
+        if warm is not None and warm.serving:
+            # warm-pool fast path: a restored AOT executable answers
+            # the call with zero tracing and zero compilation — the
+            # restart/promotion/degraded-flip paths' whole point. A
+            # miss (unknown signature, poisoned entry) falls through
+            # to the ordinary jit below. A warm-served call records no
+            # compile telemetry BY DESIGN — there was no compile —
+            # exactly like a warmed jit-cache hit; the pool's own
+            # hit/served counters are the warm path's observability.
+            out = warm.serve(self.fn_name, args, kwargs)
+            if out is not WARM_MISS:
+                return out
         obs = self._obs
         if not obs.enabled:
             return self._fn(*args, **kwargs)
@@ -317,6 +344,11 @@ class DeviceObservatory:
         #: analysis; bounded — beyond _MAX_PENDING new variants are
         #: counted but not queued
         self._pending: Dict = {}
+        #: (fn_name, sig) -> (aval_args, aval_kwargs): the warm-pool
+        #: manifest source (NOT consumed by analyze(); bounded by
+        #: _MAX_WARM) — a snapshot of which signatures are hot, with
+        #: the avals a fresh process needs to AOT-restore them
+        self._warm_avals: Dict = {}
         #: (fn_name, sig) -> {"cost": ..., "memory": ...} | {"error": ...}
         self._analyses: Dict = {}
         self._analysis_order: deque = deque()
@@ -441,6 +473,9 @@ class DeviceObservatory:
             if unseen and avals is not None \
                     and len(self._pending) < _MAX_PENDING:
                 self._pending[(fn_name, sig)] = (fn, avals[0], avals[1])
+            if unseen and avals is not None \
+                    and len(self._warm_avals) < _MAX_WARM:
+                self._warm_avals[(fn_name, sig)] = (avals[0], avals[1])
             self._seq += 1
             self._compiles_total += 1
             self._ring.append({
@@ -455,6 +490,20 @@ class DeviceObservatory:
         DEVICE_COMPILE_SECONDS.observe(wall, {"fn": fn_name})
         TRACER.instant("device-compile", cat="device",
                        args={"fn": fn_name, "compile_s": round(wall, 4)})
+
+    def warm_manifest(self) -> List[Tuple[str, tuple, dict]]:
+        """The active shape-bucket set for the warm pool (docs/DESIGN.md
+        §21): every observed (fn × aval-signature) pair as ``(fn_name,
+        aval_args, aval_kwargs)`` — exactly what a fresh process needs
+        to ``lower(*avals).compile()`` the hot programs before traffic
+        arrives. Statics (the solver config) ride in the aval tree by
+        value, arrays as ShapeDtypeStructs; nothing references live
+        buffers, so snapshotting is safe at any time."""
+        with self._lock:
+            return [
+                (fn_name, avals[0], avals[1])
+                for (fn_name, _sig), avals in self._warm_avals.items()
+            ]
 
     # -- cost & memory analysis ----------------------------------------------
 
@@ -799,6 +848,7 @@ class DeviceObservatory:
             self._fn_cache_sizes.clear()
             self._ring.clear()
             self._pending.clear()
+            self._warm_avals.clear()
             self._analyses.clear()
             self._analysis_order.clear()
             self._padding.clear()

@@ -132,6 +132,15 @@ class FlightRecorder:
             device = DEVICE_OBS.flight_payload()
         except Exception as e:  # a dump must land even if jax is upset
             device = {"error": f"{type(e).__name__}: {e}"}
+        # the warm pool's cached counters (DESIGN §21): was the round
+        # that anomalied served warm or cold, and is the store healthy
+        # — counters only, a dump never compiles or touches the store
+        try:
+            from koordinator_tpu.service.warmpool import WARM_POOL
+
+            warm = WARM_POOL.flight_payload()
+        except Exception as e:
+            warm = {"error": f"{type(e).__name__}: {e}"}
         payload = {
             "trigger": reason,
             "at": at,
@@ -139,6 +148,7 @@ class FlightRecorder:
             "extra": extra,
             "rounds": rounds,
             "device": device,
+            "warm": warm,
             "open_spans": TRACER.status()["open_marks"],
             "trace_tail": TRACER.events(tail=_TRACE_TAIL),
         }

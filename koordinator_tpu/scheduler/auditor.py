@@ -99,13 +99,19 @@ class StateAuditor:
 
     def __init__(self, scheduler, bus=None, *, interval_rounds: int = 16,
                  probe_rows: int = 64, rebuild_threshold: int = 8,
-                 assume_ttl_s: float = 900.0):
+                 assume_ttl_s: float = 900.0, warm_pool=None):
         self.scheduler = scheduler
         self.bus = bus
         self.interval_rounds = int(interval_rounds)
         self.probe_rows = int(probe_rows)
         self.rebuild_threshold = int(rebuild_threshold)
         self.assume_ttl_s = float(assume_ttl_s)
+        #: AOT warm pool (service/warmpool.WarmPool, docs/DESIGN.md
+        #: §21): the promotion sweep restores its executables and the
+        #: staged world BEFORE the new leader's first solve, so a
+        #: failover never pays a cold XLA compile. Set-once wiring
+        #: (cmd/scheduler.py main), deliberately outside the lock map.
+        self.warm_pool = warm_pool
         self._lock = threading.RLock()
         self._promotion_pending = False
         self._rounds_since = 0
@@ -164,7 +170,50 @@ class StateAuditor:
         # detection's flight dump does file I/O, and holding the RLock
         # across it would block status() readers and the pipelined
         # loop's sweep_due() quiesce check behind the disk
-        return self.sweep(kind, now=now)
+        report = self.sweep(kind, now=now)
+        if kind == "promotion":
+            # warm restart (docs/DESIGN.md §21): AFTER the sweep's
+            # repairs (so the restored staged world reflects repaired
+            # truth, not the deposed leader's leavings), restore the
+            # warm pool's executables and eagerly re-stage the world —
+            # the new leader's first solve then skips trace + compile
+            # + full staging. Loads only: a corrupt store degrades the
+            # first solve to cold compile, it never blocks promotion.
+            # The published last_report is REPLACED, never mutated: a
+            # debug-mux reader serializing the sweep's dict must not
+            # see a key inserted mid-iteration.
+            warm = self._warm_restore(now=now)
+            report = dict(report)
+            report["warm"] = warm
+            with self._lock:
+                self.last_report = report
+        return report
+
+    def _warm_restore(self, now: Optional[float] = None) -> Optional[dict]:
+        """The promotion path's warm restore: pool executables from
+        disk (typed failures quarantine + count and leave that shape
+        cold) plus an eager staged-world prestage. Never raises — a
+        failed warm restore costs latency, never the round."""
+        out: dict = {}
+        if self.warm_pool is not None:
+            try:
+                out["pool"] = self.warm_pool.restore(compile_missing=False)
+            except Exception as e:  # pragma: no cover - defensive
+                out["pool"] = {"error": f"{type(e).__name__}: {e}"}
+        model = getattr(self.scheduler, "model", None)
+        cache = getattr(self.scheduler, "cache", None)
+        if model is not None and cache is not None and \
+                hasattr(model, "prestage"):
+            try:
+                t0 = time.perf_counter()
+                times = model.prestage(cache.snapshot(now=now))
+                out["prestage"] = {
+                    "wall_s": time.perf_counter() - t0,
+                    "times": times,
+                }
+            except Exception as e:
+                out["prestage"] = {"error": f"{type(e).__name__}: {e}"}
+        return out or None
 
     # -- the sweep -----------------------------------------------------------
 

@@ -64,6 +64,14 @@ from koordinator_tpu.service.supervisor import connection_probe
 _local_solve = DEVICE_OBS.jit("failover_local_solve", jax.jit(
     solve_batch, static_argnames=("config",), donate_argnums=()
 ))
+# warm pool (docs/DESIGN.md §21): the local twin shares solve_batch's
+# PROGRAM identity with the sidecar's binding, so signatures a running
+# sidecar persisted warm THIS binding in the scheduler process — the
+# first degraded-mode solve deserializes instead of cold-compiling.
+# Adoption is donation-free by construction (§19.2).
+from koordinator_tpu.service.warmpool import WARM_POOL  # noqa: E402
+
+WARM_POOL.adopt(_local_solve, solve_batch, config_argpos=3)
 
 
 class FailoverSolver:
@@ -78,7 +86,8 @@ class FailoverSolver:
                  probe_fn: Optional[Callable[[], bool]] = None,
                  probe_timeout_s: float = 0.5,
                  on_flip_back: Optional[Callable[[], None]] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 prewarm: bool = True):
         self._remote = remote
         self.failure_threshold = failure_threshold
         self.recovery_probes = recovery_probes
@@ -114,6 +123,42 @@ class FailoverSolver:
         #: "local-fallback" (remote tried and failed this solve) |
         #: "local-degraded" (machine flipped, remote not attempted)
         self.last_mode: Optional[str] = None
+        #: the local twin's warm restore report (set by the background
+        #: prewarm; set-once wiring like on_flip_back, read for status)
+        self.prewarm_report: Optional[dict] = None
+        if prewarm and self._warm_pool().active:
+            # pre-compile/pre-load the local twin NOW, in the
+            # background, so the first degraded-mode solve — the
+            # moment the watchdog used to flag — is warm instead of
+            # paying a multi-second cold compile (DESIGN §21)
+            self.prewarm()
+
+    @staticmethod
+    def _warm_pool():
+        """The pool the local twin is adopted into (tests re-adopt the
+        binding into their own pools; production uses the singleton)."""
+        return getattr(_local_solve, "_warm", None) or WARM_POOL
+
+    def prewarm(self, background: bool = True) -> Optional[dict]:
+        """Restore (or cold-compile, off-path) the local twin's hot
+        signatures from the warm pool's manifest. Synchronous when
+        ``background=False`` (tests)."""
+        pool = self._warm_pool()
+        if not background:
+            report = pool.restore(
+                fns=("failover_local_solve",), compile_missing=True,
+            )
+            self.prewarm_report = report
+            return report
+
+        def _go():
+            self.prewarm_report = pool.restore(
+                fns=("failover_local_solve",), compile_missing=True,
+            )
+
+        threading.Thread(target=_go, daemon=True,
+                         name="failover-prewarm").start()
+        return None
 
     # -- the backend call ----------------------------------------------------
 
@@ -268,4 +313,5 @@ class FailoverSolver:
                 "local_solves": self.local_solves,
                 "last_mode": self.last_mode,
                 "last_error": self.last_error,
+                "prewarm": self.prewarm_report,
             }

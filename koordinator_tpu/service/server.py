@@ -74,6 +74,14 @@ POD_FIELDS = (
 _jit_solve = DEVICE_OBS.jit("sidecar_solve_batch", jax.jit(
     solve_batch, static_argnames=("config",), donate_argnums=()
 ))
+# AOT warm pool (docs/DESIGN.md §21): a supervisor-respawned sidecar
+# restores this binding's executables at boot (cmd/solver.py), so its
+# first solve deserializes instead of re-tracing + recompiling; the
+# background persister keeps the store covering the hot signature set.
+# Donation-free by construction (§19.2) — graftcheck pins the adopt.
+from koordinator_tpu.service.warmpool import WARM_POOL  # noqa: E402
+
+WARM_POOL.adopt(_jit_solve, solve_batch, config_argpos=3)
 
 #: kernel routing availability, mirroring PlacementModel.use_pallas:
 #: None = decide at first solve (single TPU chip => on).
@@ -299,68 +307,18 @@ def _dispatch_solve(state, pods, params, config, quota, gang, extras,
         state, pods, params, config, quota, gang, extras, resv, numa
     )
 
-#: AOT warm-start: compiled executables persisted across process
-#: restarts (utils/compilation_cache.ExecutableCache) — a respawned
-#: sidecar's first solve deserializes instead of re-tracing+compiling
-_loaded_execs: dict = {}
-
-
-def _exec_cache():
-    from koordinator_tpu.utils.compilation_cache import ExecutableCache
-
-    return ExecutableCache()
-
-
-def _program_key(config, *groups) -> str:
-    """Program identity: every leaf's (path, shape, dtype) + the static
-    config — the same key means the same compiled executable."""
-    parts = [repr(tuple(config))]
-    for group in groups:
-        for path, leaf in jax.tree_util.tree_flatten_with_path(group)[0]:
-            parts.append(
-                f"{path}:{getattr(leaf, 'shape', ())}:"
-                f"{getattr(leaf, 'dtype', type(leaf).__name__)}"
-            )
-    return "|".join(parts)
-
-
 def _cached_solve(state, pods, params, config, quota, gang, extras, resv,
                   numa):
-    if len(jax.devices()) != 1:
-        # AOT executables pin device placement; the sidecar's production
-        # shape is one chip per process — multi-device processes use the
-        # plain jit cache
-        return _jit_solve(state, pods, params, config, quota, gang,
-                          extras, resv, numa)
-    key = _program_key(
-        config, state, pods, params, quota, gang, extras, resv, numa
-    )
-    entry = _loaded_execs.get(key)
-    if entry is None:
-        jit_fn = jax.jit(
-            lambda s, p, pr, q, g, x, r, n: solve_batch(
-                s, p, pr, config, q, g, x, r, n
-            ),
-            static_argnums=(), donate_argnums=(),
-        )
-        try:
-            fn = _exec_cache().get_or_compile(
-                key, jit_fn, state, pods, params, quota, gang, extras,
-                resv, numa,
-            )
-        except Exception:
-            fn = jit_fn  # AOT path is an optimization, never a gate
-        entry = _loaded_execs[key] = (fn, jit_fn)
-    fn, jit_fn = entry
-    try:
-        return fn(state, pods, params, quota, gang, extras, resv, numa)
-    except Exception:
-        # a stale/incompatible cached executable must not poison every
-        # solve for this shape: fall back to the jit path and memoize it
-        if fn is jit_fn:
-            raise
-        _loaded_execs[key] = (jit_fn, jit_fn)
-        return jit_fn(state, pods, params, quota, gang, extras, resv, numa)
+    """The scan-path solve behind the warm pool: the adopted
+    ``_jit_solve`` binding first consults the pool's restored AOT
+    executables (a respawned sidecar's warm store — zero trace, zero
+    compile, typed/quarantined load failures), and falls back to the
+    ordinary jit cache on any miss. The bespoke per-program
+    ``_loaded_execs`` machinery this replaces lives in
+    service/warmpool.py now, shared with the promotion and failover
+    warm paths (docs/DESIGN.md §21)."""
+    return _jit_solve(state, pods, params, config, quota, gang,
+                      extras, resv, numa)
 
 
 def _state_group(cls, group):
@@ -677,6 +635,19 @@ class PlacementService:
     def __init__(self, address, config: SolverConfig = SolverConfig(),
                  secret: Optional[bytes] = None,
                  admission=True, tenants=None):
+        # embedders constructing the service directly (no cmd entry
+        # point) keep the transparent AOT warm start the pre-pool
+        # in-module executable cache gave them: configure from the
+        # environment iff nothing configured the pool yet, restore
+        # SEQUENTIALLY (a background restore racing the first client's
+        # solve would cold-compile the very request a warm start
+        # exists to answer), and persist newly observed signatures.
+        # cmd/solver.py already did all of this — no-ops there; the
+        # test suite's empty cache dir keeps the pool inert.
+        WARM_POOL.ensure_configured()
+        if WARM_POOL.active:
+            WARM_POOL.restore(compile_missing=False)
+            WARM_POOL.start_background()
         self.address = address
         if isinstance(address, str):
             # a dead predecessor leaves its socket file behind; unlink it
@@ -747,6 +718,10 @@ class PlacementService:
             # lane-depth and coalesce stats (cached analyses only — a
             # status read never compiles)
             "device": DEVICE_OBS.status(),
+            # the AOT warm pool's health (DESIGN §21): did this
+            # sidecar's restart skip its compiles, and is the store
+            # clean (hit/miss/quarantine counters, last typed error)
+            "warm_pool": WARM_POOL.status(),
         }
 
     def stop(self) -> None:

@@ -569,6 +569,29 @@ def _stage_lanes(pairs, shards: int):
 
 
 def _solve_lanes(pairs, config, want_state: bool) -> List[SolveResponse]:
+    """Dispatch ``pairs`` as lanes, splitting into per-shard-sized
+    chunks on multi-device hosts (ROADMAP 2a / ISSUE 12): one stacked
+    dispatch carrying more lanes than the mesh has lane shards builds
+    an oversized multi-lane-per-device program — at 16+ tenants on the
+    8-virtual-device child the XLA:CPU mapping pressure segfaulted the
+    process outright. Chunks of exactly ``lane_shard_count()`` lanes
+    keep every dispatch at one lane per device; chunks within a shape
+    bucket reuse one compiled program, and per-lane results are
+    bit-identical either way (lanes are independent by construction)."""
+    shards = lane_shard_count()
+    if shards > 1 and len(pairs) > shards:
+        out: List[SolveResponse] = []
+        for i in range(0, len(pairs), shards):
+            out.extend(
+                _solve_lane_chunk(pairs[i:i + shards], config,
+                                  want_state, shards)
+            )
+        return out
+    return _solve_lane_chunk(pairs, config, want_state, shards)
+
+
+def _solve_lane_chunk(pairs, config, want_state: bool,
+                      shards: int) -> List[SolveResponse]:
     head = pairs[0][0]
     if config is None:
         config = SolverConfig()
@@ -576,7 +599,6 @@ def _solve_lanes(pairs, config, want_state: bool) -> List[SolveResponse]:
         from koordinator_tpu.service.server import _decode_config
 
         config = _decode_config(head.config)
-    shards = lane_shard_count()
     states, pods, params, counts, node_counts, kb = _stage_lanes(
         pairs, shards
     )

@@ -1,0 +1,785 @@
+"""The AOT warm pool: restart, promotion, and failover never pay a
+cold XLA compile (docs/DESIGN.md §21).
+
+PR 15's preflight proved an AOT executable round-trip works
+(``utils/compilation_cache.ExecutableCache``); this module promotes it
+to a first-class recovery path. The pool sits BEHIND the existing
+``DEVICE_OBS.jit`` bindings: a binding adopted via :meth:`WarmPool.
+adopt` first consults the pool's in-memory executable map — a restored
+entry answers the call with zero tracing and zero compilation — and
+falls back to the ordinary jit on any miss. Three recovery paths ride
+it:
+
+- **Leader promotion** (``StateAuditor.note_promotion`` → the
+  promotion sweep): the new leader synchronously loads the manifest's
+  executables from disk (loads only — a corrupt store degrades to
+  cold compile at the first solve, never blocks the promotion round)
+  and eagerly restores the staged world.
+- **Sidecar respawn** (``SolverSupervisor`` children): ``koord-solver``
+  restores sequentially at boot, before the listen socket opens, so a
+  respawned sidecar's first solve is answered by a restored
+  executable instead of re-tracing + recompiling (a background
+  restore would race the first reconnecting client's solve).
+- **Degraded-mode flips** (``FailoverSolver``): the local twin is
+  pre-compiled/pre-loaded at construction in the background, so the
+  first degraded solve — the moment the watchdog used to flag — is
+  warm.
+
+**What gets warmed** is decided by the device observatory:
+``DEVICE_OBS.warm_manifest()`` snapshots the hot (fn ×
+aval-signature) pairs, and :meth:`WarmPool.persist` AOT-compiles each
+one (off the tick path) into the on-disk store plus a framed manifest.
+Entries are keyed by PROGRAM identity (the wrapped function's
+qualname + static config values + array avals), not binding name — so
+the sidecar's ``sidecar_solve_batch``, the in-process model's
+``solve_batch``, and the failover twin ``failover_local_solve`` all
+share one store: signatures recorded by a running sidecar warm the
+scheduler's failover twin in another process.
+
+**Hard rules** (DESIGN §19.2 / §21):
+
+- *The warm path never donates.* A DONATED multi-device jit replayed
+  from a persistent cache mis-applies its alias map on jax 0.4.x
+  (same-shaped outputs swap; under concurrency the heap corrupts).
+  Every executable the pool stores or restores is compiled with
+  ``donate_argnums=()`` — structurally, in :func:`_closure_jit`, the
+  only constructor of pool programs — and graftcheck's donation rule
+  pins both this module and every adopt site (a donating binding can
+  never be adopted).
+- *Single device only.* AOT executables pin device placement; the
+  pool refuses to serve (and to restore) in a multi-device process —
+  which also makes the §19.2 replay bug unreachable by construction.
+- *Every load failure is typed, counted, and quarantined.* The store
+  lives on disk across crashes; torn, bit-flipped, oversized,
+  stale-host, version-skewed, or foreign entries surface as the
+  ``WarmEntryError`` family (utils/compilation_cache.py), count a
+  ``scheduler_warm_pool_rejects_total`` with their reason (clean
+  absences count ``..._misses_total``), move aside to
+  ``*.quarantined`` (never retried in a loop), and fall back to
+  cold compile. A poisoned store slows recovery; it never crashes the
+  scheduler and never skips a round.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from koordinator_tpu.metrics.components import (
+    WARM_POOL_HITS,
+    WARM_POOL_MISSES,
+    WARM_POOL_QUARANTINED,
+    WARM_POOL_REJECTS,
+    WARM_RESTORE_SECONDS,
+)
+from koordinator_tpu.obs.device import DEVICE_OBS, WARM_MISS, _signature
+from koordinator_tpu.obs.trace import TRACER
+from koordinator_tpu.utils.compilation_cache import (
+    ExecutableCache,
+    WarmEntryCorrupt,
+    WarmEntryError,
+    frame_payload,
+    max_entry_bytes,
+    unframe_payload,
+)
+
+#: manifest rows kept/restored at most (matches DEVICE_OBS._MAX_WARM's
+#: intent: the hot set, not an unbounded archive)
+_MAX_MANIFEST = 128
+
+#: background persist cadence (cmd wiring); tests drive persist() inline
+_PERSIST_INTERVAL_S = 30.0
+
+
+class _Registration:
+    """One adopted binding: the wrapped pure function, where its static
+    config argument sits in the positional call convention, and the
+    program identity shared across processes and binding names."""
+
+    __slots__ = ("fn_name", "fun", "config_argpos", "program")
+
+    def __init__(self, fn_name: str, fun, config_argpos: int):
+        self.fn_name = fn_name
+        self.fun = fun
+        self.config_argpos = config_argpos
+        self.program = f"{fun.__module__}.{fun.__qualname__}"
+
+
+def _closure_jit(fun, config_argpos: int, config):
+    """The ONLY constructor of warm-pool programs: ``fun`` with its
+    static config closed over, jitted with ``donate_argnums=()`` —
+    donation is structurally impossible on the warm path (DESIGN
+    §19.2: a donated executable replayed from a persistent store
+    mis-aliases its outputs on this jax line). graftcheck's
+    donation-safety rule additionally pins this file to empty
+    donation declarations."""
+
+    def bound(*arrays):
+        args = arrays[:config_argpos] + (config,) + arrays[config_argpos:]
+        return fun(*args)
+
+    return jax.jit(bound, static_argnums=(), donate_argnums=())
+
+
+def _config_key(config) -> tuple:
+    """Static config as a hashable, serializable key component."""
+    try:
+        return tuple(config)
+    except TypeError:
+        return (repr(config),)
+
+
+def _disk_key(program: str, config, sig) -> str:
+    """The on-disk store key: program identity + static config values
+    + the array-aval signature. Deterministic across processes on one
+    host/jax build (ExecutableCache._path additionally scopes by
+    backend identity and jax version)."""
+    import hashlib
+
+    body = repr((_config_key(config), sig)).encode()
+    digest = hashlib.blake2b(body, digest_size=12).hexdigest()
+    return f"warm|{program}|{digest}"
+
+
+class WarmPool:
+    """Process warm pool over an :class:`ExecutableCache` store.
+
+    Inert until :meth:`configure` points it at a store directory (the
+    test suite's empty ``KTPU_COMPILATION_CACHE_DIR`` keeps the
+    singleton inert, so warm serving never leaks into unrelated
+    tests). ``serving`` is a plain flag read per adopted call without
+    the lock (torn read costs one ordinary jit dispatch); every other
+    mutable attribute is mapped to ``_lock`` in graftcheck's
+    lock-discipline registry. Slow work — AOT compiles, disk I/O —
+    always runs OUTSIDE the lock, and the pool's lock never nests with
+    any other mapped lock."""
+
+    def __init__(self, cache: Optional[ExecutableCache] = None):
+        #: fast-path flag: True only while at least one executable is
+        #: installed AND the pool is active (plain read, like
+        #: DeviceObservatory.enabled)
+        self.serving = False
+        self._lock = threading.Lock()
+        self._cache = cache
+        #: whether configure() ever ran (ensure_configured's guard —
+        #: "configured but inert" must not re-configure per service)
+        self._configured = cache is not None
+        self._single_device: Optional[bool] = None
+        self._reg: Dict[str, _Registration] = {}
+        #: (program, config_key, sig) -> compiled executable. Keyed by
+        #: PROGRAM identity, not binding name, so (a) bindings sharing
+        #: a program (solve_batch / failover twin / sidecar) share one
+        #: map and (b) a background restore can run BEFORE any binding
+        #: registers — the boot path overlaps deserialization with
+        #: scheduler construction
+        self._execs: Dict = {}
+        #: the in-flight background restore (wait_restored joins it)
+        self._restore_thread: Optional[threading.Thread] = None
+        #: (program, config_key, sig) already persisted (or known bad)
+        self._persisted: set = set()
+        #: manifest rows: (program, config_key) -> (aval_args, aval_kwargs)
+        self._manifest: Dict = {}
+        self.hits = 0
+        #: clean store misses (no entry for the key): cold compile,
+        #: nothing wrong with the store
+        self.misses = 0
+        #: typed rejection-ladder refusals by reason (truncated |
+        #: corrupt | fingerprint | oversized | stale-host | version-skew)
+        self.rejects: Dict[str, int] = {}
+        self.quarantined = 0
+        self.served = 0
+        self.load_s_total = 0.0
+        self.compiles = 0
+        self.last_restore: Optional[dict] = None
+        self.last_error: Optional[str] = None
+        self._bg_thread: Optional[threading.Thread] = None
+        self._bg_stop = threading.Event()
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, cache_dir: Optional[str] = None,
+                  force_single_device: Optional[bool] = None) -> "WarmPool":
+        """Point the pool at a store directory (None = the
+        KTPU_COMPILATION_CACHE_DIR default; an empty configured dir
+        keeps the pool inert). Re-evaluates the single-device gate —
+        AOT executables pin device placement, and the §19.2 replay bug
+        lives in multi-device processes, so a sharded host never warm-
+        serves. ``force_single_device=True`` overrides the gate for
+        the test suite's forced 8-virtual-device mesh ONLY: those
+        devices are one physical host, and the pool's program set
+        never donates, so the replay bug is structurally absent there
+        — production wiring never passes it."""
+        cache = ExecutableCache(cache_dir)
+        with self._lock:
+            self._cache = cache if cache.dir else None
+            self._configured = True
+            # None = re-probe lazily (jax may init later)
+            self._single_device = force_single_device
+        self._refresh_serving()
+        return self
+
+    def ensure_configured(self) -> "WarmPool":
+        """Configure from the environment iff :meth:`configure` never
+        ran — the embedder path (a PlacementService constructed
+        directly, no cmd entry point) keeps the transparent AOT
+        warm-start the pre-pool per-module cache gave it. A cmd entry
+        point's explicit configure always wins; the test suite's empty
+        ``KTPU_COMPILATION_CACHE_DIR`` keeps this a no-op."""
+        with self._lock:
+            configured = self._configured
+        if not configured:
+            self.configure()
+        return self
+
+    @property
+    def active(self) -> bool:
+        """Whether the pool has a store AND may serve on this process's
+        device topology."""
+        with self._lock:
+            cache = self._cache
+        return cache is not None and self._is_single_device()
+
+    def _is_single_device(self) -> bool:
+        with self._lock:
+            known = self._single_device
+        if known is None:
+            try:
+                known = len(jax.devices()) == 1
+            except Exception:
+                # do NOT latch a failed probe: jax may simply not be
+                # initializable yet — a transient failure must not
+                # silently disable the pool for the process lifetime
+                return False
+            with self._lock:
+                self._single_device = known
+        return known
+
+    def _refresh_serving(self) -> None:
+        with self._lock:
+            have = bool(self._execs) and self._cache is not None
+        self.serving = have and self._is_single_device()
+
+    def adopt(self, observed, fun, config_argpos: int) -> None:
+        """Adopt a ``DEVICE_OBS.jit`` binding into the pool: record the
+        program identity and hook the binding's call path so restored
+        executables answer matching calls. The binding itself must have
+        been constructed with ``donate_argnums=()`` — graftcheck's
+        donation rule checks every adopt site against its binding."""
+        reg = _Registration(observed.fn_name, fun, config_argpos)
+        with self._lock:
+            self._reg[observed.fn_name] = reg
+        observed._warm = self
+
+    # -- the call path -------------------------------------------------------
+
+    def serve(self, fn_name: str, args: tuple, kwargs: dict):
+        """A restored executable's answer for this call, or
+        :data:`WARM_MISS`. Cost on the adopted path: one signature
+        computation (~µs at solve arity) + two dict lookups under the
+        lock; a process with no restored executables never reaches
+        here (``serving`` gates at the binding)."""
+        if kwargs:
+            return WARM_MISS
+        with self._lock:
+            reg = self._reg.get(fn_name)
+        if reg is None or len(args) <= reg.config_argpos:
+            return WARM_MISS
+        config = args[reg.config_argpos]
+        arrays = args[: reg.config_argpos] + args[reg.config_argpos + 1:]
+        try:
+            key = (reg.program, _config_key(config),
+                   _signature(arrays, {}))
+        except TypeError:
+            return WARM_MISS
+        with self._lock:
+            fn = self._execs.get(key)
+        if fn is None:
+            return WARM_MISS
+        try:
+            out = fn(*arrays)
+        except Exception as e:
+            # a stale/incompatible executable must not poison every
+            # solve for this shape: drop it (the jit path takes over),
+            # quarantine the DISK entry too (a call-time failure found
+            # on every restart is the same retry loop the load-time
+            # ladder forbids), and un-mark it persisted so the
+            # background persister re-stores a fresh one
+            with self._lock:
+                self._execs.pop(key, None)
+                self._persisted.discard(key)
+                self.last_error = f"{type(e).__name__}: {e}"
+                cache = self._cache
+            moved = None
+            if cache is not None:
+                moved = cache.quarantine(
+                    _disk_key(key[0], config, key[2])
+                )
+            if moved is not None:
+                with self._lock:
+                    self.quarantined += 1
+                WARM_POOL_QUARANTINED.inc()
+            self._refresh_serving()
+            TRACER.instant("warm-pool-eject", cat="warm",
+                           args={"fn": fn_name,
+                                 "error": f"{type(e).__name__}"})
+            return WARM_MISS
+        # counted only AFTER the executable answered: an ejected call
+        # that fell through to the jit must never inflate the warm
+        # evidence (bench leg 17 and the chaos storm assert on served)
+        with self._lock:
+            self.served += 1
+        return out
+
+    # -- persist (the running leader's side) ---------------------------------
+
+    def persist(self) -> dict:
+        """Snapshot ``DEVICE_OBS.warm_manifest()`` and make the store
+        cover it: every hot (program × config × signature) not yet
+        persisted is AOT-compiled from its avals (one off-path backend
+        compile each), stored, installed for in-process serving, and
+        recorded in the on-disk manifest. Idempotent and cheap when
+        nothing new compiled; called from the background thread the
+        cmd entry points start (never from the tick path)."""
+        if not self.active:
+            return {"persisted": 0, "skipped": "inactive"}
+        entries = DEVICE_OBS.warm_manifest()
+        with self._lock:
+            regs = dict(self._reg)
+        todo: List[Tuple[_Registration, tuple, tuple, object]] = []
+        for fn_name, aval_args, _aval_kwargs in entries:
+            reg = regs.get(fn_name)
+            if reg is None or len(aval_args) <= reg.config_argpos:
+                continue
+            config = aval_args[reg.config_argpos]
+            arrays = (aval_args[: reg.config_argpos]
+                      + aval_args[reg.config_argpos + 1:])
+            try:
+                sig = _signature(arrays, {})
+                pkey = (reg.program, _config_key(config), sig)
+            except TypeError:
+                continue
+            with self._lock:
+                if pkey in self._persisted:
+                    continue
+                self._persisted.add(pkey)
+            todo.append((reg, config, arrays, sig))
+        persisted = 0
+        for reg, config, arrays, sig in todo:
+            key = _disk_key(reg.program, config, sig)
+            try:
+                jit_fn = _closure_jit(reg.fun, reg.config_argpos, config)
+                compiled = self._get_or_compile(key, jit_fn, arrays)
+            except Exception as e:
+                with self._lock:
+                    self.last_error = f"{type(e).__name__}: {e}"
+                continue
+            with self._lock:
+                self._execs.setdefault(
+                    (reg.program, _config_key(config), sig), compiled
+                )
+                self._manifest[(reg.program, _config_key(config), sig)] = (
+                    (reg.config_argpos, config, arrays)
+                )
+            persisted += 1
+        if persisted:
+            self._write_manifest()
+            self._refresh_serving()
+            TRACER.instant("warm-pool-persist", cat="warm",
+                           args={"new": persisted})
+        return {"persisted": persisted}
+
+    def _get_or_compile(self, key: str, jit_fn, arrays):
+        """Load ``key`` (typed failures quarantined + counted) or
+        AOT-compile from avals and store. Runs outside the lock."""
+        with self._lock:
+            cache = self._cache
+        t0 = time.perf_counter()
+        compiled = None
+        try:
+            compiled = cache.load_checked(key)
+        except WarmEntryError as e:
+            self._note_bad_entry(key, e)
+        else:
+            if compiled is not None:
+                self._note_hit(time.perf_counter() - t0)
+            else:
+                self._note_miss()
+        if compiled is None:
+            compiled = jit_fn.lower(*arrays).compile()
+            with self._lock:
+                self.compiles += 1
+            cache.store(key, compiled)
+        return compiled
+
+    def _note_hit(self, load_s: float) -> None:
+        with self._lock:
+            self.hits += 1
+            self.load_s_total += load_s
+        WARM_POOL_HITS.inc()
+
+    def _note_miss(self) -> None:
+        """A CLEAN miss: no entry for the key — cold compile, store
+        healthy."""
+        with self._lock:
+            self.misses += 1
+        WARM_POOL_MISSES.inc()
+
+    def _note_reject(self, reason: str) -> None:
+        with self._lock:
+            self.rejects[reason] = self.rejects.get(reason, 0) + 1
+        WARM_POOL_REJECTS.inc({"reason": reason})
+
+    def _note_bad_entry(self, key: str, err: WarmEntryError) -> None:
+        """A typed load failure: count the reject by reason, quarantine
+        the entry (renamed aside — never retried in a loop), record
+        the error for status surfaces."""
+        self._note_reject(err.reason)
+        with self._lock:
+            self.last_error = f"{type(err).__name__}: {err}"
+            cache = self._cache
+        moved = cache.quarantine(key)
+        if moved is not None:
+            with self._lock:
+                self.quarantined += 1
+            WARM_POOL_QUARANTINED.inc()
+        TRACER.instant("warm-pool-quarantine", cat="warm",
+                       args={"reason": err.reason})
+
+    # -- the on-disk manifest ------------------------------------------------
+
+    def _manifest_path(self) -> Optional[str]:
+        with self._lock:
+            cache = self._cache
+        if cache is None or not cache.dir:
+            return None
+        return os.path.join(cache.dir, "warm_manifest.bin")
+
+    def _write_manifest(self) -> None:
+        path = self._manifest_path()
+        if path is None:
+            return
+        import pickle
+
+        with self._lock:
+            rows = [
+                {"program": program, "config_argpos": argpos,
+                 "config": config, "arrays": arrays}
+                for (program, _ck, _sig), (argpos, config, arrays)
+                in list(self._manifest.items())[-_MAX_MANIFEST:]
+            ]
+        try:
+            body = pickle.dumps(rows)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(frame_payload(body))
+            os.replace(tmp, path)
+        except Exception as e:
+            with self._lock:
+                self.last_error = f"{type(e).__name__}: {e}"
+
+    def _read_manifest(self) -> List[dict]:
+        """The on-disk manifest rows; a bad manifest is typed, counted
+        (reason per defect), quarantined, and returns [] — a corrupt
+        manifest degrades every restore to cold, it never crashes."""
+        path = self._manifest_path()
+        if path is None or not os.path.exists(path):
+            return []
+        import pickle
+
+        try:
+            size = os.path.getsize(path)
+            if size > max_entry_bytes():
+                from koordinator_tpu.utils.compilation_cache import (
+                    WarmEntryOversized,
+                )
+
+                raise WarmEntryOversized(f"manifest: {size}B")
+            with open(path, "rb") as f:
+                body = unframe_payload(f.read(), what="manifest")
+            rows = pickle.loads(body)
+            if not isinstance(rows, list):
+                raise WarmEntryCorrupt("manifest: not a row list")
+            return rows
+        except WarmEntryError as e:
+            self._quarantine_manifest(path, e)
+            return []
+        except Exception as e:
+            self._quarantine_manifest(
+                path, WarmEntryCorrupt(f"manifest: {type(e).__name__}: {e}")
+            )
+            return []
+
+    def _quarantine_manifest(self, path: str, err: WarmEntryError) -> None:
+        self._note_reject(err.reason)
+        with self._lock:
+            self.last_error = f"{type(err).__name__}: {err}"
+        try:
+            os.replace(path, f"{path}.quarantined")
+        except OSError:
+            return
+        with self._lock:
+            self.quarantined += 1
+        WARM_POOL_QUARANTINED.inc()
+
+    # -- restore (the recovering process's side) -----------------------------
+
+    def restore(self, fns: Optional[Sequence[str]] = None,
+                compile_missing: bool = False,
+                background: bool = False) -> Optional[dict]:
+        """Load the manifest's executables into the in-memory map for
+        every adopted binding whose PROGRAM matches (``fns`` narrows to
+        specific binding names). ``compile_missing=True`` additionally
+        AOT-compiles entries the store cannot serve (cold, but off the
+        caller's critical path when ``background=True``). Typed load
+        failures quarantine + count and — without ``compile_missing``
+        — simply leave that shape cold: the first real solve compiles
+        as it always did. Returns the report (None when backgrounded).
+        """
+        if background:
+            t = threading.Thread(
+                target=self.restore,
+                kwargs={"fns": fns, "compile_missing": compile_missing},
+                daemon=True, name="warm-pool-restore",
+            )
+            with self._lock:
+                self._restore_thread = t
+            t.start()
+            return None
+        report = {"restored": 0, "compiled": 0, "failed": 0, "rows": 0}
+        if not self.active:
+            report["skipped"] = "inactive"
+            with self._lock:
+                self.last_restore = report
+            return report
+        t_start = time.perf_counter()
+        rows = self._read_manifest()
+        with self._lock:
+            cache = self._cache
+            # restoring needs NO registration (the exec map is keyed
+            # by program), so the boot path can deserialize in the
+            # background while the scheduler is still constructing;
+            # an fns filter narrows to those bindings' programs
+            programs = None if fns is None else {
+                r.program for r in self._reg.values() if r.fn_name in fns
+            }
+            reg_funs = {r.program: r.fun for r in self._reg.values()}
+        for row in rows[-_MAX_MANIFEST:]:
+            try:
+                program = row["program"]
+                argpos = int(row["config_argpos"])
+                config = row["config"]
+                arrays = row["arrays"]
+                sig = _signature(arrays, {})
+            except Exception:
+                self._note_reject("corrupt")
+                report["failed"] += 1
+                continue
+            if programs is not None and program not in programs:
+                continue
+            report["rows"] += 1
+            ck = _config_key(config)
+            with self._lock:
+                installed = (program, ck, sig) in self._execs
+            if installed:
+                # idempotent re-restore (boot after an early restore,
+                # promotion sweeps with an unchanged store): the
+                # executable is already in memory — re-deserializing
+                # the same bytes would put a disk read + jax load back
+                # on the recovery path for nothing
+                report["restored"] += 1
+                continue
+            key = _disk_key(program, config, sig)
+            t0 = time.perf_counter()
+            try:
+                compiled = cache.load_checked(key)
+            except WarmEntryError as e:
+                self._note_bad_entry(key, e)
+                compiled = None
+            else:
+                if compiled is None:
+                    self._note_miss()
+                else:
+                    self._note_hit(time.perf_counter() - t0)
+            if compiled is None:
+                fun = reg_funs.get(program)
+                if not compile_missing or fun is None:
+                    report["failed"] += 1
+                    continue
+                try:
+                    jit_fn = _closure_jit(fun, argpos, config)
+                    compiled = jit_fn.lower(*arrays).compile()
+                    with self._lock:
+                        self.compiles += 1
+                    cache.store(key, compiled)
+                    report["compiled"] += 1
+                except Exception as e:
+                    with self._lock:
+                        self.last_error = f"{type(e).__name__}: {e}"
+                    report["failed"] += 1
+                    continue
+                installed_cold = True
+            else:
+                installed_cold = False
+            ck = _config_key(config)
+            with self._lock:
+                self._execs.setdefault((program, ck, sig), compiled)
+                self._manifest[(program, ck, sig)] = (
+                    argpos, config, arrays
+                )
+                self._persisted.add((program, ck, sig))
+            if not installed_cold:
+                # "restored" means DESERIALIZED (warm): a row the store
+                # could not serve that compile_missing cold-compiled
+                # counts ONLY under "compiled" — warm_outcome_fn readers
+                # (the supervisor's probe-budget split) treat
+                # restored>0 as "this child deserves the tight warm
+                # grace", and a still-compiling child does not
+                report["restored"] += 1
+        report["wall_s"] = time.perf_counter() - t_start
+        # the headline restore-latency series (boot, promotion, failover
+        # prewarm): manifest read + every executable deserialization
+        WARM_RESTORE_SECONDS.observe(report["wall_s"])
+        self._refresh_serving()
+        with self._lock:
+            self.last_restore = report
+        if report["restored"] or report["compiled"] or report["failed"]:
+            TRACER.instant("warm-pool-restore", cat="warm", args={
+                "restored": report["restored"],
+                "compiled": report["compiled"],
+                "failed": report["failed"],
+            })
+        return report
+
+    def wait_restored(self, timeout_s: float = 60.0) -> None:
+        """Join an in-flight background restore. The production boot
+        paths restore SEQUENTIALLY (early, before the heavy imports —
+        measured both cheaper and race-free), so this exists for
+        callers that opted into ``restore(background=True)`` and must
+        fence before traffic. No-op when none is running."""
+        with self._lock:
+            thread = self._restore_thread
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+            with self._lock:
+                if self._restore_thread is thread:
+                    self._restore_thread = None
+
+    # -- background persister ------------------------------------------------
+
+    def start_background(self,
+                         interval_s: float = _PERSIST_INTERVAL_S) -> None:
+        """Persist newly-observed hot signatures on a daemon thread
+        (cmd entry points call this once; never on the tick path)."""
+        if not self.active:
+            return
+        with self._lock:
+            if self._bg_thread is not None and self._bg_thread.is_alive():
+                return
+            self._bg_stop = threading.Event()
+            stop = self._bg_stop
+
+            def _run():
+                # fast cadence until the store holds SOMETHING: a
+                # crash-looping process (supervisor respawns under the
+                # full interval — exactly the restart-storm shape §21
+                # exists for) must get its first solve's signature
+                # persisted within seconds of the compile, or the
+                # store stays empty forever and every respawn is cold
+                delay = min(5.0, interval_s)
+                while not stop.wait(delay):
+                    try:
+                        if self.persist().get("persisted") or \
+                                self._has_store_entries():
+                            delay = interval_s
+                    except Exception:
+                        pass  # the persister must never die loudly
+
+            self._bg_thread = threading.Thread(
+                target=_run, daemon=True, name="warm-pool-persist"
+            )
+            self._bg_thread.start()
+
+    def _has_store_entries(self) -> bool:
+        """Whether anything was ever persisted or restored this
+        process (the persister's cadence gate)."""
+        with self._lock:
+            return bool(self._persisted)
+
+    def stop_background(self) -> None:
+        with self._lock:
+            thread, self._bg_thread = self._bg_thread, None
+            stop = self._bg_stop
+        stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    # -- read side -----------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``warm-pool`` status/debug section (PlacementService.
+        status(), both debug muxes): counters, what is installed, the
+        last restore report — cheap, never compiles or touches disk."""
+        with self._lock:
+            return {
+                "active": self._cache is not None,
+                "serving": self.serving,
+                "store_dir": None if self._cache is None
+                else self._cache.dir,
+                "single_device": self._single_device,
+                "executables": len(self._execs),
+                "registered": sorted(self._reg),
+                "manifest_rows": len(self._manifest),
+                "hits": self.hits,
+                "misses": self.misses,
+                "rejects": dict(self.rejects),
+                "served": self.served,
+                "quarantined": self.quarantined,
+                "compiles": self.compiles,
+                "load_seconds_total": self.load_s_total,
+                "last_restore": self.last_restore,
+                "last_error": self.last_error,
+            }
+
+    def flight_payload(self) -> dict:
+        """The flight recorder's cached ``warm`` section: was the last
+        anomaly served warm or cold, and is the store healthy — from
+        counters alone (a dump must not compile or touch disk)."""
+        with self._lock:
+            return {
+                "serving": self.serving,
+                "executables": len(self._execs),
+                "hits": self.hits,
+                "misses": self.misses,
+                "rejects": dict(self.rejects),
+                "served": self.served,
+                "quarantined": self.quarantined,
+                "last_error": self.last_error,
+            }
+
+    def reset(self) -> None:
+        """Forget everything (tests)."""
+        self.stop_background()
+        with self._lock:
+            self._execs.clear()
+            self._persisted.clear()
+            self._manifest.clear()
+            self.hits = 0
+            self.misses = 0
+            self.rejects = {}
+            self.quarantined = 0
+            self.served = 0
+            self.load_s_total = 0.0
+            self.compiles = 0
+            self.last_restore = None
+            self.last_error = None
+        self.serving = False
+
+
+#: the process warm pool every adopted binding consults (inert until a
+#: cmd entry point — or a test — configures a store directory)
+WARM_POOL = WarmPool()

@@ -71,6 +71,138 @@ def _host_scoped(cache_dir: str) -> str:
     return os.path.join(cache_dir, f"host-{host_fingerprint()}")
 
 
+# -- typed store errors (docs/DESIGN.md §21) ---------------------------------
+# The warm pool (service/warmpool.py) restores serialized executables
+# on the scheduler's RECOVERY paths — leader promotion, sidecar
+# respawn, degraded-mode flips — exactly when a crash may have left the
+# store torn. Every way an entry can be bad is therefore a TYPED error
+# the caller can count and quarantine; a raw pickle/zstd traceback out
+# of this module would turn a disk problem into a scheduler crash.
+
+class WarmEntryError(Exception):
+    """Base of every typed executable-store load failure. ``reason``
+    is the metric label (``scheduler_warm_pool_rejects_total``)."""
+
+    reason = "corrupt"
+
+
+class WarmEntryTruncated(WarmEntryError):
+    """The entry file ends before its declared payload does (torn
+    write / torn copy / disk-full)."""
+
+    reason = "truncated"
+
+
+class WarmEntryCorrupt(WarmEntryError):
+    """The entry is structurally unreadable: bad magic (foreign or
+    pre-framing file) or a payload that fails to unpickle/deserialize."""
+
+    reason = "corrupt"
+
+
+class WarmEntryFingerprintMismatch(WarmEntryError):
+    """The payload does not hash to the fingerprint in the header —
+    bit rot or a torn overwrite. (An INTEGRITY check, not a security
+    boundary: the keyless digest lives beside the payload it hashes,
+    and the body feeds pickle — the store directory must be
+    trusted-local-disk, same trust level as the code itself.)"""
+
+    reason = "fingerprint"
+
+
+class WarmEntryOversized(WarmEntryError):
+    """The entry (or its declared payload) exceeds the load cap — a
+    corrupt length prefix (or a foreign file) must not make a restart
+    path buffer gigabytes."""
+
+    reason = "oversized"
+
+
+class WarmEntryHostMismatch(WarmEntryError):
+    """The entry embeds a DIFFERENT host fingerprint than this
+    machine's. The store directory is already host-scoped
+    (:func:`_host_scoped`), but a copied/renamed store — a container
+    image with a baked cache, a fleet rollout that pre-seeded the
+    wrong host dir — would bypass the path scoping; the embedded
+    fingerprint catches it at load time (XLA:CPU executables replay
+    foreign CPU features as SIGILL/stalls, the MULTICHIP_r05 class)."""
+
+    reason = "stale-host"
+
+
+class WarmEntryVersionSkew(WarmEntryError):
+    """The entry embeds a different jax version than this process
+    runs. The store key already scopes by jax version, so skew means
+    a renamed/copied entry — refuse it typed rather than feeding a
+    foreign serialization format to the deserializer."""
+
+    reason = "version-skew"
+
+
+#: framed-entry magic (version-bearing: bump on format change — old
+#: entries then read as WarmEntryCorrupt and fall back to cold compile).
+#: v2 embeds provenance (host fingerprint + jax version) in the body.
+_ENTRY_MAGIC = b"KTPUEXE2"
+#: blake2b digest bytes stored in the header
+_DIGEST_SIZE = 16
+#: hard cap on entry payloads; override with KTPU_WARM_MAX_ENTRY_BYTES
+_MAX_ENTRY_BYTES = 512 << 20
+
+
+def max_entry_bytes() -> int:
+    try:
+        return int(os.environ.get("KTPU_WARM_MAX_ENTRY_BYTES",
+                                  _MAX_ENTRY_BYTES))
+    except ValueError:
+        return _MAX_ENTRY_BYTES
+
+
+def frame_payload(body: bytes) -> bytes:
+    """Frame ``body`` for the executable store: magic + 8-byte length +
+    blake2b fingerprint + body. The fingerprint makes a flipped bit a
+    typed :class:`WarmEntryFingerprintMismatch` instead of a crash
+    inside JAX's deserializer (which this code cannot catch). It is an
+    integrity check against accidental corruption, NOT authentication
+    — the store is trusted local disk (see the mismatch class)."""
+    import hashlib
+    import struct
+
+    digest = hashlib.blake2b(body, digest_size=_DIGEST_SIZE).digest()
+    return _ENTRY_MAGIC + struct.pack(">Q", len(body)) + digest + body
+
+
+def unframe_payload(raw: bytes, what: str = "entry") -> bytes:
+    """Verify and strip the :func:`frame_payload` header, raising the
+    typed :class:`WarmEntryError` family on every defect."""
+    import hashlib
+    import struct
+
+    header = len(_ENTRY_MAGIC) + 8 + _DIGEST_SIZE
+    if len(raw) < header:
+        raise WarmEntryTruncated(f"{what}: {len(raw)}B is shorter than "
+                                 f"the {header}B header")
+    if raw[: len(_ENTRY_MAGIC)] != _ENTRY_MAGIC:
+        raise WarmEntryCorrupt(f"{what}: bad magic")
+    (length,) = struct.unpack(
+        ">Q", raw[len(_ENTRY_MAGIC): len(_ENTRY_MAGIC) + 8]
+    )
+    if length > max_entry_bytes():
+        raise WarmEntryOversized(
+            f"{what}: declared {length}B > cap {max_entry_bytes()}B"
+        )
+    digest = raw[len(_ENTRY_MAGIC) + 8: header]
+    body = raw[header:]
+    if len(body) < length:
+        raise WarmEntryTruncated(
+            f"{what}: payload {len(body)}B < declared {length}B"
+        )
+    body = body[:length]
+    if hashlib.blake2b(body, digest_size=_DIGEST_SIZE).digest() != digest:
+        raise WarmEntryFingerprintMismatch(f"{what}: payload fingerprint "
+                                           f"does not match header")
+    return body
+
+
 class ExecutableCache:
     """AOT warm-start cache: serialized COMPILED executables on disk.
 
@@ -106,23 +238,98 @@ class ExecutableCache:
         digest = hashlib.sha256(ident.encode()).hexdigest()[:24]
         return os.path.join(self.dir, f"{digest}.exec")
 
-    def load(self, key: str):
-        """The cached compiled callable for ``key``, or None."""
+    def load_checked(self, key: str):
+        """The cached compiled callable for ``key``; None when no entry
+        exists. Every OTHER failure mode is a typed
+        :class:`WarmEntryError` — truncated, corrupt, oversized,
+        fingerprint-mismatched, stale-host, version-skewed — so a
+        warm-pool caller can count the reject and quarantine the file
+        instead of crashing (or silently retrying a poisoned entry
+        forever)."""
         path = self._path(key)
         if path is None or not os.path.exists(path):
             return None
         try:
-            import pickle
+            size = os.path.getsize(path)
+        except OSError:
+            return None
+        if size > max_entry_bytes() + 64:
+            # refuse BEFORE reading: a corrupt length prefix inside a
+            # giant file must not be discovered by buffering it
+            raise WarmEntryOversized(
+                f"{key}: file {size}B > cap {max_entry_bytes()}B"
+            )
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise WarmEntryTruncated(f"{key}: unreadable: {e}") from e
+        body = unframe_payload(raw, what=key)
+        import pickle
 
+        import jax
+
+        try:
+            record = pickle.loads(body)
+        except Exception as e:
+            raise WarmEntryCorrupt(
+                f"{key}: body unpickle failed: {type(e).__name__}: {e}"
+            ) from e
+        if not isinstance(record, tuple) or len(record) != 4:
+            raise WarmEntryCorrupt(f"{key}: stale entry record shape")
+        host, version, payload, trees = record
+        # provenance checks BEFORE the deserializer sees any bytes: the
+        # path scoping (host dir, jax-version key) can be bypassed by a
+        # copied/renamed store, and a foreign executable replayed on
+        # the wrong CPU is SIGILL/stall territory (DESIGN §21)
+        if host != host_fingerprint():
+            raise WarmEntryHostMismatch(
+                f"{key}: entry built on host {host!r}, this is "
+                f"{host_fingerprint()!r}"
+            )
+        if version != jax.__version__:
+            raise WarmEntryVersionSkew(
+                f"{key}: entry built under jax {version!r}, this "
+                f"process runs {jax.__version__!r}"
+            )
+        try:
             from jax.experimental.serialize_executable import (
                 deserialize_and_load,
             )
 
-            with open(path, "rb") as f:
-                payload, trees = pickle.load(f)
             return deserialize_and_load(payload, *pickle.loads(trees))
-        except Exception:
+        except Exception as e:
+            # the fingerprint matched, so the BYTES are what store()
+            # wrote — a deserializer rejection means a stale format /
+            # wrong backend build, still a typed, quarantinable outcome
+            raise WarmEntryCorrupt(
+                f"{key}: deserialize failed: {type(e).__name__}: {e}"
+            ) from e
+
+    def load(self, key: str):
+        """The cached compiled callable for ``key``, or None (silent
+        form of :meth:`load_checked` — legacy callers that treat any
+        bad entry as a plain miss)."""
+        try:
+            return self.load_checked(key)
+        except WarmEntryError:
             return None
+
+    def quarantine(self, key: str):
+        """Move ``key``'s entry aside (``<entry>.quarantined``) so a
+        poisoned file is never retried in a loop: the next load is a
+        clean miss, the next store publishes a fresh entry, and the
+        bad bytes stay on disk for forensics. Returns the quarantine
+        path, or None when there was nothing to move."""
+        path = self._path(key)
+        if path is None or not os.path.exists(path):
+            return None
+        target = f"{path}.quarantined"
+        try:
+            os.replace(path, target)
+        except OSError:
+            return None
+        return target
 
     def store(self, key: str, compiled) -> bool:
         path = self._path(key)
@@ -133,13 +340,21 @@ class ExecutableCache:
 
             from jax.experimental.serialize_executable import serialize
 
+            import jax
+
             payload, in_tree, out_tree = serialize(compiled)
             os.makedirs(self.dir, exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}"
+            # v2 record: provenance (host fingerprint + jax version)
+            # rides INSIDE the fingerprinted body, so a copied store
+            # that dodges the path scoping still loads as a typed
+            # stale-host / version-skew reject, never a foreign replay
+            body = pickle.dumps((
+                host_fingerprint(), jax.__version__,
+                payload, pickle.dumps((in_tree, out_tree)),
+            ))
             with open(tmp, "wb") as f:
-                pickle.dump(
-                    (payload, pickle.dumps((in_tree, out_tree))), f
-                )
+                f.write(frame_payload(body))
             os.replace(tmp, path)  # atomic publish
             return True
         except Exception:

@@ -1,0 +1,27 @@
+"""Seeded violations for the donation rule's warm-path checks (DESIGN
+§19.2 / §21): a donating jit factory inside a no-donate module, and a
+donating binding adopted into the warm pool. Both must flag."""
+
+import jax
+
+
+def f(x):
+    return x + 1
+
+
+# violation 1: a warm-path module's jit factory donates (and one that
+# fails to declare donation at all would flag identically)
+bad_warm_jit = jax.jit(f, static_argnums=(), donate_argnums=(0,))
+
+# violation 2: a donating binding adopted into the pool — the §19.2
+# replay bug shape, regardless of which module the adopt lives in
+donating_solve = jax.jit(f, donate_argnums=(0,))
+
+
+class _FakePool:
+    def adopt(self, observed, fun, config_argpos):
+        pass
+
+
+POOL = _FakePool()
+POOL.adopt(donating_solve, f, 0)

@@ -497,3 +497,172 @@ def test_chaos_audit_kill_leader_promotion_sweep():
         {"action": "targeted"}) - rep_before["targeted"] == 2
     assert AUDIT_REPAIRS.value(
         {"action": "full-restage"}) - rep_before["full-restage"] == 1
+
+
+# -- ISSUE 13: the kill-the-leader property rides the AOT warm pool ----------
+
+@pytest.mark.chaos
+def test_chaos_restart_storm_warm_restores(tmp_path, xla_compiles):
+    """The warm-pool leg of the kill-the-leader chaos property
+    (ISSUE 13 / DESIGN §21): SIGKILL the leader K times in a row
+    mid-churn; each standby promotes with a POPULATED pool. Every
+    promotion warm-restores — after the first generation the process
+    performs ZERO XLA recompiles (the ``xla_compiles`` fixture) and the
+    process-wide monitoring counter (``solver_device_xla_compiles_total``)
+    stays flat, while every new leader's solves are answered by
+    executables deserialized from the shared store (``served`` counts
+    them; in-memory jit caches cannot fake that, the warm path
+    short-circuits before the jit). The storm's placements and node
+    accounting end bit-identical to the crash-free reference — and
+    ``test_chaos_audit_kill_leader_promotion_sweep`` pins the
+    cold-promotion run to that same reference, so warm and cold
+    promotions are bit-identical to EACH OTHER by transitivity. A store
+    entry corrupted mid-storm degrades that generation to cold — typed
+    reject, counted, entry quarantined — WITHOUT losing a tick."""
+    import jax
+
+    from koordinator_tpu.obs.device import DEVICE_OBS
+    from koordinator_tpu.ops.binpack import solve_batch
+    from koordinator_tpu.service.warmpool import WarmPool
+    from koordinator_tpu.testing.chaos import sabotage_store
+
+    store = str(tmp_path / "warm-store")
+    # fresh-process conditions: generation 0's compiles must be real,
+    # observable events (earlier modules' shared jit caches would
+    # otherwise hide them from the manifest)
+    jax.clear_caches()
+    DEVICE_OBS.reset()
+
+    STORM_NODES, TICKS = 20, 14
+    KILLS = (4, 7, 10)          # three SIGKILLs mid-churn
+    CORRUPT_BEFORE = 10         # the LAST generation meets a bad store
+
+    def arrivals(run_rng, t):
+        dirty = run_rng.choice(STORM_NODES, 2, replace=False)
+        metrics = [
+            (f"n{int(i)}", int(run_rng.integers(0, 12000)),
+             int(run_rng.integers(0, 32768)))
+            for i in dirty
+        ]
+        pods = [
+            (f"t{t}p{j}", int(run_rng.integers(200, 2000)),
+             int(run_rng.integers(128, 2048)))
+            for j in range(4)
+        ]
+        return metrics, pods
+
+    def seed_bus(bus, run_rng):
+        for i in range(STORM_NODES):
+            bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+                name=f"n{i}", allocatable={CPU: 64000, MEM: 131072}))
+            bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+                node_name=f"n{i}",
+                node_usage={CPU: int(run_rng.integers(0, 8000)),
+                            MEM: int(run_rng.integers(0, 16384))},
+                update_time=90.0))
+
+    def apply_tick(bus, run_rng, t, now):
+        metrics, pods = arrivals(run_rng, t)
+        for name, cpu, mem in metrics:
+            bus.apply(Kind.NODE_METRIC, name, NodeMetric(
+                node_name=name, node_usage={CPU: cpu, MEM: mem},
+                update_time=now))
+        for name, cpu, mem in pods:
+            pod = PodSpec(name=name, requests={CPU: cpu, MEM: mem})
+            bus.apply(Kind.POD, pod.uid, pod)
+
+    # ---- crash-free reference ----------------------------------------
+    ref_rng = np.random.default_rng(77)
+    ref_bus = APIServer()
+    ref_sched = Scheduler(model=PlacementModel(use_pallas=False))
+    wire_scheduler(ref_bus, ref_sched)
+    seed_bus(ref_bus, ref_rng)
+    ref_log = []
+    for t in range(TICKS):
+        now = 100.0 + 2.0 * t
+        apply_tick(ref_bus, ref_rng, t, now)
+        out = ref_sched.schedule_pending(now=now)
+        ref_log.append((t, sorted(out.items())))
+
+    # ---- the storm ---------------------------------------------------
+    rng = np.random.default_rng(77)
+    bus = APIServer()
+
+    def spawn_generation(ident):
+        sched = Scheduler(model=PlacementModel(use_pallas=False))
+        pool = WarmPool().configure(store, force_single_device=True)
+        pool.adopt(sched.model._solve, solve_batch, config_argpos=3)
+        elector = LeaderElector(bus, "koord-scheduler", ident,
+                                lease_duration=1.0)
+        auditor = StateAuditor(sched, bus, interval_rounds=0,
+                               warm_pool=pool)
+        elector.on_started_leading = auditor.note_promotion
+        wire_scheduler(bus, sched, elector=elector)
+        return {"sched": sched, "pool": pool, "elector": elector,
+                "auditor": auditor, "ticks": 0}
+
+    seed_bus(bus, rng)
+    generations = [spawn_generation("g0")]
+    log = []
+    for t in range(TICKS):
+        now = 100.0 + 2.0 * t
+        if t in KILLS:
+            gen = generations[-1]
+            gen["pool"].persist()  # the running leader's side of §21
+            # SIGKILL: the leader never ticks again; a fresh process
+            # (fresh model, fresh pool over the SHARED store) takes over
+            if t == CORRUPT_BEFORE:
+                assert sabotage_store(store, "bitflipped-entry", seed=5)
+            generations.append(spawn_generation(f"g{len(generations)}"))
+            if t == KILLS[0]:
+                # generation 0 paid the storm's only compiles
+                xla_compiles.clear()
+                obs_mark = DEVICE_OBS.mark()
+        apply_tick(bus, rng, t, now)
+        gen = generations[-1]
+        assert gen["elector"].tick(now), f"no leader at tick {t}"
+        gen["auditor"].on_round(now=now)
+        out = gen["sched"].schedule_pending(now=now)
+        gen["ticks"] += 1
+        log.append((t, sorted(out.items())))
+        if t == CORRUPT_BEFORE - 1:
+            # end of the clean phase: generations 1..K-1 ran entirely
+            # warm — zero XLA recompiles since generation 0, and the
+            # always-on monitoring counter agrees (the acceptance
+            # criterion: solver_device_xla_compiles_total delta == 0)
+            assert xla_compiles == [], (
+                "a warm generation recompiled: " + "; ".join(xla_compiles)
+            )
+            assert (DEVICE_OBS.mark()["xla_compiles"]
+                    - obs_mark["xla_compiles"]) == 0
+
+    # ---- bit-identical to the crash-free run, tick for tick ----------
+    assert log == ref_log
+    got = lower_nodes(snapshot_from_bus(bus, now=200.0))
+    want = lower_nodes(snapshot_from_bus(ref_bus, now=200.0))
+    assert got.names == want.names
+    for f in STAGED_NODE_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got, f), getattr(want, f),
+            err_msg=f"node accounting diverged: {f}")
+
+    # ---- every clean promotion warm-restored -------------------------
+    for gen in generations[1:-1]:
+        status = gen["pool"].status()
+        assert status["hits"] >= 1, "no executable loaded from disk"
+        assert status["served"] == gen["ticks"], (
+            "a warm generation's solve fell through to the jit path"
+        )
+        assert status["quarantined"] == 0
+        warm = gen["auditor"].last_report["warm"]
+        assert warm["pool"]["restored"] >= 1
+        assert "error" not in (warm.get("prestage") or {})
+
+    # ---- the corrupted-store generation degraded to cold -------------
+    last = generations[-1]
+    status = last["pool"].status()
+    assert status["quarantined"] == 1
+    assert status["rejects"].get("fingerprint") == 1
+    assert status["served"] == 0          # cold: the jit path answered
+    assert last["ticks"] == TICKS - CORRUPT_BEFORE  # zero lost ticks
+    assert last["auditor"].last_report["kind"] == "promotion"

@@ -342,6 +342,53 @@ class TestLaneDispatchIdentity:
         assert pod_bucket(5) == 8
         assert node_bucket(9) == 10
 
+    def test_sixteen_plus_tenants_chunked_per_shard(self):
+        """ROADMAP 2a / ISSUE 12 satellite: tenant counts past the
+        lane-shard count are dispatched as per-shard-sized CHUNKS
+        (one lane per device each) instead of one oversized stacked
+        program — the shape that segfaulted the 8-virtual-device
+        child under XLA:CPU mapping pressure. 18 tenants on 8 shards
+        must split into 3 dispatches of <= 8 lanes, and every tenant
+        stays bit-identical to its solo solve."""
+        import jax
+
+        from koordinator_tpu.service import tenancy
+        from koordinator_tpu.service.tenancy import lane_shard_count
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-virtual-device mesh")
+        shards = lane_shard_count()
+        assert shards > 1, "pool mesh did not shard on this host"
+        k = 2 * shards + 2  # strictly past the shard count, non-pow2
+        requests = [
+            _request(tenant=f"t{i}", n_nodes=9 + (i % 2), n_pods=3 + i % 4,
+                     seed=200 + i, pod_seed=300 + i)
+            for i in range(k)
+        ]
+        chunks = []
+        real_chunk = tenancy._solve_lane_chunk
+
+        def spy(pairs, config, want_state, shards_):
+            chunks.append(len(pairs))
+            return real_chunk(pairs, config, want_state, shards_)
+
+        tenancy._solve_lane_chunk, saved = spy, real_chunk
+        try:
+            lanes = solve_tenant_lanes(requests)
+        finally:
+            tenancy._solve_lane_chunk = saved
+        assert len(lanes) == k
+        # split per shape bucket: every dispatch bounded by the shard
+        # count (never one [18, N, ...] stack), FIFO order preserved
+        assert len(chunks) == -(-k // shards)
+        assert max(chunks) <= shards and sum(chunks) == k
+        for i, r in enumerate(requests):
+            want = solve_from_request(r)
+            np.testing.assert_array_equal(
+                want.assignments, lanes[i].assignments,
+                err_msg=f"tenant {i} diverged under chunked dispatch",
+            )
+
 
 # -- weighted-fair allocation ------------------------------------------------
 

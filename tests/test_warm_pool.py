@@ -1,0 +1,919 @@
+"""The AOT warm pool (ISSUE 13, docs/DESIGN.md §21).
+
+Contracts under test:
+
+- store framing + provenance: every way an entry can be bad —
+  truncated, bit-flipped, wrong magic, oversized, stale host
+  fingerprint, version-skewed, torn by concurrent writers
+  (``testing.chaos.WARM_POOL_FAULT_KINDS``) — is a TYPED
+  ``WarmEntryError`` (mirroring tests/test_wire_hardening.py's
+  typed-error discipline for the wire), a counted REJECT
+  (``scheduler_warm_pool_rejects_total``), and a quarantine; never a
+  crash, never a retry loop, never a stale-executable solve;
+- persist → restore → serve: a fresh process (fresh pool + fresh jit
+  binding) answers adopted calls from deserialized executables —
+  bit-identical to the jit path, ZERO XLA recompiles (the
+  ``xla_compiles`` fixture), and the warm path provably never donates
+  its inputs (the §19.2 pin, same observable contract as
+  ``test_sharded_scatter_never_donates``);
+- the failover twin prewarms from signatures another BINDING persisted
+  (program-identity sharing: the sidecar's store warms the scheduler's
+  degraded path);
+- the promotion sweep restores pool + staged world (``StateAuditor``
+  with ``warm_pool``);
+- graftcheck's donation rule refuses donating jits in the warm-pool
+  module AND donating bindings at any adopt site.
+
+The suite runs on the forced 8-virtual-device mesh, so pools here pass
+``force_single_device=True`` — one physical host, and the §19.2 replay
+bug needs donation, which the pool structurally lacks (that is the
+point of the guard). Production keeps the conservative gate.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from koordinator_tpu.obs.device import DEVICE_OBS
+from koordinator_tpu.ops.binpack import SolverConfig, solve_batch
+from koordinator_tpu.service.warmpool import WarmPool
+from koordinator_tpu.testing import example_problem
+from koordinator_tpu.testing.chaos import (
+    WARM_POOL_FAULT_KINDS,
+    sabotage_store,
+)
+from koordinator_tpu.utils.compilation_cache import (
+    ExecutableCache,
+    WarmEntryCorrupt,
+    WarmEntryError,
+    WarmEntryFingerprintMismatch,
+    WarmEntryOversized,
+    WarmEntryTruncated,
+    frame_payload,
+    unframe_payload,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_device_obs():
+    """A fresh observatory slate per test: the process-global
+    DEVICE_OBS accumulates warm-manifest avals across the whole suite,
+    and persist() would otherwise AOT-compile every solve signature
+    every other module ever recorded."""
+    DEVICE_OBS.reset()
+    yield
+
+
+def _make_toy():
+    """A tiny warm-poolable program shaped like the real solves: arrays
+    around a static config (argpos 2). Compiles in milliseconds so the
+    store-mechanics tests don't pay solve-sized compile times. A fresh
+    CLOSURE per test: jax's pjit executable cache is shared per
+    underlying function, so a fresh function object is what makes a
+    fresh binding's first call a real, observable compile (the restart
+    shape these tests simulate in-process)."""
+
+    def toy_program(a, b, scale, c):
+        return (a + b) * scale - c
+
+    return toy_program
+
+
+def _toy_binding(toy, name="toy_solve"):
+    return DEVICE_OBS.jit(name, jax.jit(
+        toy, static_argnums=(2,), donate_argnums=()
+    ))
+
+
+def _toy_args(n=8, scale=3):
+    return (
+        jax.numpy.arange(n, dtype=jax.numpy.int32),
+        jax.numpy.ones(n, dtype=jax.numpy.int32),
+        scale,
+        jax.numpy.full(n, 2, dtype=jax.numpy.int32),
+    )
+
+
+def _pool(tmp_path, name="store"):
+    return WarmPool().configure(
+        str(tmp_path / name), force_single_device=True
+    )
+
+
+def _seed_toy(tmp_path, name="toy_solve"):
+    """One warmed toy pool: binding called (signature recorded),
+    persisted to disk. Returns (pool, binding, args, reference, toy)."""
+    pool = _pool(tmp_path)
+    toy = _make_toy()
+    binding = _toy_binding(toy, name)
+    pool.adopt(binding, toy, config_argpos=2)
+    args = _toy_args()
+    want = np.asarray(binding(*args))
+    report = pool.persist()
+    assert report["persisted"] == 1
+    return pool, binding, args, want, toy
+
+
+class TestStoreFraming:
+    def test_round_trip(self):
+        body = os.urandom(1024)
+        assert unframe_payload(frame_payload(body)) == body
+
+    def test_truncated(self):
+        framed = frame_payload(b"x" * 100)
+        with pytest.raises(WarmEntryTruncated):
+            unframe_payload(framed[:16])
+        with pytest.raises(WarmEntryTruncated):
+            unframe_payload(framed[:-10])
+
+    def test_wrong_magic(self):
+        framed = bytearray(frame_payload(b"payload"))
+        framed[:4] = b"EVIL"
+        with pytest.raises(WarmEntryCorrupt):
+            unframe_payload(bytes(framed))
+
+    def test_bitflip_is_fingerprint_mismatch(self):
+        framed = bytearray(frame_payload(b"p" * 256))
+        framed[-5] ^= 0xFF
+        with pytest.raises(WarmEntryFingerprintMismatch):
+            unframe_payload(bytes(framed))
+
+    def test_oversized_declared_length(self):
+        import struct
+
+        framed = bytearray(frame_payload(b"tiny"))
+        framed[8:16] = struct.pack(">Q", 1 << 62)
+        with pytest.raises(WarmEntryOversized):
+            unframe_payload(bytes(framed))
+
+
+class TestExecutableCacheHardening:
+    """load_checked's typed errors + quarantine, against real entry
+    files (a tiny jitted program, not the solve)."""
+
+    def _seed(self, tmp_path):
+        cache = ExecutableCache(str(tmp_path))
+        fn = jax.jit(lambda x: x + 1)
+        compiled = fn.lower(jax.numpy.arange(4)).compile()
+        assert cache.store("k", compiled)
+        return cache
+
+    def _entry_path(self, cache):
+        return cache._path("k")
+
+    @pytest.mark.parametrize("kind", WARM_POOL_FAULT_KINDS)
+    def test_fuzzed_entry_is_typed_never_a_crash(self, tmp_path, kind):
+        cache = self._seed(tmp_path)
+        assert sabotage_store(str(tmp_path), kind, seed=7) is not None
+        with pytest.raises(WarmEntryError):
+            cache.load_checked("k")
+        # the silent form maps every typed failure to a plain miss
+        assert cache.load("k") is None
+
+    def test_quarantine_moves_aside_never_retries(self, tmp_path):
+        cache = self._seed(tmp_path)
+        sabotage_store(str(tmp_path), "bitflipped-entry", seed=7)
+        with pytest.raises(WarmEntryFingerprintMismatch):
+            cache.load_checked("k")
+        moved = cache.quarantine("k")
+        assert moved is not None and moved.endswith(".quarantined")
+        assert os.path.exists(moved)
+        # the poisoned entry is GONE from the load path: the next load
+        # is a clean miss, not a crash loop
+        assert cache.load_checked("k") is None
+        assert cache.quarantine("k") is None  # nothing left to move
+
+    def test_garbage_file_is_corrupt(self, tmp_path):
+        cache = self._seed(tmp_path)
+        with open(self._entry_path(cache), "wb") as f:
+            f.write(os.urandom(64))
+        with pytest.raises(WarmEntryCorrupt):
+            cache.load_checked("k")
+
+    def test_oversized_file_refused_before_read(self, tmp_path,
+                                                monkeypatch):
+        cache = self._seed(tmp_path)
+        monkeypatch.setenv("KTPU_WARM_MAX_ENTRY_BYTES", "16")
+        with pytest.raises(WarmEntryOversized):
+            cache.load_checked("k")
+
+
+class TestWarmPoolSmoke:
+    def test_smoke_persist_restore_serve_identical(self, tmp_path):
+        """The §21 round trip: a fresh pool + fresh binding (the
+        restart shape) serves the call from the store, bit-identical,
+        with hit/served counters moving."""
+        _pool0, _b0, args, want, toy = _seed_toy(tmp_path)
+        binding = _toy_binding(toy)
+        pool = _pool(tmp_path)
+        pool.adopt(binding, toy, config_argpos=2)
+        report = pool.restore()
+        assert report["restored"] == 1 and report["failed"] == 0
+        got = np.asarray(binding(*args))
+        np.testing.assert_array_equal(got, want)
+        status = pool.status()
+        assert status["hits"] == 1
+        assert status["served"] == 1
+        assert status["quarantined"] == 0
+        assert status["misses"] == 0
+        assert status["rejects"] == {}
+
+    def test_smoke_zero_xla_recompiles_when_served(self, tmp_path,
+                                                   xla_compiles):
+        """The restored executable answers with ZERO XLA compilations
+        — no trace, no lower, no backend compile (the restart-blackout
+        criterion, quantitative)."""
+        _pool0, _b0, args, want, toy = _seed_toy(tmp_path)
+        binding = _toy_binding(toy)
+        pool = _pool(tmp_path)
+        pool.adopt(binding, toy, config_argpos=2)
+        pool.restore()
+        xla_compiles.clear()
+        got = np.asarray(binding(*args))
+        np.testing.assert_array_equal(got, want)
+        assert xla_compiles == [], (
+            "a warm-served call compiled — the pool is not serving"
+        )
+
+    def test_unknown_signature_falls_through_to_jit(self, tmp_path):
+        _pool0, _b0, args, _want, toy = _seed_toy(tmp_path)
+        binding = _toy_binding(toy)
+        pool = _pool(tmp_path)
+        pool.adopt(binding, toy, config_argpos=2)
+        pool.restore()
+        other = _toy_args(n=16)  # a shape the store never saw
+        got = np.asarray(binding(*other))
+        np.testing.assert_array_equal(got, (np.arange(16) + 1) * 3 - 2)
+        assert pool.status()["served"] == 0
+
+    def test_inert_pool_never_serves(self, tmp_path, monkeypatch):
+        """The suite default (empty cache dir) keeps the singleton
+        inert: adopted bindings run the plain jit path untouched."""
+        monkeypatch.setenv("KTPU_COMPILATION_CACHE_DIR", "")
+        pool = WarmPool().configure(None)
+        toy = _make_toy()
+        binding = _toy_binding(toy)
+        pool.adopt(binding, toy, config_argpos=2)
+        assert not pool.active
+        assert not pool.serving
+        args = _toy_args()
+        np.testing.assert_array_equal(
+            np.asarray(binding(*args)),
+            (np.arange(8) + 1) * 3 - 2,
+        )
+
+    def test_poisoned_executable_ejected_not_fatal(self, tmp_path):
+        """A restored executable that raises at call time is dropped
+        (never re-served) and the call is answered by the jit path."""
+        _pool0, _b0, args, want, toy = _seed_toy(tmp_path)
+        binding = _toy_binding(toy)
+        pool = _pool(tmp_path)
+        pool.adopt(binding, toy, config_argpos=2)
+        pool.restore()
+
+        def boom(*_a):
+            raise RuntimeError("poisoned executable")
+
+        with pool._lock:
+            key = next(iter(pool._execs))
+            pool._execs[key] = boom
+        got = np.asarray(binding(*args))  # jit fallback, not a crash
+        np.testing.assert_array_equal(got, want)
+        assert pool.status()["executables"] == 0  # ejected
+        assert "poisoned" in pool.status()["last_error"]
+        # and it stays ejected: the next call is plain jit, no retry
+        np.testing.assert_array_equal(np.asarray(binding(*args)), want)
+
+
+class TestCorruptStore:
+    @pytest.mark.parametrize("kind", WARM_POOL_FAULT_KINDS)
+    def test_corrupt_entry_typed_counted_quarantined(self, tmp_path, kind):
+        """Satellite 1: every store corruption is a typed fallback —
+        the restore reports the failure, counts the miss under its
+        reason, quarantines the entry, and the scheduler-side outcome
+        is COLD COMPILE, not a crash and not a skipped solve."""
+        _pool0, _b0, args, want, toy = _seed_toy(tmp_path)
+        assert sabotage_store(str(tmp_path / "store"), kind, seed=3)
+        binding = _toy_binding(toy)
+        pool = _pool(tmp_path)
+        pool.adopt(binding, toy, config_argpos=2)
+        report = pool.restore()  # loads only: the shape stays cold
+        assert report["restored"] == 0 and report["failed"] == 1
+        status = pool.status()
+        assert status["misses"] == 0  # a reject is NOT a clean miss
+        assert sum(status["rejects"].values()) == 1
+        reason = next(iter(status["rejects"]))
+        assert reason in ("truncated", "corrupt", "fingerprint",
+                          "oversized", "stale-host", "version-skew")
+        assert status["quarantined"] == 1
+        assert status["last_error"] is not None
+        # silent fallback to cold compile: the call still answers,
+        # bit-identical, through the ordinary jit path
+        np.testing.assert_array_equal(np.asarray(binding(*args)), want)
+
+    def test_quarantined_entry_not_retried_in_a_loop(self, tmp_path):
+        _pool0, _b0, _args, _want, toy = _seed_toy(tmp_path)
+        sabotage_store(str(tmp_path / "store"), "bitflipped-entry", seed=3)
+        pool = _pool(tmp_path)
+        pool.adopt(_toy_binding(toy), toy, config_argpos=2)
+        pool.restore()
+        assert pool.status()["quarantined"] == 1
+        # a second restore meets a MISSING entry (quarantined aside),
+        # never the same poisoned bytes again
+        pool2 = _pool(tmp_path)
+        pool2.adopt(_toy_binding(toy), toy, config_argpos=2)
+        pool2.restore()
+        assert pool2.status()["quarantined"] == 0
+        assert pool2.status()["rejects"] == {}
+        assert pool2.status()["misses"] == 1  # clean absence, not a reject
+
+    def test_corrupt_entry_recompiled_when_asked(self, tmp_path):
+        """``compile_missing=True`` (the failover prewarm path): the
+        quarantined entry is cold-compiled off-path and RE-STORED, so
+        the store self-heals."""
+        _pool0, _b0, args, want, toy = _seed_toy(tmp_path)
+        sabotage_store(str(tmp_path / "store"), "bitflipped-entry", seed=3)
+        binding = _toy_binding(toy)
+        pool = _pool(tmp_path)
+        pool.adopt(binding, toy, config_argpos=2)
+        report = pool.restore(compile_missing=True)
+        # a cold-compiled row counts ONLY under "compiled" — restored
+        # means deserialized, the signal the supervisor's probe-budget
+        # split keys its tight warm grace on
+        assert report["compiled"] == 1 and report["restored"] == 0
+        assert pool.status()["quarantined"] == 1
+        np.testing.assert_array_equal(np.asarray(binding(*args)), want)
+        # the store healed: a third pool loads clean
+        pool3 = _pool(tmp_path)
+        pool3.adopt(_toy_binding(toy), toy, config_argpos=2)
+        assert pool3.restore()["restored"] == 1
+        assert pool3.status()["hits"] == 1
+
+    def test_corrupt_manifest_degrades_to_cold(self, tmp_path):
+        _pool0, _b0, args, want, toy = _seed_toy(tmp_path)
+        assert sabotage_store(str(tmp_path / "store"), "bitflipped-entry",
+                              seed=3, manifest=True)
+        binding = _toy_binding(toy)
+        pool = _pool(tmp_path)
+        pool.adopt(binding, toy, config_argpos=2)
+        report = pool.restore()
+        assert report["restored"] == 0 and report["rows"] == 0
+        assert pool.status()["quarantined"] == 1
+        assert pool.status()["rejects"] == {"fingerprint": 1}
+        np.testing.assert_array_equal(np.asarray(binding(*args)), want)
+
+    def test_metrics_series_move(self, tmp_path):
+        from koordinator_tpu.metrics.components import (
+            WARM_POOL_HITS,
+            WARM_POOL_QUARANTINED,
+            WARM_POOL_REJECTS,
+        )
+
+        h0 = WARM_POOL_HITS.value()
+        q0 = WARM_POOL_QUARANTINED.value()
+        m0 = WARM_POOL_REJECTS.value({"reason": "fingerprint"})
+        _pool0, _b0, _args, _want, toy = _seed_toy(tmp_path)
+        pool = _pool(tmp_path)
+        pool.adopt(_toy_binding(toy), toy, config_argpos=2)
+        pool.restore()
+        assert WARM_POOL_HITS.value() == h0 + 1
+        sabotage_store(str(tmp_path / "store"), "bitflipped-entry", seed=3)
+        pool2 = _pool(tmp_path)
+        pool2.adopt(_toy_binding(toy), toy, config_argpos=2)
+        pool2.restore()
+        assert WARM_POOL_REJECTS.value({"reason": "fingerprint"}) == m0 + 1
+        assert WARM_POOL_QUARANTINED.value() == q0 + 1
+
+
+@pytest.fixture(scope="module")
+def solve_store(tmp_path_factory):
+    """A store seeded with ONE real solve_batch signature (50 nodes ×
+    64-bucket pods) — shared by the never-donate / failover / promotion
+    tests so the suite pays the solve compile once."""
+    store = tmp_path_factory.mktemp("solve-store")
+    pool = WarmPool().configure(str(store), force_single_device=True)
+    binding = DEVICE_OBS.jit("solve_batch", jax.jit(
+        solve_batch, static_argnames=("config",), donate_argnums=()
+    ))
+    pool.adopt(binding, solve_batch, config_argpos=3)
+    state, pods, params = example_problem(50, 60)
+    cfg = SolverConfig()
+    # the full positional convention every production caller uses
+    # (placement model / failover twin / sidecar): feature states ride
+    # as explicit Nones and are part of the signature
+    args = (state, pods, params, cfg, None, None, None, None, None)
+    want = binding(*args)
+    report = pool.persist()
+    assert report["persisted"] >= 1
+    return {
+        "dir": str(store),
+        "args": args,
+        "want_assign": np.asarray(want.assign),
+    }
+
+
+class TestNeverDonates:
+    def test_warm_serve_never_donates_inputs(self, solve_store):
+        """The §19.2 pin, runtime half (same observable contract as
+        test_sharded_scatter_never_donates): a warm-served solve's
+        inputs survive the call — a donated program would delete
+        them — and the result is bit-identical to the jit path."""
+        args = solve_store["args"]
+        state = args[0]
+        binding = DEVICE_OBS.jit("solve_batch", jax.jit(
+            solve_batch, static_argnames=("config",), donate_argnums=()
+        ))
+        pool = WarmPool().configure(
+            solve_store["dir"], force_single_device=True
+        )
+        pool.adopt(binding, solve_batch, config_argpos=3)
+        assert pool.restore()["restored"] >= 1
+        result = binding(*args)
+        assert pool.status()["served"] == 1, "jit path answered, not warm"
+        assert not state.alloc.is_deleted(), (
+            "the warm path donated its input — the §19.2 replay bug "
+            "is reachable again"
+        )
+        assert not state.used_req.is_deleted()
+        np.testing.assert_array_equal(
+            np.asarray(result.assign), solve_store["want_assign"]
+        )
+
+    def test_graftcheck_refuses_donating_jit_in_warm_module(self):
+        """Static half of the pin: a donating (or undeclared) jit
+        factory inside the warm-pool module is a donation-safety
+        violation."""
+        from koordinator_tpu.analysis.graftcheck.engine import load_module
+        from koordinator_tpu.analysis.graftcheck.rules import DonationRule
+
+        fixture = os.path.join(
+            os.path.dirname(__file__), "fixtures", "graftcheck",
+            "warm_donate.py",
+        )
+        module = load_module(
+            __import__("pathlib").Path(fixture), "warm_pool_fixture.py"
+        )
+        rule = DonationRule(no_donate_globs=("warm_pool_fixture.py",))
+        violations = rule.check(module)
+        messages = [v.message for v in violations]
+        assert any("donate_argnums=()" in m for m in messages), messages
+        assert any("adopted into the warm pool" in m for m in messages), \
+            messages
+
+    def test_graftcheck_repo_warm_module_clean(self):
+        """The real warm-pool module and every real adopt site pass
+        the guard (the repo-wide run is also gated by check.sh; this
+        pins the rule actually COVERS the production files)."""
+        from pathlib import Path
+
+        from koordinator_tpu.analysis.graftcheck.engine import load_module
+        from koordinator_tpu.analysis.graftcheck.rules import (
+            NO_DONATE_MODULES,
+            DonationRule,
+        )
+
+        root = Path(__file__).resolve().parent.parent
+        rule = DonationRule(no_donate_globs=NO_DONATE_MODULES)
+        for rel in (
+            "koordinator_tpu/service/warmpool.py",
+            "koordinator_tpu/models/placement.py",
+            "koordinator_tpu/service/failover.py",
+            "koordinator_tpu/service/server.py",
+        ):
+            module = load_module(root / rel, rel)
+            assert rule.check(module) == [], rel
+
+    def test_stripped_donation_declaration_caught(self, tmp_path):
+        """Teeth against the REAL module source: rewriting the warm
+        pool's jit to donate must flag (the injected-violation pattern
+        of test_graftcheck_v2)."""
+        from pathlib import Path
+
+        from koordinator_tpu.analysis.graftcheck.engine import load_module
+        from koordinator_tpu.analysis.graftcheck.rules import DonationRule
+
+        root = Path(__file__).resolve().parent.parent
+        src = (root / "koordinator_tpu/service/warmpool.py").read_text()
+        # target the CODE declaration, not the docstring mention
+        evil = src.replace("static_argnums=(), donate_argnums=()",
+                           "static_argnums=(), donate_argnums=(0,)", 1)
+        assert evil != src
+        bad = tmp_path / "warmpool.py"
+        bad.write_text(evil)
+        rule = DonationRule(
+            no_donate_globs=("koordinator_tpu/service/warmpool.py",)
+        )
+        module = load_module(bad, "koordinator_tpu/service/warmpool.py")
+        assert any(
+            "warm-path jit factory" in v.message
+            for v in rule.check(module)
+        )
+
+
+class TestFailoverPrewarm:
+    def test_local_twin_prewarms_from_shared_program(self, solve_store,
+                                                     xla_compiles):
+        """The failover twin loads executables persisted under the
+        ``solve_batch`` BINDING (program-identity sharing): its first
+        degraded-mode solve is warm — zero XLA compiles — and
+        bit-identical."""
+        from koordinator_tpu.service import failover
+        from koordinator_tpu.service.client import SolverUnavailable
+
+        pool = WarmPool().configure(
+            solve_store["dir"], force_single_device=True
+        )
+        # _local_solve is a MODULE-LEVEL binding: re-adopt for this
+        # test, restore the singleton adoption afterwards so the rest
+        # of the suite never consults this test's tmp-dir pool
+        prev_warm = failover._local_solve._warm
+        pool.adopt(failover._local_solve, solve_batch, config_argpos=3)
+
+        class DeadRemote:
+            address = "/nonexistent"
+            supports_staging_delta = False
+
+            def solve_result(self, *a, **k):
+                raise SolverUnavailable("dead")
+
+        try:
+            fs = failover.FailoverSolver(
+                DeadRemote(), failure_threshold=1,
+                probe_fn=lambda: False, prewarm=False,
+            )
+            report = fs.prewarm(background=False)
+            assert report["restored"] >= 1, report
+            state, pods, params, cfg = solve_store["args"][:4]
+            xla_compiles.clear()
+            result = fs.solve_result(state, pods, params, cfg)
+            assert fs.last_mode == "local-fallback"
+            assert xla_compiles == [], (
+                "the first degraded solve compiled — the prewarm did "
+                "not cover the hot signature"
+            )
+            np.testing.assert_array_equal(
+                np.asarray(result.assign), solve_store["want_assign"]
+            )
+            assert fs.status()["prewarm"]["restored"] >= 1
+        finally:
+            failover._local_solve._warm = prev_warm
+
+
+class TestPromotionRestore:
+    def _wired(self):
+        from koordinator_tpu.apis.extension import ResourceName as R
+        from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+        from koordinator_tpu.client.bus import APIServer, Kind
+        from koordinator_tpu.client.wiring import wire_scheduler
+        from koordinator_tpu.models.placement import PlacementModel
+        from koordinator_tpu.scheduler import Scheduler
+
+        bus = APIServer()
+        sched = Scheduler(model=PlacementModel(use_pallas=False))
+        wire_scheduler(bus, sched)
+        for i in range(4):
+            bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+                name=f"n{i}",
+                allocatable={R.CPU: 64000, R.MEMORY: 131072}))
+            bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+                node_name=f"n{i}", node_usage={R.CPU: 100 * i},
+                update_time=90.0))
+        pod = PodSpec(name="p0", requests={R.CPU: 500, R.MEMORY: 256})
+        bus.apply(Kind.POD, pod.uid, pod)
+        return bus, sched
+
+    def test_promotion_sweep_warm_restores(self, solve_store):
+        """note_promotion → the promotion sweep's report carries the
+        warm-restore section: pool executables loaded from disk AND
+        the staged world eagerly prestaged — both BEFORE the first
+        solve. Periodic sweeps never pay it."""
+        from koordinator_tpu.scheduler.auditor import StateAuditor
+
+        bus, sched = self._wired()
+        pool = WarmPool().configure(
+            solve_store["dir"], force_single_device=True
+        )
+        pool.adopt(sched.model._solve, solve_batch, config_argpos=3)
+        auditor = StateAuditor(sched, bus, interval_rounds=1,
+                               warm_pool=pool)
+        auditor.note_promotion()
+        report = auditor.on_round(now=100.0)
+        assert report["kind"] == "promotion"
+        warm = report["warm"]
+        assert warm["pool"]["restored"] >= 1
+        assert pool.status()["hits"] >= 1
+        # the staged world was eagerly prestaged (full first staging)
+        assert "prestage" in warm and "error" not in warm["prestage"]
+        assert sched.model.staged_cache.state is not None
+        # a periodic sweep does NOT re-run the warm restore
+        report2 = auditor.on_round(now=101.0)
+        assert report2 is not None and report2["kind"] == "periodic"
+        assert "warm" not in report2
+
+    def test_promotion_restore_never_raises(self, tmp_path):
+        """A broken pool (store vanished mid-flight) costs latency,
+        never the promotion round."""
+        from koordinator_tpu.scheduler.auditor import StateAuditor
+
+        bus, sched = self._wired()
+
+        class ExplodingPool:
+            def restore(self, **_k):
+                raise RuntimeError("store on fire")
+
+        auditor = StateAuditor(sched, bus, interval_rounds=0,
+                               warm_pool=ExplodingPool())
+        auditor.note_promotion()
+        report = auditor.on_round(now=100.0)
+        assert "error" in report["warm"]["pool"]
+
+
+class TestObservabilitySurfaces:
+    def test_placement_service_status_has_warm_pool_section(self, tmp_path):
+        from koordinator_tpu.service.server import PlacementService
+
+        service = PlacementService(str(tmp_path / "warm-status.sock"))
+        service.start()  # stop() joins serve_forever — it must be running
+        try:
+            status = service.status()
+            warm = status["warm_pool"]
+            for key in ("active", "serving", "hits", "misses",
+                        "quarantined", "executables"):
+                assert key in warm
+        finally:
+            service.stop()
+
+    def test_flight_dump_carries_cached_warm_section(self, tmp_path):
+        import json
+
+        from koordinator_tpu.obs.flight import FlightRecorder
+
+        recorder = FlightRecorder(dump_dir=str(tmp_path),
+                                  min_interval_s=0.0)
+        path = recorder.trigger("manual", detail="warm-section-test")
+        assert path is not None
+        with open(path) as f:
+            payload = json.load(f)
+        warm = payload["warm"]
+        for key in ("serving", "hits", "misses", "quarantined"):
+            assert key in warm
+
+
+class TestEntryProvenance:
+    """The v2 record's embedded provenance (host fingerprint + jax
+    version): path scoping can be bypassed by a copied/renamed store,
+    the load-time checks cannot."""
+
+    def _seed(self, tmp_path):
+        cache = ExecutableCache(str(tmp_path))
+        fn = jax.jit(lambda x: x * 2)
+        compiled = fn.lower(jax.numpy.arange(4)).compile()
+        assert cache.store("prov", compiled)
+        return cache
+
+    def _rewrite_record(self, cache, **overrides):
+        """Re-frame the entry with provenance fields replaced — a
+        VALID frame whose only defect is the embedded provenance."""
+        import pickle
+
+        path = cache._path("prov")
+        with open(path, "rb") as f:
+            body = unframe_payload(f.read())
+        host, version, payload, trees = pickle.loads(body)
+        record = {
+            "host": host, "version": version,
+            "payload": payload, "trees": trees, **overrides,
+        }
+        body = pickle.dumps((record["host"], record["version"],
+                             record["payload"], record["trees"]))
+        with open(path, "wb") as f:
+            f.write(frame_payload(body))
+
+    def test_stale_host_fingerprint_typed(self, tmp_path):
+        from koordinator_tpu.utils.compilation_cache import (
+            WarmEntryHostMismatch,
+        )
+
+        cache = self._seed(tmp_path)
+        assert sabotage_store(str(tmp_path), "stale-host-fingerprint")
+        with pytest.raises(WarmEntryHostMismatch) as e:
+            cache.load_checked("prov")
+        assert e.value.reason == "stale-host"
+        assert cache.load("prov") is None  # silent form: a plain miss
+
+    def test_version_skew_typed(self, tmp_path):
+        from koordinator_tpu.utils.compilation_cache import (
+            WarmEntryVersionSkew,
+        )
+
+        cache = self._seed(tmp_path)
+        self._rewrite_record(cache, version="0.0.1-foreign")
+        with pytest.raises(WarmEntryVersionSkew) as e:
+            cache.load_checked("prov")
+        assert e.value.reason == "version-skew"
+
+    def test_torn_concurrent_write_typed(self, tmp_path):
+        cache = self._seed(tmp_path)
+        assert sabotage_store(str(tmp_path), "torn-concurrent-write")
+        with pytest.raises(WarmEntryError) as e:
+            cache.load_checked("prov")
+        # an interleaved write surfaces through the integrity ladder
+        assert e.value.reason in ("fingerprint", "truncated", "corrupt")
+
+
+class TestPopulateCorruptRestart:
+    def test_smoke_populate_corrupt_restart_one_reject_rest_hit(
+            self, tmp_path):
+        """The check.sh warm-pool smoke scenario (ISSUE 13): populate
+        the store with N signatures, corrupt ONE entry, restart (fresh
+        pool over the same store) — exactly 1 counted reject +
+        quarantine, the other N-1 restore as hits, and the corrupted
+        shape still answers bit-identical through the cold path."""
+        pool = _pool(tmp_path)
+        toy = _make_toy()
+        binding = _toy_binding(toy)
+        pool.adopt(binding, toy, config_argpos=2)
+        shapes = (8, 12, 24)
+        wants = {n: np.asarray(binding(*_toy_args(n=n))) for n in shapes}
+        assert pool.persist()["persisted"] == len(shapes)
+        assert sabotage_store(str(tmp_path / "store"),
+                              "bitflipped-entry", seed=11)
+
+        fresh_binding = _toy_binding(toy)
+        fresh = _pool(tmp_path)
+        fresh.adopt(fresh_binding, toy, config_argpos=2)
+        report = fresh.restore()
+        assert report["rows"] == len(shapes)
+        assert report["restored"] == len(shapes) - 1
+        assert report["failed"] == 1
+        status = fresh.status()
+        assert status["hits"] == len(shapes) - 1        # N-1 hits
+        assert sum(status["rejects"].values()) == 1     # 1 typed reject
+        assert status["quarantined"] == 1
+        # every shape still answers, bit-identical — the corrupted one
+        # through the cold jit path, the others warm-served
+        for n in shapes:
+            np.testing.assert_array_equal(
+                np.asarray(fresh_binding(*_toy_args(n=n))), wants[n]
+            )
+        assert fresh.status()["served"] == len(shapes) - 1
+
+
+class TestSupervisorProbeBudget:
+    """The respawn probe-budget split (ISSUE 13 satellite): a
+    warm-restored child is probed on the tight ``warm_ready_timeout_s``
+    — a hung warm child dies in seconds — while a cold (or undecided)
+    child keeps the generous cold-compile allowance."""
+
+    def _supervisor(self, spawned, probe, clock, warm_flag, **kw):
+        from koordinator_tpu.service.supervisor import SolverSupervisor
+
+        class _Handle:
+            def __init__(self):
+                self.returncode = None
+                self.killed = 0
+                self.pid = 777
+                self.warm_restored = warm_flag["value"]
+
+            def poll(self):
+                return self.returncode
+
+            def kill(self):
+                self.killed += 1
+                self.returncode = -9
+
+        def spawn():
+            handle = _Handle()
+            spawned.append(handle)
+            return handle
+
+        kw.setdefault("probe_interval_s", 0.01)
+        kw.setdefault("backoff_base_s", 0.0)
+        kw.setdefault("backoff_cap_s", 0.0)
+        return SolverSupervisor(
+            ("127.0.0.1", 1), spawn_fn=spawn, probe_fn=probe,
+            sleep=lambda _s: None, clock=clock,
+            probe_failure_threshold=3,
+            ready_timeout_s=120.0, warm_ready_timeout_s=10.0, **kw,
+        )
+
+    def _respawn_cold_then(self, warm_value):
+        """Boot healthy, crash, respawn with the child reporting
+        ``warm_value`` as its restore outcome; probes keep failing.
+        Returns (supervisor, now, spawned)."""
+        now = [0.0]
+        spawned = []
+        alive = {"ok": True}
+        warm_flag = {"value": warm_value}
+        sup = self._supervisor(
+            spawned, probe=lambda: alive["ok"], clock=lambda: now[0],
+            warm_flag=warm_flag,
+        )
+        sup.start(wait_ready=True, monitor=False)
+        alive["ok"] = False
+        spawned[-1].returncode = 1
+        assert sup.check_once() == "restarted"
+        return sup, now, spawned
+
+    def test_warm_respawn_probed_on_tight_grace(self):
+        from koordinator_tpu.metrics.components import (
+            SUPERVISOR_RESPAWN_WARM,
+        )
+
+        before = SUPERVISOR_RESPAWN_WARM.value()
+        sup, now, spawned = self._respawn_cold_then(warm_value=True)
+        try:
+            assert sup.check_once() == "starting"  # inside warm grace
+            status = sup.status()
+            assert status["respawn_warm"] is True
+            assert status["ready_grace_s"] == 10.0
+            assert status["respawns_warm_total"] == 1
+            assert SUPERVISOR_RESPAWN_WARM.value() == before + 1
+            # past the WARM grace (nowhere near the 120s allowance):
+            # failed probes now count — the hung warm child is killed
+            # after the probe threshold, in seconds
+            now[0] += 11.0
+            assert sup.check_once() == "probe-failed"
+            assert sup.check_once() == "probe-failed"
+            assert sup.check_once() == "restarted"
+            assert spawned[1].killed == 1
+        finally:
+            sup.stop()
+
+    def test_cold_respawn_keeps_generous_grace(self):
+        sup, now, spawned = self._respawn_cold_then(warm_value=False)
+        try:
+            now[0] += 11.0  # past warm grace — must NOT matter when cold
+            for _ in range(5):
+                assert sup.check_once() == "starting"
+            assert sup.status()["ready_grace_s"] == 120.0
+            assert sup.status()["respawns_warm_total"] == 0
+            now[0] += 121.0  # past the cold allowance: now it is hung
+            assert sup.check_once() == "probe-failed"
+        finally:
+            sup.stop()
+
+    def test_undecided_outcome_stays_generous(self):
+        """None (the child can't answer yet — boot restore in flight)
+        must keep the cold allowance: infanticiding an undecided child
+        on the tight clock would re-create the respawn loop the ready
+        grace exists to prevent."""
+        sup, now, spawned = self._respawn_cold_then(warm_value=None)
+        try:
+            now[0] += 30.0
+            assert sup.check_once() == "starting"
+            assert sup.status()["respawn_warm"] is None
+            assert sup.status()["ready_grace_s"] == 120.0
+            # the child resolves warm mid-wait: the grace TIGHTENS now
+            spawned[-1].warm_restored = True
+            assert sup.check_once() == "probe-failed"  # 30s > warm 10s
+        finally:
+            sup.stop()
+
+    def test_debug_port_warm_outcome_reads_the_mux(self):
+        from koordinator_tpu.scheduler.monitor import DebugServices
+        from koordinator_tpu.service.supervisor import (
+            debug_port_warm_outcome,
+        )
+        from koordinator_tpu.utils.debug_http import DebugHTTPServer
+
+        payload = {"active": True, "executables": 0,
+                   "last_restore": None}
+        services = DebugServices()
+        services.register("warm-pool", lambda: dict(payload))
+        server = DebugHTTPServer(services=services, port=0).start()
+        try:
+            outcome = debug_port_warm_outcome(server.port)
+            assert outcome() is None           # restore still in flight
+            payload["executables"] = 3
+            assert outcome() is True           # warm: tight grace
+            payload.update(executables=0,
+                           last_restore={"restored": 0, "failed": 1})
+            assert outcome() is False          # cold restore: generous
+            payload["active"] = False
+            assert outcome() is False          # no pool: always cold
+        finally:
+            server.stop()
+        assert outcome() is None               # mux gone: undecided
+
+
+class TestDeviceObsManifest:
+    def test_warm_manifest_snapshots_fn_aval_pairs(self):
+        obs_entries_before = {
+            fn for fn, _a, _k in DEVICE_OBS.warm_manifest()
+        }
+        binding = _toy_binding(_make_toy(), "toy_manifest_probe")
+        binding(*_toy_args(n=32))
+        entries = [
+            (fn, aval_args) for fn, aval_args, _kw
+            in DEVICE_OBS.warm_manifest()
+            if fn == "toy_manifest_probe"
+        ]
+        assert "toy_manifest_probe" not in obs_entries_before
+        assert len(entries) == 1
+        fn, aval_args = entries[0]
+        # arrays became ShapeDtypeStructs, the static rode by value
+        assert aval_args[0].shape == (32,)
+        assert aval_args[2] == 3

@@ -70,15 +70,28 @@ echo "== bench diff smoke =="
 # that no comparator fires on identical inputs
 python tools/bench_diff.py BENCH_r05.json BENCH_r05.json
 
-echo "== sharded + multi-tenant bench budgets =="
-# the measured sharded/multi-tenant legs are budget-gated (ISSUES
-# 10/11): a scaling, merge-overhead, pool-throughput, or per-tenant
-# p99 regression in the committed record fails loudly.
-# (BENCH_vcpu_r07.json is the committed virtual-CPU-mesh record — legs
-# 14/14b/15/16 always run on the forced 8-device virtual mesh, so
-# these budgets stay comparable whatever hardware records the
-# r-series; r06 remains for history.)
-python tools/bench_diff.py --budget tools/bench_budgets.json BENCH_vcpu_r07.json
+echo "== sharded + multi-tenant + warm-pool bench budgets =="
+# the measured sharded/multi-tenant/warm-pool legs are budget-gated
+# (ISSUES 10/11/13): a scaling, merge-overhead, pool-throughput,
+# per-tenant p99, or warm-restart regression in the committed record
+# fails loudly — including the leg-17 acceptance flags (>=3x warm
+# restart-to-first-bind, tick-identity both facets, served-without-
+# donation), pinned with equals/min bounds.
+# (BENCH_vcpu_r08.json is the committed virtual-CPU-box record — legs
+# 14/14b/15/16 run on the forced 8-device virtual mesh and leg 17 in
+# fresh single-device children, so these budgets stay comparable
+# whatever hardware records the r-series; r06/r07 remain for history.)
+python tools/bench_diff.py --budget tools/bench_budgets.json BENCH_vcpu_r08.json
+
+echo "== warm pool smoke =="
+# the AOT warm-pool slice (ISSUE 13): persist -> corrupt one entry ->
+# restart must count exactly 1 typed reject (+ quarantine) and restore
+# the other N-1 as hits, warm serving must be bit-identical with zero
+# XLA recompiles, and every WARM_POOL_FAULT_KINDS corruption must
+# degrade to a typed, counted, quarantined cold fallback — never a
+# crash, never a stale-executable solve
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_warm_pool.py \
+    -q -k "smoke or corrupt_entry_typed" -p no:cacheprovider
 
 echo "== device observatory smoke =="
 # the device-cost layer: compile telemetry + padding gauges must be
