@@ -1278,6 +1278,337 @@ def bench_pipelined_churn(repeats):
     }
 
 
+def bench_streaming_arrival(repeats):
+    """Config #18 (ISSUE 14): continuous-arrival serving — the adaptive
+    round trigger (batch-size watermark OR oldest-pod lane deadline,
+    docs/DESIGN.md §22) vs the fixed-cadence loop, at sustained
+    open-loop arrival rates.
+
+    The serving question legs 9-13 never asked: not pods/s per tick but
+    per-pod submit→bind p50/p99 while pods arrive CONTINUOUSLY (seeded
+    heavy-tail trace, testing/arrivals.py; arrivals never wait for the
+    scheduler). Three facets:
+
+    - **low / mid rate arms**: the same trace served by (a) the fixed
+      50ms cadence (run_loop's shape: a pod waits out the rest of the
+      tick it missed) and (b) the adaptive trigger — both through the
+      pipelined tick path, both placing every pod (equal throughput),
+      per-pod latency from the PodTimelines ring. Acceptance: adaptive
+      p99 >= 2x better at the mid rate.
+    - **bit-identity**: the adaptive arm's recorded per-round arrival
+      batches replayed through the plain fixed-round loop must
+      reproduce final placements and node accounting bit for bit (the
+      trigger changes WHEN rounds fire, never WHAT they decide).
+    - **max sustainable rate**: a rate ladder on the adaptive arm; the
+      highest rate where nothing sheds and the tail drains promptly is
+      recorded as the shed point (DESIGN §22's definition).
+    """
+    import dataclasses
+
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import NodeMetric, NodeSpec, PodSpec
+    from koordinator_tpu.client.bus import APIServer, Kind
+    from koordinator_tpu.client.wiring import (
+        snapshot_from_bus,
+        wire_scheduler,
+    )
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import (
+        STAGED_NODE_FIELDS,
+        SolverConfig,
+    )
+    from koordinator_tpu.scheduler import Scheduler
+    from koordinator_tpu.scheduler.pipeline import TickPipeline
+    from koordinator_tpu.scheduler.streaming import (
+        StreamingConfig,
+        StreamingLoop,
+    )
+    from koordinator_tpu.state.cluster import lower_nodes
+    from koordinator_tpu.testing.arrivals import heavy_tail_trace
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    # 500 nodes keeps the solve wall (~15-20ms on CPU) well under the
+    # mid rate's queueing point on this class of box: the comparison
+    # then measures the TRIGGER's queue-wait, not device saturation
+    # (at 1000 nodes / 1000 pods/s both arms saturate and converge)
+    n_nodes = int(os.environ.get("KTPU_BENCH_STREAM_NODES", 500))
+    duration_s = float(os.environ.get("KTPU_BENCH_STREAM_SECONDS", 4.0))
+    rate_low = float(os.environ.get("KTPU_BENCH_STREAM_RATE_LOW", 200.0))
+    rate_mid = float(os.environ.get("KTPU_BENCH_STREAM_RATE_MID", 800.0))
+    interval_s = float(os.environ.get("KTPU_BENCH_STREAM_INTERVAL", 0.05))
+    cfg = StreamingConfig(
+        watermark=int(os.environ.get("KTPU_BENCH_STREAM_WATERMARK", 64)),
+        lane_deadline_s=(0.002, 0.010, 0.050),
+    )
+
+    def build():
+        rng = np.random.default_rng(42)
+        bus = APIServer()
+        sched = Scheduler(model=PlacementModel(
+            config=SolverConfig(unroll=BENCH_UNROLL)))
+        wire_scheduler(bus, sched)
+        for i in range(n_nodes):
+            bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+                name=f"n{i}", allocatable={CPU: 64000, MEM: 131072}))
+            bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+                node_name=f"n{i}",
+                node_usage={CPU: int(rng.integers(500, 30000)),
+                            MEM: int(rng.integers(512, 65536))},
+                update_time=10.0))
+        return bus, sched
+
+    warm_max = int(os.environ.get("KTPU_BENCH_STREAM_WARM_PODS", 1024))
+
+    def warm(sched, bus):
+        """Compile-warm every pod-bucket variant the stream can hit
+        (quarter-pow2 buckets up to ``warm_max``): a round mid-stream
+        must never pay an XLA compile, or the latency comparison
+        measures the compiler. One slow pass per process — jax shares
+        the compiled executables across the later builds' fresh jit
+        wrappers. The warm pods are deleted afterwards so the measured
+        world starts pristine (and identical across arms)."""
+        from koordinator_tpu.parallel.mesh import pow2_quarter_bucket
+
+        buckets = sorted({1} | {
+            pow2_quarter_bucket(s, floor=8)
+            for s in range(1, warm_max + 1)
+        })
+        for b, size in enumerate(buckets):
+            uids = []
+            for j in range(size):
+                pod = PodSpec(name=f"warm{b}x{j}",
+                              requests={CPU: 100, MEM: 64})
+                bus.apply(Kind.POD, pod.uid, pod)
+                uids.append(pod.uid)
+            sched.schedule_pending(now=15.0)
+            for uid in uids:
+                bus.delete(Kind.POD, uid)
+        sched.timelines.reset()
+
+    def trace_for(rate, seed=23):
+        return heavy_tail_trace(seed, duration_s=duration_s,
+                                rate_pods_per_s=rate, cpu_cap=8000)
+
+    def run_adaptive(rate, seed=23):
+        """Open-loop wall-clock drive of the adaptive trigger (one
+        thread: submissions and round-firing interleave exactly as the
+        trigger dictates; the pipeline overlaps solve/publish)."""
+        bus, sched = build()
+        warm(sched, bus)
+        loop = StreamingLoop(
+            sched,
+            apply_fn=lambda pod: bus.apply(Kind.POD, pod.uid, pod),
+            delete_fn=lambda uid: bus.delete(Kind.POD, uid),
+            config=cfg, pipelined=True, log=lambda *a: None,
+        )
+        trace = trace_for(rate, seed)
+        pods_by_uid = {}
+        t0 = time.perf_counter()
+        i = 0
+        arrivals = trace.arrivals
+        try:
+            while i < len(arrivals):
+                now = time.perf_counter() - t0
+                while i < len(arrivals) and arrivals[i].at <= now:
+                    a = arrivals[i]
+                    pod = PodSpec(
+                        name=a.name, qos=a.qos,
+                        requests={CPU: a.cpu, MEM: a.memory})
+                    pods_by_uid[pod.uid] = dataclasses.replace(pod)
+                    loop.submit(pod)
+                    i += 1
+                reason = loop.due()
+                if reason is not None:
+                    loop.fire_round(reason)
+                    continue
+                nxt = arrivals[i].at - (time.perf_counter() - t0) \
+                    if i < len(arrivals) else 0.0
+                dl = loop.gate.next_deadline()
+                # the gate's deadlines live on ITS clock
+                # (time.monotonic) — never mix clock domains here
+                wait = nxt if dl is None else min(
+                    nxt, max(0.0, dl - time.monotonic()))
+                if wait > 0:
+                    time.sleep(min(wait, 0.005))
+            drained = loop.drain(timeout_s=30.0)
+            drain_wall = time.perf_counter() - t0 - arrivals[-1].at
+        finally:
+            loop.stop()
+        st = loop.status()
+        lat = sched.timelines.stats()
+        return {
+            "bus": bus, "sched": sched, "status": st,
+            "round_log": list(loop.round_log),
+            "pods_by_uid": pods_by_uid,
+            "latency": lat, "drained": drained,
+            "drain_wall_s": max(0.0, drain_wall),
+            "submitted": st["gate"]["submitted"],
+            "bound": st["gate"]["bound"],
+            "shed": st["gate"]["shed"]["capacity"]
+            + st["gate"]["shed"]["deadline-exceeded"],
+            "rounds": st["rounds"],
+        }
+
+    def run_fixed(rate, seed=23):
+        """The same open-loop trace on the fixed cadence: a pipelined
+        round every interval_s regardless of queue state (run_loop's
+        shape) — the baseline the adaptive trigger must beat."""
+        bus, sched = build()
+        warm(sched, bus)
+        pipeline = TickPipeline(sched, log=lambda *a: None)
+        trace = trace_for(rate, seed)
+        arrivals = trace.arrivals
+        t0 = time.perf_counter()
+        next_round = t0 + interval_s
+        i = 0
+        rounds = 0
+        try:
+            while i < len(arrivals):
+                now = time.perf_counter()
+                while i < len(arrivals) \
+                        and arrivals[i].at <= now - t0:
+                    a = arrivals[i]
+                    pod = PodSpec(
+                        name=a.name, qos=a.qos,
+                        requests={CPU: a.cpu, MEM: a.memory})
+                    bus.apply(Kind.POD, pod.uid, pod)
+                    i += 1
+                if now >= next_round:
+                    pipeline.submit_round(now=time.time())
+                    pipeline.prestage(now=time.time())
+                    rounds += 1
+                    next_round += interval_s
+                    continue
+                nxt_arr = (t0 + arrivals[i].at
+                           if i < len(arrivals) else next_round)
+                wait = min(next_round, nxt_arr) - time.perf_counter()
+                if wait > 0:
+                    time.sleep(min(wait, 0.005))
+            # drain on the same cadence until everything published
+            deadline = time.perf_counter() + 30.0
+            while time.perf_counter() < deadline:
+                pipeline.drain("bench")
+                if not sched.cache.pending:
+                    break
+                pipeline.submit_round(now=time.time())
+                rounds += 1
+        finally:
+            pipeline.stop()
+        return {
+            "bus": bus, "sched": sched,
+            "latency": sched.timelines.stats(),
+            "rounds": rounds,
+        }
+
+    def facet(rate, seed=23):
+        # best-of-2 per arm on the p99: external load only ever ADDS
+        # latency, so the min over runs isolates the systematic term —
+        # the same spike-immunity argument as _timed()'s min and leg
+        # 13's min-vs-min observatory overhead
+        def best(run_fn):
+            runs = [run_fn(rate, seed) for _ in range(2)]
+            return min(
+                runs,
+                key=lambda r: r["latency"]["all"]["p99_s"] or 1e9,
+            )
+
+        fixed = best(run_fixed)
+        adaptive = best(run_adaptive)
+        f_lat, a_lat = fixed["latency"]["all"], adaptive["latency"]["all"]
+        improvement = (
+            f_lat["p99_s"] / a_lat["p99_s"]
+            if a_lat["p99_s"] else 0.0
+        )
+        return fixed, adaptive, {
+            "rate_pods_per_s": rate,
+            "fixed_p50_s": f_lat["p50_s"],
+            "fixed_p99_s": f_lat["p99_s"],
+            "adaptive_p50_s": a_lat["p50_s"],
+            "adaptive_p99_s": a_lat["p99_s"],
+            "p99_improvement": improvement,
+            "fixed_rounds": fixed["rounds"],
+            "adaptive_rounds": adaptive["rounds"],
+            "pods": a_lat["count"],
+            # equal throughput: both arms placed the full stream
+            "equal_throughput": (
+                f_lat["count"] == a_lat["count"]
+                == adaptive["submitted"]
+            ),
+            "shed": adaptive["shed"],
+        }
+
+    def replay_identical(adaptive):
+        """The adaptive arm's recorded batches through the plain
+        fixed-round loop: placements + node accounting bit-for-bit."""
+        bus, sched = build()
+        warm(sched, bus)
+        for _reason, at, uids in adaptive["round_log"]:
+            for uid in uids:
+                pod = adaptive["pods_by_uid"].get(uid)
+                if pod is not None:
+                    bus.apply(Kind.POD, pod.uid, pod)
+            sched.schedule_pending(now=at)
+        mine = {u: getattr(p, "node_name", None)
+                for u, p in adaptive["bus"].list(Kind.POD).items()}
+        theirs = {u: getattr(p, "node_name", None)
+                  for u, p in bus.list(Kind.POD).items()}
+        if mine != theirs:
+            return False
+        got = lower_nodes(snapshot_from_bus(
+            adaptive["bus"], now=1e9))
+        want = lower_nodes(snapshot_from_bus(bus, now=1e9))
+        return got.names == want.names and all(
+            np.array_equal(getattr(got, f), getattr(want, f))
+            for f in STAGED_NODE_FIELDS
+        )
+
+    low_fixed, low_adaptive, low = facet(rate_low, seed=23)
+    mid_fixed, mid_adaptive, mid = facet(rate_mid, seed=29)
+    identical = replay_identical(mid_adaptive)
+
+    # -- the shed point: highest sustainable rate on a rate ladder ----------
+    max_rate = float(os.environ.get("KTPU_BENCH_STREAM_RATE_MAX", 16000))
+    ladder_s = float(os.environ.get("KTPU_BENCH_STREAM_LADDER_S", 1.5))
+    rate = max(2 * rate_mid, 2000.0)
+    sustained = rate_mid
+    shed_at = None
+    prev_duration = duration_s
+    duration_s = ladder_s
+    try:
+        while rate <= max_rate:
+            arm = run_adaptive(rate, seed=31)
+            ok = (arm["shed"] == 0 and arm["drained"]
+                  and arm["bound"] == arm["submitted"]
+                  and arm["drain_wall_s"] <= 1.0)
+            if not ok:
+                shed_at = rate
+                break
+            sustained = rate
+            rate *= 2
+    finally:
+        duration_s = prev_duration
+
+    return {
+        "n_nodes": n_nodes,
+        "duration_s": duration_s,
+        "interval_s": interval_s,
+        "watermark": cfg.watermark,
+        "lane_deadline_s": list(cfg.lane_deadline_s),
+        "low": low,
+        "mid": mid,
+        # HEADLINE: adaptive vs fixed p99 at the mid sustained rate
+        "p99_improvement_mid": mid["p99_improvement"],
+        "p99_improvement_ge_2": mid["p99_improvement"] >= 2.0,
+        "adaptive_p99_s": mid["adaptive_p99_s"],
+        "fixed_p99_s": mid["fixed_p99_s"],
+        "equal_throughput": low["equal_throughput"]
+        and mid["equal_throughput"],
+        "identical_to_fixed_replay": identical,
+        "max_sustained_rate_pods_per_s": sustained,
+        "shed_at_rate_pods_per_s": shed_at,
+    }
+
+
 def bench_outage_failover_churn(repeats):
     """Config #11 (failure-domain hardening): a sidecar-backed churn
     run with the sidecar SIGKILLed mid-churn, under the supervised
@@ -3536,6 +3867,14 @@ def main():
         )
         matrix["16_multi_tenant_pool"] = leg(
             _leg_subprocess, "16_multi_tenant_pool"
+        )
+    if os.environ.get("KTPU_BENCH_STREAMING", "1") != "0":
+        # the continuous-arrival serving leg (ISSUE 14): adaptive
+        # trigger vs fixed cadence at sustained open-loop rates, plus
+        # the shed point — its own toggle so the vcpu record rounds
+        # (KTPU_BENCH_MATRIX=0) still measure the serving face
+        matrix["18_streaming_arrival"] = leg(
+            bench_streaming_arrival, repeats
         )
     if os.environ.get("KTPU_BENCH_WARMPROBE", "1") != "0":
         matrix["warm_start"] = leg(bench_warm_start)

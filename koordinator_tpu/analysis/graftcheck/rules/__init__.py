@@ -201,7 +201,28 @@ LOCK_SPECS = (
         path="koordinator_tpu/obs/timeline.py",
         class_name="PodTimelines",
         lock="_lock",
-        attrs=("_active", "_completed", "_dropped"),
+        attrs=("_active", "_completed", "_dropped", "_on_drop"),
+    ),
+    # the streaming intake (docs/DESIGN.md §22): submitter threads
+    # admit, the loop thread takes rounds, the pipeline's publisher
+    # resolves outcomes, debug-mux readers snapshot — one condition
+    # guards it all (shared with the loop's trigger wait)
+    LockSpec(
+        path="koordinator_tpu/scheduler/streaming.py",
+        class_name="ArrivalGate",
+        lock="_lock",
+        attrs=("_lanes", "_by_uid", "_inflight", "_waiting",
+               "_resolved", "_resolved_map", "_stats"),
+    ),
+    # the streaming loop's own bookkeeping (round counters, the
+    # replayable round log): loop thread writes, status() readers and
+    # the publisher-thread round resolution cross it
+    LockSpec(
+        path="koordinator_tpu/scheduler/streaming.py",
+        class_name="StreamingLoop",
+        lock="_lock",
+        attrs=("_rounds", "_skipped", "_last_trigger",
+               "_last_fired_at", "round_log"),
     ),
     # the flight recorder: tick paths record, anomaly paths trigger
     # (possibly from other threads), the mux reads dumps

@@ -158,8 +158,8 @@ def wire_scheduler(bus: APIServer, scheduler, elector=None) -> None:
         TRACER.emit("publish", cat="publish", t0=t0,
                     args={"published": published})
 
-    def schedule_and_publish(now=None):
-        out = inner_schedule(now=now)
+    def schedule_and_publish(now=None, trigger=None):
+        out = inner_schedule(now=now, trigger=trigger)
         # watchdog mark: the serial loop publishes inline (the
         # pipelined path opens its own mark from the publisher
         # worker), so without this a publish wedged on a half-open

@@ -103,6 +103,26 @@ class SchedulerConfig:
     #: Requires >= node_shards attached devices; in-process backend
     #: only (the sidecar stages its own world)
     node_shards: int = 1
+    #: streaming serving mode (scheduler/streaming.py, docs/DESIGN.md
+    #: §22): pods arrive on an open-loop stream into the QoS-laned
+    #: intake and rounds fire ADAPTIVELY — batch-size watermark OR
+    #: oldest-pod lane deadline, whichever comes first — instead of on
+    #: the fixed schedule_interval cadence. The headline metric becomes
+    #: per-pod submit→bind p50/p99 at a sustained arrival rate.
+    streaming: bool = False
+    #: batch-size trigger: a round fires as soon as this many arrivals
+    #: are queued (a burst amortizes into one dispatch)
+    stream_watermark: int = 64
+    #: per-lane queue-wait targets (system, ls, be) in seconds: the
+    #: oldest queued pod's submit + lane deadline is the other trigger
+    stream_deadline_system_s: float = 0.002
+    stream_deadline_ls_s: float = 0.010
+    stream_deadline_be_s: float = 0.050
+    #: intake bound: arrivals past this shed (BE first, typed + counted)
+    stream_capacity: int = 4096
+    #: floor between adaptively-fired rounds (0 = none): bounds the
+    #: dispatch rate a trickle of deadline-armed singletons can drive
+    stream_min_interval_s: float = 0.0
     #: AOT warm pool (service/warmpool.py, docs/DESIGN.md §21):
     #: restore serialized executables for the hot solve signatures at
     #: startup and on leader promotion, and persist newly-observed
@@ -245,10 +265,63 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
     return scheduler
 
 
+def stream_config(config: SchedulerConfig):
+    """The SchedulerConfig's streaming knobs as a StreamingConfig."""
+    from koordinator_tpu.scheduler.streaming import StreamingConfig
+
+    return StreamingConfig(
+        watermark=config.stream_watermark,
+        lane_deadline_s=(
+            config.stream_deadline_system_s,
+            config.stream_deadline_ls_s,
+            config.stream_deadline_be_s,
+        ),
+        capacity=config.stream_capacity,
+        min_round_interval_s=config.stream_min_interval_s,
+    )
+
+
+def build_streaming_loop(scheduler, bus, config: SchedulerConfig,
+                         auditor=None, log=print):
+    """Wire a :class:`~koordinator_tpu.scheduler.streaming.
+    StreamingLoop` over the bus: admitted arrivals land as Pod applies,
+    shed victims / expired pods are bus-deleted (typed, observed by
+    every wired component), and pending pods applied by OTHER
+    components enter the intake through a watch — so the open-loop
+    stream and ordinary informer traffic share one trigger."""
+    from koordinator_tpu.client.bus import EventType, Kind
+    from koordinator_tpu.scheduler.streaming import StreamingLoop
+
+    loop = StreamingLoop(
+        scheduler,
+        apply_fn=lambda pod: bus.apply(Kind.POD, pod.uid, pod),
+        delete_fn=lambda uid: bus.delete(Kind.POD, uid),
+        config=stream_config(config),
+        pipelined=config.pipelined_ticks,
+        auditor=auditor,
+        log=log,
+    )
+
+    def on_pod(event, name, pod):
+        # externally-applied pending pods join the intake (their lane
+        # deadline arms the trigger); loop.submit()'s own applies are
+        # already tracked and skipped, bound/assigned pods are not
+        # arrivals, DELETEs are handled by the remove_pod chain
+        if event is EventType.DELETED:
+            return
+        if getattr(pod, "node_name", None) is not None:
+            return
+        loop.observe(pod)
+
+    bus.watch(Kind.POD, on_pod)
+    scheduler.services.register("streaming", loop.status)
+    return loop
+
+
 def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
              log=print, elector=None, now_fn=time.time,
              max_rounds: Optional[int] = None, auditor=None,
-             pipeline=None, sleep_fn=time.sleep) -> int:
+             pipeline=None, sleep_fn=time.sleep, streaming=None) -> int:
     """The scheduling loop over a wired bus: solve the pending queue
     every interval. A sidecar outage without failover skips the round —
     COUNTED and logged, never silent (``scheduler_rounds_skipped_total``
@@ -292,6 +365,30 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
         SolverOverloaded,
         SolverUnavailable,
     )
+
+    if config.streaming or streaming is not None:
+        # streaming serving mode (DESIGN §22): the adaptive trigger
+        # replaces the fixed cadence entirely — the StreamingLoop owns
+        # its own pipeline, auditor cadence, and watchdog polls
+        if streaming is None:
+            raise ValueError(
+                "streaming mode needs a bus-wired StreamingLoop — "
+                "build one with build_streaming_loop(scheduler, bus, "
+                "config) and pass it as streaming="
+            )
+        if elector is not None:
+            raise ValueError(
+                "streaming mode does not support --leader-elect yet "
+                "(ROADMAP: fold the lease gate into the trigger loop)"
+            )
+        if once:
+            raise ValueError("--once is a fixed-cadence concept; "
+                             "streaming mode serves continuously")
+        try:
+            streaming.run()  # blocks until streaming.stop()
+        finally:
+            streaming.stop()
+        return 0
 
     if pipeline is None and config.pipelined_ticks:
         from koordinator_tpu.scheduler.pipeline import TickPipeline
@@ -534,6 +631,42 @@ def main(argv=None) -> int:
              "(bit-identical placements; sub-10ms round critical path)",
     )
     parser.add_argument(
+        "--streaming", action="store_true",
+        help="continuous-arrival serving mode: rounds fire adaptively "
+             "(batch-size watermark OR oldest-pod lane deadline) "
+             "instead of on the fixed --schedule-interval cadence "
+             "(docs/DESIGN.md §22)",
+    )
+    parser.add_argument(
+        "--stream-watermark", type=int, default=64,
+        help="streaming batch-size trigger: fire a round once this "
+             "many arrivals are queued",
+    )
+    parser.add_argument(
+        "--stream-deadline-system", type=float, default=0.002,
+        help="system-lane queue-wait target in seconds (the deadline "
+             "trigger for the highest-priority lane)",
+    )
+    parser.add_argument(
+        "--stream-deadline-ls", type=float, default=0.010,
+        help="latency-sensitive-lane queue-wait target in seconds",
+    )
+    parser.add_argument(
+        "--stream-deadline-be", type=float, default=0.050,
+        help="best-effort-lane queue-wait target in seconds",
+    )
+    parser.add_argument(
+        "--stream-capacity", type=int, default=4096,
+        help="streaming intake bound: arrivals past this shed (BE "
+             "first, typed + counted — never silence)",
+    )
+    parser.add_argument(
+        "--stream-min-interval", type=float, default=0.0,
+        help="floor between adaptively-fired rounds in seconds (0 = "
+             "none): bounds the dispatch rate a trickle of urgent "
+             "singletons can drive",
+    )
+    parser.add_argument(
         "--cluster-json", default=None,
         help="seed the bus from a cluster-spec JSON file",
     )
@@ -637,6 +770,13 @@ def main(argv=None) -> int:
         monitor_timeout_seconds=args.monitor_timeout,
         node_shards=args.node_shards,
         warm_pool=not args.no_warm_pool,
+        streaming=args.streaming,
+        stream_watermark=args.stream_watermark,
+        stream_deadline_system_s=args.stream_deadline_system,
+        stream_deadline_ls_s=args.stream_deadline_ls,
+        stream_deadline_be_s=args.stream_deadline_be,
+        stream_capacity=args.stream_capacity,
+        stream_min_interval_s=args.stream_min_interval,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler
@@ -709,6 +849,14 @@ def main(argv=None) -> int:
                         prev()
 
                 elector.on_started_leading = _on_started
+        streaming = None
+        if config.streaming:
+            # the continuous-arrival front end (DESIGN §22): wired
+            # over the bus so open-loop submissions and ordinary
+            # informer traffic share one adaptive trigger
+            streaming = build_streaming_loop(
+                scheduler, bus, config, auditor=auditor,
+            )
         if args.cluster_json:
             seed_bus_from_json(bus, args.cluster_json)
         if args.debug_port is not None:
@@ -757,7 +905,7 @@ def main(argv=None) -> int:
             ).start()
             print(f"debug http on 127.0.0.1:{http_server.port}")
         return run_loop(scheduler, config, once=args.once, elector=elector,
-                        auditor=auditor)
+                        auditor=auditor, streaming=streaming)
     finally:
         if http_server is not None:
             http_server.stop()

@@ -180,6 +180,45 @@ FLIGHT_DUMPS = SCHEDULER_METRICS.counter(
     label_names=("trigger",),
 )
 
+# -- streaming serving mode (scheduler/streaming.py) ------------------------
+# The continuous-arrival front end: pods arrive on an open-loop stream
+# into QoS-laned intake, rounds fire adaptively (batch-size watermark OR
+# oldest-pod deadline), and the headline series is the per-pod
+# submit→bind histogram above at a sustained arrival rate
+# (docs/DESIGN.md §22).
+
+STREAM_ARRIVALS = SCHEDULER_METRICS.counter(
+    "scheduler_streaming_arrivals_total",
+    "Pod arrivals admitted into the streaming intake, by QoS lane",
+    label_names=("lane",),  # system | ls | be
+)
+STREAM_SHED = SCHEDULER_METRICS.counter(
+    "scheduler_streaming_shed_total",
+    "Arrivals refused or evicted by the streaming intake — the "
+    "backpressure signal (capacity = intake full; timeline-capacity = "
+    "the pod scheduled but its latency sample was refused by the "
+    "timeline registry; deadline = expired after max_pod_rounds)",
+    label_names=("lane", "reason"),
+)
+STREAM_TRIGGERS = SCHEDULER_METRICS.counter(
+    "scheduler_streaming_round_triggers_total",
+    "Adaptively-fired scheduling rounds, by what fired them "
+    "(watermark = batch-size; deadline = oldest-pod lane deadline; "
+    "idle = the periodic backstop re-solving leftover pending pods)",
+    label_names=("reason",),
+)
+STREAM_QUEUE_DEPTH = SCHEDULER_METRICS.gauge(
+    "scheduler_streaming_queue_depth",
+    "Arrivals queued in the streaming intake awaiting a round, by lane",
+    label_names=("lane",),
+)
+STREAM_BATCH_PODS = SCHEDULER_METRICS.histogram(
+    "scheduler_streaming_round_batch_pods",
+    "Arrival-batch size per adaptively-fired round (how well the "
+    "trigger amortizes dispatches without stretching the tail)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+
 # -- device-cost observatory (koordinator_tpu/obs/device.py) ----------------
 # The device-side twin of the trace fabric: compile telemetry, padding
 # waste, and live-buffer accounting. These live in their OWN registry

@@ -77,8 +77,19 @@ class PodTimelines:
         self._completed: deque = deque(maxlen=completed_capacity)
         #: submits refused at capacity (the backlog cost samples)
         self._dropped = 0
+        #: optional backpressure hook, called (outside the lock) with
+        #: the refused uid whenever a submit is dropped at capacity —
+        #: the streaming intake wires its shed accounting here so a
+        #: refused sample is visible as backpressure, not silence
+        self._on_drop = None
 
     # -- stamps --------------------------------------------------------------
+
+    def set_drop_hook(self, hook) -> None:
+        """Wire (or clear, with None) the capacity-refusal hook: called
+        with the refused uid, outside the lock, once per drop."""
+        with self._lock:
+            self._on_drop = hook
 
     def submit(self, uid: str, lane: str = "ls") -> None:
         """Open a timeline (idempotent: informer refreshes of a pending
@@ -94,8 +105,12 @@ class PodTimelines:
                 # capacity must cost the newest samples, not the tail
                 # (and never memory) — counted so the gap is visible
                 self._dropped += 1
+                hook = self._on_drop
+            else:
+                self._active[uid] = (lane, {"submit": t})
                 return
-            self._active[uid] = (lane, {"submit": t})
+        if hook is not None:
+            hook(uid)
 
     def mark(self, uid: str, stage: str) -> None:
         t = self._clock()
@@ -165,11 +180,18 @@ class PodTimelines:
 
     # -- read side -----------------------------------------------------------
 
-    def stats(self) -> dict:
+    def stats(self, window_s: Optional[float] = None) -> dict:
         """p50/p99 submit→published over the completed ring, overall
-        and per lane — what bench legs 10/13 record."""
+        and per lane — what bench legs 10/13/18 record. With
+        ``window_s``, only samples PUBLISHED within the trailing
+        window count: the rolling view a serving dashboard needs (the
+        all-time ring mixes a cold start's tail into steady state)."""
+        cutoff = None if window_s is None else self._clock() - window_s
         with self._lock:
-            samples = [(lane, e2e) for lane, e2e, _ in self._completed]
+            samples = [
+                (lane, e2e) for lane, e2e, stamps in self._completed
+                if cutoff is None or stamps.get("published", 0) >= cutoff
+            ]
 
         def pct(xs: List[float]) -> dict:
             if not xs:
@@ -189,8 +211,14 @@ class PodTimelines:
                 out[lane] = pct(lane_samples)
         return out
 
+    #: rolling-window width served by status() (seconds of the
+    #: timeline clock — the trailing view beside the all-time ring)
+    ROLLING_WINDOW_S = 30.0
+
     def status(self) -> dict:
-        """Debug-mux payload: in-flight depth + latency percentiles."""
+        """Debug-mux payload: in-flight depth, the dropped-sample
+        backpressure counter, all-time AND rolling-window latency
+        percentiles."""
         with self._lock:
             inflight = len(self._active)
             completed = len(self._completed)
@@ -200,6 +228,10 @@ class PodTimelines:
             "completed": completed,
             "dropped": dropped,
             "latency": self.stats(),
+            "rolling": {
+                "window_s": self.ROLLING_WINDOW_S,
+                **self.stats(window_s=self.ROLLING_WINDOW_S),
+            },
         }
 
     def reset(self) -> None:
@@ -207,3 +239,4 @@ class PodTimelines:
             self._active.clear()
             self._completed.clear()
             self._dropped = 0
+            self._on_drop = None

@@ -104,13 +104,16 @@ class TickPipeline:
 
     # -- coordinator side ----------------------------------------------------
 
-    def submit_round(self, now: Optional[float] = None) -> float:
+    def submit_round(self, now: Optional[float] = None,
+                     trigger: Optional[str] = None) -> float:
         """One pipelined round's critical path: wait for the previous
         tick to retire (surfacing any deferred publish-side error at
         this round boundary), then stage + dispatch this round and hand
         it to the publisher. Returns the critical-path seconds — what
         the round actually cost the loop; the solve compute and publish
-        drain in the background."""
+        drain in the background. ``trigger`` annotates why the round
+        fired (the streaming mode's adaptive triggers) onto its trace
+        spans."""
         t0 = time.perf_counter()
         self._surface(wait=True)
         TRACER.emit("retire_wait", cat="pipeline", t0=t0)
@@ -118,7 +121,7 @@ class TickPipeline:
             if self._stopped:
                 raise RuntimeError("tick pipeline is stopped")
             self._rounds += 1
-        tick = self.scheduler.begin_tick(now)
+        tick = self.scheduler.begin_tick(now, trigger=trigger)
         with self._lock:
             self._inflight = True
         self._retired.clear()
@@ -335,6 +338,7 @@ class TickPipeline:
         FLIGHT.record_round({
             "round": rid,
             "at": tick.at,
+            "trigger": getattr(tick, "trigger", None),
             "placed": placed,
             "total": len(result),
             "waiting": len(result.waiting),

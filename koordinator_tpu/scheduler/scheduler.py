@@ -68,10 +68,11 @@ class PendingTick:
     half to overlap). ``commit_tick`` consumes it exactly once."""
 
     __slots__ = ("at", "pending", "inflight", "solve_started", "result",
-                 "round_id")
+                 "round_id", "trigger")
 
     def __init__(self, at, pending=None, inflight=None,
-                 solve_started=None, result=None, round_id=0):
+                 solve_started=None, result=None, round_id=0,
+                 trigger=None):
         self.at = at
         self.pending = pending or {}
         self.inflight = inflight
@@ -81,6 +82,9 @@ class PendingTick:
         #: retiring this tick carry the SAME id as the coordinator's
         #: staging spans, so cross-thread work joins one trace round
         self.round_id = round_id
+        #: why this round fired (streaming mode: watermark | deadline |
+        #: idle; None = fixed cadence) — annotated onto the round span
+        self.trigger = trigger
 
 
 class Scheduler:
@@ -457,7 +461,8 @@ class Scheduler:
 
     # -- scheduling ---------------------------------------------------------
 
-    def schedule_pending(self, now: Optional[float] = None) -> ScheduleResult:
+    def schedule_pending(self, now: Optional[float] = None,
+                         trigger: Optional[str] = None) -> ScheduleResult:
         """One batched round: expire stale state (gang WaitTime,
         reservations), solve the whole pending queue on device, and assume
         committed placements (and waiting holds) into the cache.
@@ -467,15 +472,19 @@ class Scheduler:
         followed by :meth:`commit_tick` (materialize + epilogue). The
         pipelined loop (scheduler/pipeline.py) calls the halves from
         different threads so the epilogue and publish ride the publisher
-        worker while the next round stages."""
-        return self.commit_tick(self.begin_tick(now))
+        worker while the next round stages. ``trigger`` annotates why
+        the round fired (streaming mode) onto its trace spans."""
+        return self.commit_tick(self.begin_tick(now, trigger=trigger))
 
-    def begin_tick(self, now: Optional[float] = None) -> "PendingTick":
+    def begin_tick(self, now: Optional[float] = None,
+                   trigger: Optional[str] = None) -> "PendingTick":
         """Round start through solve DISPATCH: expire stale state, take
         the snapshot, and hand the pending queue to the model without
         materializing results. Raises the same typed solver errors a
         blocking round would (the dispatch is where a sidecar outage
-        surfaces)."""
+        surfaces). ``trigger`` annotates WHY the round fired (the
+        streaming mode's adaptive triggers, docs/DESIGN.md §22) onto
+        the round's trace spans."""
         from koordinator_tpu.metrics.components import PENDING_PODS
 
         at0 = now if now is not None else time.time()
@@ -498,7 +507,7 @@ class Scheduler:
             if not self.batched_placement:
                 return PendingTick(
                     at=at0, result=self._schedule_pending_incremental(now),
-                    round_id=rid,
+                    round_id=rid, trigger=trigger,
                 )
             snapshot = self.cache.snapshot(now=now)
             pending = {pod.uid: pod for pod in snapshot.pending_pods}
@@ -513,10 +522,12 @@ class Scheduler:
             TRACER.mark_closed(f"round:{rid}")
             raise
         TRACER.emit("begin_tick", cat="tick", t0=t_begin,
-                    round_id=rid, args={"pending": len(pending)})
+                    round_id=rid,
+                    args={"pending": len(pending),
+                          **({"trigger": trigger} if trigger else {})})
         return PendingTick(
             at=at0, pending=pending, inflight=inflight,
-            solve_started=solve_started, round_id=rid,
+            solve_started=solve_started, round_id=rid, trigger=trigger,
         )
 
     def commit_tick(self, tick: "PendingTick") -> ScheduleResult:
@@ -604,6 +615,7 @@ class Scheduler:
             args={
                 "placed": sum(1 for v in result.values() if v is not None),
                 "total": len(result),
+                **({"trigger": tick.trigger} if tick.trigger else {}),
             },
         )
         return result

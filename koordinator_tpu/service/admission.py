@@ -248,6 +248,15 @@ _jit_coalesced = DEVICE_OBS.jit("coalesced_solve", jax.jit(
 _jit_coalesced_assign = DEVICE_OBS.jit("coalesced_solve_assign", jax.jit(
     _vmapped_plain_assign, static_argnames=("config",), donate_argnums=()
 ))
+# AOT warm pool (docs/DESIGN.md §21): the gate's coalesced dispatches
+# join the manifest like the solo sidecar solve — a respawned pooled
+# sidecar's first coalesced burst restores the stacked program instead
+# of cold-compiling. Never donates (§19.2; graftcheck-pinned adopts).
+from koordinator_tpu.service.warmpool import WARM_POOL  # noqa: E402
+
+WARM_POOL.adopt(_jit_coalesced, _vmapped_plain_solve, config_argpos=3)
+WARM_POOL.adopt(_jit_coalesced_assign, _vmapped_plain_assign,
+                config_argpos=3)
 
 
 def solve_coalesced(
@@ -311,13 +320,16 @@ def solve_coalesced(
         blocked=jnp.asarray(blocked),
         **{f: jnp.asarray(v) for f, v in cols.items()},
     )
+    # config rides POSITIONALLY (jax resolves static_argnames to
+    # argnums): the warm pool answers only kwarg-free calls, and this
+    # is the call shape its persisted AOT programs expect
     if want_state:
-        result = _jit_coalesced(state, pods, params, config=config)
+        result = _jit_coalesced(state, pods, params, config)
         assign_all = np.asarray(result.assign)
         used_all = np.asarray(result.node_state.used_req)
     else:
         assign_all = np.asarray(
-            _jit_coalesced_assign(state, pods, params, config=config)
+            _jit_coalesced_assign(state, pods, params, config)
         )
         used_all = None
     out: List[SolveResponse] = []

@@ -432,6 +432,19 @@ _jit_tenant_full = DEVICE_OBS.jit("tenant_pool_solve_full", jax.jit(
     _vmapped_tenant_solve_full, static_argnames=("config",),
     donate_argnums=(),
 ))
+# Tenant-aware warm manifest (ROADMAP 2b, docs/DESIGN.md §21/§22): the
+# pool program's aval signature IS the tenant shape-bucket signature —
+# [K*, N*, ...] bucketed axes, zero tenant data — so a persisted lane
+# dispatch warms EVERY tenant that lands in the bucket, including a
+# tenant the sidecar has never seen: its first solve restores the
+# stacked program from the store instead of cold-compiling. Adoption is
+# legal because the bindings never donate (§19.2; graftcheck pins every
+# adopt site against its binding).
+from koordinator_tpu.service.warmpool import WARM_POOL  # noqa: E402
+
+WARM_POOL.adopt(_jit_tenant, _vmapped_tenant_solve, config_argpos=3)
+WARM_POOL.adopt(_jit_tenant_full, _vmapped_tenant_solve_full,
+                config_argpos=3)
 
 #: lane-sharded dispatch (multi-device hosts): mesh + solver built
 #: lazily, cached per (config, want_state) — the virtual 8-device test
@@ -607,12 +620,14 @@ def _solve_lane_chunk(pairs, config, want_state: bool,
     if solver is not None:
         used_req, assign = solver(states, pods, params)
     elif want_state:
-        used_req, assign = _jit_tenant_full(
-            states, pods, params, config=config
-        )
+        # config rides POSITIONALLY (jax resolves static_argnames to
+        # argnums): the warm pool's serve() answers only kwarg-free
+        # calls, and this is exactly the call shape its AOT programs
+        # were persisted under
+        used_req, assign = _jit_tenant_full(states, pods, params, config)
     else:
         used_req = None
-        assign = _jit_tenant(states, pods, params, config=config)
+        assign = _jit_tenant(states, pods, params, config)
     assign_all = np.asarray(assign)
     used_all = None if used_req is None else np.asarray(used_req)
     out: List[SolveResponse] = []

@@ -725,6 +725,9 @@ class WarmPool:
         status(), both debug muxes): counters, what is installed, the
         last restore report — cheap, never compiles or touches disk."""
         with self._lock:
+            programs: Dict[str, int] = {}
+            for (program, _ck, _sig) in self._manifest:
+                programs[program] = programs.get(program, 0) + 1
             return {
                 "active": self._cache is not None,
                 "serving": self.serving,
@@ -734,6 +737,11 @@ class WarmPool:
                 "executables": len(self._execs),
                 "registered": sorted(self._reg),
                 "manifest_rows": len(self._manifest),
+                # per-program row counts: the tenant-pool rows here are
+                # shape-BUCKET signatures ([K*,N*,...] axes, no tenant
+                # data), so "is a new tenant's first bucket warm?" is
+                # answerable from one GET (ROADMAP 2b)
+                "manifest_programs": programs,
                 "hits": self.hits,
                 "misses": self.misses,
                 "rejects": dict(self.rejects),

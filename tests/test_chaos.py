@@ -666,3 +666,129 @@ def test_chaos_restart_storm_warm_restores(tmp_path, xla_compiles):
     assert status["served"] == 0          # cold: the jit path answered
     assert last["ticks"] == TICKS - CORRUPT_BEFORE  # zero lost ticks
     assert last["auditor"].last_report["kind"] == "promotion"
+
+
+@pytest.mark.chaos
+def test_chaos_streaming_burst_storm_sigkill(tmp_path):
+    """Streaming chaos slice (ISSUE 14 / DESIGN §22): a burst-storm
+    arrival trace served by the ADAPTIVE trigger through the pipelined
+    tick path, with the solver sidecar SIGKILLed mid-storm under
+    supervisor + failover. Every submitted pod must resolve (bound — no
+    typed sheds fire at this load), zero silent drops (submitted ==
+    bound once drained), and the run must end bit-identical to the
+    fault-free streaming run of the SAME trace — the outage changes
+    which backend answers, never what is decided or when rounds fire."""
+    import dataclasses
+
+    from koordinator_tpu.scheduler.streaming import (
+        StreamingConfig,
+        StreamingLoop,
+    )
+    from koordinator_tpu.testing.arrivals import make_trace, trace_pods
+
+    trace = make_trace("burst-storm", seed=9, duration_s=2.0,
+                       rate_pods_per_s=20.0, bursts=1, burst_pods=40,
+                       burst_span_s=0.020)
+    pairs, _gangs = trace_pods(trace)
+    storm_idx = [i for i, (_at, p) in enumerate(pairs) if "s0" in p.name]
+    kill_idx = storm_idx[len(storm_idx) // 2]  # mid-storm
+
+    def run(model, kill=None):
+        bus = APIServer()
+        sched = Scheduler(model=model)
+        wire_scheduler(bus, sched)
+        for i in range(N_NODES):
+            bus.apply(Kind.NODE, f"n{i}", NodeSpec(
+                name=f"n{i}", allocatable={CPU: 64000, MEM: 131072}))
+            bus.apply(Kind.NODE_METRIC, f"n{i}", NodeMetric(
+                node_name=f"n{i}", node_usage={}, update_time=90.0))
+        clock = [100.0]
+        loop = StreamingLoop(
+            sched,
+            apply_fn=lambda pod: bus.apply(Kind.POD, pod.uid, pod),
+            delete_fn=lambda uid: bus.delete(Kind.POD, uid),
+            config=StreamingConfig(
+                watermark=16, lane_deadline_s=(0.002, 0.010, 0.050)),
+            pipelined=True,
+            clock=lambda: clock[0], now_fn=lambda: clock[0],
+            log=lambda *a: None,
+        )
+        try:
+            for i, (at, pod) in enumerate(pairs):
+                clock[0] = 100.0 + at
+                assert loop.submit(
+                    dataclasses.replace(pod), now=clock[0]) == "queued"
+                if kill is not None and i == kill_idx:
+                    kill()
+                loop.pump(clock[0])
+            for _ in range(64):
+                clock[0] += 0.050
+                if loop.pump(clock[0]) is None \
+                        and loop.gate.unresolved() == 0:
+                    break
+        finally:
+            loop.stop()
+        placements = {u: getattr(p, "node_name", None)
+                      for u, p in bus.list(Kind.POD).items()}
+        return placements, bus, loop.status(), list(loop.round_log)
+
+    # ---- the faulty arm: sidecar + supervisor + failover -------------
+    solver_addr = str(tmp_path / "solver.sock")
+    handles = []
+
+    def spawn():
+        handle = InProcessSidecar(solver_addr)
+        handles.append(handle)
+        return handle
+
+    supervisor = SolverSupervisor(
+        solver_addr, spawn_fn=spawn,
+        probe_interval_s=0.2, probe_timeout_s=0.2, ready_timeout_s=30.0,
+        # respawn strictly slower than the post-kill solve's retry
+        # budget, so the outage reliably produces degraded solves
+        backoff_base_s=2.0, backoff_cap_s=2.0,
+    ).start()
+    remote = RemoteSolver(solver_addr, timeout=30.0, retries=0,
+                          retry_total_s=0.3,
+                          backoff_base_s=0.01, backoff_cap_s=0.02)
+    backend = FailoverSolver(remote, failure_threshold=1,
+                             recovery_probes=1)
+    try:
+        placements, bus, status, round_log = run(
+            PlacementModel(backend=backend, use_pallas=False),
+            kill=lambda: handles[-1].kill(),
+        )
+        flips = backend.status()
+    finally:
+        supervisor.stop()
+        backend.close()
+
+    # ---- the fault-free reference arm (in-process solver) ------------
+    ref_placements, ref_bus, ref_status, ref_round_log = run(
+        PlacementModel(use_pallas=False))
+
+    # every submitted pod resolved bound; zero silent drops
+    for st in (status, ref_status):
+        gate = st["gate"]
+        assert gate["submitted"] == len(pairs)
+        assert gate["bound"] == len(pairs)
+        assert gate["shed"]["capacity"] == 0
+        assert gate["shed"]["deadline-exceeded"] == 0
+        assert gate["inflight"] == 0 and gate["waiting_permit"] == 0
+    # the outage was real: a degraded flip answered solves locally,
+    # and the supervisor respawned the killed sidecar
+    assert flips["flips_to_degraded"] >= 1
+    assert flips["local_solves"] >= 1
+    assert len(handles) >= 1
+    # the trigger schedule did not shift: same rounds, same batches
+    assert [(r, tuple(u)) for r, _n, u in round_log] \
+        == [(r, tuple(u)) for r, _n, u in ref_round_log]
+    # bit-identical to the fault-free streaming run
+    assert placements == ref_placements
+    got = lower_nodes(snapshot_from_bus(bus, now=500.0))
+    want = lower_nodes(snapshot_from_bus(ref_bus, now=500.0))
+    assert got.names == want.names
+    for f in STAGED_NODE_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got, f), getattr(want, f),
+            err_msg=f"node accounting diverged: {f}")

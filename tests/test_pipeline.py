@@ -571,7 +571,7 @@ def test_run_loop_chains_preexisting_flip_hooks():
             def register(cls, name, fn):
                 cls._m[name] = fn
 
-        def begin_tick(self, now=None):
+        def begin_tick(self, now=None, trigger=None):
             return StubTick()
 
         def commit_tick(self, tick):

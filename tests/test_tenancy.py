@@ -926,3 +926,77 @@ class TestObservability:
             assert 'tenant="team-b"' in text
         finally:
             service.stop()
+
+
+class TestTenantWarmManifest:
+    """ROADMAP 2b (ISSUE 14 satellite): the warm pool's keys for the
+    lane dispatch are tenant SHAPE-BUCKET signatures — bucketed
+    [K*,N*,...] axes, zero tenant data — so a persisted pool program
+    warms tenants the sidecar has NEVER seen."""
+
+    def test_new_tenant_first_bucket_restores_warm(self, tmp_path,
+                                                   xla_compiles):
+        from koordinator_tpu.obs.device import DEVICE_OBS
+        from koordinator_tpu.service import tenancy
+        from koordinator_tpu.service.warmpool import WARM_POOL, WarmPool
+
+        # a fresh manifest slate: the process-global observatory's
+        # bounded warm-aval ring may be full from earlier suites
+        DEVICE_OBS.reset()
+        store = str(tmp_path / "store")
+        pool = WarmPool().configure(store, force_single_device=True)
+        # the suite's forced 8-virtual-device mesh routes lane
+        # dispatches through the SHARDED solver; the warm pool serves
+        # single-device processes (the pooled-sidecar shape), so pin
+        # the plain-vmap path for this test
+        prev_mesh = tenancy._lane_mesh[0]
+        tenancy._lane_mesh[0] = None
+        try:
+            pool.adopt(tenancy._jit_tenant,
+                       tenancy._vmapped_tenant_solve, config_argpos=3)
+            # tenants a/b: distinct worlds, one shape bucket
+            # (node bucket 80, pod bucket 8, lane bucket 2)
+            req_a = _request(tenant="a", n_nodes=70, n_pods=5, seed=1,
+                             pod_seed=11)
+            req_b = _request(tenant="b", n_nodes=75, n_pods=6, seed=2,
+                             pod_seed=22)
+            solve_tenant_lanes([req_a, req_b])  # cold: records the sig
+            report = pool.persist()
+            assert report["persisted"] >= 1
+            assert pool.status()["manifest_programs"], report
+
+            # "fresh process": a new pool over the same store — only
+            # the program-keyed manifest connects the two
+            pool2 = WarmPool().configure(store, force_single_device=True)
+            pool2.adopt(tenancy._jit_tenant,
+                        tenancy._vmapped_tenant_solve, config_argpos=3)
+            restored = pool2.restore()
+            assert restored["restored"] >= 1
+
+            # tenants c/d: NEVER seen by any store writer, different
+            # node counts — but inside the same shape bucket, so their
+            # FIRST pooled solve must serve from the restored
+            # executable with zero XLA compiles
+            req_c = _request(tenant="c", n_nodes=66, n_pods=5, seed=3,
+                             pod_seed=33)
+            req_d = _request(tenant="d", n_nodes=80, n_pods=7, seed=4,
+                             pod_seed=44)
+            served_before = pool2.status()["served"]
+            xla_compiles.clear()
+            warm_out = solve_tenant_lanes([req_c, req_d])
+            assert pool2.status()["served"] == served_before + 1
+            assert xla_compiles == [], (
+                "a new tenant's first bucket cold-compiled: "
+                + "; ".join(xla_compiles)
+            )
+
+            # warm-served answers are bit-identical to the jit path
+            tenancy._jit_tenant._warm = None
+            ref_out = solve_tenant_lanes([req_c, req_d])
+            for warm_r, ref_r in zip(warm_out, ref_out):
+                np.testing.assert_array_equal(
+                    warm_r.assignments, ref_r.assignments)
+                np.testing.assert_array_equal(warm_r.commit, ref_r.commit)
+        finally:
+            tenancy._lane_mesh[0] = prev_mesh
+            tenancy._jit_tenant._warm = WARM_POOL

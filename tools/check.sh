@@ -70,18 +70,29 @@ echo "== bench diff smoke =="
 # that no comparator fires on identical inputs
 python tools/bench_diff.py BENCH_r05.json BENCH_r05.json
 
-echo "== sharded + multi-tenant + warm-pool bench budgets =="
-# the measured sharded/multi-tenant/warm-pool legs are budget-gated
-# (ISSUES 10/11/13): a scaling, merge-overhead, pool-throughput,
-# per-tenant p99, or warm-restart regression in the committed record
-# fails loudly — including the leg-17 acceptance flags (>=3x warm
-# restart-to-first-bind, tick-identity both facets, served-without-
-# donation), pinned with equals/min bounds.
-# (BENCH_vcpu_r08.json is the committed virtual-CPU-box record — legs
-# 14/14b/15/16 run on the forced 8-device virtual mesh and leg 17 in
-# fresh single-device children, so these budgets stay comparable
-# whatever hardware records the r-series; r06/r07 remain for history.)
-python tools/bench_diff.py --budget tools/bench_budgets.json BENCH_vcpu_r08.json
+echo "== streaming smoke =="
+# the continuous-arrival serving slice (ISSUE 14): the adaptive
+# trigger's fake-clock determinism (deadline-fires-first vs
+# watermark-fires-first), and a short REAL pipelined streaming run
+# that binds every submitted pod bit-identically to the fixed-round
+# replay of its recorded arrival batches
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_streaming.py \
+    -q -k "smoke or fires_first" -p no:cacheprovider
+
+echo "== sharded + multi-tenant + warm-pool + streaming bench budgets =="
+# the measured sharded/multi-tenant/warm-pool/streaming legs are
+# budget-gated (ISSUES 10/11/13/14): a scaling, merge-overhead,
+# pool-throughput, per-tenant p99, warm-restart, or serving-tail
+# regression in the committed record fails loudly — including the
+# leg-17 acceptance flags and leg 18's adaptive-vs-fixed p99 (>=2x at
+# the mid sustained rate), bit-identity replay, and shed-point
+# bounds, pinned with equals/min bounds.
+# (BENCH_vcpu_r09.json is the committed virtual-CPU-box record — legs
+# 14/14b/15/16 run on the forced 8-device virtual mesh, leg 17 in
+# fresh single-device children, and leg 18 in-process on the wall
+# clock, so these budgets stay comparable whatever hardware records
+# the r-series; r06/r07/r08 remain for history.)
+python tools/bench_diff.py --budget tools/bench_budgets.json BENCH_vcpu_r09.json
 
 echo "== warm pool smoke =="
 # the AOT warm-pool slice (ISSUE 13): persist -> corrupt one entry ->
