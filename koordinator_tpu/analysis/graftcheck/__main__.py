@@ -133,6 +133,13 @@ def main(argv=None) -> int:
             for name, s in sorted(stats.items())
         }
         payload["changed_files"] = sorted(changed) if changed else None
+        # the signature-space sidecar (ISSUE 15): per-binding enumerated
+        # axis images + the signature-space bound, machine-readable —
+        # what the warm manifest must cover and the runtime sentinel
+        # asserts against (docs/DESIGN.md §23)
+        for rule in rules:
+            if getattr(rule, "name", "") == "signature-space":
+                payload["signature_space"] = rule.last_space
         print(json.dumps(payload, indent=2))
     else:
         print(render(violations, suppressed, "text"))

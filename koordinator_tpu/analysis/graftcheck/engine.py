@@ -130,7 +130,13 @@ class ModuleFile:
     source: str
 
     def matches(self, globs: Sequence[str]) -> bool:
-        return any(fnmatch.fnmatch(self.path, g) for g in globs)
+        return module_matches(self.path, globs)
+
+
+def module_matches(path: str, globs: Sequence[str]) -> bool:
+    """THE scope predicate — every rule that narrows by path glob uses
+    this one definition so a glob-semantics change lands everywhere."""
+    return any(fnmatch.fnmatch(path, g) for g in globs)
 
 
 def load_module(file_path: Path, rel_path: str) -> ModuleFile:
@@ -141,15 +147,29 @@ def load_module(file_path: Path, rel_path: str) -> ModuleFile:
     )
 
 
+#: the repo-root scripts in the analysis universe (ISSUE 15): the
+#: bench scan legs build jit-visible worlds too, and a recompile storm
+#: seeded there poisons the trajectory records the budgets gate on.
+#: An EXPLICIT list, not a glob — an untracked scratch file at the
+#: root must never enter the universe (a syntax error there would fail
+#: the checker and error every sentinel-armed suite at arming time).
+ROOT_SCRIPTS = ("__graft_entry__.py", "bench.py")
+
+
 def iter_repo_modules(root: Path, package: str = "koordinator_tpu"
                       ) -> Iterable[ModuleFile]:
-    """Every ``.py`` file under ``root/package`` (the checker's
-    universe; rules narrow by glob). Syntax errors propagate — a file
-    the checker can't parse is a finding, not a skip."""
+    """Every ``.py`` file under ``root/package`` plus the declared
+    repo-root scripts (:data:`ROOT_SCRIPTS`) — the checker's universe;
+    rules narrow by glob. Syntax errors propagate — a file the checker
+    can't parse is a finding, not a skip."""
     pkg = root / package
     for file_path in sorted(pkg.rglob("*.py")):
         rel = file_path.relative_to(root).as_posix()
         yield load_module(file_path, rel)
+    for name in ROOT_SCRIPTS:
+        file_path = root / name
+        if file_path.is_file():
+            yield load_module(file_path, name)
 
 
 def qualname_map(tree: ast.Module) -> Dict[int, str]:

@@ -34,9 +34,22 @@ from koordinator_tpu.analysis.graftcheck.rules.parity import (
     DeltaParityRule,
     ParitySpec,
 )
+from koordinator_tpu.analysis.graftcheck.rules.metrics_hygiene import (
+    LabelDomain,
+    MetricsHygieneRule,
+    MetricsSpec,
+)
+from koordinator_tpu.analysis.graftcheck.rules.shape_flow import (
+    AxisSpec,
+    BindingSpec,
+    BucketFlowRule,
+    SignatureSpaceRule,
+    WarmCoverageRule,
+)
 from koordinator_tpu.analysis.graftcheck.rules.sync_reach import (
     SyncReachRule,
 )
+from koordinator_tpu.analysis.graftcheck.shapeflow import BucketFn
 
 #: the solve hot path: modules where a stray host sync, implicit jit
 #: declaration, or dead import is a per-tick cost, not a style nit
@@ -334,6 +347,277 @@ DETERMINISM_MODULES = HOT_MODULES + (
 )
 
 
+# -- graftcheck v3: shape-flow (docs/DESIGN.md §23) --------------------------
+
+#: the repo bucket family — THE sanctioners of the shape-flow lattice.
+#: A value returned by any of these is ``bucketed``: finite image under
+#: the config bounds, so a finite signature contribution. The pure
+#: int->int computers carry ``exempt_body=True`` (their bodies ARE the
+#: bucket math); the padding helpers stay ``False`` — their bodies are
+#: HELD to the discipline, which is what makes a stripped bucket call
+#: inside them machine-detectable (tests/test_graftcheck_v3.py teeth).
+BUCKET_FAMILY = (
+    BucketFn(name="pow2_quarter_bucket",
+             path="koordinator_tpu/parallel/mesh.py",
+             qualname="pow2_quarter_bucket", exempt_body=True),
+    BucketFn(name="shard_node_bucket",
+             path="koordinator_tpu/parallel/mesh.py",
+             qualname="shard_node_bucket", exempt_body=True),
+    BucketFn(name="shard_tile_bucket",
+             path="koordinator_tpu/parallel/mesh.py",
+             qualname="shard_tile_bucket", exempt_body=True),
+    BucketFn(name="node_bucket", path="koordinator_tpu/service/tenancy.py",
+             qualname="node_bucket", exempt_body=True),
+    BucketFn(name="pod_bucket", path="koordinator_tpu/service/tenancy.py",
+             qualname="pod_bucket", exempt_body=True),
+    BucketFn(name="lane_bucket", path="koordinator_tpu/service/tenancy.py",
+             qualname="lane_bucket", exempt_body=True),
+    BucketFn(name="pod_bucket",
+             path="koordinator_tpu/models/placement.py",
+             qualname="PlacementModel.pod_bucket", exempt_body=True),
+    BucketFn(name="resv_bucket",
+             path="koordinator_tpu/models/placement.py",
+             qualname="PlacementModel.resv_bucket", exempt_body=True),
+    BucketFn(name="dirty_row_bucket",
+             path="koordinator_tpu/ops/binpack.py",
+             qualname="dirty_row_bucket", exempt_body=True),
+    BucketFn(name="coalesce_pod_bucket",
+             path="koordinator_tpu/service/admission.py",
+             qualname="coalesce_pod_bucket", exempt_body=True),
+    # the array sanctioners: their RETURNS are bucket-shaped; their
+    # bodies stay under the rule (strip a bucket call -> convicted)
+    BucketFn(name="_pad_pods", path="koordinator_tpu/models/placement.py",
+             qualname="PlacementModel._pad_pods"),
+    BucketFn(name="_pad_resv", path="koordinator_tpu/models/placement.py",
+             qualname="PlacementModel._pad_resv"),
+    BucketFn(name="bucket_row_update",
+             path="koordinator_tpu/ops/binpack.py",
+             qualname="bucket_row_update", exempt_body=True),
+    BucketFn(name="pad_node_rows",
+             path="koordinator_tpu/state/cluster.py",
+             qualname="pad_node_rows"),
+    BucketFn(name="pad_node_arrays",
+             path="koordinator_tpu/parallel/mesh.py",
+             qualname="pad_node_arrays"),
+)
+
+#: where the bucket-flow pass convicts: the hot modules plus the
+#: streaming front end, the shared test/bench world builders, and the
+#: bench legs themselves (the engine's module universe includes the
+#: repo-root scripts for exactly this)
+SHAPEFLOW_SCOPE = HOT_MODULES + (
+    "koordinator_tpu/scheduler/streaming.py",
+    "koordinator_tpu/testing/*.py",
+    "bench.py",
+)
+
+# -- signature-space bounds (the "finite" in "finite recompile surface") -----
+# Every bound is a documented config/deployment cap, not a guess pulled
+# from the air: the enumeration's claim is "under these caps, the
+# reachable aval-signature set is THIS", and the caps are the same ones
+# the bench legs and SchedulerConfig already encode.
+
+#: node-count cap: the 100k-node single-domain roadmap target (item 3,
+#: KTPU_BENCH_SHARD_NODES leg 14) rounded up to the next power of two
+MAX_NODES = 131072
+#: per-round pod batch cap: bench churn waves peak at 10k pods/round
+#: (legs 9/14); one quarter-pow2 octave of headroom
+MAX_PODS = 16384
+#: reservation-table cap (bench/test tables run <=256; pow2 headroom)
+MAX_RESV = 4096
+#: coalesced-lane cap: AdmissionConfig.capacity default — the gate can
+#: never dispatch more lanes than it can queue
+MAX_COALESCED_LANES = 128
+#: tenant-lane cap: tenancy.MAX_TRACKED_TENANTS
+MAX_TENANT_LANES = 256
+#: lane shard sweeps: the measured mesh shapes (virtual 8-device CPU
+#: mesh and its 2/4-way splits; DESIGN §19/§20)
+SHARD_SWEEP = ((("shards", 1),), (("shards", 2),), (("shards", 4),),
+               (("shards", 8),))
+#: node-shard sweep EXCLUDES 1: shard_node_bucket is the identity at
+#: one shard by design (a single-device world never pads), and the
+#: sharded solver bindings only exist on multi-device meshes
+MULTI_SHARD_SWEEP = ((("shards", 2),), (("shards", 4),),
+                     (("shards", 8),))
+
+_POD_AXIS = AxisSpec(
+    axis="pods", bucket="koordinator_tpu.parallel.mesh:pow2_quarter_bucket",
+    kwargs_options=((("floor", 64),),), bound=MAX_PODS,
+    bound_source="bench churn wave cap (legs 9/14)",
+)
+_RESV_AXIS = AxisSpec(
+    axis="resv",
+    bucket="koordinator_tpu.models.placement:PlacementModel.resv_bucket",
+    bound=MAX_RESV, bound_source="reservation-table cap",
+)
+_DIRTY_AXIS = AxisSpec(
+    axis="dirty_rows", bucket="koordinator_tpu.ops.binpack:dirty_row_bucket",
+    bound=MAX_NODES, bound_source="node-count cap (roadmap item 3)",
+)
+_COALESCE_POD_AXIS = AxisSpec(
+    axis="pods",
+    bucket="koordinator_tpu.service.admission:coalesce_pod_bucket",
+    bound=MAX_PODS, bound_source="bench churn wave cap",
+)
+_TENANT_LANE_AXIS = AxisSpec(
+    axis="lanes", bucket="koordinator_tpu.service.tenancy:lane_bucket",
+    kwargs_options=SHARD_SWEEP, bound=MAX_TENANT_LANES,
+    bound_source="tenancy.MAX_TRACKED_TENANTS",
+)
+_TENANT_NODE_AXIS = AxisSpec(
+    axis="nodes", bucket="koordinator_tpu.service.tenancy:node_bucket",
+    bound=MAX_NODES, bound_source="node-count cap (roadmap item 3)",
+)
+_TENANT_POD_AXIS = AxisSpec(
+    axis="pods", bucket="koordinator_tpu.service.tenancy:pod_bucket",
+    bound=MAX_PODS, bound_source="bench churn wave cap",
+)
+_SHARD_NODE_AXIS = AxisSpec(
+    axis="nodes", bucket="koordinator_tpu.parallel.mesh:shard_node_bucket",
+    kwargs_options=MULTI_SHARD_SWEEP, bound=MAX_NODES,
+    bound_source="node-count cap (roadmap item 3)",
+)
+
+_SOLVE_AXES = (_POD_AXIS, _RESV_AXIS)
+#: the batched solve's quasi-static axes: one value per deployment
+#: shape (structure epochs), not a per-tick surface — the sentinel
+#: holds them constant-within-window instead of image-membered
+_SOLVE_STRUCTURAL = ("nodes", "features")
+
+#: every DEVICE_OBS.jit binding in the repo, with its declared
+#: signature space. The signature-space pass cross-checks this registry
+#: against the binding census BOTH ways (an undeclared binding and a
+#: stale declaration each fail), enumerates the images from the LIVE
+#: bucket functions, and exports the result to the JSON sidecar and the
+#: runtime sentinel (testing/shapeflow.py).
+BINDING_SPECS = (
+    BindingSpec(name="solve_batch",
+                path="koordinator_tpu/models/placement.py",
+                axes=_SOLVE_AXES, structural=_SOLVE_STRUCTURAL),
+    BindingSpec(name="sidecar_solve_batch",
+                path="koordinator_tpu/service/server.py",
+                axes=_SOLVE_AXES, structural=_SOLVE_STRUCTURAL),
+    BindingSpec(name="failover_local_solve",
+                path="koordinator_tpu/service/failover.py",
+                axes=_SOLVE_AXES, structural=_SOLVE_STRUCTURAL),
+    BindingSpec(name="coalesced_solve",
+                path="koordinator_tpu/service/admission.py",
+                axes=(AxisSpec(axis="lanes", bound=MAX_COALESCED_LANES,
+                               bound_source="AdmissionConfig.capacity"),
+                      _COALESCE_POD_AXIS),
+                structural=_SOLVE_STRUCTURAL,
+                note="lane axis is config-capped raw by design (PR 8): "
+                     "each K <= capacity reuses its program"),
+    BindingSpec(name="coalesced_solve_assign",
+                path="koordinator_tpu/service/admission.py",
+                axes=(AxisSpec(axis="lanes", bound=MAX_COALESCED_LANES,
+                               bound_source="AdmissionConfig.capacity"),
+                      _COALESCE_POD_AXIS),
+                structural=_SOLVE_STRUCTURAL),
+    BindingSpec(name="tenant_pool_solve",
+                path="koordinator_tpu/service/tenancy.py",
+                axes=(_TENANT_LANE_AXIS, _TENANT_NODE_AXIS,
+                      _TENANT_POD_AXIS),
+                structural=("features",)),
+    BindingSpec(name="tenant_pool_solve_full",
+                path="koordinator_tpu/service/tenancy.py",
+                axes=(_TENANT_LANE_AXIS, _TENANT_NODE_AXIS,
+                      _TENANT_POD_AXIS),
+                structural=("features",)),
+    BindingSpec(name="scatter_node_rows_donated",
+                path="koordinator_tpu/ops/binpack.py",
+                axes=(_DIRTY_AXIS,), structural=_SOLVE_STRUCTURAL),
+    BindingSpec(name="scatter_node_rows_copied",
+                path="koordinator_tpu/ops/binpack.py",
+                axes=(_DIRTY_AXIS,), structural=_SOLVE_STRUCTURAL),
+    BindingSpec(name="shard_solver",
+                path="koordinator_tpu/parallel/mesh.py",
+                axes=(_SHARD_NODE_AXIS, _POD_AXIS),
+                structural=("features",)),
+    BindingSpec(name="shard_full_solver",
+                path="koordinator_tpu/parallel/mesh.py",
+                axes=(_SHARD_NODE_AXIS, _POD_AXIS, _RESV_AXIS),
+                structural=("features",)),
+    BindingSpec(name="shard_lane_solver",
+                path="koordinator_tpu/parallel/mesh.py",
+                axes=(_TENANT_LANE_AXIS, _COALESCE_POD_AXIS),
+                structural=_SOLVE_STRUCTURAL),
+    BindingSpec(name="shard_tenant_solver",
+                path="koordinator_tpu/parallel/mesh.py",
+                axes=(_TENANT_LANE_AXIS, _TENANT_NODE_AXIS,
+                      _TENANT_POD_AXIS),
+                structural=("features",)),
+)
+
+#: statics the warm manifest provably keys by value (SolverConfig is a
+#: flat NamedTuple of ints/bools — ``_config_key`` tuples it). An
+#: adopted binding declaring any OTHER static is unrepresentable in
+#: the store and fails warm-coverage.
+HASHABLE_STATICS = ("config",)
+
+# -- metrics hygiene (the PR 16 tenant-label class) --------------------------
+
+#: every label on the serving-path registries, with its boundedness
+#: story. ``enum`` values are the code-enumerated emit sites (audited
+#: here so a new value is a conscious registry edit); ``binding`` is
+#: bounded by the DEVICE_OBS.jit binding census above; ``folded``
+#: labels carry wire-controlled values folded into a sentinel past the
+#: cardinality cap (tenancy.MAX_TRACKED_TENANTS -> OVERFLOW_TENANT).
+LABEL_DOMAINS = {
+    "result": LabelDomain(kind="enum", values=(
+        "scheduled", "unschedulable", "error", "nominated",
+        "written", "rate-limited", "refused",
+    )),
+    "reason": LabelDomain(kind="enum", values=(
+        # failure-domain + supervisor + streaming + warm-pool reject
+        # reasons; PIPELINE_DRAINS additionally takes bench/test-local
+        # values — still call-site-bounded, never wire-controlled
+        "solver-unavailable", "crashed", "hung", "down",
+        "auditor-sweep", "failover-flip", "standby", "shutdown", "once",
+        "truncated", "corrupt", "fingerprint", "oversized",
+        "stale-host", "version-skew",
+        "capacity", "timeline-capacity", "deadline",
+        "overloaded",
+    )),
+    "direction": LabelDomain(kind="enum",
+                             values=("to-degraded", "to-remote")),
+    "mode": LabelDomain(kind="enum", values=(
+        "local-fallback", "local-degraded", "coalesced", "lanes", "solo",
+    )),
+    "kind": LabelDomain(kind="enum", values=(
+        "periodic", "promotion", "manual", "round", "publish",
+        "fencing", "solver", "other",
+        "cache-bus", "accounting", "device-parity",
+    )),
+    "boundary": LabelDomain(kind="enum", values=(
+        "cache-bus", "accounting", "device-parity",
+    )),
+    "action": LabelDomain(kind="enum", values=(
+        "targeted", "cache-rebuild", "full-restage",
+    )),
+    "stage": LabelDomain(kind="enum",
+                         values=("lower", "stage", "solve", "publish")),
+    "trigger": LabelDomain(kind="enum", values=(
+        "auditor-detection", "failover-flip", "fencing-abort",
+        "pipeline-deferred-error", "deadline-exceeded", "manual",
+        "watermark", "deadline", "idle",
+    )),
+    "lane": LabelDomain(kind="enum", values=("system", "ls", "be")),
+    "buffer": LabelDomain(kind="enum", values=(
+        "pod_batch", "resv_table", "dirty_rows", "coalesced_pods",
+        "tenant_nodes", "tenant_pods", "tenant_lanes",
+    )),
+    "fn": LabelDomain(kind="binding"),
+    "tenant": LabelDomain(kind="folded", fold_symbol="OVERFLOW_TENANT"),
+}
+
+METRICS_SPEC = MetricsSpec(
+    components_path="koordinator_tpu/metrics/components.py",
+    registries=("SCHEDULER_METRICS", "DEVICE_METRICS", "SOLVER_METRICS"),
+    label_domains=LABEL_DOMAINS,
+)
+
+
 def default_rules():
     return (
         HostSyncRule(scope=HOT_MODULES),
@@ -348,12 +632,35 @@ def default_rules():
         DonationRule(pin_specs=PIN_SPECS,
                      no_donate_globs=NO_DONATE_MODULES),
         DeterminismRule(scope=DETERMINISM_MODULES),
+        # whole-program passes (ISSUE 15, docs/DESIGN.md §23): the
+        # static shape-flow trio proving the recompile surface finite
+        # and warm-coverable, plus the metric-exposition audit
+        BucketFlowRule(scope=SHAPEFLOW_SCOPE, buckets=BUCKET_FAMILY),
+        SignatureSpaceRule(specs=BINDING_SPECS),
+        WarmCoverageRule(specs=BINDING_SPECS, hot_scope=HOT_MODULES,
+                         hashable_statics=HASHABLE_STATICS),
+        MetricsHygieneRule(spec=METRICS_SPEC),
     )
 
 
 __all__ = [
+    "AxisSpec",
+    "BINDING_SPECS",
+    "BUCKET_FAMILY",
+    "BindingSpec",
+    "BucketFlowRule",
+    "BucketFn",
     "DETERMINISM_MODULES",
+    "HASHABLE_STATICS",
     "HOT_MODULES",
+    "LABEL_DOMAINS",
+    "LabelDomain",
+    "METRICS_SPEC",
+    "MetricsHygieneRule",
+    "MetricsSpec",
+    "SHAPEFLOW_SCOPE",
+    "SignatureSpaceRule",
+    "WarmCoverageRule",
     "LOCK_NODES",
     "LOCK_SPECS",
     "NO_DONATE_MODULES",

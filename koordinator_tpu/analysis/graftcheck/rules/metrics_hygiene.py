@@ -1,0 +1,188 @@
+"""metrics-hygiene: the serving-path metric registries stay scrapeable
+and label-bounded (the PR 16 tenant-label class, held mechanically).
+
+Two invariants over the declared registries (rules/__init__.
+METRICS_SPEC):
+
+1. **Served.** Every scoped registry (SOLVER/SCHEDULER/DEVICE) must be
+   merged into at least one debug mux (``MergedGatherer([...])``
+   anywhere in the program). A metric nobody can scrape is a metric
+   that silently rots — the operator question it answers goes dark.
+2. **Bounded labels.** Every label on a scoped metric must have a
+   declared domain: ``enum`` (a code-enumerated value set), ``binding``
+   (bounded by the DEVICE_OBS.jit binding census — the ``fn`` label),
+   or ``folded`` (wire-controlled values folded into a sentinel past a
+   cardinality cap — the ``tenant`` label's ``_overflow`` fold). A
+   label with no domain is an unbounded exposition: one hostile wire
+   value per series, the exact shape PR 16 closed for tenants.
+
+For ``folded`` domains the declared fold symbol must exist in the
+program (a fold that was deleted un-bounds the label silently).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Mapping, Sequence, Tuple
+
+from koordinator_tpu.analysis.graftcheck.callgraph import Program
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    attr_chain,
+    qualname_map,
+)
+
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+
+@dataclasses.dataclass(frozen=True)
+class LabelDomain:
+    """How one label name's value set is statically bounded."""
+
+    kind: str                      # "enum" | "binding" | "folded"
+    values: Tuple[str, ...] = ()   # enum: the documented value set
+    fold_symbol: str = ""          # folded: the sentinel constant name
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsSpec:
+    """The rule's configuration (production values live in
+    rules/__init__.METRICS_SPEC; fixtures narrow it)."""
+
+    components_path: str
+    registries: Tuple[str, ...]    # scoped registry variable names
+    label_domains: Mapping[str, LabelDomain]
+
+
+class MetricsHygieneRule:
+    """Whole-program: scoped registries are mux-served and their
+    labels carry declared bounded domains."""
+
+    name = "metrics-hygiene"
+    description = (
+        "every scoped metric registry is served by a debug mux and "
+        "every label has a statically bounded domain or an _overflow "
+        "fold"
+    )
+
+    def __init__(self, spec: MetricsSpec):
+        self.spec = spec
+
+    def check_program(self, program: Program) -> List[Violation]:
+        out: List[Violation] = []
+        comp = program.by_path.get(self.spec.components_path)
+        if comp is None:
+            return out
+        qmap = qualname_map(comp.tree)
+
+        # which registry variables reach a MergedGatherer anywhere
+        gathered = set()
+        fold_symbols = set()
+        for module in program.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func) or ""
+                    if chain.split(".")[-1] == "MergedGatherer":
+                        # registries reach the mux as list/tuple
+                        # elements OR bare name arguments — both count
+                        # as served (a refactor to positional args
+                        # must not flag the whole fleet unscrapeable)
+                        for arg in node.args:
+                            elts = arg.elts if isinstance(
+                                arg, (ast.List, ast.Tuple)) else [arg]
+                            for e in elts:
+                                name = attr_chain(e)
+                                if name:
+                                    gathered.add(name.split(".")[-1])
+            # fold sentinels are MODULE-LEVEL constants (plain or
+            # annotated); collecting nested-scope assignments too
+            # would let a coincidental function-local name satisfy
+            # the deleted-fold check
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            fold_symbols.add(t.id)
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    fold_symbols.add(node.target.id)
+
+        # registration census in the components module
+        reg_lines: Dict[str, int] = {}
+        for node in ast.walk(comp.tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            chain = attr_chain(call.func) or ""
+            parts = chain.split(".")
+            # REGISTRY = Registry("name") assignments: remember lines
+            if parts[-1] == "Registry" and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                reg_lines[node.targets[0].id] = node.lineno
+                continue
+            if len(parts) != 2 or parts[0] not in self.spec.registries \
+                    or parts[1] not in _METRIC_FACTORIES:
+                continue
+            metric_name = (
+                call.args[0].value
+                if call.args and isinstance(call.args[0], ast.Constant)
+                else "<dynamic>"
+            )
+            labels: Tuple[str, ...] = ()
+            for kw in call.keywords:
+                if kw.arg == "label_names" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    labels = tuple(
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                    )
+            func = qmap.get(id(node), "<module>")
+            for label in labels:
+                domain = self.spec.label_domains.get(label)
+                if domain is None:
+                    out.append(Violation(
+                        rule=self.name, path=comp.path,
+                        line=node.lineno, col=node.col_offset,
+                        func=func, symbol=str(metric_name),
+                        message=(
+                            f"label {label!r} on {metric_name!r} has "
+                            f"no declared domain — an unbounded label "
+                            f"set is one series per hostile value "
+                            f"(declare it in LABEL_DOMAINS: enum, "
+                            f"binding-bounded, or _overflow-folded)"
+                        ),
+                    ))
+                elif domain.kind == "folded" \
+                        and domain.fold_symbol not in fold_symbols:
+                    out.append(Violation(
+                        rule=self.name, path=comp.path,
+                        line=node.lineno, col=node.col_offset,
+                        func=func, symbol=str(metric_name),
+                        message=(
+                            f"label {label!r} on {metric_name!r} "
+                            f"declares fold symbol "
+                            f"{domain.fold_symbol!r} which no longer "
+                            f"exists in the program — the cardinality "
+                            f"fold was deleted, un-bounding the label"
+                        ),
+                    ))
+
+        for reg in self.spec.registries:
+            if reg not in gathered:
+                out.append(Violation(
+                    rule=self.name, path=comp.path,
+                    line=reg_lines.get(reg, 0), col=0,
+                    func="<module>", symbol=reg,
+                    message=(
+                        f"registry {reg} is not merged into any debug "
+                        f"mux (MergedGatherer) — its metrics are "
+                        f"registered but unscrapeable"
+                    ),
+                ))
+        return out
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        return self.check_program(Program([module]))

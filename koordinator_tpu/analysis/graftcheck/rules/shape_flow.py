@@ -1,0 +1,373 @@
+"""graftcheck v3: the three shape-flow passes (docs/DESIGN.md §23).
+
+Built on :mod:`..shapeflow` (the lattice/engine half) and the v2 call
+graph. Together they turn "we pre-warm every quarter-pow2 bucket and
+count recompiles" (PRs 13/17/18's *empirical* defenses) into a static
+proof obligation:
+
+1. **bucket-flow** — no raw-dynamic count (``len()``, comprehension,
+   arithmetic-derived) reaches a host-side device-width sink without
+   passing through the registered bucket family. The pre-PR 8 / pre-PR
+   16 storm shape, machine-rejected.
+2. **signature-space** — every ``DEVICE_OBS.jit`` binding carries a
+   declared axis spec whose bucket functions are evaluated over the
+   documented config bounds to a FINITE image; the enumerated space is
+   emitted as a machine-readable sidecar (``--format=json`` gains
+   ``signature_space``) and feeds the runtime sentinel
+   (testing/shapeflow.py). An undeclared binding is an unknown
+   recompile surface and fails loudly, as does a stale declaration.
+3. **warm-coverage** — every WARM_POOL-adopted binding's enumerated
+   space must be representable by ``warm_manifest()`` keys: statics by
+   value (declared hashable), arrays as ShapeDtypeStructs (finite
+   enumeration). The inverse holds too: a hot-module ``DEVICE_OBS``
+   binding that is NOT adopted is cold on every recovery path and gets
+   a loud finding (allowlistable with a written reason — e.g. the
+   sharded bindings, which the single-device pool refuses by design).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from koordinator_tpu.analysis.graftcheck.callgraph import Program
+from koordinator_tpu.analysis.graftcheck.engine import (
+    ModuleFile,
+    Violation,
+    module_matches,
+)
+from koordinator_tpu.analysis.graftcheck.shapeflow import (
+    BucketFn,
+    ShapeFlowEngine,
+    find_adoptions,
+    find_observed_bindings,
+)
+
+
+# -- pass 1: bucket-flow -----------------------------------------------------
+
+class BucketFlowRule:
+    """Whole-program: raw-dynamic counts never reach device-width
+    sinks outside the bucket family (see shapeflow.py for the lattice
+    and the sink set)."""
+
+    name = "bucket-flow"
+    description = (
+        "every dynamic count feeding a jit-visible axis flows through "
+        "a registered bucket function (interprocedural shape-flow)"
+    )
+
+    def __init__(self, scope: Sequence[str], buckets: Sequence[BucketFn]):
+        self.scope = tuple(scope)
+        self.buckets = tuple(buckets)
+
+    def check_program(self, program: Program) -> List[Violation]:
+        # the fixpoint runs at construction — memoize per Program +
+        # bucket registry like the binding census
+        cached = getattr(program, "_shapeflow_engine", None)
+        if cached is not None and cached[0] == self.buckets:
+            engine = cached[1]
+        else:
+            engine = ShapeFlowEngine(program, self.buckets)
+            program._shapeflow_engine = (self.buckets, engine)
+        out = []
+        for path, line, col, qual, symbol, message in \
+                engine.violations(self.scope):
+            out.append(Violation(
+                rule=self.name, path=path, line=line, col=col,
+                func=qual, symbol=symbol, message=message,
+            ))
+        return out
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        return self.check_program(Program([module]))
+
+
+# -- pass 2: signature-space enumeration -------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class AxisSpec:
+    """One dynamic axis of a jit binding's signature space.
+
+    ``bucket`` is a ``"dotted.module:qual.name"`` reference to the
+    sanctioning bucket callable — imported and EVALUATED over
+    ``range(bound + 1)`` (per kwargs option) so the enumerated image is
+    the real function's, never a hand-copied table. An axis with no
+    bucket (``bucket=""``) is a config-capped raw axis: every integer
+    in ``[1, bound]`` is reachable (the admission gate's lane count);
+    finite because the bound is a config cap, not a bucket image."""
+
+    axis: str
+    bucket: str = ""
+    #: kwargs options swept and unioned, e.g. ((("floor", 64),),) or
+    #: ((("shards", 1),), (("shards", 8),))
+    kwargs_options: Tuple[Tuple[Tuple[str, int], ...], ...] = ((),)
+    bound: int = 0
+    bound_source: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class BindingSpec:
+    """The declared signature space of one ``DEVICE_OBS.jit`` binding.
+
+    ``structural`` names the quasi-static axes (node width, feature
+    columns) that change only on structure epochs — they contribute
+    one value per deployment shape, not a per-tick surface, and the
+    runtime sentinel checks them as constant-within-window instead of
+    bucket-image members."""
+
+    name: str
+    path: str
+    axes: Tuple[AxisSpec, ...]
+    structural: Tuple[str, ...] = ()
+    note: str = ""
+
+
+def _resolve_bucket(ref: str):
+    """``"pkg.mod:Qual.name"`` -> the live callable (images must come
+    from the real function, not a parallel reimplementation)."""
+    import importlib
+
+    mod_name, _, qual = ref.partition(":")
+    obj = importlib.import_module(mod_name)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+#: enumerated images cached per (bucket ref, kwargs, bound) — the
+#: bucket family is shared across bindings, so a repo run evaluates
+#: each distinct (fn, kwargs, bound) once
+_IMAGE_CACHE: Dict[Tuple, Tuple[int, ...]] = {}
+
+
+def enumerate_axis(spec: AxisSpec) -> Tuple[int, ...]:
+    """The axis's reachable value set under its bound (sorted)."""
+    if not spec.bucket:
+        return tuple(range(1, spec.bound + 1))
+    values: set = set()
+    for opts in spec.kwargs_options:
+        key = (spec.bucket, opts, spec.bound)
+        cached = _IMAGE_CACHE.get(key)
+        if cached is None:
+            fn = _resolve_bucket(spec.bucket)
+            kwargs = dict(opts)
+            cached = tuple(sorted({
+                int(fn(n, **kwargs)) for n in range(spec.bound + 1)
+            }))
+            _IMAGE_CACHE[key] = cached
+        values.update(cached)
+    return tuple(sorted(values))
+
+
+class SignatureSpaceRule:
+    """Whole-program: the ``DEVICE_OBS.jit`` binding census must match
+    the declared axis-spec registry, and every declared axis must
+    enumerate to a finite image under the documented bounds.
+
+    After ``check_program`` runs, :attr:`last_space` holds the
+    machine-readable sidecar (also exported by the CLI's JSON format
+    and consumed by the runtime sentinel)."""
+
+    name = "signature-space"
+    description = (
+        "every DEVICE_OBS-instrumented jit binding has a declared, "
+        "finitely-enumerable signature space under the config bounds"
+    )
+
+    #: a bucket image larger than this is not a bucket, it is an
+    #: unbounded surface wearing a bucket's name
+    MAX_AXIS_IMAGE = 4096
+
+    def __init__(self, specs: Sequence[BindingSpec],
+                 obs_names: Sequence[str] = ("DEVICE_OBS",)):
+        self.specs = tuple(specs)
+        self.obs_names = tuple(obs_names)
+        self.last_space: Dict[str, dict] = {}
+
+    def check_program(self, program: Program) -> List[Violation]:
+        out: List[Violation] = []
+        bindings = find_observed_bindings(program, self.obs_names)
+        adoptions = find_adoptions(program, bindings=bindings)
+        adopted = {a.binding for a in adoptions if a.binding}
+        by_name = {s.name: s for s in self.specs}
+        seen = set()
+        space: Dict[str, dict] = {}
+        for b in bindings:
+            seen.add(b.name)
+            spec = by_name.get(b.name)
+            if spec is None:
+                out.append(Violation(
+                    rule=self.name, path=b.path, line=b.line, col=0,
+                    func=b.qualname, symbol=b.name,
+                    message=(
+                        f"DEVICE_OBS.jit binding {b.name!r} has no "
+                        f"BindingSpec: an undeclared hot jit is an "
+                        f"unknown recompile surface — declare its axis "
+                        f"buckets (rules/__init__.BINDING_SPECS)"
+                    ),
+                ))
+                continue
+            axes = []
+            bound_total = 1
+            for axis in spec.axes:
+                try:
+                    image = enumerate_axis(axis)
+                except Exception as e:
+                    out.append(Violation(
+                        rule=self.name, path=b.path, line=b.line, col=0,
+                        func=b.qualname, symbol=b.name,
+                        message=(
+                            f"axis {axis.axis!r} of {b.name!r} failed "
+                            f"to enumerate ({type(e).__name__}: {e}) — "
+                            f"the bucket reference {axis.bucket!r} must "
+                            f"resolve to the live bucket function"
+                        ),
+                    ))
+                    continue
+                if not image or len(image) > self.MAX_AXIS_IMAGE:
+                    out.append(Violation(
+                        rule=self.name, path=b.path, line=b.line, col=0,
+                        func=b.qualname, symbol=b.name,
+                        message=(
+                            f"axis {axis.axis!r} of {b.name!r} "
+                            f"enumerates to {len(image)} values under "
+                            f"bound {axis.bound} — not a finite bucket "
+                            f"image (cap {self.MAX_AXIS_IMAGE})"
+                        ),
+                    ))
+                    continue
+                bound_total *= len(image)
+                axes.append({
+                    "axis": axis.axis,
+                    "bucket": axis.bucket,
+                    "bound": axis.bound,
+                    "bound_source": axis.bound_source,
+                    "image_size": len(image),
+                    "values": list(image),
+                })
+            space[b.name] = {
+                "path": b.path,
+                "line": b.line,
+                "adopted": b.name in adopted,
+                "structural_axes": list(spec.structural),
+                "axes": axes,
+                "signature_space_bound": bound_total,
+                "note": spec.note,
+            }
+        for spec in self.specs:
+            if spec.name not in seen:
+                out.append(Violation(
+                    rule=self.name, path=spec.path, line=0, col=0,
+                    func="<registry>", symbol=spec.name,
+                    message=(
+                        f"BindingSpec {spec.name!r} matches no "
+                        f"DEVICE_OBS.jit binding in the program — "
+                        f"delete the stale declaration"
+                    ),
+                ))
+        self.last_space = space
+        return out
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        return self.check_program(Program([module]))
+
+
+# -- pass 3: warm-coverage ---------------------------------------------------
+
+class WarmCoverageRule:
+    """Whole-program: adopted bindings are warm-representable, and hot
+    bindings are adopted (or loudly excused)."""
+
+    name = "warm-coverage"
+    description = (
+        "every warm-pool-adopted binding's signature space is "
+        "manifest-representable; every hot DEVICE_OBS binding is "
+        "adopted or justified (cold-on-every-recovery otherwise)"
+    )
+
+    def __init__(self, specs: Sequence[BindingSpec],
+                 hot_scope: Sequence[str],
+                 hashable_statics: Sequence[str] = ("config",),
+                 obs_names: Sequence[str] = ("DEVICE_OBS",)):
+        self.specs = tuple(specs)
+        self.hot_scope = tuple(hot_scope)
+        self.hashable_statics = frozenset(hashable_statics)
+        self.obs_names = tuple(obs_names)
+
+    def check_program(self, program: Program) -> List[Violation]:
+        out: List[Violation] = []
+        bindings = find_observed_bindings(program, self.obs_names)
+        by_target = {b.name: b for b in bindings}
+        adoptions = find_adoptions(program, bindings=bindings)
+        by_spec = {s.name: s for s in self.specs}
+        adopted = set()
+        for a in adoptions:
+            if not a.binding:
+                out.append(Violation(
+                    rule=self.name, path=a.path, line=a.line, col=0,
+                    func="<module>", symbol=a.target,
+                    message=(
+                        f"WARM_POOL.adopt target {a.target!r} does not "
+                        f"resolve to a DEVICE_OBS.jit binding in this "
+                        f"module — the coverage contract cannot be "
+                        f"checked for an opaque adoption"
+                    ),
+                ))
+                continue
+            adopted.add(a.binding)
+            b = by_target.get(a.binding)
+            spec = by_spec.get(a.binding)
+            if b is None:
+                continue
+            # statics by value: the manifest keys hash static config
+            # values — an adopted binding may only declare statics the
+            # registry knows to be hashable-by-value
+            bad_statics = set(b.static_argnames) - self.hashable_statics
+            if bad_statics or b.has_static_argnums:
+                what = sorted(bad_statics) if bad_statics \
+                    else "positional static_argnums"
+                out.append(Violation(
+                    rule=self.name, path=a.path, line=a.line, col=0,
+                    func="<module>", symbol=a.binding,
+                    message=(
+                        f"adopted binding {a.binding!r} declares "
+                        f"statics {what} outside the hashable-statics "
+                        f"registry — warm_manifest() keys statics by "
+                        f"value, so an unhashable/undeclared static is "
+                        f"unrepresentable in the store"
+                    ),
+                ))
+            if spec is None or not spec.axes:
+                out.append(Violation(
+                    rule=self.name, path=a.path, line=a.line, col=0,
+                    func="<module>", symbol=a.binding,
+                    message=(
+                        f"adopted binding {a.binding!r} has no "
+                        f"finitely-enumerated BindingSpec axes — the "
+                        f"warm manifest cannot cover an unbounded "
+                        f"signature space"
+                    ),
+                ))
+        # the inverse: a hot binding that is NOT adopted restarts cold
+        # on every recovery path (boot, promotion, respawn, failover)
+        for b in bindings:
+            if b.name in adopted:
+                continue
+            if not module_matches(b.path, self.hot_scope):
+                continue
+            out.append(Violation(
+                rule=self.name, path=b.path, line=b.line, col=0,
+                func=b.qualname, symbol=b.name,
+                message=(
+                    f"hot DEVICE_OBS.jit binding {b.name!r} is not "
+                    f"warm-pool-adopted: cold-on-every-recovery — "
+                    f"every restart/promotion/failover re-traces and "
+                    f"recompiles it (adopt it, or allowlist with the "
+                    f"reason it cannot be pooled)"
+                ),
+            ))
+        return out
+
+    def check(self, module: ModuleFile) -> List[Violation]:
+        return self.check_program(Program([module]))

@@ -33,6 +33,7 @@ from koordinator_tpu.analysis.graftcheck.engine import (
     ModuleFile,
     Violation,
     attr_chain,
+    module_matches,
 )
 from koordinator_tpu.analysis.graftcheck.callgraph import Program
 
@@ -46,12 +47,6 @@ class _SyncSite:
     symbol: str        # "jax.device_get" | ".block_until_ready()" | ...
     path: str
     line: int
-
-
-def _module_matches(path: str, globs: Sequence[str]) -> bool:
-    import fnmatch
-
-    return any(fnmatch.fnmatch(path, g) for g in globs)
 
 
 def _direct_syncs(fn_node: ast.AST, path: str) -> List[_SyncSite]:
@@ -99,7 +94,7 @@ class SyncReachRule:
         #    local host-sync rule and its allowlist)
         reach: Dict[str, Tuple[_SyncSite, ...]] = {}
         for key, info in program.functions.items():
-            if _module_matches(info.path, self.scope):
+            if module_matches(info.path, self.scope):
                 continue
             sites = _direct_syncs(info.node, info.path)
             if sites:
@@ -118,7 +113,7 @@ class SyncReachRule:
             for caller in callers.get(callee, ()):
                 info = program.functions.get(caller)
                 if info is not None \
-                        and _module_matches(info.path, self.scope):
+                        and module_matches(info.path, self.scope):
                     continue  # hot functions report at their call sites
                 have = reach.get(caller, ())
                 merged = list(have)
@@ -134,7 +129,7 @@ class SyncReachRule:
         out: List[Violation] = []
         hot_paths = {
             m.path for m in program.modules
-            if _module_matches(m.path, self.scope)
+            if module_matches(m.path, self.scope)
         }
         for key, info in program.functions.items():
             if info.path not in hot_paths:

@@ -1,0 +1,827 @@
+"""Static shape-flow: the lattice/engine half of graftcheck v3.
+
+PRs 13-18 defended against jit recompile storms *empirically* — a
+compile ring, warm pools, pre-compiled pod buckets — every one a
+counter that trips after the storm happens. This module is the static
+half: an interprocedural abstract interpretation over array-shape
+provenance that proves, before any code runs, that every dynamic count
+feeding a hot jit axis flows through a registered bucket function — so
+the reachable aval-signature set is finite and the warm manifest
+(docs/DESIGN.md §21) can cover it.
+
+**The lattice.** Every scalar-ish value is abstracted to one of:
+
+- ``constant`` — a literal; contributes one signature.
+- ``aligned`` — copied from an existing array's ``.shape``: a width
+  that MIRRORS an axis that already exists adds no NEW signature
+  dimension (``jnp.zeros(x.shape[0])`` compiles once per shape of
+  ``x``, which some other flow already owns). Arithmetic over an
+  aligned value FORFEITS alignment: a derived count is a new surface.
+- ``bucketed(fn)`` — passed through a registered bucket function
+  (``pow2_quarter_bucket`` and family): finite image under the config
+  bounds, so a finite signature contribution. ``bucket(n) - n`` (the
+  pad-remainder idiom every ``_pad_*`` helper uses) stays bucketed:
+  the RESULTING axis is the bucket, whatever the remainder.
+- ``raw-dynamic`` — derived from ``len()`` of a python collection, a
+  comprehension, or arithmetic over the above: one compiled program
+  per distinct value. Raw reaching a device-width sink is the exact
+  shape of the pre-PR 8 / pre-PR 16 recompile storms.
+
+**Interprocedural.** Function summaries (return kind) and parameter
+taints (join of argument kinds over every resolved call site) run to a
+bounded fixpoint over the v2 call graph, so ``n_real = len(pods)``
+three frames above a ``jnp.pad`` still convicts. Functions reachable
+from a ``jax.jit``/``jax.vmap`` root are TRACED scope: inside a trace,
+``.shape`` is static per-signature and width sinks create no new
+surface, so traced bodies are exempt (the surface is the call
+boundary, which the signature-space pass and the runtime sentinel
+own).
+
+**Sinks** (host-side, scope-matched): ``jnp.zeros/ones/full/empty``
+widths, ``jnp.pad`` pad_widths, ``jax.ShapeDtypeStruct`` shapes, and
+``jnp.asarray/array`` of a comprehension-built sequence. Host ``np.*``
+staging arrays are deliberately NOT sinks: the host world is lowered
+at cluster size by design and bucketed at the device boundary — which
+is exactly the boundary this pass polices.
+
+Resolution is under-approximate like the rest of graftcheck: an
+unresolvable call contributes nothing, unknown values never convict.
+
+Stdlib-only (``ast``), like the rest of the engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from koordinator_tpu.analysis.graftcheck.callgraph import Program
+from koordinator_tpu.analysis.graftcheck.engine import (
+    attr_chain,
+    module_matches,
+)
+
+# -- the lattice -------------------------------------------------------------
+
+CONSTANT = "constant"
+ALIGNED = "aligned"
+BUCKETED = "bucketed"
+RAW = "raw-dynamic"
+
+#: join severity: raw convicts, bucketed sanctions, aligned mirrors
+_ORDER = {CONSTANT: 0, ALIGNED: 1, BUCKETED: 2, RAW: 3}
+
+
+@dataclasses.dataclass(frozen=True)
+class Sv:
+    """One abstract shape value."""
+
+    kind: str
+    origin: str = ""      # bucket fn | raw source description
+
+    def __repr__(self):
+        return f"{self.kind}({self.origin})" if self.origin else self.kind
+
+
+_CONST = Sv(CONSTANT)
+
+
+def join(values: Sequence[Optional[Sv]]) -> Optional[Sv]:
+    """Worst-of join; None (unknown) is absorbing only when alone."""
+    best: Optional[Sv] = None
+    for v in values:
+        if v is None:
+            continue
+        if best is None or _ORDER[v.kind] > _ORDER[best.kind]:
+            best = v
+    return best
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketFn:
+    """One registered bucket sanctioner.
+
+    ``name`` is the bare callable name as written at call sites (the
+    import-alias-proof fallback); ``qualname`` + ``path`` pin the real
+    definition so the census can flag a registry entry whose function
+    no longer exists. A call to a sanctioner returns ``bucketed``
+    whatever its arguments; its own body is where raw legitimately
+    becomes bucketed, so sanctioner bodies are never sink-scanned when
+    ``exempt_body`` is set (the pure int->int computers); the padding
+    helpers (``_pad_pods``/``_pad_resv``) keep ``exempt_body=False`` —
+    their bodies are HELD to the discipline, which is what makes
+    stripping a bucket call inside them machine-detectable."""
+
+    name: str
+    path: str = ""
+    qualname: str = ""
+    exempt_body: bool = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.qualname}" if self.path else ""
+
+
+#: builtins folded like arithmetic-free joins (max(8, bucket(n)) stays
+#: bucketed; max of raws stays raw)
+_JOIN_BUILTINS = frozenset({"max", "min", "int", "abs", "round", "sum"})
+
+#: width-sink producers: chain suffix -> which argument is the width
+_ZEROS_FAMILY = frozenset({"zeros", "ones", "full", "empty"})
+
+
+def _is_jnp(chain: str) -> bool:
+    head = chain.split(".")[0]
+    return head in ("jnp",) or chain.startswith("jax.numpy.")
+
+
+class ShapeFlowEngine:
+    """Program-wide shape-provenance analysis.
+
+    Construction runs the full fixpoint (the expensive part), so
+    :class:`~.rules.shape_flow.BucketFlowRule` memoizes the instance
+    on the Program per bucket registry — repeated check runs over one
+    Program pay one analysis."""
+
+    #: interprocedural fixpoint rounds (summaries/taints stabilize in
+    #: 2 on this repo; 3 bounds pathological call chains)
+    ROUNDS = 3
+
+    def __init__(self, program: Program, buckets: Sequence[BucketFn]):
+        self.program = program
+        self.buckets = tuple(buckets)
+        self._bucket_by_key = {b.key: b for b in buckets if b.key}
+        self._bucket_by_name = {b.name: b for b in buckets}
+        #: function key -> return-value summary
+        self.summaries: Dict[str, Sv] = {
+            b.key: Sv(BUCKETED, b.name) for b in buckets if b.key
+        }
+        #: function key -> {param name -> Sv}
+        self.param_taint: Dict[str, Dict[str, Sv]] = {}
+        self.traced: Set[str] = self._traced_closure()
+        for _ in range(self.ROUNDS):
+            self._propagate()
+
+    # -- traced scope --------------------------------------------------------
+
+    def _jit_roots(self) -> Set[str]:
+        """Function keys passed to ``jax.jit``/``jax.vmap``/``pjit``
+        anywhere in the program — the trace entry points."""
+        roots: Set[str] = set()
+        for module in self.program.modules:
+            table = self.program.module_table(module.path)
+            if table is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                chain = attr_chain(node.func) or ""
+                if chain.split(".")[-1] not in ("jit", "vmap", "pjit",
+                                                "shard_map"):
+                    continue
+                target = node.args[0]
+                name = target.id if isinstance(target, ast.Name) else None
+                if name is None:
+                    continue
+                sym = table.symbols.get(name)
+                if sym is not None and sym[0] == "func":
+                    roots.add(sym[1])
+                    continue
+                imp = table.imports.get(name)
+                if imp is not None and imp[0] == "symbol":
+                    target_mod = self.program.by_dotted.get(imp[1])
+                    if target_mod is not None:
+                        t2 = self.program.module_table(target_mod.path)
+                        sym2 = t2.symbols.get(imp[2]) if t2 else None
+                        if sym2 is not None and sym2[0] == "func":
+                            roots.add(sym2[1])
+        # decorator-form roots: ``@jax.jit`` and
+        # ``@functools.partial(jax.jit, ...)`` (ops/pallas_binpack.py)
+        for key, info in self.program.functions.items():
+            for dec in getattr(info.node, "decorator_list", []):
+                chain = attr_chain(dec) or ""
+                if chain.split(".")[-1] in ("jit", "pjit"):
+                    roots.add(key)
+                elif isinstance(dec, ast.Call):
+                    dchain = attr_chain(dec.func) or ""
+                    if dchain.split(".")[-1] in ("jit", "pjit"):
+                        roots.add(key)
+                    elif dchain.split(".")[-1] == "partial" and dec.args:
+                        inner = attr_chain(dec.args[0]) or ""
+                        if inner.split(".")[-1] in ("jit", "pjit"):
+                            roots.add(key)
+        return roots
+
+    def _traced_closure(self) -> Set[str]:
+        seen = set()
+        work = list(self._jit_roots())
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for site in self.program.callees(key):
+                if site.callee not in seen:
+                    work.append(site.callee)
+        return seen
+
+    # -- fixpoint ------------------------------------------------------------
+
+    def _propagate(self) -> None:
+        new_taint: Dict[str, Dict[str, Sv]] = {}
+        new_summaries: Dict[str, Sv] = dict(self.summaries)
+        for key, info in self.program.functions.items():
+            walker = _FunctionWalker(self, info, collect=False)
+            walker.run()
+            if key not in self._bucket_by_key:
+                if walker.return_value is not None:
+                    new_summaries[key] = walker.return_value
+                else:
+                    new_summaries.pop(key, None)
+            for callee, params in walker.arg_kinds:
+                slot = new_taint.setdefault(callee, {})
+                for pname, sv in params.items():
+                    slot[pname] = join([slot.get(pname), sv])
+        # registered sanctioners keep their forced summary whatever
+        # their bodies compute — that is what "sanctioner" means
+        for b in self.buckets:
+            if b.key:
+                new_summaries[b.key] = Sv(BUCKETED, b.name)
+        self.param_taint = new_taint
+        self.summaries = new_summaries
+
+    # -- the rule entry point ------------------------------------------------
+
+    def violations(self, scope: Sequence[str]):
+        """(path, line, col, qualname, symbol, message) sink hits for
+        every non-traced, non-exempt function in ``scope``."""
+        out = []
+        for key, info in sorted(self.program.functions.items()):
+            if not module_matches(info.path, scope):
+                continue
+            if key in self.traced:
+                continue
+            bucket = self._bucket_by_key.get(key)
+            if bucket is not None and bucket.exempt_body:
+                continue
+            walker = _FunctionWalker(self, info, collect=True)
+            walker.run()
+            out.extend(walker.violations)
+        return out
+
+    # -- shared resolution helpers -------------------------------------------
+
+    def resolve_call(self, keys: Sequence[str], call: ast.Call
+                     ) -> Optional[str]:
+        """The callee key of ``call`` as the v2 graph resolved it (the
+        graph stores edges per caller; match by node identity). The
+        walker passes its scope-key stack so calls inside nested defs —
+        which the graph attributes to the NESTED key — still resolve."""
+        for key in keys:
+            for site in self.program.callees(key):
+                if site.node is call:
+                    return site.callee
+        return None
+
+    def bucket_for_call(self, keys: Sequence[str], call: ast.Call,
+                        callee: Optional[str] = None
+                        ) -> Optional[BucketFn]:
+        """``callee`` lets the walker hand in the key it already
+        resolved — resolve_call is a linear scan over the caller's
+        call sites, and running it twice per call node doubled the
+        dominant cost of the pass."""
+        if callee is None:
+            callee = self.resolve_call(keys, call)
+        if callee is not None and callee in self._bucket_by_key:
+            return self._bucket_by_key[callee]
+        chain = attr_chain(call.func) or ""
+        return self._bucket_by_name.get(chain.split(".")[-1])
+
+
+class _FunctionWalker:
+    """One function's abstract interpretation (single forward pass in
+    statement order; loops are walked once — under-approximate)."""
+
+    def __init__(self, engine: ShapeFlowEngine, info, collect: bool):
+        self.engine = engine
+        self.info = info
+        self.collect = collect
+        self.violations: List[Tuple[str, int, int, str, str, str]] = []
+        #: (callee key, {param name -> Sv}) per resolved call site
+        self.arg_kinds: List[Tuple[str, Dict[str, Sv]]] = []
+        self.return_value: Optional[Sv] = None
+        self._returns_seen = 0
+
+    # -- entry ---------------------------------------------------------------
+
+    def run(self) -> None:
+        env: Dict[str, Sv] = {}
+        taint = self.engine.param_taint.get(self.info.key, {})
+        fn_node = self.info.node
+        args = fn_node.args
+        for a in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            sv = taint.get(a.arg)
+            if sv is not None:
+                env[a.arg] = sv
+        #: scope-key stack: the call graph attributes nested-def bodies
+        #: to the nested function's own key
+        self._keys: List[str] = [self.info.key]
+        #: bare name -> nested function key (the call graph cannot
+        #: resolve calls to nested defs; the walker can)
+        self._nested: Dict[str, str] = {}
+        self._walk_body(fn_node.body, env, self.info.qualname)
+
+    # -- statements ----------------------------------------------------------
+
+    def _walk_body(self, body, env: Dict[str, Sv], qual: str) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, env, qual)
+
+    def _walk_stmt(self, stmt, env, qual) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: closure reads the enclosing env as it stands
+            # at the def site; sinks inside report under the nested
+            # qualname (allowlist-stable, like qualname_map's labels)
+            nested_qual = f"{qual}.{stmt.name}"
+            nested_key = f"{self.info.path}::{nested_qual}"
+            if nested_key in self.engine.program.functions:
+                self._nested[stmt.name] = nested_key
+            nested_env = dict(env)
+            # the nested fn's own params shadow closure names and carry
+            # their interprocedural taints (call sites resolve to the
+            # NESTED key)
+            taint = self.engine.param_taint.get(nested_key, {})
+            nargs = stmt.args
+            for a in list(nargs.posonlyargs) + list(nargs.args) \
+                    + list(nargs.kwonlyargs):
+                sv = taint.get(a.arg)
+                if sv is not None:
+                    nested_env[a.arg] = sv
+                else:
+                    nested_env.pop(a.arg, None)
+            self._keys.append(nested_key)
+            # the nested body is walked for SINK collection only: its
+            # returns summarize under the nested function's own key
+            # (its own fixpoint pass), and letting them join into the
+            # enclosing summary convicts innocent callers of the outer
+            # function (or launders a raw outer return to unknown)
+            saved = (self.return_value, self._returns_seen)
+            self._walk_body(stmt.body, nested_env, nested_qual)
+            self.return_value, self._returns_seen = saved
+            self._keys.pop()
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._returns_seen += 1
+                sv = self._eval(stmt.value, env, qual)
+                if self._returns_seen == 1:
+                    self.return_value = sv
+                else:
+                    self.return_value = join([self.return_value, sv]) \
+                        if sv is not None and self.return_value is not None \
+                        else None
+            return
+        if isinstance(stmt, ast.AugAssign):
+            # ``n += 1`` is ``n = n <op> 1``: combine the target's
+            # CURRENT value with the RHS under the same arithmetic
+            # semantics as _binop — a raw count incremented in place
+            # stays raw (overwriting with the RHS-only value would let
+            # ``n = len(pods); n += 1`` escape what
+            # ``n = len(pods) + 1`` convicts)
+            rhs = self._eval(stmt.value, env, qual)
+            if isinstance(stmt.target, ast.Name):
+                container = isinstance(
+                    stmt.value, (ast.List, ast.Tuple, ast.ListComp)
+                )
+                sv = self._arith(
+                    stmt.op, env.get(stmt.target.id), rhs, container
+                )
+                if sv is not None:
+                    env[stmt.target.id] = sv
+                else:
+                    env.pop(stmt.target.id, None)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            if value is None:
+                return
+            sv = self._eval(value, env, qual)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                names = [t]
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    names = list(t.elts)
+                for n in names:
+                    if isinstance(n, ast.Name):
+                        if sv is not None:
+                            env[n.id] = sv
+                        else:
+                            env.pop(n.id, None)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._eval(stmt.test, env, qual)
+            self._walk_body(stmt.body, env, qual)
+            self._walk_body(stmt.orelse, env, qual)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._eval(stmt.iter, env, qual)
+            self._walk_body(stmt.body, env, qual)
+            self._walk_body(stmt.orelse, env, qual)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(item.context_expr, env, qual)
+            self._walk_body(stmt.body, env, qual)
+            return
+        if isinstance(stmt, ast.Try):
+            for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                self._walk_body(block, env, qual)
+            for handler in stmt.handlers:
+                self._walk_body(handler.body, env, qual)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env, qual)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._eval(child, env, qual)
+
+    # -- expressions ---------------------------------------------------------
+
+    def _eval(self, node, env, qual) -> Optional[Sv]:
+        if isinstance(node, ast.Constant):
+            return _CONST if isinstance(node.value, (int, bool)) else None
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            self._eval(node.value, env, qual)
+            if node.attr == "shape":
+                return Sv(ALIGNED, ".shape")
+            if node.attr == "ndim":
+                return _CONST  # rank is structural, never a count
+            if node.attr == "size":
+                return Sv(RAW, ".size")  # a product of dims is derived
+            return None
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env, qual)
+            self._eval(node.slice, env, qual)
+            return base
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return join([self._eval(e, env, qual) for e in node.elts])
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            # a comprehension's LENGTH is data-dependent: the sequence
+            # itself is a raw-dynamic axis if it ever becomes one. The
+            # element expression still gets walked (calls inside it
+            # feed the interprocedural taints and the sink scan).
+            for gen in node.generators:
+                self._eval(gen.iter, env, qual)
+                for cond in gen.ifs:
+                    self._eval(cond, env, qual)
+            if isinstance(node, ast.DictComp):
+                self._eval(node.key, env, qual)
+                self._eval(node.value, env, qual)
+            else:
+                self._eval(node.elt, env, qual)
+            return Sv(RAW, "comprehension")
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env, qual)
+            return join([self._eval(node.body, env, qual),
+                         self._eval(node.orelse, env, qual)])
+        if isinstance(node, ast.BoolOp):
+            return join([self._eval(v, env, qual) for v in node.values])
+        if isinstance(node, ast.Compare):
+            self._eval(node.left, env, qual)
+            for c in node.comparators:
+                self._eval(c, env, qual)
+            return None
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(node.operand, env, qual)
+        if isinstance(node, ast.BinOp):
+            return self._binop(node, env, qual)
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env, qual)
+        if isinstance(node, ast.Lambda):
+            return None
+        if isinstance(node, ast.Call):
+            return self._call(node, env, qual)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._eval(child, env, qual)
+        return None
+
+    def _binop(self, node: ast.BinOp, env, qual) -> Optional[Sv]:
+        left = self._eval(node.left, env, qual)
+        right = self._eval(node.right, env, qual)
+        container = isinstance(
+            node.left, (ast.List, ast.Tuple, ast.ListComp)
+        ) or isinstance(node.right, (ast.List, ast.Tuple, ast.ListComp))
+        return self._arith(node.op, left, right, container)
+
+    @staticmethod
+    def _arith(op, left: Optional[Sv], right: Optional[Sv],
+               container: bool) -> Optional[Sv]:
+        if container:
+            # list/tuple concat or repeat: element taints join, no
+            # arithmetic escalation (``[(0, pad)] + [(0, 0)] * k``)
+            return join([left, right])
+        # the pad-remainder idiom: bucket(n) - n keeps the bucket —
+        # the resulting axis IS the bucket, whatever the remainder
+        if isinstance(op, ast.Sub) and left is not None \
+                and left.kind == BUCKETED:
+            return Sv(BUCKETED, f"{left.origin}-remainder")
+        joined = join([left, right])
+        if joined is None:
+            return None
+        if joined.kind == RAW:
+            return joined
+        if joined.kind == BUCKETED:
+            return joined
+        if joined.kind == ALIGNED:
+            # arithmetic over an aligned width forfeits alignment: a
+            # DERIVED count is a new signature surface
+            return Sv(RAW, f"arith({joined.origin})")
+        return _CONST
+
+    def _call(self, node: ast.Call, env, qual) -> Optional[Sv]:
+        chain = attr_chain(node.func) or ""
+        tail = chain.split(".")[-1]
+        arg_vals = [self._eval(a, env, qual) for a in node.args]
+        kw_vals = {
+            k.arg: self._eval(k.value, env, qual)
+            for k in node.keywords if k.arg is not None
+        }
+        for k in node.keywords:
+            if k.arg is None:
+                self._eval(k.value, env, qual)
+
+        engine = self.engine
+        if chain == "len":
+            return Sv(RAW, "len()")
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "bit_length":
+            recv = self._eval(node.func.value, env, qual)
+            if recv is not None and recv.kind in (ALIGNED, RAW):
+                return Sv(RAW, "arith(bit_length)")
+            return recv
+
+        callee = engine.resolve_call(self._keys, node)
+        if callee is None and isinstance(node.func, ast.Name):
+            callee = self._nested.get(node.func.id)
+        if callee is not None:
+            # record argument taints BEFORE the sanctioner return: the
+            # padding helpers are sanctioners whose PARAMS carry the
+            # raw counts their bodies are held accountable for
+            self._record_args(callee, node, arg_vals, kw_vals)
+
+        bucket = engine.bucket_for_call(self._keys, node, callee)
+        if bucket is not None:
+            return Sv(BUCKETED, bucket.name)
+
+        if self.collect:
+            self._check_sinks(chain, tail, node, arg_vals, kw_vals, qual)
+
+        if chain in _JOIN_BUILTINS:
+            return join(arg_vals + list(kw_vals.values()))
+        if callee is not None:
+            return engine.summaries.get(callee)
+        return None
+
+    def _record_args(self, callee: str, node: ast.Call,
+                     arg_vals, kw_vals) -> None:
+        info = self.engine.program.functions.get(callee)
+        if info is None:
+            return
+        fn_args = info.node.args
+        params = [a.arg for a in list(fn_args.posonlyargs)
+                  + list(fn_args.args)]
+        if params and params[0] in ("self", "cls") \
+                and not isinstance(node.func, ast.Name):
+            params = params[1:]
+        elif params and params[0] in ("self", "cls") \
+                and info.qualname.endswith("__init__"):
+            params = params[1:]
+        mapped: Dict[str, Sv] = {}
+        for pname, sv in zip(params, arg_vals):
+            if sv is not None:
+                mapped[pname] = sv
+        kw_names = {a.arg for a in fn_args.args} \
+            | {a.arg for a in fn_args.kwonlyargs}
+        for k, sv in kw_vals.items():
+            if sv is not None and k in kw_names:
+                mapped[k] = sv
+        if mapped:
+            self.arg_kinds.append((callee, mapped))
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _flag(self, node, qual: str, symbol: str, raw: Sv,
+              what: str) -> None:
+        self.violations.append((
+            self.info.path, node.lineno, node.col_offset, qual, symbol,
+            f"{what} is {raw!r}: a raw-dynamic count reaching a "
+            f"jit-visible axis is one compiled program per value — "
+            f"route it through the registered bucket family",
+        ))
+
+    def _first_raw(self, values) -> Optional[Sv]:
+        for v in values:
+            if v is not None and v.kind == RAW:
+                return v
+        return None
+
+    def _check_sinks(self, chain, tail, node, arg_vals, kw_vals,
+                     qual) -> None:
+        if _is_jnp(chain) and tail in _ZEROS_FAMILY:
+            width = [arg_vals[0]] if arg_vals else []
+            if "shape" in kw_vals:
+                width.append(kw_vals["shape"])
+            raw = self._first_raw(width)
+            if raw is not None:
+                self._flag(node, qual, chain,
+                           raw, f"the shape of {chain}()")
+            return
+        if _is_jnp(chain) and tail == "pad":
+            width = [arg_vals[1]] if len(arg_vals) > 1 else []
+            if "pad_width" in kw_vals:
+                width.append(kw_vals["pad_width"])
+            raw = self._first_raw(width)
+            if raw is not None:
+                self._flag(node, qual, chain,
+                           raw, f"the pad widths of {chain}()")
+            return
+        if tail == "ShapeDtypeStruct":
+            width = [arg_vals[0]] if arg_vals else []
+            if "shape" in kw_vals:
+                width.append(kw_vals["shape"])
+            raw = self._first_raw(width)
+            if raw is not None:
+                self._flag(node, qual, chain,
+                           raw, "the shape of ShapeDtypeStruct")
+            return
+        if _is_jnp(chain) and tail in ("asarray", "array"):
+            raw = self._first_raw(arg_vals[:1])
+            if raw is not None and raw.origin == "comprehension":
+                self._flag(node, qual, chain, raw,
+                           f"the sequence materialized by {chain}()")
+
+
+# -- binding / adoption census (shared by the three v3 rules) ----------------
+
+@dataclasses.dataclass
+class ObservedBinding:
+    """One ``DEVICE_OBS.jit("name", jax.jit(f, ...))`` site."""
+
+    name: str
+    path: str
+    line: int
+    qualname: str             # enclosing scope ("<module>" | "Class.__init__")
+    target: str               # assignment target chain ("self._solve", "_jit_x")
+    wrapped: str              # the jitted callable's name ("" if opaque)
+    static_argnames: Tuple[str, ...]
+    has_static_argnums: bool
+    donates: bool
+
+
+@dataclasses.dataclass
+class Adoption:
+    """One ``WARM_POOL.adopt(binding, fun, config_argpos=N)`` site."""
+
+    binding: str              # resolved DEVICE_OBS binding name ("" if opaque)
+    target: str               # the raw first-arg chain
+    path: str
+    line: int
+
+
+def _tuple_of_strs(node) -> Tuple[str, ...]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return ()
+
+
+def find_observed_bindings(program: Program,
+                           obs_names: Sequence[str] = ("DEVICE_OBS",),
+                           ) -> List[ObservedBinding]:
+    """Every ``DEVICE_OBS.jit`` binding in the program, with the jit
+    factory's static/donate declarations when the second argument is a
+    literal ``jax.jit(...)`` call. Memoized on the Program instance
+    (immutable once built): the signature-space and warm-coverage
+    passes both census the whole universe, and without the memo every
+    check run walked every module's AST twice for identical results."""
+    from koordinator_tpu.analysis.graftcheck.engine import qualname_map
+
+    cached = getattr(program, "_shapeflow_bindings", None)
+    if cached is not None and cached[0] == tuple(obs_names):
+        return cached[1]
+
+    out: List[ObservedBinding] = []
+    for module in program.modules:
+        qmap = qualname_map(module.tree)
+        for node in ast.walk(module.tree):
+            target_node = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                call = node.value
+                target_node = node.targets[0]
+            elif isinstance(node, ast.Return):
+                # factory form: ``return DEVICE_OBS.jit("name", ...)``
+                # (parallel/mesh.py's shard_solver)
+                call = node.value
+            else:
+                continue
+            if not isinstance(call, ast.Call):
+                continue
+            chain = attr_chain(call.func) or ""
+            parts = chain.split(".")
+            if len(parts) < 2 or parts[-1] != "jit" \
+                    or parts[-2] not in obs_names:
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Constant):
+                continue
+            name = call.args[0].value
+            target = (attr_chain(target_node) or "") \
+                if target_node is not None else ""
+            wrapped = ""
+            statics: Tuple[str, ...] = ()
+            has_argnums = False
+            donates = False
+            if len(call.args) > 1 and isinstance(call.args[1], ast.Call):
+                jit_call = call.args[1]
+                if jit_call.args and isinstance(jit_call.args[0], ast.Name):
+                    wrapped = jit_call.args[0].id
+                for kw in jit_call.keywords:
+                    if kw.arg == "static_argnames":
+                        statics = _tuple_of_strs(kw.value)
+                    elif kw.arg == "static_argnums":
+                        has_argnums = bool(
+                            not isinstance(kw.value, ast.Tuple)
+                            or kw.value.elts
+                        )
+                    elif kw.arg == "donate_argnums":
+                        donates = bool(
+                            not isinstance(kw.value, ast.Tuple)
+                            or kw.value.elts
+                        )
+            out.append(ObservedBinding(
+                name=name, path=module.path, line=node.lineno,
+                qualname=qmap.get(id(node), "<module>"), target=target,
+                wrapped=wrapped, static_argnames=statics,
+                has_static_argnums=has_argnums, donates=donates,
+            ))
+    program._shapeflow_bindings = (tuple(obs_names), out)
+    return out
+
+
+def find_adoptions(program: Program,
+                   pool_names: Sequence[str] = ("WARM_POOL",),
+                   bindings: Optional[Sequence[ObservedBinding]] = None,
+                   ) -> List[Adoption]:
+    """Every warm-pool adopt site, with the first argument resolved to
+    its DEVICE_OBS binding name via same-module assignment targets.
+    Memoized like :func:`find_observed_bindings` (identity-keyed on
+    the bindings list, which the memo retains)."""
+    if bindings is None:
+        bindings = find_observed_bindings(program)
+    cached = getattr(program, "_shapeflow_adoptions", None)
+    if cached is not None and cached[0] is bindings \
+            and cached[1] == tuple(pool_names):
+        return cached[2]
+    by_module: Dict[str, Dict[str, str]] = {}
+    for b in bindings:
+        # return-factory bindings have no assignment target — mapping
+        # their "" would let any OPAQUE adopt expression (attr_chain
+        # -> "") in the same module silently resolve to a factory
+        # binding, suppressing the opaque-adoption finding AND faking
+        # the factory as adopted
+        if b.target:
+            by_module.setdefault(b.path, {})[b.target] = b.name
+    out: List[Adoption] = []
+    for module in program.modules:
+        targets = by_module.get(module.path, {})
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func) or ""
+            parts = chain.split(".")
+            if len(parts) < 2 or parts[-1] != "adopt" \
+                    or parts[-2] not in pool_names:
+                continue
+            if not node.args:
+                continue
+            target = attr_chain(node.args[0]) or ""
+            binding = targets.get(target, "") if target else ""
+            out.append(Adoption(
+                binding=binding, target=target,
+                path=module.path, line=node.lineno,
+            ))
+    program._shapeflow_adoptions = (bindings, tuple(pool_names), out)
+    return out

@@ -778,6 +778,20 @@ class DeviceObservatory:
         payload["live"] = self.live_snapshot()
         return payload
 
+    def compile_ring(self, since_seq: int = 0) -> Tuple[List[dict], int]:
+        """Ring entries newer than ``since_seq`` WITH their raw
+        ``(fn_name, signature)`` keys, plus the current sequence — the
+        shape-flow sentinel's read surface (testing/shapeflow.py):
+        per-window marks isolate one test's compiles, and the keys
+        carry the per-leaf (shape, dtype) tuples the sentinel checks
+        against the static enumeration. Bounded by the ring capacity
+        like every other reader; one lock hold, no device work."""
+        with self._lock:
+            return (
+                [dict(r) for r in self._ring if r["seq"] > since_seq],
+                self._seq,
+            )
+
     # -- bench fingerprinting ------------------------------------------------
 
     def mark(self) -> dict:

@@ -220,6 +220,14 @@ scatter_node_rows_copied = DEVICE_OBS.jit("scatter_node_rows_copied", jax.jit(
 ))
 
 
+def dirty_row_bucket(d: int) -> int:
+    """The dirty-row scatter's shape bucket (next power of two, floor
+    8) — a named member of the repo bucket family so graftcheck's
+    shape-flow passes can enumerate its finite image and sanction the
+    flows through it (docs/DESIGN.md §23)."""
+    return max(8, 1 << (d - 1).bit_length())
+
+
 def bucket_row_update(idx, rows):
     """Pad a dirty-row update to a power-of-two bucket by repeating the
     last row — identical writes land on the same index, so the scatter
@@ -228,7 +236,7 @@ def bucket_row_update(idx, rows):
     import numpy as np
 
     d = int(idx.shape[0])
-    target = max(8, 1 << (d - 1).bit_length())
+    target = dirty_row_bucket(d)
     DEVICE_OBS.note_padding("dirty_rows", d, target)
     if target == d:
         return idx, rows

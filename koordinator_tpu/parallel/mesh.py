@@ -180,6 +180,18 @@ def shard_node_bucket(n: int, shards: int) -> int:
     return pow2_quarter_bucket(-(-n // shards)) * shards
 
 
+def shard_tile_bucket(n: int, shards: int) -> int:
+    """The padded GLOBAL node count for the sharded pallas kernel:
+    each shard's local width is ``ceil(n / shards)`` tile-aligned to
+    the 128-lane VPU register width, every shard equal-width. The
+    kernel-ABI sibling of :func:`shard_node_bucket` — a named member
+    of the repo bucket family (docs/DESIGN.md §23) so graftcheck's
+    shape-flow passes can enumerate its finite image. The math is the
+    inline form PR 12 shipped, bit for bit."""
+    local = ((n + 128 * shards - 1) // (128 * shards)) * 128
+    return local * shards
+
+
 def pad_node_arrays(arrays: NodeArrays, multiple: int) -> NodeArrays:
     """Pad the node axis up to a multiple of the shard count.
 
@@ -293,8 +305,8 @@ def shard_kernel_solver(mesh: Mesh, config: SolverConfig = SolverConfig(),
         n = state.alloc.shape[0]
         # pad the node axis to shards x 128-lane multiples with
         # unschedulable zero rows (they can never win)
-        n_loc = ((n + 128 * k - 1) // (128 * k)) * 128
-        n_pad = n_loc * k
+        n_pad = shard_tile_bucket(n, k)
+        n_loc = n_pad // k
         if n_pad > 65536:
             raise ValueError("packed argmax carries 16 lane bits")
         use_r = resv is not None

@@ -214,6 +214,16 @@ def coalesce_key(req: SolveRequest) -> Optional[bytes]:
     return h.digest()
 
 
+def coalesce_pod_bucket(p: int) -> int:
+    """The coalesced lane stack's pod-axis bucket (next power of two,
+    floor 8): the largest request in the batch is padded here and every
+    lane rides that width. A named member of the repo bucket family
+    (docs/DESIGN.md §23) so graftcheck's shape-flow passes can
+    enumerate its finite image — the math is unchanged from the PR 8
+    inline form, bit for bit."""
+    return max(8, 1 << max(0, p - 1).bit_length())
+
+
 def _vmapped_plain_solve(state, pods, params, config):
     """K independent plain solves against one shared base, as ONE XLA
     program: ``pods`` carries a leading request axis; the scan runs per
@@ -292,7 +302,7 @@ def solve_coalesced(
         **{f: jnp.asarray(head.params[f]) for f in _PARAM_FIELDS}
     )
     counts = [int(np.asarray(r.pods["req"]).shape[0]) for r in requests]
-    bucket = max(8, 1 << max(0, max(counts) - 1).bit_length())
+    bucket = coalesce_pod_bucket(max(counts))
     # the coalesced lane stack's bucket padding, reported like every
     # other pow2 staging buffer (docs/DESIGN.md §17)
     DEVICE_OBS.note_padding(

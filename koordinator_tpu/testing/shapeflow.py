@@ -1,0 +1,296 @@
+"""Runtime shape-flow sentinel: the dynamic half of graftcheck v3's
+signature-space pass (docs/DESIGN.md §23) — the same shape the
+lock-order shim gives the lock-order rule.
+
+The static pass (analysis/graftcheck/rules/shape_flow.py) enumerates,
+per ``DEVICE_OBS.jit`` binding, the finite set of axis values the
+bucket family can produce under the config bounds. This sentinel
+closes the gap static resolution can't: it reads the SAME enumeration
+(derived from the same program analysis — never hand-copied) and
+asserts, against the device observatory's live compile ring, that
+every signature a real workload actually compiles is inside it.
+
+Mechanics:
+
+- :meth:`begin_window` marks the compile-ring sequence;
+  :meth:`verify_window` reads the entries the window produced and
+  checks them. The warmed chaos and streaming suites run every test in
+  its own window (autouse fixtures), so a structure change BETWEEN
+  tests (a new world size) never smears into a false positive.
+- per window, observed signatures group by ``(fn, pytree structure,
+  leaf count)``. A leaf dimension that is CONSTANT across the window's
+  signatures is structural (node width, feature columns — quasi-static
+  axes the static pass declares as such). A dimension that VARIES is a
+  live recompile axis and every observed value must be a member of the
+  binding's enumerated bucket images — a varying value outside them is
+  exactly an unbounded-signature-surface storm in progress.
+- a compile from a binding the enumeration doesn't know is itself a
+  violation: an undeclared hot jit appeared at runtime.
+- violations are recorded, never raised mid-test (the suites keep
+  running; the fixture asserts ``violations == []`` at teardown), and
+  the report carries non-vacuity counters: windows with compiles,
+  dimensions checked, dimensions covered by the enumeration — so "the
+  sentinel passed" can never mean "the sentinel watched nothing".
+
+Importing this module needs jax only transitively (the observatory);
+building the enumeration imports the live bucket functions — the same
+dependency surface the static rule already carries.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+#: from_static_analysis results memoized per BINDING_SPECS tuple: the
+#: whole-repo program build costs seconds and is a pure function of
+#: the source tree + registry, so one process builds it once however
+#: many suites arm sentinels (a monkeypatched registry is a different
+#: key and rebuilds — the refuses-to-arm property stays live)
+_STATIC_CACHE: Dict[object, Tuple[dict, dict, dict]] = {}
+
+
+class ShapeFlowSentinel:
+    """Assert observed compile signatures stay inside the statically
+    enumerated signature space."""
+
+    def __init__(self, allowed: Dict[str, Set[int]],
+                 structural: Optional[Dict[str, Sequence[str]]] = None,
+                 axis_images: Optional[
+                     Dict[str, Tuple[frozenset, ...]]] = None):
+        """``allowed``: binding name -> union of its enumerated axis
+        values. ``structural``: binding -> declared quasi-static axis
+        names (report detail only; the constant-within-window check is
+        positional). ``axis_images``: binding -> per-axis image sets —
+        when present, a varying position's value set must additionally
+        fit inside ONE axis's image (union membership alone would let
+        one axis's values launder another's: the config-capped raw
+        lane range covers every small integer)."""
+        self.allowed = {k: set(v) for k, v in allowed.items()}
+        self.structural = dict(structural or {})
+        self.axis_images = {
+            k: tuple(frozenset(s) for s in v)
+            for k, v in (axis_images or {}).items()
+        }
+        self.violations: List[dict] = []
+        self.windows = 0
+        self.windows_with_compiles = 0
+        self.observed_compiles = 0
+        self.dims_checked = 0
+        self.dims_covered = 0
+        self._lock = threading.Lock()
+        self._mark = 0
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_static_analysis(cls) -> "ShapeFlowSentinel":
+        """Build from the SAME program analysis the static rule runs —
+        the enumeration is derived, never hand-copied. The build is
+        memoized per registry tuple (pure function of the source
+        tree), so repeated arming across suites costs one analysis."""
+        from pathlib import Path
+
+        from koordinator_tpu.analysis.graftcheck.__main__ import (
+            find_repo_root,
+        )
+        from koordinator_tpu.analysis.graftcheck.callgraph import (
+            build_program,
+        )
+        from koordinator_tpu.analysis.graftcheck.engine import (
+            iter_repo_modules,
+        )
+        from koordinator_tpu.analysis.graftcheck import rules as _rules
+
+        specs = _rules.BINDING_SPECS
+        cached = _STATIC_CACHE.get(specs)
+        if cached is not None:
+            allowed, structural, axis_images = cached
+            return cls(allowed=allowed, structural=structural,
+                       axis_images=axis_images)
+
+        root = find_repo_root(Path(__file__).resolve())
+        program = build_program(list(iter_repo_modules(root)))
+        rule = _rules.SignatureSpaceRule(specs=specs)
+        findings = rule.check_program(program)
+        if findings:
+            raise AssertionError(
+                "signature-space enumeration is not clean; the "
+                "sentinel refuses to arm from a broken registry:\n"
+                + "\n".join(v.format() for v in findings)
+            )
+        allowed: Dict[str, Set[int]] = {}
+        structural: Dict[str, Sequence[str]] = {}
+        axis_images: Dict[str, Tuple[frozenset, ...]] = {}
+        for name, entry in rule.last_space.items():
+            values: Set[int] = set()
+            for axis in entry["axes"]:
+                values.update(axis["values"])
+            allowed[name] = values
+            structural[name] = tuple(entry["structural_axes"])
+            axis_images[name] = tuple(
+                frozenset(axis["values"]) for axis in entry["axes"]
+            )
+        _STATIC_CACHE[specs] = (allowed, structural, axis_images)
+        return cls(allowed=allowed, structural=structural,
+                   axis_images=axis_images)
+
+    # -- windows -------------------------------------------------------------
+
+    def begin_window(self) -> None:
+        from koordinator_tpu.obs.device import DEVICE_OBS
+
+        _, seq = DEVICE_OBS.compile_ring()
+        with self._lock:
+            self._mark = seq
+            self.windows += 1
+
+    def verify_window(self) -> None:
+        """Check every compile the window produced; record violations
+        (never raise — teardown asserts)."""
+        from koordinator_tpu.obs.device import DEVICE_OBS
+
+        with self._lock:
+            mark = self._mark
+        entries, _ = DEVICE_OBS.compile_ring(mark)
+        self.check_entries(
+            [(e["fn"], e["key"][1]) for e in entries if "key" in e]
+        )
+
+    # -- the check (pure; unit-testable without a live observatory) ----------
+
+    @staticmethod
+    def _leaf_dims(sig) -> Optional[Tuple[Tuple[int, ...], ...]]:
+        """The array-leaf shape tuples of one observed signature
+        (``_signature`` leaves: arrays as (shape, dtype), statics by
+        value — only shape-like leaves carry dims)."""
+        try:
+            leaves = sig[1]
+        except Exception:
+            return None
+        shapes = []
+        for leaf in leaves:
+            if (
+                isinstance(leaf, tuple) and len(leaf) == 2
+                and isinstance(leaf[0], tuple)
+                and all(isinstance(d, int) for d in leaf[0])
+            ):
+                shapes.append(tuple(leaf[0]))
+        return tuple(shapes)
+
+    def check_entries(self, entries: Sequence[Tuple[str, object]]) -> None:
+        """``entries``: (fn_name, signature) pairs from one window."""
+        if not entries:
+            return
+        with self._lock:
+            self.windows_with_compiles += 1
+            self.observed_compiles += len(entries)
+        #: (fn, treedef repr, n leaves) -> list of dim matrices
+        groups: Dict[Tuple, List] = {}
+        for fn, sig in entries:
+            if fn not in self.allowed:
+                with self._lock:
+                    self.violations.append({
+                        "kind": "unknown-binding", "fn": fn,
+                        "detail": (
+                            "compile observed from a binding the "
+                            "static enumeration does not declare"
+                        ),
+                    })
+                continue
+            dims = self._leaf_dims(sig)
+            if dims is None:
+                continue
+            try:
+                tree = repr(sig[0])
+            except Exception:
+                tree = "?"
+            groups.setdefault((fn, tree, len(dims)), []).append(dims)
+        for (fn, _tree, _n), dim_sets in groups.items():
+            allowed = self.allowed[fn]
+            # positionally align: dimension (leaf i, axis j) across the
+            # window's signatures; constant positions are structural,
+            # varying positions must live inside the enumeration
+            positions: Dict[Tuple[int, int], Set[int]] = {}
+            for dims in dim_sets:
+                for i, shape in enumerate(dims):
+                    for j, d in enumerate(shape):
+                        positions.setdefault((i, j), set()).add(d)
+            for (i, j), values in sorted(positions.items()):
+                if len(values) <= 1:
+                    # constant within the window: structural. Still
+                    # counts toward coverage when the enumeration
+                    # names it — the "actually exercised" signal: the
+                    # static image describes live signatures, not just
+                    # hypothetical ones.
+                    d = next(iter(values))
+                    if d in allowed:
+                        with self._lock:
+                            self.dims_covered += 1
+                    continue
+                with self._lock:
+                    self.dims_checked += len(values)
+                for d in sorted(values):
+                    if d in allowed:
+                        with self._lock:
+                            self.dims_covered += 1
+                    else:
+                        with self._lock:
+                            self.violations.append({
+                                "kind": "out-of-enumeration",
+                                "fn": fn, "leaf": i, "axis": j,
+                                "value": d,
+                                "varying": sorted(values),
+                                "detail": (
+                                    "a VARYING axis value outside the "
+                                    "enumerated bucket images — an "
+                                    "unbounded recompile surface in "
+                                    "progress"
+                                ),
+                            })
+                # one position is ONE semantic axis: beyond union
+                # membership, the varying set must fit a single axis's
+                # image — otherwise one axis's values launder
+                # another's (the config-capped raw lane range covers
+                # every small integer)
+                images = self.axis_images.get(fn)
+                if images and values <= allowed and not any(
+                        values <= img for img in images):
+                    with self._lock:
+                        self.violations.append({
+                            "kind": "axis-inconsistent",
+                            "fn": fn, "leaf": i, "axis": j,
+                            "varying": sorted(values),
+                            "detail": (
+                                "the varying values are each inside "
+                                "SOME enumerated image but no single "
+                                "axis's image contains them all — a "
+                                "surface drifting across axis "
+                                "identities"
+                            ),
+                        })
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "windows": self.windows,
+                "windows_with_compiles": self.windows_with_compiles,
+                "observed_compiles": self.observed_compiles,
+                "dims_checked": self.dims_checked,
+                "dims_covered": self.dims_covered,
+                "enumerated_bindings": len(self.allowed),
+                "enumerated_values": sum(
+                    len(v) for v in self.allowed.values()
+                ),
+                # which axes the registry declares quasi-static per
+                # binding — the report's explanation for why a
+                # constant-within-window dimension outside every
+                # bucket image is still legitimate
+                "structural_axes": {
+                    k: list(v) for k, v in self.structural.items()
+                },
+                "violations": list(self.violations),
+            }

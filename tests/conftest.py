@@ -30,6 +30,17 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+#: module nodeids (e.g. "tests/test_chaos.py") that had tests
+#: deselected this run (-k / -m / --deselect): the shape-flow
+#: sentinel's non-vacuity teardown only fires on modules that ran
+#: their full test set
+_DESELECTED_MODULES = set()
+
+
+def pytest_deselected(items):
+    for item in items:
+        _DESELECTED_MODULES.add(item.nodeid.split("::", 1)[0])
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
@@ -72,6 +83,50 @@ def lock_order_shim():
             "lock-order shim observed no acquisitions — the "
             "instrumentation no longer reaches the mapped locks"
         )
+
+
+@pytest.fixture(scope="module")
+def shape_flow_sentinel(request):
+    """The runtime shape-flow sentinel (ISSUE 15, docs/DESIGN.md §23):
+    derives the expected signature set from the SAME static analysis
+    the ``signature-space`` rule runs and asserts every signature the
+    DEVICE_OBS compile ring observes is inside it. The analysis build
+    is memoized process-wide (testing/shapeflow.py), so module scope
+    costs one build however many suites arm; the chaos and streaming
+    suites opt in with an autouse per-test window wrapper so a
+    structure change BETWEEN tests never smears into a false positive.
+    Teardown asserts zero out-of-enumeration compiles always, and
+    non-vacuity — compiles observed, enumeration covering live dims —
+    only on a module that ran its FULL test set: a ``-k``/``-m``/
+    nodeid selection of a few fake-clock tests legitimately compiles
+    nothing, and erroring such a run would punish exactly the narrow
+    reruns developers use. Partial selection is detected by
+    deselection events against this module plus explicit nodeid args;
+    tier-1's ``-m 'not slow'`` deselects nothing in the sentinel-armed
+    modules, so the canonical run enforces non-vacuity."""
+    from koordinator_tpu.testing.shapeflow import ShapeFlowSentinel
+
+    sentinel = ShapeFlowSentinel.from_static_analysis()
+    yield sentinel
+    report = sentinel.report()
+    assert report["violations"] == [], (
+        "runtime shape-flow violations (out-of-enumeration compiles):\n"
+        + "\n".join(map(str, report["violations"]))
+    )
+    assert report["enumerated_values"] > 0, (
+        "shape-flow sentinel armed with an EMPTY enumeration"
+    )
+    module_id = request.node.nodeid
+    nodeid_selected = any(
+        "::" in str(a) for a in request.config.invocation_params.args
+    )
+    if nodeid_selected or module_id in _DESELECTED_MODULES:
+        return
+    assert report["windows_with_compiles"] > 0 \
+        and report["dims_covered"] > 0, (
+        f"shape-flow sentinel was vacuous: {report} — the suite no "
+        f"longer exercises any enumerated compile signature"
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,38 @@
+"""Seeded metrics-hygiene violations beside the clean shapes: a served
+registry with domain-declared labels (quiet), an unserved registry
+(violation), an unknown label (violation), and a folded label whose
+fold symbol exists (quiet) — the rule's fixture is self-contained so
+the test can narrow its MetricsSpec to this file."""
+
+from koordinator_tpu.metrics.registry import MergedGatherer, Registry
+
+# annotated on purpose: the fold-symbol census must see AnnAssign
+# module constants too (a type-annotation refactor must not read as
+# "the fold was deleted")
+OVERFLOW_USER: str = "_overflow"
+
+SERVED = Registry("fx-served")
+GOOD = SERVED.counter(
+    "fx_good_total", "bounded enum label", label_names=("lane",),
+)
+FOLDED = SERVED.counter(
+    "fx_folded_total", "folded label", label_names=("user",),
+)
+UNBOUNDED = SERVED.counter(
+    "fx_unbounded_total", "hostile label", label_names=("pod_name",),
+)
+
+ORPHAN = Registry("fx-orphan")
+LOST = ORPHAN.gauge("fx_lost", "registered but unscrapeable")
+
+
+def _local_decoy():
+    # a function-local name must never satisfy the fold-symbol check:
+    # fold sentinels are module-level constants
+    GONE = "_overflow"
+    return GONE
+
+# bare-Name argument on purpose: registries reach the mux as literal
+# list elements in the repo, but a positional-args refactor must
+# still count as served
+_MUX = MergedGatherer(SERVED)

@@ -1,0 +1,102 @@
+"""Seeded bucket-flow violations + adjacent clean shapes.
+
+The bad functions each route a raw-dynamic count (len(), comprehension,
+arithmetic over .shape) into a device-width sink; the clean functions
+exercise the sanctioned idioms the rule must stay quiet on: a bucket
+call, a bare aligned width, and the pad-remainder idiom.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def fx_bucket(n, floor=8):
+    """The fixture's registered bucket function."""
+    if n <= floor:
+        return floor
+    return 1 << (n - 1).bit_length()
+
+
+def raw_len_zeros(pods):
+    n = len(pods)
+    return jnp.zeros(n, jnp.int32)
+
+
+def raw_len_struct(pods):
+    n = len(pods)
+    return jax.ShapeDtypeStruct((n, 4), jnp.int32)
+
+
+def raw_len_pad(a, pods):
+    extra = len(pods)
+    return jnp.pad(a, [(0, extra)])
+
+
+def raw_comprehension_asarray(pods):
+    return jnp.asarray([p.cpu for p in pods])
+
+
+def raw_augassign_zeros(pods):
+    # in-place arithmetic over a raw count stays raw: ``n += 1`` is
+    # ``n = n + 1``, the same surface as the spelled-out form
+    n = len(pods)
+    n += 1
+    return jnp.zeros(n, jnp.int32)
+
+
+def raw_arith_shape(a):
+    doubled = a.shape[0] * 2
+    return jnp.zeros(doubled, jnp.int32)
+
+
+def raw_via_helper(pods):
+    # interprocedural: the raw len flows through a parameter
+    return _make_axis(len(pods))
+
+
+def _make_axis(count):
+    return jnp.zeros(count, jnp.int32)
+
+
+def clean_bucketed(pods):
+    n = fx_bucket(len(pods))
+    return jnp.zeros(n, jnp.int32)
+
+
+def clean_aligned(a):
+    # a width copied from an existing axis adds no new surface
+    return jnp.zeros(a.shape[0], jnp.int32)
+
+
+def clean_pad_remainder(a, pods):
+    # the canonical pad idiom: bucket(n) - n stays bucketed
+    n = len(pods)
+    target = fx_bucket(n)
+    pad = target - n
+    return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+
+
+def clean_constant():
+    return jnp.zeros(64, jnp.int32)
+
+
+def clean_augassign_constant():
+    # constant arithmetic stays constant, in-place or not
+    k = 4
+    k += 60
+    return jnp.zeros(k, jnp.int32)
+
+
+def clean_nested_return(pods):
+    # a nested def's raw return must summarize under the NESTED
+    # function's key, never contaminate the enclosing summary: this
+    # function returns a bucketed width, so its caller stays clean
+    def helper(xs):
+        return len(xs)
+
+    _ = helper(pods)
+    return fx_bucket(7)
+
+
+def clean_nested_return_caller(pods):
+    return jnp.zeros(clean_nested_return(pods), jnp.int32)

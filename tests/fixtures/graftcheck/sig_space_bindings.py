@@ -1,0 +1,43 @@
+"""Seeded signature-space / warm-coverage shapes: one declared and
+adopted binding (clean), one undeclared binding, one adopted binding
+whose statics fall outside the hashable registry, and one hot binding
+never adopted (cold-on-every-recovery)."""
+
+import jax
+
+from koordinator_tpu.obs.device import DEVICE_OBS
+from koordinator_tpu.service.warmpool import WARM_POOL
+
+
+def fx_solve(state, pods, params, config):
+    return pods
+
+
+def fx_orphan(state):
+    return state
+
+
+def fx_weird(state, pods, params, session):
+    return pods
+
+
+_jit_declared = DEVICE_OBS.jit("fx_declared", jax.jit(
+    fx_solve, static_argnames=("config",), donate_argnums=()
+))
+WARM_POOL.adopt(_jit_declared, fx_solve, config_argpos=3)
+
+# no BindingSpec anywhere: an unknown recompile surface
+_jit_undeclared = DEVICE_OBS.jit("fx_undeclared", jax.jit(
+    fx_orphan, donate_argnums=()
+))
+
+# adopted, but its static is not in the hashable-statics registry
+_jit_weird = DEVICE_OBS.jit("fx_weird_statics", jax.jit(
+    fx_weird, static_argnames=("session",), donate_argnums=()
+))
+WARM_POOL.adopt(_jit_weird, fx_weird, config_argpos=3)
+
+# hot (in the narrowed scope) and never adopted: cold on every recovery
+_jit_cold = DEVICE_OBS.jit("fx_cold", jax.jit(
+    fx_solve, static_argnames=("config",), donate_argnums=()
+))

@@ -55,6 +55,19 @@ def _lock_order_under_chaos(lock_order_shim):
     yield lock_order_shim
 
 
+@pytest.fixture(autouse=True)
+def _shape_flow_under_chaos(shape_flow_sentinel):
+    """Every chaos scenario also runs inside a shape-flow sentinel
+    window (ISSUE 15): any signature the compile ring observes during
+    the scenario must be inside the statically-enumerated signature
+    space — a recompile storm under fault injection fails here, not in
+    a production tail (module teardown asserts zero violations and
+    non-vacuity)."""
+    shape_flow_sentinel.begin_window()
+    yield
+    shape_flow_sentinel.verify_window()
+
+
 N_NODES = 16
 PENDING_PER_TICK = 8
 DIRTY_PER_TICK = 3

@@ -182,9 +182,16 @@ def test_injected_device_get_fails():
         if e.path == path
     ]
     violations, _ = run_checks([module], default_rules(), allow)
-    assert len(violations) == 1
-    assert violations[0].symbol == "jax.device_get"
-    assert violations[0].func == "PlacementModel.schedule_async"
+    # the v3 census passes (signature-space/warm-coverage) legitimately
+    # report registry mismatches against a ONE-module program — the
+    # injected-sync property here is about the sync rules
+    hits = [
+        v for v in violations
+        if v.rule in ("host-sync", "sync-reach")
+    ]
+    assert len(hits) == 1
+    assert hits[0].symbol == "jax.device_get"
+    assert hits[0].func == "PlacementModel.schedule_async"
 
 
 # -- 3b. allowlist engine teeth ----------------------------------------------

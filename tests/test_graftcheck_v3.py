@@ -1,0 +1,548 @@
+"""graftcheck v3: static shape-flow — per-pass self-tests + teeth.
+
+The ISSUE 15 layers, mirroring test_graftcheck_v2.py's structure:
+
+1. each new pass detects its seeded-violation fixture
+   (``tests/fixtures/graftcheck/``) and stays quiet on the sanctioned
+   idioms beside it (bucket calls, aligned widths, pad remainders);
+2. the real repo is clean across all passes AND the enumeration is
+   non-vacuous (the committed bucket images really contain the hot
+   buckets — an empty enumeration would pass a coverage check for the
+   wrong reason);
+3. injected violations in REAL source fail loudly: the pre-PR 8 storm
+   shape itself (a stripped bucket call in ``_pad_pods``), an
+   un-adopted ``solve_batch`` (cold-on-every-recovery), and a renamed
+   binding (unknown recompile surface + stale declaration);
+4. the runtime sentinel (testing/shapeflow.py) convicts
+   out-of-enumeration compiles — unit-level on synthetic signatures
+   and END TO END against a live PlacementModel driving two pod
+   buckets — and its chaos/streaming teeth live in
+   test_chaos.py/test_streaming.py as autouse window fixtures;
+5. the CLI exports the signature-space sidecar and the new
+   whole-program passes run full-graph under ``--changed-files``.
+"""
+
+import ast
+import json
+from pathlib import Path
+
+import pytest
+
+from koordinator_tpu.analysis.graftcheck import (
+    ModuleFile,
+    default_rules,
+    load_allowlist,
+    load_module,
+    run_checks,
+)
+from koordinator_tpu.analysis.graftcheck.callgraph import (
+    Program,
+    build_program,
+)
+from koordinator_tpu.analysis.graftcheck.engine import (
+    iter_repo_modules,
+    run_checks_timed,
+)
+from koordinator_tpu.analysis.graftcheck.rules import (
+    BINDING_SPECS,
+    AxisSpec,
+    BindingSpec,
+    BucketFlowRule,
+    BucketFn,
+    LabelDomain,
+    MetricsHygieneRule,
+    MetricsSpec,
+    SignatureSpaceRule,
+    WarmCoverageRule,
+)
+from koordinator_tpu.analysis.graftcheck.rules.shape_flow import (
+    enumerate_axis,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "graftcheck"
+
+SF_PATH = "tests/fixtures/graftcheck/shape_flow_bad.py"
+SIG_PATH = "tests/fixtures/graftcheck/sig_space_bindings.py"
+MET_PATH = "tests/fixtures/graftcheck/metrics_bad.py"
+
+FX_BUCKETS = (BucketFn(name="fx_bucket", path=SF_PATH,
+                       qualname="fx_bucket", exempt_body=True),)
+
+FX_SPECS = (
+    BindingSpec(name="fx_declared", path=SIG_PATH, axes=(AxisSpec(
+        axis="pods",
+        bucket="koordinator_tpu.parallel.mesh:pow2_quarter_bucket",
+        kwargs_options=((("floor", 8),),), bound=256,
+        bound_source="fixture"),)),
+    BindingSpec(name="fx_weird_statics", path=SIG_PATH, axes=(AxisSpec(
+        axis="pods",
+        bucket="koordinator_tpu.parallel.mesh:pow2_quarter_bucket",
+        kwargs_options=((("floor", 8),),), bound=256,
+        bound_source="fixture"),)),
+    BindingSpec(name="fx_cold", path=SIG_PATH, axes=(AxisSpec(
+        axis="pods",
+        bucket="koordinator_tpu.parallel.mesh:pow2_quarter_bucket",
+        kwargs_options=((("floor", 8),),), bound=256,
+        bound_source="fixture"),)),
+)
+
+
+def _fixture(name: str) -> ModuleFile:
+    rel = f"tests/fixtures/graftcheck/{name}"
+    return load_module(FIXTURES / name, rel)
+
+
+@pytest.fixture(scope="module")
+def repo_program():
+    return build_program(list(iter_repo_modules(REPO)))
+
+
+# -- 1. the new passes detect their seeded fixtures --------------------------
+
+def test_bucket_flow_fixture_detected():
+    module = _fixture("shape_flow_bad.py")
+    rule = BucketFlowRule(scope=(SF_PATH,), buckets=FX_BUCKETS)
+    violations = rule.check_program(Program([module]))
+    by_func = {v.func for v in violations}
+    assert by_func == {
+        "raw_len_zeros", "raw_len_struct", "raw_len_pad",
+        "raw_comprehension_asarray", "raw_augassign_zeros",
+        "raw_arith_shape",
+        # the interprocedural case reports at the sink, inside the
+        # helper the raw len flowed into
+        "_make_axis",
+    }, [v.format() for v in violations]
+    for quiet in ("clean_bucketed", "clean_aligned",
+                  "clean_pad_remainder", "clean_constant",
+                  "clean_augassign_constant", "clean_nested_return",
+                  "clean_nested_return_caller"):
+        assert quiet not in by_func
+    assert all("raw-dynamic" in v.message for v in violations)
+
+
+def test_signature_space_fixture_detected():
+    module = _fixture("sig_space_bindings.py")
+    rule = SignatureSpaceRule(specs=FX_SPECS)
+    violations = rule.check_program(Program([module]))
+    assert [v.symbol for v in violations] == ["fx_undeclared"], (
+        [v.format() for v in violations]
+    )
+    assert "no BindingSpec" in violations[0].message
+    # the sidecar carries the enumerated images for the declared ones
+    space = rule.last_space
+    assert set(space) == {"fx_declared", "fx_weird_statics", "fx_cold"}
+    assert space["fx_declared"]["adopted"] is True
+    assert space["fx_cold"]["adopted"] is False
+    values = space["fx_declared"]["axes"][0]["values"]
+    assert 8 in values and 256 in values and 9 not in values
+
+
+def test_signature_space_stale_spec_detected():
+    module = _fixture("sig_space_bindings.py")
+    ghost = FX_SPECS + (BindingSpec(
+        name="fx_ghost", path=SIG_PATH, axes=()),)
+    rule = SignatureSpaceRule(specs=ghost)
+    violations = rule.check_program(Program([module]))
+    assert any(
+        v.symbol == "fx_ghost" and "stale" in v.message
+        for v in violations
+    ), [v.format() for v in violations]
+
+
+def test_warm_coverage_fixture_detected():
+    module = _fixture("sig_space_bindings.py")
+    rule = WarmCoverageRule(specs=FX_SPECS, hot_scope=(SIG_PATH,))
+    violations = rule.check_program(Program([module]))
+    by_symbol = {v.symbol for v in violations}
+    # statics outside the hashable registry + the two never-adopted
+    # hot bindings; the declared+adopted one stays quiet
+    assert by_symbol == {"fx_weird_statics", "fx_cold",
+                        "fx_undeclared"}, (
+        [v.format() for v in violations]
+    )
+    weird = [v for v in violations if v.symbol == "fx_weird_statics"]
+    assert any("session" in v.message for v in weird)
+    cold = [v for v in violations if v.symbol == "fx_cold"]
+    assert any("cold-on-every-recovery" in v.message for v in cold)
+
+
+def test_opaque_adoption_never_resolves_to_factory_binding():
+    """A return-factory binding has no assignment target; an OPAQUE
+    adopt expression in the same module must be flagged as
+    unresolvable, never silently resolved to the factory (which would
+    also fake the factory adopted, hiding its cold-on-every-recovery
+    finding)."""
+    from koordinator_tpu.analysis.graftcheck.shapeflow import (
+        find_adoptions,
+        find_observed_bindings,
+    )
+
+    path = "tests/fixtures/graftcheck/opaque_inline.py"
+    src = (
+        "import jax\n"
+        "from koordinator_tpu.obs.device import DEVICE_OBS\n"
+        "from koordinator_tpu.service.warmpool import WARM_POOL\n"
+        "\n"
+        "\n"
+        "def fx_solve(state, pods, params, config):\n"
+        "    return pods\n"
+        "\n"
+        "\n"
+        "def fx_make():\n"
+        "    return DEVICE_OBS.jit(\"fx_factory\", jax.jit(\n"
+        "        fx_solve, static_argnames=(\"config\",),\n"
+        "        donate_argnums=()\n"
+        "    ))\n"
+        "\n"
+        "\n"
+        "WARM_POOL.adopt(fx_make(), fx_solve, config_argpos=3)\n"
+    )
+    program = Program([_reparse(path, src)])
+    bindings = find_observed_bindings(program)
+    assert [b.name for b in bindings] == ["fx_factory"]
+    adoptions = find_adoptions(program, bindings=bindings)
+    assert [a.binding for a in adoptions] == [""], adoptions
+
+    spec = (BindingSpec(name="fx_factory", path=path, axes=(AxisSpec(
+        axis="pods",
+        bucket="koordinator_tpu.parallel.mesh:pow2_quarter_bucket",
+        kwargs_options=((("floor", 8),),), bound=64,
+        bound_source="fixture"),)),)
+    rule = WarmCoverageRule(specs=spec, hot_scope=(path,))
+    violations = rule.check_program(program)
+    assert any(
+        "does not resolve" in v.message for v in violations
+    ), [v.format() for v in violations]
+    assert any(
+        v.symbol == "fx_factory" and "cold-on-every-recovery" in v.message
+        for v in violations
+    ), [v.format() for v in violations]
+
+
+def test_metrics_hygiene_fixture_detected():
+    module = _fixture("metrics_bad.py")
+    spec = MetricsSpec(
+        components_path=MET_PATH,
+        registries=("SERVED", "ORPHAN"),
+        label_domains={
+            "lane": LabelDomain(kind="enum", values=("a", "b")),
+            "user": LabelDomain(kind="folded",
+                                fold_symbol="OVERFLOW_USER"),
+        },
+    )
+    rule = MetricsHygieneRule(spec=spec)
+    violations = rule.check_program(Program([module]))
+    by_symbol = {v.symbol for v in violations}
+    assert by_symbol == {"fx_unbounded_total", "ORPHAN"}, (
+        [v.format() for v in violations]
+    )
+    # and the fold check has teeth: pointing the domain at a deleted
+    # symbol flags the folded metric too
+    spec2 = MetricsSpec(
+        components_path=MET_PATH, registries=("SERVED",),
+        label_domains={
+            "lane": LabelDomain(kind="enum", values=("a", "b")),
+            "user": LabelDomain(kind="folded", fold_symbol="GONE"),
+            "pod_name": LabelDomain(kind="enum", values=("x",)),
+        },
+    )
+    flagged = MetricsHygieneRule(spec=spec2).check_program(
+        Program([module])
+    )
+    assert any(
+        v.symbol == "fx_folded_total" and "GONE" in v.message
+        for v in flagged
+    )
+
+
+# -- 2. the real repo: clean AND the enumeration is non-vacuous --------------
+
+def test_repo_wide_clean_with_v3_rules(repo_program):
+    violations, _, stats = run_checks_timed(
+        repo_program.modules, default_rules(),
+        load_allowlist(REPO / "graftcheck.toml"),
+    )
+    assert violations == [], "\n".join(v.format() for v in violations)
+    assert set(stats) >= {
+        "bucket-flow", "signature-space", "warm-coverage",
+        "metrics-hygiene",
+    }
+    assert all(s["violations"] == 0 for s in stats.values())
+
+
+def test_repo_enumeration_nonvacuous(repo_program):
+    rule = SignatureSpaceRule(specs=BINDING_SPECS)
+    assert rule.check_program(repo_program) == []
+    space = rule.last_space
+    # the live hot path really is inside the enumeration: the default
+    # pod bucket floor and the first few buckets of every family
+    solve = space["solve_batch"]
+    pods = next(a for a in solve["axes"] if a["axis"] == "pods")
+    assert {64, 80, 96, 256} <= set(pods["values"])
+    assert solve["adopted"] is True
+    scatter = space["scatter_node_rows_copied"]
+    dirty = scatter["axes"][0]
+    assert {8, 16, 32} <= set(dirty["values"])
+    # every adopted binding enumerates finite and nonzero
+    for name, entry in space.items():
+        if entry["adopted"]:
+            assert entry["signature_space_bound"] > 0, name
+            assert entry["axes"], name
+
+
+def test_axis_images_come_from_live_functions():
+    """The enumeration evaluates the REAL bucket functions — the image
+    of pow2_quarter_bucket must match a direct evaluation, not a
+    hand-copied table."""
+    from koordinator_tpu.parallel.mesh import pow2_quarter_bucket
+
+    spec = AxisSpec(
+        axis="pods",
+        bucket="koordinator_tpu.parallel.mesh:pow2_quarter_bucket",
+        kwargs_options=((("floor", 64),),), bound=1000,
+        bound_source="test",
+    )
+    image = enumerate_axis(spec)
+    assert set(image) == {
+        pow2_quarter_bucket(n, floor=64) for n in range(1001)
+    }
+
+
+# -- 3. injected violations in REAL source fail loudly -----------------------
+
+def _reparse(path: str, source: str) -> ModuleFile:
+    return ModuleFile(path=path, tree=ast.parse(source, filename=path),
+                      source=source)
+
+
+def _run_with_replacement(path: str, source: str):
+    mods = {m.path: m for m in iter_repo_modules(REPO)}
+    mods[path] = _reparse(path, source)
+    return run_checks(
+        list(mods.values()), default_rules(),
+        load_allowlist(REPO / "graftcheck.toml"),
+    )
+
+
+_BUCKET_ANCHOR = "        target = self.pod_bucket(n_real)"
+
+
+def test_injected_stripped_bucket_call_fails():
+    """The pre-PR 8 storm shape itself: _pad_pods padding to the RAW
+    pod count instead of its bucket — one compiled program per queue
+    length, now machine-rejected."""
+    path = "koordinator_tpu/models/placement.py"
+    source = (REPO / path).read_text()
+    assert _BUCKET_ANCHOR in source, (
+        "bucket anchor drifted — update the teeth"
+    )
+    injected = source.replace(_BUCKET_ANCHOR, "        target = n_real")
+    violations, _ = _run_with_replacement(path, injected)
+    hits = [v for v in violations if v.rule == "bucket-flow"]
+    assert any(
+        v.func.startswith("PlacementModel._pad_pods")
+        and "raw-dynamic" in v.message for v in hits
+    ), [v.format() for v in violations]
+
+
+_ADOPT_ANCHOR = (
+    "        WARM_POOL.adopt(self._solve, solve_batch, config_argpos=3)"
+)
+
+
+def test_injected_unadopted_solve_batch_fails():
+    """Un-adopt the flagship binding: every recovery path would
+    re-trace + recompile it — warm-coverage must fail loudly."""
+    path = "koordinator_tpu/models/placement.py"
+    source = (REPO / path).read_text()
+    assert _ADOPT_ANCHOR in source, (
+        "adopt anchor drifted — update the teeth"
+    )
+    injected = source.replace(_ADOPT_ANCHOR, "        pass")
+    violations, _ = _run_with_replacement(path, injected)
+    hits = [v for v in violations if v.rule == "warm-coverage"]
+    assert any(
+        v.symbol == "solve_batch"
+        and "cold-on-every-recovery" in v.message for v in hits
+    ), [v.format() for v in violations]
+
+
+def test_injected_renamed_binding_fails():
+    """A binding the registry doesn't know is an unknown recompile
+    surface (and its old declaration goes stale) — both directions of
+    the census cross-check must fire."""
+    path = "koordinator_tpu/models/placement.py"
+    source = (REPO / path).read_text()
+    assert '"solve_batch", jax.jit(' in source
+    injected = source.replace(
+        '"solve_batch", jax.jit(', '"solve_batch_rogue", jax.jit(', 1
+    )
+    violations, _ = _run_with_replacement(path, injected)
+    sig = [v for v in violations if v.rule == "signature-space"]
+    assert any(
+        v.symbol == "solve_batch_rogue" and "no BindingSpec" in v.message
+        for v in sig
+    ), [v.format() for v in sig]
+    assert any(
+        v.symbol == "solve_batch" and "stale" in v.message for v in sig
+    ), [v.format() for v in sig]
+
+
+# -- 4. the runtime sentinel -------------------------------------------------
+
+def _sig(*shapes):
+    """A synthetic observed signature: (treedef-ish, leaves)."""
+    return ("tree", tuple((s, "int32") for s in shapes))
+
+
+def test_sentinel_convicts_out_of_enumeration():
+    from koordinator_tpu.testing.shapeflow import ShapeFlowSentinel
+
+    s = ShapeFlowSentinel(allowed={"b": {8, 16, 32}})
+    s.check_entries([
+        ("b", _sig((100, 4), (8,))),
+        ("b", _sig((100, 4), (10,))),   # axis varies: 10 not in image
+    ])
+    report = s.report()
+    kinds = [(v["kind"], v.get("value")) for v in report["violations"]]
+    assert ("out-of-enumeration", 10) in kinds, report
+    # the constant (100, 4) leaf is structural: never convicted
+    assert not any(v.get("value") in (100, 4)
+                   for v in report["violations"])
+
+
+def test_sentinel_unknown_binding_and_quiet_paths():
+    from koordinator_tpu.testing.shapeflow import ShapeFlowSentinel
+
+    s = ShapeFlowSentinel(allowed={"b": {8, 16}})
+    s.check_entries([
+        ("mystery", _sig((4,))),        # undeclared binding
+        ("b", _sig((100, 4), (8,))),
+        ("b", _sig((100, 4), (16,))),   # varies inside the image: ok
+    ])
+    report = s.report()
+    assert [v["kind"] for v in report["violations"]] == [
+        "unknown-binding"
+    ], report
+    assert report["dims_checked"] == 2
+    assert report["dims_covered"] >= 2
+
+
+def test_sentinel_axis_consistency():
+    """Union membership alone must not let one axis's values launder
+    another's (a config-capped raw lane range covers every small
+    integer): a varying position whose values straddle two different
+    axis images is flagged even though each value is enumerated."""
+    from koordinator_tpu.testing.shapeflow import ShapeFlowSentinel
+
+    images = (frozenset({1, 2, 3}), frozenset({64, 128}))
+    s = ShapeFlowSentinel(allowed={"b": {1, 2, 3, 64, 128}},
+                          axis_images={"b": images})
+    s.check_entries([
+        ("b", _sig((2,))),
+        ("b", _sig((64,))),   # varies ACROSS two different axis images
+    ])
+    kinds = [v["kind"] for v in s.report()["violations"]]
+    assert kinds == ["axis-inconsistent"], s.report()
+
+    ok = ShapeFlowSentinel(allowed={"b": {1, 2, 3, 64, 128}},
+                           axis_images={"b": images})
+    ok.check_entries([("b", _sig((64,))), ("b", _sig((128,)))])
+    assert ok.report()["violations"] == [], ok.report()
+
+
+def test_sentinel_static_build_is_memoized():
+    """Arming twice must reuse one program analysis (the build costs
+    seconds and both the chaos and streaming suites arm)."""
+    from koordinator_tpu.testing import shapeflow as sf
+
+    a = sf.ShapeFlowSentinel.from_static_analysis()
+    assert sf._STATIC_CACHE
+    b = sf.ShapeFlowSentinel.from_static_analysis()
+    assert a.allowed == b.allowed
+    assert a.axis_images == b.axis_images
+    # instances never share mutable state through the cache
+    a.allowed["solve_batch"].add(-1)
+    assert -1 not in b.allowed["solve_batch"]
+
+
+def test_sentinel_refuses_broken_registry(monkeypatch):
+    """from_static_analysis must not arm from a registry the static
+    pass rejects — a sentinel with a silently-empty enumeration would
+    pass every suite vacuously."""
+    import koordinator_tpu.analysis.graftcheck.rules as rules_mod
+    from koordinator_tpu.testing.shapeflow import ShapeFlowSentinel
+
+    ghost = rules_mod.BINDING_SPECS + (BindingSpec(
+        name="fx_never_exists", path="nowhere.py", axes=()),)
+    monkeypatch.setattr(rules_mod, "BINDING_SPECS", ghost)
+    with pytest.raises(AssertionError, match="refuses to arm"):
+        ShapeFlowSentinel.from_static_analysis()
+
+
+def test_sentinel_end_to_end_nonvacuous():
+    """The acceptance property, driven directly: a live model solving
+    two bucketed batch sizes stays inside the enumeration (with the
+    membership check EXERCISED on a varying axis), and the same model
+    solving raw unbucketed axes is convicted."""
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.testing import example_problem
+    from koordinator_tpu.testing.shapeflow import ShapeFlowSentinel
+
+    sentinel = ShapeFlowSentinel.from_static_analysis()
+    assert sentinel.report()["enumerated_values"] > 0
+
+    model = PlacementModel(SolverConfig())
+    state1, pods1, _ = example_problem(20, 10, seed=0)
+    state2, pods2, _ = example_problem(20, 200, seed=1)
+
+    sentinel.begin_window()
+    b1, _, _ = model._pad_pods(pods1, None, None, 10)    # bucket 64
+    model.solve(state1, b1)
+    b2, _, _ = model._pad_pods(pods2, None, None, 200)   # bucket 256
+    model.solve(state2, b2)
+    sentinel.verify_window()
+    report = sentinel.report()
+    assert report["violations"] == [], report
+    assert report["observed_compiles"] >= 2
+    # non-vacuity: the varying pod axis was CHECKED and covered
+    assert report["dims_checked"] > 0
+    assert report["dims_covered"] > 0
+
+    # the negative arm: raw, unbucketed axes through the same binding
+    rogue = ShapeFlowSentinel.from_static_analysis()
+    rogue.begin_window()
+    model.solve(state1, pods1)    # raw 10
+    model.solve(state2, pods2)    # raw 200
+    rogue.verify_window()
+    bad = rogue.report()["violations"]
+    assert any(
+        v["kind"] == "out-of-enumeration" and v["fn"] == "solve_batch"
+        for v in bad
+    ), bad
+
+
+# -- 5. CLI: sidecar + incremental full-graph --------------------------------
+
+def test_cli_json_sidecar_and_changed_files(capsys):
+    from koordinator_tpu.analysis.graftcheck.__main__ import main
+
+    rc = main([
+        "--changed-files=koordinator_tpu/ops/binpack.py",
+        "--format=json",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["violation_count"] == 0
+    # the new whole-program passes ran full-graph despite the narrowed
+    # local set (same contract as sync-reach)
+    for name in ("bucket-flow", "signature-space", "warm-coverage",
+                 "metrics-hygiene"):
+        assert name in payload["rules"], name
+        assert payload["rules"][name]["violations"] == 0
+    space = payload["signature_space"]
+    assert space["solve_batch"]["adopted"] is True
+    assert space["solve_batch"]["signature_space_bound"] > 0
+    assert all(a["values"] for a in space["solve_batch"]["axes"])

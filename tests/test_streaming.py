@@ -49,6 +49,20 @@ from koordinator_tpu.testing.arrivals import (
 
 CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
 
+
+@pytest.fixture(autouse=True)
+def _shape_flow_under_streaming(shape_flow_sentinel):
+    """Every streaming scenario runs inside a shape-flow sentinel
+    window (ISSUE 15): the continuous-arrival path's drifting batch
+    sizes are exactly the load shape that recompile storms feed on, so
+    every signature the compile ring observes here must sit inside the
+    statically-enumerated bucket images (module teardown asserts zero
+    violations and non-vacuity)."""
+    shape_flow_sentinel.begin_window()
+    yield
+    shape_flow_sentinel.verify_window()
+
+
 N_NODES = 8
 
 

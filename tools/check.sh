@@ -9,9 +9,11 @@ cd "$(dirname "$0")/.."
 echo "== graftcheck =="
 # incremental by default: local rules scan the git-diff-scoped file set
 # while the whole-program passes (sync-reach, lock-order,
-# donation-safety) always load the full call graph; a clean tree falls
-# back to the full scan automatically. GRAFTCHECK_FULL=1 forces a full
-# local scan too (CI / release gates).
+# donation-safety, and the v3 shape-flow trio + metrics-hygiene —
+# census/enumeration passes are only sound over the full graph) always
+# load the full call graph; a clean tree falls back to the full scan
+# automatically. GRAFTCHECK_FULL=1 forces a full local scan too
+# (CI / release gates).
 if [ "${GRAFTCHECK_FULL:-0}" = "1" ]; then
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m koordinator_tpu.analysis.graftcheck "$@"
 else
@@ -22,7 +24,9 @@ echo "== chaos smoke =="
 # a fast seeded fault-injection pass through the failure-domain layer
 # (torn/corrupt/stalled frames + forced base loss): quick signal that
 # the wire boundary still survives hostile transport before paying for
-# the full suite
+# the full suite. Sentinel-armed (ISSUE 15): every chaos test runs in
+# a shape-flow sentinel window, so a compile whose signature falls
+# outside the static enumeration fails here, not in a production tail.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
     -q -m chaos -k smoke -p no:cacheprovider
 
@@ -75,7 +79,12 @@ echo "== streaming smoke =="
 # trigger's fake-clock determinism (deadline-fires-first vs
 # watermark-fires-first), and a short REAL pipelined streaming run
 # that binds every submitted pod bit-identically to the fixed-round
-# replay of its recorded arrival batches
+# replay of its recorded arrival batches. Sentinel-armed (ISSUE 15):
+# the drifting batch sizes of the arrival path are exactly the load
+# shape recompile storms feed on, so every signature the compile ring
+# observes here must sit inside the statically-enumerated bucket
+# images (module teardown asserts zero violations; non-vacuity is
+# additionally asserted on the unfiltered tier-1 run of these suites).
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_streaming.py \
     -q -k "smoke or fires_first" -p no:cacheprovider
 
