@@ -92,8 +92,11 @@ KTPU_BENCH_PALLAS=0 to disable the pallas kernel legs (scan only),
 KTPU_BENCH_ORACLE=0 to skip the full-shape oracle identity legs,
 KTPU_BENCH_CHURN_NODES / _CHURN_DIRTY / _CHURN_TICKS to reshape the
 churn-tick leg, KTPU_BENCH_SHARD_NODES / _SHARD_COUNT / _SHARD_DIRTY /
-_SHARD_PENDING for the sharded churn leg, and KTPU_BENCH_LANE_NODES /
-_LANE_PODS / _LANE_COUNT for the shard scaling curve.
+_SHARD_PENDING for the sharded churn leg, KTPU_BENCH_LANE_NODES /
+_LANE_PODS / _LANE_COUNT for the shard scaling curve, and
+KTPU_BENCH_STORM=0 to skip the preemption-storm leg (#19) —
+KTPU_BENCH_STORM_NODES / _RPN / _ARRIVALS / _ORACLE_PODS /
+_PLACE / _DRAIN_S reshape it (see bench_preemption_storm).
 """
 
 import json
@@ -3116,6 +3119,236 @@ def _tenant_storm(PlacementService, PlacementClient, AdmissionConfig,
     }
 
 
+def bench_preemption_storm(repeats):
+    """Config #19 (ISSUE 16): the preemption storm — every node packed
+    tight with low-priority preemptible BE residents
+    (``testing/chaos.preemption_storm``, same seed → same storm), then
+    a wave of high-priority LS arrivals sized so plain fit fails: each
+    can place ONLY by evicting a minimal victim set. Three facets:
+
+    - **victim-selection throughput, device vs host**: the same
+      evict-as-you-go sweep both ways over the first
+      KTPU_BENCH_STORM_ORACLE_PODS arrivals. The host arm is the
+      legacy backend's real per-pod cost — the scalar oracle walk
+      (scheduler/preemption.find_preemption) plus a FULL cluster
+      re-lower after every hit; the device arm is the production path
+      (docs/DESIGN.md §24) — one vectorized joint place+evict dispatch
+      per preemptor plus a one-row eviction delta
+      (state/cluster.evict_resident_rows). Acceptance (budget-gated):
+      device >= 10x host. The one-dispatch storm variant
+      (``preempt_solve_scan``) rides beside it as scan_pods_per_sec —
+      the whole wave's victim sets in a single dispatch.
+    - **bit-parity + churn minimality**: the device sweep's per-pod
+      (node, ordered victims) answers must equal the oracle's exactly
+      (identical_to_oracle), so evictions-per-successful-placement
+      lands ON the oracle's minimum (churn_vs_oracle == 1.0) — the
+      descheduler gap closed without over-evicting.
+    - **time-to-placed under the storm**: all arrivals submitted
+      through the streaming intake (leg 18's adaptive trigger) at t0,
+      rounds fired until the storm drains; per-pod submit→bind p50/p99
+      from the PodTimelines ring. MAX_PREEMPTIONS_PER_ROUND bounds
+      evictions per round, so the tail IS the round-cap queue — the
+      storm's victims drain 32 preemptors at a time.
+
+    Env knobs: KTPU_BENCH_STORM_NODES / _RPN (residents per node) /
+    _ARRIVALS reshape the storm (defaults 1250 x 4 = 5k BE residents,
+    1k LS arrivals); _ORACLE_PODS sizes the host-sweep subset (the
+    full wave through the scalar walk would take minutes);
+    KTPU_BENCH_STORM_PLACE=0 skips the streaming placement arm;
+    _DRAIN_S bounds its drain wait."""
+    from koordinator_tpu.apis.extension import ResourceName
+    from koordinator_tpu.apis.types import PodSpec
+    from koordinator_tpu.client.bus import APIServer, Kind
+    from koordinator_tpu.client.wiring import wire_scheduler
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.ops.binpack import SolverConfig
+    from koordinator_tpu.scheduler import Scheduler
+    from koordinator_tpu.scheduler.preemption import find_preemption
+    from koordinator_tpu.scheduler.streaming import (
+        StreamingConfig,
+        StreamingLoop,
+    )
+    from koordinator_tpu.state.cluster import (
+        evict_resident_rows,
+        lower_nodes,
+    )
+    from koordinator_tpu.testing.chaos import preemption_storm
+
+    n_nodes = int(os.environ.get("KTPU_BENCH_STORM_NODES", 1250))
+    rpn = int(os.environ.get("KTPU_BENCH_STORM_RPN", 4))
+    n_arrivals = int(os.environ.get("KTPU_BENCH_STORM_ARRIVALS", 1000))
+    oracle_pods = int(os.environ.get("KTPU_BENCH_STORM_ORACLE_PODS", 24))
+    nodes, residents, arrivals = preemption_storm(
+        seed=11, n_nodes=n_nodes, residents_per_node=rpn,
+        n_arrivals=n_arrivals,
+    )
+
+    def standalone():
+        sched = Scheduler(model=PlacementModel(
+            config=SolverConfig(unroll=BENCH_UNROLL)))
+        for node in nodes:
+            sched.add_node(node)
+        for pod in residents:
+            sched.add_pod(pod)
+        return sched
+
+    sched = standalone()
+    model = sched.model
+    thresholds = np.asarray(model.params.thresholds)
+    prod_thresholds = np.asarray(model.params.prod_thresholds)
+
+    def staged_world():
+        snapshot = sched.cache.snapshot(now=50.0)
+        arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+        resident = model.lower_residents(snapshot, arrays)
+        return snapshot, arrays, resident, model.resident_world(resident)
+
+    # scan throughput: the whole wave's victim selection in ONE
+    # dispatch (compile excluded; the world is never mutated, so the
+    # repeat runs time pure dispatch+compute)
+    _snap, arrays, resident, world = staged_world()
+    scanned = model.preempt_scan_device(
+        arrays, resident, arrivals, world=world)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        scanned = model.preempt_scan_device(
+            arrays, resident, arrivals, world=world)
+    scan_wall = (time.perf_counter() - t0) / repeats
+    scan_hits = sum(1 for s in scanned if s is not None)
+
+    # device sweep — the production per-pod path with one-row eviction
+    # deltas, measured over the oracle subset so the host comparison
+    # is apples-to-apples (same pods, same evict-as-you-go semantics)
+    sweep = arrivals[:oracle_pods]
+    snapshot, arrays, resident, world = staged_world()
+    model.select_victims_device(arrays, resident, sweep[0], world=world)
+    dev_hits = []
+    dev_evictions = 0
+    t0 = time.perf_counter()
+    for pod in sweep:
+        got = model.select_victims_device(
+            arrays, resident, pod, world=world)
+        if got is not None:
+            node_name, uids = got
+            dev_evictions += len(uids)
+            evict_resident_rows(
+                snapshot, arrays, resident, node_name, uids,
+                **model.lowering_kwargs(),
+            )
+        dev_hits.append(got)
+    device_wall = time.perf_counter() - t0
+
+    # host sweep — the legacy backend's cost shape verbatim: oracle
+    # walk, then a full cluster re-lower so later preemptors see the
+    # eviction
+    h_snapshot = sched.cache.snapshot(now=50.0)
+    h_arrays = lower_nodes(h_snapshot, **model.lowering_kwargs())
+    host_hits = []
+    host_evictions = 0
+    t0 = time.perf_counter()
+    for pod in sweep:
+        got = find_preemption(
+            h_snapshot, pod, arrays=h_arrays,
+            thresholds=thresholds, prod_thresholds=prod_thresholds,
+        )
+        if got is None:
+            host_hits.append(None)
+            continue
+        node_name, victims = got
+        host_hits.append((node_name, [v.uid for v in victims]))
+        host_evictions += len(victims)
+        wanted = {v.uid for v in victims}
+        h_snapshot.pods = [
+            p for p in h_snapshot.pods if p.uid not in wanted
+        ]
+        h_arrays = lower_nodes(h_snapshot, **model.lowering_kwargs())
+    host_wall = time.perf_counter() - t0
+
+    placements = sum(1 for h in host_hits if h is not None)
+    out = {
+        "n_nodes": n_nodes,
+        "n_residents": len(residents),
+        "n_arrivals": n_arrivals,
+        "oracle_pods": oracle_pods,
+        "scan_pods_per_sec": n_arrivals / scan_wall,
+        "scan_hits": scan_hits,
+        "device_pods_per_sec": len(sweep) / device_wall,
+        "host_pods_per_sec": len(sweep) / host_wall,
+        "device_vs_host_speedup": host_wall / device_wall,
+        "identical_to_oracle": bool(dev_hits == host_hits),
+        "placements": placements,
+        "evictions_device": dev_evictions,
+        "evictions_oracle": host_evictions,
+        "churn_vs_oracle": (
+            dev_evictions / host_evictions if host_evictions else 1.0
+        ),
+    }
+
+    if os.environ.get("KTPU_BENCH_STORM_PLACE", "1") != "0":
+        from koordinator_tpu.metrics.components import PREEMPT_VICTIMS
+        from koordinator_tpu.parallel.mesh import pow2_quarter_bucket
+
+        CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+        bus = APIServer()
+        sched_p = Scheduler(model=PlacementModel(
+            config=SolverConfig(unroll=BENCH_UNROLL)))
+        wire_scheduler(bus, sched_p)
+        for node in nodes:
+            bus.apply(Kind.NODE, node.name, node)
+        for pod in residents:
+            bus.apply(Kind.POD, pod.uid, pod)
+        # leg 18's warm discipline: compile-warm every pending-bucket
+        # variant the draining wave can shrink through, or the
+        # latency tail measures the compiler (the pods never place —
+        # the world is packed — so deleting them restores it exactly)
+        buckets = sorted({1} | {
+            pow2_quarter_bucket(s, floor=8)
+            for s in range(1, n_arrivals + 1)
+        })
+        for b, size in enumerate(buckets):
+            uids = []
+            for j in range(size):
+                pod = PodSpec(name=f"stormwarm{b}x{j}",
+                              requests={CPU: 1, MEM: 1})
+                bus.apply(Kind.POD, pod.uid, pod)
+                uids.append(pod.uid)
+            sched_p.schedule_pending(now=60.0)
+            for uid in uids:
+                bus.delete(Kind.POD, uid)
+        sched_p.timelines.reset()
+        evicted0 = PREEMPT_VICTIMS.value({"outcome": "evicted"})
+        loop = StreamingLoop(
+            sched_p,
+            apply_fn=lambda pod: bus.apply(Kind.POD, pod.uid, pod),
+            delete_fn=lambda uid: bus.delete(Kind.POD, uid),
+            config=StreamingConfig(watermark=64),
+            pipelined=True, log=lambda *a: None,
+        )
+        t0 = time.perf_counter()
+        try:
+            for pod in arrivals:
+                loop.submit(pod)
+            drained = loop.drain(timeout_s=float(
+                os.environ.get("KTPU_BENCH_STORM_DRAIN_S", 600)))
+        finally:
+            loop.stop()
+        storm_wall = time.perf_counter() - t0
+        lat = sched_p.timelines.stats()
+        st = loop.status()
+        out.update({
+            "storm_drained": bool(drained),
+            "storm_wall_s": storm_wall,
+            "storm_rounds": st["rounds"],
+            "storm_bound": st["gate"]["bound"],
+            "storm_evictions": (
+                PREEMPT_VICTIMS.value({"outcome": "evicted"}) - evicted0
+            ),
+            "time_to_placed_p50_s": lat["all"]["p50_s"],
+            "time_to_placed_p99_s": lat["all"]["p99_s"],
+        })
+    return out
+
+
 #: legs that need a REAL multi-device mesh — the parent bench process
 #: may hold a single-device backend (or a TPU tunnel), so these run in
 #: a fresh interpreter with the virtual-CPU 8-device forcing and hand
@@ -3875,6 +4108,13 @@ def main():
         # (KTPU_BENCH_MATRIX=0) still measure the serving face
         matrix["18_streaming_arrival"] = leg(
             bench_streaming_arrival, repeats
+        )
+    if os.environ.get("KTPU_BENCH_STORM", "1") != "0":
+        # the preemption-storm leg (ISSUE 16): device joint
+        # place+evict vs the host oracle sweep, bit-parity and churn
+        # minimality included — its own toggle like the streaming leg
+        matrix["19_preemption_storm"] = leg(
+            bench_preemption_storm, repeats
         )
     if os.environ.get("KTPU_BENCH_WARMPROBE", "1") != "0":
         matrix["warm_start"] = leg(bench_warm_start)

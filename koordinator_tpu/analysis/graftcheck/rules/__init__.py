@@ -378,6 +378,12 @@ BUCKET_FAMILY = (
     BucketFn(name="resv_bucket",
              path="koordinator_tpu/models/placement.py",
              qualname="PlacementModel.resv_bucket", exempt_body=True),
+    BucketFn(name="victim_bucket",
+             path="koordinator_tpu/models/placement.py",
+             qualname="PlacementModel.victim_bucket", exempt_body=True),
+    BucketFn(name="preemptor_bucket",
+             path="koordinator_tpu/models/placement.py",
+             qualname="PlacementModel.preemptor_bucket", exempt_body=True),
     BucketFn(name="dirty_row_bucket",
              path="koordinator_tpu/ops/binpack.py",
              qualname="dirty_row_bucket", exempt_body=True),
@@ -425,6 +431,10 @@ MAX_NODES = 131072
 MAX_PODS = 16384
 #: reservation-table cap (bench/test tables run <=256; pow2 headroom)
 MAX_RESV = 4096
+#: resident-pods-per-node cap for the victim axis: kubelet's max-pods
+#: default is 110; pow2 headroom for dense BE packing (bench leg 19
+#: runs ~2 residents/node at 5k nodes, chaos storms reach dozens)
+MAX_RESIDENTS = 512
 #: coalesced-lane cap: AdmissionConfig.capacity default — the gate can
 #: never dispatch more lanes than it can queue
 MAX_COALESCED_LANES = 128
@@ -478,6 +488,20 @@ _SHARD_NODE_AXIS = AxisSpec(
     bound_source="node-count cap (roadmap item 3)",
 )
 
+_VICTIM_AXIS = AxisSpec(
+    axis="victims",
+    bucket="koordinator_tpu.models.placement:PlacementModel.victim_bucket",
+    bound=MAX_RESIDENTS,
+    bound_source="kubelet max-pods default (110), pow2 headroom",
+)
+_PREEMPTOR_AXIS = AxisSpec(
+    axis="preemptors",
+    bucket="koordinator_tpu.models.placement:PlacementModel."
+           "preemptor_bucket",
+    bound=MAX_PODS,
+    bound_source="bench churn wave cap (storm leg 19 scans arrivals)",
+)
+
 _SOLVE_AXES = (_POD_AXIS, _RESV_AXIS)
 #: the batched solve's quasi-static axes: one value per deployment
 #: shape (structure epochs), not a per-tick surface — the sentinel
@@ -524,6 +548,22 @@ BINDING_SPECS = (
                 axes=(_TENANT_LANE_AXIS, _TENANT_NODE_AXIS,
                       _TENANT_POD_AXIS),
                 structural=("features",)),
+    BindingSpec(name="preempt_solve",
+                path="koordinator_tpu/models/placement.py",
+                axes=(_VICTIM_AXIS,), structural=_SOLVE_STRUCTURAL,
+                note="joint place+evict per-preemptor victim selection "
+                     "(ops/preempt.select_victims, DESIGN §24)"),
+    BindingSpec(name="preempt_solve_scan",
+                path="koordinator_tpu/models/placement.py",
+                axes=(_VICTIM_AXIS, _PREEMPTOR_AXIS),
+                structural=_SOLVE_STRUCTURAL,
+                note="scanned storm variant: whole preemptor batch in "
+                     "one program"),
+    BindingSpec(name="defrag_repack",
+                path="koordinator_tpu/models/placement.py",
+                axes=(_VICTIM_AXIS,), structural=_SOLVE_STRUCTURAL,
+                note="headroom repack: drain a fragmented node for a "
+                     "gang-sized hole"),
     BindingSpec(name="scatter_node_rows_donated",
                 path="koordinator_tpu/ops/binpack.py",
                 axes=(_DIRTY_AXIS,), structural=_SOLVE_STRUCTURAL),
@@ -606,6 +646,10 @@ LABEL_DOMAINS = {
     "buffer": LabelDomain(kind="enum", values=(
         "pod_batch", "resv_table", "dirty_rows", "coalesced_pods",
         "tenant_nodes", "tenant_pods", "tenant_lanes",
+        "resident_pods", "preemptor_batch",
+    )),
+    "outcome": LabelDomain(kind="enum", values=(
+        "selected", "reprieved", "evicted",
     )),
     "fn": LabelDomain(kind="binding"),
     "tenant": LabelDomain(kind="folded", fold_symbol="OVERFLOW_TENANT"),

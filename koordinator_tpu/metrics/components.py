@@ -32,6 +32,18 @@ PREEMPTION_ATTEMPTS = SCHEDULER_METRICS.counter(
     "scheduler_preemption_attempts_total",
     "PostFilter preemption attempts",
 )
+PREEMPT_VICTIMS = SCHEDULER_METRICS.counter(
+    "scheduler_preempt_victims_total",
+    "Joint place+evict victim flow per outcome: candidates the solve "
+    "chose (selected), candidates the reprieve loop spared (reprieved), "
+    "victims actually evicted (evicted)",
+    label_names=("outcome",),  # selected | reprieved | evicted
+)
+DEFRAG_DRAINS = SCHEDULER_METRICS.counter(
+    "scheduler_defrag_drains_total",
+    "Headroom-repack drains applied (pods evicted to restore a "
+    "gang-sized hole)",
+)
 GANG_REJECTIONS = SCHEDULER_METRICS.counter(
     "scheduler_gang_rejections_total",
     "Gang-group rejections (strict failures + WaitTime expiry)",

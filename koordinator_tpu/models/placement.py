@@ -20,8 +20,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from koordinator_tpu.apis.extension import NUM_RESOURCES
-from koordinator_tpu.apis.types import ClusterSnapshot, GangMode
+from koordinator_tpu.apis.extension import NUM_RESOURCES, PriorityClass
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    GangMode,
+    PodSpec,
+    resources_to_vector,
+)
 from koordinator_tpu.models.finegrained import FineGrained
 from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.obs.trace import TRACER
@@ -42,6 +47,13 @@ from koordinator_tpu.ops.binpack import (
     solve_batch,
 )
 from koordinator_tpu.ops.gang import GangState
+from koordinator_tpu.ops.preempt import (
+    PreemptorBatch,
+    ResidentWorld,
+    headroom_repack,
+    preempt_scan,
+    select_victims,
+)
 from koordinator_tpu.ops.quota import QuotaState
 from koordinator_tpu.state.cluster import (
     DEFAULT_ESTIMATED_SCALING_FACTORS,
@@ -50,9 +62,12 @@ from koordinator_tpu.state.cluster import (
     AggregatedArgs,
     NodeArrays,
     PendingPodArrays,
+    ResidentPodArrays,
+    _clip_i32,
     lower_nodes,
     lower_nodes_delta,
     lower_pending_pods,
+    lower_resident_pods,
 )
 
 
@@ -650,6 +665,26 @@ class PlacementModel:
         match or consume them."""
         return max(8, 1 << (v - 1).bit_length())
 
+    @staticmethod
+    def victim_bucket(p: int) -> int:
+        """Shape bucket for the resident-victim axis (next power of two,
+        floor 8): per-node resident counts drift by ones every tick, so
+        an unbucketed ``[N, P]`` world would retrace the preempt solve
+        per count. Padding columns are ``valid=False`` — never
+        candidates, never reprieved — so results are identical."""
+        return max(8, 1 << (p - 1).bit_length())
+
+    @staticmethod
+    def preemptor_bucket(k: int) -> int:
+        """Shape bucket for the scanned-preemptor axis (next power of
+        two, floor 4). The scheduler round path stays at
+        MAX_PREEMPTIONS_PER_ROUND (=32) preemptors; the storm bench
+        scans bigger batches, so the bucket itself is unbounded —
+        graftcheck bounds the axis image at MAX_PODS. Padding rows are
+        ``active=False``: the scan step carries the world through
+        unchanged."""
+        return max(4, 1 << (k - 1).bit_length())
+
     def __init__(
         self,
         config: SolverConfig = SolverConfig(),
@@ -741,6 +776,24 @@ class PlacementModel:
         from koordinator_tpu.service.warmpool import WARM_POOL
 
         WARM_POOL.adopt(self._solve, solve_batch, config_argpos=3)
+        #: joint place+evict variants (ops/preempt.py): per-preemptor
+        #: victim selection, the scanned storm solve, and the defrag
+        #: planner. Same binding discipline as solve_batch — static
+        #: config (position 0), never donate (warm-pool adoption
+        #: legality), DEVICE_OBS-wrapped so the runtime sentinel and
+        #: graftcheck's signature-space census see every signature.
+        self._preempt = DEVICE_OBS.jit("preempt_solve", jax.jit(
+            select_victims, static_argnames=("config",), donate_argnums=()
+        ))
+        WARM_POOL.adopt(self._preempt, select_victims, config_argpos=0)
+        self._preempt_scan = DEVICE_OBS.jit("preempt_solve_scan", jax.jit(
+            preempt_scan, static_argnames=("config",), donate_argnums=()
+        ))
+        WARM_POOL.adopt(self._preempt_scan, preempt_scan, config_argpos=0)
+        self._defrag = DEVICE_OBS.jit("defrag_repack", jax.jit(
+            headroom_repack, static_argnames=("config",), donate_argnums=()
+        ))
+        WARM_POOL.adopt(self._defrag, headroom_repack, config_argpos=0)
         #: device-resident staging reused across schedule() calls when
         #: the snapshot carries a ClusterDeltaTracker (steady-state
         #: ticks re-lower + re-upload only the dirty node rows)
@@ -788,6 +841,214 @@ class PlacementModel:
             "resource_weights": self.resource_weights,
             "aggregated": self.aggregated,
         }
+
+    # -- joint place+evict (ops/preempt.py, docs/DESIGN.md §24) -------------
+
+    def lower_residents(
+        self, snapshot: ClusterSnapshot, arrays: NodeArrays
+    ) -> ResidentPodArrays:
+        """Lower the assigned-pod world for victim selection, P axis
+        padded to :meth:`victim_bucket`."""
+        resident = lower_resident_pods(
+            snapshot, arrays, victim_bucket=self.victim_bucket
+        )
+        DEVICE_OBS.note_padding(
+            "resident_pods", resident.max_residents, resident.p
+        )
+        return resident
+
+    def resident_world(self, resident: ResidentPodArrays) -> ResidentWorld:
+        """Stage the resident world on device — once per preemption
+        round. Between evictions only ``valid`` shrinks; callers pass
+        the staged world back in and the wrappers refresh just that
+        mask from the host arrays."""
+        return ResidentWorld(
+            req=jnp.asarray(resident.req),
+            priority=jnp.asarray(resident.priority),
+            quota_id=jnp.asarray(resident.quota_id),
+            preemptible=jnp.asarray(resident.preemptible),
+            valid=jnp.asarray(resident.valid),
+        )
+
+    def _victim_uids(self, resident, node_index: int, mask) -> List[str]:
+        uids = resident.uids[node_index]
+        return [
+            uids[j]
+            for j in range(min(len(uids), mask.shape[0]))
+            if mask[j]
+        ]
+
+    def select_victims_device(
+        self,
+        arrays: NodeArrays,
+        resident: ResidentPodArrays,
+        pod: PodSpec,
+        quota_used=None,
+        used_limit=None,
+        world: Optional[ResidentWorld] = None,
+    ) -> Optional[Tuple[str, List[str]]]:
+        """One preemptor against the whole cluster in one dispatch.
+
+        Returns ``(node_name, victim uids in importance order)`` — the
+        oracle's ``find_preemption`` answer — or None. ``quota_used``/
+        ``used_limit`` arm the ElasticQuota reprieve gate (both None =
+        quota-unmanaged pod, gate off, like the oracle)."""
+        if world is None:
+            world = self.resident_world(resident)
+        else:
+            world = world._replace(valid=jnp.asarray(resident.valid))
+        quota_on = quota_used is not None and used_limit is not None
+        zeros = np.zeros(NUM_RESOURCES, dtype=np.int64)
+        best, victims, _cand, _nv = self._preempt(
+            self.config,
+            jnp.asarray(_clip_i32(resources_to_vector(pod.requests))),
+            jnp.int32(pod.priority),
+            jnp.int32(resident.quota_id_of(pod.quota)),
+            jnp.asarray(bool(pod.is_daemonset)),
+            jnp.asarray(pod.priority_class == PriorityClass.PROD),
+            jnp.asarray(_clip_i32(
+                zeros if quota_used is None else np.asarray(quota_used)
+            )),
+            jnp.asarray(_clip_i32(
+                zeros if used_limit is None else np.asarray(used_limit)
+            )),
+            jnp.asarray(quota_on),
+            jnp.asarray(arrays.alloc),
+            jnp.asarray(arrays.used_req),
+            jnp.asarray(arrays.usage),
+            jnp.asarray(arrays.prod_usage),
+            jnp.asarray(arrays.metric_fresh),
+            jnp.asarray(arrays.schedulable),
+            jnp.asarray(resident.node_rank),
+            self.params.thresholds,
+            self.params.prod_thresholds,
+            world,
+        )
+        b = int(best)
+        if b < 0:
+            return None
+        row = np.asarray(victims[b])
+        return arrays.names[b], self._victim_uids(resident, b, row)
+
+    def preempt_scan_device(
+        self,
+        arrays: NodeArrays,
+        resident: ResidentPodArrays,
+        pods: List[PodSpec],
+        quota_rows=None,
+        world: Optional[ResidentWorld] = None,
+    ) -> List[Optional[Tuple[str, List[str]]]]:
+        """The scanned storm variant: the whole preemptor batch in ONE
+        program, eviction deltas carried in-scan. ``quota_rows[k]`` is
+        ``(quota_used, used_limit)`` or None per pod; rows are the
+        round-start snapshot held constant — identical to the per-pod
+        path whenever quota groups don't overlap within the round
+        (docs/DESIGN.md §24)."""
+        k = len(pods)
+        if k == 0:
+            return []
+        kp = self.preemptor_bucket(k)
+        DEVICE_OBS.note_padding("preemptor_batch", k, kp)
+        req = np.zeros((kp, NUM_RESOURCES), dtype=np.int64)
+        prio = np.zeros(kp, dtype=np.int32)
+        quota = np.full(kp, -3, dtype=np.int32)
+        is_ds = np.zeros(kp, dtype=bool)
+        is_prod = np.zeros(kp, dtype=bool)
+        q_used = np.zeros((kp, NUM_RESOURCES), dtype=np.int64)
+        q_limit = np.zeros((kp, NUM_RESOURCES), dtype=np.int64)
+        q_en = np.zeros(kp, dtype=bool)
+        active = np.zeros(kp, dtype=bool)
+        for i, pod in enumerate(pods):
+            req[i] = resources_to_vector(pod.requests)
+            prio[i] = pod.priority
+            quota[i] = resident.quota_id_of(pod.quota)
+            is_ds[i] = pod.is_daemonset
+            is_prod[i] = pod.priority_class == PriorityClass.PROD
+            row = quota_rows[i] if quota_rows is not None else None
+            if row is not None:
+                q_used[i], q_limit[i] = np.asarray(row[0]), np.asarray(row[1])
+                q_en[i] = True
+            active[i] = True
+        batch = PreemptorBatch(
+            req=jnp.asarray(_clip_i32(req)),
+            priority=jnp.asarray(prio),
+            quota_id=jnp.asarray(quota),
+            is_daemonset=jnp.asarray(is_ds),
+            is_prod=jnp.asarray(is_prod),
+            quota_used=jnp.asarray(_clip_i32(q_used)),
+            used_limit=jnp.asarray(_clip_i32(q_limit)),
+            quota_enabled=jnp.asarray(q_en),
+            active=jnp.asarray(active),
+        )
+        if world is None:
+            world = self.resident_world(resident)
+        else:
+            world = world._replace(valid=jnp.asarray(resident.valid))
+        best_nodes, victim_cols = self._preempt_scan(
+            self.config,
+            batch,
+            jnp.asarray(arrays.alloc),
+            jnp.asarray(arrays.used_req),
+            jnp.asarray(arrays.usage),
+            jnp.asarray(arrays.prod_usage),
+            jnp.asarray(arrays.metric_fresh),
+            jnp.asarray(arrays.schedulable),
+            jnp.asarray(resident.node_rank),
+            self.params.thresholds,
+            self.params.prod_thresholds,
+            world,
+        )
+        best_nodes = np.asarray(best_nodes)
+        victim_cols = np.asarray(victim_cols)
+        out: List[Optional[Tuple[str, List[str]]]] = []
+        for i in range(k):
+            b = int(best_nodes[i])
+            if b < 0:
+                out.append(None)
+                continue
+            out.append((
+                arrays.names[b],
+                self._victim_uids(resident, b, victim_cols[i]),
+            ))
+        return out
+
+    def plan_defrag_device(
+        self,
+        arrays: NodeArrays,
+        resident: ResidentPodArrays,
+        target_req,
+        max_victim_priority: int,
+        world: Optional[ResidentWorld] = None,
+    ) -> Optional[Tuple[str, List[str]]]:
+        """Headroom repack: the cheapest node to drain until
+        ``target_req`` (a gang-sized hole) fits, draining preemptible
+        residents strictly below ``max_victim_priority``
+        least-important-first. Returns ``(node_name, drain uids in
+        eviction order)`` or None (None also when the hole already fits
+        somewhere — no drain needed)."""
+        if world is None:
+            world = self.resident_world(resident)
+        else:
+            world = world._replace(valid=jnp.asarray(resident.valid))
+        best, drain_mask, _nd, fits_now = self._defrag(
+            self.config,
+            jnp.asarray(_clip_i32(np.asarray(target_req))),
+            jnp.int32(max_victim_priority),
+            jnp.asarray(arrays.alloc),
+            jnp.asarray(arrays.used_req),
+            jnp.asarray(arrays.schedulable),
+            jnp.asarray(resident.node_rank),
+            world,
+        )
+        if bool(np.asarray(fits_now)[np.asarray(arrays.schedulable)].any()):
+            return None  # a hole already exists; nothing to drain
+        b = int(best)
+        if b < 0:
+            return None
+        row = np.asarray(drain_mask[b])
+        ordered = self._victim_uids(resident, b, row)
+        ordered.reverse()  # eviction order: least important first
+        return arrays.names[b], ordered
 
     # -- staging ------------------------------------------------------------
 

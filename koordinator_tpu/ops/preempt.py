@@ -1,0 +1,328 @@
+"""Joint place+evict: vectorized victim selection over the resident world.
+
+Device twin of the host preemption oracle (scheduler/preemption.py —
+``SelectVictimsOnNode``/``find_preemption``, transliterated from
+preempt.go:103-294). The host path walks every node in Python, sorting
+and reprieving per node at ~10 sweeps/s on a 5k-node world; here the
+same decision is three vectorized passes over a dense ``[N, P]``
+resident-pod world plus one ``lax.scan`` over the (bucketed) resident
+axis, so a whole-cluster victim selection is one XLA dispatch.
+
+Semantics reproduced bit-exactly (property-tested against the oracle in
+tests/test_quota_preemption.py):
+
+- **candidacy** (canPreempt, preempt.go:276-294): a resident is a
+  candidate iff it is preemptible, has STRICTLY lower priority than the
+  preemptor, and belongs to the same quota group;
+- **remove-all gate**: evict every candidate; if the preemptor still
+  fails fit (or the node fails the loadaware filter — usage does not
+  change on eviction, so eviction cannot help) the node is out;
+- **reprieve in importance order** (util.MoreImportantPod: priority
+  desc, then earlier assignment): candidates are re-added
+  most-important-first unless the preemptor would stop fitting. The
+  ``[N, P]`` world arrives PRE-SORTED per node in importance order
+  (state/cluster.lower_resident_pods), so the reprieve loop is a
+  ``lax.scan`` over the P axis, vectorized over all nodes at once, and
+  the surviving victim mask read in column order IS the oracle's
+  victim order;
+- **constant quota gate** (preempt.go:176-201): ``used + podReq >
+  usedLimit`` is checked against the PostFilter-snapshot used — an
+  over-runtime quota reprieves NOTHING;
+- **ranking** (pickOneNodeForPreemption spirit): fewest victims, then
+  lowest top victim priority, then the host's node iteration order
+  (shipped as ``node_rank``).
+
+The scan variant (:func:`preempt_scan`) runs the whole preemptor batch
+in one program with eviction deltas applied in-carry; the defrag
+variant (:func:`headroom_repack`) drains least-important-first to
+restore a gang-sized hole. All integer arithmetic is int32 end-to-end,
+matching the solver's bit-identity contract (ops/binpack.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from koordinator_tpu.ops.binpack import SolverConfig
+from koordinator_tpu.ops.fit import fit_filter
+from koordinator_tpu.ops.loadaware import loadaware_filter
+
+I32_MAX = jnp.int32(2**31 - 1)
+I32_MIN = jnp.int32(-(2**31))
+
+
+class ResidentWorld(NamedTuple):
+    """Dense per-node resident-pod state, pre-sorted per node in
+    importance order (priority desc, then earlier assignment — the
+    oracle's ``_more_important`` key). Padding columns are
+    ``valid=False`` and inert everywhere."""
+
+    req: jnp.ndarray          # [N,P,R] int32 victim requests
+    priority: jnp.ndarray     # [N,P] int32
+    quota_id: jnp.ndarray     # [N,P] int32, -1 = no quota group
+    preemptible: jnp.ndarray  # [N,P] bool
+    valid: jnp.ndarray        # [N,P] bool (False = padding or evicted)
+
+
+class PreemptorBatch(NamedTuple):
+    """Preemptor pods for the scanned joint solve (xs over K)."""
+
+    req: jnp.ndarray          # [K,R] int32
+    priority: jnp.ndarray     # [K] int32
+    quota_id: jnp.ndarray     # [K] int32, -1 = no quota group
+    is_daemonset: jnp.ndarray  # [K] bool
+    is_prod: jnp.ndarray      # [K] bool
+    quota_used: jnp.ndarray   # [K,R] int32 PostFilter-snapshot used
+    used_limit: jnp.ndarray   # [K,R] int32 runtime (usedLimit)
+    quota_enabled: jnp.ndarray  # [K] bool — quota gate armed for this pod
+    active: jnp.ndarray       # [K] bool — False = padding row, a no-op step
+
+
+def victim_candidacy(
+    world: ResidentWorld,
+    pod_priority: jnp.ndarray,   # [] int32
+    pod_quota: jnp.ndarray,      # [] int32
+) -> jnp.ndarray:
+    """canPreempt as a ``[N,P]`` mask (preempt.go:276-294)."""
+    return (
+        world.valid
+        & world.preemptible
+        & (world.priority < pod_priority)
+        & (world.quota_id == pod_quota)
+    )
+
+
+def _reprieve_scan(
+    pod_req, node_alloc, kept0, cand, res_req, quota_blocks, unroll
+):
+    """The reprieve loop over the importance-ordered P axis, vectorized
+    over nodes: carry is the per-node kept allocation; a candidate is
+    reprieved when the preemptor still fits with it re-added and the
+    quota gate does not block. Returns ``(kept [N,R],
+    reprieved [N,P])``."""
+
+    def step(kept, xs):
+        req_p, cand_p = xs                       # [N,R], [N]
+        trial = kept + req_p
+        ok = cand_p & fit_filter(pod_req, node_alloc, trial) & ~quota_blocks
+        kept = jnp.where(ok[:, None], trial, kept)
+        return kept, ok
+
+    xs = (jnp.swapaxes(res_req, 0, 1), jnp.swapaxes(cand, 0, 1))
+    kept, reprieved = lax.scan(step, kept0, xs, unroll=unroll)
+    return kept, jnp.swapaxes(reprieved, 0, 1)
+
+
+def _select_core(
+    config: SolverConfig,
+    pod_req, pod_priority, pod_quota, pod_is_ds, pod_is_prod,
+    quota_used, used_limit, quota_enabled,
+    alloc, used_req, usage, prod_usage, metric_fresh, schedulable,
+    node_rank, thresholds, prod_thresholds,
+    world: ResidentWorld,
+):
+    """One preemptor against the whole world. Shared verbatim by the
+    per-pod entry and the scanned joint solve so the two can never
+    disagree on a step's outcome."""
+    cand = victim_candidacy(world, pod_priority, pod_quota)
+    has_cand = jnp.any(cand, axis=1)                       # [N]
+    removed = jnp.sum(
+        jnp.where(cand[..., None], world.req, 0), axis=1
+    )                                                      # [N,R]
+    la_ok = loadaware_filter(
+        alloc, usage, prod_usage, metric_fresh,
+        thresholds, prod_thresholds, pod_is_ds, pod_is_prod,
+    )
+    kept0 = used_req - removed
+    fit_all = fit_filter(pod_req, alloc, kept0)
+    # quota gate: CONSTANT across the reprieve loop (preempt.go:191-199)
+    quota_blocks = quota_enabled & jnp.any(
+        (pod_req > 0) & (quota_used + pod_req > used_limit)
+    )
+    node_ok = schedulable & has_cand & la_ok & fit_all
+    _, reprieved = _reprieve_scan(
+        pod_req, alloc, kept0, cand, world.req, quota_blocks,
+        config.unroll,
+    )
+    victims = cand & ~reprieved
+    n_victims = jnp.sum(victims, axis=1).astype(jnp.int32)
+    feasible = node_ok & (n_victims > 0)
+    top_prio = jnp.max(
+        jnp.where(victims, world.priority, I32_MIN), axis=1
+    )
+    # rank lexicographically — fewest victims, lowest top priority,
+    # host iteration order — via staged int32 argmin (no int64: the
+    # solver substrate is x32)
+    nv_key = jnp.where(feasible, n_victims, I32_MAX)
+    best_nv = jnp.min(nv_key)
+    tie1 = feasible & (n_victims == best_nv)
+    tp_key = jnp.where(tie1, top_prio, I32_MAX)
+    best_tp = jnp.min(tp_key)
+    tie2 = tie1 & (top_prio == best_tp)
+    rank_key = jnp.where(tie2, node_rank, I32_MAX)
+    best = jnp.where(
+        jnp.any(feasible),
+        jnp.argmin(rank_key).astype(jnp.int32),
+        jnp.int32(-1),
+    )
+    return best, victims, cand, n_victims
+
+
+def select_victims(
+    config: SolverConfig,
+    pod_req: jnp.ndarray,        # [R] int32
+    pod_priority: jnp.ndarray,   # [] int32
+    pod_quota: jnp.ndarray,      # [] int32, -1 = none
+    pod_is_ds: jnp.ndarray,      # [] bool
+    pod_is_prod: jnp.ndarray,    # [] bool
+    quota_used: jnp.ndarray,     # [R] int32
+    used_limit: jnp.ndarray,     # [R] int32
+    quota_enabled: jnp.ndarray,  # [] bool
+    alloc: jnp.ndarray,          # [N,R] int32
+    used_req: jnp.ndarray,       # [N,R] int32
+    usage: jnp.ndarray,          # [N,R] int32
+    prod_usage: jnp.ndarray,     # [N,R] int32
+    metric_fresh: jnp.ndarray,   # [N] bool
+    schedulable: jnp.ndarray,    # [N] bool
+    node_rank: jnp.ndarray,      # [N] int32 host iteration order
+    thresholds: jnp.ndarray,     # [R] int32
+    prod_thresholds: jnp.ndarray,  # [R] int32
+    world: ResidentWorld,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Whole-cluster victim selection for ONE preemptor.
+
+    Returns ``(best_node [], victims [N,P], candidates [N,P],
+    n_victims [N])`` — ``best_node`` is -1 when no node is viable;
+    ``victims`` read along the (importance-sorted) P axis of the best
+    row is the oracle's ordered victim list."""
+    return _select_core(
+        config, pod_req, pod_priority, pod_quota, pod_is_ds, pod_is_prod,
+        quota_used, used_limit, quota_enabled,
+        alloc, used_req, usage, prod_usage, metric_fresh, schedulable,
+        node_rank, thresholds, prod_thresholds, world,
+    )
+
+
+def preempt_scan(
+    config: SolverConfig,
+    pods: PreemptorBatch,
+    alloc: jnp.ndarray,          # [N,R] int32
+    used_req0: jnp.ndarray,      # [N,R] int32
+    usage: jnp.ndarray,          # [N,R]
+    prod_usage: jnp.ndarray,     # [N,R]
+    metric_fresh: jnp.ndarray,   # [N]
+    schedulable: jnp.ndarray,    # [N]
+    node_rank: jnp.ndarray,      # [N] int32
+    thresholds: jnp.ndarray,     # [R]
+    prod_thresholds: jnp.ndarray,  # [R]
+    world: ResidentWorld,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The joint place+evict solve: every preemptor in ONE program.
+
+    A scan over the (bucketed) preemptor axis whose carry is the
+    eviction-adjusted world — each step runs :func:`_select_core` and,
+    on a hit, scatters the victims OUT of the carry (``used_req`` row
+    decremented, resident columns invalidated) exactly the way placed
+    rows scatter in on the solve path. Per-pod quota rows are the
+    PostFilter-snapshot values held constant for the round — identical
+    to the host loop whenever the preemptors' quota groups don't
+    overlap within a round (the per-pod dispatch path handles the
+    general case; docs/DESIGN.md §24).
+
+    Returns ``(best_node [K] int32 (-1 = none), victims [K,P] bool)``
+    where ``victims[k]`` is the chosen node's victim-column mask for
+    preemptor ``k``."""
+
+    def step(carry, xs):
+        used_req, valid = carry
+        (req, prio, quota, is_ds, is_prod,
+         q_used, q_limit, q_en, active) = xs
+        w = world._replace(valid=valid)
+        best, victims, _cand, _nv = _select_core(
+            config, req, prio, quota, is_ds, is_prod,
+            q_used, q_limit, q_en,
+            alloc, used_req, usage, prod_usage, metric_fresh,
+            schedulable, node_rank, thresholds, prod_thresholds, w,
+        )
+        hit = active & (best >= 0)
+        b = jnp.maximum(best, 0)
+        row_victims = victims[b] & hit                     # [P]
+        freed = jnp.sum(
+            jnp.where(row_victims[:, None], world.req[b], 0), axis=0
+        )                                                  # [R]
+        used_req = used_req.at[b].add(-freed)
+        valid = valid.at[b].set(valid[b] & ~row_victims)
+        return (used_req, valid), (jnp.where(hit, best, -1), row_victims)
+
+    xs = (
+        pods.req, pods.priority, pods.quota_id, pods.is_daemonset,
+        pods.is_prod, pods.quota_used, pods.used_limit,
+        pods.quota_enabled, pods.active,
+    )
+    (_, _), (best_nodes, victim_cols) = lax.scan(
+        step, (used_req0, world.valid), xs, unroll=1
+    )
+    return best_nodes, victim_cols
+
+
+def headroom_repack(
+    config: SolverConfig,
+    target_req: jnp.ndarray,       # [R] int32 the gang-sized hole to restore
+    max_victim_priority: jnp.ndarray,  # [] int32 drain only below this
+    alloc: jnp.ndarray,            # [N,R] int32
+    used_req: jnp.ndarray,         # [N,R] int32
+    schedulable: jnp.ndarray,      # [N] bool
+    node_rank: jnp.ndarray,        # [N] int32
+    world: ResidentWorld,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Defrag planner: cheapest node to DRAIN until ``target_req`` fits.
+
+    Drain candidacy is preemptible residents strictly below
+    ``max_victim_priority``; draining goes least-important-first (the
+    reverse of the importance-sorted P axis), so the plan evicts the
+    cheapest tail of each fragmented node. No scan — the cumulative
+    freed prefix is one ``cumsum`` and the minimal drain count per node
+    one masked ``min``.
+
+    Returns ``(best_node [] int32 (-1 = none), drain_mask [N,P],
+    n_drain [N] int32 (I32_MAX = cannot restore the hole),
+    fits_now [N] bool)``. Nodes where the hole already fits are not
+    drain targets (``fits_now`` reports them)."""
+    cand = (
+        world.valid & world.preemptible
+        & (world.priority < max_victim_priority)
+    )                                                      # [N,P]
+    fits_now = fit_filter(target_req, alloc, used_req)     # [N]
+    # reverse the importance axis: position j drains the j+1
+    # least-important slots (non-candidates contribute nothing)
+    cand_rev = cand[:, ::-1]
+    req_rev = jnp.where(cand_rev[..., None], world.req[:, ::-1, :], 0)
+    freed = jnp.cumsum(req_rev, axis=1)                    # [N,P,R]
+    ncand = jnp.cumsum(cand_rev.astype(jnp.int32), axis=1)  # [N,P]
+    remain = used_req[:, None, :] - freed                  # [N,P,R]
+    fits_j = jnp.all(
+        (target_req == 0)
+        | (remain + target_req <= alloc[:, None, :]),
+        axis=-1,
+    )                                                      # [N,P]
+    # only positions that actually drained a candidate count as plans
+    # (a non-candidate slot repeats the previous prefix)
+    plan = fits_j & cand_rev
+    n_drain = jnp.min(jnp.where(plan, ncand, I32_MAX), axis=1)
+    n_drain = jnp.where(fits_now, jnp.int32(0), n_drain)
+    feasible = schedulable & ~fits_now & (n_drain < I32_MAX)
+    nd_key = jnp.where(feasible, n_drain, I32_MAX)
+    best_nd = jnp.min(nd_key)
+    tie = feasible & (n_drain == best_nd)
+    rank_key = jnp.where(tie, node_rank, I32_MAX)
+    best = jnp.where(
+        jnp.any(feasible),
+        jnp.argmin(rank_key).astype(jnp.int32),
+        jnp.int32(-1),
+    )
+    drain_rev = cand_rev & (ncand <= n_drain[:, None])
+    drain_mask = drain_rev[:, ::-1]
+    return best, drain_mask, n_drain, fits_now

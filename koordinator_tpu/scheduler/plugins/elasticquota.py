@@ -102,6 +102,25 @@ class ElasticQuotaPlugin(Plugin):
 
     # PostFilter preemption (plugin.go:302, preempt.go) --------------------
 
+    def quota_rows(self, pod):
+        """``(quota_used, used_limit)`` for the pod's quota group, or
+        None for a quota-unmanaged pod — the PostFilter-snapshot rows
+        the preemption reprieve gate checks (preempt.go:176-201).
+        Shared by the host oracle path and the device joint solve so
+        both see identical quota state at dispatch time."""
+        if not pod.quota:
+            return None
+        mgr = self._mgr(pod.quota)
+        info = mgr.quotas.get(pod.quota)
+        if info is None:
+            return None
+        used_limit = (
+            mgr.refresh_runtime(pod.quota)
+            if self.enable_runtime_quota
+            else info.max
+        )
+        return info.used, used_limit
+
     def post_filter(self, state: CycleState, snapshot, pod):
         """Try preempting same-quota lower-priority pods; returns
         ``(node name, [victim PodSpec])`` or None."""
@@ -112,17 +131,8 @@ class ElasticQuotaPlugin(Plugin):
             find_preemption,
         )
 
-        quota_used = used_limit = None
-        if pod.quota:
-            mgr = self._mgr(pod.quota)
-            info = mgr.quotas.get(pod.quota)
-            if info is not None:
-                quota_used = info.used
-                used_limit = (
-                    mgr.refresh_runtime(pod.quota)
-                    if self.enable_runtime_quota
-                    else info.max
-                )
+        rows = self.quota_rows(pod)
+        quota_used, used_limit = rows if rows is not None else (None, None)
         from koordinator_tpu.scheduler.plugins.lowering import THRESHOLDS_KEY
 
         arrays = state.get(ARRAYS_STATE_KEY) if state is not None else None

@@ -166,3 +166,66 @@ def find_preemption(
         if best_key is None or key < best_key:
             best, best_key = (node_name, victims), key
     return best
+
+
+def plan_defrag(
+    snapshot: ClusterSnapshot,
+    target_req: np.ndarray,
+    max_victim_priority: int,
+    arrays=None,
+) -> Optional[Tuple[str, List[PodSpec]]]:
+    """Headroom repack oracle: the cheapest node to DRAIN until a
+    ``target_req``-sized hole (a gang member's shape) fits, or None.
+
+    Drain candidacy is preemptible residents strictly below
+    ``max_victim_priority``; draining goes least-important-first (the
+    reverse of the preemption reprieve order), so the plan evicts the
+    cheapest tail of each fragmented node. Nodes where the hole already
+    fits mean no drain is needed at all (returns None). Ranked by
+    fewest drained, then node iteration order — the scalar twin of
+    ``ops/preempt.headroom_repack``, property-tested bit-identical in
+    tests/test_rebalance_oracle.py."""
+    if arrays is None:
+        arrays = lower_nodes(snapshot)
+    for i in range(arrays.n):
+        if arrays.schedulable[i] and fit_filter_node(
+            target_req,
+            arrays.alloc[i].astype(np.int64),
+            arrays.used_req[i].astype(np.int64),
+        ):
+            return None  # a hole already exists somewhere
+    by_node: Dict[str, List[PodSpec]] = {}
+    for p in snapshot.pods:
+        if p.node_name is not None:
+            by_node.setdefault(p.node_name, []).append(p)
+    index = arrays.index()
+
+    best: Optional[Tuple[str, List[PodSpec]]] = None
+    best_key = None
+    for node_name, residents in by_node.items():
+        i = index.get(node_name)
+        if i is None or not arrays.schedulable[i]:
+            continue
+        cand = sorted(
+            (
+                p for p in residents
+                if p.preemptible and p.priority < max_victim_priority
+            ),
+            key=_more_important,
+        )
+        alloc = arrays.alloc[i].astype(np.int64)
+        kept = arrays.used_req[i].astype(np.int64)
+        drained: List[PodSpec] = []
+        fits = False
+        for v in reversed(cand):
+            kept = kept - resources_to_vector(v.requests)
+            drained.append(v)
+            if fit_filter_node(target_req, alloc, kept):
+                fits = True
+                break
+        if not fits:
+            continue
+        key = (len(drained),)
+        if best_key is None or key < best_key:
+            best, best_key = (node_name, drained), key
+    return best

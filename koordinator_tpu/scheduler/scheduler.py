@@ -101,6 +101,7 @@ class Scheduler:
         model: Optional[PlacementModel] = None,
         cluster_total=None,
         enable_preemption: bool = True,
+        preemption_backend: str = "device",
     ):
         self.cache = SchedulerCache()
         self.quota_registry = QuotaTreeRegistry(cluster_total=cluster_total or {})
@@ -128,6 +129,18 @@ class Scheduler:
         #: BatchedPlacement feature gate: False falls back to per-pod
         #: incremental cycles in schedule_pending
         self.batched_placement = True
+        #: which victim-selection path _preempt_unplaced dispatches
+        #: (docs/DESIGN.md §24): "device" (default) runs the vectorized
+        #: joint place+evict solve (ops/preempt.py) with incremental
+        #: eviction relowering; "host" keeps the scalar oracle walk
+        #: (scheduler/preemption.py) as the hot path; "verify" runs
+        #: BOTH and asserts bit-identical nominations — the parity
+        #: harness mode the property tests drive.
+        if preemption_backend not in ("device", "host", "verify"):
+            raise ValueError(
+                f"unknown preemption_backend {preemption_backend!r}"
+            )
+        self.preemption_backend = preemption_backend
         #: preemption eviction sink (set by client.wiring.wire_scheduler):
         #: deletes the victim from the bus so every wired component
         #: observes the eviction — the reference deletes victims via the
@@ -675,12 +688,25 @@ class Scheduler:
         assigned = [p for p in snapshot.pods if p.preemptible]
         if not assigned:
             return
-        from koordinator_tpu.metrics.components import PREEMPTION_ATTEMPTS
-        from koordinator_tpu.scheduler.preemption import ARRAYS_STATE_KEY
-        from koordinator_tpu.state.cluster import lower_nodes
+        from koordinator_tpu.metrics.components import (
+            PREEMPT_VICTIMS,
+            PREEMPTION_ATTEMPTS,
+        )
+        from koordinator_tpu.scheduler.preemption import (
+            ARRAYS_STATE_KEY,
+            can_preempt,
+        )
+        from koordinator_tpu.state.cluster import (
+            evict_resident_rows,
+            lower_nodes,
+        )
 
+        backend = self.preemption_backend
+        if backend != "host" and not self._quota_plugin.enable_preemption:
+            return  # same gate the host post_filter applies internally
         min_priority = min(p.priority for p in assigned)
         arrays = None
+        resident = world = None
         attempts = 0
         result.nominations = {}
         for uid in unplaced:
@@ -693,21 +719,74 @@ class Scheduler:
             PREEMPTION_ATTEMPTS.inc()
             if arrays is None:
                 arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
-            # seeded like a plugin-chain cycle: the preemption filter
-            # must run with the model's thresholds/aggregated profile
-            state = CycleState(self.framework.cycle_seed)
-            state[ARRAYS_STATE_KEY] = arrays
-            nomination = self._quota_plugin.post_filter(state, snapshot, pod)
-            if nomination is None:
+                if backend != "host":
+                    resident = self.model.lower_residents(snapshot, arrays)
+                    world = self.model.resident_world(resident)
+            if backend == "host":
+                # seeded like a plugin-chain cycle: the preemption filter
+                # must run with the model's thresholds/aggregated profile
+                state = CycleState(self.framework.cycle_seed)
+                state[ARRAYS_STATE_KEY] = arrays
+                nomination = self._quota_plugin.post_filter(
+                    state, snapshot, pod
+                )
+                if nomination is None:
+                    continue
+                node_name, victims = nomination
+                victim_uids = sorted(v.uid for v in victims)
+                self._evict_victims(victim_uids)
+                # later preemptors must see the eviction, not the stale
+                # view
+                wanted = set(victim_uids)
+                snapshot.pods = [
+                    p for p in snapshot.pods if p.uid not in wanted
+                ]
+                arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
+                result.nominations[uid] = node_name
                 continue
-            node_name, victims = nomination
-            victim_uids = {v.uid for v in victims}
-            self._evict_victims(sorted(victim_uids))
-            # later preemptors must see the eviction, not the stale view
-            snapshot.pods = [
-                p for p in snapshot.pods if p.uid not in victim_uids
-            ]
-            arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
+            # device joint place+evict (ops/preempt.py): one dispatch
+            # per preemptor against the staged resident world; the
+            # eviction delta re-lowers ONE node row in place instead of
+            # re-lowering the cluster (the host loop's dominant cost)
+            rows = self._quota_plugin.quota_rows(pod)
+            got = self.model.select_victims_device(
+                arrays, resident, pod,
+                quota_used=rows[0] if rows is not None else None,
+                used_limit=rows[1] if rows is not None else None,
+                world=world,
+            )
+            if backend == "verify":
+                state = CycleState(self.framework.cycle_seed)
+                state[ARRAYS_STATE_KEY] = arrays
+                want = self._quota_plugin.post_filter(
+                    state, snapshot, pod
+                )
+                want_pair = (
+                    None if want is None
+                    else (want[0], [v.uid for v in want[1]])
+                )
+                if got != want_pair:
+                    raise AssertionError(
+                        f"preemption parity violation for {pod.uid}: "
+                        f"device {got!r} != oracle {want_pair!r}"
+                    )
+            if got is None:
+                continue
+            node_name, ordered_uids = got
+            n_cand = sum(
+                1 for p in snapshot.pods
+                if p.node_name == node_name and can_preempt(pod, p)
+            )
+            PREEMPT_VICTIMS.inc({"outcome": "selected"}, len(ordered_uids))
+            PREEMPT_VICTIMS.inc(
+                {"outcome": "reprieved"}, n_cand - len(ordered_uids)
+            )
+            self._evict_victims(sorted(ordered_uids))
+            PREEMPT_VICTIMS.inc({"outcome": "evicted"}, len(ordered_uids))
+            evict_resident_rows(
+                snapshot, arrays, resident, node_name, ordered_uids,
+                **self.model.lowering_kwargs(),
+            )
             result.nominations[uid] = node_name
 
     def _evict_victims(self, uids: List[str]) -> None:
@@ -722,6 +801,63 @@ class Scheduler:
                 self.evict_pod_fn(victim)
             else:
                 self.remove_pod(victim)
+
+    def defrag_headroom(
+        self,
+        target_req,
+        max_victim_priority: int,
+        apply: bool = False,
+        now: Optional[float] = None,
+    ):
+        """Headroom repack (docs/DESIGN.md §24): find the cheapest node
+        to drain — preemptible residents strictly below
+        ``max_victim_priority``, least-important-first — until a
+        ``target_req``-sized hole (a gang member's shape) fits.
+
+        Returns ``(node_name, drain uids in eviction order)`` or None
+        (also None when the hole already fits somewhere). With
+        ``apply=True`` the drains are evicted through the same sink as
+        preemption victims. Backend follows ``preemption_backend``:
+        device plan (ops/preempt.headroom_repack), host oracle
+        (scheduler/preemption.plan_defrag), or both with a parity
+        assert under "verify"."""
+        from koordinator_tpu.metrics.components import DEFRAG_DRAINS
+        from koordinator_tpu.scheduler.preemption import plan_defrag
+        from koordinator_tpu.state.cluster import lower_nodes
+
+        target = np.asarray(target_req)
+        snapshot = self.cache.snapshot(now=now)
+        arrays = lower_nodes(snapshot, **self.model.lowering_kwargs())
+        if self.preemption_backend == "host":
+            plan = plan_defrag(
+                snapshot, target, max_victim_priority, arrays=arrays
+            )
+            got = (
+                None if plan is None
+                else (plan[0], [v.uid for v in plan[1]])
+            )
+        else:
+            resident = self.model.lower_residents(snapshot, arrays)
+            got = self.model.plan_defrag_device(
+                arrays, resident, target, max_victim_priority
+            )
+            if self.preemption_backend == "verify":
+                plan = plan_defrag(
+                    snapshot, target, max_victim_priority, arrays=arrays
+                )
+                want = (
+                    None if plan is None
+                    else (plan[0], [v.uid for v in plan[1]])
+                )
+                if got != want:
+                    raise AssertionError(
+                        f"defrag parity violation: device {got!r} != "
+                        f"oracle {want!r}"
+                    )
+        if got is not None and apply:
+            self._evict_victims(got[1])
+            DEFRAG_DRAINS.inc(amount=len(got[1]))
+        return got
 
     def forget_assumed_unbound(self) -> List[str]:
         """Release every assumed-but-unbound pod back to pending,

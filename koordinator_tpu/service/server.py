@@ -615,6 +615,24 @@ class _Handler(socketserver.BaseRequestHandler):
             stream.close()
 
 
+def _preemption_status() -> dict:
+    """Eviction-flow counters for :meth:`PlacementService.status`."""
+    from koordinator_tpu.metrics.components import (
+        DEFRAG_DRAINS,
+        PREEMPT_VICTIMS,
+        PREEMPTION_ATTEMPTS,
+    )
+
+    return {
+        "attempts": PREEMPTION_ATTEMPTS.value(),
+        "victims": {
+            outcome: PREEMPT_VICTIMS.value({"outcome": outcome})
+            for outcome in ("selected", "reprieved", "evicted")
+        },
+        "defrag_drains": DEFRAG_DRAINS.value(),
+    }
+
+
 class PlacementService:
     """The sidecar server (UDS by default; TCP for cross-host —
     trusted-network-only unless ``secret`` is set).
@@ -722,6 +740,10 @@ class PlacementService:
             # sidecar's restart skip its compiles, and is the store
             # clean (hit/miss/quarantine counters, last typed error)
             "warm_pool": WARM_POOL.status(),
+            # joint place+evict flow (DESIGN §24): victim selection /
+            # reprieve / eviction counts and defrag drains, read from
+            # the scheduler registry the control plane shares
+            "preemption": _preemption_status(),
         }
 
     def stop(self) -> None:

@@ -784,3 +784,158 @@ def lower_pending_pods(
         quota_id=quota_id,
         gang_id=gang_id,
     )
+
+
+# -- resident-pod world (the joint place+evict solve's victim side) ----------
+
+
+@dataclasses.dataclass
+class ResidentPodArrays:
+    """Dense ``[N, P]`` resident-pod world for the device victim
+    selection (ops/preempt.py), pre-sorted per node in the oracle's
+    importance order (priority desc, then earlier assignment —
+    scheduler/preemption._more_important), so a victim mask read along
+    the P axis IS the oracle's ordered victim list.
+
+    ``quota_ids`` maps quota-group names (``""`` = no quota) to the
+    int32 ids in ``quota_id``; a preemptor's own id resolves through
+    :meth:`quota_id_of` — an unseen group matches no resident, exactly
+    like the oracle's string comparison. ``node_rank`` is the host
+    oracle's node ITERATION order (first appearance of each
+    ``node_name`` in ``snapshot.pods`` — the ``by_node`` dict order
+    ``find_preemption`` walks), the final ranking tiebreak."""
+
+    uids: List[List[str]]      # [N][<=P] resident uids, importance order
+    req: np.ndarray            # [N,P,R] int32 requests
+    priority: np.ndarray       # [N,P] int32
+    quota_id: np.ndarray       # [N,P] int32
+    preemptible: np.ndarray    # [N,P] bool
+    valid: np.ndarray          # [N,P] bool (False = padding or evicted)
+    node_rank: np.ndarray      # [N] int32
+    quota_ids: Dict[str, int]  # quota name ("" = none) -> id
+    max_residents: int         # real P before bucket padding
+
+    @property
+    def n(self) -> int:
+        return self.req.shape[0]
+
+    @property
+    def p(self) -> int:
+        return self.req.shape[1]
+
+    def quota_id_of(self, quota: Optional[str]) -> int:
+        """The preemptor-side id for ``quota`` — ``-2`` (matching no
+        resident; padding is ``-3``) when no resident carries it."""
+        return self.quota_ids.get(quota or "", -2)
+
+    def columns_of(self, node_index: int, uids) -> List[int]:
+        """P-axis columns of ``uids`` on ``node_index`` (host map-back
+        for eviction application)."""
+        wanted = set(uids)
+        return [
+            j for j, uid in enumerate(self.uids[node_index])
+            if uid in wanted
+        ]
+
+
+def lower_resident_pods(
+    snapshot: ClusterSnapshot,
+    arrays: NodeArrays,
+    *,
+    victim_bucket=None,
+) -> ResidentPodArrays:
+    """Lower the assigned-pod world to :class:`ResidentPodArrays`.
+
+    ``victim_bucket`` (e.g. ``PlacementModel.victim_bucket``) pads the
+    P axis to a shape bucket so resident counts drifting by ones reuse
+    one compiled victim-selection program; padding columns are
+    ``valid=False`` and can never be candidates, so results are
+    identical (the solver padding contract, docs/DESIGN.md §23)."""
+    index = arrays.index()
+    by_node: Dict[int, List[PodSpec]] = {}
+    node_rank = np.full(arrays.n, np.iinfo(np.int32).max, dtype=np.int32)
+    rank = 0
+    for pod in snapshot.pods:
+        if pod.node_name is None:
+            continue
+        i = index.get(pod.node_name)
+        if i is None:
+            continue
+        if node_rank[i] == np.iinfo(np.int32).max:
+            node_rank[i] = rank
+            rank += 1
+        by_node.setdefault(i, []).append(pod)
+
+    quota_ids: Dict[str, int] = {}
+    for pods in by_node.values():
+        # stable sort on the oracle's importance key
+        pods.sort(key=lambda p: (-p.priority, p.assign_time))
+        for pod in pods:
+            quota_ids.setdefault(pod.quota or "", len(quota_ids))
+
+    max_residents = max((len(v) for v in by_node.values()), default=0)
+    p = victim_bucket(max_residents) if victim_bucket else max_residents
+    p = max(p, 1)  # a zero-width axis would degenerate the scan
+    n = arrays.n
+    req = np.zeros((n, p, NUM_RESOURCES), dtype=np.int64)
+    priority = np.zeros((n, p), dtype=np.int32)
+    quota_id = np.full((n, p), -3, dtype=np.int32)
+    preemptible = np.zeros((n, p), dtype=bool)
+    valid = np.zeros((n, p), dtype=bool)
+    uids: List[List[str]] = [[] for _ in range(n)]
+    for i, pods in by_node.items():
+        uids[i] = [pod.uid for pod in pods]
+        for j, pod in enumerate(pods):
+            req[i, j] = resources_to_vector(pod.requests)
+            priority[i, j] = pod.priority
+            quota_id[i, j] = quota_ids[pod.quota or ""]
+            preemptible[i, j] = pod.preemptible
+            valid[i, j] = True
+    return ResidentPodArrays(
+        uids=uids,
+        req=_clip_i32(req),
+        priority=priority,
+        quota_id=quota_id,
+        preemptible=preemptible,
+        valid=valid,
+        node_rank=node_rank,
+        quota_ids=quota_ids,
+        max_residents=max_residents,
+    )
+
+
+def evict_resident_rows(
+    snapshot: ClusterSnapshot,
+    arrays: NodeArrays,
+    resident: ResidentPodArrays,
+    node_name: str,
+    victim_uids,
+    **lowering_kwargs,
+) -> Optional[np.ndarray]:
+    """Apply an eviction delta: victims leave ``snapshot.pods``, the
+    touched node row re-lowers IN PLACE through the same per-row
+    helpers as the full lowering (:func:`lower_nodes_delta` — the
+    delta-parity contract), the resident columns invalidate, and the
+    snapshot's delta tracker is marked so the staged device world
+    scatters the row out exactly the way placed rows scatter in
+    (models/placement.StagedStateCache).
+
+    Returns the rewritten row indices (``None`` = structure drift, the
+    caller must full-relower). The in-place update is bit-identical to
+    re-lowering the filtered snapshot from scratch: request sums are
+    integer arithmetic and the metric row re-derives from the reduced
+    assigned set."""
+    wanted = set(victim_uids)
+    snapshot.pods = [pod for pod in snapshot.pods if pod.uid not in wanted]
+    index = arrays.index()
+    i = index.get(node_name)
+    if i is not None:
+        for j, uid in enumerate(resident.uids[i]):
+            if uid in wanted and j < resident.p:
+                resident.valid[i, j] = False
+    tracker = getattr(snapshot, "delta_tracker", None)
+    if tracker is not None:
+        tracker.mark_node(node_name)
+    return lower_nodes_delta(
+        snapshot, arrays, [node_name], **lowering_kwargs
+    )

@@ -523,3 +523,69 @@ class InProcessSidecar:
     @property
     def service(self):
         return self._service
+
+
+def preemption_storm(seed: int, n_nodes: int = 24,
+                     residents_per_node: int = 4,
+                     n_arrivals: int = 12,
+                     quota: Optional[str] = None):
+    """Seeded preemption-storm world: every node packed tight with
+    low-priority preemptible BE residents, then a wave of higher-priority
+    LS arrivals sized so plain fit fails — each can only place by
+    evicting. Drives the joint place+evict solve's compile signatures
+    (``preempt_solve`` / ``preempt_solve_scan`` / ``defrag_repack``)
+    under the chaos suite's runtime sentinel and the storm bench leg.
+    Same seed → same storm, forever.
+
+    Returns ``(nodes, residents, arrivals)`` as typed specs; residents
+    carry ``node_name`` (pre-assigned), arrivals are pending. With
+    ``quota`` set, every pod shares that quota group, arming the
+    ElasticQuota reprieve gate."""
+    from koordinator_tpu.apis.extension import (
+        PriorityClass,
+        QoSClass,
+        ResourceName,
+    )
+    from koordinator_tpu.apis.types import NodeSpec, PodSpec
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    rng = random.Random(seed)
+    nodes, residents, arrivals = [], [], []
+    for i in range(n_nodes):
+        nodes.append(NodeSpec(
+            name=f"storm-n{i}",
+            allocatable={CPU: 16000, MEM: 65536},
+        ))
+        for j in range(residents_per_node):
+            # residents fill the node: per-resident share with a little
+            # jitter, leaving no room for an arrival without eviction
+            residents.append(PodSpec(
+                name=f"storm-be-{i}-{j}",
+                node_name=f"storm-n{i}",
+                requests={
+                    CPU: 16000 // residents_per_node,
+                    MEM: rng.randrange(
+                        49152 // residents_per_node,
+                        65536 // residents_per_node + 1,
+                    ),
+                },
+                qos=QoSClass.BE,
+                priority=rng.randrange(100, 400),
+                quota=quota,
+                assign_time=float(rng.randrange(0, 1000)),
+            ))
+    for k in range(n_arrivals):
+        # an arrival needs more than any single resident frees — the
+        # minimal victim set is >1 pod, so reprieve ordering matters
+        arrivals.append(PodSpec(
+            name=f"storm-ls-{k}",
+            requests={
+                CPU: (16000 // residents_per_node) * 2,
+                MEM: (49152 // residents_per_node) * 2,
+            },
+            qos=QoSClass.LS,
+            priority_class=PriorityClass.PROD,
+            priority=rng.randrange(5000, 9000),
+            quota=quota,
+        ))
+    return nodes, residents, arrivals

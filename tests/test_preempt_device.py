@@ -1,0 +1,448 @@
+"""Differential: the device joint place+evict solve vs the host oracle.
+
+The oracle (scheduler/preemption.py) is the scalar transliteration of
+the reference's ``SelectVictimsOnNode``/``find_preemption``
+(preempt.go:103-294); ops/preempt.py re-derives the same decision as
+vectorized passes over the ``[N, P]`` resident world. These tests drive
+both over randomized clusters — priority/quota/preemptible diversity,
+stale metrics, unschedulable nodes, loadaware threshold boundaries,
+over-runtime quotas — and require the chosen node AND the ORDERED
+victim list to match exactly, per pod, through whole eviction rounds.
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.apis.extension import (
+    PriorityClass,
+    QoSClass,
+    ResourceName,
+)
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+    resources_to_vector,
+)
+from koordinator_tpu.models.placement import PlacementModel
+from koordinator_tpu.scheduler import Scheduler
+from koordinator_tpu.scheduler.preemption import (
+    find_preemption,
+    plan_defrag,
+)
+from koordinator_tpu.state.cluster import (
+    evict_resident_rows,
+    lower_nodes,
+    lower_resident_pods,
+)
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+
+QUOTAS = [None, "team-a", "team-b"]
+
+
+def storm_cluster(rng, n_nodes=12, n_residents=60, stale_frac=0.15,
+                  unsched_frac=0.1, metric_frac=0.8):
+    nodes, pods, metrics = [], [], {}
+    for i in range(n_nodes):
+        nodes.append(NodeSpec(
+            name=f"n{i}",
+            allocatable={CPU: int(rng.integers(8000, 32000)),
+                         MEM: int(rng.integers(16384, 65536))},
+            unschedulable=bool(rng.random() < unsched_frac),
+        ))
+    for j in range(n_residents):
+        node = nodes[int(rng.integers(n_nodes))]
+        pods.append(PodSpec(
+            name=f"p{j}",
+            node_name=node.name,
+            requests={CPU: int(rng.integers(500, 6000)),
+                      MEM: int(rng.integers(512, 8192))},
+            qos=QoSClass.BE,
+            priority=int(rng.integers(0, 6) * 500),
+            preemptible=bool(rng.random() < 0.8),
+            quota=QUOTAS[int(rng.integers(len(QUOTAS)))],
+            assign_time=float(rng.integers(0, 40)),
+        ))
+    for node in nodes:
+        if rng.random() < metric_frac:
+            cap = node.allocatable
+            metrics[node.name] = NodeMetric(
+                node_name=node.name,
+                node_usage={
+                    CPU: int(rng.integers(0, int(cap[CPU] * 1.05))),
+                    MEM: int(rng.integers(0, int(cap[MEM] * 1.05))),
+                },
+                update_time=(
+                    -1000.0 if rng.random() < stale_frac else 100.0
+                ),
+            )
+    return ClusterSnapshot(nodes=nodes, pods=pods, node_metrics=metrics,
+                           now=120.0)
+
+
+def preemptor(rng, k=0):
+    return PodSpec(
+        name=f"ls{k}",
+        requests={CPU: int(rng.integers(2000, 12000)),
+                  MEM: int(rng.integers(2048, 16384))},
+        qos=QoSClass.LS,
+        priority_class=(
+            PriorityClass.PROD if rng.random() < 0.5
+            else PriorityClass.NONE
+        ),
+        priority=int(rng.integers(1000, 4000)),
+        quota=QUOTAS[int(rng.integers(len(QUOTAS)))],
+        is_daemonset=bool(rng.random() < 0.1),
+    )
+
+
+def oracle_pair(snapshot, pod, model, arrays, quota_used=None,
+                used_limit=None):
+    want = find_preemption(
+        snapshot, pod, quota_used=quota_used, used_limit=used_limit,
+        arrays=arrays,
+        thresholds=np.asarray(model.params.thresholds),
+        prod_thresholds=np.asarray(model.params.prod_thresholds),
+    )
+    return None if want is None else (want[0], [v.uid for v in want[1]])
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_select_victims_identity(seed):
+    """Per-preemptor device selection == oracle: node, victim set AND
+    reprieve order, over diverse random worlds."""
+    rng = np.random.default_rng(seed)
+    snapshot = storm_cluster(rng)
+    model = PlacementModel(use_pallas=False)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    resident = model.lower_residents(snapshot, arrays)
+    world = model.resident_world(resident)
+    for k in range(8):
+        pod = preemptor(rng, k)
+        got = model.select_victims_device(
+            arrays, resident, pod, world=world,
+        )
+        want = oracle_pair(snapshot, pod, model, arrays)
+        assert got == want, f"pod {k}: device {got} != oracle {want}"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_quota_gate_identity(seed):
+    """The ElasticQuota reprieve gate: with headroom the reprieve loop
+    runs; over-runtime (used + podReq > usedLimit) NO victim is
+    reprieved — both paths must agree on both regimes, including the
+    all-candidates victim list the no-reprieve edge produces."""
+    rng = np.random.default_rng(100 + seed)
+    snapshot = storm_cluster(rng, stale_frac=0.0, unsched_frac=0.0)
+    model = PlacementModel(use_pallas=False)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    resident = model.lower_residents(snapshot, arrays)
+    world = model.resident_world(resident)
+    import dataclasses
+
+    for k in range(6):
+        pod = preemptor(rng, k)
+        if pod.quota is None:
+            pod = dataclasses.replace(pod, quota="team-a")
+        headroom = bool(rng.random() < 0.5)
+        req = resources_to_vector(pod.requests)
+        quota_used = np.full(
+            len(req), int(rng.integers(0, 20000)), dtype=np.int64
+        )
+        if headroom:
+            used_limit = quota_used + req + 10000
+        else:
+            used_limit = quota_used  # any positive req dim overflows
+        got = model.select_victims_device(
+            arrays, resident, pod,
+            quota_used=quota_used, used_limit=used_limit, world=world,
+        )
+        want = oracle_pair(
+            snapshot, pod, model, arrays,
+            quota_used=quota_used, used_limit=used_limit,
+        )
+        assert got == want, (
+            f"pod {k} headroom={headroom}: device {got} != oracle {want}"
+        )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_eviction_round_identity(seed):
+    """A whole multi-preemptor round: per-pod device dispatch with the
+    incremental eviction delta (evict_resident_rows) vs the oracle loop
+    with full re-lowering — the rounds must agree pod for pod, and the
+    delta-maintained arrays must stay bit-identical to from-scratch
+    lowering after every eviction."""
+    from koordinator_tpu.ops.binpack import STAGED_NODE_FIELDS
+
+    rng = np.random.default_rng(200 + seed)
+    dev_snap = storm_cluster(rng)
+    model = PlacementModel(use_pallas=False)
+    # an independent oracle arm over an identical world
+    rng2 = np.random.default_rng(200 + seed)
+    ora_snap = storm_cluster(rng2)
+
+    dev_arrays = lower_nodes(dev_snap, **model.lowering_kwargs())
+    resident = model.lower_residents(dev_snap, dev_arrays)
+    ora_arrays = lower_nodes(ora_snap, **model.lowering_kwargs())
+    world = model.resident_world(resident)
+    for k in range(6):
+        pod = preemptor(rng, k)
+        got = model.select_victims_device(
+            dev_arrays, resident, pod, world=world,
+        )
+        want = oracle_pair(ora_snap, pod, model, ora_arrays)
+        assert got == want, f"round step {k}: {got} != {want}"
+        if got is None:
+            continue
+        node_name, uids = got
+        evict_resident_rows(
+            dev_snap, dev_arrays, resident, node_name, uids,
+            **model.lowering_kwargs(),
+        )
+        wanted = set(uids)
+        ora_snap.pods = [p for p in ora_snap.pods if p.uid not in wanted]
+        ora_arrays = lower_nodes(ora_snap, **model.lowering_kwargs())
+        # the eviction delta is bit-identical to full relowering
+        for f in STAGED_NODE_FIELDS:
+            np.testing.assert_array_equal(
+                getattr(dev_arrays, f), getattr(ora_arrays, f),
+                err_msg=f"eviction delta diverged on {f} at step {k}",
+            )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_preempt_scan_identity_disjoint_quota(seed):
+    """The scanned storm variant == the sequential per-pod path when no
+    quota gate is armed (the regime the scan is exact in): same nodes,
+    same ordered victims, eviction deltas carried in-scan."""
+    rng = np.random.default_rng(300 + seed)
+    snapshot = storm_cluster(rng)
+    model = PlacementModel(use_pallas=False)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    resident = model.lower_residents(snapshot, arrays)
+    pods = [preemptor(rng, k) for k in range(5)]
+    scanned = model.preempt_scan_device(arrays, resident, pods)
+
+    # sequential reference: per-pod device dispatch + eviction deltas
+    seq_snap = storm_cluster(np.random.default_rng(300 + seed))
+    seq_arrays = lower_nodes(seq_snap, **model.lowering_kwargs())
+    seq_res = model.lower_residents(seq_snap, seq_arrays)
+    for k, pod in enumerate(pods):
+        got = model.select_victims_device(seq_arrays, seq_res, pod)
+        assert scanned[k] == got, (
+            f"scan step {k}: {scanned[k]} != sequential {got}"
+        )
+        if got is None:
+            continue
+        evict_resident_rows(
+            seq_snap, seq_arrays, seq_res, got[0], got[1],
+            **model.lowering_kwargs(),
+        )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_defrag_identity(seed):
+    """Headroom repack: device plan == host oracle (node, drain set and
+    least-important-first order), including the no-drain-needed answer
+    when the hole already fits."""
+    rng = np.random.default_rng(400 + seed)
+    snapshot = storm_cluster(rng)
+    model = PlacementModel(use_pallas=False)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    resident = model.lower_residents(snapshot, arrays)
+    for k in range(4):
+        target = resources_to_vector({
+            CPU: int(rng.integers(4000, 20000)),
+            MEM: int(rng.integers(4096, 32768)),
+        })
+        max_prio = int(rng.integers(500, 3000))
+        got = model.plan_defrag_device(arrays, resident, target, max_prio)
+        plan = plan_defrag(snapshot, target, max_prio, arrays=arrays)
+        want = None if plan is None else (
+            plan[0], [v.uid for v in plan[1]]
+        )
+        assert got == want, f"defrag {k}: device {got} != oracle {want}"
+
+
+def test_loadaware_half_boundary_identity():
+    """The percent_rounded .5 boundary (used=23/total=40 → exact 57.5 →
+    58, where the reference's float64 lands 57): the preemption
+    loadaware gate must agree between device and oracle exactly AT the
+    boundary, both when the threshold equals the rounded value (node
+    fails, eviction can't help) and one above (node passes)."""
+    nodes = [NodeSpec(name="n0", allocatable={CPU: 40, MEM: 65536})]
+    residents = [
+        PodSpec(name=f"b{j}", node_name="n0",
+                requests={CPU: 10, MEM: 16384},
+                priority=100, assign_time=float(j))
+        for j in range(3)
+    ]
+    metrics = {"n0": NodeMetric(
+        node_name="n0", node_usage={CPU: 23, MEM: 0}, update_time=100.0,
+    )}
+    snapshot = ClusterSnapshot(
+        nodes=nodes, pods=residents, node_metrics=metrics, now=120.0,
+    )
+    pod = PodSpec(name="ls", requests={CPU: 25, MEM: 1024}, priority=900)
+    for cpu_thr, expect_hit in ((58, False), (59, True)):
+        model = PlacementModel(
+            use_pallas=False, usage_thresholds={CPU: cpu_thr},
+        )
+        arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+        resident = model.lower_residents(snapshot, arrays)
+        got = model.select_victims_device(arrays, resident, pod)
+        want = oracle_pair(snapshot, pod, model, arrays)
+        assert got == want, f"thr={cpu_thr}: {got} != {want}"
+        assert (got is not None) == expect_hit
+
+
+def test_quota_over_runtime_no_reprieve_order():
+    """Over-runtime quota: the oracle appends EVERY candidate in
+    importance order (no reprieve at all); the device victim mask read
+    along the importance-sorted P axis must produce exactly that list."""
+    nodes = [NodeSpec(name="n0", allocatable={CPU: 10000, MEM: 65536})]
+    residents = [
+        PodSpec(name=f"b{j}", node_name="n0",
+                requests={CPU: 2000, MEM: 1024},
+                priority=[300, 100, 300, 200][j],
+                assign_time=[5.0, 1.0, 2.0, 9.0][j],
+                quota="q")
+        for j in range(4)
+    ]
+    snapshot = ClusterSnapshot(
+        nodes=nodes, pods=residents, node_metrics={}, now=120.0,
+    )
+    pod = PodSpec(name="ls", requests={CPU: 4000, MEM: 2048},
+                  priority=900, quota="q")
+    model = PlacementModel(use_pallas=False)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    resident = model.lower_residents(snapshot, arrays)
+    req = resources_to_vector(pod.requests)
+    quota_used = np.full_like(req, 100)
+    used_limit = quota_used  # any positive req dim is over
+    got = model.select_victims_device(
+        arrays, resident, pod, quota_used=quota_used,
+        used_limit=used_limit,
+    )
+    want = oracle_pair(snapshot, pod, model, arrays,
+                       quota_used=quota_used, used_limit=used_limit)
+    assert got == want
+    # all four candidates, importance order: prio desc, then assign asc
+    assert got is not None
+    assert got[1] == [
+        "default/b2", "default/b0", "default/b3", "default/b1",
+    ]
+
+
+def test_verify_backend_runs_and_agrees():
+    """The scheduler's "verify" backend runs device AND oracle per
+    preemptor and raises on any divergence — a storm round through it
+    is the end-to-end parity harness."""
+    from koordinator_tpu.testing.chaos import preemption_storm
+
+    nodes, residents, arrivals = preemption_storm(
+        seed=11, n_nodes=6, residents_per_node=3, n_arrivals=3,
+        quota="storm-q",
+    )
+    sched = Scheduler(model=PlacementModel(use_pallas=False),
+                      preemption_backend="verify")
+    for node in nodes:
+        sched.add_node(node)
+    for pod in residents + arrivals:
+        sched.add_pod(pod)
+    out = sched.schedule_pending(now=100.0)
+    assert getattr(out, "nominations", None), "no preemption happened"
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(ValueError):
+        Scheduler(preemption_backend="gpu")
+
+
+def test_victim_bucket_padding_is_inert():
+    """Bucket-padded resident columns can never be selected: the same
+    world lowered with and without bucketing gives identical answers."""
+    rng = np.random.default_rng(7)
+    snapshot = storm_cluster(rng, stale_frac=0.0, unsched_frac=0.0)
+    model = PlacementModel(use_pallas=False)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    bucketed = model.lower_residents(snapshot, arrays)
+    raw = lower_resident_pods(snapshot, arrays)  # no bucket
+    assert bucketed.p >= raw.p
+    for k in range(4):
+        pod = preemptor(rng, k)
+        got_b = model.select_victims_device(arrays, bucketed, pod)
+        got_r = model.select_victims_device(arrays, raw, pod)
+        assert got_b == got_r
+
+
+def test_placement_service_status_has_preemption_section(tmp_path):
+    """Eviction counters ride the same operator surface as everything
+    else: PlacementService.status() carries a preemption section with
+    attempts, per-outcome victim counts and defrag drains — the bounded
+    label set the metrics-hygiene rules enumerate."""
+    from koordinator_tpu.service.server import PlacementService
+
+    service = PlacementService(str(tmp_path / "preempt-status.sock"))
+    service.start()
+    try:
+        status = service.status()
+        section = status["preemption"]
+        assert set(section) == {"attempts", "victims", "defrag_drains"}
+        assert set(section["victims"]) == {
+            "selected", "reprieved", "evicted",
+        }
+        for value in section["victims"].values():
+            assert value >= 0
+    finally:
+        service.stop()
+
+
+@pytest.mark.slow
+def test_storm_scale_parity_slow():
+    """Storm-scale parity (excluded from tier-1): the bench-leg-19
+    world — 5k BE residents across 1250 packed nodes — swept through
+    the device per-pod path WITH eviction deltas against the host
+    oracle with full re-lowers, plus the one-dispatch scan variant
+    hitting every arrival. Small-shape parity is pinned dozens of ways
+    above; this pins it at the shape the throughput claim is made."""
+    from koordinator_tpu.testing.chaos import preemption_storm
+
+    nodes, residents, arrivals = preemption_storm(
+        seed=11, n_nodes=1250, residents_per_node=4, n_arrivals=64,
+    )
+    sched = Scheduler(model=PlacementModel(use_pallas=False))
+    for node in nodes:
+        sched.add_node(node)
+    for pod in residents:
+        sched.add_pod(pod)
+    model = sched.model
+    snapshot = sched.cache.snapshot(now=50.0)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    resident = model.lower_residents(snapshot, arrays)
+    world = model.resident_world(resident)
+    scanned = model.preempt_scan_device(
+        arrays, resident, arrivals, world=world)
+    assert sum(1 for s in scanned if s is not None) == len(arrivals)
+    h_snapshot = sched.cache.snapshot(now=50.0)
+    h_arrays = lower_nodes(h_snapshot, **model.lowering_kwargs())
+    for pod in arrivals[:16]:
+        got = model.select_victims_device(
+            arrays, resident, pod, world=world)
+        want = oracle_pair(h_snapshot, pod, model, h_arrays)
+        assert got == want, f"storm-scale divergence for {pod.uid}"
+        if got is None:
+            continue
+        node_name, uids = got
+        evict_resident_rows(
+            snapshot, arrays, resident, node_name, uids,
+            **model.lowering_kwargs(),
+        )
+        wanted = set(uids)
+        h_snapshot.pods = [
+            p for p in h_snapshot.pods if p.uid not in wanted
+        ]
+        h_arrays = lower_nodes(h_snapshot, **model.lowering_kwargs())

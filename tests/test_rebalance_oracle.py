@@ -285,3 +285,80 @@ def test_number_of_nodes_gate_identity():
         )],
     )
     run_both(args, snapshot)
+
+
+# -- defrag (headroom repack) parity: device plan vs scalar oracle ----------
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_defrag_plan_identity(seed):
+    """The device headroom-repack planner (ops/preempt.headroom_repack)
+    must match the scalar oracle (scheduler/preemption.plan_defrag)
+    exactly — chosen node, drain set AND least-important-first order —
+    over the same randomized clusters the rebalance differential uses."""
+    from koordinator_tpu.apis.types import resources_to_vector
+    from koordinator_tpu.models.placement import PlacementModel
+    from koordinator_tpu.scheduler.preemption import plan_defrag
+    from koordinator_tpu.state.cluster import lower_nodes
+
+    rng = np.random.default_rng(500 + seed)
+    snapshot = random_cluster(rng)
+    model = PlacementModel(use_pallas=False)
+    arrays = lower_nodes(snapshot, **model.lowering_kwargs())
+    resident = model.lower_residents(snapshot, arrays)
+    for k in range(4):
+        target = resources_to_vector({
+            CPU: int(rng.integers(8000, 48000)),
+            MEM: int(rng.integers(8192, 65536)),
+        })
+        max_prio = int(rng.integers(500, 2500))
+        got = model.plan_defrag_device(arrays, resident, target, max_prio)
+        plan = plan_defrag(snapshot, target, max_prio, arrays=arrays)
+        want = None if plan is None else (plan[0], [v.uid for v in plan[1]])
+        assert got == want, (
+            f"seed {seed} target {k}: device {got} != oracle {want}"
+        )
+
+
+def test_threshold_float64_truncation_identity():
+    """The documented float64 rounding case (ops/rebalance.py): a 29%
+    threshold on a power-of-ten capacity resolves through
+    ``int64(float64(29) * 0.01 * cap)`` — 28999…, NOT the integer
+    ``29 * cap // 100`` — so a node at exactly 29% must classify as
+    OVER the low threshold on both plugin and oracle (and the eviction
+    sequences stay identical either way)."""
+    nodes = [
+        NodeSpec(name="hot", allocatable={CPU: 100000, MEM: 131072}),
+        NodeSpec(name="cold", allocatable={CPU: 100000, MEM: 131072}),
+    ]
+    pods = [
+        PodSpec(name=f"p{j}", node_name="hot",
+                requests={CPU: 2000, MEM: 512}, qos=QoSClass.BE,
+                creation_time=float(j))
+        for j in range(4)
+    ]
+    metrics = {
+        # 29000/100000 = exactly 29%: float64 truncation puts the
+        # resolved low-threshold QUANTITY at 28999, so 29000 is above it
+        "hot": NodeMetric(
+            node_name="hot", node_usage={CPU: 29000, MEM: 0},
+            pod_usages={p.uid: {CPU: 5000, MEM: 128} for p in pods},
+            update_time=100.0,
+        ),
+        "cold": NodeMetric(node_name="cold", node_usage={CPU: 0, MEM: 0},
+                           update_time=100.0),
+    }
+    snapshot = ClusterSnapshot(nodes=nodes, pods=pods,
+                               node_metrics=metrics, now=120.0)
+    args = LowNodeLoadArgs(node_pools=[NodePool(
+        low_thresholds={CPU: 29},
+        high_thresholds={CPU: 90},
+    )])
+    evictor = RecordingEvictor()
+    LowNodeLoad(args).balance(snapshot, evictor)
+    want = RebalanceOracle(args).sweep(snapshot)
+    assert evictor.sequence == want
+    # the truncation made "hot" properly utilized (29000 > 28999), so
+    # nothing is over the high threshold and nothing evicts — but BOTH
+    # implementations must have made the same call
+    assert evictor.sequence == []

@@ -917,3 +917,85 @@ class TestDeviceObsManifest:
         # arrays became ShapeDtypeStructs, the static rode by value
         assert aval_args[0].shape == (32,)
         assert aval_args[2] == 3
+
+
+class TestPreemptVariantsAdoption:
+    """ISSUE 16 warm-pool satellite: the joint place+evict solve
+    variants — preempt_solve, preempt_solve_scan, defrag_repack — are
+    ordinary (fn × aval-signature) pool citizens. A promoted replica's
+    first eviction round must restore warm, not cold: the same
+    adopt → persist → restore → serve contract the solve path pins."""
+
+    def _storm_world(self):
+        from koordinator_tpu.models.placement import PlacementModel
+        from koordinator_tpu.scheduler.scheduler import Scheduler
+        from koordinator_tpu.state.cluster import lower_nodes
+        from koordinator_tpu.testing.chaos import preemption_storm
+
+        nodes, residents, arrivals = preemption_storm(
+            seed=7, n_nodes=6, residents_per_node=4, n_arrivals=3,
+        )
+        sched = Scheduler(model=PlacementModel(use_pallas=False))
+        for node in nodes:
+            sched.add_node(node)
+        for pod in residents:
+            sched.add_pod(pod)
+        snapshot = sched.cache.snapshot(now=100.0)
+        arrays = lower_nodes(snapshot, **sched.model.lowering_kwargs())
+        resident = sched.model.lower_residents(snapshot, arrays)
+        return sched.model, arrivals, arrays, resident
+
+    def _adopt_all(self, pool, model):
+        from koordinator_tpu.ops.preempt import (
+            headroom_repack,
+            preempt_scan,
+            select_victims,
+        )
+
+        pool.adopt(model._preempt, select_victims, config_argpos=0)
+        pool.adopt(model._preempt_scan, preempt_scan, config_argpos=0)
+        pool.adopt(model._defrag, headroom_repack, config_argpos=0)
+
+    def test_preempt_variants_restore_warm(self, tmp_path):
+        from koordinator_tpu.apis.types import (
+            ResourceName,
+            resources_to_vector,
+        )
+        from koordinator_tpu.models.placement import PlacementModel
+
+        model, arrivals, arrays, resident = self._storm_world()
+        target = resources_to_vector({
+            ResourceName.CPU: 8000, ResourceName.MEMORY: 16384,
+        })
+        pool = _pool(tmp_path, "preempt-store")
+        self._adopt_all(pool, model)
+        want_select = model.select_victims_device(
+            arrays, resident, arrivals[0])
+        want_scan = model.preempt_scan_device(
+            arrays, resident, arrivals[:2])
+        want_defrag = model.plan_defrag_device(
+            arrays, resident, target, max_victim_priority=5000)
+        report = pool.persist()
+        assert report["persisted"] >= 3, (
+            "preempt/scan/defrag signatures missing from the pooled "
+            "manifest"
+        )
+        # the restart shape: a fresh model (fresh jit bindings) and a
+        # fresh pool over the same store — every eviction-round entry
+        # must come back warm and answer bit-identically
+        model2 = PlacementModel(use_pallas=False)
+        pool2 = _pool(tmp_path, "preempt-store")
+        self._adopt_all(pool2, model2)
+        assert pool2.restore()["restored"] >= 3
+        got_select = model2.select_victims_device(
+            arrays, resident, arrivals[0])
+        got_scan = model2.preempt_scan_device(
+            arrays, resident, arrivals[:2])
+        got_defrag = model2.plan_defrag_device(
+            arrays, resident, target, max_victim_priority=5000)
+        assert pool2.status()["served"] >= 3, (
+            "jit path answered an adopted eviction-round call"
+        )
+        assert got_select == want_select
+        assert got_scan == want_scan
+        assert got_defrag == want_defrag

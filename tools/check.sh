@@ -88,6 +88,19 @@ echo "== streaming smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_streaming.py \
     -q -k "smoke or fires_first" -p no:cacheprovider
 
+echo "== preemption smoke =="
+# the joint place+evict slice (ISSUE 16): device victim selection,
+# reprieve ORDER, and the quota-over-runtime no-reprieve edge must
+# stay bit-identical to the host oracle (scheduler/preemption.py);
+# the "verify" backend must agree end-to-end on a scheduling round;
+# the seeded preemption storm additionally runs under the chaos
+# suite's shape-flow sentinel (see the chaos smoke above)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_preempt_device.py \
+    -q -k "verify_backend or over_runtime or half_boundary or status" \
+    -p no:cacheprovider
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
+    -q -m chaos -k preemption_storm -p no:cacheprovider
+
 echo "== sharded + multi-tenant + warm-pool + streaming bench budgets =="
 # the measured sharded/multi-tenant/warm-pool/streaming legs are
 # budget-gated (ISSUES 10/11/13/14): a scaling, merge-overhead,
