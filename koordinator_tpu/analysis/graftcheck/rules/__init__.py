@@ -244,7 +244,24 @@ LOCK_SPECS = (
         class_name="FlightRecorder",
         lock="_lock",
         attrs=("_ring", "_dumps", "_last_dump", "_dump_dir",
-               "_min_interval_s", "_seq", "_files", "_max_files"),
+               "_min_interval_s", "_seq", "_files", "_max_files",
+               "_payload_hooks"),
+    ),
+    # the serving SLO controller (docs/DESIGN.md §25): the loop thread
+    # reconciles, promotion hooks adopt published knob state from the
+    # elector callback, debug-mux/flight readers snapshot the decision
+    # and observation rings — one lock over policy state and both rings
+    LockSpec(
+        path="koordinator_tpu/control/slo.py",
+        class_name="ServingSLOController",
+        lock="_lock",
+        attrs=("_ring", "_obs_ring", "_decisions_total",
+               "_last_reconcile_at", "_adopted", "_seq",
+               "_breach", "_under", "_relax_cap", "_last_relax",
+               "_wm_raise_ok", "_last_decision_now"),
+        # _step_locked is the lock-held policy body: both call sites
+        # (step(), reconcile()) enter it inside `with self._lock`
+        exempt_methods=("__init__", "_step_locked"),
     ),
     # the device-cost observatory (docs/DESIGN.md §17): instrumented
     # jit calls record from solve threads, the monitoring listener
@@ -299,7 +316,7 @@ PARITY_SPECS = (
 
 
 #: every mapped lock as a node of the whole-program lock-order graph:
-#: the twelve LockSpec classes' primary locks plus the observatory's
+#: the seventeen LockSpec classes' primary locks plus the observatory's
 #: documented secondary lock (``_profile_io_lock`` OUTER, ``_lock``
 #: inner — obs/device.py) so the documented order is machine-checked
 #: RLock-backed classes: same-instance re-acquisition is legal, so the
@@ -344,6 +361,10 @@ NO_DONATE_MODULES = (
 DETERMINISM_MODULES = HOT_MODULES + (
     "koordinator_tpu/service/codec.py",
     "koordinator_tpu/service/client.py",
+    # the SLO controller's decision log must replay bit-for-bit from
+    # its recorded observation ring (DESIGN §25) — no wall clocks or
+    # ambient randomness may leak into the policy
+    "koordinator_tpu/control/slo.py",
 )
 
 
@@ -643,6 +664,15 @@ LABEL_DOMAINS = {
         "watermark", "deadline", "idle",
     )),
     "lane": LabelDomain(kind="enum", values=("system", "ls", "be")),
+    # the SLO controller's typed decision vocabulary (DESIGN §25):
+    # every knob it may move and every signal that may move one —
+    # control/slo.py KNOBS / SIGNALS are the code-side enumerations
+    "knob": LabelDomain(kind="enum", values=(
+        "watermark", "deadline", "capacity",
+    )),
+    "signal": LabelDomain(kind="enum", values=(
+        "p99-over", "p99-under", "shed-capacity", "padding-waste",
+    )),
     "buffer": LabelDomain(kind="enum", values=(
         "pod_batch", "resv_table", "dirty_rows", "coalesced_pods",
         "tenant_nodes", "tenant_pods", "tenant_lanes",

@@ -1,11 +1,12 @@
 """lock-order: a whole-program lock acquisition graph, cycles = deadlock.
 
 The lock-discipline rule proves each mapped attribute is touched under
-its own lock; it says nothing about lock NESTING. With twelve mapped
-classes (SchedulerCache, StagedStateCache, TickPipeline, StateAuditor,
-SpanTracer, PodTimelines, FlightRecorder, DeviceObservatory,
-SolverSupervisor, FailoverSolver, AdmissionGate, ClusterDeltaTracker)
-sharing threads — coordinator, publisher, gate executor, sidecar
+its own lock; it says nothing about lock NESTING. With seventeen
+mapped classes (SchedulerCache, StagedStateCache, TickPipeline,
+StateAuditor, SpanTracer, PodTimelines, FlightRecorder,
+DeviceObservatory, SolverSupervisor, FailoverSolver, AdmissionGate,
+ClusterDeltaTracker, TenantRegistry, WarmPool, ArrivalGate,
+StreamingLoop, ServingSLOController) sharing threads — coordinator, publisher, gate executor, sidecar
 handlers, debug mux — two code paths that nest the same pair of locks
 in opposite orders are a real deadlock waiting on a real interleaving
 (the class the reference's Go race detector + mutex profiling covers).

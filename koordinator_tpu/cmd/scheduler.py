@@ -123,6 +123,19 @@ class SchedulerConfig:
     #: floor between adaptively-fired rounds (0 = none): bounds the
     #: dispatch rate a trickle of deadline-armed singletons can drive
     stream_min_interval_s: float = 0.0
+    #: per-lane serving SLOs (control/slo.py, docs/DESIGN.md §25):
+    #: ``p99=<seconds>`` (or a bare float) per lane. Any set target
+    #: turns on the ServingSLOController in streaming mode — the
+    #: static stream_* knobs above become its STARTING point, and the
+    #: reconcile loop walks them toward the declared target (bounded,
+    #: hysteretic, one knob per reconcile, every decision recorded)
+    slo_system: Optional[str] = None
+    slo_ls: Optional[str] = None
+    slo_be: Optional[str] = None
+    #: controller cadence: rolling-stats window the lane p99 is read
+    #: over, and the per-decision cooldown (hysteresis)
+    slo_window_s: float = 5.0
+    slo_cooldown_s: float = 1.0
     #: AOT warm pool (service/warmpool.py, docs/DESIGN.md §21):
     #: restore serialized executables for the hot solve signatures at
     #: startup and on leader promotion, and persist newly-observed
@@ -310,12 +323,48 @@ def build_streaming_loop(scheduler, bus, config: SchedulerConfig,
         if event is EventType.DELETED:
             return
         if getattr(pod, "node_name", None) is not None:
+            # a bind — possibly published by ANOTHER seat (HA
+            # streaming, DESIGN §25): resolve the intake's tracked
+            # submit→bind span so a standby's timelines and depth
+            # gauges stay true without it ever firing a round
+            loop.observe_bound(pod)
             return
         loop.observe(pod)
 
     bus.watch(Kind.POD, on_pod)
     scheduler.services.register("streaming", loop.status)
     return loop
+
+
+def build_slo_controller(streaming, bus, config: SchedulerConfig,
+                         elector=None, log=print):
+    """Close the loop on the streaming knobs (docs/DESIGN.md §25):
+    when any ``--slo-*`` lane target is declared, a
+    :class:`~koordinator_tpu.control.slo.ServingSLOController` rides
+    the StreamingLoop's trigger loop and walks
+    watermark/deadline/capacity toward the target — bounded,
+    hysteretic, one knob per reconcile, every decision a typed record
+    on the debug mux and stamped into flight-recorder dumps. Returns
+    None when no target is set (the static flags stay in charge)."""
+    from koordinator_tpu.control.slo import ServingSLOController, SLOSpec
+    from koordinator_tpu.obs.flight import FLIGHT
+
+    spec = SLOSpec.parse(config.slo_system, config.slo_ls, config.slo_be)
+    if not spec.any():
+        return None
+    controller = ServingSLOController(
+        streaming, spec, bus=bus, elector=elector,
+        window_s=config.slo_window_s,
+        cooldown_s=config.slo_cooldown_s,
+        log=log,
+    )
+    streaming.attach_controller(controller)
+    streaming.scheduler.services.register("slo", controller.status)
+    # the decision-ring tail lands in every anomaly dump: "what was
+    # the controller doing to the knobs before this?" answered from
+    # the dump alone
+    FLIGHT.register_payload("slo", controller.flight_payload)
+    return controller
 
 
 def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
@@ -377,10 +426,12 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
                 "config) and pass it as streaming="
             )
         if elector is not None:
-            raise ValueError(
-                "streaming mode does not support --leader-elect yet "
-                "(ROADMAP: fold the lease gate into the trigger loop)"
-            )
+            # HA streaming (DESIGN §25): the lease gates the trigger
+            # loop itself — a standby seat drains its pipeline and
+            # watch-feeds the intake without firing rounds; promotion
+            # adopts the deposed leader's knob state FIRST, then
+            # sweeps the pending cache into the gate (intake handoff)
+            streaming.attach_elector(elector)
         if once:
             raise ValueError("--once is a fixed-cadence concept; "
                              "streaming mode serves continuously")
@@ -667,6 +718,33 @@ def main(argv=None) -> int:
              "singletons can drive",
     )
     parser.add_argument(
+        "--slo-system", default=None,
+        help="system-lane serving SLO, e.g. 'p99=0.002' (seconds; a "
+             "bare float also parses). Any --slo-* target turns on "
+             "the self-tuning SLO controller in --streaming mode: the "
+             "static --stream-* knobs become its starting point and a "
+             "reconcile loop walks them toward the target "
+             "(docs/DESIGN.md §25)",
+    )
+    parser.add_argument(
+        "--slo-ls", default=None,
+        help="latency-sensitive-lane serving SLO (see --slo-system)",
+    )
+    parser.add_argument(
+        "--slo-be", default=None,
+        help="best-effort-lane serving SLO (see --slo-system)",
+    )
+    parser.add_argument(
+        "--slo-window", type=float, default=5.0,
+        help="SLO controller rolling-stats window in seconds (the "
+             "lane p99 the reconcile loop reads)",
+    )
+    parser.add_argument(
+        "--slo-cooldown", type=float, default=1.0,
+        help="SLO controller per-decision cooldown in seconds "
+             "(hysteresis: at most one knob adjustment per cooldown)",
+    )
+    parser.add_argument(
         "--cluster-json", default=None,
         help="seed the bus from a cluster-spec JSON file",
     )
@@ -777,6 +855,11 @@ def main(argv=None) -> int:
         stream_deadline_be_s=args.stream_deadline_be,
         stream_capacity=args.stream_capacity,
         stream_min_interval_s=args.stream_min_interval,
+        slo_system=args.slo_system,
+        slo_ls=args.slo_ls,
+        slo_be=args.slo_be,
+        slo_window_s=args.slo_window,
+        slo_cooldown_s=args.slo_cooldown,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler
@@ -856,6 +939,12 @@ def main(argv=None) -> int:
             # informer traffic share one adaptive trigger
             streaming = build_streaming_loop(
                 scheduler, bus, config, auditor=auditor,
+            )
+            # declared SLO targets turn on the closed loop over the
+            # streaming knobs (no targets = static flags stay in
+            # charge, controller not built)
+            build_slo_controller(
+                streaming, bus, config, elector=elector,
             )
         if args.cluster_json:
             seed_bus_from_json(bus, args.cluster_json)

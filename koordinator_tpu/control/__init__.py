@@ -1,0 +1,16 @@
+"""Control plane: reconcile loops that close feedback onto declared
+SLOs (reference: koord-manager's slo-controller — NodeSLO policy
+continuously re-derived from declared specs + observed metrics).
+
+The first resident is :mod:`koordinator_tpu.control.slo`'s
+:class:`~koordinator_tpu.control.slo.ServingSLOController`: the serving
+path's analog of the NodeSLO reconcile loop, turning the streaming
+intake's static watermark/deadline/capacity flags into a closed loop
+toward per-lane latency SLOs (docs/DESIGN.md §25)."""
+
+from koordinator_tpu.control.slo import (  # noqa: F401
+    KnobBounds,
+    ServingSLOController,
+    SLOSpec,
+    replay_decisions,
+)

@@ -231,6 +231,30 @@ STREAM_BATCH_PODS = SCHEDULER_METRICS.histogram(
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
 )
 
+# -- serving SLO controller (koordinator_tpu/control/slo.py) ----------------
+# The closed loop over the streaming knobs: every applied knob move is
+# counted by its trigger signal, and the per-lane rolling p99 vs the
+# declared target is exported as a ratio gauge (<=1 means in-SLO) so a
+# dashboard answers "is the serving path converged, and what is the
+# controller doing about it" from one scrape (docs/DESIGN.md §25).
+
+SLO_DECISIONS = SCHEDULER_METRICS.counter(
+    "scheduler_slo_decisions_total",
+    "Knob adjustments the serving SLO controller applied, by knob and "
+    "trigger signal (one knob per reconcile, cooldown-gated — a high "
+    "rate here means the declared SLO fights the offered load)",
+    label_names=("knob", "signal"),
+    # knob: watermark | deadline | capacity
+    # signal: p99-over | p99-under | shed-capacity | padding-waste
+)
+SLO_LANE_P99_RATIO = SCHEDULER_METRICS.gauge(
+    "scheduler_slo_lane_p99_ratio",
+    "Rolling-window submit→bind p99 over the declared per-lane target "
+    "(<= 1.0 means the lane meets its SLO; only exported for lanes "
+    "with a declared target and enough window samples)",
+    label_names=("lane",),  # system | ls | be
+)
+
 # -- device-cost observatory (koordinator_tpu/obs/device.py) ----------------
 # The device-side twin of the trace fabric: compile telemetry, padding
 # waste, and live-buffer accounting. These live in their OWN registry

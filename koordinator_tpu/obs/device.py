@@ -778,6 +778,16 @@ class DeviceObservatory:
         payload["live"] = self.live_snapshot()
         return payload
 
+    def padding_waste(self) -> float:
+        """Worst current padding-waste ratio across the staged buffers
+        — the SLO controller's batch-amortization signal
+        (koordinator_tpu/control/slo.py). One lock hold, no device
+        work, 0.0 before anything staged."""
+        with self._lock:
+            return max(
+                (v["waste"] for v in self._padding.values()), default=0.0
+            )
+
     def compile_ring(self, since_seq: int = 0) -> Tuple[List[dict], int]:
         """Ring entries newer than ``since_seq`` WITH their raw
         ``(fn_name, signature)`` keys, plus the current sequence — the

@@ -78,6 +78,12 @@ class FlightRecorder:
         self._files: List[str] = []
         self._max_files = max_files
         self._seq = 0
+        #: name -> zero-arg payload fn stamped into every dump as a
+        #: top-level section (the SLO controller registers its
+        #: decision-ring tail here; hooks must be cheap and cached-only
+        #: — a dump never compiles). A raising hook degrades to a typed
+        #: error section, never a lost dump.
+        self._payload_hooks: Dict[str, object] = {}
 
     def configure(self, dump_dir: Optional[str] = None,
                   capacity: Optional[int] = None,
@@ -90,6 +96,20 @@ class FlightRecorder:
                 self._ring = deque(self._ring, maxlen=capacity)
             if min_interval_s is not None:
                 self._min_interval_s = min_interval_s
+
+    def register_payload(self, name: str, fn) -> None:
+        """Stamp ``fn()``'s dict into every future dump under ``name``
+        (reserved section names are refused loudly — a hook must not
+        shadow the core dump sections)."""
+        if name in ("trigger", "at", "detail", "extra", "rounds",
+                    "device", "warm", "open_spans", "trace_tail"):
+            raise ValueError(f"flight payload name {name!r} is reserved")
+        with self._lock:
+            self._payload_hooks[name] = fn
+
+    def unregister_payload(self, name: str) -> None:
+        with self._lock:
+            self._payload_hooks.pop(name, None)
 
     # -- the per-round feed --------------------------------------------------
 
@@ -119,6 +139,7 @@ class FlightRecorder:
             seq = self._seq
             rounds = list(self._ring)
             dump_dir = self._dump_dir or _default_dump_dir()
+            hooks = dict(self._payload_hooks)
         TRACER.instant("flight-dump", cat="flight",
                        args={"trigger": reason})
         # the device-cost observatory's cached snapshot: "did we just
@@ -152,6 +173,11 @@ class FlightRecorder:
             "open_spans": TRACER.status()["open_marks"],
             "trace_tail": TRACER.events(tail=_TRACE_TAIL),
         }
+        for name in sorted(hooks):
+            try:
+                payload[name] = hooks[name]()
+            except Exception as e:  # a broken hook never loses a dump
+                payload[name] = {"error": f"{type(e).__name__}: {e}"}
         path = os.path.join(dump_dir, f"flight-{reason}-{seq:04d}.json")
         error = None
         pruned = None

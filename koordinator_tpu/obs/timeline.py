@@ -82,6 +82,14 @@ class PodTimelines:
         #: the streaming intake wires its shed accounting here so a
         #: refused sample is visible as backpressure, not silence
         self._on_drop = None
+        #: (lane, reason, t) for pods the intake RESOLVED as failures
+        #: (shed at capacity / expired past their lane deadline): the
+        #: failure tail folded into the same rolling surface the
+        #: survivor percentiles come from — a dashboard (or the SLO
+        #: controller) reading stats(window_s=) must see a lane that
+        #: sheds half its arrivals, not just the p99 of the half that
+        #: made it through
+        self._failures: deque = deque(maxlen=completed_capacity)
 
     # -- stamps --------------------------------------------------------------
 
@@ -149,6 +157,17 @@ class PodTimelines:
         with self._lock:
             self._active.pop(uid, None)
 
+    def note_shed(self, lane: str, reason: str, uid: Optional[str] = None) -> None:
+        """Record an intake failure resolution (``capacity`` /
+        ``deadline-exceeded``) into the rolling failure ring, and close
+        the pod's active timeline without observing — a shed pod is a
+        FAILURE sample for the window counters, never a latency one."""
+        t = self._clock()
+        with self._lock:
+            self._failures.append((lane, reason, t))
+            if uid is not None:
+                self._active.pop(uid, None)
+
     @contextmanager
     def preserved(self, uid: str):
         """Carry a timeline across a forget/submit round-trip. The
@@ -192,6 +211,10 @@ class PodTimelines:
                 (lane, e2e) for lane, e2e, stamps in self._completed
                 if cutoff is None or stamps.get("published", 0) >= cutoff
             ]
+            failures = [
+                (lane, reason) for lane, reason, t in self._failures
+                if cutoff is None or t >= cutoff
+            ]
 
         def pct(xs: List[float]) -> dict:
             if not xs:
@@ -204,11 +227,20 @@ class PodTimelines:
                 "p99_s": xs[hi],
             }
 
+        def shed_counts(fs) -> dict:
+            counts: dict = {}
+            for _, reason in fs:
+                counts[reason] = counts.get(reason, 0) + 1
+            return counts
+
         out = {"all": pct([e for _, e in samples])}
+        out["all"]["shed"] = shed_counts(failures)
         for lane in LANES:
             lane_samples = [e for l, e in samples if l == lane]
-            if lane_samples:
+            lane_failures = [(l, r) for l, r in failures if l == lane]
+            if lane_samples or lane_failures:
                 out[lane] = pct(lane_samples)
+                out[lane]["shed"] = shed_counts(lane_failures)
         return out
 
     #: rolling-window width served by status() (seconds of the
@@ -238,5 +270,6 @@ class PodTimelines:
         with self._lock:
             self._active.clear()
             self._completed.clear()
+            self._failures.clear()
             self._dropped = 0
             self._on_drop = None

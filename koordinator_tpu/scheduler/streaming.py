@@ -134,9 +134,15 @@ class ArrivalGate:
     """
 
     def __init__(self, config: StreamingConfig = StreamingConfig(),
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 shed_hook: Optional[Callable] = None):
         self.cfg = config
         self._clock = clock
+        #: optional (lane_name, reason, uid) callback fired OUTSIDE the
+        #: lock for every shed/expired resolution — the loop wires the
+        #: pod-timeline registry's failure fold here so the rolling
+        #: stats surface sees the failure tail beside the survivor p99
+        self._shed_hook = shed_hook
         self._lock = threading.Condition()
         #: per-lane FIFO of queued entries (arrival order per lane)
         self._lanes: List[deque] = [deque() for _ in LANES]
@@ -197,11 +203,15 @@ class ArrivalGate:
         self._publish_depths(depths)
         if refused:
             STREAM_SHED.inc({"lane": LANES[lane], "reason": "capacity"})
+            if self._shed_hook is not None:
+                self._shed_hook(LANES[lane], "capacity", uid)
             return "shed", None
         STREAM_ARRIVALS.inc({"lane": LANES[lane]})
         if victim is not None:
             STREAM_SHED.inc({"lane": LANES[victim.lane],
                              "reason": "capacity"})
+            if self._shed_hook is not None:
+                self._shed_hook(LANES[victim.lane], "capacity", victim.uid)
         return "queued", victim.uid if victim is not None else None
 
     def _pick_victim(self, lane: int) -> Optional[_Entry]:
@@ -228,6 +238,73 @@ class ArrivalGate:
             self._stats["timeline_dropped"] += 1
         STREAM_SHED.inc({"lane": LANES[lane],
                          "reason": "timeline-capacity"})
+
+    # -- knob retuning (the SLO controller, koordinator_tpu/control) ---------
+
+    def retune(self, watermark: Optional[int] = None,
+               lane_deadline_s: Optional[Tuple[float, float, float]] = None,
+               capacity: Optional[int] = None) -> StreamingConfig:
+        """Replace the trigger/intake knobs live (the SLO controller's
+        actuator). The config object is frozen, so a retune swaps in a
+        ``dataclasses.replace`` copy under the gate lock — every reader
+        already takes ``self.cfg`` under ``_lock``.
+
+        A lane-deadline change re-stamps every QUEUED entry's
+        ``deadline_at`` by the per-lane delta: entries were stamped
+        ``t_i + old`` with a monotone clock, so a uniform shift to
+        ``t_i + new`` preserves the per-lane deadline monotonicity the
+        O(1) head-min trigger depends on. In-flight/waiting entries
+        keep their stamps (their next requeue uses the new constant).
+        Wakes a parked loop: a tightened deadline or lowered watermark
+        may be due NOW."""
+        with self._lock:
+            old = self.cfg
+            fields = {}
+            if watermark is not None:
+                fields["watermark"] = int(watermark)
+            if lane_deadline_s is not None:
+                fields["lane_deadline_s"] = tuple(lane_deadline_s)
+            if capacity is not None:
+                fields["capacity"] = int(capacity)
+            if not fields:
+                return old
+            cfg = dataclasses.replace(old, **fields)
+            if lane_deadline_s is not None:
+                for lane, q in enumerate(self._lanes):
+                    delta = (cfg.lane_deadline_s[lane]
+                             - old.lane_deadline_s[lane])
+                    if delta:
+                        for e in q:
+                            e.deadline_at += delta
+            self.cfg = cfg
+            self._lock.notify_all()
+        return cfg
+
+    def note_bound(self, uid: str) -> None:
+        """A bind for a tracked pod landed on the bus from OUTSIDE this
+        gate's own round resolution — the HA case: a standby's gate
+        tracks the watch-fed intake while the leader places it.
+        Queued/Permit-waiting entries resolve ``bound`` (the submission
+        succeeded cluster-wide); an IN-FLIGHT entry is left alone — it
+        belongs to this seat's firing round and resolves exactly once
+        through :meth:`resolve_round`."""
+        with self._lock:
+            e = self._by_uid.pop(uid, None)
+            if e is not None:
+                try:
+                    self._lanes[e.lane].remove(e)
+                except ValueError:
+                    pass
+            elif uid in self._waiting:
+                self._waiting.pop(uid)
+            elif uid in self._inflight:
+                return
+            else:
+                return
+            self._stats["bound"] += 1
+            self._resolve_locked(uid, OUTCOME_BOUND)
+            depths = self._depths_locked()
+        self._publish_depths(depths)
 
     # -- triggering ----------------------------------------------------------
 
@@ -360,6 +437,8 @@ class ArrivalGate:
             depths = self._depths_locked()
         for e in expired:
             STREAM_SHED.inc({"lane": LANES[e.lane], "reason": "deadline"})
+            if self._shed_hook is not None:
+                self._shed_hook(LANES[e.lane], "deadline-exceeded", e.uid)
         self._publish_depths(depths)
         return counts
 
@@ -494,8 +573,12 @@ class StreamingLoop:
                  now_fn: Callable[[], float] = time.time,
                  auditor=None, log: Callable = print):
         self.scheduler = scheduler
-        self.gate = ArrivalGate(config, clock=clock)
-        self.cfg = config
+        # the timeline registry's failure fold (obs/timeline.py): every
+        # shed/expired resolution lands in the same rolling stats
+        # surface the survivor percentiles come from
+        _timelines = getattr(scheduler, "timelines", None)
+        shed_hook = getattr(_timelines, "note_shed", None)
+        self.gate = ArrivalGate(config, clock=clock, shed_hook=shed_hook)
         self._apply = apply_fn
         self._delete = delete_fn
         self._clock = clock
@@ -521,6 +604,14 @@ class StreamingLoop:
         self.pipeline = None
         self._hooked_backend = None
         self._prev_flip = self._prev_degraded = None
+        #: HA composition (DESIGN §25): when an elector is attached the
+        #: trigger loop fires rounds only while the lease is held; a
+        #: promoted standby adopts the watch-fed intake + knob state
+        self._elector = None
+        self._prev_started = None
+        #: the SLO controller (koordinator_tpu/control/slo.py): when
+        #: attached, the loop drives its reconcile cadence
+        self._controller = None
         if pipelined:
             from koordinator_tpu.scheduler.pipeline import TickPipeline
 
@@ -571,6 +662,87 @@ class StreamingLoop:
         if timelines is not None and hasattr(timelines, "set_drop_hook"):
             timelines.set_drop_hook(self.gate.note_timeline_drop)
 
+    @property
+    def cfg(self) -> StreamingConfig:
+        """The LIVE trigger/intake config. The gate owns the object —
+        the SLO controller retunes it through :meth:`ArrivalGate.
+        retune` — so the loop reads through rather than caching the
+        construction-time copy."""
+        return self.gate.cfg
+
+    # -- HA composition (lease gate + promotion handoff, DESIGN §25) ---------
+
+    def attach_elector(self, elector) -> None:
+        """Fold the ``--leader-elect`` lease gate into the trigger
+        loop: rounds fire only while ``elector.tick`` reports the
+        lease held; a standby parks (draining deferred pipeline
+        errors) and a promotion adopts the watch-fed intake + the
+        controller's knob state via the chained
+        ``on_started_leading``."""
+        self._elector = elector
+        self._prev_started = elector.on_started_leading
+
+        def _promoted(_prev=self._prev_started):
+            if _prev is not None:
+                _prev()
+            self.on_promoted()
+
+        elector.on_started_leading = _promoted
+
+    def attach_controller(self, controller) -> None:
+        """Attach the SLO controller: the loop drives its reconcile
+        cadence (leader-only under HA) and a promotion adopts the
+        published knob state before the first post-failover round."""
+        self._controller = controller
+
+    def on_promoted(self) -> None:
+        """Lease acquired: inherit the previous leader's convergence
+        (knob state published on the bus) FIRST — the adopted deadlines
+        govern how the swept intake re-arms — then sweep pending pods
+        the watch fed while standby into the gate."""
+        if self._controller is not None:
+            self._controller.on_promoted()
+        self.adopt_intake()
+
+    def adopt_intake(self, now: Optional[float] = None) -> int:
+        """Admit every pending pod the scheduler cache holds that the
+        gate does not already track (idempotent: ``observe`` skips
+        tracked uids, so a watch-fed standby whose gate mirrored every
+        arrival adopts zero). Returns the number adopted."""
+        adopted = 0
+        for pod in list(self.scheduler.cache.pending.values()):
+            if self.gate.tracks(pod.uid):
+                continue
+            self.observe(pod, now=now)
+            adopted += 1
+        return adopted
+
+    def _lease_held(self, now: Optional[float] = None) -> bool:
+        """Tick the lease gate (no elector = always leading). A tick
+        both renews a held lease and attempts acquisition on an
+        expired one — promotion fires inside it."""
+        if self._elector is None:
+            return True
+        return self._elector.tick(
+            self._now_fn() if now is None else now
+        )
+
+    def _standby_step(self) -> None:
+        """Lease held elsewhere: fire nothing, but surface deferred
+        publish-side errors the pipeline may still hold from the
+        rounds fired while leading (run_loop's standby discipline) —
+        a fencing abort forgets assumed-but-unbound pods."""
+        from koordinator_tpu.client.leaderelection import FencingError
+
+        if self.pipeline is None:
+            return
+        try:
+            self.pipeline.drain("standby")
+        except FencingError as e:
+            forgotten = self.scheduler.forget_assumed_unbound()
+            self._log(f"streaming standby: fenced publish surfaced: "
+                      f"{e}; forgot {len(forgotten)} assumed pod(s)")
+
     # -- intake --------------------------------------------------------------
 
     def submit(self, pod, now: Optional[float] = None) -> str:
@@ -600,6 +772,16 @@ class StreamingLoop:
             self._delete(evicted)
         if verdict == "shed" and self._delete is not None:
             self._delete(pod.uid)
+
+    def observe_bound(self, pod) -> None:
+        """A bind for ``pod`` landed on the bus (the wiring's watch
+        routes assigned-pod events here). Resolves a queued/waiting
+        gate entry ``bound`` — the HA standby's accounting: its
+        watch-fed intake mirrors every arrival, and the LEADER's bind
+        must resolve the mirror or the entry would leak unresolved
+        forever. A uid in this loop's own firing round is left to
+        :meth:`ArrivalGate.resolve_round` (exactly-once outcomes)."""
+        self.gate.note_bound(pod.uid)
 
     # -- firing --------------------------------------------------------------
 
@@ -709,7 +891,16 @@ class StreamingLoop:
         """Deterministic single step (fake-clock tests): fire at most
         one round if the trigger is due at ``now``; with ``drain``,
         wait the pipelined round out so outcomes are resolved on
-        return. Returns the trigger reason or None."""
+        return. Returns the trigger reason or None. Under HA the step
+        first ticks the lease gate — a standby pumps nothing (and
+        surfaces deferred publish errors); the tick itself is the
+        acquisition path, so a pump on an expired lease IS the
+        promotion."""
+        if not self._lease_held(now):
+            self._standby_step()
+            return None
+        if self._controller is not None:
+            self._controller.maybe_reconcile(now=now)
         reason = self.due(now)
         if reason is None:
             return None
@@ -735,6 +926,14 @@ class StreamingLoop:
             now = self._clock()
             if monitor is not None:
                 monitor.check_stuck()
+            if not self._lease_held():
+                # standby: hold no rounds, keep the intake watch-fed,
+                # retry on the elector's cadence (wake early on stop)
+                self._standby_step()
+                self._stopped.wait(self._elector.retry_period)
+                continue
+            if self._controller is not None:
+                self._controller.maybe_reconcile(now=now)
             reason = self.due(now)
             if reason is not None:
                 self.fire_round(reason, now=now)
@@ -792,6 +991,11 @@ class StreamingLoop:
         # unchain the remove_pod hook: a re-wired scheduler must not
         # keep forgetting into a stopped loop's gate
         self.scheduler.remove_pod = self._prev_remove
+        # unchain the promotion hook likewise — a later promotion of
+        # this elector must not adopt into a stopped loop
+        if self._elector is not None:
+            self._elector.on_started_leading = self._prev_started
+            self._elector = None
         timelines = getattr(self.scheduler, "timelines", None)
         if timelines is not None and hasattr(timelines, "set_drop_hook"):
             timelines.set_drop_hook(None)
@@ -844,6 +1048,10 @@ class StreamingLoop:
             "last_trigger": last,
             "gate": self.gate.status(),
         }
+        if self._elector is not None:
+            out["leader"] = self._elector.is_leader()
+        if self._controller is not None:
+            out["slo"] = {"decisions": self._controller.decisions_total()}
         timelines = getattr(self.scheduler, "timelines", None)
         if timelines is not None:
             # the headline serving numbers: rolling-window submit→bind

@@ -232,6 +232,45 @@ def gang_wave_trace(seed: int, duration_s: float = 10.0,
     return _finish("gang-wave", seed, duration_s, rate_pods_per_s, rows)
 
 
+#: the convergence scenario's load regimes (DESIGN §25): time-dilation
+#: factors applied to ONE seeded trace — same pods, same order, same
+#: relative shape, 3 sustained-rate points
+REGIMES: Dict[str, float] = {
+    "low": 0.25,
+    "mid": 1.0,
+    "saturating": 4.0,
+}
+
+
+def regime_scale(trace: ArrivalTrace, regime) -> ArrivalTrace:
+    """Replay ONE seeded trace at another load regime without
+    re-deriving seeds: a time-dilation by ``factor`` (a
+    :data:`REGIMES` name or a float) divides every arrival timestamp
+    and the duration by the factor, multiplying the sustained rate —
+    the pod SEQUENCE (names, lanes, sizes, gangs, relative shape) is
+    byte-identical across regimes, so a controller property like
+    "converges at low/mid/saturating" is tested against the same
+    workload, not three different random draws."""
+    factor = REGIMES[regime] if isinstance(regime, str) else float(regime)
+    if factor <= 0:
+        raise ValueError(f"regime factor must be positive: {factor}")
+    label = regime if isinstance(regime, str) else f"x{factor:g}"
+    if factor == 1.0:
+        scaled = trace.arrivals
+    else:
+        scaled = tuple(
+            dataclasses.replace(a, at=a.at / factor)
+            for a in trace.arrivals
+        )
+    return ArrivalTrace(
+        kind=f"{trace.kind}@{label}",
+        seed=trace.seed,
+        duration_s=trace.duration_s / factor,
+        rate_pods_per_s=trace.rate_pods_per_s * factor,
+        arrivals=scaled,
+    )
+
+
 #: generator registry: scenario diversity is data-driven — benches and
 #: tests iterate this instead of hand-picking scenarios
 TRACE_KINDS: Dict[str, object] = {

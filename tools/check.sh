@@ -101,6 +101,20 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_preempt_device
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_chaos.py \
     -q -m chaos -k preemption_storm -p no:cacheprovider
 
+echo "== slo smoke =="
+# the self-tuning serving control plane (ISSUE 18): the pure policy
+# units (breach confirmation + cooldown, the burn-the-ceiling
+# anti-oscillation bound, the watermark ratchet) and a short
+# closed-loop run that must tighten the breaching lane inside its
+# declared p99 target and replay its decision log bit-for-bit from
+# the recorded observation ring; the leader-kill handoff leg (knob +
+# intake adoption, exactly-once binds, bit-identical placements
+# against the crash-free run) rides the chaos marker
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_slo_controller.py \
+    -q -k "smoke or Policy" -p no:cacheprovider
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_slo_controller.py \
+    -q -m chaos -k slo -p no:cacheprovider
+
 echo "== sharded + multi-tenant + warm-pool + streaming bench budgets =="
 # the measured sharded/multi-tenant/warm-pool/streaming legs are
 # budget-gated (ISSUES 10/11/13/14): a scaling, merge-overhead,
