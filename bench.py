@@ -99,7 +99,9 @@ KTPU_BENCH_STORM_NODES / _RPN / _ARRIVALS / _ORACLE_PODS /
 _PLACE / _DRAIN_S reshape it (see bench_preemption_storm),
 KTPU_BENCH_SLO=0 to skip the closed-loop SLO-convergence leg (#20) —
 KTPU_BENCH_SLO_NODES / _SECONDS / _RATE / _TARGET reshape it
-(see bench_slo_convergence).
+(see bench_slo_convergence), and KTPU_BENCH_DENSITY=0 to skip the
+tenant-density degradation leg (#21) — KTPU_BENCH_DENSITY_TENANTS /
+_NODES / _PODS / _ROUNDS reshape it (see bench_tenant_density).
 """
 
 import json
@@ -4147,6 +4149,223 @@ def graftcheck_report():
         return -1, {}
 
 
+def bench_tenant_density(repeats):
+    """Config #21 (ISSUE 19): pods/s vs resident-tenant fraction — the
+    HBM working-set manager's degradation curve (docs/DESIGN.md §26).
+
+    ONE fleet of 16 tenants on the wire-delta protocol (1024-node
+    worlds, 64-pod bursts — leg 16's serving shape), served in-process
+    at three budget lines: every world resident (f100), half resident
+    (f50), a quarter resident (f25). Every arm replays byte-identical
+    round streams, so what the curve measures is purely the ladder tax:
+    demoted tenants restage host-pinned bases through the existing
+    delta/scatter path before each solve. Facets the record gates:
+
+    - **no_cliff**: each halving of the resident fraction costs < 4x
+      throughput (graceful degradation, not a swap storm);
+    - **identical_to_unbudgeted**: every (tenant, round) placement and
+      used_req carry under every budget line is bit-identical to the
+      unbudgeted reference arm — residency is invisible to answers;
+    - **curve**: per-fraction pods/s plus the demotion/restage counts
+      that priced it.
+    """
+    from koordinator_tpu.apis.extension import NUM_RESOURCES, ResourceName
+    from koordinator_tpu.ops.binpack import STAGED_NODE_FIELDS
+    from koordinator_tpu.service.codec import SolveRequest
+    from koordinator_tpu.service.server import (
+        NodeStateCache,
+        solve_from_request,
+    )
+    from koordinator_tpu.state.workingset import WORKING_SET
+
+    n_tenants = int(os.environ.get("KTPU_BENCH_DENSITY_TENANTS", 16))
+    n_nodes = int(os.environ.get("KTPU_BENCH_DENSITY_NODES", 1024))
+    n_pods = int(os.environ.get("KTPU_BENCH_DENSITY_PODS", 64))
+    warmup = 2
+    timed = max(4, int(os.environ.get("KTPU_BENCH_DENSITY_ROUNDS",
+                                      repeats * 2)))
+    rounds = warmup + timed
+
+    def world(tenant_i):
+        rng = np.random.default_rng(1000 + tenant_i)
+        alloc = np.zeros((n_nodes, NUM_RESOURCES), np.int32)
+        alloc[:, ResourceName.CPU] = 64000
+        alloc[:, ResourceName.MEMORY] = 131072
+        used = np.zeros_like(alloc)
+        used[:, ResourceName.CPU] = rng.integers(0, 30000, n_nodes)
+        used[:, ResourceName.MEMORY] = rng.integers(0, 65536, n_nodes)
+        node = {
+            "alloc": alloc, "used_req": used,
+            "usage": np.zeros_like(alloc),
+            "prod_usage": np.zeros_like(alloc),
+            "est_extra": np.zeros_like(alloc),
+            "prod_base": np.zeros_like(alloc),
+            "metric_fresh": np.ones(n_nodes, bool),
+            "schedulable": np.ones(n_nodes, bool),
+        }
+        weights = np.zeros(NUM_RESOURCES, np.int32)
+        weights[ResourceName.CPU] = 1
+        weights[ResourceName.MEMORY] = 1
+        thresholds = np.zeros(NUM_RESOURCES, np.int32)
+        thresholds[ResourceName.CPU] = 65
+        thresholds[ResourceName.MEMORY] = 95
+        params = {
+            "weights": weights, "thresholds": thresholds,
+            "prod_thresholds": np.zeros(NUM_RESOURCES, np.int32),
+        }
+        return node, params
+
+    def tick_pods(tenant_i, r):
+        rng = np.random.default_rng(8_000_000 + tenant_i * 10_000 + r)
+        req_cols = np.zeros((n_pods, NUM_RESOURCES), np.int32)
+        req_cols[:, ResourceName.CPU] = rng.integers(200, 2000, n_pods)
+        req_cols[:, ResourceName.MEMORY] = rng.integers(128, 2048, n_pods)
+        return {
+            "req": req_cols, "est": (req_cols * 85) // 100,
+            "is_prod": np.zeros(n_pods, bool),
+            "is_daemonset": np.zeros(n_pods, bool),
+        }
+
+    def tenant_stream(tenant_i):
+        """(establish, [(delta_request, round)]) — worlds evolve
+        deterministically per (tenant, round) so every arm replays the
+        identical stream."""
+        node, params = world(tenant_i)
+        establish = SolveRequest(
+            node={k: v.copy() for k, v in node.items()}, params=params,
+            pods=tick_pods(tenant_i, 0),
+            node_delta={"epoch": np.asarray(0, np.int64)},
+        )
+        deltas = []
+        for r in range(1, rounds):
+            rng = np.random.default_rng(
+                8_000_000 + tenant_i * 10_000 + r
+            )
+            idx = np.sort(rng.choice(n_nodes, 16, replace=False))
+            node["used_req"][idx, ResourceName.CPU] = rng.integers(
+                0, 40000, idx.size
+            )
+            delta = {
+                "idx": idx.astype(np.int32),
+                "base_epoch": np.asarray(r - 1, np.int64),
+                "epoch": np.asarray(r, np.int64),
+            }
+            delta.update({f: node[f][idx].copy()
+                          for f in STAGED_NODE_FIELDS})
+            deltas.append(SolveRequest(
+                node={}, params=params, pods=tick_pods(tenant_i, r),
+                node_delta=delta,
+            ))
+        return establish, deltas
+
+    streams = [tenant_stream(i) for i in range(n_tenants)]
+
+    def run_arm(budget_worlds, world_bytes):
+        """One serve of every stream under ``budget_worlds`` resident
+        worlds (None = unbudgeted). Returns (pods/s over the timed
+        rounds, per-(tenant, round) answer digests, ladder counts)."""
+        WORKING_SET.reset()
+        if budget_worlds is not None:
+            # half-a-world of slack keeps the line strictly between
+            # K and K+1 resident worlds — no boundary flapping
+            WORKING_SET.set_budget(
+                budget_worlds * world_bytes + world_bytes // 2)
+        caches = [NodeStateCache(tenant=f"d{i}", lane="be")
+                  for i in range(n_tenants)]
+        digests = []
+        try:
+            for i, (establish, _) in enumerate(streams):
+                resp = solve_from_request(establish, node_cache=caches[i])
+                if resp.error:
+                    raise RuntimeError(
+                        f"tenant {i} establish: {resp.error}")
+            demo0 = WORKING_SET.status()
+            t0 = None
+            placed = 0
+            for r in range(rounds - 1):
+                if r == warmup:
+                    demo0 = WORKING_SET.status()
+                    t0 = time.perf_counter()
+                for i, (_, deltas) in enumerate(streams):
+                    resp = solve_from_request(deltas[r],
+                                              node_cache=caches[i])
+                    if resp.error:
+                        raise RuntimeError(
+                            f"tenant {i} round {r + 1}: {resp.error}")
+                    if t0 is not None:
+                        placed += int(
+                            np.sum(np.asarray(resp.assignments) >= 0))
+                        digests.append((
+                            i, r,
+                            int(np.asarray(resp.assignments)
+                                .astype(np.int64).sum()),
+                            hash(np.asarray(resp.assignments)
+                                 .tobytes()),
+                            hash(np.asarray(resp.node_used_req)
+                                 .tobytes()),
+                        ))
+            wall = time.perf_counter() - t0
+            st = WORKING_SET.status()
+            ladder = {
+                "restages": sum(st["restages"].values())
+                - sum(demo0["restages"].values()),
+                "demotions": sum(st["demotions"].values())
+                - sum(demo0["demotions"].values()),
+                "resident_device": st["residents"]["device"],
+            }
+            return placed / wall if wall > 0 else 0.0, digests, ladder
+        finally:
+            for cache in caches:
+                cache.close()
+            WORKING_SET.reset()
+
+    # price one staged world off a probe establish (budgets are set in
+    # world units so the leg survives shape-env reconfiguration)
+    probe = NodeStateCache(tenant="density-probe")
+    resp = solve_from_request(streams[0][0], node_cache=probe)
+    if resp.error:
+        raise RuntimeError(f"density probe: {resp.error}")
+    world_bytes = probe.device_bytes()
+    probe.close()
+
+    reference, ref_digests, _ = run_arm(None, world_bytes)
+    fractions = {
+        "f100": n_tenants,
+        "f50": max(1, n_tenants // 2),
+        "f25": max(1, n_tenants // 4),
+    }
+    curve = {}
+    identical = True
+    for name, budget_worlds in fractions.items():
+        pods_per_sec, digests, ladder = run_arm(budget_worlds, world_bytes)
+        identical = identical and digests == ref_digests
+        curve[name] = {
+            "pods_per_sec": pods_per_sec,
+            "resident_worlds": budget_worlds,
+            **ladder,
+        }
+    # the no-cliff flag: each halving of the resident fraction costs
+    # < 4x throughput (restage is a transfer, not a recompile)
+    halving_costs = [
+        curve["f100"]["pods_per_sec"] / max(curve["f50"]["pods_per_sec"],
+                                            1e-9),
+        curve["f50"]["pods_per_sec"] / max(curve["f25"]["pods_per_sec"],
+                                           1e-9),
+    ]
+    return {
+        "n_tenants": n_tenants,
+        "n_nodes": n_nodes,
+        "n_pods": n_pods,
+        "timed_rounds": timed,
+        "world_bytes": int(world_bytes),
+        "unbudgeted_pods_per_sec": reference,
+        "curve": curve,
+        "max_halving_cost": max(halving_costs),
+        "no_cliff": all(c < 4.0 for c in halving_costs),
+        "identical_to_unbudgeted": identical,
+    }
+
+
 def main():
     # persist compiled programs: every solver start after the first
     # warms from disk (measured by the warm_start entry below)
@@ -4296,6 +4515,12 @@ def main():
         # vcpu record rounds still gate the control plane
         matrix["20_slo_convergence"] = leg(
             bench_slo_convergence, repeats
+        )
+    if os.environ.get("KTPU_BENCH_DENSITY", "1") != "0":
+        # the working-set degradation curve (#21, ISSUE 19): pods/s vs
+        # resident-tenant fraction under the HBM budget
+        matrix["21_tenant_density"] = leg(
+            bench_tenant_density, repeats
         )
     if os.environ.get("KTPU_BENCH_WARMPROBE", "1") != "0":
         matrix["warm_start"] = leg(bench_warm_start)

@@ -57,6 +57,10 @@ HOT_MODULES = (
     "koordinator_tpu/models/placement.py",
     "koordinator_tpu/ops/*.py",
     "koordinator_tpu/state/cluster.py",
+    # the HBM working-set manager (DESIGN §26): touch/admit/enforce run
+    # inside every staging and scatter — pure ledger arithmetic by
+    # contract, so any device op or host sync here is a bug
+    "koordinator_tpu/state/workingset.py",
     "koordinator_tpu/service/server.py",
     "koordinator_tpu/service/admission.py",
     # the multi-tenant pool (DESIGN §20): its lane staging + dispatch
@@ -112,6 +116,27 @@ LOCK_SPECS = (
             "arrays", "state", "tracker", "seen_epoch", "epoch",
             "last_delta", "last_path", "last_now", "_pinned",
             "_wire_delta",
+        ),
+        # the working-set demote hooks (DESIGN §26) hold the SAME lock
+        # via non-blocking acquire/try/finally — `with` would block,
+        # and the contract is "a busy cache refuses, never stalls"
+        exempt_methods=("__init__", "demote_device", "demote_cold"),
+    ),
+    # the HBM working-set ledger (DESIGN §26): touched from every
+    # staging call site and the chaos saboteur; the `*_locked` helpers
+    # are only entered under the lock (the SLO controller's
+    # _step_locked precedent)
+    LockSpec(
+        path="koordinator_tpu/state/workingset.py",
+        class_name="WorkingSetManager",
+        lock="_lock",
+        attrs=(
+            "_residents", "_budget", "_squeeze", "_clock", "_auto",
+            "_seq", "_events", "_counts", "_faults", "_oversubscribed",
+        ),
+        exempt_methods=(
+            "__init__", "_used_locked", "_effective_budget_locked",
+            "_count_locked", "_event_locked", "_publish_locked",
         ),
     ),
     # the pipelined tick loop's state machine: the coordinator thread
@@ -639,7 +664,14 @@ LABEL_DOMAINS = {
         "stale-host", "version-skew",
         "capacity", "timeline-capacity", "deadline",
         "overloaded",
+        # HBM working-set outcomes (state/workingset.py, DESIGN §26):
+        # demotion causes, restage source rungs, alloc-fail boundaries
+        "admission", "budget", "alloc-failure",
+        "host", "cold",
+        "stage", "scatter",
     )),
+    # the working-set residency census gauge (DESIGN §26)
+    "rung": LabelDomain(kind="enum", values=("device", "host", "cold")),
     "direction": LabelDomain(kind="enum",
                              values=("to-degraded", "to-remote")),
     "mode": LabelDomain(kind="enum", values=(
@@ -687,7 +719,8 @@ LABEL_DOMAINS = {
 
 METRICS_SPEC = MetricsSpec(
     components_path="koordinator_tpu/metrics/components.py",
-    registries=("SCHEDULER_METRICS", "DEVICE_METRICS", "SOLVER_METRICS"),
+    registries=("SCHEDULER_METRICS", "DEVICE_METRICS", "SOLVER_METRICS",
+                "WORKINGSET_METRICS"),
     label_domains=LABEL_DOMAINS,
 )
 

@@ -236,9 +236,14 @@ class DonationRule:
                         module, qualname, fn, stmt_path, stmt, call,
                         arg, chain, out,
                     )
+                    pin_target = chain
+                    if "." not in chain:
+                        aliased = _resolve_alias(stmt_path, stmt, chain)
+                        if aliased is not None:
+                            pin_target = aliased
                     self._check_pin_guard(
                         module, qualname, class_name, fn, call, arg,
-                        chain, out,
+                        pin_target, out,
                     )
 
         _walk_functions(module.tree, [], None, visit_fn)
@@ -457,6 +462,32 @@ class DonationRule:
             return False
 
         return search(fn)
+
+
+def _resolve_alias(stmt_path: List[List[ast.stmt]], stmt: ast.stmt,
+                   chain: str) -> Optional[str]:
+    """The self-attr a bare donated name was just bound from: ``cur =
+    self.state`` dominating the donation in the same block makes
+    ``cur`` an alias of ``self.state``. The pin protocol follows the
+    GENERATION, not the binding — the staging wrappers capture a local
+    precisely so their dispatch closures never read ``self`` state,
+    and without this resolution that capture would hide the PR 11
+    unguarded-donation shape from the rule."""
+    block = stmt_path[-1]
+    anchor = block.index(_containing(block, stmt))
+    for earlier in reversed(block[:anchor]):
+        if isinstance(earlier, ast.Assign) \
+                and len(earlier.targets) == 1 \
+                and isinstance(earlier.targets[0], ast.Name) \
+                and earlier.targets[0].id == chain:
+            value_chain = attr_chain(earlier.value)
+            if value_chain is not None \
+                    and value_chain.startswith("self."):
+                return value_chain
+            return None
+        if _kills(earlier, chain):
+            return None
+    return None
 
 
 def _last_seg(func: ast.AST) -> str:

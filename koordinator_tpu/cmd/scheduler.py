@@ -144,6 +144,11 @@ class SchedulerConfig:
     #: KTPU_COMPILATION_CACHE_DIR store (inert when that is empty);
     #: single-device processes only (AOT executables pin placement)
     warm_pool: bool = True
+    #: HBM working-set budget (state/workingset.py, DESIGN §26): the
+    #: byte line every staged tenant world is governed under — under
+    #: pressure the least-valuable worlds demote host-pinned/cold
+    #: instead of the process allocating past the line; 0 = unlimited
+    hbm_budget_bytes: int = 0
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -275,6 +280,14 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
         FLIGHT.configure(dump_dir=config.flight_dir)
     if config.profile_dir is not None:
         DEVICE_OBS.configure(profile_dir=config.profile_dir)
+    # the HBM working-set ledger (DESIGN §26): budget applied before
+    # the first staging, the residency/demotion census on the debug
+    # mux beside the other per-subsystem status services
+    from koordinator_tpu.state.workingset import WORKING_SET
+
+    if config.hbm_budget_bytes:
+        WORKING_SET.set_budget(config.hbm_budget_bytes)
+    scheduler.services.register("workingset", WORKING_SET.status)
     return scheduler
 
 
@@ -745,6 +758,13 @@ def main(argv=None) -> int:
              "(hysteresis: at most one knob adjustment per cooldown)",
     )
     parser.add_argument(
+        "--hbm-budget-bytes", type=int, default=0,
+        help="device-memory line for staged tenant worlds "
+             "(docs/DESIGN.md §26): under pressure the least-valuable "
+             "staged bases demote host-pinned/cold instead of the "
+             "process allocating past the line; 0 = unlimited",
+    )
+    parser.add_argument(
         "--cluster-json", default=None,
         help="seed the bus from a cluster-spec JSON file",
     )
@@ -860,6 +880,7 @@ def main(argv=None) -> int:
         slo_be=args.slo_be,
         slo_window_s=args.slo_window,
         slo_cooldown_s=args.slo_cooldown,
+        hbm_budget_bytes=args.hbm_budget_bytes,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler
@@ -965,7 +986,10 @@ def main(argv=None) -> int:
                 )
             from koordinator_tpu.metrics.registry import MergedGatherer
             from koordinator_tpu.obs.device import DEVICE_OBS
-            from koordinator_tpu.metrics.components import DEVICE_METRICS
+            from koordinator_tpu.metrics.components import (
+                DEVICE_METRICS,
+                WORKINGSET_METRICS,
+            )
             from koordinator_tpu.obs.explain import PlacementExplainer
 
             scheduler.services.register("flight-recorder", FLIGHT.status)
@@ -984,7 +1008,7 @@ def main(argv=None) -> int:
             http_server = DebugHTTPServer(
                 services=scheduler.services, debug=scheduler.debug,
                 metrics=MergedGatherer(
-                    [SCHEDULER_METRICS, DEVICE_METRICS]
+                    [SCHEDULER_METRICS, DEVICE_METRICS, WORKINGSET_METRICS]
                 ),
                 port=args.debug_port,
                 tracer=TRACER,

@@ -63,6 +63,13 @@ def main(argv=None) -> int:
         help="disable the AOT warm pool: a respawned sidecar then "
              "pays the cold trace + compile on its first solve again",
     )
+    parser.add_argument(
+        "--hbm-budget-bytes", type=int, default=0,
+        help="device-memory line for staged tenant worlds "
+             "(docs/DESIGN.md §26): staying under it demotes "
+             "least-valuable bases host-pinned/cold instead of "
+             "allocating past it; 0 = unlimited",
+    )
     args = parser.parse_args(argv)
 
     # before the first jit: a restarted sidecar deserializes its
@@ -98,6 +105,11 @@ def main(argv=None) -> int:
 
     from koordinator_tpu.service.server import PlacementService
 
+    if args.hbm_budget_bytes:
+        from koordinator_tpu.state.workingset import WORKING_SET
+
+        WORKING_SET.set_budget(args.hbm_budget_bytes)
+
     secret: Optional[bytes] = None
     if args.secret_file:
         with open(args.secret_file, "rb") as f:
@@ -118,6 +130,7 @@ def main(argv=None) -> int:
         from koordinator_tpu.metrics.components import (
             DEVICE_METRICS,
             SOLVER_METRICS,
+            WORKINGSET_METRICS,
         )
         from koordinator_tpu.metrics.registry import MergedGatherer
         from koordinator_tpu.obs.trace import TRACER
@@ -135,13 +148,20 @@ def main(argv=None) -> int:
         services.register("solver", service.status)
         services.register("trace", TRACER.status)
         services.register("device-observatory", DEVICE_OBS.status)
+        # the HBM working-set ledger (§26): budget/rung census, who got
+        # demoted and why, beside the gate and breaker state
+        from koordinator_tpu.state.workingset import WORKING_SET
+
+        services.register("workingset", WORKING_SET.status)
         if warm_pool is not None:
             # warm-pool health beside the breaker/gate state: did this
             # respawn skip its compiles, is the store clean (§21)
             services.register("warm-pool", warm_pool.status)
         debug_server = DebugHTTPServer(
             services=services,
-            metrics=MergedGatherer([SOLVER_METRICS, DEVICE_METRICS]),
+            metrics=MergedGatherer(
+                [SOLVER_METRICS, DEVICE_METRICS, WORKINGSET_METRICS]
+            ),
             tracer=TRACER, port=args.debug_port,
             device=DEVICE_OBS.debug_payload,
             profile=DEVICE_OBS.request_profile,

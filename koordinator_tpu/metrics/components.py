@@ -255,6 +255,58 @@ SLO_LANE_P99_RATIO = SCHEDULER_METRICS.gauge(
     label_names=("lane",),  # system | ls | be
 )
 
+# -- HBM working-set manager (state/workingset.py) --------------------------
+# The device-memory budget over staged tenant worlds (docs/DESIGN.md
+# §26): a fixed byte line, per-rung residency counts, and typed counters
+# for every demotion, restage, and allocation failure — so memory
+# pressure reads as a measured degradation curve on a dashboard, never
+# as an unexplained crash or latency cliff. Own registry for the same
+# reason as the device observatory below: the ledger lives in whichever
+# long-lived process stages worlds — the in-process scheduler AND the
+# multi-tenant solver sidecar — so both muxes merge it.
+
+WORKINGSET_METRICS = Registry("hbm-workingset")
+HBM_BUDGET_BYTES = WORKINGSET_METRICS.gauge(
+    "scheduler_hbm_budget_bytes",
+    "Configured HBM budget for staged tenant worlds (0 = unlimited; "
+    "the working-set manager demotes victims instead of staging past "
+    "this line)",
+)
+HBM_USED_BYTES = WORKINGSET_METRICS.gauge(
+    "scheduler_hbm_used_bytes",
+    "Metadata-summed bytes of device-resident staged worlds currently "
+    "charged against the HBM budget",
+)
+TENANT_RESIDENCY = WORKINGSET_METRICS.gauge(
+    "scheduler_tenant_residency",
+    "Registered staged worlds per residency rung of the eviction "
+    "ladder (device-resident, host-pinned, cold)",
+    label_names=("rung",),  # device | host | cold
+)
+WORKINGSET_DEMOTIONS = WORKINGSET_METRICS.counter(
+    "scheduler_workingset_demotions_total",
+    "Residency demotions (one rung each) applied by the working-set "
+    "manager, by cause: headroom for a new/regrown world (admission), "
+    "over the budget line after a touch or squeeze (budget), or the "
+    "allocation-failure retry ladder (alloc-failure)",
+    label_names=("reason",),  # admission | budget | alloc-failure
+)
+WORKINGSET_RESTAGES = WORKINGSET_METRICS.counter(
+    "scheduler_workingset_restages_total",
+    "Demoted worlds re-staged onto the device on their next solve, by "
+    "the rung they came back from (host = re-upload of the kept host "
+    "arrays; cold = full re-lower from typed truth)",
+    label_names=("reason",),  # host | cold
+)
+WORKINGSET_ALLOC_FAILURES = WORKINGSET_METRICS.counter(
+    "scheduler_workingset_alloc_failures_total",
+    "Device allocation failures (real RESOURCE_EXHAUSTED or injected) "
+    "caught at the staging boundary, by which boundary raised: a full "
+    "world staging (stage) or a delta row scatter (scatter); each is "
+    "followed by demotion + bounded retry, never an unhandled crash",
+    label_names=("reason",),  # stage | scatter
+)
+
 # -- device-cost observatory (koordinator_tpu/obs/device.py) ----------------
 # The device-side twin of the trace fabric: compile telemetry, padding
 # waste, and live-buffer accounting. These live in their OWN registry

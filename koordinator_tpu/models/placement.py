@@ -69,6 +69,7 @@ from koordinator_tpu.state.cluster import (
     lower_pending_pods,
     lower_resident_pods,
 )
+from koordinator_tpu.state.workingset import WORKING_SET
 
 
 def measure_host_fallback_cells(
@@ -348,6 +349,19 @@ def merge_staging_deltas(prev: Optional[NodeStagingDelta],
     )
 
 
+def _staged_estimate(arrays: Optional[NodeArrays]) -> int:
+    """Bytes about to land on device for a staging of ``arrays`` — the
+    working-set admission estimate (host metadata sum over the staged
+    columns; sharding pads a little past this, which the post-stage
+    repricing via ``device_bytes()`` trues up)."""
+    if arrays is None:
+        return 0
+    return int(sum(
+        getattr(arrays, f).nbytes for f in STAGED_NODE_FIELDS
+        if getattr(arrays, f, None) is not None
+    ))
+
+
 class StagedStateCache:
     """Device-resident cluster state reused across ``schedule()`` calls.
 
@@ -425,6 +439,12 @@ class StagedStateCache:
         # above is mapped to this lock in graftcheck's lock-discipline
         # registry.
         self._lock = threading.Lock()
+        #: the HBM working-set registration (docs/DESIGN.md §26): the
+        #: in-process staged cluster rides the system lane — it demotes
+        #: LAST, after every tenant world, mirroring the shed order
+        self._ws_key = WORKING_SET.register_auto(
+            "staged", self, tenant="_model", lane="system"
+        )
 
     def ensure(self, snapshot: ClusterSnapshot, want_device: bool = True
                ) -> Tuple[NodeArrays, Optional[NodeState],
@@ -443,6 +463,19 @@ class StagedStateCache:
         restage anyway — a NodeState carrying NUMA inventories — skip
         the device scatter entirely; the device half is re-established
         from the current host arrays the next time it is wanted."""
+        out = self._ensure(snapshot, want_device)
+        # residency touch AFTER the cache lock released: the manager
+        # reprices via device_bytes() (which takes the lock) and may
+        # demote OTHER residents over the line; this cache is the
+        # protected key and a mid-solve victim is skipped by the
+        # non-blocking demote hooks below
+        WORKING_SET.touch(self._ws_key)
+        return out
+
+    def _ensure(self, snapshot: ClusterSnapshot, want_device: bool
+                ) -> Tuple[NodeArrays, Optional[NodeState],
+                           Dict[str, float],
+                           Tuple[int, Optional[NodeStagingDelta]]]:
         with self._lock:
             tracker = getattr(snapshot, "delta_tracker", None)
             # sync point: the epoch captured when the snapshot was TAKEN
@@ -498,14 +531,29 @@ class StagedStateCache:
                                 # 10, one generation-sized copy per
                                 # tick is the safe price until a fixed
                                 # jax lets sharded donation back in.
-                                self.state = scatter_node_rows_copied(
-                                    self.state, jnp.asarray(sidx), srows
+                                cur = self.state
+                                self.state = WORKING_SET.run_staged(
+                                    self._ws_key, "scatter",
+                                    lambda: scatter_node_rows_copied(
+                                        cur, jnp.asarray(sidx), srows,
+                                    ),
                                 )
                             else:
                                 # single-device, unpinned: the PR 6
-                                # donating fast path
-                                self.state = scatter_node_rows_donated(
-                                    self.state, jnp.asarray(sidx), srows
+                                # donating fast path. NOTE on the retry
+                                # contract: an INJECTED alloc failure
+                                # raises before the callable runs, so
+                                # its retry re-invokes a never-executed
+                                # donation; a real mid-execution OOM on
+                                # the donated path falls through to the
+                                # typed-error boundary instead of
+                                # retrying a consumed buffer.
+                                cur = self.state
+                                self.state = WORKING_SET.run_staged(
+                                    self._ws_key, "scatter",
+                                    lambda: scatter_node_rows_donated(
+                                        cur, jnp.asarray(sidx), srows,
+                                    ),
                                 )
                             jax.block_until_ready(self.state)
                         else:
@@ -524,8 +572,16 @@ class StagedStateCache:
                     if want_device and self.state is None:
                         # re-establish the device half from the current
                         # host arrays (content unchanged — the sidecar
-                        # epoch does not move)
-                        self.state = self.model.stage_nodes(self.arrays)
+                        # epoch does not move). This is ALSO the
+                        # host-rung restage path of the working-set
+                        # ladder: a demoted world comes back through
+                        # here, headroom admitted first.
+                        host_arrays = self.arrays
+                        self.state = WORKING_SET.run_staged(
+                            self._ws_key, "stage",
+                            lambda: self.model.stage_nodes(host_arrays),
+                            estimate=_staged_estimate(host_arrays),
+                        )
                         jax.block_until_ready(self.state)
                     self.last_path = "delta"
                     return self.arrays, self.state, {
@@ -539,7 +595,13 @@ class StagedStateCache:
             t1 = time.perf_counter()
             state = None
             if want_device:
-                state = self.model.stage_nodes(arrays)
+                # the cold-rung restage path: re-lowered from typed
+                # truth above, staged under the admission contract here
+                state = WORKING_SET.run_staged(
+                    self._ws_key, "stage",
+                    lambda: self.model.stage_nodes(arrays),
+                    estimate=_staged_estimate(arrays),
+                )
                 jax.block_until_ready(state)
             self.arrays = arrays
             self.state = state
@@ -601,6 +663,51 @@ class StagedStateCache:
         with self._lock:
             if self._pinned is state:
                 self._pinned = None
+
+    def demote_device(self) -> bool:
+        """Working-set ladder rung 1 (device → host): drop the staged
+        device generation, keep the host arrays, tracker, and epoch —
+        the next ensure() re-establishes the device half from the kept
+        host state through the EXISTING staging path, bit-identical,
+        without moving the sidecar epoch. Non-blocking by contract: a
+        cache mid-solve (lock held) or with a pinned in-flight
+        generation refuses (returns False) rather than waiting — the
+        manager skips busy victims instead of stalling a solve."""
+        if not self._lock.acquire(blocking=False):
+            return False
+        try:
+            if self.state is None or self._pinned is not None:
+                return False
+            self.state = None
+            return True
+        finally:
+            self._lock.release()
+
+    def demote_cold(self) -> bool:
+        """Working-set ladder rung 2 (host → cold): drop the host half
+        too — the next ensure() re-lowers from typed truth via
+        ``lower_nodes`` (the full path, parity-registered helpers, so
+        placements stay bit-identical). The epoch stays monotone, same
+        as :meth:`invalidate`, so a sidecar can never confuse a
+        pre-demotion base with a post-restage one."""
+        if not self._lock.acquire(blocking=False):
+            return False
+        try:
+            if self._pinned is not None:
+                return False
+            if self.arrays is None and self.state is None:
+                return False
+            self.arrays = None
+            self.state = None
+            self.tracker = None
+            self.seen_epoch = -1
+            self.last_delta = None
+            self.last_path = None
+            self.last_now = None
+            self._wire_delta = None
+            return True
+        finally:
+            self._lock.release()
 
     def device_bytes(self) -> int:
         """Metadata-summed bytes of the staged device generations this

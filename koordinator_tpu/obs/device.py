@@ -356,6 +356,11 @@ class DeviceObservatory:
         self._padding: Dict[str, Dict] = {}
         #: owner name -> callable() -> bytes (live-buffer attribution)
         self._owners: Dict[str, object] = {}
+        #: the HBM working-set manager's pressure view (budget line,
+        #: charged bytes, per-rung census) — stamped into live_snapshot
+        #: so status/debug/flight device payloads answer "how close to
+        #: the line are we" beside the live-buffer attribution
+        self._pressure_source: Optional[object] = None
         self._seq = 0
         self._compiles_total = 0
         self._xla_compiles = 0
@@ -416,6 +421,14 @@ class DeviceObservatory:
         per name wins."""
         with self._lock:
             self._owners[name] = nbytes_fn
+
+    def set_pressure_source(self, fn) -> None:
+        """Register the working-set manager's pressure view (a cheap
+        zero-arg callable returning budget/used/residency) — carried in
+        :meth:`live_snapshot` so every device payload shows memory
+        pressure next to what is live. Last registration wins."""
+        with self._lock:
+            self._pressure_source = fn
 
     # -- compile telemetry ---------------------------------------------------
 
@@ -584,6 +597,7 @@ class DeviceObservatory:
             return {"error": f"{type(e).__name__}: {e}"}
         with self._lock:
             owners = dict(self._owners)
+            pressure = self._pressure_source
         by_owner = {}
         for name, fn in owners.items():
             try:
@@ -592,7 +606,15 @@ class DeviceObservatory:
                 by_owner[name] = f"{type(e).__name__}: {e}"
         DEVICE_LIVE_BUFFERS.set(count)
         DEVICE_LIVE_BYTES.set(total)
-        return {"count": count, "bytes": total, "owners": by_owner}
+        out = {"count": count, "bytes": total, "owners": by_owner}
+        if pressure is not None:
+            try:
+                out["workingset"] = pressure()
+            except Exception as e:
+                out["workingset"] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
+        return out
 
     # -- profiler windows ----------------------------------------------------
 

@@ -50,7 +50,13 @@ from koordinator_tpu.obs.device import DEVICE_OBS
 from koordinator_tpu.obs.trace import TRACER
 from koordinator_tpu.ops.gang import GangState
 from koordinator_tpu.ops.quota import QuotaState
-from koordinator_tpu.service.admission import AdmissionConfig, AdmissionGate
+from koordinator_tpu.service.admission import (
+    LANE_NAMES,
+    AdmissionConfig,
+    AdmissionGate,
+    request_lane,
+)
+from koordinator_tpu.state.workingset import WORKING_SET
 from koordinator_tpu.service.codec import (
     SolveRequest,
     SolveResponse,
@@ -361,12 +367,59 @@ class NodeStateCache:
     multiplexing front-ends over one connection can never cross one
     tenant's delta into another tenant's base — a base/epoch mismatch
     stays a per-tenant ``delta-base-mismatch``, never silent
-    cross-tenant state bleed."""
+    cross-tenant state bleed.
 
-    def __init__(self):
+    Every cache is a working-set resident (DESIGN §26): the staged
+    device world it pins is priced against the HBM budget. Under
+    pressure the manager demotes it host-pinned (``state`` dropped,
+    ``host`` kept — the next delta restages through ``apply``) or cold
+    (``host`` dropped too — the next delta gets the typed
+    ``delta-base-mismatch`` and the client re-establishes, the
+    protocol's existing self-heal). Both rungs rebuild the exact rows
+    the resident carried, so placements stay bit-identical across the
+    ladder by construction."""
+
+    def __init__(self, tenant: str = "default", lane: str = "ls",
+                 weight: float = 1.0):
         self.host: Optional[Dict[str, np.ndarray]] = None
         self.state: Optional[NodeState] = None
         self.epoch: Optional[int] = None
+        self._ws_key = WORKING_SET.register_auto(
+            "base", self, tenant=tenant, lane=lane, weight=weight
+        )
+
+    def device_bytes(self) -> int:
+        """Live HBM held by the staged base (the working-set price)."""
+        state = self.state
+        if state is None:
+            return 0
+        return int(sum(
+            getattr(state, f).nbytes for f in STAGED_NODE_FIELDS
+            if getattr(state, f, None) is not None
+        ))
+
+    def demote_device(self) -> bool:
+        """→ host-pinned: drop the device world, keep the host rows.
+
+        Lock-free on purpose: ``host`` is authoritative and patched
+        before every scatter, so a demotion racing ``apply`` at worst
+        drops a generation the next delta restages bit-identically."""
+        if self.state is None:
+            return False
+        self.state = None
+        return True
+
+    def demote_cold(self) -> bool:
+        """→ cold: drop host too. The epoch survives so the client's
+        next delta fails the base fence (typed mismatch → re-send)."""
+        if self.host is None and self.state is None:
+            return False
+        self.host = None
+        self.state = None
+        return True
+
+    def close(self) -> None:
+        WORKING_SET.drop(self._ws_key)
 
     def establish(self, node_group, state: NodeState, epoch: int) -> None:
         self.host = {
@@ -375,8 +428,26 @@ class NodeStateCache:
         }
         self.state = state
         self.epoch = epoch
+        WORKING_SET.touch(self._ws_key)
 
     def apply(self, delta) -> NodeState:
+        if self.state is None:
+            # host-pinned rung: the device world was demoted under
+            # budget pressure but the host rows are authoritative —
+            # restage them through the manager (admission headroom
+            # first, typed alloc-failure ladder on failure) before
+            # patching. Same rows the resident carried, so the solve
+            # downstream is bit-identical to never-demoted.
+            host = self.host
+            self.state = WORKING_SET.run_staged(
+                self._ws_key, "stage",
+                lambda: NodeState(**{
+                    f: jnp.asarray(host[f]) for f in STAGED_NODE_FIELDS
+                }),
+                estimate=int(sum(
+                    host[f].nbytes for f in STAGED_NODE_FIELDS
+                )),
+            )
         idx = np.asarray(delta["idx"], np.int32)
         if idx.size:
             rows = {f: np.asarray(delta[f]) for f in STAGED_NODE_FIELDS}
@@ -396,8 +467,17 @@ class NodeStateCache:
                 scatter_node_rows_donated
                 if len(jax.devices()) == 1 else scatter_node_rows_copied
             )
-            self.state = scatter(self.state, jnp.asarray(sidx), srows)
+            # the scatter allocates the new generation's row buffers —
+            # the second alloc-failure boundary. Injected faults raise
+            # BEFORE the callable runs (workingset contract), so the
+            # post-demotion retry executes the scatter exactly once.
+            base = self.state
+            self.state = WORKING_SET.run_staged(
+                self._ws_key, "scatter",
+                lambda: scatter(base, jnp.asarray(sidx), srows),
+            )
         self.epoch = int(np.asarray(delta["epoch"]).item())
+        WORKING_SET.touch(self._ws_key)
         return self.state
 
 
@@ -438,9 +518,12 @@ def solve_from_request(req: SolveRequest,
         node_host = req.node
         if delta is not None and "idx" in delta:
             base = int(np.asarray(delta["base_epoch"]).item())
+            # host-pinned bases (state demoted, host kept — DESIGN §26)
+            # stay delta-eligible: apply() restages from host. Only a
+            # COLD base (host gone too) forces the typed mismatch.
             if (
                 node_cache is None
-                or node_cache.state is None
+                or node_cache.host is None
                 or node_cache.epoch != base
             ):
                 have = None if node_cache is None else node_cache.epoch
@@ -454,11 +537,24 @@ def solve_from_request(req: SolveRequest,
             state = node_cache.apply(delta)
             node_host = node_cache.host
         else:
-            state = NodeState(
+            stage = lambda: NodeState(
                 **{f: jnp.asarray(req.node[f]) for f in NODE_FIELDS},
                 **{f: jnp.asarray(req.node[f])
                    for f in ("numa_cap", "numa_free") if f in req.node},
             )
+            if node_cache is not None:
+                # full staging is the first alloc boundary: admission
+                # headroom (estimate) runs before the upload, and a
+                # real/injected RESOURCE_EXHAUSTED rides the typed
+                # demote→retry ladder instead of crashing the solve
+                state = WORKING_SET.run_staged(
+                    node_cache._ws_key, "stage", stage,
+                    estimate=int(sum(
+                        np.asarray(req.node[f]).nbytes for f in NODE_FIELDS
+                    )),
+                )
+            else:
+                state = stage()
             if (
                 delta is not None
                 and "epoch" in delta
@@ -582,11 +678,23 @@ class _Handler(socketserver.BaseRequestHandler):
                     tenant = request_tenant(request)
                     node_cache = node_caches.pop(tenant, None)
                     if node_cache is None:
-                        node_cache = NodeStateCache()
+                        # the working-set ledger (DESIGN §26) wants the
+                        # QoS lane and fair-share weight at admission
+                        # time: BE tenants demote first, heavier
+                        # tenants last
+                        gate = self.server.admission_gate
+                        node_cache = NodeStateCache(
+                            tenant=tenant,
+                            lane=LANE_NAMES[request_lane(request)],
+                            weight=(1.0 if gate is None
+                                    else gate.tenants.weight(tenant)),
+                        )
                         while len(node_caches) >= MAX_CONNECTION_TENANTS:
                             # least-recently-used tenant's base evicted
                             # (dict order IS recency: hits re-insert)
-                            node_caches.pop(next(iter(node_caches)))
+                            node_caches.pop(
+                                next(iter(node_caches))
+                            ).close()
                     node_caches[tenant] = node_cache
                     gate = self.server.admission_gate
                     if gate is None:
@@ -611,6 +719,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     if entry is not None:
                         entry.delivered()
         finally:
+            # drop the connection's working-set registrations — a gone
+            # peer's staged bases must stop pinning the HBM budget
+            for cache in node_caches.values():
+                cache.close()
             self.server.active_connections.discard(self.request)
             stream.close()
 
@@ -744,6 +856,11 @@ class PlacementService:
             # reprieve / eviction counts and defrag drains, read from
             # the scheduler registry the control plane shares
             "preemption": _preemption_status(),
+            # HBM working-set ledger (DESIGN §26): budget, per-rung
+            # residency, demotion/restage/alloc-failure counters and
+            # the top residents by bytes — pressure is attributable
+            # from this one endpoint
+            "workingset": WORKING_SET.status(),
         }
 
     def stop(self) -> None:

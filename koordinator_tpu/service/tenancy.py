@@ -283,9 +283,12 @@ def delta_shape_key(req: SolveRequest, node_cache) -> Optional[bytes]:
     between this check and the dispatch that applies the patch."""
     if not delta_request(req):
         return None
+    # state is deliberately NOT required: a host-pinned base (device
+    # world demoted under HBM pressure, DESIGN §26) still lane-batches
+    # — apply() restages it from host before the stack. Only a cold
+    # base (host gone) rides solo for the typed mismatch.
     if (
         node_cache is None
-        or node_cache.state is None
         or node_cache.host is None
         or node_cache.epoch is None
     ):
@@ -688,15 +691,46 @@ def solve_entry_lanes(entries, config=None) -> List[SolveResponse]:
     8-device sync barrier — the pool round ballooned 2-5x. The
     two-step shape below — per-cache scatter, then stack — keeps the
     staged bases single-device and measured fastest.)"""
+    from koordinator_tpu.state.workingset import WorkingSetExhausted
+
     pairs = []
-    for e in entries:
+    slots: List[Optional[int]] = []  # entry index -> pairs index
+    failed: Dict[int, SolveResponse] = {}
+    for i, e in enumerate(entries):
         req = e.request
         state = None
         if delta_request(req):
-            # eligibility (base present, epoch match) was established
-            # at submit time and cannot have changed: only this
-            # executor thread mutates caches, one request per
-            # connection is in flight
-            state = e.node_cache.apply(req.node_delta)
+            # epoch eligibility was established at submit time and
+            # cannot have changed (only this executor thread mutates
+            # caches, one request per connection in flight) — but the
+            # RESIDENCY can have: an earlier entry's restage in this
+            # very loop may have demoted this base under HBM pressure
+            # (DESIGN §26). A cold base or an exhausted budget costs
+            # THIS entry a typed error, never the co-batched lanes.
+            cache = e.node_cache
+            if cache is None or cache.host is None:
+                failed[i] = SolveResponse(
+                    assignments=np.empty(0, np.int32),
+                    error=(
+                        "delta-base-mismatch: base demoted cold under "
+                        "memory pressure, re-establish"
+                    ),
+                )
+                slots.append(None)
+                continue
+            try:
+                state = cache.apply(req.node_delta)
+            except WorkingSetExhausted as exc:
+                failed[i] = SolveResponse(
+                    assignments=np.empty(0, np.int32),
+                    error=f"overloaded: {exc}",
+                )
+                slots.append(None)
+                continue
+        slots.append(len(pairs))
         pairs.append((req, state))
-    return _solve_lanes(pairs, config, want_state=False)
+    solved = _solve_lanes(pairs, config, want_state=False) if pairs else []
+    return [
+        failed[i] if slot is None else solved[slot]
+        for i, slot in enumerate(slots)
+    ]

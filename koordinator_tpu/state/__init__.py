@@ -9,3 +9,9 @@ from koordinator_tpu.state.cluster import (  # noqa: F401
     lower_nodes_delta,
     lower_pending_pods,
 )
+from koordinator_tpu.state.workingset import (  # noqa: F401
+    WORKING_SET,
+    InjectedAllocFailure,
+    WorkingSetExhausted,
+    WorkingSetManager,
+)

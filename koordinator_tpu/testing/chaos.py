@@ -64,6 +64,18 @@ STATE_FAULT_KINDS = (
 #: one must surface as a TYPED WarmEntryError + counted reject +
 #: quarantine, then degrade to cold compile; never a crash and never a
 #: stale-executable solve
+#: device-memory-pressure fault kinds (injected by :class:`HBMSaboteur`
+#: into the process-wide working-set manager, docs/DESIGN.md §26) —
+#: every one must degrade through the typed demote→retry ladder with
+#: counted outcomes; never a crashed tick, never a silently dropped
+#: solve, and final placements bit-identical to a fault-free run
+HBM_FAULT_KINDS = (
+    "alloc-fail-stage",        # RESOURCE_EXHAUSTED at the next staging
+    "alloc-fail-scatter",      # RESOURCE_EXHAUSTED at the next scatter
+    "budget-squeeze-mid-churn",  # budget transiently halved: forced
+                                 # demotions under live multi-tenant load
+)
+
 WARM_POOL_FAULT_KINDS = (
     "truncated-entry",          # torn write: the file ends mid-payload
     "bitflipped-entry",         # bit rot: bytes flipped under the header
@@ -164,7 +176,11 @@ class FaultSchedule:
     def __init__(self, events: Optional[Dict[int, str]] = None):
         self.events = dict(events or {})
         for kind in self.events.values():
-            if kind not in FAULT_KINDS and kind not in STATE_FAULT_KINDS:
+            if (
+                kind not in FAULT_KINDS
+                and kind not in STATE_FAULT_KINDS
+                and kind not in HBM_FAULT_KINDS
+            ):
                 raise ValueError(f"unknown fault kind: {kind!r}")
 
     @classmethod
@@ -473,6 +489,64 @@ class StateSaboteur:
                 usage=state.usage.at[i, 0].add(777)
             )
         return name
+
+
+class HBMSaboteur:
+    """Deterministic *device-memory-pressure* injection: the allocation
+    failures and budget squeezes the working-set manager
+    (state/workingset.py, docs/DESIGN.md §26) exists to absorb, driven
+    by the same :class:`FaultSchedule` machinery as
+    :class:`StateSaboteur` — a schedule maps tick ordinals to
+    :data:`HBM_FAULT_KINDS`, ``inject(tick)`` executes the scheduled
+    fault against the process singleton:
+
+    - ``alloc-fail-stage`` / ``alloc-fail-scatter``: arm one injected
+      ``RESOURCE_EXHAUSTED`` at the named boundary — the NEXT staging
+      (or scatter) raises before any device work runs, forcing the
+      typed demote→retry ladder. The retried callable executes exactly
+      once, so the landed solve is bit-identical to a fault-free one.
+    - ``budget-squeeze-mid-churn``: the HBM budget is transiently
+      halved and enforced — residents demote (BE lanes first) under
+      live load, then the budget is restored; subsequent touches
+      restage on demand.
+
+    ``inject`` returns the kind applied (None when nothing scheduled);
+    ``injected`` counts per kind and ``log`` keeps ``(tick, kind,
+    detail)`` for assertions."""
+
+    def __init__(self, schedule: FaultSchedule, manager=None, seed: int = 0):
+        from koordinator_tpu.state.workingset import WORKING_SET
+
+        self.schedule = schedule
+        self.manager = manager if manager is not None else WORKING_SET
+        self._rng = random.Random(seed)
+        self.injected: Dict[str, int] = {}
+        self.log: list = []
+
+    def inject(self, tick: int) -> Optional[str]:
+        kind = self.schedule.fault_for(tick)
+        if kind is None or kind not in HBM_FAULT_KINDS:
+            return None
+        detail = getattr(self, "_" + kind.replace("-", "_"))()
+        if detail is None:
+            return None
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        self.log.append((tick, kind, detail))
+        return kind
+
+    # -- fault implementations ----------------------------------------------
+
+    def _alloc_fail_stage(self) -> Optional[str]:
+        self.manager.arm_fault("stage")
+        return "armed:stage"
+
+    def _alloc_fail_scatter(self) -> Optional[str]:
+        self.manager.arm_fault("scatter")
+        return "armed:scatter"
+
+    def _budget_squeeze_mid_churn(self) -> Optional[str]:
+        demoted = self.manager.squeeze(0.5)
+        return f"squeezed:demoted={demoted}"
 
 
 class InProcessSidecar:

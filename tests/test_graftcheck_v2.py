@@ -214,8 +214,12 @@ def test_injected_cross_module_device_get_fails():
 
 
 _DONATED_ANCHOR = """\
-                                self.state = scatter_node_rows_donated(
-                                    self.state, jnp.asarray(sidx), srows
+                                cur = self.state
+                                self.state = WORKING_SET.run_staged(
+                                    self._ws_key, "scatter",
+                                    lambda: scatter_node_rows_donated(
+                                        cur, jnp.asarray(sidx), srows,
+                                    ),
                                 )"""
 
 
@@ -225,16 +229,14 @@ def test_injected_read_after_donate_fails():
     path = "koordinator_tpu/models/placement.py"
     source = (REPO / path).read_text()
     assert _DONATED_ANCHOR in source
-    injected = source.replace(_DONATED_ANCHOR, """\
-                                tmp = self.state
-                                self.state = scatter_node_rows_donated(
-                                    tmp, jnp.asarray(sidx), srows
-                                )
-                                _ = tmp.alloc""")
+    injected = source.replace(
+        _DONATED_ANCHOR,
+        _DONATED_ANCHOR + "\n                                _ = cur.alloc",
+    )
     violations, _ = _run_with_replacement(path, injected)
     hits = [v for v in violations if v.rule == "donation-safety"]
     assert any(
-        v.func == "StagedStateCache.ensure" and v.symbol == "tmp"
+        v.func == "StagedStateCache._ensure" and v.symbol == "cur"
         for v in hits
     ), [v.format() for v in hits]
 
@@ -261,7 +263,7 @@ def test_injected_unguarded_donation_fails():
     violations, _ = _run_with_replacement(path, injected)
     hits = [v for v in violations if v.rule == "donation-safety"]
     assert any(
-        v.func == "StagedStateCache.ensure"
+        v.func == "StagedStateCache._ensure"
         and v.symbol == "self.state"
         and "pinned" in v.message for v in hits
     ), [v.format() for v in hits]

@@ -146,6 +146,15 @@ echo "== device observatory smoke =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_device_obs.py \
     -q -k "smoke or identical" -p no:cacheprovider
 
+echo "== workingset smoke =="
+# the HBM working-set slice (ISSUE 19, docs/DESIGN.md Â§26): the
+# residency ladder's policy unit tests (victim order, budget boundary,
+# typed alloc-failure retry/escalation) plus the 16-tenant chaos churn
+# under every HBM_FAULT_KINDS kind â placements bit-identical to the
+# fault-free arm, every degradation typed + counted, zero crashes
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_workingset.py \
+    -q -k "unit or chaos" -p no:cacheprovider
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
