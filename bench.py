@@ -99,9 +99,12 @@ KTPU_BENCH_STORM_NODES / _RPN / _ARRIVALS / _ORACLE_PODS /
 _PLACE / _DRAIN_S reshape it (see bench_preemption_storm),
 KTPU_BENCH_SLO=0 to skip the closed-loop SLO-convergence leg (#20) —
 KTPU_BENCH_SLO_NODES / _SECONDS / _RATE / _TARGET reshape it
-(see bench_slo_convergence), and KTPU_BENCH_DENSITY=0 to skip the
+(see bench_slo_convergence), KTPU_BENCH_DENSITY=0 to skip the
 tenant-density degradation leg (#21) — KTPU_BENCH_DENSITY_TENANTS /
-_NODES / _PODS / _ROUNDS reshape it (see bench_tenant_density).
+_NODES / _PODS / _ROUNDS reshape it (see bench_tenant_density) — and
+KTPU_BENCH_REBALANCE=0 to skip the rebalance-storm leg (#22) —
+KTPU_BENCH_REBALANCE_NODES / _PPN reshape it (see
+bench_rebalance_storm).
 """
 
 import json
@@ -3524,6 +3527,160 @@ def bench_preemption_storm(repeats):
     return out
 
 
+def bench_rebalance_storm(repeats):
+    """Config #22 (ISSUE 20): the rebalance storm — a large imbalanced
+    cluster (half the nodes hot over the high threshold, half cold)
+    where one LoadAware Balance pass proposes thousands of evictions.
+    Three facets:
+
+    - **sweep throughput, device vs host**: the same ordered
+      eviction walk both ways over the same world. The host arm is the
+      reference-shaped per-pod Python loop (the bit-parity oracle kept
+      verbatim in descheduler/loadaware.py); the device arm flattens
+      the host-ordered candidate list into ONE ``lax.scan``
+      (ops/rebalance.run_balance_sweep) and replays its decision
+      streams through the evictor. The shared head (classification,
+      scoring, sorting) rides inside both timings — this is
+      whole-balance() wall, not kernel-only.
+    - **bit-parity + churn**: the device sweep's eviction sequence
+      (victim sets AND order) must equal the host walk's exactly
+      (identical_to_oracle), so churn_vs_oracle == 1.0.
+    - **budget-bounded eviction rate**: the same wave through a
+      tightly budgeted MigrationArbiter (max_per_node=1): admitted
+      evictions stop exactly at nodes-over-threshold, every refusal a
+      typed counted deferral (budget_bounded gates both).
+
+    Env knobs: KTPU_BENCH_REBALANCE_NODES / _PPN (pods per hot node)
+    reshape the world (defaults 400 x 10 = 2k candidate pods on the
+    200 hot nodes)."""
+    from koordinator_tpu.apis.extension import QoSClass, ResourceName
+    from koordinator_tpu.apis.types import (
+        ClusterSnapshot,
+        NodeMetric,
+        NodeSpec,
+        PodSpec,
+    )
+    from koordinator_tpu.control.migration import (
+        MigrationArbiter,
+        MigrationBudget,
+    )
+    from koordinator_tpu.descheduler import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+        NodePool,
+    )
+    from koordinator_tpu.descheduler.framework import Evictor
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    n_nodes = int(os.environ.get("KTPU_BENCH_REBALANCE_NODES", 400))
+    ppn = int(os.environ.get("KTPU_BENCH_REBALANCE_PPN", 10))
+    rng = np.random.default_rng(22)
+
+    def build_world():
+        nodes, pods, metrics = [], [], {}
+        for i in range(n_nodes):
+            hot = i % 2 == 0
+            node = NodeSpec(
+                name=f"rb-n{i}",
+                allocatable={CPU: 32000, MEM: 65536},
+            )
+            nodes.append(node)
+            pod_usages = {}
+            if hot:
+                for j in range(ppn):
+                    pod = PodSpec(
+                        name=f"rb-p{i}-{j}", node_name=node.name,
+                        requests={CPU: 200, MEM: 256},
+                        qos=QoSClass.BE,
+                        priority=int(rng.integers(0, 3) * 1000),
+                        creation_time=float(rng.integers(0, 50)),
+                    )
+                    pods.append(pod)
+                    pod_usages[pod.uid] = {
+                        CPU: int(rng.integers(1500, 3200)),
+                        MEM: int(rng.integers(2048, 6000)),
+                    }
+            usage = (
+                {CPU: int(rng.integers(27000, 31000)),
+                 MEM: int(rng.integers(56000, 64000))}
+                if hot else
+                {CPU: int(rng.integers(500, 3000)),
+                 MEM: int(rng.integers(1024, 6000))}
+            )
+            metrics[node.name] = NodeMetric(
+                node_name=node.name, node_usage=usage,
+                pod_usages=pod_usages, update_time=100.0,
+            )
+        return ClusterSnapshot(nodes=nodes, pods=pods,
+                               node_metrics=metrics, now=120.0)
+
+    snapshot = build_world()
+    pool = NodePool(low_thresholds={CPU: 30, MEM: 30},
+                    high_thresholds={CPU: 60, MEM: 60})
+
+    class Sink(Evictor):
+        """Approves everything, mutates nothing: repeated sweeps time
+        the same world."""
+
+        def _do_evict(self, snap, pod, reason):
+            return True
+
+    def run(backend):
+        sequences = []
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            plugin = LowNodeLoad(LowNodeLoadArgs(
+                node_pools=[pool], backend=backend))
+            sink = Sink()
+            plugin.balance(snapshot, sink)
+            sequences.append([(p.node_name, p.uid) for p in sink.evicted])
+        return (time.perf_counter() - t0) / repeats, sequences[-1]
+
+    # warm the sweep kernel's candidate bucket off the clock
+    run("device")
+    device_wall, device_seq = run("device")
+    host_wall, host_seq = run("host")
+
+    # the budget-bounded arm: one admitted eviction per hot node, the
+    # rest typed deferrals — the arbitrated control plane under load
+    arb = MigrationArbiter(MigrationBudget(max_per_node=1))
+    plugin = LowNodeLoad(LowNodeLoadArgs(node_pools=[pool],
+                                         backend="device"))
+    sink = Sink(arbiter=arb)
+    t0 = time.perf_counter()
+    plugin.balance(snapshot, sink)
+    budget_wall = time.perf_counter() - t0
+    status = arb.status()
+    hot_nodes = {n for n, _ in host_seq}
+    budget_bounded = (
+        len(sink.evicted) <= len(hot_nodes)
+        and all(c <= 1 for c in status["window_nodes"].values())
+        and status["deferred_total"] > 0
+        and set(status["deferred_by_reason"]) <= {"node-budget",
+                                                  "cooldown"}
+    )
+
+    return {
+        "n_nodes": n_nodes,
+        "n_candidates": ppn * (n_nodes // 2),
+        "evictions": len(host_seq),
+        "device_wall_s": device_wall,
+        "host_wall_s": host_wall,
+        "device_vs_host_speedup": host_wall / device_wall,
+        "device_evictions_per_sec": len(device_seq) / device_wall,
+        "identical_to_oracle": bool(device_seq == host_seq),
+        "churn_vs_oracle": (
+            len(device_seq) / len(host_seq) if host_seq else 1.0
+        ),
+        "budgeted_evictions": len(sink.evicted),
+        "budgeted_deferrals": status["deferred_total"],
+        "budgeted_eviction_rate": (
+            len(sink.evicted) / budget_wall if budget_wall else 0.0
+        ),
+        "budget_bounded": bool(budget_bounded),
+    }
+
+
 #: legs that need a REAL multi-device mesh — the parent bench process
 #: may hold a single-device backend (or a TPU tunnel), so these run in
 #: a fresh interpreter with the virtual-CPU 8-device forcing and hand
@@ -4521,6 +4678,14 @@ def main():
         # resident-tenant fraction under the HBM budget
         matrix["21_tenant_density"] = leg(
             bench_tenant_density, repeats
+        )
+    if os.environ.get("KTPU_BENCH_REBALANCE", "1") != "0":
+        # the rebalance-storm leg (ISSUE 20): the device Balance sweep
+        # vs the host walk over a large imbalanced cluster (bit-parity
+        # + churn), plus the budget-bounded arm through the migration
+        # arbiter
+        matrix["22_rebalance_storm"] = leg(
+            bench_rebalance_storm, repeats
         )
     if os.environ.get("KTPU_BENCH_WARMPROBE", "1") != "0":
         matrix["warm_start"] = leg(bench_warm_start)

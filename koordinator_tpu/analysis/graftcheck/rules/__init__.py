@@ -288,6 +288,40 @@ LOCK_SPECS = (
         # (step(), reconcile()) enter it inside `with self._lock`
         exempt_methods=("__init__", "_step_locked"),
     ),
+    # the migration arbiter (docs/DESIGN.md §27): every eviction source
+    # (preemption solve, defrag drain, rebalance sweep, working-set
+    # demotion notes) requests from its own thread; debug-mux/flight
+    # readers snapshot the decision ring — one lock over the budget,
+    # the sliding windows, and the ring. It is a LEAF lock: request()
+    # is called with scheduler/cache locks already held, and the
+    # arbiter never calls out while holding it.
+    LockSpec(
+        path="koordinator_tpu/control/migration.py",
+        class_name="MigrationArbiter",
+        lock="_lock",
+        attrs=("_budget", "_ring", "_node_times", "_lane_times",
+               "_gang_times", "_node_last", "_round_key", "_round_count",
+               "_requests_total", "_admitted_total", "_deferred_total",
+               "_deferred_reasons", "_seq"),
+        # the _locked helpers are the lock-held arbitration body: every
+        # call site enters them inside `with self._lock`
+        exempt_methods=("__init__", "_request_locked", "_refusal_locked",
+                        "_commit_locked", "_purge_locked"),
+    ),
+    # the closed-loop defrag controller (docs/DESIGN.md §27): the loop
+    # thread reconciles on the pump, debug-mux/flight readers snapshot
+    # the decision and observation rings
+    LockSpec(
+        path="koordinator_tpu/control/migration.py",
+        class_name="DefragController",
+        lock="_lock",
+        attrs=("_ring", "_obs_ring", "_streak", "_last_decision_now",
+               "_last_reconcile_at", "_decisions_total", "_seq"),
+        # _step_locked is the lock-held policy body (same contract as
+        # ServingSLOController): step()/reconcile()/replay enter it
+        # under the owning instance's lock
+        exempt_methods=("__init__", "_step_locked"),
+    ),
     # the device-cost observatory (docs/DESIGN.md §17): instrumented
     # jit calls record from solve threads, the monitoring listener
     # fires from whichever thread compiles, analyze()/status() run from
@@ -390,6 +424,10 @@ DETERMINISM_MODULES = HOT_MODULES + (
     # its recorded observation ring (DESIGN §25) — no wall clocks or
     # ambient randomness may leak into the policy
     "koordinator_tpu/control/slo.py",
+    # the migration arbiter's decision ring must replay bit-for-bit
+    # (replay_requests, DESIGN §27) and the defrag controller's policy
+    # must replay from its observation ring — same contract as slo.py
+    "koordinator_tpu/control/migration.py",
 )
 
 
@@ -436,6 +474,9 @@ BUCKET_FAMILY = (
     BucketFn(name="coalesce_pod_bucket",
              path="koordinator_tpu/service/admission.py",
              qualname="coalesce_pod_bucket", exempt_body=True),
+    BucketFn(name="sweep_candidate_bucket",
+             path="koordinator_tpu/ops/rebalance.py",
+             qualname="sweep_candidate_bucket", exempt_body=True),
     # the array sanctioners: their RETURNS are bucket-shaped; their
     # bodies stay under the rule (strip a bucket call -> convicted)
     BucketFn(name="_pad_pods", path="koordinator_tpu/models/placement.py",
@@ -610,6 +651,19 @@ BINDING_SPECS = (
                 axes=(_VICTIM_AXIS,), structural=_SOLVE_STRUCTURAL,
                 note="headroom repack: drain a fragmented node for a "
                      "gang-sized hole"),
+    BindingSpec(name="rebalance_sweep",
+                path="koordinator_tpu/ops/rebalance.py",
+                axes=(AxisSpec(
+                    axis="candidates",
+                    bucket="koordinator_tpu.ops.rebalance:"
+                           "sweep_candidate_bucket",
+                    bound=MAX_PODS,
+                    bound_source="bench churn wave cap (a sweep scans "
+                                 "at most one round's pod census)"),),
+                structural=("features",),
+                note="device Balance sweep: flattened host-ordered "
+                     "candidate scan, bit-parity oracle in "
+                     "descheduler/loadaware.py (DESIGN §27)"),
     BindingSpec(name="scatter_node_rows_donated",
                 path="koordinator_tpu/ops/binpack.py",
                 axes=(_DIRTY_AXIS,), structural=_SOLVE_STRUCTURAL),
@@ -669,6 +723,17 @@ LABEL_DOMAINS = {
         "admission", "budget", "alloc-failure",
         "host", "cold",
         "stage", "scatter",
+        # migration-arbiter typed refusal reasons (control/migration.py
+        # REASONS, DESIGN §27) — the deferral vocabulary, precedence
+        # order mirrored in code
+        "cooldown", "round-budget", "node-budget", "tenant-budget",
+        "gang-min-available",
+    )),
+    # the migration arbiter's eviction-source vocabulary
+    # (control/migration.py SOURCES, DESIGN §27): every path that may
+    # evict a resident declares which one it is
+    "source": LabelDomain(kind="enum", values=(
+        "preemption", "defrag", "rebalance", "workingset",
     )),
     # the working-set residency census gauge (DESIGN §26)
     "rung": LabelDomain(kind="enum", values=("device", "host", "cold")),
@@ -704,11 +769,13 @@ LABEL_DOMAINS = {
     )),
     "signal": LabelDomain(kind="enum", values=(
         "p99-over", "p99-under", "shed-capacity", "padding-waste",
+        # the defrag controller's fragmentation signal (DESIGN §27)
+        "frag-over",
     )),
     "buffer": LabelDomain(kind="enum", values=(
         "pod_batch", "resv_table", "dirty_rows", "coalesced_pods",
         "tenant_nodes", "tenant_pods", "tenant_lanes",
-        "resident_pods", "preemptor_batch",
+        "resident_pods", "preemptor_batch", "sweep_candidates",
     )),
     "outcome": LabelDomain(kind="enum", values=(
         "selected", "reprieved", "evicted",

@@ -149,6 +149,36 @@ class SchedulerConfig:
     #: pressure the least-valuable worlds demote host-pinned/cold
     #: instead of the process allocating past the line; 0 = unlimited
     hbm_budget_bytes: int = 0
+    #: migration arbiter disruption budgets (control/migration.py,
+    #: docs/DESIGN.md §27): every eviction source — preemption victims,
+    #: defrag drains, rebalance sweeps, working-set demotion notes —
+    #: passes through one arbiter. All-None caps + zero cooldown is the
+    #: unlimited default: every path stays bit-identical to pre-arbiter
+    #: behavior while still producing the typed decision ring.
+    migration_max_per_round: Optional[int] = None
+    migration_max_per_node: Optional[int] = None
+    migration_max_per_tenant: Optional[int] = None
+    migration_window_s: float = 60.0
+    migration_node_cooldown_s: float = 0.0
+    migration_dry_run: bool = False
+    #: closed-loop defrag controller (control/migration.py): watches
+    #: the fragmentation signal (a pending gang whose member shape fits
+    #: no node though aggregate free capacity could hold it) and
+    #: applies ONE arbitrated headroom repack per cooldown. Fixed-
+    #: cadence loop only; off by default (defrag_headroom stays an
+    #: operator-called API).
+    defrag_loop: bool = False
+    defrag_interval_s: float = 5.0
+    defrag_cooldown_s: float = 30.0
+    defrag_confirm: int = 2
+    defrag_dry_run: bool = False
+    #: periodic LoadAware Balance sweep inside the scheduling loop
+    #: (scheduler.rebalance_sweep): 0 = no sweep. Backend picks the
+    #: eviction-walk implementation: host (reference-shaped oracle),
+    #: device (one lax.scan over the flattened candidates), verify
+    #: (device + host replica, bit-equality asserted before applying)
+    rebalance_interval_s: float = 0.0
+    rebalance_backend: str = "host"
 
 
 def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None):
@@ -288,6 +318,32 @@ def build_scheduler(config: SchedulerConfig, gates: Optional[FeatureGate] = None
     if config.hbm_budget_bytes:
         WORKING_SET.set_budget(config.hbm_budget_bytes)
     scheduler.services.register("workingset", WORKING_SET.status)
+    # the migration arbiter (control/migration.py, DESIGN §27): ALWAYS
+    # constructed — with no --migration-* caps it is the unlimited
+    # budget, which admits everything bit-identically to the legacy
+    # paths while keeping the typed decision ring, the debug-mux
+    # service, and the flight payload live
+    from koordinator_tpu.control.migration import (
+        MigrationArbiter,
+        MigrationBudget,
+    )
+
+    arbiter = MigrationArbiter(MigrationBudget(
+        max_per_round=config.migration_max_per_round,
+        max_per_node=config.migration_max_per_node,
+        max_per_tenant=config.migration_max_per_tenant,
+        window_s=config.migration_window_s,
+        node_cooldown_s=config.migration_node_cooldown_s,
+        dry_run=config.migration_dry_run,
+    ))
+    scheduler.migration_arbiter = arbiter
+    # working-set demotions are the fourth eviction source: recorded
+    # against the same windows, undeferrable (the memory safety valve)
+    WORKING_SET.migration_hook = lambda key, lane, reason: arbiter.note(
+        "workingset", None, [key], lanes=[lane]
+    )
+    scheduler.services.register("migration", arbiter.status)
+    FLIGHT.register_payload("migration", arbiter.flight_payload)
     return scheduler
 
 
@@ -380,10 +436,53 @@ def build_slo_controller(streaming, bus, config: SchedulerConfig,
     return controller
 
 
+def build_defrag_controller(scheduler, config: SchedulerConfig, log=print):
+    """Close the loop on ``defrag_headroom`` (docs/DESIGN.md §27):
+    with ``--defrag-loop``, a
+    :class:`~koordinator_tpu.control.migration.DefragController` rides
+    the fixed-cadence scheduling loop — reconcile-on-the-pump like the
+    SLO controller — watching the fragmentation signal and applying one
+    arbitrated repack per cooldown. Returns None when the loop is off
+    (the API stays operator-called)."""
+    from koordinator_tpu.control.migration import (
+        DefragController,
+        DefragPolicy,
+    )
+    from koordinator_tpu.obs.flight import FLIGHT
+
+    if not config.defrag_loop:
+        return None
+    controller = DefragController(scheduler, DefragPolicy(
+        interval_s=config.defrag_interval_s,
+        cooldown_s=config.defrag_cooldown_s,
+        confirm=config.defrag_confirm,
+        dry_run=config.defrag_dry_run,
+    ))
+    scheduler.services.register("defrag", controller.status)
+    FLIGHT.register_payload("defrag", controller.flight_payload)
+    return controller
+
+
+def build_rebalance_plugin(config: SchedulerConfig):
+    """The in-loop LoadAware Balance sweep's plugin: built when
+    ``--rebalance-interval`` is set, run by the loop through
+    ``scheduler.rebalance_sweep`` (arbitrated sink, delta-path
+    evictions). Returns None when the sweep is off."""
+    from koordinator_tpu.descheduler.loadaware import (
+        LowNodeLoad,
+        LowNodeLoadArgs,
+    )
+
+    if config.rebalance_interval_s <= 0:
+        return None
+    return LowNodeLoad(LowNodeLoadArgs(backend=config.rebalance_backend))
+
+
 def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
              log=print, elector=None, now_fn=time.time,
              max_rounds: Optional[int] = None, auditor=None,
-             pipeline=None, sleep_fn=time.sleep, streaming=None) -> int:
+             pipeline=None, sleep_fn=time.sleep, streaming=None,
+             defrag=None, rebalance=None) -> int:
     """The scheduling loop over a wired bus: solve the pending queue
     every interval. A sidecar outage without failover skips the round —
     COUNTED and logged, never silent (``scheduler_rounds_skipped_total``
@@ -489,6 +588,9 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
 
     skipped = 0
     rounds = 0
+    # in-loop rebalance cadence: first sweep one full interval after
+    # loop start (a sweep before any metric lands would be noise)
+    last_rebalance = now_fn()
 
     def on_round_error(e):
         """The one round-failure handler — shared by the main loop's
@@ -582,6 +684,23 @@ def run_loop(scheduler, config: SchedulerConfig, once: bool = False,
                     out = None
                 else:
                     out = scheduler.schedule_pending()
+                # post-round control plane (DESIGN §27): the defrag
+                # controller reconciles on the pump (interval-gated
+                # internally, one arbitrated repack per cooldown), and
+                # the LoadAware Balance sweep fires on its own cadence
+                # through the arbitrated sink
+                if defrag is not None:
+                    defrag.maybe_reconcile(now=now_fn())
+                if rebalance is not None and (
+                    round_start - last_rebalance
+                    >= config.rebalance_interval_s
+                ):
+                    last_rebalance = round_start
+                    swept = scheduler.rebalance_sweep(
+                        rebalance, now=now_fn()
+                    )
+                    if swept:
+                        log(f"rebalance: evicted {len(swept)} pod(s)")
             except (FencingError, SolverUnavailable,
                     SolverOverloaded) as e:
                 # in pipelined mode this may be a DEFERRED abort from
@@ -765,6 +884,81 @@ def main(argv=None) -> int:
              "process allocating past the line; 0 = unlimited",
     )
     parser.add_argument(
+        "--migration-max-per-round", type=int, default=None,
+        help="disruption budget: admitted evictions per scheduling "
+             "round, all sources combined (control/migration.py, "
+             "docs/DESIGN.md §27); unset = unlimited",
+    )
+    parser.add_argument(
+        "--migration-max-per-node", type=int, default=None,
+        help="disruption budget: admitted evictions per node within "
+             "--migration-window; unset = unlimited",
+    )
+    parser.add_argument(
+        "--migration-max-per-tenant", type=int, default=None,
+        help="disruption budget: admitted evictions per QoS lane "
+             "(system/ls/be) within --migration-window; unset = "
+             "unlimited",
+    )
+    parser.add_argument(
+        "--migration-window", type=float, default=60.0,
+        help="rolling window in seconds the per-node/per-tenant "
+             "budgets are counted over",
+    )
+    parser.add_argument(
+        "--migration-node-cooldown", type=float, default=0.0,
+        help="per-node quiet period in seconds after an admitted "
+             "eviction on that node (0 = none)",
+    )
+    parser.add_argument(
+        "--migration-dry-run", action="store_true",
+        help="classify-only arbitration: every eviction request is "
+             "judged and recorded in the decision ring but NOTHING is "
+             "evicted — audit what the budgets would do before "
+             "enforcing them",
+    )
+    parser.add_argument(
+        "--defrag-loop", action="store_true",
+        help="closed-loop defrag (docs/DESIGN.md §27): watch the "
+             "fragmentation signal (a pending gang that fits nowhere "
+             "though aggregate free capacity could hold it) and apply "
+             "one arbitrated headroom repack per cooldown; "
+             "fixed-cadence loop only",
+    )
+    parser.add_argument(
+        "--defrag-interval", type=float, default=5.0,
+        help="defrag controller reconcile cadence in seconds",
+    )
+    parser.add_argument(
+        "--defrag-cooldown", type=float, default=30.0,
+        help="quiet period in seconds between applied repacks (one "
+             "bounded action per cooldown)",
+    )
+    parser.add_argument(
+        "--defrag-confirm", type=int, default=2,
+        help="hysteresis: consecutive fragmented observations before "
+             "the controller acts",
+    )
+    parser.add_argument(
+        "--defrag-dry-run", action="store_true",
+        help="defrag decisions are recorded (ring + metric) but "
+             "defrag_headroom is never called",
+    )
+    parser.add_argument(
+        "--rebalance-interval", type=float, default=0.0,
+        help="run the LoadAware Balance sweep inside the scheduling "
+             "loop every this many seconds, evictions routed through "
+             "the migration arbiter (0 = no in-loop sweep)",
+    )
+    parser.add_argument(
+        "--rebalance-backend", choices=("host", "device", "verify"),
+        default="host",
+        help="eviction-walk backend for the Balance sweep: host "
+             "(reference-shaped oracle), device (one lax.scan over "
+             "the flattened candidate list), verify (both, "
+             "bit-equality asserted before applying)",
+    )
+    parser.add_argument(
         "--cluster-json", default=None,
         help="seed the bus from a cluster-spec JSON file",
     )
@@ -881,6 +1075,19 @@ def main(argv=None) -> int:
         slo_window_s=args.slo_window,
         slo_cooldown_s=args.slo_cooldown,
         hbm_budget_bytes=args.hbm_budget_bytes,
+        migration_max_per_round=args.migration_max_per_round,
+        migration_max_per_node=args.migration_max_per_node,
+        migration_max_per_tenant=args.migration_max_per_tenant,
+        migration_window_s=args.migration_window,
+        migration_node_cooldown_s=args.migration_node_cooldown,
+        migration_dry_run=args.migration_dry_run,
+        defrag_loop=args.defrag_loop,
+        defrag_interval_s=args.defrag_interval,
+        defrag_cooldown_s=args.defrag_cooldown,
+        defrag_confirm=args.defrag_confirm,
+        defrag_dry_run=args.defrag_dry_run,
+        rebalance_interval_s=args.rebalance_interval,
+        rebalance_backend=args.rebalance_backend,
     )
     from koordinator_tpu.client.bus import APIServer
     from koordinator_tpu.client.wiring import wire_scheduler
@@ -967,6 +1174,15 @@ def main(argv=None) -> int:
             build_slo_controller(
                 streaming, bus, config, elector=elector,
             )
+        defrag = None
+        rebalance = None
+        if not config.streaming:
+            defrag = build_defrag_controller(scheduler, config)
+            rebalance = build_rebalance_plugin(config)
+        elif config.defrag_loop or config.rebalance_interval_s > 0:
+            print("defrag loop / in-loop rebalance ride the "
+                  "fixed-cadence scheduling loop; ignored in "
+                  "--streaming mode")
         if args.cluster_json:
             seed_bus_from_json(bus, args.cluster_json)
         if args.debug_port is not None:
@@ -1018,7 +1234,8 @@ def main(argv=None) -> int:
             ).start()
             print(f"debug http on 127.0.0.1:{http_server.port}")
         return run_loop(scheduler, config, once=args.once, elector=elector,
-                        auditor=auditor, streaming=streaming)
+                        auditor=auditor, streaming=streaming,
+                        defrag=defrag, rebalance=rebalance)
     finally:
         if http_server is not None:
             http_server.stop()

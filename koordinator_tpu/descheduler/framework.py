@@ -81,11 +81,21 @@ class EvictionLimiter:
 
 
 class Evictor:
-    """Evictor protocol (reference: framework/types.go Evictor)."""
+    """Evictor protocol (reference: framework/types.go Evictor).
 
-    def __init__(self, limiter: Optional[EvictionLimiter] = None):
+    ``arbiter`` optionally routes every eviction through the migration
+    arbiter (control/migration.py, docs/DESIGN.md §27) under the given
+    source label — a standalone descheduler run then obeys the same
+    disruption budgets as the scheduler-integrated sweep. A deferral
+    surfaces as the protocol's existing refusal (``evict`` returns
+    False); the typed reason lands in the arbiter's ring + metrics."""
+
+    def __init__(self, limiter: Optional[EvictionLimiter] = None,
+                 arbiter=None, arbiter_source: str = "rebalance"):
         self.limiter = limiter or EvictionLimiter()
         self.evicted: List[PodSpec] = []
+        self.arbiter = arbiter
+        self.arbiter_source = arbiter_source
 
     def filter(self, pod: PodSpec) -> bool:
         """Whether this pod may be evicted at all."""
@@ -94,6 +104,15 @@ class Evictor:
     def evict(self, snapshot: ClusterSnapshot, pod: PodSpec, reason: str = "") -> bool:
         if not self.limiter.allow(pod):
             return False
+        if self.arbiter is not None:
+            from koordinator_tpu.obs.timeline import lane_of
+
+            verdict = self.arbiter.request(
+                self.arbiter_source, pod.node_name, [pod.uid],
+                lanes=[lane_of(pod)], gangs=[pod.gang],
+            )
+            if not verdict.apply or not verdict.admitted:
+                return False
         # capture the accounting keys before _do_evict mutates the pod
         node, namespace = pod.node_name or "", pod.namespace
         if not self._do_evict(snapshot, pod, reason):

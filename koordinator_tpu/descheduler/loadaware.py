@@ -75,6 +75,13 @@ class LowNodeLoadArgs:
     node_metric_expiration_seconds: Optional[float] = 180.0
     # pod filter: which pods are candidates for eviction at all
     pod_filter: Optional[Callable[[PodSpec], bool]] = None
+    # eviction-sweep backend: "host" walks nodes/pods in Python
+    # (reference-shaped, the bit-parity oracle); "device" runs the
+    # ordered sweep as one lax.scan over the flattened candidate list
+    # (ops.rebalance.run_balance_sweep); "verify" runs the device sweep
+    # and asserts its decision stream bit-equal to a pure-host replica
+    # before applying anything
+    backend: str = "host"
 
 
 def _percent_vec(thresholds: Dict[ResourceName, int]) -> np.ndarray:
@@ -129,6 +136,11 @@ class LowNodeLoad(BalancePlugin):
     def balance(self, snapshot: ClusterSnapshot, evictor: Evictor) -> None:
         if self.args.paused:
             return
+        if self.args.backend not in ("host", "device", "verify"):
+            raise ValueError(
+                f"unknown rebalance backend {self.args.backend!r} "
+                "(expected host | device | verify)"
+            )
         self.last_proposals = []
         try:
             processed: set = set()
@@ -255,12 +267,20 @@ class LowNodeLoad(BalancePlugin):
                 pods_by_node.setdefault(pod.node_name, []).append(pod)
         low_arr = np.asarray(low_idx, dtype=np.int64)
         fits_any = _FitProbe(alloc[low_arr] - usage[low_arr])
-        for i in abnormal_idx:
-            self._evict_from_node(
-                pool, snapshot, evictor, nodes[i],
-                pods_by_node.get(nodes[i].name, []), usage[i], high_q[i],
-                available, res_mask, weights, fits_any,
+        if self.args.backend in ("device", "verify"):
+            self._sweep_device(
+                pool, snapshot, evictor, nodes, abnormal_idx,
+                pods_by_node, usage, high_q, available, res_mask,
+                weights, fits_any,
+                verify=(self.args.backend == "verify"),
             )
+        else:
+            for i in abnormal_idx:
+                self._evict_from_node(
+                    pool, snapshot, evictor, nodes[i],
+                    pods_by_node.get(nodes[i].name, []), usage[i],
+                    high_q[i], available, res_mask, weights, fits_any,
+                )
         # one normal observation on every abnormal node at the end of
         # the pass (reference: tryMarkNodesAsNormal)
         for i in abnormal_idx:
@@ -278,10 +298,15 @@ class LowNodeLoad(BalancePlugin):
             return metric.pod_usages[pod.uid]
         return None
 
-    def _evict_from_node(
+    def _removable_sorted(
         self, pool, snapshot, evictor, node, node_pods, node_usage,
-        node_high_q, available, res_mask, weights, fits_any,
-    ) -> None:
+        node_high_q, res_mask, weights, fits_any,
+    ) -> List[PodSpec]:
+        """The candidate head both backends share: filter evictable
+        pods and order them under the full PodSorter chain. Keeping it
+        one function is what makes host/device parity structural — the
+        backends can only disagree about the sequential walk, which the
+        parity suite pins."""
         removable = []
         for pod in node_pods:
             if pod.is_daemonset:
@@ -294,7 +319,7 @@ class LowNodeLoad(BalancePlugin):
                 continue
             removable.append(pod)
         if not removable:
-            return
+            return removable
 
         # evict biggest consumers of the *overused* resources first,
         # under the full PodSorter chain (priority class, priority, QoS,
@@ -309,6 +334,16 @@ class LowNodeLoad(BalancePlugin):
             self._pod_metric(snapshot, node, pod), node.allocatable,
             over_weights,
         ))
+        return removable
+
+    def _evict_from_node(
+        self, pool, snapshot, evictor, node, node_pods, node_usage,
+        node_high_q, available, res_mask, weights, fits_any,
+    ) -> None:
+        removable = self._removable_sorted(
+            pool, snapshot, evictor, node, node_pods, node_usage,
+            node_high_q, res_mask, weights, fits_any,
+        )
         for pod in removable:
             # stop once the node is back under every high threshold or the
             # destination headroom is gone (reference: continueEvictionCond)
@@ -336,6 +371,121 @@ class LowNodeLoad(BalancePlugin):
             u = resources_to_vector(pod_metric)
             available -= np.where(res_mask, u, 0)
             node_usage -= np.where(res_mask, u, 0)
+
+    # -- the device backend (docs/DESIGN.md §27) ---------------------------
+    def _sweep_device(
+        self, pool, snapshot, evictor, nodes, abnormal_idx, pods_by_node,
+        usage, high_q, available, res_mask, weights, fits_any,
+        verify=False,
+    ) -> None:
+        """Run the ordered eviction walk as one scan over the flattened
+        candidate list (ops.rebalance). Host preprocessing — node score
+        order, per-node removable filter + PodSorter order — is the
+        SAME code as the host backend; only the sequential
+        check/evict/subtract walk moves to the device. Evictor refusals
+        (including arbiter deferrals) feed back as a ``blocked`` mask
+        and the scan re-runs: a refusal can only change decisions at or
+        after its own index, so the applied prefix stays valid and the
+        walk resumes in place — worst case one re-scan per refusal."""
+        from koordinator_tpu.ops.rebalance import (
+            SweepBatch,
+            replay_sweep_host,
+            run_balance_sweep,
+        )
+
+        cand_pods: List[PodSpec] = []
+        cand_nodes: List[NodeSpec] = []
+        rows = {"start": [], "u0": [], "hq": [], "m": [], "hm": []}
+        segments = []  # (node, first candidate index, end index)
+        for i in abnormal_idx:
+            node = nodes[i]
+            removable = self._removable_sorted(
+                pool, snapshot, evictor, node,
+                pods_by_node.get(node.name, []), usage[i], high_q[i],
+                res_mask, weights, fits_any,
+            )
+            first = len(cand_pods)
+            for j, pod in enumerate(removable):
+                cand_pods.append(pod)
+                cand_nodes.append(node)
+                rows["start"].append(j == 0)
+                rows["u0"].append(usage[i])
+                rows["hq"].append(high_q[i])
+                pod_metric = self._pod_metric(snapshot, node, pod)
+                rows["hm"].append(pod_metric is not None)
+                rows["m"].append(
+                    np.zeros(NUM_RESOURCES, dtype=np.int64)
+                    if pod_metric is None
+                    else resources_to_vector(pod_metric)
+                )
+            segments.append((node, first, len(cand_pods)))
+        k = len(cand_pods)
+        if k == 0:
+            return
+        batch = SweepBatch(
+            node_start=np.asarray(rows["start"], bool),
+            usage0=np.stack(rows["u0"]).astype(np.int64),
+            high_q=np.stack(rows["hq"]).astype(np.int64),
+            metric=np.stack(rows["m"]).astype(np.int64),
+            has_metric=np.asarray(rows["hm"], bool),
+            valid=np.ones(k, bool),
+        )
+        blocked = np.zeros(k, bool)
+
+        def run_sweep():
+            got = run_balance_sweep(batch, available, res_mask, blocked)
+            if verify:
+                want = replay_sweep_host(batch, available, res_mask, blocked)
+                for name, a, b in zip(("propose", "over", "avail_ok"),
+                                      got, want):
+                    if not np.array_equal(a, b):
+                        raise RuntimeError(
+                            "rebalance verify backend: device sweep "
+                            f"{name} stream diverged from the host "
+                            f"replica at candidates "
+                            f"{np.flatnonzero(a != b).tolist()}"
+                        )
+            return got
+
+        propose, over, avail_ok = run_sweep()
+        applied = np.zeros(k, bool)
+        idx = 0
+        while idx < k:
+            if not propose[idx] or applied[idx]:
+                idx += 1
+                continue
+            pod = cand_pods[idx]
+            if self.args.dry_run:
+                self.last_proposals.append(pod)
+                applied[idx] = True
+                idx += 1
+            elif evictor.evict(snapshot, pod, reason=(
+                f"node {cand_nodes[idx].name} over-utilized"
+            )):
+                applied[idx] = True
+                idx += 1
+            else:
+                blocked[idx] = True
+                propose, over, avail_ok = run_sweep()
+        # detector resets, replayed from the decision streams: the host
+        # walk resets a node's detector iff the first candidate that
+        # stops the walk on that node stops it via the under-threshold
+        # check (over == False, checked BEFORE headroom exhaustion)
+        for node, first, end in segments:
+            for j in range(first, end):
+                if not over[j]:
+                    det = self.detectors.get(node.name)
+                    if det is not None:
+                        det.reset()
+                    break
+                if not avail_ok[j]:
+                    break
+        # reproduce the host's in-place pool accounting (nothing after
+        # the sweep reads it today, but the contract is bit-parity of
+        # state, not just of decisions)
+        for j in np.flatnonzero(applied & batch.has_metric):
+            available -= np.where(res_mask, batch.metric[j], 0)
+
 
 class _FitProbe:
     """nodeFit gate (reference: nodeutil.PodFitsAnyNode): some

@@ -44,6 +44,30 @@ DEFRAG_DRAINS = SCHEDULER_METRICS.counter(
     "Headroom-repack drains applied (pods evicted to restore a "
     "gang-sized hole)",
 )
+MIGRATION_REQUESTS = SCHEDULER_METRICS.counter(
+    "scheduler_migration_requests_total",
+    "Eviction victims presented to the migration arbiter, per source",
+    label_names=("source",),  # preemption | defrag | rebalance | workingset
+)
+MIGRATION_ADMITTED = SCHEDULER_METRICS.counter(
+    "scheduler_migration_admitted_total",
+    "Victims the arbiter admitted within the declared disruption "
+    "budgets (working-set demotions count here too: undeferrable)",
+    label_names=("source",),  # preemption | defrag | rebalance | workingset
+)
+MIGRATION_DEFERRALS = SCHEDULER_METRICS.counter(
+    "scheduler_migration_deferrals_total",
+    "Victims deferred by the arbiter, per typed refusal reason — the "
+    "never-dropped-silently contract (docs/DESIGN.md §27)",
+    # reason: cooldown | round-budget | node-budget | tenant-budget |
+    #         gang-min-available
+    label_names=("source", "reason"),
+)
+DEFRAG_DECISIONS = SCHEDULER_METRICS.counter(
+    "scheduler_defrag_decisions_total",
+    "Closed-loop defrag controller decisions, per triggering signal",
+    label_names=("signal",),  # frag-over
+)
 GANG_REJECTIONS = SCHEDULER_METRICS.counter(
     "scheduler_gang_rejections_total",
     "Gang-group rejections (strict failures + WaitTime expiry)",

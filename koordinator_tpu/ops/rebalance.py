@@ -34,9 +34,12 @@ from __future__ import annotations
 
 from typing import NamedTuple, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.apis.extension import ResourceName
+from koordinator_tpu.obs.device import DEVICE_OBS
 
 
 class RebalanceVerdict(NamedTuple):
@@ -146,3 +149,199 @@ def classify_nodes(
     low = under_each.all(axis=1) & active & schedulable
     high = over_each.any(axis=1) & active
     return RebalanceVerdict(low, high, over_each, low_q, high_q)
+
+
+# -- the device Balance sweep (docs/DESIGN.md §27) ---------------------------
+#
+# The host sweep above classifies; the EVICTION sweep (reference
+# low_node_load.go balanceNodes → evictPodsFromSourceNodes) then walks
+# abnormal nodes in score order and pods in sort-key order, stopping per
+# node when it drops below its high threshold and globally when the low
+# nodes' absorbing headroom is exhausted. That walk is sequential state —
+# available and node usage shrink as victims are chosen — so the port is
+# a ``lax.scan`` over the HOST-ORDERED flattened candidate list (node
+# score sort and pod sort-key order are pure host preprocessing, kept
+# verbatim in descheduler/loadaware.py), with the carry holding exactly
+# the two mutating vectors:
+#
+#   carry = (available [R] i32, cur_usage [R] i32)   # cur = current node
+#   per candidate: cur     = where(node_start, usage0, cur)
+#                  over    = any((cur > high_q) & res_mask)
+#                  avail_ok= ~any((available <= 0) & res_mask)
+#                  propose = valid & over & avail_ok & ~blocked
+#                  subtract the masked metric from both on propose
+#
+# Three monotonicities make the flat scan reproduce the nested loops
+# bit-for-bit: ``over`` is monotone-false within a node (usage only
+# decreases), ``available`` is monotone nonincreasing (so the global
+# exhaustion exit persists across later nodes), and a ``blocked``
+# candidate (an evictor refusal) changes no state — so the per-candidate
+# (propose, over, avail_ok) stream is sufficient for the caller to
+# replay every host-side side effect (proposal order, detector resets,
+# early exits). ``blocked`` is how the arbiter's deferrals and the
+# evictor's refusals feed back: the caller re-runs the scan with the
+# refused candidate masked, and the decision prefix up to that candidate
+# is invariant (nothing earlier depended on it).
+#
+# All quantities are host int64; staging validates that every value AND
+# every reachable endpoint (available minus all masked metrics, per-node
+# usage minus that node's metrics) fits int32 and raises ValueError
+# otherwise — the x32 substrate contract (§24), loud instead of clipped.
+
+
+def sweep_candidate_bucket(n: int) -> int:
+    """Pad the flattened candidate axis to a power of two (min 8) so
+    recompiles stay logarithmic in storm size."""
+    n = int(n)
+    return max(8, 1 << max(n - 1, 0).bit_length())
+
+
+class SweepBatch(NamedTuple):
+    """The staged flattened candidate list, host order (node score
+    order, pod sort-key order within a node). All numpy, i32/bool."""
+
+    node_start: np.ndarray  # [K] bool: candidate i is its node's first
+    usage0: np.ndarray      # [K, R] i32: owning node's usage at entry
+    high_q: np.ndarray      # [K, R] i32: owning node's high quantities
+    metric: np.ndarray      # [K, R] i32: pod usage (0 where unknown)
+    has_metric: np.ndarray  # [K] bool: pod usage is known
+    valid: np.ndarray       # [K] bool: real row (False = padding)
+
+
+def _balance_sweep(node_start, usage0, high_q, metric, has_metric,
+                   valid, blocked, available0, res_mask):
+    def step(carry, xs):
+        avail, cur = carry
+        start, u0, hq, m, hm, ok, blk = xs
+        cur = jnp.where(start, u0, cur)
+        over = jnp.any((cur > hq) & res_mask)
+        avail_ok = ~jnp.any((avail <= 0) & res_mask)
+        propose = ok & over & avail_ok & ~blk
+        sub = jnp.where(propose & hm, jnp.where(res_mask, m, 0), 0)
+        return (avail - sub, cur - sub), (propose, over, avail_ok)
+
+    init = (available0, jnp.zeros_like(available0))
+    xs = (node_start, usage0, high_q, metric, has_metric, valid, blocked)
+    (avail, _), ys = jax.lax.scan(step, init, xs)
+    propose, over, avail_ok = ys
+    return propose, over, avail_ok, avail
+
+
+rebalance_sweep = DEVICE_OBS.jit(
+    "rebalance_sweep",
+    jax.jit(_balance_sweep, donate_argnums=(), static_argnums=()),
+)
+
+_I32_MIN = np.iinfo(np.int32).min
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _require_i32(name: str, arr: np.ndarray) -> None:
+    arr = np.asarray(arr)
+    if arr.size and (
+        int(arr.min()) < _I32_MIN or int(arr.max()) > _I32_MAX
+    ):
+        raise ValueError(
+            f"rebalance sweep {name} exceeds the int32 device domain "
+            f"[{int(arr.min())}, {int(arr.max())}] — the x32 substrate "
+            "contract (docs/DESIGN.md §24) requires quantities staged "
+            "in device units that fit i32"
+        )
+
+
+def run_balance_sweep(
+    batch: SweepBatch,
+    available: np.ndarray,   # [R] i64: absorbing headroom on low nodes
+    res_mask: np.ndarray,    # [R] bool: participating resources
+    blocked: np.ndarray,     # [K] bool: refused candidates (masked out)
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stage, pad, and run the sweep; return host (propose, over,
+    avail_ok) trimmed to the real candidate count."""
+    k = int(batch.valid.shape[0])
+    available = np.asarray(available, dtype=np.int64)
+    res_mask = np.asarray(res_mask, bool)
+    blocked = np.asarray(blocked, bool)
+    # endpoint validation: every staged value, plus the furthest the
+    # carry can travel (all masked metrics subtracted)
+    masked = np.where(res_mask[None, :], batch.metric, 0).astype(np.int64)
+    _require_i32("usage", batch.usage0)
+    _require_i32("high quantities", batch.high_q)
+    _require_i32("pod metrics", batch.metric)
+    _require_i32("available headroom", available)
+    _require_i32("available endpoint", available - masked.sum(axis=0))
+    if k:
+        if not batch.node_start[0]:
+            raise ValueError(
+                "sweep batch must open with a node_start candidate"
+            )
+        # per-node endpoint: entry usage minus that node's metric total
+        group = np.cumsum(np.asarray(batch.node_start, bool)) - 1
+        starts = np.flatnonzero(batch.node_start)
+        if starts.size:
+            node_total = np.zeros(
+                (starts.size, masked.shape[1]), dtype=np.int64
+            )
+            np.add.at(node_total, group, masked)
+            _require_i32(
+                "usage endpoint",
+                batch.usage0[starts].astype(np.int64) - node_total,
+            )
+    target = sweep_candidate_bucket(k)
+    if target != k:
+        DEVICE_OBS.note_padding("sweep_candidates", k, target)
+    pad = target - k
+
+    def pad1(a, fill=0):
+        if not pad:
+            return a
+        width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        return np.pad(a, width, constant_values=fill)
+
+    propose, over, avail_ok, _ = rebalance_sweep(
+        jnp.asarray(pad1(batch.node_start), dtype=bool),
+        jnp.asarray(pad1(batch.usage0), dtype=jnp.int32),
+        jnp.asarray(pad1(batch.high_q), dtype=jnp.int32),
+        jnp.asarray(pad1(batch.metric), dtype=jnp.int32),
+        jnp.asarray(pad1(batch.has_metric), dtype=bool),
+        jnp.asarray(pad1(batch.valid), dtype=bool),
+        jnp.asarray(pad1(blocked), dtype=bool),
+        jnp.asarray(available, dtype=jnp.int32),
+        jnp.asarray(res_mask, dtype=bool),
+    )
+    return (
+        np.asarray(propose, bool)[:k],
+        np.asarray(over, bool)[:k],
+        np.asarray(avail_ok, bool)[:k],
+    )
+
+
+def replay_sweep_host(
+    batch: SweepBatch,
+    available: np.ndarray,
+    res_mask: np.ndarray,
+    blocked: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pure-numpy replica of the scan, same flattened candidates — the
+    verify backend's second opinion (asserted bit-equal to the device
+    stream before anything is applied)."""
+    res_mask = np.asarray(res_mask, bool)
+    avail = np.asarray(available, dtype=np.int64).copy()
+    cur = np.zeros_like(avail)
+    k = int(batch.valid.shape[0])
+    propose = np.zeros(k, bool)
+    over_s = np.zeros(k, bool)
+    ok_s = np.zeros(k, bool)
+    for i in range(k):
+        if batch.node_start[i]:
+            cur = batch.usage0[i].astype(np.int64).copy()
+        over = bool(((cur > batch.high_q[i]) & res_mask).any())
+        avail_ok = not bool(((avail <= 0) & res_mask).any())
+        p = bool(batch.valid[i]) and over and avail_ok and not bool(
+            blocked[i]
+        )
+        if p and batch.has_metric[i]:
+            sub = np.where(res_mask, batch.metric[i], 0).astype(np.int64)
+            avail -= sub
+            cur -= sub
+        propose[i], over_s[i], ok_s[i] = p, over, avail_ok
+    return propose, over_s, ok_s

@@ -147,6 +147,14 @@ class Scheduler:
         #: API server (defaultpreemption). None = local cache only
         #: (standalone scheduler, no bus).
         self.evict_pod_fn = None
+        #: migration arbiter (control/migration.py, docs/DESIGN.md §27):
+        #: when set, every eviction source — preemption victims, defrag
+        #: drains, rebalance sweeps — passes through it before touching
+        #: the sink, and deferred victims stay placed. None = legacy
+        #: unthrottled eviction, bit-identical to pre-arbiter behavior.
+        self.migration_arbiter = None
+        #: monotone round key feeding the arbiter's per-round budget
+        self._migration_round = 0
         #: bind publisher (set by client.wiring.wire_scheduler): applies
         #: a round's committed placements back onto the bus. The serial
         #: loop's schedule_and_publish wrapper calls it inline; the
@@ -515,6 +523,9 @@ class Scheduler:
             # over. The pipelined loop preserves this ordering — a tick
             # begins only after the previous tick's publish retired.
             self._resv_inflight = {}
+            if self.migration_arbiter is not None:
+                self._migration_round += 1
+                self.migration_arbiter.begin_round(self._migration_round)
             self.expire_waiting(at0)
             self.reservation_controller.sync(at0)
             if not self.batched_placement:
@@ -734,7 +745,15 @@ class Scheduler:
                     continue
                 node_name, victims = nomination
                 victim_uids = sorted(v.uid for v in victims)
-                self._evict_victims(victim_uids)
+                admitted = self._evict_victims(
+                    victim_uids, source="preemption", node=node_name,
+                    now=now, all_or_nothing=True,
+                )
+                if victim_uids and not admitted:
+                    # the whole victim set deferred by the arbiter:
+                    # nothing evicted, no nomination — the preemptor
+                    # retries once budget frees (docs/DESIGN.md §27)
+                    continue
                 # later preemptors must see the eviction, not the stale
                 # view
                 wanted = set(victim_uids)
@@ -781,7 +800,14 @@ class Scheduler:
             PREEMPT_VICTIMS.inc(
                 {"outcome": "reprieved"}, n_cand - len(ordered_uids)
             )
-            self._evict_victims(sorted(ordered_uids))
+            admitted = self._evict_victims(
+                sorted(ordered_uids), source="preemption",
+                node=node_name, now=now, all_or_nothing=True,
+            )
+            if ordered_uids and not admitted:
+                # deferred whole-batch: the resident world keeps its
+                # rows, the hole stays unfree, no nomination
+                continue
             PREEMPT_VICTIMS.inc({"outcome": "evicted"}, len(ordered_uids))
             evict_resident_rows(
                 snapshot, arrays, resident, node_name, ordered_uids,
@@ -789,7 +815,45 @@ class Scheduler:
             )
             result.nominations[uid] = node_name
 
-    def _evict_victims(self, uids: List[str]) -> None:
+    def _evict_victims(
+        self,
+        uids: List[str],
+        source: str = "preemption",
+        node: Optional[str] = None,
+        now: Optional[float] = None,
+        all_or_nothing: bool = False,
+    ) -> List[str]:
+        """Evict ``uids`` through the sink, arbitrated when a migration
+        arbiter is wired (docs/DESIGN.md §27). Returns the admitted
+        uids; deferred victims stay placed (typed + counted in the
+        arbiter's ring). ``all_or_nothing`` is the preemption contract —
+        a victim set is indivisible, a partial evict burns budget
+        without freeing the hole. Without an arbiter the behavior is
+        the legacy unthrottled loop, bit-identically."""
+        if self.migration_arbiter is not None and uids:
+            from koordinator_tpu.obs.timeline import lane_of
+
+            victims = [self.cache.pods.get(uid) for uid in uids]
+            lanes = [None if v is None else lane_of(v) for v in victims]
+            gangs = [None if v is None else v.gang for v in victims]
+            headroom: Dict[str, int] = {}
+            for gang in set(g for g in gangs if g):
+                spec = self.cache.gangs.get(gang)
+                if spec is None:
+                    continue
+                live = sum(
+                    1 for p in self.cache.pods.values()
+                    if p.gang == gang and p.node_name
+                )
+                headroom[gang] = max(live - spec.min_member, 0)
+            verdict = self.migration_arbiter.request(
+                source, node, uids, lanes=lanes, gangs=gangs,
+                gang_headroom=headroom, now=now,
+                all_or_nothing=all_or_nothing,
+            )
+            if not verdict.apply:
+                return []
+            uids = list(verdict.admitted)
         for uid in uids:
             victim = self.cache.pods.get(uid)
             if victim is None:
@@ -801,6 +865,7 @@ class Scheduler:
                 self.evict_pod_fn(victim)
             else:
                 self.remove_pod(victim)
+        return list(uids)
 
     def defrag_headroom(
         self,
@@ -855,9 +920,53 @@ class Scheduler:
                         f"oracle {want!r}"
                     )
         if got is not None and apply:
-            self._evict_victims(got[1])
-            DEFRAG_DRAINS.inc(amount=len(got[1]))
+            # arbitrated (docs/DESIGN.md §27): the manual API obeys the
+            # same budgets/cooldowns as the closed defrag loop; a
+            # deferred drain stays placed and the plan reports only the
+            # admitted slice. Partial admission is fine here — unlike a
+            # preemption victim set, each drain independently shrinks
+            # the hole's remaining deficit, and the defrag controller
+            # (or operator) retries after the cooldown.
+            admitted = self._evict_victims(
+                got[1], source="defrag", node=got[0], now=now,
+            )
+            DEFRAG_DRAINS.inc(amount=len(admitted))
+            got = (got[0], admitted)
         return got
+
+    def rebalance_sweep(self, plugin, now: Optional[float] = None) -> List[str]:
+        """Run one LoadAware Balance pass (descheduler/loadaware.py)
+        against the live cache, with evictions routed through the
+        scheduler's sink — and therefore through the migration arbiter
+        when one is wired (docs/DESIGN.md §27). The plugin's backend
+        field picks host/device/verify for the eviction walk itself.
+
+        Evictions land via ``remove_pod``/``evict_pod_fn`` exactly like
+        preemption victims, so they mark the cache's delta tracker and
+        the next solve round re-lowers only the touched node rows (the
+        ``evict_resident_rows`` one-row delta path) instead of paying a
+        full-cluster re-lower. Returns the evicted uids in sweep
+        order."""
+        from koordinator_tpu.descheduler.framework import Evictor
+
+        scheduler = self
+
+        class _ArbitratedSink(Evictor):
+            """Bridges the descheduler Evictor protocol onto the
+            scheduler's arbitrated eviction path: a deferral surfaces
+            as the protocol's refusal (False), which the sweep already
+            treats as continue-without-subtracting."""
+
+            def _do_evict(self, snapshot, pod, reason) -> bool:
+                return bool(scheduler._evict_victims(
+                    [pod.uid], source="rebalance", node=pod.node_name,
+                    now=now,
+                ))
+
+        snapshot = self.cache.snapshot(now=now)
+        sink = _ArbitratedSink()
+        plugin.balance(snapshot, sink)
+        return [p.uid for p in sink.evicted]
 
     def forget_assumed_unbound(self) -> List[str]:
         """Release every assumed-but-unbound pod back to pending,

@@ -155,6 +155,13 @@ class WorkingSetManager:
         self._faults: Dict[str, int] = {}
         self._oversubscribed = 0
         self.max_alloc_retries = int(max_alloc_retries)
+        #: migration-arbiter notification hook, ``(key, lane, reason)``
+        #: (set by the control-plane builder to ``arbiter.note`` —
+        #: docs/DESIGN.md §27). Demotions are UNDEFERRABLE — they are
+        #: the memory-pressure safety valve, so they are recorded and
+        #: counted against the disruption windows but never refused.
+        #: Called with no lock held (beside the demotion counter).
+        self.migration_hook: Optional[Callable[[str, str, str], None]] = None
         if budget_bytes:
             self.set_budget(budget_bytes)
 
@@ -342,6 +349,8 @@ class WorkingSetManager:
                                    reason)
                 victim.rung = RUNG_HOST
             WORKINGSET_DEMOTIONS.inc({"reason": reason})
+            if self.migration_hook is not None:
+                self.migration_hook(victim.key, victim.lane, reason)
             demoted += 1
         self._publish()
         return demoted
@@ -359,6 +368,7 @@ class WorkingSetManager:
             r = self._residents.get(key)
             obj = None if r is None else r.ref()
             rung_from = None if r is None else r.rung
+            lane = None if r is None else r.lane
         if obj is None or rung_from == RUNG_COLD or rung_from == rung:
             return False
         try:
@@ -376,6 +386,8 @@ class WorkingSetManager:
                 self._event_locked(r, rung_from, rung, reason)
                 r.rung = rung
         WORKINGSET_DEMOTIONS.inc({"reason": reason})
+        if self.migration_hook is not None:
+            self.migration_hook(key, lane, reason)
         self._publish()
         return True
 
@@ -413,6 +425,9 @@ class WorkingSetManager:
                                    "alloc-failure")
                 victim.rung = RUNG_COLD
             WORKINGSET_DEMOTIONS.inc({"reason": "alloc-failure"})
+            if self.migration_hook is not None:
+                self.migration_hook(victim.key, victim.lane,
+                                    "alloc-failure")
             self._publish()
             return 1
         return 0

@@ -76,6 +76,21 @@ HBM_FAULT_KINDS = (
                                  # demotions under live multi-tenant load
 )
 
+#: eviction-storm fault kinds (driven by the chaos arbitration suite
+#: against a live scheduler + migration arbiter, docs/DESIGN.md §27) —
+#: every one must pass through the MigrationArbiter: no declared budget
+#: exceeded in any window, every over-budget request deferred with a
+#: typed + counted refusal (never dropped silently), no eviction
+#: cascade, and final placements + node accounting bit-identical to a
+#: fault-free control arm
+EVICTION_STORM_FAULT_KINDS = (
+    "rebalance-wave",           # a LoadAware Balance sweep fired mid-run
+    "preemption-storm",         # a wave of unique-fit LS arrivals, each
+                                # placing only by evicting a BE resident
+    "budget-squeeze-mid-wave",  # arbiter budget transiently tightened
+                                # against already-admitted evictions
+)
+
 WARM_POOL_FAULT_KINDS = (
     "truncated-entry",          # torn write: the file ends mid-payload
     "bitflipped-entry",         # bit rot: bytes flipped under the header
@@ -180,6 +195,7 @@ class FaultSchedule:
                 kind not in FAULT_KINDS
                 and kind not in STATE_FAULT_KINDS
                 and kind not in HBM_FAULT_KINDS
+                and kind not in EVICTION_STORM_FAULT_KINDS
             ):
                 raise ValueError(f"unknown fault kind: {kind!r}")
 
@@ -661,5 +677,66 @@ def preemption_storm(seed: int, n_nodes: int = 24,
             priority_class=PriorityClass.PROD,
             priority=rng.randrange(5000, 9000),
             quota=quota,
+        ))
+    return nodes, residents, arrivals
+
+
+def eviction_storm_world(seed: int, n_nodes: int = 12,
+                         base_cpu: int = 4000, base_mem: int = 8192,
+                         step: int = 64):
+    """Seeded UNIQUE-FIT eviction-storm world for the arbitration
+    chaos suite (docs/DESIGN.md §27, :data:`EVICTION_STORM_FAULT_KINDS`).
+
+    Node ``i`` allocates ``(base_cpu + i*step, base_mem + (N-1-i)*step)``
+    — a two-resource staircase where arrival ``i`` requests EXACTLY
+    node ``i``'s shape, so it fits node ``i`` and no other (every
+    ``j < i`` is CPU-short, every ``j > i`` is memory-short). Each node
+    starts filled by exactly one preemptible BE resident of the same
+    shape. Consequences, by construction:
+
+    - every LS arrival has exactly one feasible node and exactly one
+      victim there, so the FINAL placement set is order-independent —
+      deferrals and budget squeezes reshuffle WHEN evictions land,
+      never WHERE, which is what lets the property test demand
+      bit-identical final placements against the fault-free arm;
+    - an evicted BE resident fits nowhere else while the storm is in
+      flight (its unique node is being taken by its arrival), so the
+      world cannot cascade by geometry: any observed cascade is an
+      arbitration bug, not storm noise.
+
+    Resident priorities are seeded jitter (the arbiter must not depend
+    on them); arrival priorities strictly dominate. Returns
+    ``(nodes, residents, arrivals)``; residents carry ``node_name``."""
+    from koordinator_tpu.apis.extension import (
+        PriorityClass,
+        QoSClass,
+        ResourceName,
+    )
+    from koordinator_tpu.apis.types import NodeSpec, PodSpec
+
+    CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+    rng = random.Random(seed)
+    nodes, residents, arrivals = [], [], []
+    for i in range(n_nodes):
+        cpu = base_cpu + i * step
+        mem = base_mem + (n_nodes - 1 - i) * step
+        nodes.append(NodeSpec(
+            name=f"evstorm-n{i}",
+            allocatable={CPU: cpu, MEM: mem},
+        ))
+        residents.append(PodSpec(
+            name=f"evstorm-be-{i}",
+            node_name=f"evstorm-n{i}",
+            requests={CPU: cpu, MEM: mem},
+            qos=QoSClass.BE,
+            priority=rng.randrange(100, 400),
+            assign_time=float(rng.randrange(0, 1000)),
+        ))
+        arrivals.append(PodSpec(
+            name=f"evstorm-ls-{i}",
+            requests={CPU: cpu, MEM: mem},
+            qos=QoSClass.LS,
+            priority_class=PriorityClass.PROD,
+            priority=5000 + rng.randrange(0, 4000),
         ))
     return nodes, residents, arrivals

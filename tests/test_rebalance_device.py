@@ -1,0 +1,283 @@
+"""Differential: the device Balance sweep vs the host classifier.
+
+The host LoadAware eviction walk (descheduler/loadaware.py, itself
+bit-parity-tested against the scalar oracle in test_rebalance_oracle)
+is the semantics oracle for the device sweep (ops/rebalance.py
+``run_balance_sweep``: one lax.scan over the flattened host-ordered
+candidate list). These tests require the ORDERED eviction sequence to
+match exactly across backends over randomized clusters, through the
+refusal fixpoint, the dry-run proposal path, and the multi-sweep
+debounce — plus the numeric contracts: the reference's float64
+threshold truncation, the strict over-threshold compare, the i32
+staging domain, and the candidate bucket law.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_rebalance_oracle import RecordingEvictor, random_cluster
+
+from koordinator_tpu.apis.extension import ResourceName
+from koordinator_tpu.apis.types import (
+    ClusterSnapshot,
+    NodeMetric,
+    NodeSpec,
+    PodSpec,
+)
+from koordinator_tpu.descheduler import LowNodeLoad, LowNodeLoadArgs, NodePool
+from koordinator_tpu.ops.rebalance import (
+    SweepBatch,
+    replay_sweep_host,
+    run_balance_sweep,
+    sweep_candidate_bucket,
+    threshold_quantities,
+)
+
+CPU, MEM = ResourceName.CPU, ResourceName.MEMORY
+
+
+def _args(rng, backend, consecutive=1):
+    return LowNodeLoadArgs(
+        backend=backend,
+        node_pools=[NodePool(
+            low_thresholds={CPU: int(rng.integers(20, 50)),
+                            MEM: int(rng.integers(20, 60))},
+            high_thresholds={CPU: int(rng.integers(55, 80)),
+                             MEM: int(rng.integers(65, 90))},
+            resource_weights={CPU: int(rng.integers(1, 4)),
+                              MEM: int(rng.integers(1, 4))},
+            consecutive_abnormalities=consecutive,
+        )],
+    )
+
+
+def _sweep(backend, seed, evictor_cls=RecordingEvictor, sweeps=1,
+           consecutive=1, dry_run=False):
+    rng = np.random.default_rng(seed)
+    snapshot = random_cluster(rng)
+    args = _args(rng, backend, consecutive=consecutive)
+    args.dry_run = dry_run
+    plugin = LowNodeLoad(args)
+    sequences, proposals = [], []
+    for _ in range(sweeps):
+        evictor = evictor_cls()
+        plugin.balance(snapshot, evictor)
+        sequences.append(evictor.sequence)
+        proposals.append([p.uid for p in plugin.last_proposals])
+    return sequences, proposals
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_device_sweep_ordered_parity(seed):
+    """Victim sets AND order: the device sweep must reproduce the host
+    walk's eviction sequence exactly."""
+    want, _ = _sweep("host", seed)
+    got, _ = _sweep("device", seed)
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_verify_backend_round(seed):
+    """backend="verify" runs the device sweep, asserts its decision
+    streams bit-equal to the pure-host replica, then applies — the
+    applied sequence still matches the host walk."""
+    want, _ = _sweep("host", 200 + seed)
+    got, _ = _sweep("verify", 200 + seed)
+    assert got == want
+
+
+def test_parity_suite_not_vacuous():
+    total = 0
+    for seed in range(12):
+        seqs, _ = _sweep("host", seed)
+        total += len(seqs[0])
+    assert total > 0, "no seed produced evictions: the suite is vacuous"
+
+
+class RefusingEvictor(RecordingEvictor):
+    """Deterministically refuses ~30% of evictions: exercises the
+    device backend's blocked-mask fixpoint re-scan (a refusal must not
+    perturb decisions for the already-walked prefix)."""
+
+    def __init__(self, seed):
+        super().__init__()
+        self._rng = np.random.default_rng(seed)
+        self.refused = 0
+
+    def _do_evict(self, snapshot, pod, reason) -> bool:
+        if self._rng.random() < 0.3:
+            self.refused += 1
+            return False
+        return True
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_refusal_fixpoint_parity(seed):
+    """Both backends call the evictor in the SAME sequence, so the
+    refusal rng draws align and the applied sequences must match."""
+    results = {}
+    for backend in ("host", "device", "verify"):
+        rng = np.random.default_rng(seed)
+        snapshot = random_cluster(rng)
+        plugin = LowNodeLoad(_args(rng, backend))
+        evictor = RefusingEvictor(seed=1000 + seed)
+        plugin.balance(snapshot, evictor)
+        results[backend] = (evictor.sequence, evictor.refused)
+    assert results["device"] == results["host"]
+    assert results["verify"] == results["host"]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_dry_run_proposal_parity(seed):
+    """Dry run proposes (and keeps subtracting, per the reference) but
+    never evicts: identical proposal lists, zero evictions."""
+    want_seq, want_prop = _sweep("host", 400 + seed, dry_run=True)
+    got_seq, got_prop = _sweep("device", 400 + seed, dry_run=True)
+    assert got_prop == want_prop
+    assert want_seq == got_seq == [[]]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_multi_sweep_debounce_parity(seed):
+    """consecutive_abnormalities=2: the first sweep only arms the
+    anomaly counters, the second evicts — streak state must carry
+    identically across backends."""
+    want, _ = _sweep("host", 600 + seed, sweeps=3, consecutive=2)
+    got, _ = _sweep("device", 600 + seed, sweeps=3, consecutive=2)
+    assert got == want
+    assert want[0] == [], "debounce did not suppress the first sweep"
+
+
+# -- numeric contracts -------------------------------------------------------
+
+
+def test_float64_threshold_truncation():
+    """The reference computes quantities through float64 and truncates:
+    29% of 100000 is 28999.999... -> 28999, NOT 29000. Both the
+    resolver and the staged device compare must live on that value."""
+    alloc = np.zeros((1, 8), dtype=np.int64)
+    alloc[0, int(CPU)] = 100000
+    usage = np.zeros((1, 8), dtype=np.int64)
+    low_p = np.full(8, -1, dtype=np.int64)
+    high_p = np.full(8, -1, dtype=np.int64)
+    high_p[int(CPU)] = 29
+    low_p[int(CPU)] = 10
+    _low_q, high_q, mask = threshold_quantities(
+        usage, alloc, low_p, high_p, active=np.ones(1, bool))
+    assert int(high_q[0, int(CPU)]) == 28999
+    assert bool(mask[int(CPU)])
+
+
+def _edge_world(cpu_usage):
+    """One over-threshold node (exactly at/over the truncated edge) and
+    one empty low node to absorb; high CPU threshold 29% of 100000."""
+    nodes = [
+        NodeSpec(name="hot", allocatable={CPU: 100000, MEM: 1 << 20}),
+        NodeSpec(name="cold", allocatable={CPU: 100000, MEM: 1 << 20}),
+    ]
+    pods = [PodSpec(name="p0", node_name="hot",
+                    requests={CPU: 100, MEM: 64})]
+    metrics = {
+        "hot": NodeMetric(
+            node_name="hot",
+            node_usage={CPU: cpu_usage, MEM: 1024},
+            pod_usages={pods[0].uid: {CPU: cpu_usage, MEM: 1024}},
+            update_time=100.0),
+        "cold": NodeMetric(node_name="cold",
+                           node_usage={CPU: 0, MEM: 0},
+                           update_time=100.0),
+    }
+    return ClusterSnapshot(nodes=nodes, pods=pods, node_metrics=metrics,
+                           now=120.0)
+
+
+@pytest.mark.parametrize("backend", ["host", "device", "verify"])
+def test_percent_rounding_threshold_edges(backend):
+    """The over compare is STRICT (> high_q): usage 28999 (== the
+    truncated quantity) stays put, 29000 evicts — on every backend.
+    The integer-percent config value 29000 would mistakenly keep if the
+    sweep recomputed 29% as 29000."""
+    args = LowNodeLoadArgs(backend=backend, node_pools=[NodePool(
+        low_thresholds={CPU: 10}, high_thresholds={CPU: 29},
+    )])
+    at_edge = RecordingEvictor()
+    LowNodeLoad(args).balance(_edge_world(28999), at_edge)
+    assert at_edge.sequence == []
+    over_edge = RecordingEvictor()
+    LowNodeLoad(args).balance(_edge_world(29000), over_edge)
+    assert [n for n, _ in over_edge.sequence] == ["hot"]
+
+
+# -- the staged kernel -------------------------------------------------------
+
+
+def _random_batch(rng, k, r=4):
+    node_start = np.zeros(k, bool)
+    node_start[0] = True
+    for i in range(1, k):
+        node_start[i] = rng.random() < 0.3
+    return SweepBatch(
+        node_start=node_start,
+        usage0=rng.integers(0, 10000, size=(k, r)).astype(np.int64),
+        high_q=rng.integers(0, 9000, size=(k, r)).astype(np.int64),
+        metric=rng.integers(0, 500, size=(k, r)).astype(np.int64),
+        has_metric=rng.random(k) < 0.8,
+        valid=rng.random(k) < 0.9,
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_kernel_matches_host_replay(seed):
+    rng = np.random.default_rng(seed)
+    k = int(rng.integers(1, 40))
+    batch = _random_batch(rng, k)
+    available = rng.integers(0, 5000, size=4).astype(np.int64)
+    res_mask = rng.random(4) < 0.7
+    blocked = rng.random(k) < 0.2
+    got = run_balance_sweep(batch, available, res_mask, blocked)
+    want = replay_sweep_host(batch, available, res_mask, blocked)
+    for g, w, name in zip(got, want, ("propose", "over", "avail_ok")):
+        np.testing.assert_array_equal(g, w, err_msg=name)
+
+
+def test_sweep_candidate_bucket_values():
+    assert [sweep_candidate_bucket(n) for n in (0, 1, 7, 8, 9, 100)] == [
+        8, 8, 8, 8, 16, 128]
+    # monotone power-of-two law: padding shrinks recompiles to log(n)
+    for n in range(1, 300):
+        b = sweep_candidate_bucket(n)
+        assert b >= n and (b & (b - 1)) == 0
+
+
+def test_i32_overflow_raises():
+    rng = np.random.default_rng(0)
+    batch = _random_batch(rng, 4)
+    batch.usage0[0, 0] = np.int64(1) << 40
+    with pytest.raises(ValueError, match="int32 device domain"):
+        run_balance_sweep(batch, np.zeros(4, np.int64),
+                          np.ones(4, bool), np.zeros(4, bool))
+
+
+def test_available_endpoint_overflow_raises():
+    """The carry's furthest travel (all masked metrics subtracted) must
+    stay i32 even when every individual staged value fits."""
+    rng = np.random.default_rng(1)
+    batch = _random_batch(rng, 4)
+    available = np.full(4, np.iinfo(np.int32).min + 100, dtype=np.int64)
+    with pytest.raises(ValueError, match="int32 device domain"):
+        run_balance_sweep(batch, available, np.ones(4, bool),
+                          np.zeros(4, bool))
+
+
+def test_batch_must_open_with_node_start():
+    rng = np.random.default_rng(2)
+    batch = _random_batch(rng, 4)
+    batch.node_start[0] = False
+    with pytest.raises(ValueError, match="node_start"):
+        run_balance_sweep(batch, np.zeros(4, np.int64),
+                          np.ones(4, bool), np.zeros(4, bool))

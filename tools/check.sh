@@ -115,6 +115,21 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_slo_controller
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_slo_controller.py \
     -q -m chaos -k slo -p no:cacheprovider
 
+echo "== migration smoke =="
+# the arbitrated eviction control plane (ISSUE 20): the arbiter's
+# budget/refusal-precedence units + replay determinism, the device
+# Balance sweep's ordered bit-parity against the host walk (victim
+# sets AND order, refusal fixpoint, verify backend), and the seeded
+# eviction-storm property — budgets never exceeded in any window, no
+# cascade, typed + counted deferrals, final placements bit-identical
+# to the fault-free control arm — rides the chaos marker
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_migration.py \
+    -q -k "not chaos" -p no:cacheprovider
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_rebalance_device.py \
+    -q -k "parity or edges or bucket" -p no:cacheprovider
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/test_migration.py \
+    -q -m chaos -p no:cacheprovider
+
 echo "== sharded + multi-tenant + warm-pool + streaming bench budgets =="
 # the measured sharded/multi-tenant/warm-pool/streaming legs are
 # budget-gated (ISSUES 10/11/13/14): a scaling, merge-overhead,
